@@ -1,0 +1,21 @@
+"""Root conftest: force an 8-device CPU platform BEFORE the jax backend initializes, so
+every multi-device test runs the real sharded code path without TPU hardware (the
+fake-backend layer the reference lacked — SURVEY §4).
+
+Note: this environment pre-imports jax via a sitecustomize with JAX_PLATFORMS=axon, so
+plain env vars are too late; ``jax.config.update`` still works because the backend
+itself initializes lazily at first device query.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,29 @@
+from tensorflowdistributedlearning_tpu.models.layers import (
+    SplitSeparableConv2D,
+    fixed_padding,
+    subsample,
+    upsample,
+)
+from tensorflowdistributedlearning_tpu.models.resnet import (
+    ResNetBackbone,
+    ResNetClassifier,
+    ResNetSegmentation,
+    build_model,
+)
+from tensorflowdistributedlearning_tpu.models.xception import (
+    Xception41,
+    XceptionBackbone,
+)
+
+__all__ = [
+    "SplitSeparableConv2D",
+    "fixed_padding",
+    "subsample",
+    "upsample",
+    "ResNetBackbone",
+    "ResNetClassifier",
+    "ResNetSegmentation",
+    "build_model",
+    "Xception41",
+    "XceptionBackbone",
+]

@@ -1,0 +1,159 @@
+"""Shared layer library (reference: core/layers.py).
+
+All layers are NHWC — on TPU, XLA chooses physical layouts, so the reference's
+NCHW/NHWC dual-path plumbing (core/layers.py:53-109 carried transposes because
+``tf.image`` is NHWC-only) collapses away; the public API still accepts NCHW at the
+boundary (see train/trainer.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# slim's variance_scaling_initializer() defaults: factor=2.0, mode='FAN_IN', truncated
+# normal — i.e. He init (reference: core/resnet.py:377 used it for every conv).
+conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_in", "truncated_normal")
+
+
+def fixed_padding(
+    x: jax.Array, kernel_size: int, mode: str = "constant", rate: int = 1
+) -> jax.Array:
+    """Explicit spatial padding independent of input size (reference:
+    core/layers.py:53-79; the rate-aware effective-kernel form is reference:
+    core/xception.py:18-36). ``x`` is NHWC."""
+    effective = kernel_size + (kernel_size - 1) * (rate - 1)
+    pad_total = effective - 1
+    pad_beg = pad_total // 2
+    pad_end = pad_total - pad_beg
+    return jnp.pad(
+        x, [(0, 0), (pad_beg, pad_end), (pad_beg, pad_end), (0, 0)], mode=mode
+    )
+
+
+def subsample(x: jax.Array, stride: int) -> jax.Array:
+    """Spatial subsampling by strided slicing — the effect of slim's
+    ``resnet_utils.subsample`` (1x1 max-pool with stride) used for identity shortcuts
+    (reference: core/resnet.py:76, 131)."""
+    if stride == 1:
+        return x
+    return x[:, ::stride, ::stride, :]
+
+
+def upsample(x: jax.Array, out_hw: Tuple[int, int]) -> jax.Array:
+    """Bilinear upsampling with symmetric edge padding (reference: core/layers.py:83-109).
+
+    The reference padded 1 px SYMMETRIC on each side, resized to (h+4, w+4) and trimmed
+    2 px per side so interpolation never reads a zero halo. Same scheme here with
+    ``jax.image.resize``; no layout transposes are needed on TPU. (The reference also
+    read ``out_shape`` as (width, height) — harmless there because every call site was
+    square; here the contract is unambiguously (height, width).)
+    """
+    h, w = int(out_hw[0]), int(out_hw[1])
+    x = fixed_padding(x, 3, mode="symmetric")
+    n, _, _, c = x.shape
+    x = jax.image.resize(x, (n, h + 4, w + 4, c), method="bilinear")
+    return x[:, 2:-2, 2:-2, :]
+
+
+class ConvBN(nn.Module):
+    """Conv2D + BatchNorm + activation, the slim ``conv2d`` arg_scope default
+    (reference: core/resnet.py:378-383: conv with He init, BN normalizer, relu).
+    With ``use_bn=False`` it is a plain conv with bias and no activation — the
+    shortcut/final-projection flavor (reference: core/resnet.py:78-80, 147-149).
+    """
+
+    features: int
+    kernel_size: int = 3
+    stride: int = 1
+    rate: int = 1
+    use_bn: bool = True
+    activation: Optional[Callable[[jax.Array], jax.Array]] = nn.relu
+    bn_decay: float = 0.99
+    bn_epsilon: float = 0.001
+    bn_scale: bool = True
+    bn_axis_name: Optional[str] = None
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        x = nn.Conv(
+            self.features,
+            (self.kernel_size, self.kernel_size),
+            strides=(self.stride, self.stride),
+            kernel_dilation=(self.rate, self.rate),
+            padding="SAME",
+            use_bias=not self.use_bn,
+            kernel_init=conv_kernel_init,
+            dtype=self.dtype,
+            name="conv",
+        )(x)
+        if self.use_bn:
+            x = nn.BatchNorm(
+                use_running_average=not train,
+                momentum=self.bn_decay,
+                epsilon=self.bn_epsilon,
+                use_scale=self.bn_scale,
+                axis_name=self.bn_axis_name,
+                dtype=self.dtype,
+                name="bn",
+            )(x)
+        if self.activation is not None:
+            x = self.activation(x)
+        return x
+
+
+class SplitSeparableConv2D(nn.Module):
+    """Separable conv split into depthwise and pointwise with an activation between
+    (reference: core/layers.py:7-49 — it differs from fused separable conv exactly in
+    that intermediate activation). The depthwise kernel uses truncated-normal
+    stddev 0.33 and the pointwise stddev 0.06, as in the reference; the pointwise conv
+    carries BN + relu (it lowered to slim.conv2d under the resnet arg_scope), the
+    depthwise carries plain relu (slim.separable_conv2d defaults).
+    """
+
+    features: int
+    kernel_size: int = 3
+    rate: int = 1
+    bn_decay: float = 0.99
+    bn_epsilon: float = 0.001
+    bn_scale: bool = True
+    bn_axis_name: Optional[str] = None
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        in_ch = x.shape[-1]
+        x = nn.Conv(
+            in_ch,
+            (self.kernel_size, self.kernel_size),
+            kernel_dilation=(self.rate, self.rate),
+            padding="SAME",
+            feature_group_count=in_ch,
+            use_bias=True,
+            kernel_init=nn.initializers.truncated_normal(stddev=0.33),
+            dtype=self.dtype,
+            name="depthwise",
+        )(x)
+        x = nn.relu(x)
+        x = nn.Conv(
+            self.features,
+            (1, 1),
+            use_bias=False,
+            kernel_init=nn.initializers.truncated_normal(stddev=0.06),
+            dtype=self.dtype,
+            name="pointwise",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=self.bn_decay,
+            epsilon=self.bn_epsilon,
+            use_scale=self.bn_scale,
+            axis_name=self.bn_axis_name,
+            dtype=self.dtype,
+            name="pointwise_bn",
+        )(x)
+        return nn.relu(x)

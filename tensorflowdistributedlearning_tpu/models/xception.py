@@ -1,0 +1,285 @@
+"""Xception-41 backbone as Flax modules (reference: core/xception.py).
+
+The reference's Xception was dead code with three blocking defects (SURVEY §2.4.8-10):
+the per-unit loop body was dedented so only one unit per block was ever built
+(core/xception.py:272-275), the root block referenced an unimported ``resnet_utils``
+(core/xception.py:352), and its batch-norm arg_scope covered only ``net = inputs``
+(core/xception.py:345-346). This implementation is the working network those fragments
+describe — the DeepLab Xception-41: every conv is followed by batch norm, all units are
+built, and the root is two plain 3x3 convs (32 stride-2, then 64).
+
+Structure (reference: core/xception.py:405-465):
+  entry_flow:  block1 [128x3] conv-skip s2 | block2 [256x3] conv-skip s2 |
+               block3 [728x3] conv-skip s2
+  middle_flow: block1 [728x3] sum-skip s1 x 8 units
+  exit_flow:   block1 [728,1024,1024] conv-skip s2 |
+               block2 [1536,1536,2048] no-skip s1, activation inside separable convs,
+               unit_rate_list = multi_grid
+Atrous output_stride control mirrors the ResNet stacker but divides by the root's
+stride of 2 (reference: core/xception.py:347-351).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensorflowdistributedlearning_tpu.config import ModelConfig
+from tensorflowdistributedlearning_tpu.models.layers import (
+    ConvBN,
+    conv_kernel_init,
+    fixed_padding,
+)
+
+
+class SeparableConvSame(nn.Module):
+    """Depthwise + pointwise conv pair with BN after each, optional activation inside,
+    and explicit-padding alignment for strides (reference: core/xception.py:39-128)."""
+
+    features: int
+    kernel_size: int = 3
+    stride: int = 1
+    rate: int = 1
+    activation_inside: bool = False
+    bn_decay: float = 0.99
+    bn_epsilon: float = 0.001
+    bn_scale: bool = True
+    bn_axis_name: Optional[str] = None
+    dtype: Optional[jnp.dtype] = None
+
+    def _bn(self, name: str, x: jax.Array, train: bool) -> jax.Array:
+        return nn.BatchNorm(
+            use_running_average=not train,
+            momentum=self.bn_decay,
+            epsilon=self.bn_epsilon,
+            use_scale=self.bn_scale,
+            axis_name=self.bn_axis_name,
+            dtype=self.dtype,
+            name=name,
+        )(x)
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        in_ch = x.shape[-1]
+        if self.stride > 1:
+            x = fixed_padding(x, self.kernel_size, rate=self.rate)
+            padding = "VALID"
+        else:
+            padding = "SAME"
+        x = nn.Conv(
+            in_ch,
+            (self.kernel_size, self.kernel_size),
+            strides=(self.stride, self.stride),
+            kernel_dilation=(self.rate, self.rate),
+            padding=padding,
+            feature_group_count=in_ch,
+            use_bias=False,
+            kernel_init=nn.initializers.truncated_normal(stddev=0.33),
+            dtype=self.dtype,
+            name="depthwise",
+        )(x)
+        x = self._bn("depthwise_bn", x, train)
+        if self.activation_inside:
+            x = nn.relu(x)
+        x = nn.Conv(
+            self.features,
+            (1, 1),
+            use_bias=False,
+            kernel_init=nn.initializers.truncated_normal(stddev=0.06),
+            dtype=self.dtype,
+            name="pointwise",
+        )(x)
+        x = self._bn("pointwise_bn", x, train)
+        if self.activation_inside:
+            x = nn.relu(x)
+        return x
+
+
+@dataclasses.dataclass(frozen=True)
+class XceptionUnitSpec:
+    depth_list: Tuple[int, int, int]
+    skip_connection_type: str  # 'conv' | 'sum' | 'none'
+    stride: int
+    unit_rate_list: Tuple[int, int, int] = (1, 1, 1)
+    activation_inside: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class XceptionBlockSpec:
+    name: str
+    units: Tuple[XceptionUnitSpec, ...]
+
+
+class XceptionUnit(nn.Module):
+    """One Xception module: three pre-relu separable convs (stride on the third) plus a
+    conv/sum/no shortcut (reference: core/xception.py:131-228)."""
+
+    spec: XceptionUnitSpec
+    rate: int = 1
+    bn_decay: float = 0.99
+    bn_epsilon: float = 0.001
+    bn_scale: bool = True
+    bn_axis_name: Optional[str] = None
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        spec = self.spec
+        common = dict(
+            bn_decay=self.bn_decay,
+            bn_epsilon=self.bn_epsilon,
+            bn_scale=self.bn_scale,
+            bn_axis_name=self.bn_axis_name,
+            dtype=self.dtype,
+        )
+        residual = x
+        for i in range(3):
+            residual = nn.relu(residual)
+            residual = SeparableConvSame(
+                spec.depth_list[i],
+                3,
+                stride=spec.stride if i == 2 else 1,
+                rate=self.rate * spec.unit_rate_list[i],
+                activation_inside=spec.activation_inside,
+                name=f"separable_conv{i + 1}",
+                **common,
+            )(residual, train)
+        if spec.skip_connection_type == "conv":
+            shortcut = nn.Conv(
+                spec.depth_list[-1],
+                (1, 1),
+                strides=(spec.stride, spec.stride),
+                use_bias=False,
+                kernel_init=conv_kernel_init,
+                dtype=self.dtype,
+                name="shortcut",
+            )(x)
+            shortcut = nn.BatchNorm(
+                use_running_average=not train,
+                momentum=self.bn_decay,
+                epsilon=self.bn_epsilon,
+                use_scale=self.bn_scale,
+                axis_name=self.bn_axis_name,
+                dtype=self.dtype,
+                name="shortcut_bn",
+            )(shortcut)
+            return residual + shortcut
+        if spec.skip_connection_type == "sum":
+            return residual + x
+        if spec.skip_connection_type == "none":
+            return residual
+        raise ValueError("Unsupported skip connection type.")
+
+
+def xception_41_block_specs(
+    multi_grid: Tuple[int, int, int] = (1, 1, 1),
+) -> Tuple[XceptionBlockSpec, ...]:
+    """Xception-41 block table (reference: core/xception.py:405-465)."""
+
+    def block(name, depths, skip, num_units, stride, rates=(1, 1, 1), act_inside=False):
+        unit = XceptionUnitSpec(
+            depth_list=tuple(depths),
+            skip_connection_type=skip,
+            stride=stride,
+            unit_rate_list=tuple(rates),
+            activation_inside=act_inside,
+        )
+        return XceptionBlockSpec(name, (unit,) * num_units)
+
+    return (
+        block("entry_block1", (128, 128, 128), "conv", 1, 2),
+        block("entry_block2", (256, 256, 256), "conv", 1, 2),
+        block("entry_block3", (728, 728, 728), "conv", 1, 2),
+        block("middle_block1", (728, 728, 728), "sum", 8, 1),
+        block("exit_block1", (728, 1024, 1024), "conv", 1, 2),
+        block("exit_block2", (1536, 1536, 2048), "none", 1, 1, multi_grid, True),
+    )
+
+
+class XceptionBackbone(nn.Module):
+    """Xception feature extractor with atrous output_stride control (reference:
+    core/xception.py:295-364). Returns an end-point dict keyed by block name plus
+    'root' and 'features'."""
+
+    config: ModelConfig
+    multi_grid: Tuple[int, int, int] = (1, 1, 1)
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> Dict[str, jax.Array]:
+        cfg = self.config
+        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        x = x.astype(dtype)
+        common = dict(
+            bn_decay=cfg.batch_norm_decay,
+            bn_epsilon=cfg.batch_norm_epsilon,
+            bn_scale=cfg.batch_norm_scale,
+            bn_axis_name=self.bn_axis_name,
+            dtype=dtype,
+        )
+        output_stride = cfg.output_stride
+        if output_stride is not None:
+            if output_stride % 2 != 0:
+                raise ValueError("The output_stride needs to be a multiple of 2.")
+            # root conv1_1 strides by 2 (reference: core/xception.py:347-351)
+            target_stride = output_stride // 2
+        else:
+            target_stride = None
+
+        end_points: Dict[str, jax.Array] = {}
+        x = ConvBN(32, 3, stride=2, name="conv1_1", **common)(x, train)
+        x = ConvBN(64, 3, name="conv1_2", **common)(x, train)
+        end_points["root"] = x
+
+        current_stride = 1
+        rate = 1
+        for blk in xception_41_block_specs(self.multi_grid):
+            for i, unit in enumerate(blk.units):
+                if target_stride is not None and current_stride == target_stride:
+                    applied = dataclasses.replace(unit, stride=1)
+                    unit_rate = rate
+                    rate *= unit.stride
+                else:
+                    applied = unit
+                    unit_rate = 1
+                    current_stride *= unit.stride
+                x = XceptionUnit(
+                    spec=applied,
+                    rate=unit_rate,
+                    name=f"{blk.name}_unit{i + 1}",
+                    **common,
+                )(x, train)
+            end_points[blk.name] = x
+        if target_stride is not None and current_stride != target_stride:
+            raise ValueError("The target output_stride cannot be reached.")
+        end_points["features"] = x
+        return end_points
+
+
+class Xception41(nn.Module):
+    """Xception-41 classifier: backbone, global pool, pre-logits dropout (the
+    reference declared ``keep_prob=0.5`` but never used it, core/xception.py:298),
+    dense logits. With ``num_classes=None`` returns pooled features."""
+
+    config: ModelConfig
+    keep_prob: float = 0.5
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        cfg = self.config
+        backbone_cfg = dataclasses.replace(cfg, output_stride=None)
+        end_points = XceptionBackbone(
+            backbone_cfg, bn_axis_name=self.bn_axis_name, name="backbone"
+        )(x, train)
+        pooled = jnp.mean(end_points["features"], axis=(1, 2)).astype(jnp.float32)
+        if cfg.num_classes is None:
+            return pooled
+        pooled = nn.Dropout(rate=1.0 - self.keep_prob, deterministic=not train)(pooled)
+        return nn.Dense(cfg.num_classes, kernel_init=conv_kernel_init, name="logits")(
+            pooled
+        )

@@ -1,0 +1,31 @@
+from tensorflowdistributedlearning_tpu.ops.losses import (
+    lovasz_grad,
+    lovasz_hinge,
+    lovasz_hinge_flat,
+    lovasz_loss,
+    sigmoid_cross_entropy,
+    softmax_cross_entropy,
+)
+from tensorflowdistributedlearning_tpu.ops.metrics import (
+    IOU_THRESHOLDS,
+    Mean,
+    iou_scores,
+    mean_accuracy_scores,
+    miou,
+    mean_accuracy,
+)
+
+__all__ = [
+    "lovasz_grad",
+    "lovasz_hinge",
+    "lovasz_hinge_flat",
+    "lovasz_loss",
+    "sigmoid_cross_entropy",
+    "softmax_cross_entropy",
+    "IOU_THRESHOLDS",
+    "Mean",
+    "iou_scores",
+    "mean_accuracy_scores",
+    "miou",
+    "mean_accuracy",
+]

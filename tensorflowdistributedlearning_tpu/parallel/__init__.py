@@ -1,0 +1,34 @@
+"""SPMD device-mesh layer: the TPU-native replacement for the reference's
+MirroredStrategy/NCCL distribution config (reference: model.py:114-121, utils.py:6-8)."""
+
+from tensorflowdistributedlearning_tpu.parallel.mesh import (
+    BATCH_AXIS,
+    MODEL_AXIS,
+    SEQUENCE_AXIS,
+    available_devices,
+    batch_sharding,
+    local_batch_size,
+    make_mesh,
+    replicate,
+    replicated_sharding,
+    shard_batch,
+)
+from tensorflowdistributedlearning_tpu.parallel.collectives import (
+    pmean_tree,
+    psum_tree,
+)
+
+__all__ = [
+    "BATCH_AXIS",
+    "MODEL_AXIS",
+    "SEQUENCE_AXIS",
+    "available_devices",
+    "batch_sharding",
+    "local_batch_size",
+    "make_mesh",
+    "replicate",
+    "replicated_sharding",
+    "shard_batch",
+    "pmean_tree",
+    "psum_tree",
+]

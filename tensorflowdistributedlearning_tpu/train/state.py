@@ -1,0 +1,55 @@
+"""Functional training state.
+
+The reference's training state was implicit TF1 graph collections — GLOBAL_VARIABLES,
+UPDATE_OPS for the BN moving stats, the optimizer's slots, and the global step
+(reference: model.py:457-467). Here it is one explicit pytree, which is what makes
+donation, sharding, and Orbax checkpointing trivial.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import core, struct
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: core.FrozenDict
+    # BN moving statistics — the explicit form of the reference's UPDATE_OPS dance
+    # (reference: model.py:465-467)
+    batch_stats: core.FrozenDict
+    opt_state: optax.OptState
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads: Any, new_batch_stats: Any) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            batch_stats=new_batch_stats,
+            opt_state=new_opt_state,
+        )
+
+
+def create_train_state(
+    model, tx: optax.GradientTransformation, rng: jax.Array, sample_input: jax.Array
+) -> TrainState:
+    """Initialize parameters/BN stats from a sample input and wrap them with the
+    optimizer state."""
+    variables = model.init(rng, sample_input, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", core.FrozenDict())
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+        apply_fn=model.apply,
+        tx=tx,
+    )

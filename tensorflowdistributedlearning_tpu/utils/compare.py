@@ -1,0 +1,27 @@
+"""Best-model comparison (reference: utils.py:11-28).
+
+The reference's ``metric_comparisson(greater_is_better=True)`` returned
+``best > current`` — i.e. it told BestExporter to export when the NEW result was WORSE
+(reference: utils.py:23-28 against BestExporter's "True => current is better" contract).
+This implementation returns the comparison the right way around.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+
+def metric_comparison(
+    best_eval_result: Mapping[str, float],
+    current_eval_result: Mapping[str, float],
+    key: str = "metrics/mean_iou",
+    greater_is_better: bool = True,
+) -> bool:
+    """True iff ``current_eval_result[key]`` improves on ``best_eval_result[key]``."""
+    if not best_eval_result or key not in best_eval_result:
+        raise ValueError(f"best_eval_result cannot be empty and must contain {key!r}")
+    if not current_eval_result or key not in current_eval_result:
+        raise ValueError(f"current_eval_result cannot be empty and must contain {key!r}")
+    if greater_is_better:
+        return current_eval_result[key] > best_eval_result[key]
+    return current_eval_result[key] < best_eval_result[key]

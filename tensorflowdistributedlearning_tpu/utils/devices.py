@@ -1,0 +1,17 @@
+"""Device discovery (reference: utils.py:6-8 filtered ``device_lib.list_local_devices``
+for GPUs; the TPU-native equivalent asks the JAX runtime)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+
+
+def get_available_devices(platform: Optional[str] = None) -> List[str]:
+    """Return device name strings, e.g. ``['TPU:0', 'TPU:1']``.
+
+    ``platform`` filters like the reference filtered ``device_type == 'GPU'``.
+    """
+    devices = jax.devices() if platform is None else jax.devices(platform)
+    return [f"{d.platform.upper()}:{d.id}" for d in devices]

@@ -1,0 +1,14 @@
+"""Parameter counting (reference: model.py:444-445 computed ``n_params`` by summing
+variable shapes inside model_fn; here it is a pure pytree fold usable any time)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def count_params(params: Any) -> int:
+    """Total number of scalar parameters in a pytree."""
+    return int(sum(np.prod(x.shape) for x in jax.tree.leaves(params) if hasattr(x, "shape")))

@@ -1,0 +1,15 @@
+import jax
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devices = jax.devices()
+    assert len(devices) >= 8, "tests expect the 8-device CPU override from root conftest"
+    return devices[:8]
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

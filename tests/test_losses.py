@@ -1,0 +1,129 @@
+"""Lovász hinge tests against an independent numpy oracle (the reference shipped its
+loss untested — reference: core/losses.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.ops import (
+    lovasz_hinge,
+    lovasz_hinge_flat,
+    lovasz_loss,
+)
+from tensorflowdistributedlearning_tpu.ops.losses import (
+    sigmoid_cross_entropy,
+    softmax_cross_entropy,
+)
+
+
+def np_lovasz_hinge_flat(logits, labels):
+    """Straight-from-the-paper numpy implementation (Berman et al. 2018, Alg. 1)."""
+    signs = 2.0 * labels - 1.0
+    errors = 1.0 - logits * signs
+    order = np.argsort(-errors, kind="stable")
+    errors_sorted = errors[order]
+    gt_sorted = labels[order]
+    gts = gt_sorted.sum()
+    intersection = gts - np.cumsum(gt_sorted)
+    union = gts + np.cumsum(1.0 - gt_sorted)
+    jaccard = 1.0 - intersection / union
+    jaccard[1:] = jaccard[1:] - jaccard[:-1]
+    return float(np.maximum(errors_sorted, 0.0) @ jaccard)
+
+
+def test_matches_numpy_oracle(rng):
+    logits = rng.normal(size=128).astype(np.float32)
+    labels = (rng.random(128) > 0.6).astype(np.float32)
+    got = float(lovasz_hinge_flat(jnp.asarray(logits), jnp.asarray(labels)))
+    want = np_lovasz_hinge_flat(logits, labels)
+    assert got == pytest.approx(want, rel=1e-5)
+
+
+def test_perfect_prediction_low_loss(rng):
+    labels = (rng.random(64) > 0.5).astype(np.float32)
+    logits = (2.0 * labels - 1.0) * 50.0  # confidently correct
+    loss = float(lovasz_hinge_flat(jnp.asarray(logits), jnp.asarray(labels)))
+    assert loss == pytest.approx(0.0, abs=1e-5)
+
+
+def test_wrong_prediction_high_loss(rng):
+    labels = (rng.random(64) > 0.5).astype(np.float32)
+    logits = -(2.0 * labels - 1.0) * 50.0  # confidently wrong
+    loss = float(lovasz_hinge_flat(jnp.asarray(logits), jnp.asarray(labels)))
+    assert loss > 1.0
+
+
+def test_all_background_image():
+    # empty ground truth: union accumulates, intersection stays 0 — loss is finite and
+    # pushes logits negative
+    labels = np.zeros(32, np.float32)
+    logits = np.full(32, 0.5, np.float32)
+    loss = float(lovasz_hinge_flat(jnp.asarray(logits), jnp.asarray(labels)))
+    assert np.isfinite(loss) and loss > 0
+
+
+def test_per_image_averages(rng):
+    logits = rng.normal(size=(4, 8, 8)).astype(np.float32)
+    labels = (rng.random((4, 8, 8)) > 0.5).astype(np.float32)
+    per_image = float(lovasz_hinge(jnp.asarray(logits), jnp.asarray(labels)))
+    manual = np.mean(
+        [np_lovasz_hinge_flat(l.ravel(), y.ravel()) for l, y in zip(logits, labels)]
+    )
+    assert per_image == pytest.approx(manual, rel=1e-5)
+
+
+def test_ignore_mask_matches_dropping_pixels(rng):
+    """Fixed-shape void handling must equal the reference's dynamic boolean_mask
+    (core/losses.py:68-80): compare against the oracle run on only the valid pixels."""
+    logits = rng.normal(size=64).astype(np.float32)
+    labels = (rng.random(64) > 0.5).astype(np.float32)
+    labels[rng.random(64) < 0.3] = 255.0  # void label
+    got = float(
+        lovasz_hinge(
+            jnp.asarray(logits)[None], jnp.asarray(labels)[None], ignore=255
+        )
+    )
+    keep = labels != 255.0
+    want = np_lovasz_hinge_flat(logits[keep], labels[keep])
+    assert got == pytest.approx(want, rel=1e-4)
+
+
+def test_all_void_image_zero_loss():
+    """All-void image yields 0 (the reference's tf.cond arm, core/losses.py:59-64)."""
+    logits = jnp.ones((1, 16))
+    labels = jnp.full((1, 16), 255.0)
+    got = float(lovasz_hinge(logits, labels, ignore=255))
+    assert got == pytest.approx(0.0, abs=1e-6)
+
+
+def test_lovasz_loss_layout_wrappers(rng):
+    y = (rng.random((2, 8, 8, 1)) > 0.5).astype(np.float32)
+    p = rng.normal(size=(2, 8, 8, 1)).astype(np.float32)
+    nhwc = float(lovasz_loss(jnp.asarray(y), jnp.asarray(p), "NHWC"))
+    nchw = float(
+        lovasz_loss(
+            jnp.asarray(y.transpose(0, 3, 1, 2)),
+            jnp.asarray(p.transpose(0, 3, 1, 2)),
+            "NCHW",
+        )
+    )
+    assert nhwc == pytest.approx(nchw, rel=1e-6)
+
+
+def test_gradients_finite_and_jittable(rng):
+    y = (rng.random((2, 8, 8, 1)) > 0.5).astype(np.float32)
+    p = rng.normal(size=(2, 8, 8, 1)).astype(np.float32)
+    grad = jax.jit(jax.grad(lambda logits: lovasz_loss(jnp.asarray(y), logits)))(
+        jnp.asarray(p)
+    )
+    assert np.all(np.isfinite(np.asarray(grad)))
+
+
+def test_aux_losses(rng):
+    logits = jnp.asarray(rng.normal(size=(8,)).astype(np.float32))
+    labels = jnp.asarray((rng.random(8) > 0.5).astype(np.float32))
+    assert np.isfinite(float(sigmoid_cross_entropy(logits, labels)))
+    cls_logits = jnp.asarray(rng.normal(size=(4, 10)).astype(np.float32))
+    cls_labels = jnp.asarray([1, 2, 3, 4])
+    assert np.isfinite(float(softmax_cross_entropy(cls_logits, cls_labels)))

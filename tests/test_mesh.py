@@ -1,0 +1,77 @@
+"""Mesh/sharding layer tests (the distribution config the reference never tested —
+reference: model.py:114-121, utils.py:6-8)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.parallel import (
+    BATCH_AXIS,
+    available_devices,
+    batch_sharding,
+    local_batch_size,
+    make_mesh,
+    replicate,
+    shard_batch,
+)
+from tensorflowdistributedlearning_tpu.parallel.mesh import data_parallel_degree
+from tensorflowdistributedlearning_tpu.utils import get_available_devices
+
+
+def test_available_devices(eight_devices):
+    assert len(available_devices()) >= 8
+    names = get_available_devices()
+    assert all(isinstance(n, str) and ":" in n for n in names)
+
+
+def test_make_mesh_default_uses_all_devices():
+    mesh = make_mesh()
+    assert mesh.shape[BATCH_AXIS] == len(available_devices())
+
+
+def test_make_mesh_subset():
+    mesh = make_mesh(4)
+    assert mesh.shape[BATCH_AXIS] == 4
+    assert data_parallel_degree(mesh) == 4
+
+
+def test_make_mesh_model_axis():
+    mesh = make_mesh(8, model_parallel=2)
+    assert mesh.shape[BATCH_AXIS] == 4
+    assert mesh.shape["model"] == 2
+
+
+def test_make_mesh_too_many_devices_raises():
+    with pytest.raises(ValueError):
+        make_mesh(10_000)
+
+
+def test_make_mesh_indivisible_raises():
+    with pytest.raises(ValueError):
+        make_mesh(8, model_parallel=3)
+
+
+def test_local_batch_size_divisibility():
+    mesh = make_mesh(8)
+    assert local_batch_size(64, mesh) == 8
+    # the reference raised on indivisible global batches (model.py:156-159)
+    with pytest.raises(ValueError):
+        local_batch_size(63, mesh)
+
+
+def test_shard_batch_places_on_batch_axis():
+    mesh = make_mesh(8)
+    x = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+    sharded = shard_batch({"images": x}, mesh)["images"]
+    assert sharded.shape == (16, 3)
+    assert sharded.sharding.is_equivalent_to(batch_sharding(mesh, 2), 2)
+    np.testing.assert_array_equal(np.asarray(sharded), x)
+
+
+def test_replicate_tree():
+    mesh = make_mesh(8)
+    tree = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    rep = replicate(tree, mesh)
+    assert rep["w"].sharding.is_fully_replicated
+    np.testing.assert_array_equal(np.asarray(rep["w"]), np.ones((4, 4)))

@@ -1,0 +1,85 @@
+"""Metric tests pinning the reference's exact semantics (reference: core/metric.py),
+including its nonstandard score*(score>t) thresholding (SURVEY §2.4.14)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.ops import (
+    IOU_THRESHOLDS,
+    Mean,
+    iou_scores,
+    mean_accuracy,
+    mean_accuracy_scores,
+    miou,
+)
+from tensorflowdistributedlearning_tpu.ops.metrics import top1_accuracy_scores
+
+
+def expected_threshold_score(score: float) -> float:
+    return float(np.mean([score * (score > t) for t in IOU_THRESHOLDS]))
+
+
+def test_perfect_nonempty_mask():
+    y = jnp.ones((1, 4, 4, 1))
+    assert float(iou_scores(y, y)[0]) == pytest.approx(1.0)
+
+
+def test_empty_mask_rule():
+    """TP+FP+FN == 0 => score 1.0 (reference: core/metric.py:27-30)."""
+    y = jnp.zeros((1, 4, 4, 1))
+    assert float(iou_scores(y, y)[0]) == pytest.approx(1.0)
+
+
+def test_partial_overlap_thresholding():
+    # IoU = 2/6: pred covers 4 cells, truth covers 4 cells, overlap 2
+    t = np.zeros((1, 4, 4, 1), np.float32)
+    p = np.zeros((1, 4, 4, 1), np.float32)
+    t[0, :2, :2, 0] = 1  # 4 cells
+    p[0, 1:3, :2, 0] = 1  # 4 cells, 2 overlap
+    iou = 2 / 6
+    got = float(iou_scores(jnp.asarray(t), jnp.asarray(p))[0])
+    assert got == pytest.approx(expected_threshold_score(iou))
+
+
+def test_false_positive_on_empty_truth():
+    t = np.zeros((1, 4, 4, 1), np.float32)
+    p = np.zeros((1, 4, 4, 1), np.float32)
+    p[0, 0, 0, 0] = 1
+    got = float(iou_scores(jnp.asarray(t), jnp.asarray(p))[0])
+    assert got == pytest.approx(0.0)  # score 0, fails every threshold
+
+
+def test_streaming_miou_matches_tf_metrics_mean_semantics():
+    """Two updates must average over all images, as tf.metrics.mean's running
+    (total, count) does (reference: core/metric.py:42)."""
+    y1 = jnp.ones((2, 4, 4, 1))
+    y0 = jnp.zeros((2, 4, 4, 1))
+    bad = jnp.concatenate([jnp.ones((2, 2, 4, 1)), jnp.zeros((2, 2, 4, 1))], axis=1)
+    value1, state = miou(y1, y1)
+    assert float(value1) == pytest.approx(1.0)
+    value2, state = miou(y1, bad, state)  # IoU 0.5 per image -> thresholded 0
+    assert float(value2) == pytest.approx((1.0 + 1.0 + 0.0 + 0.0) / 4)
+    assert float(state.count) == 4
+
+
+def test_mean_state_merge_psum_equivalence():
+    a = Mean.empty().update(jnp.asarray([1.0, 0.0]))
+    b = Mean.empty().update(jnp.asarray([1.0, 1.0]))
+    merged = a.merge(b)
+    assert float(merged.compute()) == pytest.approx(0.75)
+
+
+def test_mean_accuracy():
+    t = jnp.asarray(np.array([[[[1.0]], [[0.0]]], [[[1.0]], [[1.0]]]]))  # [2,2,1,1]
+    p = jnp.asarray(np.array([[[[1.0]], [[1.0]]], [[[1.0]], [[1.0]]]]))
+    scores = mean_accuracy_scores(t, p)
+    np.testing.assert_allclose(np.asarray(scores), [0.5, 1.0])
+    value, state = mean_accuracy(t, p)
+    assert float(value) == pytest.approx(0.75)
+
+
+def test_top1_accuracy():
+    logits = jnp.asarray([[0.1, 0.9], [0.8, 0.2]])
+    labels = jnp.asarray([1, 1])
+    np.testing.assert_allclose(np.asarray(top1_accuracy_scores(logits, labels)), [1.0, 0.0])

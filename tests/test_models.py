@@ -1,0 +1,178 @@
+"""Model-shape and end-point tests — the golden-shape unit layer that would have caught
+the reference's dead Xception (SURVEY §2.4.8-10)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.config import ModelConfig
+from tensorflowdistributedlearning_tpu.models import (
+    ResNetBackbone,
+    ResNetClassifier,
+    ResNetSegmentation,
+    SplitSeparableConv2D,
+    Xception41,
+    build_model,
+    subsample,
+    upsample,
+)
+from tensorflowdistributedlearning_tpu.utils import count_params
+
+
+def init_and_apply(model, x, train=False):
+    variables = model.init(jax.random.key(0), x, train=False)
+    if train:
+        out, _ = model.apply(
+            variables, x, train=True, mutable=["batch_stats"],
+            rngs={"dropout": jax.random.key(1)},
+        )
+        return variables, out
+    return variables, model.apply(variables, x, train=False)
+
+
+def test_upsample_shape():
+    x = jnp.ones((2, 13, 13, 8))
+    assert upsample(x, (26, 26)).shape == (2, 26, 26, 8)
+    assert upsample(x, (101, 101)).shape == (2, 101, 101, 8)
+
+
+def test_subsample():
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    out = subsample(x, 2)
+    assert out.shape == (1, 2, 2, 1)
+    np.testing.assert_array_equal(np.asarray(out)[0, :, :, 0], [[0, 2], [8, 10]])
+
+
+def test_split_separable_conv_params_and_shape():
+    model = SplitSeparableConv2D(16, 3, rate=2)
+    x = jnp.ones((1, 8, 8, 4))
+    variables, out = init_and_apply(model, x)
+    assert out.shape == (1, 8, 8, 16)
+    # depthwise kernel is per-channel: [3,3,1,4]; pointwise [1,1,4,16]
+    assert variables["params"]["depthwise"]["kernel"].shape == (3, 3, 1, 4)
+    assert variables["params"]["pointwise"]["kernel"].shape == (1, 1, 4, 16)
+
+
+def test_backbone_endpoint_shapes_output_stride_8():
+    """101x101 input at output_stride 8: root 26x26, block1 13x13 (stride-2 last unit),
+    block2-4 stay 13x13 atrous; the decoder skip is 26x26 — the resolution the reference
+    hard-coded as (26, 26) (reference: core/resnet.py:474)."""
+    cfg = ModelConfig()
+    model = ResNetBackbone(cfg)
+    x = jnp.ones((1, 101, 101, 2))
+    _, eps = init_and_apply(model, x)
+    assert eps["root"].shape == (1, 26, 26, 128)
+    assert eps["block1_unit1_residual"].shape == (1, 26, 26, 512)
+    assert eps["block1"].shape == (1, 13, 13, 512)
+    assert eps["block2"].shape == (1, 13, 13, 1024)
+    assert eps["block3"].shape == (1, 13, 13, 2048)
+    assert eps["block4"].shape == (1, 13, 13, 1024)
+
+
+def test_backbone_no_output_stride_is_stride_32():
+    cfg = ModelConfig(output_stride=None, input_shape=(64, 64), input_channels=3)
+    model = ResNetBackbone(cfg)
+    x = jnp.ones((1, 64, 64, 3))
+    _, eps = init_and_apply(model, x)
+    assert eps["features"].shape == (1, 2, 2, 1024)
+
+
+def test_backbone_invalid_output_stride_raises():
+    cfg = ModelConfig(output_stride=6)
+    with pytest.raises(ValueError):
+        ResNetBackbone(cfg).init(jax.random.key(0), jnp.ones((1, 32, 32, 2)), train=False)
+
+
+def test_segmentation_logits_shape_and_dtype():
+    cfg = ModelConfig()
+    model = ResNetSegmentation(cfg)
+    x = jnp.ones((1, 101, 101, 2))
+    variables, logits = init_and_apply(model, x)
+    assert logits.shape == (1, 101, 101, 1)
+    assert logits.dtype == jnp.float32
+    assert count_params(variables["params"]) > 1_000_000
+
+
+def test_segmentation_other_input_size():
+    """The (26,26) hard-coding is gone: any input size works (SURVEY §2.4.7)."""
+    cfg = ModelConfig(input_shape=(128, 128))
+    model = ResNetSegmentation(cfg)
+    x = jnp.ones((1, 128, 128, 2))
+    _, logits = init_and_apply(model, x)
+    assert logits.shape == (1, 128, 128, 1)
+
+
+def test_segmentation_basic_block():
+    cfg = ModelConfig(block_type="basic_block", n_blocks=(2, 2, 2))
+    model = ResNetSegmentation(cfg)
+    x = jnp.ones((1, 101, 101, 2))
+    _, logits = init_and_apply(model, x)
+    assert logits.shape == (1, 101, 101, 1)
+
+
+def test_segmentation_train_mode_updates_batch_stats():
+    cfg = ModelConfig(n_blocks=(1, 1, 1))
+    model = ResNetSegmentation(cfg)
+    x = jnp.ones((2, 101, 101, 2))
+    variables = model.init(jax.random.key(0), x, train=False)
+    out, mutated = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    before = variables["batch_stats"]
+    after = mutated["batch_stats"]
+    changed = jax.tree_util.tree_reduce(
+        lambda acc, pair: acc or bool(np.any(pair)),
+        jax.tree.map(lambda a, b: np.any(np.asarray(a) != np.asarray(b)), before, after),
+        False,
+    )
+    assert changed
+
+
+def test_bfloat16_compute_keeps_float32_params_and_logits():
+    cfg = ModelConfig(n_blocks=(1, 1, 1), dtype="bfloat16")
+    model = ResNetSegmentation(cfg)
+    x = jnp.ones((1, 101, 101, 2))
+    variables, logits = init_and_apply(model, x)
+    assert logits.dtype == jnp.float32
+    leaf = variables["params"]["backbone"]["conv1_1"]["conv"]["kernel"]
+    assert leaf.dtype == jnp.float32
+
+
+def test_classifier_logits():
+    cfg = ModelConfig(num_classes=10, input_shape=(64, 64), input_channels=3)
+    model = ResNetClassifier(cfg)
+    x = jnp.ones((2, 64, 64, 3))
+    _, logits = init_and_apply(model, x)
+    assert logits.shape == (2, 10)
+
+
+def test_xception_classifier():
+    cfg = ModelConfig(
+        backbone="xception", num_classes=10, input_shape=(64, 64), input_channels=3
+    )
+    model = Xception41(cfg)
+    x = jnp.ones((2, 64, 64, 3))
+    variables, logits = init_and_apply(model, x)
+    assert logits.shape == (2, 10)
+    # all 8 middle-flow units must exist — the reference's dedented loop built only one
+    # (SURVEY §2.4.8)
+    params = variables["params"]["backbone"]
+    middle = [k for k in params if k.startswith("middle_block1_unit")]
+    assert len(middle) == 8
+
+
+def test_xception_atrous_output_stride():
+    cfg = ModelConfig(
+        backbone="xception", output_stride=16, input_shape=(64, 64), input_channels=3
+    )
+    from tensorflowdistributedlearning_tpu.models.xception import XceptionBackbone
+
+    model = XceptionBackbone(cfg)
+    x = jnp.ones((1, 64, 64, 3))
+    _, eps = init_and_apply(model, x)
+    assert eps["features"].shape[1:3] == (4, 4)  # 64/16
+
+
+def test_build_model_factory():
+    assert isinstance(build_model(ModelConfig()), ResNetSegmentation)
+    assert isinstance(build_model(ModelConfig(num_classes=5)), ResNetClassifier)
+    assert isinstance(build_model(ModelConfig(backbone="xception", num_classes=5)), Xception41)
