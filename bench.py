@@ -1,0 +1,111 @@
+"""Headline benchmark: ResNet-50 ImageNet-shape training throughput, images/sec/chip.
+
+BASELINE.json's metric is "ImageNet ResNet-50 images/sec/chip"; the reference era's
+per-chip number for the same job (TF1 fp32 ResNet-50 on a V100, the hardware the
+reference's 2-GPU MirroredStrategy runs used) is ~360 images/sec/chip, which is the
+``vs_baseline`` denominator here.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+V100_FP32_RESNET50_IMAGES_PER_SEC = 360.0
+
+
+def main() -> None:
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.models import build_model
+    from tensorflowdistributedlearning_tpu.parallel.mesh import (
+        make_mesh,
+        replicate,
+        shard_batch,
+    )
+    from tensorflowdistributedlearning_tpu.train.state import create_train_state
+    from tensorflowdistributedlearning_tpu.train.step import (
+        ClassificationTask,
+        make_optimizer,
+        make_train_step,
+    )
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    n = len(devices)
+
+    if on_tpu:
+        # ResNet-50 ImageNet config, bfloat16 on the MXU
+        cfg = ModelConfig(
+            num_classes=1000,
+            input_shape=(224, 224),
+            input_channels=3,
+            n_blocks=(3, 4, 6),
+            dtype="bfloat16",
+        )
+        per_chip_batch = 128
+        timed_steps, warmup = 20, 3
+    else:
+        # CPU fallback (local smoke): tiny model, tiny batch
+        cfg = ModelConfig(
+            num_classes=10,
+            input_shape=(32, 32),
+            input_channels=3,
+            n_blocks=(1, 1, 1),
+            base_depth=32,
+        )
+        per_chip_batch = 8
+        timed_steps, warmup = 3, 1
+
+    global_batch = per_chip_batch * n
+    mesh = make_mesh(n)
+    model = build_model(cfg)
+    tx = make_optimizer(TrainConfig())
+    h, w = cfg.input_shape
+    rng = jax.random.PRNGKey(0)
+    sample = np.zeros((1, h, w, cfg.input_channels), np.float32)
+    state = replicate(create_train_state(model, tx, rng, sample), mesh)
+
+    rng_np = np.random.default_rng(0)
+    batch = {
+        "images": rng_np.normal(0, 1, (global_batch, h, w, cfg.input_channels)).astype(
+            np.float32
+        ),
+        "labels": rng_np.integers(0, cfg.num_classes, global_batch).astype(np.int32),
+    }
+    batch = shard_batch(batch, mesh)
+
+    step = make_train_step(mesh, ClassificationTask(), donate=False)
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(timed_steps):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    images_per_sec_per_chip = global_batch * timed_steps / dt / n
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_imagenet_train_throughput_per_chip"
+                if on_tpu
+                else "resnet_tiny_cpu_train_throughput_per_chip",
+                "value": round(images_per_sec_per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(
+                    images_per_sec_per_chip / V100_FP32_RESNET50_IMAGES_PER_SEC, 3
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

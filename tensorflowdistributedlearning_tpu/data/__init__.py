@@ -1,3 +1,43 @@
+from tensorflowdistributedlearning_tpu.data.augment import (
+    AugmentConfig,
+    add_laplace_channel,
+    augment_batch,
+    prepare_eval_batch,
+    tta_inverse,
+    tta_transform,
+    TTA_TRANSFORMS,
+)
+from tensorflowdistributedlearning_tpu.data.folds import (
+    build_fold_manifests,
+    coverage_to_class,
+    stratified_kfold,
+    write_fold_manifests,
+)
+from tensorflowdistributedlearning_tpu.data.pipeline import (
+    InMemoryDataset,
+    device_prefetch,
+    eval_batches,
+    host_shard,
+    train_batches,
+)
 from tensorflowdistributedlearning_tpu.data.synthetic import synthetic_batches
 
-__all__ = ["synthetic_batches"]
+__all__ = [
+    "AugmentConfig",
+    "add_laplace_channel",
+    "augment_batch",
+    "prepare_eval_batch",
+    "tta_inverse",
+    "tta_transform",
+    "TTA_TRANSFORMS",
+    "build_fold_manifests",
+    "coverage_to_class",
+    "stratified_kfold",
+    "write_fold_manifests",
+    "InMemoryDataset",
+    "device_prefetch",
+    "eval_batches",
+    "host_shard",
+    "train_batches",
+    "synthetic_batches",
+]

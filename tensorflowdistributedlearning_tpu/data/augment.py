@@ -1,0 +1,321 @@
+"""On-device, batched image augmentation (reference: preprocessing/preprocessing.py).
+
+TPU-first redesign of the reference's per-image host-side tf.data augmentation
+(reference: preprocessing/preprocessing.py:112-246):
+
+- The whole augmentation is a jittable function of ``(key, images, masks)``; the host
+  only decodes PNGs. Geometry runs on TPU as one composed inverse-warp gather per
+  image (the reference likewise composed flips/rotation/shift/crop into ONE projective
+  transform, reference: preprocessing/preprocessing.py:162-238 — but executed it on the
+  host CPU per image).
+- Randomness uses per-image PRNG keys from ``jax.random.split``, fixing the reference's
+  graph-construction-time numpy RNG for shifts, which sampled ONE shift per pipeline
+  and reused it for every image (reference: preprocessing/preprocessing.py:196-203,
+  SURVEY §2.4.11).
+- Transform semantics preserved: REFLECT pad 40 px (:150-151), random transpose at
+  p=0.5 (:165-167), optional brightness jitter (:169-170), horizontal/vertical flips at
+  p=0.5 (:172-188), rotation U(-rotate_range°, +rotate_range°) (:190-194), shifts
+  U(-range, +range)·height (:196-211), optional zoom-crop (:213-228), BILINEAR for the
+  image / NEAREST for the mask (:230-238), central crop 101/181 (:240-241), and the
+  Laplacian second channel (:11-30, :243).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# TGS Salt dataset intensity statistics (reference: preprocessing/preprocessing.py:7-8).
+MEAN = 0.47194585
+STD = 0.16105755
+
+# Reference: preprocessing/preprocessing.py:27-29 — an isotropic 3x3 Laplacian stencil.
+_LAPLACE_KERNEL = (
+    (0.5, 1.0, 0.5),
+    (1.0, -6.0, 1.0),
+    (0.5, 1.0, 0.5),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class AugmentConfig:
+    """Knob set of ``read_and_preprocess`` (reference:
+    preprocessing/preprocessing.py:112-123), same defaults."""
+
+    horizontal_flip: bool = True
+    vertical_flip: bool = True
+    rotate_range: float = 10.0  # degrees
+    crop_probability: float = 0.5  # the trainer passed 0 (reference: model.py:316)
+    crop_min_percent: float = 0.9
+    crop_max_percent: float = 1.1
+    height_shift_range: float = 0.2
+    width_shift_range: float = 0.2
+    brightness_range: float = 0.0
+    pad: int = 40  # REFLECT padding before warping (reference: :150-151)
+    transpose_probability: float = 0.5
+
+
+def normalize(image: jax.Array) -> jax.Array:
+    """(x - MEAN) / STD (reference: preprocessing/preprocessing.py:146)."""
+    return (image - MEAN) / STD
+
+
+def laplacian(images: jax.Array) -> jax.Array:
+    """Per-channel 3x3 Laplacian of a [B, H, W, C] batch (reference:
+    preprocessing/preprocessing.py:11-30 ran a depthwise conv per image)."""
+    c = images.shape[-1]
+    kernel = jnp.asarray(_LAPLACE_KERNEL, images.dtype)
+    kernel = jnp.tile(kernel[:, :, None, None], (1, 1, 1, c))  # HWIO, depthwise
+    return lax.conv_general_dilated(
+        images,
+        kernel,
+        window_strides=(1, 1),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=c,
+    )
+
+
+def add_laplace_channel(images: jax.Array) -> jax.Array:
+    """Concatenate the Laplacian as a second channel (reference:
+    preprocessing/preprocessing.py:243)."""
+    return jnp.concatenate([images, laplacian(images)], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Affine machinery. Matrices are 3x3 INVERSE warps: out-pixel (x, y) samples
+# in-pixel (x', y', 1)^T = M @ (x, y, 1)^T — the same output->input convention the
+# reference's flat [a0..c1] projective transforms used
+# (reference: preprocessing/preprocessing.py:162-238). Applying A then B composes as
+# M_A @ M_B.
+# ---------------------------------------------------------------------------
+
+
+def _identity() -> jax.Array:
+    return jnp.eye(3, dtype=jnp.float32)
+
+
+def _hflip(width: float) -> jax.Array:
+    return jnp.asarray(
+        [[-1.0, 0.0, width - 1.0], [0.0, 1.0, 0.0], [0.0, 0.0, 1.0]], jnp.float32
+    )
+
+
+def _vflip(height: float) -> jax.Array:
+    return jnp.asarray(
+        [[1.0, 0.0, 0.0], [0.0, -1.0, height - 1.0], [0.0, 0.0, 1.0]], jnp.float32
+    )
+
+
+def _rotation(angle: jax.Array, height: float, width: float) -> jax.Array:
+    """Rotation about the image center (the reference used
+    ``angles_to_projective_transforms``, preference for same center convention)."""
+    cos, sin = jnp.cos(angle), jnp.sin(angle)
+    cx, cy = (width - 1.0) / 2.0, (height - 1.0) / 2.0
+    # translate center to origin, rotate, translate back (inverse warp)
+    return jnp.asarray(
+        [
+            [cos, -sin, cx - cos * cx + sin * cy],
+            [sin, cos, cy - sin * cx - cos * cy],
+            [0.0, 0.0, 1.0],
+        ],
+        jnp.float32,
+    )
+
+
+def _translation(tx: jax.Array, ty: jax.Array) -> jax.Array:
+    one = jnp.ones((), jnp.float32)
+    zero = jnp.zeros((), jnp.float32)
+    return jnp.stack(
+        [
+            jnp.stack([one, zero, tx]),
+            jnp.stack([zero, one, ty]),
+            jnp.stack([zero, zero, one]),
+        ]
+    )
+
+
+def _zoom_crop(pct: jax.Array, off_x: jax.Array, off_y: jax.Array) -> jax.Array:
+    one = jnp.ones((), jnp.float32)
+    zero = jnp.zeros((), jnp.float32)
+    return jnp.stack(
+        [
+            jnp.stack([pct, zero, off_x]),
+            jnp.stack([zero, pct, off_y]),
+            jnp.stack([zero, zero, one]),
+        ]
+    )
+
+
+def _apply_warp(image: jax.Array, matrix: jax.Array, order: int) -> jax.Array:
+    """Inverse-warp a [H, W, C] image by a 3x3 affine matrix. ``order=1`` bilinear
+    (image), ``order=0`` nearest (mask) — reference: preprocessing.py:230-238. Out-of-
+    bounds samples fill with 0, matching ``tf.contrib.image.transform``."""
+    h, w, c = image.shape
+    ys, xs = jnp.meshgrid(
+        jnp.arange(h, dtype=jnp.float32), jnp.arange(w, dtype=jnp.float32), indexing="ij"
+    )
+    in_x = matrix[0, 0] * xs + matrix[0, 1] * ys + matrix[0, 2]
+    in_y = matrix[1, 0] * xs + matrix[1, 1] * ys + matrix[1, 2]
+
+    def warp_channel(ch: jax.Array) -> jax.Array:
+        return jax.scipy.ndimage.map_coordinates(
+            ch, [in_y, in_x], order=order, mode="constant", cval=0.0
+        )
+
+    return jnp.stack([warp_channel(image[..., i]) for i in range(c)], axis=-1)
+
+
+def central_crop(x: jax.Array, out_hw: Tuple[int, int]) -> jax.Array:
+    """Static central crop (the reference's ``tf.image.central_crop(x, 101/181)``,
+    preprocessing/preprocessing.py:240-241)."""
+    h, w = x.shape[-3], x.shape[-2]
+    th, tw = out_hw
+    top, left = (h - th) // 2, (w - tw) // 2
+    return x[..., top : top + th, left : left + tw, :]
+
+
+def _sample_affine(
+    key: jax.Array, cfg: AugmentConfig, height: float, width: float
+) -> jax.Array:
+    """Sample the composed per-image affine (flips ∘ rotation ∘ shift ∘ crop), the
+    reference's transform list (preprocessing/preprocessing.py:162-228)."""
+    k_h, k_v, k_rot, k_tx, k_ty, k_crop, k_pct, k_ox, k_oy = jax.random.split(key, 9)
+    m = _identity()
+    if cfg.horizontal_flip:
+        coin = jax.random.uniform(k_h) < 0.5
+        m = m @ jnp.where(coin, _hflip(width), _identity())
+    if cfg.vertical_flip:
+        coin = jax.random.uniform(k_v) < 0.5
+        m = m @ jnp.where(coin, _vflip(height), _identity())
+    if cfg.rotate_range:
+        max_rad = cfg.rotate_range / 180.0 * math.pi
+        angle = jax.random.uniform(k_rot, minval=-max_rad, maxval=max_rad)
+        m = m @ _rotation(angle, height, width)
+    # per-image shifts — the fix for SURVEY §2.4.11; the reference also scaled BOTH
+    # shifts by `height` (preprocessing/preprocessing.py:197-201), kept for parity
+    # (all its inputs are square).
+    tx = (
+        jax.random.uniform(
+            k_tx, minval=-cfg.width_shift_range, maxval=cfg.width_shift_range
+        )
+        * height
+        if cfg.width_shift_range
+        else jnp.zeros(())
+    )
+    ty = (
+        jax.random.uniform(
+            k_ty, minval=-cfg.height_shift_range, maxval=cfg.height_shift_range
+        )
+        * height
+        if cfg.height_shift_range
+        else jnp.zeros(())
+    )
+    m = m @ _translation(tx, ty)
+    if cfg.crop_probability > 0:
+        pct = jax.random.uniform(
+            k_pct, minval=cfg.crop_min_percent, maxval=cfg.crop_max_percent
+        )
+        off_x = jax.random.uniform(k_ox, minval=0.0, maxval=width * jnp.abs(1.0 - pct))
+        off_y = jax.random.uniform(k_oy, minval=0.0, maxval=height * jnp.abs(1.0 - pct))
+        coin = jax.random.uniform(k_crop) < cfg.crop_probability
+        m = m @ jnp.where(coin, _zoom_crop(pct, off_x, off_y), _identity())
+    return m
+
+
+def _augment_one(
+    key: jax.Array,
+    image: jax.Array,
+    mask: jax.Array,
+    cfg: AugmentConfig,
+    out_hw: Tuple[int, int],
+) -> Tuple[jax.Array, jax.Array]:
+    """Augment a single [H, W, 1] image/mask pair. vmapped over the batch."""
+    pad = cfg.pad
+    pad_spec = [(pad, pad), (pad, pad), (0, 0)]
+    image = jnp.pad(image, pad_spec, mode="reflect")
+    mask = jnp.pad(mask, pad_spec, mode="reflect")
+
+    k_transpose, k_bright, k_affine = jax.random.split(key, 3)
+
+    # random transpose (reference: preprocessing/preprocessing.py:165-167)
+    do_t = jax.random.uniform(k_transpose) < cfg.transpose_probability
+    image = jnp.where(do_t, jnp.transpose(image, (1, 0, 2)), image)
+    mask = jnp.where(do_t, jnp.transpose(mask, (1, 0, 2)), mask)
+
+    # brightness jitter (reference: preprocessing/preprocessing.py:169-170)
+    if cfg.brightness_range > 0:
+        delta = jax.random.uniform(
+            k_bright, minval=-cfg.brightness_range, maxval=cfg.brightness_range
+        )
+        image = image + delta
+
+    h, w = image.shape[0], image.shape[1]
+    matrix = _sample_affine(k_affine, cfg, float(h), float(w))
+    image = _apply_warp(image, matrix, order=1)
+    mask = _apply_warp(mask, matrix, order=0)
+
+    image = central_crop(image, out_hw)
+    mask = central_crop(mask, out_hw)
+    return image, mask
+
+
+def augment_batch(
+    key: jax.Array,
+    images: jax.Array,
+    masks: jax.Array,
+    cfg: AugmentConfig = AugmentConfig(),
+    out_hw: Optional[Tuple[int, int]] = None,
+) -> Dict[str, jax.Array]:
+    """Jittable batched augmentation + Laplacian channel.
+
+    ``images``/``masks``: [B, H, W, 1] normalized images and binary masks. Returns
+    {'images': [B, h, w, 2], 'labels': [B, h, w, 1]} ready for the train step — the
+    whole of the reference's augmenting input_fn map (model.py:315-317) as one fused
+    XLA computation with per-image keys.
+    """
+    if out_hw is None:
+        out_hw = (images.shape[1], images.shape[2])
+    keys = jax.random.split(key, images.shape[0])
+    aug_images, aug_masks = jax.vmap(
+        lambda k, i, m: _augment_one(k, i, m, cfg, out_hw)
+    )(keys, images, masks)
+    return {"images": add_laplace_channel(aug_images), "labels": aug_masks}
+
+
+def prepare_eval_batch(images: jax.Array, masks: jax.Array) -> Dict[str, jax.Array]:
+    """Eval-mode preparation: no geometry, just the Laplacian channel (the reference's
+    non-augmenting input_fn path, preprocessing/preprocessing.py:243-246)."""
+    return {"images": add_laplace_channel(images), "labels": masks}
+
+
+# ---------------------------------------------------------------------------
+# Test-time augmentation (reference: preprocessing/preprocessing.py:254-278 and the
+# PREDICT-branch inversion, model.py:384-387). All four transforms are involutions, so
+# each is its own inverse.
+# ---------------------------------------------------------------------------
+
+TTA_TRANSFORMS = ("vertical", "horizontal", "transpose", "none")
+
+
+def tta_transform(x: jax.Array, transformation: str) -> jax.Array:
+    """Apply a named TTA transform to a [B, H, W, C] batch."""
+    if transformation == "vertical":
+        return x[:, ::-1, :, :]
+    if transformation == "horizontal":
+        return x[:, :, ::-1, :]
+    if transformation == "transpose":
+        return jnp.transpose(x, (0, 2, 1, 3))
+    if transformation == "none":
+        return x
+    raise ValueError(f"Unknown transformation {transformation}")
+
+
+def tta_inverse(x: jax.Array, transformation: str) -> jax.Array:
+    """Invert a named TTA transform (all are involutions)."""
+    return tta_transform(x, transformation)

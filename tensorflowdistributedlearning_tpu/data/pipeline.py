@@ -1,0 +1,200 @@
+"""Host-side input pipeline: decode, shuffle, batch, shard, prefetch.
+
+The reference's pipeline was tf.data per GPU tower: glob symlinked fold dirs →
+``from_tensor_slices`` → ``shuffle_and_repeat(10×batch)`` → per-image augmenting map →
+``batch`` → ``prefetch(2×n_gpus)`` (reference: model.py:287-322). The TPU-native split
+is different by design:
+
+- the host ONLY decodes PNGs and assembles batches (decode once, cache in RAM — the
+  TGS-scale datasets the reference trained on fit trivially);
+- geometry/augmentation runs ON DEVICE as part of the jitted step
+  (see data/augment.py), so the host never bottlenecks the MXU;
+- under multi-host SPMD each process loads only its shard of every global batch
+  (``jax.process_index``), the per-host generalization of the reference's per-tower
+  ``batch/n_gpus`` contract (reference: model.py:156-159, 298-299);
+- a double-buffered device prefetcher overlaps host→HBM copies with compute (the
+  reference's ``prefetch(2×n_gpus)``, model.py:319-320).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import queue as queue_lib
+from glob import glob
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from tensorflowdistributedlearning_tpu.data.augment import MEAN, STD
+
+
+def load_png(path: str) -> np.ndarray:
+    """Decode one grayscale PNG to [H, W, 1] float32 in [0, 1] (reference:
+    preprocessing/preprocessing.py:91-97 — which called decode_jpeg on PNGs; the files
+    are PNGs, SURVEY §2.4.12)."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        arr = np.asarray(im.convert("L"), np.float32) / 255.0
+    return arr[:, :, None]
+
+
+def discover_ids(data_dir: str) -> List[str]:
+    """List example ids from ``{data_dir}/images/*.png`` (the reference globbed the
+    same layout, model.py:289-294)."""
+    paths = sorted(glob(os.path.join(data_dir, "images", "*.png")))
+    return [os.path.splitext(os.path.basename(p))[0] for p in paths]
+
+
+def mask_coverage(masks: np.ndarray) -> np.ndarray:
+    """Fraction of positive pixels per mask, the notebooks' stratification signal
+    (Untitled.ipynb cell 4)."""
+    flat = masks.reshape(masks.shape[0], -1)
+    return flat.mean(axis=1)
+
+
+class InMemoryDataset:
+    """Decoded, normalized examples held in host RAM.
+
+    ``images``: [N, H, W, 1] float32, already (x-MEAN)/STD normalized;
+    ``masks``: [N, H, W, 1] float32 in {0, 1} (None for test sets).
+    """
+
+    def __init__(self, images: np.ndarray, masks: Optional[np.ndarray], ids: List[str]):
+        self.images = images
+        self.masks = masks
+        self.ids = ids
+
+    def __len__(self) -> int:
+        return len(self.ids)
+
+    @classmethod
+    def from_directory(
+        cls,
+        data_dir: str,
+        ids: Optional[Sequence[str]] = None,
+        with_masks: bool = True,
+        normalize: bool = True,
+    ) -> "InMemoryDataset":
+        """Load ``{data_dir}/images/{id}.png`` (+ ``masks/``) for the given ids."""
+        if ids is None:
+            ids = discover_ids(data_dir)
+        ids = list(ids)
+        images = np.stack(
+            [load_png(os.path.join(data_dir, "images", f"{i}.png")) for i in ids]
+        )
+        if normalize:
+            images = (images - MEAN) / STD
+        masks = None
+        if with_masks:
+            masks = np.stack(
+                [load_png(os.path.join(data_dir, "masks", f"{i}.png")) for i in ids]
+            )
+            masks = (masks > 0.5).astype(np.float32)
+        return cls(images, masks, ids)
+
+    def select(self, ids: Sequence[str]) -> "InMemoryDataset":
+        index = {i: k for k, i in enumerate(self.ids)}
+        rows = np.asarray([index[i] for i in ids])
+        return InMemoryDataset(
+            self.images[rows],
+            None if self.masks is None else self.masks[rows],
+            list(ids),
+        )
+
+
+def host_shard(ids: Sequence[str]) -> List[str]:
+    """The ids this process is responsible for under multi-host SPMD. Single-host
+    (the reference's only mode) returns everything."""
+    n = jax.process_count()
+    if n == 1:
+        return list(ids)
+    return list(ids)[jax.process_index() :: n]
+
+
+def train_batches(
+    dataset: InMemoryDataset,
+    batch_size: int,
+    seed: int,
+    steps: Optional[int] = None,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Infinite (or ``steps``-bounded) stream of shuffled {'images', 'masks'} batches.
+
+    Full reshuffle each epoch with a seeded RNG — strictly stronger mixing than the
+    reference's 10×batch shuffle buffer (model.py:301-304) and reproducible, which the
+    reference's was not.
+    """
+    n = len(dataset)
+    if n == 0:
+        raise ValueError("Empty dataset")
+    if batch_size > n:
+        raise ValueError(
+            f"batch_size {batch_size} exceeds dataset size {n}; downstream sharding "
+            f"requires full batches"
+        )
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(n)
+    pos = 0
+    emitted = 0
+    while steps is None or emitted < steps:
+        if pos + batch_size > n:
+            order = rng.permutation(n)
+            pos = 0
+        rows = order[pos : pos + batch_size]
+        pos += batch_size
+        emitted += 1
+        yield {"images": dataset.images[rows], "masks": dataset.masks[rows]}
+
+
+def eval_batches(
+    dataset: InMemoryDataset, batch_size: int
+) -> Iterator[Dict[str, np.ndarray]]:
+    """One pass over the dataset in order. The final partial batch is padded by
+    wrap-around to keep shapes static for jit, and a per-example ``valid`` 0/1 mask
+    marks the pad rows so the eval step's weighted streaming means exclude them —
+    every example counts exactly once regardless of ``n % batch_size``."""
+    n = len(dataset)
+    for start in range(0, n, batch_size):
+        rows = np.arange(start, min(start + batch_size, n))
+        valid = np.ones(batch_size, np.float32)
+        if len(rows) < batch_size:
+            valid[len(rows) :] = 0.0
+            rows = np.concatenate([rows, np.arange(batch_size - len(rows))])
+        yield {
+            "images": dataset.images[rows],
+            "masks": dataset.masks[rows],
+            "valid": valid,
+        }
+
+
+def device_prefetch(
+    iterator: Iterator, place, depth: int = 2
+) -> Iterator:
+    """Double-buffered host→device prefetch (the reference's ``prefetch(2×n_gpus)``,
+    model.py:319-320): a daemon thread stays ``depth`` batches ahead so HBM copies
+    overlap the previous step's compute. ``place`` maps a host batch to device arrays
+    (e.g. ``lambda b: shard_batch(b, mesh)``)."""
+    q: queue_lib.Queue = queue_lib.Queue(maxsize=depth)
+    _done = object()
+    _error = object()
+
+    def producer():
+        try:
+            for item in iterator:
+                q.put(place(item))
+        except BaseException as e:  # noqa: BLE001 — re-raised on the consumer side
+            q.put((_error, e))
+            return
+        q.put(_done)
+
+    thread = threading.Thread(target=producer, daemon=True)
+    thread.start()
+    while True:
+        item = q.get()
+        if item is _done:
+            return
+        if isinstance(item, tuple) and len(item) == 2 and item[0] is _error:
+            raise item[1]
+        yield item

@@ -83,19 +83,28 @@ def lovasz_hinge(
     valid = None if ignore is None else (labels != ignore)
 
     if per_image:
-        flat_logits = logits.reshape(logits.shape[0], -1)
-        flat_labels = labels.reshape(labels.shape[0], -1)
-        flat_valid = None if valid is None else valid.reshape(valid.shape[0], -1)
-        if flat_valid is None:
-            losses = jax.vmap(lovasz_hinge_flat)(flat_logits, flat_labels)
-        else:
-            losses = jax.vmap(lovasz_hinge_flat)(flat_logits, flat_labels, flat_valid)
-        return jnp.mean(losses)
+        return jnp.mean(lovasz_hinge_per_image(logits, labels, ignore))
 
     return lovasz_hinge_flat(
         logits.reshape(-1),
         labels.reshape(-1),
         None if valid is None else valid.reshape(-1),
+    )
+
+
+def lovasz_hinge_per_image(
+    logits: jax.Array, labels: jax.Array, ignore: Optional[int] = None
+) -> jax.Array:
+    """Per-image Lovász hinge losses, shape [B] — the un-averaged form of the
+    reference's ``map_fn`` path (core/losses.py:27-34); used by eval to weight out
+    wrap-around-padded examples."""
+    valid = None if ignore is None else (labels != ignore)
+    flat_logits = logits.reshape(logits.shape[0], -1)
+    flat_labels = labels.reshape(labels.shape[0], -1)
+    if valid is None:
+        return jax.vmap(lovasz_hinge_flat)(flat_logits, flat_labels)
+    return jax.vmap(lovasz_hinge_flat)(
+        flat_logits, flat_labels, valid.reshape(valid.shape[0], -1)
     )
 
 
@@ -118,9 +127,15 @@ def sigmoid_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return jnp.mean(jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits))))
 
 
+def softmax_cross_entropy_per_example(
+    logits: jax.Array, labels: jax.Array
+) -> jax.Array:
+    """Per-example softmax cross entropy with integer labels, shape [B]."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+
+
 def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Mean softmax cross entropy with integer labels, for the classification path the
     reference kept alongside segmentation (reference: core/resnet.py:246-256)."""
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
-    return jnp.mean(nll)
+    return jnp.mean(softmax_cross_entropy_per_example(logits, labels))

@@ -41,9 +41,19 @@ class Mean:
     def empty(cls) -> "Mean":
         return cls(total=jnp.zeros((), jnp.float32), count=jnp.zeros((), jnp.float32))
 
-    def update(self, values: jax.Array) -> "Mean":
+    def update(self, values: jax.Array, weights: jax.Array | None = None) -> "Mean":
+        """Add ``values`` to the stream; optional per-value ``weights`` (0 excludes a
+        value — used to mask wrap-around padding in the final eval batch)."""
         values = values.astype(jnp.float32)
-        return Mean(total=self.total + jnp.sum(values), count=self.count + values.size)
+        if weights is None:
+            return Mean(
+                total=self.total + jnp.sum(values), count=self.count + values.size
+            )
+        weights = jnp.broadcast_to(weights.astype(jnp.float32), values.shape)
+        return Mean(
+            total=self.total + jnp.sum(values * weights),
+            count=self.count + jnp.sum(weights),
+        )
 
     def merge(self, other: "Mean") -> "Mean":
         return Mean(total=self.total + other.total, count=self.count + other.count)

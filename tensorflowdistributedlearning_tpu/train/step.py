@@ -60,6 +60,14 @@ class SegmentationTask:
     def loss(self, logits: jax.Array, batch: Dict[str, jax.Array]) -> jax.Array:
         return losses_lib.lovasz_loss(batch["labels"], logits, "NHWC")
 
+    def loss_per_example(
+        self, logits: jax.Array, batch: Dict[str, jax.Array]
+    ) -> jax.Array:
+        return losses_lib.lovasz_hinge_per_image(
+            jnp.squeeze(logits, -1).astype(jnp.float32),
+            jnp.squeeze(batch["labels"], -1),
+        )
+
     def metric_scores(
         self, logits: jax.Array, batch: Dict[str, jax.Array]
     ) -> Dict[str, jax.Array]:
@@ -87,6 +95,11 @@ class ClassificationTask:
     def loss(self, logits: jax.Array, batch: Dict[str, jax.Array]) -> jax.Array:
         return losses_lib.softmax_cross_entropy(logits, batch["labels"])
 
+    def loss_per_example(
+        self, logits: jax.Array, batch: Dict[str, jax.Array]
+    ) -> jax.Array:
+        return losses_lib.softmax_cross_entropy_per_example(logits, batch["labels"])
+
     def metric_scores(
         self, logits: jax.Array, batch: Dict[str, jax.Array]
     ) -> Dict[str, jax.Array]:
@@ -112,15 +125,20 @@ def _l2_penalty(params: Any) -> jax.Array:
 
 
 def _metric_deltas(
-    scores: Dict[str, jax.Array], loss: jax.Array
+    scores: Dict[str, jax.Array],
+    loss: jax.Array,
+    weights: Optional[jax.Array] = None,
 ) -> Metrics:
     """Per-step metric contributions as psum-able Mean states. The loss is tracked the
     same way the reference tracked it in eval — as a streaming mean
-    (reference: model.py:401-403)."""
+    (reference: model.py:401-403). ``weights`` ([B] 0/1) excludes wrap-around-padded
+    eval examples; ``loss`` must then be per-example [B]."""
     out: Metrics = {
-        name: metrics_lib.Mean.empty().update(s) for name, s in scores.items()
+        name: metrics_lib.Mean.empty().update(s, weights) for name, s in scores.items()
     }
-    out["loss"] = metrics_lib.Mean.empty().update(loss[None])
+    out["loss"] = metrics_lib.Mean.empty().update(
+        loss if loss.ndim else loss[None], weights if loss.ndim else None
+    )
     return out
 
 
@@ -204,8 +222,13 @@ def make_eval_step(
             batch["images"],
             train=False,
         )
-        loss = task.loss(outputs, batch)
-        return _psum_metrics(_metric_deltas(task.metric_scores(outputs, batch), loss))
+        # per-example losses so the optional batch["valid"] mask (wrap-around padding
+        # of the final eval batch — data/pipeline.py eval_batches) weights correctly
+        loss = task.loss_per_example(outputs, batch)
+        weights = batch.get("valid")
+        return _psum_metrics(
+            _metric_deltas(task.metric_scores(outputs, batch), loss, weights)
+        )
 
     sharded = jax.shard_map(
         step, mesh=mesh, in_specs=(P(), P(BATCH_AXIS)), out_specs=P()

@@ -1,0 +1,138 @@
+"""Tests for on-device augmentation (reference semantics: preprocessing.py:112-278).
+The reference had no tests; its augmentation was only ever eyeballed via matplotlib
+(SURVEY §4) — these are the assertions that practice lacked."""
+
+from dataclasses import replace as dataclasses_replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.data import augment
+
+
+def _batch(rng, b=2, h=101, w=101):
+    images = rng.normal(0, 1, (b, h, w, 1)).astype(np.float32)
+    masks = (rng.uniform(size=(b, h, w, 1)) > 0.5).astype(np.float32)
+    return jnp.asarray(images), jnp.asarray(masks)
+
+
+def test_laplacian_of_constant_is_zero():
+    x = jnp.ones((1, 8, 8, 1))
+    lap = augment.laplacian(x)
+    # stencil sums to zero => flat interior response is zero
+    assert jnp.allclose(lap[0, 2:-2, 2:-2, 0], 0.0, atol=1e-5)
+
+
+def test_laplacian_detects_edge():
+    x = jnp.zeros((1, 8, 8, 1)).at[:, :, 4:, :].set(1.0)
+    lap = augment.laplacian(x)
+    assert jnp.abs(lap[0, 4, 4, 0]) > 0.5
+
+
+def test_add_laplace_channel_shape():
+    x = jnp.zeros((3, 101, 101, 1))
+    out = augment.add_laplace_channel(x)
+    assert out.shape == (3, 101, 101, 2)
+    assert jnp.array_equal(out[..., :1], x)
+
+
+def test_augment_batch_shapes_and_determinism(rng):
+    images, masks = _batch(rng)
+    key = jax.random.PRNGKey(0)
+    out1 = augment.augment_batch(key, images, masks)
+    out2 = augment.augment_batch(key, images, masks)
+    assert out1["images"].shape == (2, 101, 101, 2)
+    assert out1["labels"].shape == (2, 101, 101, 1)
+    # fixed key => bitwise identical (the determinism test SURVEY §5.2 calls for)
+    assert jnp.array_equal(out1["images"], out2["images"])
+    assert jnp.array_equal(out1["labels"], out2["labels"])
+
+
+def test_augment_batch_per_image_randomness(rng):
+    """Different images in one batch get different transforms — the reference's numpy
+    shift bug applied ONE shift to all images (SURVEY §2.4.11); verify the fix."""
+    img = rng.normal(0, 1, (1, 101, 101, 1)).astype(np.float32)
+    images = jnp.asarray(np.repeat(img, 4, axis=0))
+    masks = jnp.ones((4, 101, 101, 1), jnp.float32)
+    out = augment.augment_batch(jax.random.PRNGKey(1), images, masks)
+    a = np.asarray(out["images"])
+    assert not np.array_equal(a[0], a[1]) or not np.array_equal(a[1], a[2])
+
+
+def test_augment_mask_stays_binary(rng):
+    """NEAREST interpolation for masks (reference: preprocessing.py:235-238) must not
+    create fractional values."""
+    images, masks = _batch(rng)
+    out = augment.augment_batch(jax.random.PRNGKey(2), images, masks)
+    vals = np.unique(np.asarray(out["labels"]))
+    assert set(vals.tolist()) <= {0.0, 1.0}
+
+
+def test_augment_jits(rng):
+    images, masks = _batch(rng, b=2)
+    f = jax.jit(augment.augment_batch)
+    out = f(jax.random.PRNGKey(3), images, masks)
+    assert out["images"].shape == (2, 101, 101, 2)
+
+
+def test_identity_affine_roundtrip(rng):
+    """With all randomness disabled the augmentation is pad + identity warp + central
+    crop — the image must come back (nearly) unchanged."""
+    cfg = augment.AugmentConfig(
+        horizontal_flip=False,
+        vertical_flip=False,
+        rotate_range=0.0,
+        crop_probability=0.0,
+        height_shift_range=0.0,
+        width_shift_range=0.0,
+        transpose_probability=0.0,
+    )
+    images = jnp.asarray(rng.normal(0, 1, (1, 32, 32, 1)).astype(np.float32))
+    masks = (jnp.asarray(rng.uniform(size=(1, 32, 32, 1))) > 0.5).astype(jnp.float32)
+
+    out = augment.augment_batch(jax.random.PRNGKey(0), images, masks, cfg)
+    got = np.asarray(out["images"][..., :1])
+    np.testing.assert_allclose(got, np.asarray(images), atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(out["labels"]), np.asarray(masks))
+
+
+def test_transpose_probability_knob(rng):
+    """transpose_probability=0 must disable the transpose; =1 must force it."""
+    cfg_off = augment.AugmentConfig(
+        horizontal_flip=False, vertical_flip=False, rotate_range=0.0,
+        crop_probability=0.0, height_shift_range=0.0, width_shift_range=0.0,
+        transpose_probability=0.0,
+    )
+    cfg_on = dataclasses_replace(cfg_off, transpose_probability=1.0)
+    # asymmetric image so a transpose is detectable
+    img = np.zeros((1, 16, 16, 1), np.float32)
+    img[0, 2, 10, 0] = 1.0
+    images = jnp.asarray(img)
+    masks = jnp.asarray((img > 0).astype(np.float32))
+    for k in range(8):
+        out = augment.augment_batch(jax.random.PRNGKey(k), images, masks, cfg_off)
+        np.testing.assert_allclose(
+            np.asarray(out["images"][..., :1]), img, atol=1e-4
+        )
+    out = augment.augment_batch(jax.random.PRNGKey(0), images, masks, cfg_on)
+    np.testing.assert_allclose(
+        np.asarray(out["images"][..., :1]), img.transpose(0, 2, 1, 3), atol=1e-4
+    )
+
+
+def test_tta_transforms_are_involutions(rng):
+    x = jnp.asarray(rng.normal(0, 1, (2, 7, 7, 1)).astype(np.float32))
+    for name in augment.TTA_TRANSFORMS:
+        y = augment.tta_transform(x, name)
+        assert jnp.array_equal(augment.tta_inverse(y, name), x)
+    with pytest.raises(ValueError):
+        augment.tta_transform(x, "bogus")
+
+
+def test_tta_transforms_differ(rng):
+    x = jnp.asarray(rng.normal(0, 1, (1, 5, 5, 1)).astype(np.float32))
+    outs = [np.asarray(augment.tta_transform(x, t)) for t in ("vertical", "horizontal", "transpose")]
+    for o in outs:
+        assert not np.array_equal(o, np.asarray(x))

@@ -1,0 +1,134 @@
+"""Tests for fold manifests and the host-side pipeline (reference:
+preprocessing/preprocessing.py:33-88 symlink trees; model.py:287-322 input_fns)."""
+
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.data import folds, pipeline
+
+
+def test_coverage_to_class_bins():
+    cov = np.array([0.0, 0.01, 0.5, 1.0])
+    cls = folds.coverage_to_class(cov)
+    assert cls.tolist() == [0, 1, 5, 10]
+
+
+def test_stratified_kfold_partition():
+    y = np.array([0] * 10 + [1] * 20 + [2] * 5)
+    splits = folds.stratified_kfold(y, n_splits=5, seed=0)
+    assert len(splits) == 5
+    all_eval = np.concatenate([ev for _, ev in splits])
+    # eval folds partition the dataset
+    assert sorted(all_eval.tolist()) == list(range(35))
+    for train_idx, eval_idx in splits:
+        assert set(train_idx) & set(eval_idx) == set()
+        # stratification: each fold's class-1 share within one sample of 20/35
+        n1 = (y[eval_idx] == 1).sum()
+        assert 3 <= n1 <= 5
+
+
+def test_stratified_kfold_deterministic():
+    y = np.random.default_rng(0).integers(0, 3, 50)
+    a = folds.stratified_kfold(y, 5, seed=7)
+    b = folds.stratified_kfold(y, 5, seed=7)
+    for (ta, ea), (tb, eb) in zip(a, b):
+        assert np.array_equal(ta, tb) and np.array_equal(ea, eb)
+
+
+def test_write_fold_manifests_idempotent(tmp_path):
+    ids = [f"img{i}" for i in range(20)]
+    y = [i % 2 for i in range(20)]
+    m1 = folds.write_fold_manifests(str(tmp_path), ids, y, 4, seed=1)
+    # second call must reuse the saved split even with different inputs
+    m2 = folds.write_fold_manifests(str(tmp_path), list(reversed(ids)), y, 4, seed=99)
+    assert m1 == m2
+    assert len(m1) == 4
+    for fold in m1:
+        assert set(fold["train"]) | set(fold["eval"]) == set(ids)
+
+
+def _png_dataset(tmp_path, n=6, h=101, w=101):
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    (tmp_path / "images").mkdir()
+    (tmp_path / "masks").mkdir()
+    ids = []
+    for i in range(n):
+        ids.append(f"ex{i}")
+        img = (rng.uniform(size=(h, w)) * 255).astype(np.uint8)
+        msk = (rng.uniform(size=(h, w)) > 0.5).astype(np.uint8) * 255
+        Image.fromarray(img, "L").save(tmp_path / "images" / f"ex{i}.png")
+        Image.fromarray(msk, "L").save(tmp_path / "masks" / f"ex{i}.png")
+    return ids
+
+
+def test_in_memory_dataset_from_pngs(tmp_path):
+    ids = _png_dataset(tmp_path)
+    ds = pipeline.InMemoryDataset.from_directory(str(tmp_path))
+    assert ds.ids == ids
+    assert ds.images.shape == (6, 101, 101, 1)
+    assert ds.masks.shape == (6, 101, 101, 1)
+    assert set(np.unique(ds.masks)) <= {0.0, 1.0}
+    # normalization applied (reference: preprocessing.py:146)
+    assert abs(ds.images.mean()) < 1.0
+
+    sub = ds.select(["ex3", "ex1"])
+    assert sub.ids == ["ex3", "ex1"]
+    assert np.array_equal(sub.images[0], ds.images[3])
+
+
+def test_train_batches_shuffled_and_bounded(tmp_path):
+    ids = _png_dataset(tmp_path)
+    ds = pipeline.InMemoryDataset.from_directory(str(tmp_path))
+    batches = list(pipeline.train_batches(ds, batch_size=4, seed=0, steps=5))
+    assert len(batches) == 5
+    for b in batches:
+        assert b["images"].shape == (4, 101, 101, 1)
+    # deterministic under the same seed
+    again = list(pipeline.train_batches(ds, batch_size=4, seed=0, steps=5))
+    assert np.array_equal(batches[0]["images"], again[0]["images"])
+
+
+def test_eval_batches_pads_final_with_valid_mask(tmp_path):
+    _png_dataset(tmp_path)
+    ds = pipeline.InMemoryDataset.from_directory(str(tmp_path))
+    batches = list(pipeline.eval_batches(ds, batch_size=4))
+    assert len(batches) == 2
+    assert all(b["images"].shape[0] == 4 for b in batches)
+    # wrap-around padding repeats the head of the dataset, masked out via `valid`
+    assert np.array_equal(batches[1]["images"][2], ds.images[0])
+    assert batches[0]["valid"].tolist() == [1, 1, 1, 1]
+    assert batches[1]["valid"].tolist() == [1, 1, 0, 0]
+    # every example counts exactly once
+    assert sum(b["valid"].sum() for b in batches) == len(ds)
+
+
+def test_device_prefetch_passthrough():
+    src = iter([{"x": np.ones((2,))} for _ in range(3)])
+    out = list(pipeline.device_prefetch(src, place=lambda b: b, depth=2))
+    assert len(out) == 3
+
+
+def test_device_prefetch_propagates_errors():
+    def bad_iter():
+        yield {"x": 1}
+        raise RuntimeError("decode failed")
+
+    it = pipeline.device_prefetch(bad_iter(), place=lambda b: b, depth=2)
+    assert next(it) == {"x": 1}
+    with pytest.raises(RuntimeError, match="decode failed"):
+        next(it)
+
+
+def test_train_batches_rejects_oversized_batch(tmp_path):
+    _png_dataset(tmp_path, n=3)
+    ds = pipeline.InMemoryDataset.from_directory(str(tmp_path))
+    with pytest.raises(ValueError, match="exceeds dataset size"):
+        next(pipeline.train_batches(ds, batch_size=8, seed=0))
+
+
+def test_train_batches_empty_raises():
+    ds = pipeline.InMemoryDataset(np.zeros((0, 1, 1, 1)), np.zeros((0, 1, 1, 1)), [])
+    with pytest.raises(ValueError):
+        next(pipeline.train_batches(ds, 2, 0))

@@ -83,3 +83,12 @@ def test_top1_accuracy():
     logits = jnp.asarray([[0.1, 0.9], [0.8, 0.2]])
     labels = jnp.asarray([1, 1])
     np.testing.assert_allclose(np.asarray(top1_accuracy_scores(logits, labels)), [1.0, 0.0])
+
+
+def test_mean_weighted_update_excludes_padding():
+    """Weights=0 must exclude values — the eval wrap-around-padding mask contract."""
+    m = Mean.empty().update(jnp.asarray([1.0, 3.0, 100.0]), jnp.asarray([1.0, 1.0, 0.0]))
+    assert float(m.compute()) == pytest.approx(2.0)
+    # unweighted stream merged with a weighted one
+    m2 = m.merge(Mean.empty().update(jnp.asarray([2.0])))
+    assert float(m2.compute()) == pytest.approx(2.0)

@@ -136,3 +136,34 @@ def test_state_stays_replicated_after_step():
     state, _ = train_step(state, shard_batch(batch, mesh))
     leaf = jax.tree.leaves(state.params)[0]
     assert leaf.sharding.is_fully_replicated
+
+
+def test_eval_step_valid_mask_excludes_padding():
+    """Eval metrics with a `valid` mask must equal metrics computed over only the
+    valid rows — the wrap-around-padding exclusion contract of eval_batches."""
+    mesh = make_mesh(8)
+    task = SegmentationTask()
+    state = _setup(SMALL_SEG, task, mesh, (1, 49, 49, 2))
+    eval_step = make_eval_step(mesh, task)
+    batch = next(synthetic_batches("segmentation", 16, seed=6, input_shape=(49, 49)))
+
+    # full batch, but only the first 10 rows are real
+    valid = np.zeros(16, np.float32)
+    valid[:10] = 1.0
+    masked = dict(batch)
+    masked["valid"] = valid
+    got = compute_metrics(eval_step(state, shard_batch(masked, mesh)))
+
+    # reference: build a 16-row batch whose rows are the 10 real ones wrapped around,
+    # all valid -- metrics over exactly the same multiset requires matching rows, so
+    # instead compare against a masked run with the padded rows REPLACED by garbage:
+    # results must be identical since weight 0 excludes them.
+    garbage = dict(masked)
+    garbage["images"] = batch["images"].copy()
+    garbage["images"][10:] = 999.0
+    got_garbage = compute_metrics(eval_step(state, shard_batch(garbage, mesh)))
+    for k in got:
+        assert got[k] == pytest.approx(got_garbage[k], rel=1e-6), k
+    # and the count only reflects valid rows
+    acc = eval_step(state, shard_batch(masked, mesh))
+    assert float(acc["metrics/mean_iou"].count) == 10.0
