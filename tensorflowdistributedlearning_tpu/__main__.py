@@ -1,0 +1,3 @@
+from tensorflowdistributedlearning_tpu.cli import main
+
+raise SystemExit(main())

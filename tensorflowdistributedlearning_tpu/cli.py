@@ -1,0 +1,183 @@
+"""Command-line driver — the reference's notebook cells as a CLI.
+
+The reference was driven by two notebooks that loaded Kaggle CSVs, binned mask
+coverage into stratification classes, and called ``Model(...).train(X, y, 64, 10000)``
+(reference: Untitled.ipynb cells 0-8, Test.ipynb cells 7-8; SURVEY §2.1 C13). This CLI
+covers the same flows plus a synthetic smoke mode that needs no data on disk:
+
+    python -m tensorflowdistributedlearning_tpu train   --data-dir D --model-dir M [...]
+    python -m tensorflowdistributedlearning_tpu predict --test-dir T --model-dir M [...]
+    python -m tensorflowdistributedlearning_tpu smoke   [--steps N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--model-dir", required=True)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--n-devices", type=int, default=None)
+    p.add_argument("--n-fold", type=int, default=5)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--input-shape", type=int, nargs=2, default=(101, 101))
+    p.add_argument("--n-blocks", type=int, nargs="+", default=(3, 4, 6))
+    p.add_argument("--base-depth", type=int, default=256)
+    p.add_argument("--backbone", choices=("resnet", "xception"), default="resnet")
+    p.add_argument("--block-type", choices=("bottleneck", "basic_block"),
+                   default="bottleneck")
+    p.add_argument("--dtype", choices=("float32", "bfloat16"), default="float32")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tensorflowdistributedlearning_tpu",
+        description="TPU-native K-fold segmentation training framework",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_train = sub.add_parser("train", help="K-fold cross-validated training")
+    _add_common(p_train)
+    p_train.add_argument("--data-dir", required=True,
+                         help="directory with images/*.png and masks/*.png")
+    p_train.add_argument("--lr", type=float, default=0.001)
+    p_train.add_argument("--steps", type=int, default=10_000)
+    p_train.add_argument("--save-best", type=int, default=5)
+    p_train.add_argument("--checkpoint-every", type=int, default=500)
+    p_train.add_argument("--eval-throttle-secs", type=int, default=300)
+
+    p_pred = sub.add_parser("predict", help="fold x TTA ensemble prediction")
+    _add_common(p_pred)
+    p_pred.add_argument("--test-dir", required=True)
+    p_pred.add_argument("--no-tta", action="store_true",
+                        help="disable test-time augmentation (single forward pass)")
+    p_pred.add_argument("--output", default=None,
+                        help="write predictions to this .npz (default: stdout summary)")
+
+    p_smoke = sub.add_parser(
+        "smoke", help="synthetic end-to-end training smoke (no data needed)"
+    )
+    p_smoke.add_argument("--steps", type=int, default=10)
+    p_smoke.add_argument("--batch-size", type=int, default=8)
+    p_smoke.add_argument("--n-devices", type=int, default=None)
+    return parser
+
+
+def _trainer(args):
+    from tensorflowdistributedlearning_tpu.config import TrainConfig
+    from tensorflowdistributedlearning_tpu.train.trainer import Trainer
+
+    tcfg = TrainConfig(
+        lr=getattr(args, "lr", 0.001),
+        n_devices=args.n_devices,
+        n_folds=args.n_fold,
+        seed=args.seed,
+        save_best=getattr(args, "save_best", 5),
+        checkpoint_every_steps=getattr(args, "checkpoint_every", 500),
+        eval_throttle_secs=getattr(args, "eval_throttle_secs", 300),
+    )
+    return Trainer(
+        args.model_dir,
+        getattr(args, "data_dir", ""),
+        train_config=tcfg,
+        backbone=args.backbone,
+        input_shape=tuple(args.input_shape),
+        n_blocks=tuple(args.n_blocks),
+        base_depth=args.base_depth,
+        block_type=args.block_type,
+        dtype=args.dtype,
+    )
+
+
+def cmd_train(args) -> int:
+    from tensorflowdistributedlearning_tpu.data import pipeline as pipeline_lib
+
+    trainer = _trainer(args)
+    ids = pipeline_lib.discover_ids(args.data_dir)
+    if not ids:
+        print(f"No images found under {args.data_dir}/images", file=sys.stderr)
+        return 1
+    results = trainer.train(ids, batch_size=args.batch_size, steps=args.steps)
+    print(json.dumps({"folds": results, "n_params": trainer.params}))
+    return 0
+
+
+def cmd_predict(args) -> int:
+    trainer = _trainer(args)
+    pred = trainer.predict(
+        args.test_dir, batch_size=args.batch_size, tta=not args.no_tta
+    )
+    if args.output:
+        np.savez(
+            args.output,
+            ids=np.asarray(pred["ids"]),
+            probabilities=pred["probabilities"],
+            masks=pred["masks"],
+        )
+        print(json.dumps({"written": args.output, "n": len(pred["ids"])}))
+    else:
+        coverage = float(pred["masks"].mean())
+        print(json.dumps({"n": len(pred["ids"]), "mean_mask_coverage": coverage}))
+    return 0
+
+
+def cmd_smoke(args) -> int:
+    """Synthetic segmentation training on whatever devices are visible."""
+    import jax
+
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.data.synthetic import synthetic_batches
+    from tensorflowdistributedlearning_tpu.models import build_model
+    from tensorflowdistributedlearning_tpu.parallel import mesh as mesh_lib
+    from tensorflowdistributedlearning_tpu.train import step as step_lib
+    from tensorflowdistributedlearning_tpu.train.state import create_train_state
+
+    cfg = ModelConfig(input_shape=(32, 32), n_blocks=(1, 1, 1), base_depth=32)
+    tcfg = TrainConfig(n_devices=args.n_devices)
+    mesh = mesh_lib.make_mesh(args.n_devices)
+    model = build_model(cfg)
+    state = mesh_lib.replicate(
+        create_train_state(
+            model,
+            step_lib.make_optimizer(tcfg),
+            jax.random.PRNGKey(0),
+            np.zeros((1, 32, 32, 2), np.float32),
+        ),
+        mesh,
+    )
+    train_step = step_lib.make_train_step(mesh, step_lib.SegmentationTask())
+    first = last = None
+    for batch in synthetic_batches(
+        "segmentation", args.batch_size, steps=args.steps,
+        input_shape=(32, 32), channels=2,
+    ):
+        state, metrics = train_step(state, mesh_lib.shard_batch(batch, mesh))
+        scalars = step_lib.compute_metrics(jax.device_get(metrics))
+        first = first if first is not None else scalars["loss"]
+        last = scalars["loss"]
+    print(json.dumps({
+        "steps": args.steps,
+        "devices": mesh_lib.data_parallel_degree(mesh),
+        "first_loss": first,
+        "last_loss": last,
+    }))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    args = build_parser().parse_args(argv)
+    return {"train": cmd_train, "predict": cmd_predict, "smoke": cmd_smoke}[
+        args.command
+    ](args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

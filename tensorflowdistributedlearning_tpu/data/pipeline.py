@@ -82,17 +82,23 @@ class InMemoryDataset:
         if ids is None:
             ids = discover_ids(data_dir)
         ids = list(ids)
-        images = np.stack(
-            [load_png(os.path.join(data_dir, "images", f"{i}.png")) for i in ids]
-        )
+        if not ids:
+            raise ValueError(f"No examples found under {data_dir}/images")
+        from tensorflowdistributedlearning_tpu.native import decode_png_batch
+
+        image_paths = [os.path.join(data_dir, "images", f"{i}.png") for i in ids]
+        # probe the first file for the dataset's (static) spatial shape
+        h, w = load_png(image_paths[0]).shape[:2]
+        # multithreaded native decode (GIL-free C++; PIL fallback inside)
+        images = decode_png_batch(image_paths, h, w, channels=1)
         if normalize:
             images = (images - MEAN) / STD
         masks = None
         if with_masks:
-            masks = np.stack(
-                [load_png(os.path.join(data_dir, "masks", f"{i}.png")) for i in ids]
+            mask_paths = [os.path.join(data_dir, "masks", f"{i}.png") for i in ids]
+            masks = (decode_png_batch(mask_paths, h, w, channels=1) > 0.5).astype(
+                np.float32
             )
-            masks = (masks > 0.5).astype(np.float32)
         return cls(images, masks, ids)
 
     def select(self, ids: Sequence[str]) -> "InMemoryDataset":
@@ -129,18 +135,16 @@ def train_batches(
     n = len(dataset)
     if n == 0:
         raise ValueError("Empty dataset")
-    if batch_size > n:
-        raise ValueError(
-            f"batch_size {batch_size} exceeds dataset size {n}; downstream sharding "
-            f"requires full batches"
-        )
     rng = np.random.default_rng(seed)
+    # Epoch permutations are chained so full batches always come off an infinite
+    # stream — the reference's ``shuffle_and_repeat`` semantics (model.py:301-304),
+    # which also serve folds smaller than one batch.
     order = rng.permutation(n)
     pos = 0
     emitted = 0
     while steps is None or emitted < steps:
-        if pos + batch_size > n:
-            order = rng.permutation(n)
+        while len(order) - pos < batch_size:
+            order = np.concatenate([order[pos:], rng.permutation(n)])
             pos = 0
         rows = order[pos : pos + batch_size]
         pos += batch_size
@@ -154,7 +158,8 @@ def eval_batches(
     """One pass over the dataset in order. The final partial batch is padded by
     wrap-around to keep shapes static for jit, and a per-example ``valid`` 0/1 mask
     marks the pad rows so the eval step's weighted streaming means exclude them —
-    every example counts exactly once regardless of ``n % batch_size``."""
+    every example counts exactly once regardless of ``n % batch_size``. Datasets
+    without masks (test sets) yield only {'images', 'valid'}."""
     n = len(dataset)
     for start in range(0, n, batch_size):
         rows = np.arange(start, min(start + batch_size, n))
@@ -162,11 +167,10 @@ def eval_batches(
         if len(rows) < batch_size:
             valid[len(rows) :] = 0.0
             rows = np.concatenate([rows, np.arange(batch_size - len(rows))])
-        yield {
-            "images": dataset.images[rows],
-            "masks": dataset.masks[rows],
-            "valid": valid,
-        }
+        batch = {"images": dataset.images[rows], "valid": valid}
+        if dataset.masks is not None:
+            batch["masks"] = dataset.masks[rows]
+        yield batch
 
 
 def device_prefetch(

@@ -1,0 +1,153 @@
+// Native host-side IO: multithreaded PNG decode into a caller-provided float32
+// arena.
+//
+// The reference's input pipeline leaned on TensorFlow's C++ tf.data runtime for
+// its decode/shuffle/batch/prefetch hot path (reference: model.py:296-322; SURVEY
+// §2.2 "tf.data C++ pipeline"). This is the TPU-native framework's equivalent:
+// the host-side decode runs in native threads (off the GIL), the device-side
+// augmentation stays in XLA (data/augment.py).
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image):
+//   tfdl_decode_png_batch(paths, n, out, h, w, channels, n_threads) -> int
+//     Decodes n PNG files into out[n, h, w, channels] float32 in [0, 1].
+//     Grayscale files fill every requested channel; RGB(A) files must match
+//     channels (or be gray-converted when channels == 1). Returns 0 on success,
+//     else 1 + the index of the first failing file.
+//   tfdl_version() -> const char*
+
+#include <png.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Decode one 8/16-bit PNG to float32 [h, w, channels] in [0, 1].
+// Returns true on success (file exists, is a PNG, and matches h x w).
+bool DecodeOne(const char* path, float* out, int h, int w, int channels) {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) return false;
+
+  png_byte header[8];
+  if (std::fread(header, 1, 8, fp) != 8 || png_sig_cmp(header, 0, 8)) {
+    std::fclose(fp);
+    return false;
+  }
+
+  png_structp png =
+      png_create_read_struct(PNG_LIBPNG_VER_STRING, nullptr, nullptr, nullptr);
+  if (!png) {
+    std::fclose(fp);
+    return false;
+  }
+  png_infop info = png_create_info_struct(png);
+  // Declared BEFORE setjmp so a libpng longjmp unwinds through objects that are
+  // already fully constructed — their destructors run on the error-path return.
+  std::vector<png_byte> pixels;
+  std::vector<png_bytep> rows;
+  if (!info || setjmp(png_jmpbuf(png))) {
+    png_destroy_read_struct(&png, info ? &info : nullptr, nullptr);
+    std::fclose(fp);
+    return false;
+  }
+
+  png_init_io(png, fp);
+  png_set_sig_bytes(png, 8);
+  png_read_info(png, info);
+
+  const int img_w = png_get_image_width(png, info);
+  const int img_h = png_get_image_height(png, info);
+  if (img_w != w || img_h != h) {
+    png_destroy_read_struct(&png, &info, nullptr);
+    std::fclose(fp);
+    return false;
+  }
+
+  // Normalize every input to 8-bit gray or RGB.
+  png_set_strip_16(png);
+  png_set_strip_alpha(png);
+  png_set_palette_to_rgb(png);
+  png_set_expand_gray_1_2_4_to_8(png);
+  png_set_interlace_handling(png);  // de-interlace Adam7 files
+  png_read_update_info(png, info);
+  const int img_channels = png_get_channels(png, info);
+
+  // Read the whole image through row pointers: png_read_image runs every
+  // interlace pass, which per-row png_read_row would not.
+  const size_t rowbytes = png_get_rowbytes(png, info);
+  pixels.resize(rowbytes * h);
+  rows.resize(h);
+  for (int y = 0; y < h; ++y) rows[y] = pixels.data() + rowbytes * y;
+  png_read_image(png, rows.data());
+
+  for (int y = 0; y < h; ++y) {
+    const png_byte* row = rows[y];
+    float* dst = out + static_cast<int64_t>(y) * w * channels;
+    if (img_channels == 1) {
+      // gray: broadcast into every requested channel
+      for (int x = 0; x < w; ++x) {
+        const float v = row[x] / 255.0f;
+        for (int c = 0; c < channels; ++c) dst[x * channels + c] = v;
+      }
+    } else if (img_channels == 3 && channels == 3) {
+      for (int x = 0; x < w * 3; ++x) dst[x] = row[x] / 255.0f;
+    } else if (img_channels == 3 && channels == 1) {
+      // ITU-R BT.601 luma, what PIL's convert("L") computes
+      for (int x = 0; x < w; ++x) {
+        dst[x] = (0.299f * row[3 * x] + 0.587f * row[3 * x + 1] +
+                  0.114f * row[3 * x + 2]) /
+                 255.0f;
+      }
+    } else {
+      png_destroy_read_struct(&png, &info, nullptr);
+      std::fclose(fp);
+      return false;
+    }
+  }
+
+  png_destroy_read_struct(&png, &info, nullptr);
+  std::fclose(fp);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+int tfdl_decode_png_batch(const char** paths, int n, float* out, int h, int w,
+                          int channels, int n_threads) {
+  if (n <= 0) return 0;
+  if (n_threads <= 0) n_threads = 1;
+  if (n_threads > n) n_threads = n;
+
+  std::atomic<int> next(0);
+  std::atomic<int> first_error(-1);
+  const int64_t stride = static_cast<int64_t>(h) * w * channels;
+
+  auto worker = [&]() {
+    for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) {
+      if (first_error.load(std::memory_order_relaxed) >= 0) return;
+      if (!DecodeOne(paths[i], out + i * stride, h, w, channels)) {
+        int expected = -1;
+        first_error.compare_exchange_strong(expected, i);
+        return;
+      }
+    }
+  };
+
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  for (int t = 0; t < n_threads; ++t) threads.emplace_back(worker);
+  for (auto& t : threads) t.join();
+
+  const int err = first_error.load();
+  return err < 0 ? 0 : 1 + err;
+}
+
+const char* tfdl_version() { return "tfdl-io 0.1.0"; }
+
+}  // extern "C"

@@ -1,0 +1,266 @@
+"""Minimal, dependency-free TensorBoard event-file writer.
+
+The reference's observability was TensorBoard summaries written by manual
+``SummarySaverHook``s — train scalars + image grids every 20 steps to
+``fold{i}/train``, eval images every step to ``fold{i}/eval``, with automatic
+summaries disabled so train and eval curves share plots (reference:
+model.py:405-481, 120). This module reproduces those event files WITHOUT importing
+TensorFlow: it hand-encodes the two tiny protobuf messages TensorBoard reads
+(``Event`` wrapping ``Summary``) and frames them as TFRecords with masked CRC-32C —
+the on-disk format is byte-compatible with what ``tf.summary.FileWriter`` produced.
+
+Wire schema encoded here (field numbers from the public tensorboard .protos):
+  Event:   1=wall_time(double) 2=step(int64) 5=summary(message)
+  Summary: 1=repeated Value;  Value: 1=tag(string) 2=simple_value(float)
+                                     4=image(message)
+  Image:   1=height 2=width 3=colorspace 4=encoded_image_string(PNG bytes)
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+from typing import Dict, Optional, Union
+
+import numpy as np
+
+# -- protobuf wire-format primitives ----------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire_type: int) -> bytes:
+    return _varint((field << 3) | wire_type)
+
+
+def _field_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(value)
+
+
+def _field_double(field: int, value: float) -> bytes:
+    return _tag(field, 1) + struct.pack("<d", value)
+
+
+def _field_float(field: int, value: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", value)
+
+
+def _field_bytes(field: int, value: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(value)) + value
+
+
+# -- CRC-32C (Castagnoli), table-driven, with the TFRecord mask --------------
+
+_CRC_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ 0x82F63B78 if _c & 1 else _c >> 1
+    _CRC_TABLE.append(_c)
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def _tfrecord(payload: bytes) -> bytes:
+    header = struct.pack("<Q", len(payload))
+    return (
+        header
+        + struct.pack("<I", _masked_crc(header))
+        + payload
+        + struct.pack("<I", _masked_crc(payload))
+    )
+
+
+# -- summary message builders ------------------------------------------------
+
+
+def _scalar_value(tag: str, value: float) -> bytes:
+    body = _field_bytes(1, tag.encode()) + _field_float(2, float(value))
+    return _field_bytes(1, body)  # Summary.value
+
+
+def _encode_png(image: np.ndarray) -> bytes:
+    from io import BytesIO
+
+    from PIL import Image
+
+    buf = BytesIO()
+    Image.fromarray(image).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _image_value(tag: str, image: np.ndarray) -> bytes:
+    """``image``: [H, W] or [H, W, C] float in [0,1] or uint8."""
+    if image.dtype != np.uint8:
+        image = (np.clip(image, 0.0, 1.0) * 255.0).astype(np.uint8)
+    if image.ndim == 3 and image.shape[-1] == 1:
+        image = image[..., 0]
+    h, w = image.shape[0], image.shape[1]
+    colorspace = 1 if image.ndim == 2 else image.shape[-1]
+    img_msg = (
+        _field_varint(1, h)
+        + _field_varint(2, w)
+        + _field_varint(3, colorspace)
+        + _field_bytes(4, _encode_png(image))
+    )
+    body = _field_bytes(1, tag.encode()) + _field_bytes(4, img_msg)
+    return _field_bytes(1, body)
+
+
+def _event(step: int, summary_body: bytes, wall_time: Optional[float] = None) -> bytes:
+    return (
+        _field_double(1, wall_time if wall_time is not None else time.time())
+        + _field_varint(2, step)
+        + _field_bytes(5, summary_body)
+    )
+
+
+# -- public writer -----------------------------------------------------------
+
+
+class SummaryWriter:
+    """Append-only TensorBoard event file in ``logdir`` (one per writer, created with
+    the conventional ``events.out.tfevents.{ts}.{host}`` name)."""
+
+    def __init__(self, logdir: str):
+        self.logdir = logdir
+        os.makedirs(logdir, exist_ok=True)
+        fname = f"events.out.tfevents.{int(time.time())}.{os.uname().nodename}"
+        self._f = open(os.path.join(logdir, fname), "ab")
+        # file-version header event, as the TF writer emits
+        header = _field_double(1, time.time()) + _field_bytes(
+            3, b"brain.Event:2"
+        )
+        self._f.write(_tfrecord(header))
+        self._f.flush()
+
+    def scalar(self, tag: str, value: float, step: int) -> None:
+        self._f.write(_tfrecord(_event(step, _scalar_value(tag, value))))
+
+    def scalars(self, values: Dict[str, float], step: int) -> None:
+        body = b"".join(_scalar_value(t, v) for t, v in values.items())
+        self._f.write(_tfrecord(_event(step, body)))
+
+    def image(self, tag: str, image: np.ndarray, step: int) -> None:
+        """One image summary (the reference summarized input/label/probability/
+        prediction grids, model.py:405-426)."""
+        self._f.write(_tfrecord(_event(step, _image_value(tag, np.asarray(image)))))
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.flush()
+        self._f.close()
+
+
+def read_events(path: str):
+    """Parse an event file back into [(step, {tag: value})] for scalars — used by
+    tests to round-trip the writer without TensorBoard installed."""
+    out = []
+    with open(path, "rb") as f:
+        data = f.read()
+    pos = 0
+    while pos + 12 <= len(data):
+        (length,) = struct.unpack_from("<Q", data, pos)
+        payload = data[pos + 12 : pos + 12 + length]
+        pos += 12 + length + 4
+        step, scalars = _parse_event(payload)
+        if scalars:
+            out.append((step, scalars))
+    return out
+
+
+def _parse_event(payload: bytes):
+    step, scalars = 0, {}
+    pos = 0
+    while pos < len(payload):
+        key, pos = _read_varint(payload, pos)
+        field, wt = key >> 3, key & 7
+        if wt == 0:
+            val, pos = _read_varint(payload, pos)
+            if field == 2:
+                step = val
+        elif wt == 1:
+            pos += 8
+        elif wt == 5:
+            pos += 4
+        elif wt == 2:
+            ln, pos = _read_varint(payload, pos)
+            chunk = payload[pos : pos + ln]
+            pos += ln
+            if field == 5:  # summary
+                scalars.update(_parse_summary(chunk))
+    return step, scalars
+
+
+def _parse_summary(data: bytes) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    pos = 0
+    while pos < len(data):
+        key, pos = _read_varint(data, pos)
+        field, wt = key >> 3, key & 7
+        if wt == 2:
+            ln, pos = _read_varint(data, pos)
+            chunk = data[pos : pos + ln]
+            pos += ln
+            if field == 1:  # Value
+                tag, val = None, None
+                p = 0
+                while p < len(chunk):
+                    k, p = _read_varint(chunk, p)
+                    f, w = k >> 3, k & 7
+                    if w == 2:
+                        l2, p = _read_varint(chunk, p)
+                        if f == 1:
+                            tag = chunk[p : p + l2].decode()
+                        p += l2
+                    elif w == 5:
+                        if f == 2:
+                            (val,) = struct.unpack_from("<f", chunk, p)
+                        p += 4
+                    elif w == 1:
+                        p += 8
+                    elif w == 0:
+                        _, p = _read_varint(chunk, p)
+                if tag is not None and val is not None:
+                    out[tag] = val
+        elif wt == 0:
+            _, pos = _read_varint(data, pos)
+        elif wt == 1:
+            pos += 8
+        elif wt == 5:
+            pos += 4
+    return out
+
+
+def _read_varint(data: bytes, pos: int):
+    result = shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7

@@ -1,0 +1,84 @@
+"""Checkpoint manager tests: periodic cadence, auto-resume, best-k export with the
+comparison the right way around (SURVEY §2.4.4 — the reference exported on
+regressions)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+from tensorflowdistributedlearning_tpu.models import build_model
+from tensorflowdistributedlearning_tpu.parallel import make_mesh, replicate
+from tensorflowdistributedlearning_tpu.train import create_train_state, make_optimizer
+from tensorflowdistributedlearning_tpu.train.checkpoint import CheckpointManager
+
+TINY = ModelConfig(n_blocks=(1, 1, 1), input_shape=(33, 33), base_depth=16)
+
+
+@pytest.fixture(scope="module")
+def state(eight_devices_module=None):
+    cfg = TINY
+    model = build_model(cfg)
+    tx = make_optimizer(TrainConfig())
+    sample = np.zeros((1, 33, 33, 2), np.float32)
+    mesh = make_mesh(8)
+    return replicate(
+        create_train_state(model, tx, jax.random.PRNGKey(0), sample), mesh
+    )
+
+
+def _bump(state, n):
+    return state.replace(
+        step=state.step + n,
+        params=jax.tree.map(lambda x: x + 1.0, state.params),
+    )
+
+
+def test_save_restore_roundtrip(state, tmp_path):
+    ckpt = CheckpointManager(str(tmp_path / "m"), save_every_steps=2)
+    s1 = _bump(state, 2)
+    assert ckpt.maybe_save(s1)
+    restored = ckpt.restore_latest(state)
+    assert int(restored.step) == 2
+    a = jax.tree.leaves(s1.params)[0]
+    b = jax.tree.leaves(restored.params)[0]
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    ckpt.close()
+
+
+def test_maybe_save_respects_cadence(state, tmp_path):
+    ckpt = CheckpointManager(str(tmp_path / "m"), save_every_steps=4)
+    assert not ckpt.maybe_save(_bump(state, 3))  # off-cadence
+    assert ckpt.maybe_save(_bump(state, 4))
+    assert ckpt.latest_step() == 4
+    ckpt.close()
+
+
+def test_restore_latest_without_checkpoint_returns_template(state, tmp_path):
+    ckpt = CheckpointManager(str(tmp_path / "m"))
+    restored = ckpt.restore_latest(state)
+    assert restored is state
+    ckpt.close()
+
+
+def test_best_export_keeps_top_k_and_right_direction(state, tmp_path):
+    ckpt = CheckpointManager(str(tmp_path / "m"), save_best=2)
+    # offer three states with mIOU 0.5 (step 1), 0.9 (step 2), 0.2 (step 3)
+    for step, miou in [(1, 0.5), (2, 0.9), (3, 0.2)]:
+        s = state.replace(step=jnp.asarray(step, jnp.int32))
+        ckpt.export_best(s, {"metrics/mean_iou": miou})
+    # best must be the 0.9 run, NOT the most recent worse one
+    assert ckpt.best_step() == 2
+    restored = ckpt.restore_best(state)
+    assert int(restored.step) == 2
+    ckpt.close()
+
+
+def test_restore_best_falls_back_to_latest(state, tmp_path):
+    ckpt = CheckpointManager(str(tmp_path / "m"), save_every_steps=1)
+    s1 = _bump(state, 1)
+    ckpt.save(s1)
+    restored = ckpt.restore_best(state)  # no best export yet
+    assert int(restored.step) == 1
+    ckpt.close()

@@ -1,0 +1,46 @@
+"""CLI driver tests (the reference's notebook flows as commands, SURVEY §2.1 C13)."""
+
+import json
+
+import pytest
+
+from tensorflowdistributedlearning_tpu.cli import build_parser, main
+
+
+def test_parser_requires_command():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args([])
+
+
+def test_parser_train_defaults():
+    args = build_parser().parse_args(
+        ["train", "--data-dir", "d", "--model-dir", "m"]
+    )
+    assert args.batch_size == 64
+    assert args.steps == 10_000
+    assert args.n_fold == 5
+    assert tuple(args.input_shape) == (101, 101)
+
+
+def test_smoke_command_trains(capsys):
+    rc = main(["smoke", "--steps", "2", "--batch-size", "8"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["steps"] == 2
+    assert out["devices"] >= 1
+    assert out["last_loss"] == pytest.approx(out["last_loss"])  # finite
+
+
+def test_train_command_missing_data(tmp_path, capsys):
+    rc = main(
+        [
+            "train",
+            "--data-dir",
+            str(tmp_path),
+            "--model-dir",
+            str(tmp_path / "m"),
+            "--steps",
+            "1",
+        ]
+    )
+    assert rc == 1

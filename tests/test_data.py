@@ -121,11 +121,16 @@ def test_device_prefetch_propagates_errors():
         next(it)
 
 
-def test_train_batches_rejects_oversized_batch(tmp_path):
+def test_train_batches_oversized_batch_repeats_dataset(tmp_path):
+    # folds can be smaller than one batch; the infinite-repeat stream must still
+    # fill full batches (the reference's shuffle_and_repeat, model.py:301-304)
     _png_dataset(tmp_path, n=3)
     ds = pipeline.InMemoryDataset.from_directory(str(tmp_path))
-    with pytest.raises(ValueError, match="exceeds dataset size"):
-        next(pipeline.train_batches(ds, batch_size=8, seed=0))
+    batch = next(pipeline.train_batches(ds, batch_size=8, seed=0))
+    assert batch["images"].shape[0] == 8
+    # every underlying example appears at least twice in 8 draws from 3
+    flat = batch["images"].reshape(8, -1)
+    assert len(np.unique(flat, axis=0)) == 3
 
 
 def test_train_batches_empty_raises():

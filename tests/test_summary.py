@@ -1,0 +1,49 @@
+"""TensorBoard event-writer tests: wire-format correctness (CRC-32C known-answer,
+TFRecord framing) and scalar round-trips via the bundled parser."""
+
+import glob
+import os
+import struct
+
+import numpy as np
+
+from tensorflowdistributedlearning_tpu.utils import summary as summary_lib
+
+
+def test_crc32c_known_answer():
+    # RFC 3720 check value for "123456789"
+    assert summary_lib._crc32c(b"123456789") == 0xE3069283
+
+
+def test_tfrecord_framing():
+    rec = summary_lib._tfrecord(b"abc")
+    (length,) = struct.unpack_from("<Q", rec, 0)
+    assert length == 3
+    assert rec[12:15] == b"abc"
+    # payload crc verifies
+    (crc,) = struct.unpack_from("<I", rec, 15)
+    assert crc == summary_lib._masked_crc(b"abc")
+
+
+def test_scalar_roundtrip(tmp_path):
+    w = summary_lib.SummaryWriter(str(tmp_path))
+    w.scalar("loss", 1.5, step=10)
+    w.scalars({"metrics/mean_iou": 0.25, "metrics/mean_acc": 0.75}, step=20)
+    w.close()
+    (path,) = glob.glob(os.path.join(str(tmp_path), "events.out.tfevents.*"))
+    events = summary_lib.read_events(path)
+    assert events[0] == (10, {"loss": 1.5})
+    step, scalars = events[1]
+    assert step == 20
+    assert abs(scalars["metrics/mean_iou"] - 0.25) < 1e-6
+    assert abs(scalars["metrics/mean_acc"] - 0.75) < 1e-6
+
+
+def test_image_event_written(tmp_path):
+    w = summary_lib.SummaryWriter(str(tmp_path))
+    w.image("probability/0", np.random.default_rng(0).uniform(0, 1, (8, 8)), step=1)
+    w.close()
+    (path,) = glob.glob(os.path.join(str(tmp_path), "events.out.tfevents.*"))
+    # parseable (image events yield no scalars but must not break the reader)
+    assert summary_lib.read_events(path) == []
+    assert os.path.getsize(path) > 100
