@@ -67,6 +67,8 @@ def build_parser() -> argparse.ArgumentParser:
     p_smoke.add_argument("--steps", type=int, default=10)
     p_smoke.add_argument("--batch-size", type=int, default=8)
     p_smoke.add_argument("--n-devices", type=int, default=None)
+
+    sub.add_parser("presets", help="list the named BASELINE config presets")
     return parser
 
 
@@ -171,12 +173,37 @@ def cmd_smoke(args) -> int:
     return 0
 
 
+def cmd_presets(args) -> int:
+    from tensorflowdistributedlearning_tpu.configs import PRESETS
+
+    print(
+        json.dumps(
+            {
+                name: {
+                    "description": p.description,
+                    "global_batch": p.global_batch,
+                    "backbone": p.model.backbone,
+                    "num_classes": p.model.num_classes,
+                    "input_shape": list(p.model.input_shape),
+                    "dtype": p.model.dtype,
+                }
+                for name, p in PRESETS.items()
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     logging.basicConfig(level=logging.INFO)
     args = build_parser().parse_args(argv)
-    return {"train": cmd_train, "predict": cmd_predict, "smoke": cmd_smoke}[
-        args.command
-    ](args)
+    return {
+        "train": cmd_train,
+        "predict": cmd_predict,
+        "smoke": cmd_smoke,
+        "presets": cmd_presets,
+    }[args.command](args)
 
 
 if __name__ == "__main__":

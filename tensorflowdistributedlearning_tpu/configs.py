@@ -1,0 +1,116 @@
+"""Named config registry — the BASELINE.json config ladder as one-call presets.
+
+The reference's "configs" were notebook cells (SURVEY §5.6: batch 64, 10 000 steps,
+2 GPUs, 5 folds hard-coded in Untitled.ipynb/Test.ipynb). Here every supported
+configuration is a named ``(ModelConfig, TrainConfig)`` preset covering the
+BASELINE.json ladder: CIFAR smoke -> ImageNet ResNet-50/101/152 + Xception-41 DP ->
+bf16 large-batch pod config, plus the reference's own TGS-salt segmentation run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class Preset:
+    model: ModelConfig
+    train: TrainConfig
+    global_batch: int
+    description: str
+
+
+def _imagenet_model(**kw) -> ModelConfig:
+    base = dict(
+        num_classes=1000,
+        input_shape=(224, 224),
+        input_channels=3,
+        output_stride=None,  # standard stride-32 classification trunk
+        dtype="bfloat16",
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+PRESETS: Dict[str, Preset] = {
+    # the reference's production config: TGS salt segmentation, 5-fold, batch 64,
+    # Adam 1e-3 halving each 10k steps (reference: model.py:33, 457-462;
+    # Untitled.ipynb cells 7-8)
+    "tgs_salt": Preset(
+        model=ModelConfig(),
+        train=TrainConfig(),
+        global_batch=64,
+        description="Reference parity: ResNet-v2-beta + DeepLabV3+ head, 101x101x2, "
+        "5-fold CV, Lovász hinge (reference: model.py defaults)",
+    ),
+    # BASELINE.json "ResNet-50 single-tower CIFAR-10 (CPU smoke test)"
+    "cifar10_smoke": Preset(
+        model=ModelConfig(
+            num_classes=10,
+            input_shape=(32, 32),
+            input_channels=3,
+            n_blocks=(1, 1, 1),
+            base_depth=64,
+            output_stride=None,
+        ),
+        train=TrainConfig(n_folds=2, checkpoint_every_steps=100),
+        global_batch=64,
+        description="CIFAR-10-shaped smoke config runnable on a CPU mesh",
+    ),
+    # BASELINE.json "ResNet-50 multi-tower data-parallel (ImageNet-1k)"
+    "resnet50_imagenet": Preset(
+        model=_imagenet_model(n_blocks=(3, 4, 6)),
+        train=TrainConfig(lr=0.001),
+        global_batch=1024,
+        description="ResNet-50 ImageNet-1k data-parallel, bf16",
+    ),
+    # BASELINE.json "ResNet-101 / ResNet-152 deeper variants"
+    "resnet101_imagenet": Preset(
+        model=_imagenet_model(n_blocks=(3, 4, 23)),
+        train=TrainConfig(lr=0.001),
+        global_batch=1024,
+        description="ResNet-101 ImageNet-1k data-parallel, bf16",
+    ),
+    "resnet152_imagenet": Preset(
+        model=_imagenet_model(n_blocks=(3, 8, 36)),
+        train=TrainConfig(lr=0.001),
+        global_batch=1024,
+        description="ResNet-152 ImageNet-1k data-parallel, bf16",
+    ),
+    # BASELINE.json "Xception multi-tower data-parallel (ImageNet-1k)"
+    "xception41_imagenet": Preset(
+        model=_imagenet_model(backbone="xception"),
+        train=TrainConfig(lr=0.001),
+        global_batch=1024,
+        description="Xception-41 ImageNet-1k data-parallel, bf16 (the backbone the "
+        "reference shipped broken, fixed here — SURVEY §2.4.8-10)",
+    ),
+    # BASELINE.json "ResNet-50 bfloat16 large-batch (8k) on v5e-64 pod"
+    "resnet50_bf16_8k": Preset(
+        model=_imagenet_model(n_blocks=(3, 4, 6)),
+        train=TrainConfig(lr=0.008),  # linear-scaled for the 8x batch
+        global_batch=8192,
+        description="ResNet-50 bf16 large-batch (8k) pod config (v5e-64: 128/chip)",
+    ),
+}
+
+
+def get_preset(name: str) -> Preset:
+    if name not in PRESETS:
+        raise ValueError(
+            f"Unknown preset {name!r}; available: {sorted(PRESETS)}"
+        )
+    return PRESETS[name]
+
+
+def resnet_depth_blocks(depth: int) -> Tuple[int, int, int]:
+    """Stage sizes for the standard ResNet depths (units before the 3-unit atrous/
+    final stage, matching the reference's (3,4,6)=ResNet-50 convention,
+    reference: model.py:101-103, core/resnet.py:330-344)."""
+    table = {50: (3, 4, 6), 101: (3, 4, 23), 152: (3, 8, 36)}
+    if depth not in table:
+        raise ValueError(f"Unsupported ResNet depth {depth}; choose from {sorted(table)}")
+    return table[depth]

@@ -381,26 +381,47 @@ class Trainer:
         total = None
         n_members = 0
         for fold in folds:
-            ckpt = self._checkpointer(fold)
-            if ckpt.best_step() is None and ckpt.latest_step() is None:
-                ckpt.close()
-                raise RuntimeError(
-                    f"fold {fold} has no trained checkpoint under "
-                    f"{self._fold_dir(fold)} — train it first or pass "
-                    f"folds=[...] with only the trained folds"
-                )
-            state = ckpt.restore_best(template)
+            state = self._restore_fold_or_raise(fold, template)
             for transformation in transforms:
                 probs = self._predict_one(state, test_ds, batch_size, transformation)
                 total = probs if total is None else total + probs
                 n_members += 1
-            ckpt.close()
         mean_probs = total / n_members
         return {
             "ids": list(test_ds.ids),
             "probabilities": mean_probs,
             "masks": (mean_probs > self.task.threshold).astype(np.float32),
         }
+
+    def _restore_fold_or_raise(self, fold: int, template: TrainState) -> TrainState:
+        """Best exported state for ``fold`` (falling back to the latest periodic
+        checkpoint); raises if the fold was never trained."""
+        ckpt = self._checkpointer(fold)
+        try:
+            if ckpt.best_step() is None and ckpt.latest_step() is None:
+                raise RuntimeError(
+                    f"fold {fold} has no trained checkpoint under "
+                    f"{self._fold_dir(fold)} — train it first or pass "
+                    f"folds=[...] with only the trained folds"
+                )
+            return ckpt.restore_best(template)
+        finally:
+            ckpt.close()
+
+    def serving_fn(self, fold: int):
+        """Jitted single-model inference function for deployment — the JAX analogue
+        of the reference's exported SavedModel with serving signature
+        ``image: [None, H, W, input_channels] float32`` (reference: model.py:190-194).
+
+        Loads the fold's best state and returns ``serve(images) ->
+        {'probabilities', 'mask'}`` where ``images`` is the preprocessed input batch
+        (normalized + Laplacian channel, exactly what the reference's serving
+        placeholder received).
+        """
+        state = self._restore_fold_or_raise(fold, self._init_state())
+        task = self.task
+        forward = self._forward
+        return lambda images: task.predictions(forward(state, images))
 
     def _predict_one(
         self,

@@ -1,0 +1,103 @@
+"""Tracing / profiling utilities.
+
+The reference had NO profiler integration — its only performance artifacts were a
+param-count probe and docstring notes ("NCHW ~10% faster", reference: model.py:45-46,
+444-445; SURVEY §5.1). This module supplies the subsystem the reference lacked:
+
+- ``trace``: context manager around ``jax.profiler`` writing TensorBoard-viewable
+  traces (XLA op timeline, HBM usage) to a log dir;
+- ``StepTimer``: wall-clock per-step timing with a sync that is robust on tunneled
+  TPU backends (pulls a scalar with ``device_get`` — ``block_until_ready`` alone has
+  been observed to return before remote execution finishes);
+- ``annotate``: named trace spans (``jax.profiler.TraceAnnotation``) so host-side
+  phases (decode, shard, step) are visible in the timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture a ``jax.profiler`` trace for the enclosed block; view with
+    TensorBoard's profile plugin pointed at ``logdir``."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span visible in profiler timelines (host + device)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def sync(tree: Any) -> None:
+    """Force completion of every array in ``tree``. Uses ``device_get`` on one leaf
+    (full-result fetch) plus ``block_until_ready`` on the rest."""
+    leaves = [x for x in jax.tree.leaves(tree) if isinstance(x, jax.Array)]
+    if not leaves:
+        return
+    jax.block_until_ready(leaves)
+    # the cross-host/tunnel-safe barrier: an actual value fetch
+    np.asarray(jax.device_get(leaves[0]))
+
+
+class StepTimer:
+    """Accumulates per-step wall times; ``summary()`` reports mean/p50/p90 and
+    optional items/sec. Synchronization is the caller's choice: pass the step
+    output to ``stop`` and it is ``sync``'d before the clock stops."""
+
+    def __init__(self, items_per_step: Optional[int] = None):
+        self.items_per_step = items_per_step
+        self._times: List[float] = []
+        self._t0: Optional[float] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, outputs: Any = None) -> float:
+        if self._t0 is None:
+            raise RuntimeError("StepTimer.stop() without start()")
+        if outputs is not None:
+            sync(outputs)
+        dt = time.perf_counter() - self._t0
+        self._times.append(dt)
+        self._t0 = None
+        return dt
+
+    @contextlib.contextmanager
+    def step(self):
+        """``with timer.step(): out = train_step(...); sync(out)`` — the CALLER must
+        sync inside the block (or use start()/stop(outputs) which syncs for you);
+        otherwise only async dispatch is measured."""
+        self.start()
+        yield
+        self.stop()
+
+    @property
+    def times(self) -> List[float]:
+        return list(self._times)
+
+    def summary(self, skip_first: int = 1) -> Dict[str, float]:
+        """Timing stats, excluding the first ``skip_first`` (compile) steps."""
+        if not self._times:
+            raise RuntimeError("StepTimer.summary(): no steps recorded")
+        ts = np.asarray(self._times[skip_first:] or self._times, np.float64)
+        out = {
+            "steps": float(len(ts)),
+            "mean_s": float(ts.mean()),
+            "p50_s": float(np.percentile(ts, 50)),
+            "p90_s": float(np.percentile(ts, 90)),
+            "total_s": float(ts.sum()),
+        }
+        if self.items_per_step:
+            out["items_per_sec"] = self.items_per_step / out["mean_s"]
+        return out

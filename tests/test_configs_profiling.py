@@ -1,0 +1,103 @@
+"""Tests for the named-config registry, profiling utilities, and serving export."""
+
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.configs import (
+    PRESETS,
+    get_preset,
+    resnet_depth_blocks,
+)
+from tensorflowdistributedlearning_tpu.utils.profiling import StepTimer, annotate, sync
+
+
+BASELINE_LADDER = {
+    "tgs_salt",
+    "cifar10_smoke",
+    "resnet50_imagenet",
+    "resnet101_imagenet",
+    "resnet152_imagenet",
+    "xception41_imagenet",
+    "resnet50_bf16_8k",
+}
+
+
+def test_registry_covers_baseline_ladder():
+    assert BASELINE_LADDER <= set(PRESETS)
+
+
+def test_presets_are_buildable():
+    # every preset's ModelConfig must pass validation and build a module
+    from tensorflowdistributedlearning_tpu.models import build_model
+
+    for name in PRESETS:
+        preset = get_preset(name)
+        model = build_model(preset.model)
+        assert model is not None
+        assert preset.global_batch > 0
+
+
+def test_tgs_salt_is_reference_parity():
+    p = get_preset("tgs_salt")
+    assert p.model.input_shape == (101, 101)
+    assert p.model.input_channels == 2
+    assert p.train.lr == 0.001
+    assert p.train.n_folds == 5
+    assert p.global_batch == 64  # Untitled.ipynb cells 7-8
+
+
+def test_unknown_preset_raises():
+    with pytest.raises(ValueError, match="Unknown preset"):
+        get_preset("resnet9000")
+
+
+def test_resnet_depth_blocks():
+    assert resnet_depth_blocks(50) == (3, 4, 6)
+    assert resnet_depth_blocks(101) == (3, 4, 23)
+    assert resnet_depth_blocks(152) == (3, 8, 36)
+    with pytest.raises(ValueError):
+        resnet_depth_blocks(42)
+
+
+def test_step_timer_summary():
+    import jax.numpy as jnp
+
+    t = StepTimer(items_per_step=8)
+    for _ in range(4):
+        t.start()
+        out = jnp.ones((4, 4)) * 2
+        t.stop(out)
+    s = t.summary(skip_first=1)
+    assert s["steps"] == 3
+    assert s["mean_s"] > 0
+    assert s["items_per_sec"] > 0
+    assert s["p50_s"] <= s["p90_s"] or abs(s["p50_s"] - s["p90_s"]) < 1e-9
+
+
+def test_step_timer_requires_start():
+    with pytest.raises(RuntimeError):
+        StepTimer().stop()
+
+
+def test_step_timer_empty_summary_raises():
+    with pytest.raises(RuntimeError, match="no steps recorded"):
+        StepTimer().summary()
+
+
+def test_sync_handles_non_arrays():
+    sync({"a": 1, "b": [2, 3]})  # no jax arrays: must be a no-op, not a crash
+
+
+def test_annotate_span_runs():
+    with annotate("decode"):
+        np.zeros(3)
+
+
+def test_cli_presets_command(capsys):
+    import json
+
+    from tensorflowdistributedlearning_tpu.cli import main
+
+    assert main(["presets"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert BASELINE_LADDER <= set(out)

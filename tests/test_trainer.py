@@ -140,6 +140,24 @@ def test_predict_without_tta_differs_from_ensemble(trained):
     assert not np.allclose(tta["probabilities"], plain["probabilities"])
 
 
+def test_serving_fn(trained):
+    import jax.numpy as jnp
+
+    trainer, *_ = trained
+    serve = trainer.serving_fn(fold=0)
+    # the serving signature: preprocessed [B, H, W, input_channels] images
+    images = jnp.zeros((2, *SHAPE, 2), jnp.float32)
+    out = serve(images)
+    assert out["probabilities"].shape == (2, *SHAPE, 1)
+    assert set(np.unique(np.asarray(out["mask"]))) <= {0.0, 1.0}
+
+
+def test_serving_fn_refuses_untrained_fold(trained):
+    trainer, *_ = trained
+    with pytest.raises(RuntimeError, match="no trained checkpoint"):
+        trainer.serving_fn(fold=9)
+
+
 def test_predict_refuses_untrained_fold(trained):
     trainer, _, _, test, _ = trained
     with pytest.raises(RuntimeError, match="no trained checkpoint"):
