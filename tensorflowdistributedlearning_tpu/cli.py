@@ -60,6 +60,8 @@ def build_parser() -> argparse.ArgumentParser:
                         help="disable test-time augmentation (single forward pass)")
     p_pred.add_argument("--output", default=None,
                         help="write predictions to this .npz (default: stdout summary)")
+    p_pred.add_argument("--submission", default=None,
+                        help="also write a Kaggle RLE submission csv here")
 
     p_smoke = sub.add_parser(
         "smoke", help="synthetic end-to-end training smoke (no data needed)"
@@ -116,6 +118,10 @@ def cmd_predict(args) -> int:
     pred = trainer.predict(
         args.test_dir, batch_size=args.batch_size, tta=not args.no_tta
     )
+    if args.submission:
+        from tensorflowdistributedlearning_tpu.data.kaggle import write_submission
+
+        write_submission(args.submission, pred["ids"], pred["masks"])
     if args.output:
         np.savez(
             args.output,

@@ -46,6 +46,10 @@ class ModelConfig:
     # compute dtype: params stay float32, activations/matmuls run in this dtype. TPU MXU
     # natively prefers bfloat16 — this is a TPU-first knob the reference had no analogue of.
     dtype: str = "float32"
+    # route the ASPP's atrous depthwise convs through the Pallas VMEM kernel
+    # (ops/pallas_kernels.py) instead of XLA's grouped conv; parameter trees are
+    # identical between the two paths, so this is a pure execution-path switch.
+    use_pallas_depthwise: bool = False
 
     def __post_init__(self):
         if self.backbone not in ("resnet", "xception"):

@@ -1,0 +1,105 @@
+"""Kaggle TGS-salt driver helpers — the notebooks' data-prep cells as a library.
+
+The reference's drivers loaded ``train.csv`` + ``depths.csv``, computed per-image
+mask coverage, and binned it into 11 stratification classes fed to the K-fold split
+(reference: Untitled.ipynb cells 2-6: ``cov_to_class``; SURVEY §2.1 C13). This module
+reproduces that flow against the on-disk dataset layout, without requiring pandas
+(the CSVs are two-column files).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tensorflowdistributedlearning_tpu.data.folds import coverage_to_class
+from tensorflowdistributedlearning_tpu.data.pipeline import (
+    InMemoryDataset,
+    discover_ids,
+    mask_coverage,
+)
+
+
+def read_two_column_csv(path: str) -> Dict[str, str]:
+    """{first_column: second_column} for a headered CSV (train.csv id,rle_mask /
+    depths.csv id,z)."""
+    out: Dict[str, str] = {}
+    with open(path, newline="") as f:
+        reader = csv.reader(f)
+        next(reader, None)  # header
+        for row in reader:
+            if row:
+                out[row[0]] = row[1] if len(row) > 1 else ""
+    return out
+
+
+def load_depths(csv_path: str) -> Dict[str, float]:
+    """id -> depth from depths.csv (the notebooks merged it for analysis)."""
+    return {k: float(v) for k, v in read_two_column_csv(csv_path).items() if v}
+
+
+def load_tgs_training_set(
+    data_dir: str,
+    train_csv: Optional[str] = None,
+    n_classes: int = 11,
+) -> Tuple[List[str], np.ndarray]:
+    """(ids, stratification_classes) for ``Trainer.train`` — the notebooks' X and y.
+
+    Ids come from ``train.csv`` when given (the Kaggle manifest), else from the
+    images directory; classes are mask-coverage bins (``cov_to_class``,
+    Untitled.ipynb cell 4) computed from the decoded masks.
+    """
+    if train_csv is not None:
+        ids = sorted(read_two_column_csv(train_csv))
+        missing = [
+            i
+            for i in ids
+            if not os.path.exists(os.path.join(data_dir, "images", f"{i}.png"))
+        ]
+        if missing:
+            raise FileNotFoundError(
+                f"{len(missing)} ids from {train_csv} have no image under "
+                f"{data_dir}/images (first: {missing[0]})"
+            )
+    else:
+        ids = discover_ids(data_dir)
+    dataset = InMemoryDataset.from_directory(data_dir, ids=ids, normalize=False)
+    classes = coverage_to_class(mask_coverage(dataset.masks), n_classes)
+    return ids, classes
+
+
+def rle_encode(mask: np.ndarray) -> str:
+    """Kaggle run-length encoding of a binary mask (column-major, 1-indexed) — the
+    submission format the reference's unfinished predict path was headed for
+    (reference: model.py:229-255 TODO)."""
+    pixels = np.asarray(mask, np.uint8).flatten(order="F")
+    padded = np.concatenate([[0], pixels, [0]])
+    changes = np.flatnonzero(padded[1:] != padded[:-1]) + 1
+    starts, ends = changes[::2], changes[1::2]
+    return " ".join(f"{s} {e - s}" for s, e in zip(starts, ends))
+
+
+def rle_decode(rle: str, shape: Tuple[int, int]) -> np.ndarray:
+    """Inverse of ``rle_encode``; empty string -> empty mask."""
+    mask = np.zeros(shape[0] * shape[1], np.uint8)
+    if rle.strip():
+        nums = np.asarray(rle.split(), np.int64)
+        starts, lengths = nums[::2] - 1, nums[1::2]
+        for s, l in zip(starts, lengths):
+            mask[s : s + l] = 1
+    return mask.reshape(shape, order="F")
+
+
+def write_submission(
+    path: str, ids: List[str], masks: np.ndarray
+) -> None:
+    """Write a Kaggle submission csv (id,rle_mask) from [N, H, W, 1] binary masks —
+    finishing the ensemble-to-submission step the reference left TODO."""
+    with open(path, "w", newline="") as f:
+        writer = csv.writer(f)
+        writer.writerow(["id", "rle_mask"])
+        for i, id_ in enumerate(ids):
+            writer.writerow([id_, rle_encode(masks[i, :, :, 0])])

@@ -106,6 +106,44 @@ class ConvBN(nn.Module):
         return x
 
 
+class DepthwiseConv2D(nn.Module):
+    """Stride-1 SAME depthwise conv with an optional Pallas fast path.
+
+    Parameter tree matches ``nn.Conv(feature_group_count=C)`` — ``kernel``
+    [kh, kw, 1, C] and ``bias`` [C] — so the two execution paths share checkpoints.
+    ``use_pallas=True`` routes through the VMEM shift-accumulate kernel
+    (ops/pallas_kernels.py); False uses XLA's grouped convolution.
+    """
+
+    kernel_size: int = 3
+    rate: int = 1
+    use_pallas: bool = False
+    kernel_init: Callable = nn.initializers.truncated_normal(stddev=0.33)
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = x.shape[-1]
+        k = self.kernel_size
+        if k % 2 != 1:
+            # both execution paths assume symmetric SAME padding; fail loudly and
+            # identically rather than silently shrinking the output (XLA path) or
+            # erroring deep in the kernel (Pallas path)
+            raise ValueError(f"DepthwiseConv2D requires an odd kernel_size, got {k}")
+        kernel = self.param("kernel", self.kernel_init, (k, k, 1, c))
+        bias = self.param("bias", nn.initializers.zeros, (c,))
+        dtype = self.dtype or x.dtype
+        x = x.astype(dtype)
+        from tensorflowdistributedlearning_tpu.ops.pallas_kernels import (
+            depthwise_conv2d,
+            depthwise_conv2d_reference,
+        )
+
+        dw = depthwise_conv2d if self.use_pallas else depthwise_conv2d_reference
+        out = dw(x, kernel[:, :, 0, :].astype(dtype), self.rate)
+        return out + bias.astype(dtype)
+
+
 class SplitSeparableConv2D(nn.Module):
     """Separable conv split into depthwise and pointwise with an activation between
     (reference: core/layers.py:7-49 — it differs from fused separable conv exactly in
@@ -122,19 +160,15 @@ class SplitSeparableConv2D(nn.Module):
     bn_epsilon: float = 0.001
     bn_scale: bool = True
     bn_axis_name: Optional[str] = None
+    use_pallas: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
-        in_ch = x.shape[-1]
-        x = nn.Conv(
-            in_ch,
-            (self.kernel_size, self.kernel_size),
-            kernel_dilation=(self.rate, self.rate),
-            padding="SAME",
-            feature_group_count=in_ch,
-            use_bias=True,
-            kernel_init=nn.initializers.truncated_normal(stddev=0.33),
+        x = DepthwiseConv2D(
+            kernel_size=self.kernel_size,
+            rate=self.rate,
+            use_pallas=self.use_pallas,
             dtype=self.dtype,
             name="depthwise",
         )(x)

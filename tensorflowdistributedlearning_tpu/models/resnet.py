@@ -337,10 +337,11 @@ class ASPP(nn.Module):
         )
         depth = cfg.base_depth
         out_size = x.shape[1:3]
+        sep = dict(common, use_pallas=cfg.use_pallas_depthwise)
         a1 = ConvBN(depth, 1, name="conv_1x1", **common)(x, train)
-        a2 = SplitSeparableConv2D(depth, 3, rate=2, name="conv_3x3_1", **common)(x, train)
-        a3 = SplitSeparableConv2D(depth, 3, rate=4, name="conv_3x3_2", **common)(x, train)
-        a4 = SplitSeparableConv2D(depth, 3, rate=8, name="conv_3x3_3", **common)(x, train)
+        a2 = SplitSeparableConv2D(depth, 3, rate=2, name="conv_3x3_1", **sep)(x, train)
+        a3 = SplitSeparableConv2D(depth, 3, rate=4, name="conv_3x3_2", **sep)(x, train)
+        a4 = SplitSeparableConv2D(depth, 3, rate=8, name="conv_3x3_3", **sep)(x, train)
         pooled = jnp.mean(x, axis=(1, 2), keepdims=True)
         pooled = ConvBN(depth, 1, name="pool_conv_1x1", **common)(pooled, train)
         a5 = upsample(pooled, out_size).astype(dtype)

@@ -1,0 +1,76 @@
+"""Multi-host SPMD support: process initialization and per-host data feeding.
+
+The reference was strictly single-process/single-host — its strategy was built from
+local GPUs only, with no cluster spec (reference: utils.py:6-8, model.py:114-121;
+SURVEY §2.3 "Cross-host DP: NO"). The TPU-native build scales past that by design:
+``jax.distributed`` brings every host's chips into one ``jax.devices()`` view, the
+mesh spans them all, and XLA routes collectives over ICI within a slice and DCN
+across slices. The only host-side code multi-host adds is here:
+
+- ``initialize``: one call per process before any jax op (TPU pods auto-discover;
+  explicit coordinator args supported for CPU/GPU clusters);
+- ``global_shard_batch``: each process contributes ONLY its local shard of every
+  global batch (``jax.make_array_from_process_local_data``), the per-host
+  generalization of the reference's per-tower ``batch/n_gpus`` input_fn contract
+  (reference: model.py:156-159, 298-299) — pair it with ``data.pipeline.host_shard``
+  for which examples this process loads.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tensorflowdistributedlearning_tpu.parallel.mesh import BATCH_AXIS
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join this process to the jax.distributed cluster (no-op if already
+    initialized or single-process). On TPU pods all arguments auto-discover from
+    the TPU metadata; pass them explicitly for multi-host CPU/GPU runs."""
+    if jax.process_count() > 1:
+        return  # already initialized
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (ValueError, RuntimeError):
+        # single-process run (no coordinator configured) — the reference's only mode
+        pass
+
+
+def process_info() -> Dict[str, int]:
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }
+
+
+def global_shard_batch(local_tree: Any, mesh: Mesh) -> Any:
+    """Assemble a globally-sharded batch from THIS PROCESS's local examples.
+
+    ``local_tree``: pytree of host arrays holding only this process's
+    ``global_batch / process_count`` examples (in process order — use
+    ``data.pipeline.host_shard`` to pick them). Returns jax Arrays sharded on the
+    ``batch`` mesh axis spanning all hosts. Single-process, this is exactly
+    ``mesh_lib.shard_batch``.
+    """
+
+    def place(x):
+        x = np.asarray(x)
+        spec = P(BATCH_AXIS, *([None] * (x.ndim - 1)))
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree.map(place, local_tree)

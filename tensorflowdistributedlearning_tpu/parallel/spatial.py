@@ -1,0 +1,197 @@
+"""Spatial (sequence/context) parallelism: halo exchange + sharded convolution.
+
+The reference had no sequence dimension at all — it "scaled context" within one
+device via atrous convolution (SURVEY §5.7; reference: core/resnet.py:244, 340-344).
+This module is the TPU-native generalization the mesh API reserves a ``sequence``
+axis for: inputs sharded along a spatial dimension across devices, with boundary
+("halo") rows exchanged over ICI neighbor links via ``lax.ppermute`` — the same
+ring-neighbor communication pattern ring attention uses for sequence parallelism,
+applied to the convolutional setting this framework's models live in. Everything
+here runs inside ``shard_map`` and composes with the batch-parallel train step.
+
+Use cases: images/feature maps too large for one chip's HBM (the CNN analogue of
+long-context), and halving activation memory per chip at fixed batch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tensorflowdistributedlearning_tpu.parallel.mesh import SEQUENCE_AXIS
+
+
+def _neighbor_perm(n: int, forward: bool):
+    """Ring permutation (i -> i+1) or (i -> i-1) over n devices."""
+    if forward:
+        return [(i, (i + 1) % n) for i in range(n)]
+    return [(i, (i - 1) % n) for i in range(n)]
+
+
+def _line_perm(n: int, forward: bool):
+    """Open-chain permutation: like the ring but without the wrap-around pair.
+    Devices that receive nothing get zeros from ppermute — exactly the boundary
+    condition a zero-padded convolution needs, with no wasted wrap transfer."""
+    if forward:
+        return [(i, i + 1) for i in range(n - 1)]
+    return [(i + 1, i) for i in range(n - 1)]
+
+
+def halo_exchange(
+    x: jax.Array,
+    halo: int,
+    *,
+    axis_name: str = SEQUENCE_AXIS,
+    spatial_axis: int = 1,
+) -> jax.Array:
+    """Pad a sharded block with ``halo`` boundary rows from each ring neighbor.
+
+    ``x`` is this device's shard with the sharded spatial dimension at
+    ``spatial_axis`` (default 1 = H of an NHWC tensor). Returns the shard extended
+    by ``halo`` rows on each side: interior shards receive their neighbors' edge
+    rows (one ``ppermute`` hop over ICI per direction), the outermost shards
+    receive zeros — matching XLA's zero-padded SAME convolution so a sharded conv
+    reproduces the unsharded result exactly.
+    """
+    if halo <= 0:
+        return x
+    local = x.shape[spatial_axis]
+    if halo > local:
+        raise ValueError(
+            f"halo {halo} exceeds the local shard extent {local} along axis "
+            f"{spatial_axis}; a single-hop exchange cannot reach beyond the "
+            "adjacent shard — use fewer devices on the sequence axis or a "
+            "smaller kernel"
+        )
+    n = lax.axis_size(axis_name)
+
+    def take(arr, start, size):
+        return lax.slice_in_dim(arr, start, start + size, axis=spatial_axis)
+
+    # my last rows become my successor's top halo; my first rows the predecessor's
+    # bottom halo. The open-chain permutation leaves the outermost shards' missing
+    # neighbors as ppermute-provided zeros (the zero-padded-SAME boundary).
+    from_prev = lax.ppermute(
+        take(x, local - halo, halo), axis_name, _line_perm(n, True)
+    )
+    from_next = lax.ppermute(take(x, 0, halo), axis_name, _line_perm(n, False))
+    return jnp.concatenate([from_prev, x, from_next], axis=spatial_axis)
+
+
+def spatial_conv2d(
+    x: jax.Array,
+    kernel: jax.Array,
+    *,
+    stride: int = 1,
+    axis_name: str = SEQUENCE_AXIS,
+) -> jax.Array:
+    """2-D convolution of an H-sharded NHWC batch, exact vs the unsharded op.
+
+    ``x``: local shard [B, H_local, W, C_in]; ``kernel``: [kh, kw, C_in, C_out]
+    (odd kh). H is sharded over ``axis_name``; W is whole on every device. The op
+    halo-exchanges (kh-1)/2 rows, then convolves VALID along H / SAME along W.
+    With ``stride`` > 1, every shard's H_local must be divisible by the stride so
+    shard boundaries stay aligned with the global stride phase.
+    """
+    kh, kw = kernel.shape[0], kernel.shape[1]
+    if kh % 2 != 1:
+        raise ValueError(f"spatial_conv2d requires odd kernel height, got {kh}")
+    h_local = x.shape[1]
+    if h_local % stride != 0:
+        raise ValueError(
+            f"H_local {h_local} must be divisible by stride {stride} to keep "
+            "shard boundaries stride-aligned"
+        )
+    halo = (kh - 1) // 2
+    padded = halo_exchange(x, halo, axis_name=axis_name, spatial_axis=1)
+    # Reproduce XLA's SAME padding phase exactly: with global H divisible by the
+    # stride, SAME pads a total of max(kh - stride, 0) rows, floor-split low/high —
+    # NOT (kh-1)/2 each side when stride > 1. The first tap of this shard's first
+    # output row therefore sits `pad_lo` rows above the shard start, i.e. at offset
+    # (halo - pad_lo) inside the halo-extended block; VALID conv from there with
+    # the same stride reproduces the global output rows owned by this shard.
+    total_pad = max(kh - stride, 0)
+    pad_lo = total_pad // 2
+    out_rows = h_local // stride
+    offset = halo - pad_lo
+    window = (out_rows - 1) * stride + kh
+    sliced = lax.slice_in_dim(padded, offset, offset + window, axis=1)
+    # W is unsharded: apply XLA's actual SAME split there too (low gets the floor)
+    w = x.shape[2]
+    out_cols = -(-w // stride)
+    total_w = max((out_cols - 1) * stride + kw - w, 0)
+    pw_lo = total_w // 2
+    pw_hi = total_w - pw_lo
+    return lax.conv_general_dilated(
+        sliced,
+        kernel,
+        window_strides=(stride, stride),
+        padding=[(0, 0), (pw_lo, pw_hi)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def ring_all_gather(
+    x: jax.Array, *, axis_name: str = SEQUENCE_AXIS, axis: int = 0
+) -> jax.Array:
+    """All-gather along a mesh axis implemented as n-1 ``ppermute`` ring hops —
+    the bandwidth-optimal neighbor-only pattern that rides ICI links (what XLA
+    emits for ``lax.all_gather`` on TPU, written out explicitly here so the
+    framework owns a ring primitive for sequence-parallel algorithms).
+
+    Returns the concatenation of every device's shard in device order, on every
+    device.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    perm = _neighbor_perm(n, True)
+
+    def body(i, carry):
+        block, out = carry
+        block = lax.ppermute(block, axis_name, perm)
+        # the block received at hop i originated at device (idx - 1 - i) mod n
+        src = jnp.mod(idx - 1 - i, n)
+        out = lax.dynamic_update_index_in_dim(out, block, src, 0)
+        return block, out
+
+    out = jnp.zeros((n,) + x.shape, x.dtype)
+    out = lax.dynamic_update_index_in_dim(out, x, idx, 0)
+    _, out = lax.fori_loop(0, n - 1, body, (x, out))
+    return jnp.moveaxis(out, 0, axis).reshape(
+        x.shape[:axis] + (n * x.shape[axis],) + x.shape[axis + 1 :]
+    )
+
+
+def reduce_scatter(
+    x: jax.Array, *, axis_name: str = SEQUENCE_AXIS, axis: int = 0
+) -> jax.Array:
+    """Sum across the mesh axis, leaving each device its own 1/n slice
+    (``lax.psum_scatter``, the gradient-sharding half of distributed data/optim
+    sharding). ``x.shape[axis]`` must divide by the axis size."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# Host-side helpers to run a spatially-sharded computation end to end.
+# ---------------------------------------------------------------------------
+
+
+def shard_spatial(x: np.ndarray, mesh: Mesh, *, spatial_axis: int = 1):
+    """Place a host array on the mesh sharded along ``spatial_axis`` over the
+    ``sequence`` mesh axis (batch stays on the ``batch`` axis if axis 0)."""
+    spec = [None] * x.ndim
+    spec[0] = "batch"
+    spec[spatial_axis] = SEQUENCE_AXIS
+    from jax.sharding import NamedSharding
+
+    return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+
+def sequence_parallel_degree(mesh: Mesh) -> int:
+    return mesh.shape[SEQUENCE_AXIS]

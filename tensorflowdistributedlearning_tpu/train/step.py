@@ -142,6 +142,26 @@ def _metric_deltas(
     return out
 
 
+def _mean_grads(grads: Any) -> Any:
+    """Average gradients across the batch mesh axis, leaf-by-leaf vma-aware.
+
+    Inside ``shard_map`` with varying-manual-axes checking, the gradient of a
+    REPLICATED (unvarying) parameter is already psum'd by the automatic
+    transposition, so the mean is ``leaf / axis_size``; a leaf that is still
+    per-shard (varying on the batch axis) needs a real ``pmean``.
+    """
+    from tensorflowdistributedlearning_tpu.parallel.collectives import vma_of
+
+    n = jax.lax.axis_size(BATCH_AXIS)
+
+    def mean_leaf(g):
+        if BATCH_AXIS in vma_of(g):
+            return jax.lax.pmean(g, BATCH_AXIS)
+        return g / n
+
+    return jax.tree.map(mean_leaf, grads)
+
+
 def _psum_metrics(metrics: Metrics) -> Metrics:
     return jax.tree.map(
         lambda x: jax.lax.psum(x, BATCH_AXIS), metrics
@@ -186,14 +206,22 @@ def make_train_step(
             loss = task.loss(outputs, batch)
             if apply_weight_decay and weight_decay:
                 loss = loss + weight_decay * _l2_penalty(params)
-            return loss, (outputs, mutated["batch_stats"])
+            # BN-free models mutate nothing; keep the (empty) pytree structure
+            new_stats = mutated.get("batch_stats", state.batch_stats)
+            return loss, (outputs, new_stats)
 
         (loss, (outputs, new_batch_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params)
 
-        # MirroredStrategy's NCCL all-reduce, as a compiler-emitted ICI collective
-        grads = jax.lax.pmean(grads, BATCH_AXIS)
+        # MirroredStrategy's gradient MEAN across towers. Under shard_map's
+        # varying-manual-axes tracking, autodiff of replicated params already
+        # inserts the cross-shard psum (the cotangent of an unvarying input must
+        # be unvarying), so grads arrive as the SUM of per-shard local-mean
+        # grads; _mean_grads turns that into the global mean — and still works
+        # if a grad leaf arrives per-shard (varying), where an explicit pmean is
+        # the right reduction.
+        grads = _mean_grads(grads)
         # per-shard (per-tower) BN stats, averaged to keep state replicated
         new_batch_stats = jax.lax.pmean(new_batch_stats, BATCH_AXIS)
 

@@ -1,0 +1,88 @@
+"""Kaggle driver-helper tests: CSV parsing, RLE round-trip (including the empty
+mask), coverage stratification classes (data/kaggle.py — the notebooks' data-prep
+cells, SURVEY §2.1 C13)."""
+
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from tensorflowdistributedlearning_tpu.data import kaggle
+
+
+def test_rle_roundtrip():
+    rng = np.random.default_rng(0)
+    mask = (rng.uniform(0, 1, (101, 101)) > 0.7).astype(np.uint8)
+    rle = kaggle.rle_encode(mask)
+    back = kaggle.rle_decode(rle, (101, 101))
+    np.testing.assert_array_equal(mask, back)
+
+
+def test_rle_empty_and_full():
+    empty = np.zeros((4, 4), np.uint8)
+    assert kaggle.rle_encode(empty) == ""
+    np.testing.assert_array_equal(kaggle.rle_decode("", (4, 4)), empty)
+    full = np.ones((4, 4), np.uint8)
+    assert kaggle.rle_encode(full) == "1 16"
+    np.testing.assert_array_equal(kaggle.rle_decode("1 16", (4, 4)), full)
+
+
+def test_rle_is_column_major():
+    mask = np.zeros((3, 3), np.uint8)
+    mask[:, 0] = 1  # first column = first run in Kaggle's Fortran order
+    assert kaggle.rle_encode(mask) == "1 3"
+
+
+def test_csv_and_training_set(tmp_path):
+    data = str(tmp_path / "train")
+    os.makedirs(os.path.join(data, "images"))
+    os.makedirs(os.path.join(data, "masks"))
+    rng = np.random.default_rng(1)
+    ids = [f"k{i}" for i in range(6)]
+    coverages = [0.0, 0.0, 0.3, 0.5, 0.8, 1.0]
+    for id_, cov in zip(ids, coverages):
+        img = rng.integers(0, 255, (16, 16)).astype(np.uint8)
+        Image.fromarray(img).save(os.path.join(data, "images", f"{id_}.png"))
+        mask = np.zeros((16, 16), np.uint8)
+        mask[: int(cov * 16), :] = 255
+        Image.fromarray(mask).save(os.path.join(data, "masks", f"{id_}.png"))
+
+    csv_path = str(tmp_path / "train.csv")
+    with open(csv_path, "w") as f:
+        f.write("id,rle_mask\n" + "\n".join(f"{i}," for i in ids))
+
+    got_ids, classes = kaggle.load_tgs_training_set(data, csv_path)
+    assert got_ids == sorted(ids)
+    assert classes.shape == (6,)
+    assert classes[0] == 0  # empty mask -> class 0
+    assert classes[-1] == 10  # full mask -> class 10
+    assert (np.diff(classes) >= 0).all()  # monotone in coverage
+
+
+def test_training_set_missing_image_raises(tmp_path):
+    data = str(tmp_path / "train")
+    os.makedirs(os.path.join(data, "images"))
+    os.makedirs(os.path.join(data, "masks"))
+    csv_path = str(tmp_path / "train.csv")
+    with open(csv_path, "w") as f:
+        f.write("id,rle_mask\nghost,\n")
+    with pytest.raises(FileNotFoundError, match="ghost"):
+        kaggle.load_tgs_training_set(data, csv_path)
+
+
+def test_depths(tmp_path):
+    p = str(tmp_path / "depths.csv")
+    with open(p, "w") as f:
+        f.write("id,z\na,100\nb,250.5\n")
+    d = kaggle.load_depths(p)
+    assert d == {"a": 100.0, "b": 250.5}
+
+
+def test_write_submission(tmp_path):
+    masks = np.zeros((2, 4, 4, 1), np.float32)
+    masks[1, :, 0, 0] = 1.0
+    out = str(tmp_path / "sub.csv")
+    kaggle.write_submission(out, ["x", "y"], masks)
+    rows = kaggle.read_two_column_csv(out)
+    assert rows == {"x": "", "y": "1 4"}

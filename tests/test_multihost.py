@@ -1,0 +1,46 @@
+"""Multi-host helper tests — single-process semantics on the 8-device CPU mesh
+(the multi-process path differs only in which rows each process contributes;
+jax.make_array_from_process_local_data handles the assembly either way)."""
+
+import jax
+import numpy as np
+
+from tensorflowdistributedlearning_tpu.parallel import multihost
+from tensorflowdistributedlearning_tpu.parallel.mesh import (
+    BATCH_AXIS,
+    make_mesh,
+    shard_batch,
+)
+
+
+def test_initialize_is_safe_single_process():
+    multihost.initialize()  # no coordinator: must not raise
+    info = multihost.process_info()
+    assert info["process_count"] == 1
+    assert info["process_index"] == 0
+    assert info["global_device_count"] >= 8
+
+
+def test_global_shard_batch_matches_shard_batch():
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(0)
+    batch = {
+        "images": rng.normal(0, 1, (16, 4, 4, 2)).astype(np.float32),
+        "labels": rng.integers(0, 2, (16, 4, 4, 1)).astype(np.float32),
+    }
+    a = multihost.global_shard_batch(batch, mesh)
+    b = shard_batch(batch, mesh)
+    for k in batch:
+        assert a[k].sharding.spec == b[k].sharding.spec
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(a[k])), np.asarray(jax.device_get(b[k]))
+        )
+
+
+def test_global_shard_batch_feeds_train_shapes():
+    mesh = make_mesh(8)
+    x = np.zeros((8, 2, 2, 1), np.float32)
+    arr = multihost.global_shard_batch({"x": x}, mesh)["x"]
+    assert arr.shape == (8, 2, 2, 1)
+    # each device owns exactly one row
+    assert len(arr.sharding.device_set) == 8

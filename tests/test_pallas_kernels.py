@@ -1,0 +1,114 @@
+"""Pallas depthwise-conv kernel tests (interpreter mode on CPU — same kernel code
+the TPU runs): forward exactness vs the XLA grouped-conv oracle across atrous
+rates, gradient correctness via the custom VJP, and the VMEM fallback path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.ops.pallas_kernels import (
+    depthwise_conv2d,
+    depthwise_conv2d_reference,
+)
+
+
+@pytest.mark.parametrize("rate", [1, 2, 4])
+@pytest.mark.parametrize("shape", [(2, 13, 13, 128), (1, 10, 7, 128)])
+def test_forward_matches_xla(rate, shape):
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, shape).astype(np.float32)
+    w = rng.normal(0, 0.5, (3, 3, shape[-1])).astype(np.float32)
+    got = depthwise_conv2d(jnp.asarray(x), jnp.asarray(w), rate, interpret=True)
+    want = depthwise_conv2d_reference(jnp.asarray(x), jnp.asarray(w), rate)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_5x5_kernel():
+    rng = np.random.default_rng(1)
+    x = rng.normal(0, 1, (1, 9, 9, 128)).astype(np.float32)
+    w = rng.normal(0, 0.5, (5, 5, 128)).astype(np.float32)
+    got = depthwise_conv2d(jnp.asarray(x), jnp.asarray(w), 1, interpret=True)
+    want = depthwise_conv2d_reference(jnp.asarray(x), jnp.asarray(w), 1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_gradients_match_reference():
+    rng = np.random.default_rng(2)
+    x = rng.normal(0, 1, (2, 8, 8, 128)).astype(np.float32)
+    w = rng.normal(0, 0.5, (3, 3, 128)).astype(np.float32)
+
+    def loss_kernel(x, w):
+        return jnp.sum(depthwise_conv2d(x, w, 2, interpret=True) ** 2)
+
+    def loss_ref(x, w):
+        return jnp.sum(depthwise_conv2d_reference(x, w, 2) ** 2)
+
+    gx, gw = jax.grad(loss_kernel, argnums=(0, 1))(jnp.asarray(x), jnp.asarray(w))
+    rx, rw = jax.grad(loss_ref, argnums=(0, 1))(jnp.asarray(x), jnp.asarray(w))
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), rtol=1e-4, atol=1e-3)
+
+
+def test_channel_tiling_matches_oracle():
+    # budget that fits one 128-lane tile but not all 256 channels: the kernel must
+    # tile C across the grid and still be exact
+    rng = np.random.default_rng(6)
+    x = rng.normal(0, 1, (2, 12, 12, 256)).astype(np.float32)
+    w = rng.normal(0, 0.5, (3, 3, 256)).astype(np.float32)
+    budget = (12 + 2) * (12 + 2) * 128 * 4 + 1
+    got = depthwise_conv2d(
+        jnp.asarray(x), jnp.asarray(w), 1, interpret=True, vmem_limit_bytes=budget
+    )
+    want = depthwise_conv2d_reference(jnp.asarray(x), jnp.asarray(w), 1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_vmem_fallback_used_for_large_blocks():
+    rng = np.random.default_rng(3)
+    x = rng.normal(0, 1, (1, 64, 64, 128)).astype(np.float32)
+    w = rng.normal(0, 0.5, (3, 3, 128)).astype(np.float32)
+    # tiny budget forces the XLA path; result must still be exact
+    got = depthwise_conv2d(
+        jnp.asarray(x), jnp.asarray(w), 1, interpret=True, vmem_limit_bytes=1024
+    )
+    want = depthwise_conv2d_reference(jnp.asarray(x), jnp.asarray(w), 1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_bfloat16_inputs():
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(0, 1, (1, 8, 8, 128)), jnp.bfloat16)
+    w = jnp.asarray(rng.normal(0, 0.5, (3, 3, 128)), jnp.bfloat16)
+    got = depthwise_conv2d(x, w, 1, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    want = depthwise_conv2d_reference(x.astype(jnp.float32), w.astype(jnp.float32), 1)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want), rtol=0.05, atol=0.05
+    )
+
+
+def test_model_paths_agree():
+    # the ASPP with use_pallas_depthwise on/off must produce identical outputs from
+    # the same parameters (pure execution-path switch)
+    from tensorflowdistributedlearning_tpu.config import ModelConfig
+    from tensorflowdistributedlearning_tpu.models import build_model
+
+    base = dict(input_shape=(33, 33), n_blocks=(1, 1, 1), base_depth=32)
+    m_xla = build_model(ModelConfig(**base))
+    m_pl = build_model(ModelConfig(use_pallas_depthwise=True, **base))
+    x = jnp.asarray(np.random.default_rng(5).normal(0, 1, (1, 33, 33, 2)), jnp.float32)
+    variables = m_xla.init(jax.random.PRNGKey(0), x, train=False)
+    out_xla = m_xla.apply(variables, x, train=False)
+    out_pl = m_pl.apply(variables, x, train=False)  # same params, pallas path
+    np.testing.assert_allclose(
+        np.asarray(out_pl), np.asarray(out_xla), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_validation():
+    x = jnp.zeros((1, 4, 4, 8))
+    with pytest.raises(ValueError, match="odd kernel"):
+        depthwise_conv2d(x, jnp.zeros((2, 2, 8)), interpret=True)
+    with pytest.raises(ValueError, match="channel mismatch"):
+        depthwise_conv2d(x, jnp.zeros((3, 3, 4)), interpret=True)

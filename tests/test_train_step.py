@@ -127,6 +127,46 @@ def test_sharded_step_matches_single_device():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_cross_degree_grads():
+    """True MirroredStrategy semantics: training on the SAME global batch must
+    produce the same parameter update at data-parallel degree 1 and 8 (grads are
+    the global-batch MEAN, not a per-shard sum — reference: MirroredStrategy's
+    cross-device gradient aggregation, model.py:115-121). Uses a BN-free model so
+    per-shard batch statistics cannot introduce a legitimate difference."""
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.Conv(8, (3, 3), padding="SAME")(x)
+            x = nn.relu(x)
+            x = x.mean(axis=(1, 2))
+            return nn.Dense(4)(x)
+
+    task = ClassificationTask()
+    batch = next(
+        synthetic_batches(
+            "classification", 16, seed=9, input_shape=(8, 8), num_classes=4
+        )
+    )
+    tx = make_optimizer(TrainConfig(lr=0.01))
+    results = {}
+    for n in (1, 8):
+        mesh = make_mesh(n)
+        model = Tiny()
+        state = replicate(
+            create_train_state(
+                model, tx, jax.random.PRNGKey(0), np.zeros((1, 8, 8, 3), np.float32)
+            ),
+            mesh,
+        )
+        step = make_train_step(mesh, task, donate=False)
+        new_state, _ = step(state, shard_batch(batch, mesh))
+        results[n] = jax.tree.leaves(jax.device_get(new_state.params))
+    for a, b in zip(results[1], results[8]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
 def test_state_stays_replicated_after_step():
     mesh = make_mesh(8)
     task = SegmentationTask()
