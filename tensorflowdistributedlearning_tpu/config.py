@@ -50,6 +50,9 @@ class ModelConfig:
     # (ops/pallas_kernels.py) instead of XLA's grouped conv; parameter trees are
     # identical between the two paths, so this is a pure execution-path switch.
     use_pallas_depthwise: bool = False
+    # rematerialize residual units on the backward pass (jax.checkpoint): trades
+    # recompute FLOPs for activation HBM — enables large per-chip batches.
+    remat: bool = False
 
     def __post_init__(self):
         if self.backbone not in ("resnet", "xception"):

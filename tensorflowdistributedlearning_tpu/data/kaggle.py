@@ -16,11 +16,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from tensorflowdistributedlearning_tpu.data.folds import coverage_to_class
-from tensorflowdistributedlearning_tpu.data.pipeline import (
-    InMemoryDataset,
-    discover_ids,
-    mask_coverage,
-)
+from tensorflowdistributedlearning_tpu.data.pipeline import discover_ids, mask_coverage
 
 
 def read_two_column_csv(path: str) -> Dict[str, str]:
@@ -66,8 +62,17 @@ def load_tgs_training_set(
             )
     else:
         ids = discover_ids(data_dir)
-    dataset = InMemoryDataset.from_directory(data_dir, ids=ids, normalize=False)
-    classes = coverage_to_class(mask_coverage(dataset.masks), n_classes)
+    if not ids:
+        raise ValueError(f"No examples found under {data_dir}/images")
+    # decode ONLY the masks — images are decoded once later by Trainer.train; pass
+    # the returned classes as its ``y`` so nothing is recomputed
+    from tensorflowdistributedlearning_tpu.native import decode_png_batch
+    from tensorflowdistributedlearning_tpu.data.pipeline import load_png
+
+    mask_paths = [os.path.join(data_dir, "masks", f"{i}.png") for i in ids]
+    h, w = load_png(mask_paths[0]).shape[:2]
+    masks = (decode_png_batch(mask_paths, h, w, channels=1) > 0.5).astype(np.float32)
+    classes = coverage_to_class(mask_coverage(masks), n_classes)
     return ids, classes
 
 

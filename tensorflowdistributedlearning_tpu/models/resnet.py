@@ -285,6 +285,12 @@ class ResNetBackbone(nn.Module):
         end_points["root"] = x
 
         unit_cls = BasicBlockUnit if cfg.block_type == "basic_block" else BottleneckUnit
+        if cfg.remat:
+            # rematerialize each residual unit on the backward pass: activations are
+            # recomputed instead of stored, trading MXU FLOPs for HBM — the knob the
+            # large-batch pod configs rely on (a TPU-first capability; the reference
+            # had no memory-saving story). `train` is static (BN mode selection).
+            unit_cls = nn.remat(unit_cls, static_argnums=(2,))
         blocks = resnet_block_specs(cfg.n_blocks, self.multi_grid)
 
         # slim stack_blocks_dense semantics (reference: core/resnet.py:244): strides

@@ -16,9 +16,29 @@ from tensorflowdistributedlearning_tpu.parallel.mesh import (
 from tensorflowdistributedlearning_tpu.parallel.collectives import (
     pmean_tree,
     psum_tree,
+    vma_of,
+)
+from tensorflowdistributedlearning_tpu.parallel.spatial import (
+    halo_exchange,
+    reduce_scatter,
+    ring_all_gather,
+    spatial_conv2d,
+)
+from tensorflowdistributedlearning_tpu.parallel.multihost import (
+    global_shard_batch,
+    initialize as initialize_multihost,
+    process_info,
 )
 
 __all__ = [
+    "halo_exchange",
+    "reduce_scatter",
+    "ring_all_gather",
+    "spatial_conv2d",
+    "global_shard_batch",
+    "initialize_multihost",
+    "process_info",
+    "vma_of",
     "BATCH_AXIS",
     "MODEL_AXIS",
     "SEQUENCE_AXIS",

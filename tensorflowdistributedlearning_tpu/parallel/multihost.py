@@ -33,10 +33,25 @@ def initialize(
     process_id: Optional[int] = None,
 ) -> None:
     """Join this process to the jax.distributed cluster (no-op if already
-    initialized or single-process). On TPU pods all arguments auto-discover from
-    the TPU metadata; pass them explicitly for multi-host CPU/GPU runs."""
-    if jax.process_count() > 1:
-        return  # already initialized
+    initialized). On TPU pods all arguments auto-discover from the TPU metadata;
+    pass them explicitly for multi-host CPU/GPU runs.
+
+    MUST run before any jax call that initializes the XLA backend (even
+    ``jax.devices()``/``jax.process_count()``) — jax refuses to form a cluster
+    afterwards. With explicit coordinator arguments a failure to join RAISES
+    (silently degrading to per-host single-process training would be wrong
+    training at pod scale); with auto-discovery a quiet single-process fallback
+    is the correct behavior for laptop/CI runs.
+    """
+    explicit = (
+        coordinator_address is not None
+        or num_processes is not None
+        or process_id is not None
+    )
+    # already-initialized check WITHOUT touching the XLA backend
+    state = getattr(jax.distributed, "global_state", None)
+    if state is not None and getattr(state, "client", None) is not None:
+        return
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -44,8 +59,10 @@ def initialize(
             process_id=process_id,
         )
     except (ValueError, RuntimeError):
-        # single-process run (no coordinator configured) — the reference's only mode
-        pass
+        if explicit:
+            raise
+        # auto-discovery found no cluster: single-process run (the reference's
+        # only mode)
 
 
 def process_info() -> Dict[str, int]:

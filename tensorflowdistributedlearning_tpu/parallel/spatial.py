@@ -183,10 +183,16 @@ def reduce_scatter(
 
 
 def shard_spatial(x: np.ndarray, mesh: Mesh, *, spatial_axis: int = 1):
-    """Place a host array on the mesh sharded along ``spatial_axis`` over the
-    ``sequence`` mesh axis (batch stays on the ``batch`` axis if axis 0)."""
+    """Place a host array on the mesh with axis 0 on the ``batch`` mesh axis and
+    ``spatial_axis`` on the ``sequence`` mesh axis."""
+    if spatial_axis == 0:
+        raise ValueError(
+            "spatial_axis 0 is the batch dimension; pick a spatial dimension >= 1"
+        )
+    from tensorflowdistributedlearning_tpu.parallel.mesh import BATCH_AXIS
+
     spec = [None] * x.ndim
-    spec[0] = "batch"
+    spec[0] = BATCH_AXIS
     spec[spatial_axis] = SEQUENCE_AXIS
     from jax.sharding import NamedSharding
 

@@ -176,3 +176,38 @@ def test_build_model_factory():
     assert isinstance(build_model(ModelConfig()), ResNetSegmentation)
     assert isinstance(build_model(ModelConfig(num_classes=5)), ResNetClassifier)
     assert isinstance(build_model(ModelConfig(backbone="xception", num_classes=5)), Xception41)
+
+
+def test_remat_matches_no_remat():
+    # remat is a pure memory/recompute trade: outputs and gradients must be
+    # identical to the non-remat model with the same parameters
+    base = dict(input_shape=(33, 33), n_blocks=(1, 1, 1), base_depth=32)
+    m_plain = build_model(ModelConfig(**base))
+    m_remat = build_model(ModelConfig(remat=True, **base))
+    x = jnp.asarray(
+        np.random.default_rng(11).normal(0, 1, (2, 33, 33, 2)), jnp.float32
+    )
+    variables = m_plain.init(jax.random.PRNGKey(0), x, train=False)
+    out_plain = m_plain.apply(variables, x, train=False)
+    out_remat = m_remat.apply(variables, x, train=False)
+    np.testing.assert_allclose(
+        np.asarray(out_remat), np.asarray(out_plain), rtol=1e-5, atol=1e-5
+    )
+
+    def loss(params, model):
+        out, _ = model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            x,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        return jnp.sum(out**2)
+
+    g_plain = jax.grad(loss)(variables["params"], m_plain)
+    g_remat = jax.grad(loss)(variables["params"], m_remat)
+    # recompute changes float op ordering, so compare with a relative tolerance
+    # scaled to each leaf's magnitude
+    for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_remat)):
+        a, b = np.asarray(a), np.asarray(b)
+        scale = max(np.abs(a).max(), 1.0)
+        np.testing.assert_allclose(a / scale, b / scale, rtol=1e-3, atol=1e-3)
