@@ -1,0 +1,84 @@
+"""End-to-end TGS Salt training driver — the reference's notebooks as a script.
+
+The reference was driven by two notebooks (Untitled.ipynb NCHW / Test.ipynb NHWC)
+that loaded `train.csv`/`depths.csv`, binned mask coverage into 11 stratification
+classes, and ran `Model(...).train(X, y, 64, 10000)` on 2 GPUs (SURVEY §2.1 C13).
+Equivalent flow here, against a Kaggle competition-data directory:
+
+    data_root/
+      train/images/*.png   train/masks/*.png
+      test/images/*.png    (optional, for --predict)
+      train.csv  depths.csv  (optional manifests)
+
+Usage:
+    python examples/train_tgs_salt.py --data-root /path/to/tgs --model-dir /tmp/run \
+        [--batch-size 64] [--steps 10000] [--predict --submission sub.csv]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+
+from tensorflowdistributedlearning_tpu.config import TrainConfig
+from tensorflowdistributedlearning_tpu.data.kaggle import (
+    load_tgs_training_set,
+    write_submission,
+)
+from tensorflowdistributedlearning_tpu.train.trainer import Trainer
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data-root", required=True)
+    p.add_argument("--model-dir", required=True)
+    p.add_argument("--batch-size", type=int, default=64)  # Untitled.ipynb cell 7
+    p.add_argument("--steps", type=int, default=10_000)  # Untitled.ipynb cell 8
+    p.add_argument("--n-fold", type=int, default=5)
+    p.add_argument("--lr", type=float, default=0.001)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--predict", action="store_true",
+                   help="after training, run the fold x TTA ensemble on test/")
+    p.add_argument("--submission", default=None,
+                   help="write a Kaggle submission csv here (implies --predict)")
+    # architecture overrides (defaults are the reference's 101x101 flagship)
+    p.add_argument("--input-shape", type=int, nargs=2, default=(101, 101))
+    p.add_argument("--n-blocks", type=int, nargs="+", default=(3, 4, 6))
+    p.add_argument("--base-depth", type=int, default=256)
+    args = p.parse_args()
+
+    train_dir = os.path.join(args.data_root, "train")
+    train_csv = os.path.join(args.data_root, "train.csv")
+    ids, classes = load_tgs_training_set(
+        train_dir, train_csv if os.path.exists(train_csv) else None
+    )
+
+    trainer = Trainer(
+        args.model_dir,
+        train_dir,
+        train_config=TrainConfig(
+            lr=args.lr, n_folds=args.n_fold, seed=args.seed
+        ),
+        input_shape=tuple(args.input_shape),
+        n_blocks=tuple(args.n_blocks),
+        base_depth=args.base_depth,
+    )
+    results = trainer.train(
+        ids, classes, batch_size=args.batch_size, steps=args.steps
+    )
+    print(json.dumps({"folds": results, "n_params": trainer.params}))
+
+    if args.predict or args.submission:
+        test_dir = os.path.join(args.data_root, "test")
+        pred = trainer.predict(test_dir, batch_size=args.batch_size, tta=True)
+        if args.submission:
+            write_submission(args.submission, pred["ids"], pred["masks"])
+            print(json.dumps({"submission": args.submission, "n": len(pred["ids"])}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

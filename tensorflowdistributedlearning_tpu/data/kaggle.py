@@ -64,15 +64,11 @@ def load_tgs_training_set(
         ids = discover_ids(data_dir)
     if not ids:
         raise ValueError(f"No examples found under {data_dir}/images")
-    # decode ONLY the masks — images are decoded once later by Trainer.train; pass
-    # the returned classes as its ``y`` so nothing is recomputed
-    from tensorflowdistributedlearning_tpu.native import decode_png_batch
-    from tensorflowdistributedlearning_tpu.data.pipeline import load_png
+    # decode ONLY the masks (shared recipe) — images are decoded once later by
+    # Trainer.train; pass the returned classes as its ``y``
+    from tensorflowdistributedlearning_tpu.data.pipeline import load_masks
 
-    mask_paths = [os.path.join(data_dir, "masks", f"{i}.png") for i in ids]
-    h, w = load_png(mask_paths[0]).shape[:2]
-    masks = (decode_png_batch(mask_paths, h, w, channels=1) > 0.5).astype(np.float32)
-    classes = coverage_to_class(mask_coverage(masks), n_classes)
+    classes = coverage_to_class(mask_coverage(load_masks(data_dir, ids)), n_classes)
     return ids, classes
 
 

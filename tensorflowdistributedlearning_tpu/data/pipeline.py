@@ -41,6 +41,17 @@ def load_png(path: str) -> np.ndarray:
     return arr[:, :, None]
 
 
+def load_masks(data_dir: str, ids: Sequence[str]) -> np.ndarray:
+    """Decode ``{data_dir}/masks/{id}.png`` to binary [N, H, W, 1] float32 masks —
+    the single source of the mask-decode recipe (native batch decode + 0.5
+    threshold) shared by the dataset loader and the stratification helpers."""
+    from tensorflowdistributedlearning_tpu.native import decode_png_batch
+
+    paths = [os.path.join(data_dir, "masks", f"{i}.png") for i in ids]
+    h, w = load_png(paths[0]).shape[:2]
+    return (decode_png_batch(paths, h, w, channels=1) > 0.5).astype(np.float32)
+
+
 def discover_ids(data_dir: str) -> List[str]:
     """List example ids from ``{data_dir}/images/*.png`` (the reference globbed the
     same layout, model.py:289-294)."""
@@ -93,12 +104,7 @@ class InMemoryDataset:
         images = decode_png_batch(image_paths, h, w, channels=1)
         if normalize:
             images = (images - MEAN) / STD
-        masks = None
-        if with_masks:
-            mask_paths = [os.path.join(data_dir, "masks", f"{i}.png") for i in ids]
-            masks = (decode_png_batch(mask_paths, h, w, channels=1) > 0.5).astype(
-                np.float32
-            )
+        masks = load_masks(data_dir, ids) if with_masks else None
         return cls(images, masks, ids)
 
     def select(self, ids: Sequence[str]) -> "InMemoryDataset":

@@ -13,6 +13,7 @@ from tensorflowdistributedlearning_tpu.models.resnet import (
 from tensorflowdistributedlearning_tpu.models.xception import (
     Xception41,
     XceptionBackbone,
+    XceptionSegmentation,
 )
 
 __all__ = [
@@ -26,4 +27,5 @@ __all__ = [
     "build_model",
     "Xception41",
     "XceptionBackbone",
+    "XceptionSegmentation",
 ]

@@ -355,6 +355,44 @@ class ASPP(nn.Module):
         return ConvBN(depth, 1, name="project", **common)(cat, train)
 
 
+def deeplab_head(
+    cfg: ModelConfig,
+    bn_axis_name: Optional[str],
+    features: jax.Array,
+    skip: jax.Array,
+    train: bool,
+) -> jax.Array:
+    """Shared DeepLabV3+ head: ASPP over the backbone features, upsample to the
+    skip resolution, 1x1-projected skip concat, 3x3 fuse to one channel, bilinear
+    upsample to input resolution in float32 (reference: core/resnet.py:440-496 —
+    with the hard-coded (26, 26) generalized to the skip tensor's actual shape,
+    SURVEY §2.4.7). MUST be called inside a module's compact ``__call__`` so the
+    submodules bind to that module's parameter scope; both segmentation networks
+    (ResNet, Xception) use it, keeping their heads structurally identical.
+    """
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    common = dict(
+        bn_decay=cfg.batch_norm_decay,
+        bn_epsilon=cfg.batch_norm_epsilon,
+        bn_scale=cfg.batch_norm_scale,
+        bn_axis_name=bn_axis_name,
+        dtype=dtype,
+    )
+    aspp = ASPP(cfg, bn_axis_name=bn_axis_name, name="aspp")(features, train)
+    aspp_up = upsample(aspp, skip.shape[1:3]).astype(dtype)
+    decoder = ConvBN(cfg.base_depth, 1, name="decoder_conv_1x1", **common)(skip, train)
+    decoder = jnp.concatenate([decoder, aspp_up], axis=-1)
+    decoder = nn.Conv(
+        1,
+        (3, 3),
+        padding="SAME",
+        kernel_init=conv_kernel_init,
+        dtype=dtype,
+        name="decoder_conv_3x3",
+    )(decoder)
+    return upsample(decoder.astype(jnp.float32), cfg.input_shape)
+
+
 class ResNetSegmentation(nn.Module):
     """Full segmentation network: backbone + ASPP + decoder with block1 skip, producing
     per-pixel logits at input resolution (reference: core/resnet.py:398-496). Logits are
@@ -366,37 +404,17 @@ class ResNetSegmentation(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         cfg = self.config
-        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-        common = dict(
-            bn_decay=cfg.batch_norm_decay,
-            bn_epsilon=cfg.batch_norm_epsilon,
-            bn_scale=cfg.batch_norm_scale,
-            bn_axis_name=self.bn_axis_name,
-            dtype=dtype,
-        )
         end_points = ResNetBackbone(
             cfg, multi_grid=SEGMENTATION_MULTI_GRID, bn_axis_name=self.bn_axis_name,
             name="backbone",
         )(x, train)
-        aspp = ASPP(cfg, bn_axis_name=self.bn_axis_name, name="aspp")(
-            end_points["features"], train
+        return deeplab_head(
+            cfg,
+            self.bn_axis_name,
+            end_points["features"],
+            end_points["block1_unit1_residual"],
+            train,
         )
-        skip = end_points["block1_unit1_residual"]
-        # generalizes the reference's hard-coded (26, 26) (core/resnet.py:474) to the
-        # skip tensor's actual spatial shape
-        aspp_up = upsample(aspp, skip.shape[1:3]).astype(dtype)
-        decoder = ConvBN(cfg.base_depth, 1, name="decoder_conv_1x1", **common)(skip, train)
-        decoder = jnp.concatenate([decoder, aspp_up], axis=-1)
-        decoder = nn.Conv(
-            1,
-            (3, 3),
-            padding="SAME",
-            kernel_init=conv_kernel_init,
-            dtype=dtype,
-            name="decoder_conv_3x3",
-        )(decoder)
-        logits = upsample(decoder.astype(jnp.float32), cfg.input_shape)
-        return logits
 
 
 class ResNetClassifier(nn.Module):
@@ -438,6 +456,9 @@ def build_model(config: ModelConfig, bn_axis_name: Optional[str] = None) -> nn.M
         return ResNetClassifier(config, bn_axis_name=bn_axis_name)
     from tensorflowdistributedlearning_tpu.models.xception import (
         Xception41,
+        XceptionSegmentation,
     )
 
+    if config.num_classes is None:
+        return XceptionSegmentation(config, bn_axis_name=bn_axis_name)
     return Xception41(config, bn_axis_name=bn_axis_name)

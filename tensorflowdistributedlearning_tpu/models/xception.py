@@ -33,6 +33,7 @@ from tensorflowdistributedlearning_tpu.models.layers import (
     ConvBN,
     conv_kernel_init,
     fixed_padding,
+    upsample,
 )
 
 
@@ -258,6 +259,57 @@ class XceptionBackbone(nn.Module):
             raise ValueError("The target output_stride cannot be reached.")
         end_points["features"] = x
         return end_points
+
+
+class XceptionSegmentation(nn.Module):
+    """Xception-41 + ASPP + decoder segmentation network — the DeepLabV3+
+    arrangement the reference's (dead) Xception backbone was built for
+    (reference: core/xception.py existed solely as a DeepLab backbone but was
+    never wired to a head, SURVEY §2.4.8-10; the head layout follows the ResNet
+    flagship, core/resnet.py:440-496). Skip connection comes from the stride-4
+    entry_block1 features, the Xception analogue of the reference's block1 skip.
+    Returns [B, H, W, 1] float32 logits at input resolution."""
+
+    config: ModelConfig
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        from tensorflowdistributedlearning_tpu.models.resnet import ASPP
+
+        cfg = self.config
+        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        common = dict(
+            bn_decay=cfg.batch_norm_decay,
+            bn_epsilon=cfg.batch_norm_epsilon,
+            bn_scale=cfg.batch_norm_scale,
+            bn_axis_name=self.bn_axis_name,
+            dtype=dtype,
+        )
+        end_points = XceptionBackbone(
+            cfg,
+            multi_grid=(1, 2, 1),
+            bn_axis_name=self.bn_axis_name,
+            name="backbone",
+        )(x, train)
+        aspp = ASPP(cfg, bn_axis_name=self.bn_axis_name, name="aspp")(
+            end_points["features"], train
+        )
+        skip = end_points["entry_block1"]
+        aspp_up = upsample(aspp, skip.shape[1:3]).astype(dtype)
+        decoder = ConvBN(cfg.base_depth, 1, name="decoder_conv_1x1", **common)(
+            skip, train
+        )
+        decoder = jnp.concatenate([decoder, aspp_up], axis=-1)
+        decoder = nn.Conv(
+            1,
+            (3, 3),
+            padding="SAME",
+            kernel_init=conv_kernel_init,
+            dtype=dtype,
+            name="decoder_conv_3x3",
+        )(decoder)
+        return upsample(decoder.astype(jnp.float32), cfg.input_shape)
 
 
 class Xception41(nn.Module):

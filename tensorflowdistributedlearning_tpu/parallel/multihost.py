@@ -49,8 +49,8 @@ def initialize(
         or process_id is not None
     )
     # already-initialized check WITHOUT touching the XLA backend
-    state = getattr(jax.distributed, "global_state", None)
-    if state is not None and getattr(state, "client", None) is not None:
+    is_initialized = getattr(jax.distributed, "is_initialized", None)
+    if is_initialized is not None and is_initialized():
         return
     try:
         jax.distributed.initialize(

@@ -24,7 +24,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tensorflowdistributedlearning_tpu.parallel.mesh import SEQUENCE_AXIS
+from tensorflowdistributedlearning_tpu.parallel.mesh import BATCH_AXIS, SEQUENCE_AXIS
 
 
 def _neighbor_perm(n: int, forward: bool):
@@ -189,8 +189,6 @@ def shard_spatial(x: np.ndarray, mesh: Mesh, *, spatial_axis: int = 1):
         raise ValueError(
             "spatial_axis 0 is the batch dimension; pick a spatial dimension >= 1"
         )
-    from tensorflowdistributedlearning_tpu.parallel.mesh import BATCH_AXIS
-
     spec = [None] * x.ndim
     spec[0] = BATCH_AXIS
     spec[spatial_axis] = SEQUENCE_AXIS

@@ -211,3 +211,15 @@ def test_remat_matches_no_remat():
         a, b = np.asarray(a), np.asarray(b)
         scale = max(np.abs(a).max(), 1.0)
         np.testing.assert_allclose(a / scale, b / scale, rtol=1e-3, atol=1e-3)
+
+
+def test_xception_segmentation():
+    # the DeepLabV3+ head on the Xception backbone — the pairing the reference's
+    # dead xception.py was built for but never wired up (SURVEY §2.4.8-10)
+    cfg = ModelConfig(backbone="xception", input_shape=(33, 33))
+    model = build_model(cfg)
+    x = jnp.zeros((1, 33, 33, 2), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 33, 33, 1)
+    assert out.dtype == jnp.float32
