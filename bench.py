@@ -5,21 +5,67 @@ per-chip number for the same job (TF1 fp32 ResNet-50 on a V100, the hardware the
 reference's 2-GPU MirroredStrategy runs used) is ~360 images/sec/chip, which is the
 ``vs_baseline`` denominator here.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+
+Architecture: the TPU backend in this environment is flaky — ``jax.devices()`` has
+been observed to HANG for minutes (round 1 shipped no number because of exactly
+this). A hang cannot be recovered in-process, so bench.py runs as a SUPERVISOR that
+executes the real benchmark in a child process under a bounded timeout, retrying
+with backoff; if the TPU child never succeeds it falls back to a CPU child and
+finally to a degraded-but-valid JSON line with an "error" field. The driver always
+gets its one JSON line on stdout.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-import numpy as np
 
 V100_FP32_RESNET50_IMAGES_PER_SEC = 360.0
 
+# bf16 peak matmul TFLOP/s per chip by device_kind substring (public figures).
+PEAK_BF16_TFLOPS = {
+    "v6e": 918.0,
+    "v6": 918.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v5": 197.0,
+    "v4": 275.0,
+    "v3": 123.0,
+    "v2": 45.0,
+}
 
-def main() -> None:
+# Supervisor budget: attempts x per-attempt timeout. First TPU compile is 20-40s,
+# plus flaky backend init observed at >170s — give each child a generous bound.
+TPU_ATTEMPTS = 3
+TPU_TIMEOUT_SECS = 900
+CPU_TIMEOUT_SECS = 600
+
+
+def _peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, tflops in PEAK_BF16_TFLOPS.items():
+        if key in kind:
+            return tflops * 1e12
+    return None
+
+
+def run_benchmark(platform: str | None = None) -> dict:
+    """The actual measurement (runs inside the child process).
+
+    ``platform='cpu'`` forces the CPU backend via jax.config — this image's
+    sitecustomize pre-imports jax with the tunneled TPU platform, so environment
+    variables alone are too late; the config route works because backend
+    initialization is lazy."""
+    import jax
+
+    if platform is not None:
+        jax.config.update("jax_platforms", platform)
+    import numpy as np
+
     from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
     from tensorflowdistributedlearning_tpu.models import build_model
     from tensorflowdistributedlearning_tpu.parallel.mesh import (
@@ -33,6 +79,7 @@ def main() -> None:
         make_optimizer,
         make_train_step,
     )
+    from tensorflowdistributedlearning_tpu.utils.profiling import sync
 
     devices = jax.devices()
     on_tpu = devices[0].platform == "tpu"
@@ -82,37 +129,132 @@ def main() -> None:
     }
     batch = shard_batch(batch, mesh)
 
-    from tensorflowdistributedlearning_tpu.utils.profiling import sync
-
     # donate=False: `batch` and `state` are reused across calls here; the trainer's
     # production path donates. profiling.sync pulls a value that depends on the last
     # step — on the tunneled TPU platform block_until_ready alone has been observed
     # to return before execution finishes, inflating throughput ~10x.
     step = make_train_step(mesh, ClassificationTask(), donate=False)
+    # AOT-compile ONCE and reuse the executable for warmup, timing, and the MFU
+    # cost analysis — step.lower().compile() does not share the jit cache, so
+    # calling it after the timed run would trigger a second full XLA compile.
+    compiled = step.lower(state, batch).compile()
     for _ in range(warmup):
-        state, metrics = step(state, batch)
+        state, metrics = compiled(state, batch)
     sync(metrics)
 
     t0 = time.perf_counter()
     for _ in range(timed_steps):
-        state, metrics = step(state, batch)
+        state, metrics = compiled(state, batch)
     sync(metrics)
     dt = time.perf_counter() - t0
 
     images_per_sec_per_chip = global_batch * timed_steps / dt / n
+    result = {
+        "metric": "resnet50_imagenet_train_throughput_per_chip"
+        if on_tpu
+        else "resnet_tiny_cpu_train_throughput_per_chip",
+        "value": round(images_per_sec_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(
+            images_per_sec_per_chip / V100_FP32_RESNET50_IMAGES_PER_SEC, 3
+        ),
+        "platform": devices[0].platform,
+        "device_kind": getattr(devices[0], "device_kind", "unknown"),
+        "n_chips": n,
+        "global_batch": global_batch,
+        "step_time_ms": round(dt / timed_steps * 1000, 2),
+    }
+
+    # MFU: XLA's own FLOP count for the compiled step vs chip peak. cost_analysis
+    # is best-effort across backends — fall back to the analytic ResNet-50 figure
+    # (~2x 4.1e9 MAC-derived FLOPs fwd, x3 for fwd+bwd) when unavailable.
+    flops_per_step = None
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        f = float(cost.get("flops", 0.0))
+        if f > 0:
+            flops_per_step = f
+    except Exception:
+        pass
+    if flops_per_step is None and on_tpu:
+        flops_per_step = 3 * 2 * 4.1e9 * global_batch
+    peak = _peak_flops(devices[0])
+    if flops_per_step is not None and peak is not None:
+        achieved = flops_per_step / (dt / timed_steps) / n
+        result["mfu"] = round(achieved / peak, 4)
+        result["model_tflops_per_step"] = round(flops_per_step / 1e12, 3)
+    return result
+
+
+def _run_child(platform: str, timeout: int) -> dict | None:
+    args = [sys.executable, os.path.abspath(__file__), "--child"]
+    if platform == "cpu":
+        args.append("--platform=cpu")
+    try:
+        proc = subprocess.run(
+            args,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {"__error__": f"{platform} child timed out after {timeout}s"}
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip()[-400:]
+        return {"__error__": f"{platform} child rc={proc.returncode}: {tail}"}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return {"__error__": f"{platform} child produced no JSON line"}
+
+
+def main() -> None:
+    if "--child" in sys.argv:
+        # Child mode: do the measurement; any crash surfaces via rc + stderr.
+        platform = "cpu" if "--platform=cpu" in sys.argv else None
+        print(json.dumps(run_benchmark(platform)), flush=True)
+        return
+
+    errors = []
+    # TPU attempts with backoff, bounded per attempt (a hung backend init in the
+    # child is killed by the timeout instead of wedging the driver).
+    for attempt in range(TPU_ATTEMPTS):
+        result = _run_child("tpu", TPU_TIMEOUT_SECS)
+        if result is not None and "__error__" not in result:
+            print(json.dumps(result), flush=True)
+            return
+        errors.append(result["__error__"] if result else "no result")
+        if attempt < TPU_ATTEMPTS - 1:  # no pointless backoff before the fallback
+            time.sleep(min(30 * (attempt + 1), 60))
+
+    # Degraded: CPU fallback still yields a real (if unimpressive) measurement.
+    result = _run_child("cpu", CPU_TIMEOUT_SECS)
+    if result is not None and "__error__" not in result:
+        result["error"] = "TPU unavailable: " + " | ".join(errors)
+        result["degraded"] = True
+        print(json.dumps(result), flush=True)
+        return
+    errors.append(result["__error__"] if result else "no result")
+
+    # Last resort: a syntactically valid JSON line with the failure recorded.
     print(
         json.dumps(
             {
-                "metric": "resnet50_imagenet_train_throughput_per_chip"
-                if on_tpu
-                else "resnet_tiny_cpu_train_throughput_per_chip",
-                "value": round(images_per_sec_per_chip, 2),
+                "metric": "resnet50_imagenet_train_throughput_per_chip",
+                "value": 0.0,
                 "unit": "images/sec/chip",
-                "vs_baseline": round(
-                    images_per_sec_per_chip / V100_FP32_RESNET50_IMAGES_PER_SEC, 3
-                ),
+                "vs_baseline": 0.0,
+                "error": " | ".join(errors),
             }
-        )
+        ),
+        flush=True,
     )
 
 

@@ -70,6 +70,21 @@ def build_parser() -> argparse.ArgumentParser:
     p_smoke.add_argument("--batch-size", type=int, default=8)
     p_smoke.add_argument("--n-devices", type=int, default=None)
 
+    p_fit = sub.add_parser(
+        "fit",
+        help="single-run classification training from a named preset "
+        "(streaming ImageFolder data; synthetic when --data-dir is omitted)",
+    )
+    p_fit.add_argument("--preset", required=True)
+    p_fit.add_argument("--model-dir", required=True)
+    p_fit.add_argument("--data-dir", default=None,
+                       help="ImageFolder root with train/{class}/*.png "
+                       "(+ optional val/); omitted = synthetic data")
+    p_fit.add_argument("--steps", type=int, default=100)
+    p_fit.add_argument("--batch-size", type=int, default=None,
+                       help="global batch (default: the preset's)")
+    p_fit.add_argument("--eval-every", type=int, default=None)
+
     sub.add_parser("presets", help="list the named BASELINE config presets")
     return parser
 
@@ -179,6 +194,26 @@ def cmd_smoke(args) -> int:
     return 0
 
 
+def cmd_fit(args) -> int:
+    from tensorflowdistributedlearning_tpu.train.fit import fit_preset
+
+    result = fit_preset(
+        args.preset,
+        args.model_dir,
+        data_dir=args.data_dir,
+        steps=args.steps,
+        batch_size=args.batch_size,
+        eval_every_steps=args.eval_every,
+    )
+    print(json.dumps({
+        "preset": args.preset,
+        "steps": result.steps,
+        "n_params": result.n_params,
+        "final_metrics": result.final_metrics,
+    }))
+    return 0
+
+
 def cmd_presets(args) -> int:
     from tensorflowdistributedlearning_tpu.configs import PRESETS
 
@@ -208,6 +243,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "train": cmd_train,
         "predict": cmd_predict,
         "smoke": cmd_smoke,
+        "fit": cmd_fit,
         "presets": cmd_presets,
     }[args.command](args)
 

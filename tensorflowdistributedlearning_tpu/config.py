@@ -83,6 +83,11 @@ class TrainConfig:
     lr_decay_rate: float = 0.5
     # number of devices to use; None = all (reference: n_gpus, model.py:33)
     n_devices: Optional[int] = None
+    # sequence (spatial) parallel degree: shard the image H dimension over this
+    # many devices per data-parallel replica (halo-exchange convs,
+    # parallel/spatial.py). 1 = pure data parallelism (the reference's only mode).
+    # A TPU-first capability for feature maps too large for one chip's HBM.
+    sequence_parallel: int = 1
     n_folds: int = 5
     seed: int = 42
     # best-model exports to keep (reference: model.py:37, 196-202)
@@ -96,4 +101,8 @@ class TrainConfig:
         if self.data_format not in ("NCHW", "NHWC"):
             raise ValueError(
                 f"Unknown data format {self.data_format}. Has to be either NCHW or NHWC"
+            )
+        if self.sequence_parallel < 1:
+            raise ValueError(
+                f"sequence_parallel must be >= 1, got {self.sequence_parallel}"
             )

@@ -1,0 +1,216 @@
+"""Streaming on-disk classification pipeline (ImageFolder layout).
+
+The reference kept a working classification head in its backbone
+(reference: core/resnet.py:246-256) but no classification input pipeline or driver —
+its only data path was the TGS-salt segmentation layout. The ImageNet/CIFAR presets
+(BASELINE.json's config ladder) need one, and at ImageNet scale "decode the whole
+dataset into RAM" (data/pipeline.py InMemoryDataset) is not an option. This module
+streams instead:
+
+- the file list (not pixel data) is what lives in memory: ``{root}/{split}/{class}/
+  {id}.png``, the standard ImageFolder layout, scanned once;
+- each process keeps only its round-robin shard of the file list (the per-host
+  generalization of the reference's per-tower input_fn contract, model.py:156-159,
+  298-299);
+- batches decode on demand through the native multithreaded PNG decoder
+  (native/io.cc; GIL-free, one thread per core) in the ``device_prefetch`` producer
+  thread, so decode overlaps both the host->HBM copy and the device step;
+- light host-side augmentation (random horizontal flip + optional padded random
+  crop — the standard ImageNet-style recipe) on the decoded batch; heavier
+  geometry stays on device for the segmentation task (data/augment.py).
+"""
+
+from __future__ import annotations
+
+import os
+from glob import glob
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class ImageFolder:
+    """A lazily-decoded labeled image dataset in ImageFolder layout.
+
+    ``{root}/{class_name}/{id}.png`` — one directory per class, sorted class names
+    map to label ids 0..K-1. Only paths and labels are held in memory.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        image_size: Tuple[int, int],
+        channels: int = 3,
+        paths: Optional[List[str]] = None,
+        labels: Optional[np.ndarray] = None,
+        class_names: Optional[List[str]] = None,
+    ):
+        self.root = root
+        self.image_size = tuple(image_size)
+        self.channels = channels
+        if paths is None:
+            class_names = sorted(
+                d
+                for d in os.listdir(root)
+                if os.path.isdir(os.path.join(root, d))
+            )
+            if not class_names:
+                raise ValueError(f"No class directories under {root}")
+            paths, labels_list = [], []
+            for k, name in enumerate(class_names):
+                files = sorted(glob(os.path.join(root, name, "*.png")))
+                paths.extend(files)
+                labels_list.extend([k] * len(files))
+            if not paths:
+                raise ValueError(f"No .png files under {root}/<class>/")
+            labels = np.asarray(labels_list, np.int32)
+        self.paths = list(paths)
+        self.labels = np.asarray(labels, np.int32)
+        self.class_names = list(class_names or [])
+
+    def __len__(self) -> int:
+        return len(self.paths)
+
+    @property
+    def num_classes(self) -> int:
+        return len(self.class_names) if self.class_names else int(self.labels.max()) + 1
+
+    def shard(self, index: int, count: int) -> "ImageFolder":
+        """Round-robin shard ``index`` of ``count`` (per-host data split)."""
+        rows = np.arange(index, len(self.paths), count)
+        return ImageFolder(
+            self.root,
+            self.image_size,
+            self.channels,
+            paths=[self.paths[i] for i in rows],
+            labels=self.labels[rows],
+            class_names=self.class_names,
+        )
+
+    def host_shard(self) -> "ImageFolder":
+        import jax
+
+        return self.shard(jax.process_index(), jax.process_count())
+
+    def decode(self, rows: Sequence[int]) -> np.ndarray:
+        """Decode the given rows to [n, H, W, C] float32 in [0, 1] via the native
+        batch decoder (PIL fallback inside)."""
+        from tensorflowdistributedlearning_tpu.native import decode_png_batch
+
+        h, w = self.image_size
+        return decode_png_batch(
+            [self.paths[i] for i in rows], h, w, channels=self.channels
+        )
+
+
+# ImageNet channel statistics (the classification analogue of the reference's
+# grayscale MEAN/STD constants, preprocessing/preprocessing.py:7-8).
+IMAGENET_MEAN = np.asarray([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.asarray([0.229, 0.224, 0.225], np.float32)
+
+
+def _normalize(images: np.ndarray, channels: int) -> np.ndarray:
+    if channels == 3:
+        return (images - IMAGENET_MEAN) / IMAGENET_STD
+    return (images - images.mean()) / max(images.std(), 1e-6)
+
+
+def _augment(
+    images: np.ndarray, rng: np.random.Generator, crop_padding: int
+) -> np.ndarray:
+    """Random horizontal flip + optional zero-padded random crop, per image."""
+    n, h, w, _ = images.shape
+    flip = rng.random(n) < 0.5
+    images[flip] = images[flip, :, ::-1]
+    if crop_padding > 0:
+        p = crop_padding
+        padded = np.pad(images, ((0, 0), (p, p), (p, p), (0, 0)), mode="reflect")
+        ys = rng.integers(0, 2 * p + 1, n)
+        xs = rng.integers(0, 2 * p + 1, n)
+        images = np.stack(
+            [padded[i, ys[i] : ys[i] + h, xs[i] : xs[i] + w] for i in range(n)]
+        )
+    return images
+
+
+def train_batches(
+    dataset: ImageFolder,
+    batch_size: int,
+    seed: int,
+    steps: Optional[int] = None,
+    augment: bool = True,
+    crop_padding: int = 4,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Infinite (or ``steps``-bounded) shuffled {'images','labels'} stream, decoded
+    per batch. Epoch permutations chain like data.pipeline.train_batches."""
+    n = len(dataset)
+    if n == 0:
+        raise ValueError("Empty dataset")
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(n)
+    pos = 0
+    emitted = 0
+    while steps is None or emitted < steps:
+        while len(order) - pos < batch_size:
+            order = np.concatenate([order[pos:], rng.permutation(n)])
+            pos = 0
+        rows = order[pos : pos + batch_size]
+        pos += batch_size
+        emitted += 1
+        images = dataset.decode(rows)
+        if augment:
+            images = _augment(images, rng, crop_padding)
+        images = _normalize(images, dataset.channels)
+        yield {"images": images, "labels": dataset.labels[rows]}
+
+
+def eval_batches(
+    dataset: ImageFolder,
+    batch_size: int,
+    num_batches: Optional[int] = None,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Ordered single pass, decoded per batch, under the shared
+    ``pipeline.eval_index_batches`` padding contract (wrap-around pad rows,
+    ``valid`` mask, forced multi-host step count, n=0 empty-shard edge)."""
+    from tensorflowdistributedlearning_tpu.data.pipeline import eval_index_batches
+
+    n = len(dataset)
+    h, w = dataset.image_size
+    for rows, valid in eval_index_batches(n, batch_size, num_batches):
+        if n == 0:
+            images = np.zeros((batch_size, h, w, dataset.channels), np.float32)
+            labels = np.zeros(batch_size, np.int32)
+        else:
+            images = _normalize(dataset.decode(rows), dataset.channels)
+            labels = dataset.labels[rows]
+        yield {"images": images, "labels": labels, "valid": valid}
+
+
+def write_synthetic_imagefolder(
+    root: str,
+    num_classes: int,
+    per_class: int,
+    image_size: Tuple[int, int],
+    channels: int = 3,
+    seed: int = 0,
+) -> None:
+    """Materialize a synthetic-but-learnable ImageFolder dataset as real PNGs
+    (class-conditional brightness, the on-disk twin of
+    data.synthetic.synthetic_classification_batch). Idempotent."""
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    h, w = image_size
+    for k in range(num_classes):
+        d = os.path.join(root, f"class{k:03d}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            path = os.path.join(d, f"im{i:04d}.png")
+            if os.path.exists(path):
+                continue
+            base = (k + 0.5) / num_classes * 255.0
+            arr = np.clip(
+                rng.normal(base, 40.0, (h, w, channels)), 0, 255
+            ).astype(np.uint8)
+            img = Image.fromarray(arr[..., 0] if channels == 1 else arr)
+            img.save(path)

@@ -158,24 +158,58 @@ def train_batches(
         yield {"images": dataset.images[rows], "masks": dataset.masks[rows]}
 
 
-def eval_batches(
-    dataset: InMemoryDataset, batch_size: int
-) -> Iterator[Dict[str, np.ndarray]]:
-    """One pass over the dataset in order. The final partial batch is padded by
-    wrap-around to keep shapes static for jit, and a per-example ``valid`` 0/1 mask
-    marks the pad rows so the eval step's weighted streaming means exclude them —
-    every example counts exactly once regardless of ``n % batch_size``. Datasets
-    without masks (test sets) yield only {'images', 'valid'}."""
-    n = len(dataset)
-    for start in range(0, n, batch_size):
-        rows = np.arange(start, min(start + batch_size, n))
+def eval_index_batches(
+    n: int, batch_size: int, num_batches: Optional[int] = None
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Yield ``(rows, valid)`` index batches covering ``n`` examples in order.
+
+    The single source of the eval padding contract, shared by every eval stream
+    (in-memory segmentation, streaming ImageFolder): the final partial batch wraps
+    around (modulo ``n``) so shapes stay static for jit, and the 0/1 ``valid``
+    mask excludes pad rows from the weighted streaming means — every example
+    counts exactly once regardless of ``n % batch_size``.
+
+    ``num_batches`` forces the stream to exactly that length (extra batches are
+    all-padding, valid=0): under multi-host SPMD every process must run the SAME
+    number of collective-bearing eval steps even when host shards differ in size
+    — including n=0, the empty-shard edge, where every batch is pure padding
+    (rows full of index 0 into a caller-provided placeholder) — or the jitted
+    steps deadlock; see ``multihost.eval_num_batches``."""
+    total = num_batches if num_batches is not None else max(1, -(-n // batch_size))
+    for b in range(total):
+        start = b * batch_size
+        rows = np.arange(start, min(start + batch_size, n), dtype=np.int64)
         valid = np.ones(batch_size, np.float32)
         if len(rows) < batch_size:
             valid[len(rows) :] = 0.0
-            rows = np.concatenate([rows, np.arange(batch_size - len(rows))])
-        batch = {"images": dataset.images[rows], "valid": valid}
-        if dataset.masks is not None:
-            batch["masks"] = dataset.masks[rows]
+            pad = (
+                np.arange(batch_size - len(rows), dtype=np.int64) % n
+                if n > 0
+                else np.zeros(batch_size - len(rows), np.int64)
+            )
+            rows = np.concatenate([rows, pad])
+        yield rows, valid
+
+
+def eval_batches(
+    dataset: InMemoryDataset, batch_size: int, num_batches: Optional[int] = None
+) -> Iterator[Dict[str, np.ndarray]]:
+    """One ordered pass over the dataset as {'images', 'valid'[, 'masks']} batches
+    under the ``eval_index_batches`` padding contract (wrap-around pad rows,
+    ``valid`` mask, optional forced multi-host step count). Datasets without masks
+    (test sets) yield only {'images', 'valid'}."""
+    n = len(dataset)
+    h, w, c = dataset.images.shape[1:]
+    zero_images = np.zeros((batch_size, h, w, c), np.float32)
+    for rows, valid in eval_index_batches(n, batch_size, num_batches):
+        if n == 0:
+            batch = {"images": zero_images, "valid": valid}
+            if dataset.masks is not None:
+                batch["masks"] = np.zeros((batch_size, h, w, 1), np.float32)
+        else:
+            batch = {"images": dataset.images[rows], "valid": valid}
+            if dataset.masks is not None:
+                batch["masks"] = dataset.masks[rows]
         yield batch
 
 

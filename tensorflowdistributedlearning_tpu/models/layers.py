@@ -59,11 +59,54 @@ def upsample(x: jax.Array, out_hw: Tuple[int, int]) -> jax.Array:
     return x[:, 2:-2, 2:-2, :]
 
 
+class SpatialConv(nn.Module):
+    """``nn.Conv``-parameter-compatible SAME conv whose H dimension is sharded over
+    a mesh axis (sequence/context parallelism): halo exchange + phase-exact VALID
+    convolution (parallel/spatial.py). Param tree is identical to ``nn.Conv``
+    (``kernel`` [kh, kw, C_in, C_out], optional ``bias`` [C_out]), so checkpoints
+    transfer between sharded and unsharded execution unchanged.
+    """
+
+    features: int
+    kernel_size: int = 3
+    stride: int = 1
+    rate: int = 1
+    use_bias: bool = True
+    axis_name: str = "sequence"
+    kernel_init: Callable = conv_kernel_init
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from tensorflowdistributedlearning_tpu.parallel.spatial import spatial_conv2d
+
+        k = self.kernel_size
+        kernel = self.param(
+            "kernel", self.kernel_init, (k, k, x.shape[-1], self.features)
+        )
+        dtype = self.dtype or x.dtype
+        out = spatial_conv2d(
+            x.astype(dtype),
+            kernel.astype(dtype),
+            stride=self.stride,
+            rate=self.rate,
+            axis_name=self.axis_name,
+        )
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.features,))
+            out = out + bias.astype(dtype)
+        return out
+
+
 class ConvBN(nn.Module):
     """Conv2D + BatchNorm + activation, the slim ``conv2d`` arg_scope default
     (reference: core/resnet.py:378-383: conv with He init, BN normalizer, relu).
     With ``use_bn=False`` it is a plain conv with bias and no activation — the
     shortcut/final-projection flavor (reference: core/resnet.py:78-80, 147-149).
+
+    ``spatial_axis_name`` routes kernels > 1x1 through the halo-exchange
+    ``SpatialConv`` for H-sharded (sequence-parallel) execution; 1x1 kernels are
+    pointwise and need no halo, so ``nn.Conv`` serves them in either mode.
     """
 
     features: int
@@ -76,21 +119,34 @@ class ConvBN(nn.Module):
     bn_epsilon: float = 0.001
     bn_scale: bool = True
     bn_axis_name: Optional[str] = None
+    spatial_axis_name: Optional[str] = None
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
-        x = nn.Conv(
-            self.features,
-            (self.kernel_size, self.kernel_size),
-            strides=(self.stride, self.stride),
-            kernel_dilation=(self.rate, self.rate),
-            padding="SAME",
-            use_bias=not self.use_bn,
-            kernel_init=conv_kernel_init,
-            dtype=self.dtype,
-            name="conv",
-        )(x)
+        if self.spatial_axis_name is not None and self.kernel_size > 1:
+            x = SpatialConv(
+                self.features,
+                self.kernel_size,
+                stride=self.stride,
+                rate=self.rate,
+                use_bias=not self.use_bn,
+                axis_name=self.spatial_axis_name,
+                dtype=self.dtype,
+                name="conv",
+            )(x)
+        else:
+            x = nn.Conv(
+                self.features,
+                (self.kernel_size, self.kernel_size),
+                strides=(self.stride, self.stride),
+                kernel_dilation=(self.rate, self.rate),
+                padding="SAME",
+                use_bias=not self.use_bn,
+                kernel_init=conv_kernel_init,
+                dtype=self.dtype,
+                name="conv",
+            )(x)
         if self.use_bn:
             x = nn.BatchNorm(
                 use_running_average=not train,

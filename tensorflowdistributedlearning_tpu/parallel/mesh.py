@@ -97,6 +97,28 @@ def shard_batch(tree: Any, mesh: Mesh) -> Any:
     return jax.tree.map(_put, tree)
 
 
+def shard_batch_spatial(tree: Any, mesh: Mesh) -> Any:
+    """Place a batch for sequence-parallel training: ``images`` sharded (batch,
+    sequence) — axis 0 over data-parallel shards, axis 1 (H) over the sequence
+    axis — and every other leaf (labels, valid) sharded on batch only. The H
+    extent must divide the sequence-axis size."""
+
+    def _put(key, x):
+        x = np.asarray(x)
+        if key == "images":
+            if x.shape[1] % mesh.shape[SEQUENCE_AXIS] != 0:
+                raise ValueError(
+                    f"Spatial extent {x.shape[1]} must be divisible by the "
+                    f"sequence-parallel degree {mesh.shape[SEQUENCE_AXIS]}"
+                )
+            spec = P(BATCH_AXIS, SEQUENCE_AXIS, *([None] * (x.ndim - 2)))
+        else:
+            spec = P(BATCH_AXIS, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return {k: _put(k, v) for k, v in tree.items()}
+
+
 def replicate(tree: Any, mesh: Mesh) -> Any:
     """Place a pytree on the mesh fully replicated (params/optimizer state)."""
     sharding = replicated_sharding(mesh)

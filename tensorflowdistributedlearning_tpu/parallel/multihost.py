@@ -74,20 +74,108 @@ def process_info() -> Dict[str, int]:
     }
 
 
-def global_shard_batch(local_tree: Any, mesh: Mesh) -> Any:
+def per_process_batch_size(global_batch: int) -> int:
+    """This process's share of every global batch (``global_batch / process_count``)
+    — the per-host generalization of the reference's per-tower ``batch/n_gpus``
+    split (reference: model.py:156-159)."""
+    p = jax.process_count()
+    if global_batch % p != 0:
+        raise ValueError(
+            f"Global batch size {global_batch} must be divisible by the process "
+            f"count {p}"
+        )
+    return global_batch // p
+
+
+def eval_num_batches(global_n: int, per_process_batch: int) -> int:
+    """Number of eval steps EVERY process must run for a ``global_n``-example eval
+    set split round-robin across processes (``data.pipeline.host_shard``).
+
+    All processes must execute the same number of collective-bearing jitted eval
+    steps or they deadlock; the largest host shard (``ceil(global_n / P)``) sets
+    the count, and smaller shards pad with valid=0 batches."""
+    p = jax.process_count()
+    max_shard = -(-global_n // p)
+    return max(1, -(-max_shard // per_process_batch))
+
+
+def process_local_rows(global_batch: int, mesh: Mesh) -> np.ndarray:
+    """Row indices of a batch-axis-sharded global batch owned by THIS process.
+
+    Computed exactly from the sharding's device→index map, so it is correct for
+    any device ordering. Single-process this is ``arange(global_batch)``. Use it
+    to slice a batch every host holds in full (e.g. a test set) down to the local
+    chunk ``global_shard_batch`` expects, and to know which output rows
+    ``fetch``'s allgather attributes to which input rows."""
+    sharding = NamedSharding(mesh, P(BATCH_AXIS))
+    index_map = sharding.devices_indices_map((global_batch,))
+    me = jax.process_index()
+    rows = [
+        np.arange(
+            idx[0].start if idx[0].start is not None else 0,
+            idx[0].stop if idx[0].stop is not None else global_batch,
+        )
+        for d, idx in index_map.items()
+        if d.process_index == me
+    ]
+    return np.unique(np.concatenate(rows))
+
+
+def _leaf_spec(key: Optional[str], ndim: int, spatial: bool) -> P:
+    """Batch-axis spec; under spatial (sequence) parallelism ``images`` are
+    additionally H-sharded over the sequence axis."""
+    from tensorflowdistributedlearning_tpu.parallel.mesh import SEQUENCE_AXIS
+
+    if spatial and key == "images":
+        return P(BATCH_AXIS, SEQUENCE_AXIS, *([None] * (ndim - 2)))
+    return P(BATCH_AXIS, *([None] * (ndim - 1)))
+
+
+def shard_replicated_batch(tree: Any, mesh: Mesh, *, spatial: bool = False) -> Any:
+    """Shard a batch dict that EVERY process holds identically in full (e.g. a
+    test set built on all hosts) onto the ``batch`` (and, for images under
+    ``spatial``, ``sequence``) mesh axes. Single-process this is a plain
+    ``device_put``; multi-process each host contributes only the rows its devices
+    own."""
+
+    def place(key, x):
+        x = np.asarray(x)
+        spec = _leaf_spec(key, x.ndim, spatial)
+        sharding = NamedSharding(mesh, spec)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        rows = process_local_rows(x.shape[0], mesh)
+        return jax.make_array_from_process_local_data(sharding, x[rows])
+
+    return {k: place(k, v) for k, v in tree.items()}
+
+
+def fetch(x: Any) -> np.ndarray:
+    """Device→host fetch of a batch-sharded global array that works under
+    multi-host (cross-process allgather so every host sees the full array);
+    single-process it is a plain ``device_get``."""
+    if jax.process_count() == 1:
+        return np.asarray(jax.device_get(x))
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def global_shard_batch(local_tree: Any, mesh: Mesh, *, spatial: bool = False) -> Any:
     """Assemble a globally-sharded batch from THIS PROCESS's local examples.
 
-    ``local_tree``: pytree of host arrays holding only this process's
+    ``local_tree``: dict of host arrays holding only this process's
     ``global_batch / process_count`` examples (in process order — use
     ``data.pipeline.host_shard`` to pick them). Returns jax Arrays sharded on the
     ``batch`` mesh axis spanning all hosts. Single-process, this is exactly
-    ``mesh_lib.shard_batch``.
+    ``mesh_lib.shard_batch``. ``spatial`` additionally H-shards images over the
+    sequence axis (multi-process spatial placement assumes each process's
+    addressable devices cover whole sequence groups, as on TPU pod slices).
     """
 
-    def place(x):
+    def place(key, x):
         x = np.asarray(x)
-        spec = P(BATCH_AXIS, *([None] * (x.ndim - 1)))
-        sharding = NamedSharding(mesh, spec)
+        sharding = NamedSharding(mesh, _leaf_spec(key, x.ndim, spatial))
         return jax.make_array_from_process_local_data(sharding, x)
 
-    return jax.tree.map(place, local_tree)
+    return {k: place(k, v) for k, v in local_tree.items()}

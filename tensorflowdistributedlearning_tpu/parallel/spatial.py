@@ -89,15 +89,21 @@ def spatial_conv2d(
     kernel: jax.Array,
     *,
     stride: int = 1,
+    rate: int = 1,
     axis_name: str = SEQUENCE_AXIS,
+    feature_group_count: int = 1,
 ) -> jax.Array:
-    """2-D convolution of an H-sharded NHWC batch, exact vs the unsharded op.
+    """2-D (optionally atrous) convolution of an H-sharded NHWC batch, exact vs
+    the unsharded SAME op.
 
     ``x``: local shard [B, H_local, W, C_in]; ``kernel``: [kh, kw, C_in, C_out]
     (odd kh). H is sharded over ``axis_name``; W is whole on every device. The op
-    halo-exchanges (kh-1)/2 rows, then convolves VALID along H / SAME along W.
-    With ``stride`` > 1, every shard's H_local must be divisible by the stride so
-    shard boundaries stay aligned with the global stride phase.
+    halo-exchanges ``rate*(kh-1)/2`` rows, then convolves VALID along H / SAME
+    along W. With ``stride`` > 1, every shard's H_local must be divisible by the
+    stride so shard boundaries stay aligned with the global stride phase. When the
+    halo exceeds the local extent (deep atrous stages on small maps), it falls
+    back to an all-gather of H — exact, costlier in ICI bandwidth, and only hit
+    where the maps are smallest.
     """
     kh, kw = kernel.shape[0], kernel.shape[1]
     if kh % 2 != 1:
@@ -108,33 +114,132 @@ def spatial_conv2d(
             f"H_local {h_local} must be divisible by stride {stride} to keep "
             "shard boundaries stride-aligned"
         )
-    halo = (kh - 1) // 2
+    # effective (dilated) kernel extents
+    ekh = kh + (kh - 1) * (rate - 1)
+    ekw = kw + (kw - 1) * (rate - 1)
+    halo = (ekh - 1) // 2
+    out_rows = h_local // stride
+
+    # W is unsharded: XLA's actual SAME split (low gets the floor)
+    w = x.shape[2]
+    out_cols = -(-w // stride)
+    total_w = max((out_cols - 1) * stride + ekw - w, 0)
+    pw_lo = total_w // 2
+    pw_hi = total_w - pw_lo
+
+    if halo > h_local:
+        # single-hop halo cannot reach beyond the adjacent shard: gather H whole,
+        # run the global SAME conv, keep this shard's output rows
+        n = lax.axis_size(axis_name)
+        idx = lax.axis_index(axis_name)
+        full = lax.all_gather(x, axis_name, axis=1, tiled=True)
+        out = lax.conv_general_dilated(
+            full,
+            kernel,
+            window_strides=(stride, stride),
+            padding="SAME",
+            rhs_dilation=(rate, rate),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=feature_group_count,
+        )
+        return lax.dynamic_slice_in_dim(out, idx * out_rows, out_rows, axis=1)
+
     padded = halo_exchange(x, halo, axis_name=axis_name, spatial_axis=1)
     # Reproduce XLA's SAME padding phase exactly: with global H divisible by the
-    # stride, SAME pads a total of max(kh - stride, 0) rows, floor-split low/high —
-    # NOT (kh-1)/2 each side when stride > 1. The first tap of this shard's first
+    # stride, SAME pads a total of max(ekh - stride, 0) rows, floor-split low/high —
+    # NOT (ekh-1)/2 each side when stride > 1. The first tap of this shard's first
     # output row therefore sits `pad_lo` rows above the shard start, i.e. at offset
     # (halo - pad_lo) inside the halo-extended block; VALID conv from there with
     # the same stride reproduces the global output rows owned by this shard.
-    total_pad = max(kh - stride, 0)
+    total_pad = max(ekh - stride, 0)
     pad_lo = total_pad // 2
-    out_rows = h_local // stride
     offset = halo - pad_lo
-    window = (out_rows - 1) * stride + kh
+    window = (out_rows - 1) * stride + ekh
     sliced = lax.slice_in_dim(padded, offset, offset + window, axis=1)
-    # W is unsharded: apply XLA's actual SAME split there too (low gets the floor)
-    w = x.shape[2]
-    out_cols = -(-w // stride)
-    total_w = max((out_cols - 1) * stride + kw - w, 0)
-    pw_lo = total_w // 2
-    pw_hi = total_w - pw_lo
     return lax.conv_general_dilated(
         sliced,
         kernel,
         window_strides=(stride, stride),
         padding=[(0, 0), (pw_lo, pw_hi)],
+        rhs_dilation=(rate, rate),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=feature_group_count,
     )
+
+
+def spatial_max_pool(
+    x: jax.Array,
+    window: int = 3,
+    stride: int = 2,
+    *,
+    axis_name: str = SEQUENCE_AXIS,
+) -> jax.Array:
+    """SAME max pool of an H-sharded NHWC batch, exact vs ``nn.max_pool``.
+
+    Same halo/phase scheme as ``spatial_conv2d``; halo rows that lie beyond the
+    global image boundary (the outermost shards' missing neighbors, which
+    ``halo_exchange`` fills with zeros) are reset to -inf so they never win the
+    max — matching reduce_window's SAME padding identity.
+    """
+    h_local = x.shape[1]
+    if h_local % stride != 0:
+        raise ValueError(
+            f"H_local {h_local} must be divisible by stride {stride} to keep "
+            "shard boundaries stride-aligned"
+        )
+    halo = (window - 1) // 2
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    # a PYTHON scalar, not a traced array: reduce_window's reverse-mode autodiff
+    # rule only recognizes the max-pool pattern with a static -inf init value
+    neg = (
+        float("-inf")
+        if jnp.issubdtype(x.dtype, jnp.floating)
+        else int(jnp.iinfo(x.dtype).min)
+    )
+    padded = halo_exchange(x, halo, axis_name=axis_name, spatial_axis=1)
+    if halo > 0:
+        rows = jnp.arange(padded.shape[1])
+        beyond_top = (rows < halo) & (idx == 0)
+        beyond_bot = (rows >= padded.shape[1] - halo) & (idx == n - 1)
+        mask = (beyond_top | beyond_bot)[None, :, None, None]
+        padded = jnp.where(mask, neg, padded)
+    total_pad = max(window - stride, 0)
+    pad_lo = total_pad // 2
+    out_rows = h_local // stride
+    offset = halo - pad_lo
+    span = (out_rows - 1) * stride + window
+    sliced = lax.slice_in_dim(padded, offset, offset + span, axis=1)
+    w = x.shape[2]
+    out_cols = -(-w // stride)
+    total_w = max((out_cols - 1) * stride + window - w, 0)
+    pw_lo = total_w // 2
+    pw_hi = total_w - pw_lo
+    return lax.reduce_window(
+        sliced,
+        neg,
+        lax.max,
+        (1, window, window, 1),
+        (1, stride, stride, 1),
+        [(0, 0), (0, 0), (pw_lo, pw_hi), (0, 0)],
+    )
+
+
+def spatial_global_mean(
+    x: jax.Array, *, axis_name: str = SEQUENCE_AXIS, keepdims: bool = False
+) -> jax.Array:
+    """Global spatial mean over (H, W) of an H-sharded NHWC batch: local mean then
+    ``pmean`` across equal shards (the ASPP image-pool branch / classifier
+    global-pool under spatial parallelism)."""
+    local = jnp.mean(x, axis=(1, 2), keepdims=keepdims)
+    return lax.pmean(local, axis_name)
+
+
+def spatial_gather(x: jax.Array, *, axis_name: str = SEQUENCE_AXIS, axis: int = 1) -> jax.Array:
+    """Reassemble the full tensor from H-shards on every device (one all-gather
+    over the sequence axis) — used where a computation genuinely needs the whole
+    extent (the decoder's bilinear upsampling, the per-image loss)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)
 
 
 def ring_all_gather(

@@ -90,10 +90,15 @@ class CheckpointManager:
         self._ckpt.wait_until_finished()
         return saved
 
-    def maybe_save(self, state: TrainState) -> bool:
+    def maybe_save(self, state: TrainState, step: Optional[int] = None) -> bool:
         """Save iff ``step`` is on the periodic cadence (reference:
-        ``save_checkpoints_steps=500``, model.py:118)."""
-        step = int(jax.device_get(state.step))
+        ``save_checkpoints_steps=500``, model.py:118).
+
+        Pass the host-side ``step`` counter when available: the cadence check then
+        never touches ``state.step``, so it does not force a host-device sync on the
+        just-dispatched train step (which would defeat async dispatch pipelining)."""
+        if step is None:
+            step = int(jax.device_get(state.step))
         if step % self.save_every_steps != 0:
             return False
         return self.save(state)

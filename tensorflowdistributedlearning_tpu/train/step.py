@@ -31,7 +31,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
 from tensorflowdistributedlearning_tpu.ops import losses as losses_lib
 from tensorflowdistributedlearning_tpu.ops import metrics as metrics_lib
-from tensorflowdistributedlearning_tpu.parallel.mesh import BATCH_AXIS
+from tensorflowdistributedlearning_tpu.parallel.mesh import BATCH_AXIS, SEQUENCE_AXIS
 from tensorflowdistributedlearning_tpu.train.state import TrainState
 
 Metrics = Dict[str, metrics_lib.Mean]
@@ -143,29 +143,43 @@ def _metric_deltas(
 
 
 def _mean_grads(grads: Any) -> Any:
-    """Average gradients across the batch mesh axis, leaf-by-leaf vma-aware.
+    """Average gradients across the batch (and sequence) mesh axes, leaf-by-leaf
+    vma-aware.
 
     Inside ``shard_map`` with varying-manual-axes checking, the gradient of a
     REPLICATED (unvarying) parameter is already psum'd by the automatic
     transposition, so the mean is ``leaf / axis_size``; a leaf that is still
-    per-shard (varying on the batch axis) needs a real ``pmean``.
+    per-shard (varying on an axis) needs a real ``pmean``. The sequence axis
+    matters under spatial parallelism: every sequence shard computes the same
+    (gathered) loss, so the automatic psum over-counts by the axis size — the
+    division below is what restores the true gradient. Axis size 1 (the
+    non-spatial meshes) makes it a no-op.
     """
     from tensorflowdistributedlearning_tpu.parallel.collectives import vma_of
 
-    n = jax.lax.axis_size(BATCH_AXIS)
-
     def mean_leaf(g):
-        if BATCH_AXIS in vma_of(g):
-            return jax.lax.pmean(g, BATCH_AXIS)
-        return g / n
+        vma = vma_of(g)
+        for axis in (BATCH_AXIS, SEQUENCE_AXIS):
+            if axis in vma:
+                g = jax.lax.pmean(g, axis)
+            else:
+                g = g / jax.lax.axis_size(axis)
+        return g
 
     return jax.tree.map(mean_leaf, grads)
 
 
 def _psum_metrics(metrics: Metrics) -> Metrics:
-    return jax.tree.map(
-        lambda x: jax.lax.psum(x, BATCH_AXIS), metrics
-    )
+    """Total metric contributions across batch shards. The trailing pmean over the
+    sequence axis is numerically an identity (every sequence shard computes
+    identical metrics from the gathered outputs) but makes the result unvarying on
+    that axis so it can leave the shard_map replicated."""
+
+    def reduce(x):
+        x = jax.lax.psum(x, BATCH_AXIS)
+        return jax.lax.pmean(x, SEQUENCE_AXIS)
+
+    return jax.tree.map(reduce, metrics)
 
 
 def merge_metrics(acc: Optional[Metrics], new: Metrics) -> Metrics:
@@ -179,6 +193,19 @@ def compute_metrics(acc: Metrics) -> Dict[str, float]:
     return {k: float(v.compute()) for k, v in acc.items()}
 
 
+def _batch_in_specs(spatial: bool, keys: Tuple[str, ...]):
+    """shard_map in_specs for a batch dict: everything sharded on the batch axis;
+    under spatial (sequence) parallelism the images are additionally H-sharded
+    over the sequence axis, while labels/valid stay whole per batch shard (they
+    are 1-channel/scalar-sized, and the loss needs full images)."""
+    if not spatial:
+        return P(BATCH_AXIS)
+    return {
+        k: P(BATCH_AXIS, SEQUENCE_AXIS) if k == "images" else P(BATCH_AXIS)
+        for k in keys
+    }
+
+
 def make_train_step(
     mesh: Mesh,
     task,
@@ -186,6 +213,7 @@ def make_train_step(
     weight_decay: float = 0.0,
     apply_weight_decay: bool = False,
     donate: bool = True,
+    spatial: bool = False,
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Metrics]]:
     """Build the jitted SPMD train step.
 
@@ -193,6 +221,12 @@ def make_train_step(
     every conv but minimized only the Lovász loss (reference: model.py:462-467 — the
     REGULARIZATION_LOSSES collection was never added). Default False reproduces the
     effective reference objective; True applies the declared one.
+
+    ``spatial=True`` expects a model built with ``spatial_axis_name=SEQUENCE_AXIS``
+    and a batch whose images are sharded (batch, sequence) — see
+    ``mesh.shard_batch_spatial``. The model's forward runs H-sharded over the
+    sequence mesh axis with halo exchanges; outputs are gathered inside the model,
+    so loss/metrics math below is unchanged.
     """
 
     def step(state: TrainState, batch: Dict[str, jax.Array]):
@@ -222,8 +256,11 @@ def make_train_step(
         # if a grad leaf arrives per-shard (varying), where an explicit pmean is
         # the right reduction.
         grads = _mean_grads(grads)
-        # per-shard (per-tower) BN stats, averaged to keep state replicated
+        # per-shard (per-tower) BN stats, averaged to keep state replicated (the
+        # sequence pmean is an identity when BN already syncs over that axis, and
+        # required either way so the stored stats leave the shard_map unvarying)
         new_batch_stats = jax.lax.pmean(new_batch_stats, BATCH_AXIS)
+        new_batch_stats = jax.lax.pmean(new_batch_stats, SEQUENCE_AXIS)
 
         new_state = state.apply_gradients(grads, new_batch_stats)
         metrics = _psum_metrics(_metric_deltas(task.metric_scores(outputs, batch), loss))
@@ -232,14 +269,14 @@ def make_train_step(
     sharded = jax.shard_map(
         step,
         mesh=mesh,
-        in_specs=(P(), P(BATCH_AXIS)),
+        in_specs=(P(), _batch_in_specs(spatial, ("images", "labels"))),
         out_specs=(P(), P()),
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
 def make_eval_step(
-    mesh: Mesh, task
+    mesh: Mesh, task, *, spatial: bool = False, with_valid: bool = True
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Metrics]:
     """Jitted SPMD eval step: forward in inference mode (BN running stats), streaming
     metric deltas (the reference's EVAL branch, model.py:391-403)."""
@@ -258,14 +295,18 @@ def make_eval_step(
             _metric_deltas(task.metric_scores(outputs, batch), loss, weights)
         )
 
+    keys = ("images", "labels", "valid") if with_valid else ("images", "labels")
     sharded = jax.shard_map(
-        step, mesh=mesh, in_specs=(P(), P(BATCH_AXIS)), out_specs=P()
+        step,
+        mesh=mesh,
+        in_specs=(P(), _batch_in_specs(spatial, keys)),
+        out_specs=P(),
     )
     return jax.jit(sharded)
 
 
 def make_predict_step(
-    mesh: Mesh, task
+    mesh: Mesh, task, *, spatial: bool = False
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Dict[str, jax.Array]]:
     """Jitted SPMD predict step (the reference's PREDICT branch, model.py:371-387);
     outputs stay sharded on the batch axis."""
@@ -276,9 +317,22 @@ def make_predict_step(
             batch["images"],
             train=False,
         )
-        return task.predictions(outputs)
+        preds = task.predictions(outputs)
+        if spatial:
+            # every sequence shard holds the full gathered prediction; reduce to
+            # clear the sequence-varying type (numerically an identity)
+            preds = jax.tree.map(
+                lambda v: jax.lax.pmax(v, SEQUENCE_AXIS)
+                if jnp.issubdtype(v.dtype, jnp.integer)
+                else jax.lax.pmean(v, SEQUENCE_AXIS),
+                preds,
+            )
+        return preds
 
     sharded = jax.shard_map(
-        step, mesh=mesh, in_specs=(P(), P(BATCH_AXIS)), out_specs=P(BATCH_AXIS)
+        step,
+        mesh=mesh,
+        in_specs=(P(), _batch_in_specs(spatial, ("images",))),
+        out_specs=P(BATCH_AXIS),
     )
     return jax.jit(sharded)

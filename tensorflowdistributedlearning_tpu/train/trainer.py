@@ -40,6 +40,7 @@ from tensorflowdistributedlearning_tpu.data import folds as folds_lib
 from tensorflowdistributedlearning_tpu.data import pipeline as pipeline_lib
 from tensorflowdistributedlearning_tpu.models import build_model
 from tensorflowdistributedlearning_tpu.parallel import mesh as mesh_lib
+from tensorflowdistributedlearning_tpu.parallel import multihost
 from tensorflowdistributedlearning_tpu.train import step as step_lib
 from tensorflowdistributedlearning_tpu.train.checkpoint import CheckpointManager
 from tensorflowdistributedlearning_tpu.train.state import TrainState, create_train_state
@@ -76,6 +77,9 @@ class Trainer:
         unknown = set(kwargs) - _MODEL_FIELDS
         if unknown:
             raise ValueError(f"Unknown model config keys: {sorted(unknown)}")
+        # join the jax.distributed cluster (auto-discovery; quiet single-process
+        # fallback) BEFORE the first device query below builds the mesh
+        multihost.initialize()
         self.model_dir = model_dir
         self.data_directory = data_directory
         self.model_config = ModelConfig(**kwargs)
@@ -92,8 +96,18 @@ class Trainer:
             crop_probability=0.0
         )
         self.task = step_lib.SegmentationTask()
-        self.mesh = mesh_lib.make_mesh(self.train_config.n_devices)
-        self.model = build_model(self.model_config)
+        tcfg = self.train_config
+        self.mesh = mesh_lib.make_mesh(
+            tcfg.n_devices, sequence_parallel=tcfg.sequence_parallel
+        )
+        # sequence_parallel > 1: H-sharded backbone with halo-exchange convs and
+        # sequence-synced BN (parallel/spatial.py; a TPU-first capability — the
+        # reference was data-parallel only, model.py:115-116)
+        self._spatial = tcfg.sequence_parallel > 1
+        axis = mesh_lib.SEQUENCE_AXIS if self._spatial else None
+        self.model = build_model(
+            self.model_config, bn_axis_name=axis, spatial_axis_name=axis
+        )
         self._n_params: Optional[int] = None
         os.makedirs(model_dir, exist_ok=True)
 
@@ -114,14 +128,27 @@ class Trainer:
     def _fold_dir(self, fold: int) -> str:
         return os.path.join(self.model_dir, f"fold{fold}")
 
+    @property
+    def _plain_model(self):
+        """Unsharded twin of ``self.model`` (identical param tree — SpatialConv is
+        nn.Conv-compatible): used for init and host-side single-device forwards,
+        which cannot run the spatial collectives outside shard_map."""
+        if not hasattr(self, "_plain_model_cache"):
+            self._plain_model_cache = (
+                build_model(self.model_config) if self._spatial else self.model
+            )
+        return self._plain_model_cache
+
     def _init_state(self) -> TrainState:
         cfg, tcfg = self.model_config, self.train_config
         tx = step_lib.make_optimizer(tcfg)
         h, w = cfg.input_shape
         sample = np.zeros((1, h, w, cfg.input_channels), np.float32)
         state = create_train_state(
-            self.model, tx, jax.random.PRNGKey(tcfg.seed), sample
+            self._plain_model, tx, jax.random.PRNGKey(tcfg.seed), sample
         )
+        if self._spatial:
+            state = state.replace(apply_fn=self.model.apply)
         self._n_params = count_params(state.params)
         return mesh_lib.replicate(state, self.mesh)
 
@@ -179,8 +206,14 @@ class Trainer:
         steps: int,
     ) -> Dict[str, float]:
         tcfg = self.train_config
+        # per-process data: each host loads only its round-robin shard of the fold
+        # and draws batch/P examples per step; global_shard_batch assembles them
+        # into one globally-sharded batch (the per-host generalization of the
+        # reference's per-tower batch/n_gpus contract, model.py:156-159)
+        local_bs = multihost.per_process_batch_size(batch_size)
         train_ds = dataset.select(pipeline_lib.host_shard(manifest["train"]))
         eval_ds = dataset.select(pipeline_lib.host_shard(manifest["eval"]))
+        eval_global_n = len(manifest["eval"])
 
         ckpt = self._checkpointer(fold)
         state = ckpt.restore_latest(self._init_state())
@@ -188,23 +221,33 @@ class Trainer:
         if start_step >= steps:
             logger.info("fold %d already trained to step %d", fold, start_step)
             ckpt.close()
-            return self._evaluate(state, eval_ds, batch_size, fold, writer=None)
+            return self._evaluate(
+                state, eval_ds, batch_size, fold, writer=None,
+                global_n=eval_global_n,
+            )
 
         train_step = step_lib.make_train_step(
-            self.mesh, self.task, weight_decay=self.model_config.weight_decay
+            self.mesh,
+            self.task,
+            weight_decay=self.model_config.weight_decay,
+            spatial=self._spatial,
         )
         prepare = self._make_prepare_train(fold)
 
-        tb_train = SummaryWriter(os.path.join(self._fold_dir(fold), "train"))
-        tb_eval = SummaryWriter(os.path.join(self._fold_dir(fold), "eval"))
+        is_main = jax.process_index() == 0
+        tb_train = SummaryWriter(os.path.join(self._fold_dir(fold), "train")) if is_main else None
+        tb_eval = SummaryWriter(os.path.join(self._fold_dir(fold), "eval")) if is_main else None
         last_eval_time = 0.0
         final_metrics: Dict[str, float] = {}
 
         batches = pipeline_lib.train_batches(
-            train_ds, batch_size, seed=tcfg.seed + fold, steps=steps - start_step
+            train_ds, local_bs, seed=tcfg.seed + fold, steps=steps - start_step
         )
         batches = pipeline_lib.device_prefetch(
-            batches, lambda b: mesh_lib.shard_batch(b, self.mesh)
+            batches,
+            lambda b: multihost.global_shard_batch(
+                b, self.mesh, spatial=self._spatial
+            ),
         )
         step_no = start_step
         last_eval_step = -1
@@ -212,16 +255,23 @@ class Trainer:
             batch = prepare(jnp.asarray(step_no), raw)
             state, metrics = train_step(state, batch)
             step_no += 1
-            if step_no % tcfg.train_log_every_steps == 0:
+            if tb_train is not None and step_no % tcfg.train_log_every_steps == 0:
                 scalars = step_lib.compute_metrics(jax.device_get(metrics))
                 tb_train.scalars(scalars, step_no)
-            if ckpt.maybe_save(state) and (
+                # train-phase image grids every train_log_every_steps — the
+                # reference's SummarySaverHook wrote input/label/probability/
+                # prediction to fold{i}/train every 20 steps (model.py:470-481);
+                # one extra inference-mode forward per log interval
+                if jax.process_count() == 1:
+                    self._write_image_summaries(tb_train, state, batch, step_no)
+            if ckpt.maybe_save(state, step=step_no) and (
                 time.time() - last_eval_time >= tcfg.eval_throttle_secs
             ):
                 last_eval_time = time.time()
                 last_eval_step = step_no
                 final_metrics = self._evaluate(
-                    state, eval_ds, batch_size, fold, writer=tb_eval
+                    state, eval_ds, batch_size, fold, writer=tb_eval,
+                    global_n=eval_global_n,
                 )
                 ckpt.export_best(state, final_metrics)
         # end of training: final checkpoint + eval + export (train_and_evaluate's
@@ -230,11 +280,14 @@ class Trainer:
         ckpt.save(state, force=True)
         if last_eval_step != step_no:
             final_metrics = self._evaluate(
-                state, eval_ds, batch_size, fold, writer=tb_eval
+                state, eval_ds, batch_size, fold, writer=tb_eval,
+                global_n=eval_global_n,
             )
             ckpt.export_best(state, final_metrics)
-        tb_train.close()
-        tb_eval.close()
+        if tb_train is not None:
+            tb_train.close()
+        if tb_eval is not None:
+            tb_eval.close()
         ckpt.close()
         return final_metrics
 
@@ -263,17 +316,29 @@ class Trainer:
         batch_size: int,
         fold: int,
         writer: Optional[SummaryWriter],
+        global_n: Optional[int] = None,
     ) -> Dict[str, float]:
         """One full eval pass with streaming metrics (the EVAL branch + SummarySaverHook,
         reference: model.py:391-403, 475-481). Runs at the caller's ``batch_size``
         (the reference used 2x the train batch, model.py:207-211 — here the wrap-around
-        padding makes eval batch size a pure throughput knob, so it is not doubled)."""
+        padding makes eval batch size a pure throughput knob, so it is not doubled).
+
+        ``eval_ds`` is this process's host shard; ``global_n`` (the fold's total eval
+        size) pins the step count so every process runs the same number of
+        collective-bearing steps."""
+        mesh_lib.local_batch_size(batch_size, self.mesh)  # fail fast, clear message
+        local_bs = multihost.per_process_batch_size(batch_size)
+        num = multihost.eval_num_batches(
+            global_n if global_n is not None else len(eval_ds), local_bs
+        )
         eval_step = self._eval_step
         prepare = self._prepare_eval
         acc = None
         first_batch = None
-        for raw in pipeline_lib.eval_batches(eval_ds, batch_size):
-            sharded = mesh_lib.shard_batch(raw, self.mesh)
+        for raw in pipeline_lib.eval_batches(eval_ds, local_bs, num_batches=num):
+            sharded = multihost.global_shard_batch(
+                raw, self.mesh, spatial=self._spatial
+            )
             batch = prepare(sharded)
             metrics = eval_step(state, batch)
             acc = step_lib.merge_metrics(acc, jax.device_get(metrics))
@@ -284,7 +349,10 @@ class Trainer:
         logger.info("fold %d eval @ %d: %s", fold, step_no, result)
         if writer is not None:
             writer.scalars(result, step_no)
-            self._write_image_summaries(writer, state, first_batch, step_no)
+            if jax.process_count() == 1:
+                # image grids need fully-addressable batches; multi-host scalar
+                # summaries still flow from process 0
+                self._write_image_summaries(writer, state, first_batch, step_no)
             writer.flush()
         return result
 
@@ -310,13 +378,17 @@ class Trainer:
     @property
     def _eval_step(self):
         if not hasattr(self, "_eval_step_fn"):
-            self._eval_step_fn = step_lib.make_eval_step(self.mesh, self.task)
+            self._eval_step_fn = step_lib.make_eval_step(
+                self.mesh, self.task, spatial=self._spatial
+            )
         return self._eval_step_fn
 
     @property
     def _predict_step(self):
         if not hasattr(self, "_predict_step_fn"):
-            self._predict_step_fn = step_lib.make_predict_step(self.mesh, self.task)
+            self._predict_step_fn = step_lib.make_predict_step(
+                self.mesh, self.task, spatial=self._spatial
+            )
         return self._predict_step_fn
 
     @property
@@ -339,9 +411,11 @@ class Trainer:
     def _forward(self):
         if not hasattr(self, "_forward_fn"):
 
+            plain_apply = self._plain_model.apply
+
             @jax.jit
             def forward(state, images):
-                return state.apply_fn(
+                return plain_apply(
                     {"params": state.params, "batch_stats": state.batch_stats},
                     images,
                     train=False,
@@ -371,6 +445,7 @@ class Trainer:
         Returns ``{"ids", "probabilities" [N,H,W,1], "masks" [N,H,W,1]}``.
         """
         transforms = augment_lib.TTA_TRANSFORMS if tta else ("none",)
+        mesh_lib.local_batch_size(batch_size, self.mesh)  # fail fast, clear message
         folds = list(folds) if folds is not None else list(
             range(self.train_config.n_folds)
         )
@@ -417,11 +492,54 @@ class Trainer:
         {'probabilities', 'mask'}`` where ``images`` is the preprocessed input batch
         (normalized + Laplacian channel, exactly what the reference's serving
         placeholder received).
+
+        ``data_format="NCHW"`` is honored at this boundary: inputs arrive
+        ``[B, C, H, W]`` and outputs return ``[B, 1, H, W]`` (the reference's NCHW
+        mode transposed at the top of model_fn, model.py:344-351; on TPU, XLA owns
+        the internal layout, so the transpose happens exactly once, here).
         """
         state = self._restore_fold_or_raise(fold, self._init_state())
         task = self.task
         forward = self._forward
-        return lambda images: task.predictions(forward(state, images))
+        nchw = self.train_config.data_format == "NCHW"
+
+        def serve(images):
+            if nchw:
+                images = jnp.transpose(images, (0, 2, 3, 1))
+            out = task.predictions(forward(state, images))
+            if nchw:
+                out = {k: jnp.transpose(v, (0, 3, 1, 2)) for k, v in out.items()}
+            return out
+
+        return serve
+
+    def export_serving(self, fold: int, directory: Optional[str] = None) -> str:
+        """Write a standalone serialized-StableHLO serving artifact for the fold's
+        best state (the reference's SavedModel export, model.py:190-204, done the
+        JAX-native way — see train/serving.py). Returns the artifact path; default
+        location ``{fold_dir}/export/serving``."""
+        from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+        directory = directory or os.path.join(
+            self._fold_dir(fold), "export", "serving"
+        )
+        h, w = self.model_config.input_shape
+        c = self.model_config.input_channels
+        shape = (
+            (1, c, h, w)
+            if self.train_config.data_format == "NCHW"
+            else (1, h, w, c)
+        )
+        return serving_lib.export_serving_artifact(
+            self.serving_fn(fold),
+            shape,
+            directory,
+            metadata={
+                "fold": fold,
+                "data_format": self.train_config.data_format,
+                "backbone": self.model_config.backbone,
+            },
+        )
 
     def _predict_one(
         self,
@@ -430,18 +548,24 @@ class Trainer:
         batch_size: int,
         transformation: str,
     ) -> np.ndarray:
-        """Probabilities [N, H, W, 1] for one (state, transform) ensemble member."""
+        """Probabilities [N, H, W, 1] for one (state, transform) ensemble member.
+
+        Every process holds the full test set, so batches are placed with
+        ``shard_replicated_batch`` and outputs pulled with ``fetch`` (a cross-process
+        allgather under multi-host; plain device_get single-process)."""
         predict_step = self._predict_step
         chunks = []
         n = len(test_ds)
         for raw in pipeline_lib.eval_batches(test_ds, batch_size):
             images = augment_lib.tta_transform(jnp.asarray(raw["images"]), transformation)
             batch = {"images": augment_lib.add_laplace_channel(images)}
-            batch = mesh_lib.shard_batch(batch, self.mesh)
+            batch = multihost.shard_replicated_batch(
+                batch, self.mesh, spatial=self._spatial
+            )
             out = predict_step(state, batch)
             probs = augment_lib.tta_inverse(out["probabilities"], transformation)
             valid = raw["valid"].astype(bool)
-            chunks.append(np.asarray(jax.device_get(probs))[valid])
+            chunks.append(multihost.fetch(probs)[valid])
         return np.concatenate(chunks)[:n]
 
 

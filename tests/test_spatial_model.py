@@ -1,0 +1,277 @@
+"""Spatial (sequence) parallelism integrated into the real model + train step
+(VERDICT r1 #4): atrous/pool/global-mean spatial ops exactness, H-sharded flagship
+forward exactness, and the end-to-end criterion — one train step on a (4, 1, 2)
+mesh matching the same-tower-count (4, 1, 1) run."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+from tensorflowdistributedlearning_tpu.data.synthetic import (
+    synthetic_segmentation_batch,
+)
+from tensorflowdistributedlearning_tpu.models import build_model
+from tensorflowdistributedlearning_tpu.parallel import mesh as mesh_lib
+from tensorflowdistributedlearning_tpu.parallel import spatial as sp
+from tensorflowdistributedlearning_tpu.parallel.mesh import (
+    SEQUENCE_AXIS,
+    make_mesh,
+)
+from tensorflowdistributedlearning_tpu.train import step as step_lib
+from tensorflowdistributedlearning_tpu.train.state import create_train_state
+
+CFG = ModelConfig(input_shape=(32, 32), n_blocks=(1, 1, 1), base_depth=16)
+
+
+@pytest.fixture(scope="module")
+def seq_mesh():
+    return make_mesh(8, sequence_parallel=8)
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    return jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    )
+
+
+@pytest.mark.parametrize("rate", [2, 4, 8])
+def test_spatial_conv_dilated_matches_unsharded(seq_mesh, rate):
+    """rate 8 on 4-row shards exceeds the single-hop halo and exercises the
+    gather fallback; rates 2/4 ride the halo exchange."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (2, 32, 8, 3)).astype(np.float32)  # 4 rows/shard
+    k = rng.normal(0, 0.5, (3, 3, 3, 5)).astype(np.float32)
+
+    ref = jax.lax.conv_general_dilated(
+        x, k, (1, 1), "SAME", rhs_dilation=(rate, rate),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    f = _shard_map(
+        lambda a: sp.spatial_conv2d(a, jnp.asarray(k), rate=rate),
+        seq_mesh,
+        (P(None, SEQUENCE_AXIS, None, None),),
+        P(None, SEQUENCE_AXIS, None, None),
+    )
+    np.testing.assert_allclose(
+        jax.device_get(f(x)), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_spatial_max_pool_matches_unsharded(seq_mesh):
+    import flax.linen as nn
+
+    rng = np.random.default_rng(1)
+    # negative values probe the -inf boundary handling (zero halo fill must not win)
+    x = (rng.normal(0, 1, (2, 32, 7, 3)) - 2.0).astype(np.float32)
+    ref = nn.max_pool(jnp.asarray(x), (3, 3), strides=(2, 2), padding="SAME")
+    f = _shard_map(
+        lambda a: sp.spatial_max_pool(a, 3, 2),
+        seq_mesh,
+        (P(None, SEQUENCE_AXIS, None, None),),
+        P(None, SEQUENCE_AXIS, None, None),
+    )
+    np.testing.assert_allclose(
+        jax.device_get(f(x)), np.asarray(ref), rtol=0, atol=0
+    )
+
+
+def test_spatial_global_mean_matches(seq_mesh):
+    rng = np.random.default_rng(2)
+    x = rng.normal(0, 1, (2, 16, 5, 3)).astype(np.float32)
+    f = _shard_map(
+        lambda a: sp.spatial_global_mean(a),
+        seq_mesh,
+        (P(None, SEQUENCE_AXIS, None, None),),
+        P(None, None),
+    )
+    np.testing.assert_allclose(
+        jax.device_get(f(x)), x.mean(axis=(1, 2)), rtol=1e-5, atol=1e-6
+    )
+
+
+@pytest.fixture(scope="module")
+def models_and_state():
+    plain = build_model(CFG)
+    spatial = build_model(
+        CFG, bn_axis_name=SEQUENCE_AXIS, spatial_axis_name=SEQUENCE_AXIS
+    )
+    tx = step_lib.make_optimizer(TrainConfig())
+    state = create_train_state(
+        plain, tx, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 2), np.float32)
+    )
+    return plain, spatial, state
+
+
+def test_spatial_param_tree_matches_plain(models_and_state):
+    """SpatialConv is checkpoint-compatible with nn.Conv: identical param trees
+    (init must run inside shard_map — the spatial ops need the sequence axis)."""
+    plain, spatial, state = models_and_state
+    mesh = make_mesh(8, sequence_parallel=2)
+
+    def init_fn(im):
+        return spatial.init(jax.random.PRNGKey(0), im, train=False)
+
+    v = jax.jit(
+        jax.shard_map(
+            init_fn,
+            mesh=mesh,
+            in_specs=(P("batch", SEQUENCE_AXIS, None, None),),
+            out_specs=P(),
+        )
+    )(np.zeros((8, 32, 32, 2), np.float32))
+    plain_shapes = jax.tree.map(jnp.shape, state.params)
+    spatial_shapes = jax.tree.map(jnp.shape, v["params"])
+    assert plain_shapes == spatial_shapes
+
+
+def test_spatial_forward_matches_unsharded(models_and_state):
+    plain, spatial, state = models_and_state
+    mesh = make_mesh(8, sequence_parallel=2)  # (4, 1, 2)
+    rng = np.random.default_rng(3)
+    images = rng.normal(0, 1, (8, 32, 32, 2)).astype(np.float32)
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+
+    ref = jax.jit(lambda v, im: plain.apply(v, im, train=False))(variables, images)
+
+    def fwd(v, im):
+        out = spatial.apply(v, im, train=False)
+        # numerically an identity (every sequence shard holds the gathered full
+        # output); clears the sequence-varying type so P(batch) out_specs hold
+        return jax.lax.pmean(out, SEQUENCE_AXIS)
+
+    f = jax.jit(
+        jax.shard_map(
+            fwd,
+            mesh=mesh,
+            in_specs=(P(), P("batch", SEQUENCE_AXIS, None, None)),
+            out_specs=P("batch", None, None, None),
+        )
+    )
+    out = f(
+        mesh_lib.replicate(variables, mesh),
+        sp.shard_spatial(images, mesh),
+    )
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(out)), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_spatial_train_step_matches_plain_mesh(models_and_state):
+    """VERDICT r1 #4 'done' criterion: one end-to-end train step on mesh (4,1,2)
+    matches the (4,1,1) run with the same 4-way tower split (same per-tower BN
+    batches; the sequence axis must be numerically free)."""
+    plain, spatial, state = models_and_state
+    mesh_dp = make_mesh(4)                      # (4, 1, 1)
+    mesh_sp = make_mesh(8, sequence_parallel=2)  # (4, 1, 2)
+    task = step_lib.SegmentationTask()
+
+    batch = synthetic_segmentation_batch(
+        np.random.default_rng(4), 8, input_shape=(32, 32), channels=2
+    )
+    batch = {"images": batch["images"], "labels": batch["labels"]}
+
+    state_dp = mesh_lib.replicate(state, mesh_dp)
+    state_sp = mesh_lib.replicate(state, mesh_sp).replace(apply_fn=spatial.apply)
+
+    step_dp = step_lib.make_train_step(mesh_dp, task, donate=False)
+    step_sp = step_lib.make_train_step(mesh_sp, task, donate=False, spatial=True)
+
+    new_dp, m_dp = step_dp(state_dp, mesh_lib.shard_batch(batch, mesh_dp))
+    new_sp, m_sp = step_sp(state_sp, mesh_lib.shard_batch_spatial(batch, mesh_sp))
+
+    r_dp = step_lib.compute_metrics(jax.device_get(m_dp))
+    r_sp = step_lib.compute_metrics(jax.device_get(m_sp))
+    assert r_dp["loss"] == pytest.approx(r_sp["loss"], rel=1e-4)
+    assert r_dp["metrics/mean_iou"] == pytest.approx(
+        r_sp["metrics/mean_iou"], rel=1e-4
+    )
+
+    # Param atol is set by Adam's update scale: where a gradient element is
+    # ~zero, float32 reassociation across the two reduction orders can flip the
+    # sign of g/sqrt(v), moving the element by up to 2*lr = 2e-3. The tight loss/
+    # metric agreement above is the exactness signal; this guards the overall tree.
+    flat_dp = jax.tree_util.tree_leaves_with_path(jax.device_get(new_dp.params))
+    flat_sp = dict(
+        jax.tree_util.tree_leaves_with_path(jax.device_get(new_sp.params))
+    )
+    for path, leaf in flat_dp:
+        np.testing.assert_allclose(
+            np.asarray(leaf),
+            np.asarray(flat_sp[path]),
+            rtol=5e-4,
+            atol=2.5e-3,
+            err_msg=str(path),
+        )
+    # BN moving stats also agree (sequence-synced BN == full-H per-tower BN)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+        jax.device_get(new_dp.batch_stats)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(leaf),
+            np.asarray(
+                dict(
+                    jax.tree_util.tree_leaves_with_path(
+                        jax.device_get(new_sp.batch_stats)
+                    )
+                )[path]
+            ),
+            rtol=5e-4,
+            atol=5e-5,
+            err_msg=str(path),
+        )
+
+
+def test_spatial_classifier_forward_matches(models_and_state):
+    # 64x64 keeps every strided stage of the stride-32 classification trunk
+    # shard-aligned at sequence degree 2 (32x32 would shrink H_local below the
+    # stride — an invalid spatial config that spatial_conv2d rejects loudly)
+    cfg = ModelConfig(
+        num_classes=5,
+        input_shape=(64, 64),
+        input_channels=3,
+        n_blocks=(1, 1, 1),
+        base_depth=16,
+        output_stride=None,
+    )
+    plain = build_model(cfg)
+    spatial = build_model(
+        cfg, bn_axis_name=SEQUENCE_AXIS, spatial_axis_name=SEQUENCE_AXIS
+    )
+    state = create_train_state(
+        plain,
+        step_lib.make_optimizer(TrainConfig()),
+        jax.random.PRNGKey(1),
+        np.zeros((1, 64, 64, 3), np.float32),
+    )
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+    rng = np.random.default_rng(5)
+    images = rng.normal(0, 1, (8, 64, 64, 3)).astype(np.float32)
+    ref = jax.jit(lambda v, im: plain.apply(v, im, train=False))(variables, images)
+
+    mesh = make_mesh(8, sequence_parallel=2)
+
+    def fwd(v, im):
+        out = spatial.apply(v, im, train=False)
+        return jax.lax.pmean(out, SEQUENCE_AXIS)
+
+    f = jax.jit(
+        jax.shard_map(
+            fwd,
+            mesh=mesh,
+            in_specs=(P(), P("batch", SEQUENCE_AXIS, None, None)),
+            out_specs=P("batch", None),
+        )
+    )
+    out = f(mesh_lib.replicate(variables, mesh), sp.shard_spatial(images, mesh))
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(out)), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_build_model_rejects_spatial_xception():
+    cfg = ModelConfig(backbone="xception")
+    with pytest.raises(ValueError, match="resnet backbone only"):
+        build_model(cfg, spatial_axis_name=SEQUENCE_AXIS)
