@@ -185,6 +185,17 @@ def run_benchmark(platform: str | None = None) -> dict:
         achieved = flops_per_step / (dt / timed_steps) / n
         result["mfu"] = round(achieved / peak, 4)
         result["model_tflops_per_step"] = round(flops_per_step / 1e12, 3)
+
+    if on_tpu:
+        # Pallas-vs-XLA depthwise decision data at the flagship's ASPP shapes
+        # (VERDICT r1 #5): recorded so use_pallas_depthwise can be flipped on
+        # the evidence. Best-effort — the headline number stands without it.
+        try:
+            from bench_kernels import bench_depthwise
+
+            result["depthwise_kernels"] = bench_depthwise(iters=20, warmup=3)
+        except Exception as e:  # noqa: BLE001
+            result["depthwise_kernels"] = {"error": str(e)[:200]}
     return result
 
 

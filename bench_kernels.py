@@ -1,0 +1,85 @@
+"""Microbenchmark: Pallas depthwise conv vs XLA grouped conv at ASPP shapes.
+
+The Pallas VMEM shift-accumulate kernel (ops/pallas_kernels.py) exists on the
+claim that XLA's grouped-convolution lowering of the depthwise stage is
+VPU-suboptimal. This benchmark decides that claim on real hardware at exactly the
+shapes the flagship runs: the ASPP head's atrous depthwise convs (rates 2/4/8 on
+the [B, 13, 13, 1024] output-stride-8 feature map of a 101x101 input) and the
+decoder's rate-1 conv. ``use_pallas_depthwise`` in the flagship preset should be
+flipped on if and only if the Pallas column wins here.
+
+Run: ``python bench_kernels.py [--platform=cpu]`` — prints one JSON line.
+bench.py embeds the same measurement in its TPU child ("depthwise_kernels").
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Dict
+
+
+def bench_depthwise(
+    batch: int = 32,
+    hw: int = 13,
+    channels: int = 1024,
+    rates=(1, 2, 4, 8),
+    iters: int = 30,
+    warmup: int = 5,
+) -> Dict:
+    import jax
+    import numpy as np
+
+    from tensorflowdistributedlearning_tpu.ops.pallas_kernels import (
+        depthwise_conv2d,
+        depthwise_conv2d_reference,
+    )
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (batch, hw, hw, channels)).astype(np.float32)
+    w = rng.normal(0, 0.3, (3, 3, channels)).astype(np.float32)
+    x, w = jax.device_put(x), jax.device_put(w)
+
+    def timed(fn) -> float:
+        out = fn(x, w)  # compile
+        jax.block_until_ready(out)
+        for _ in range(warmup):
+            out = fn(x, w)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(x, w)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e6  # us
+
+    results: Dict = {}
+    wins = 0
+    for rate in rates:
+        pallas_us = timed(jax.jit(lambda a, b, r=rate: depthwise_conv2d(a, b, r)))
+        xla_us = timed(
+            jax.jit(lambda a, b, r=rate: depthwise_conv2d_reference(a, b, r))
+        )
+        results[f"rate{rate}"] = {
+            "pallas_us": round(pallas_us, 1),
+            "xla_us": round(xla_us, 1),
+            "speedup": round(xla_us / pallas_us, 3),
+        }
+        wins += pallas_us < xla_us
+    results["pallas_wins"] = bool(wins > len(rates) / 2)
+    results["shape"] = [batch, hw, hw, channels]
+    return results
+
+
+def main() -> None:
+    import jax
+
+    if "--platform=cpu" in sys.argv:
+        jax.config.update("jax_platforms", "cpu")
+    out = bench_depthwise()
+    out["platform"] = jax.default_backend()
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -94,6 +94,12 @@ class TrainConfig:
     save_best: int = 5
     checkpoint_every_steps: int = 500
     eval_throttle_secs: int = 300
+    # eval cadence in steps, decoupled from checkpointing and EXEMPT from
+    # eval_throttle_secs (an explicit cadence is explicit user intent; same
+    # semantics in Trainer and fit()). None preserves the reference's
+    # train_and_evaluate shape: eval considered when a periodic checkpoint
+    # lands AND the time throttle passed (reference: model.py:214)
+    eval_every_steps: Optional[int] = None
     # train summaries every N steps / eval summaries every step (reference: model.py:470-481)
     train_log_every_steps: int = 20
 

@@ -60,11 +60,13 @@ def upsample(x: jax.Array, out_hw: Tuple[int, int]) -> jax.Array:
 
 
 class SpatialConv(nn.Module):
-    """``nn.Conv``-parameter-compatible SAME conv whose H dimension is sharded over
+    """``nn.Conv``-parameter-compatible conv whose H dimension is sharded over
     a mesh axis (sequence/context parallelism): halo exchange + phase-exact VALID
     convolution (parallel/spatial.py). Param tree is identical to ``nn.Conv``
-    (``kernel`` [kh, kw, C_in, C_out], optional ``bias`` [C_out]), so checkpoints
-    transfer between sharded and unsharded execution unchanged.
+    (``kernel`` [kh, kw, C_in/groups, C_out], optional ``bias`` [C_out]), so
+    checkpoints transfer between sharded and unsharded execution unchanged.
+    ``feature_group_count=C`` gives the depthwise flavor (Xception separable
+    convs); ``phase='fixed'`` matches slim's fixed_padding+VALID strided convs.
     """
 
     features: int
@@ -73,6 +75,8 @@ class SpatialConv(nn.Module):
     rate: int = 1
     use_bias: bool = True
     axis_name: str = "sequence"
+    feature_group_count: int = 1
+    phase: str = "same"
     kernel_init: Callable = conv_kernel_init
     dtype: Optional[jnp.dtype] = None
 
@@ -82,7 +86,9 @@ class SpatialConv(nn.Module):
 
         k = self.kernel_size
         kernel = self.param(
-            "kernel", self.kernel_init, (k, k, x.shape[-1], self.features)
+            "kernel",
+            self.kernel_init,
+            (k, k, x.shape[-1] // self.feature_group_count, self.features),
         )
         dtype = self.dtype or x.dtype
         out = spatial_conv2d(
@@ -91,6 +97,8 @@ class SpatialConv(nn.Module):
             stride=self.stride,
             rate=self.rate,
             axis_name=self.axis_name,
+            feature_group_count=self.feature_group_count,
+            phase=self.phase,
         )
         if self.use_bias:
             bias = self.param("bias", nn.initializers.zeros, (self.features,))

@@ -501,15 +501,10 @@ def build_model(
     via ``resnet_model(...)`` arguments, model.py:356-370; Xception existed but was dead
     code — here it is a working first-class citizen).
 
-    ``spatial_axis_name`` builds the ResNet family for H-sharded sequence-parallel
+    ``spatial_axis_name`` builds the model for H-sharded sequence-parallel
     execution inside ``shard_map`` (parallel/spatial.py); pair it with
     ``bn_axis_name`` on the same axis so BN statistics span the full spatial
-    extent. Xception does not support spatial sharding yet."""
-    if spatial_axis_name is not None and config.backbone != "resnet":
-        raise ValueError(
-            "spatial (sequence) parallelism is currently implemented for the "
-            f"resnet backbone only, not {config.backbone!r}"
-        )
+    extent. Supported by both backbone families."""
     if config.backbone == "resnet":
         if config.num_classes is None:
             return ResNetSegmentation(
@@ -528,5 +523,9 @@ def build_model(
     )
 
     if config.num_classes is None:
-        return XceptionSegmentation(config, bn_axis_name=bn_axis_name)
-    return Xception41(config, bn_axis_name=bn_axis_name)
+        return XceptionSegmentation(
+            config, bn_axis_name=bn_axis_name, spatial_axis_name=spatial_axis_name
+        )
+    return Xception41(
+        config, bn_axis_name=bn_axis_name, spatial_axis_name=spatial_axis_name
+    )

@@ -50,6 +50,7 @@ class SeparableConvSame(nn.Module):
     bn_epsilon: float = 0.001
     bn_scale: bool = True
     bn_axis_name: Optional[str] = None
+    spatial_axis_name: Optional[str] = None
     dtype: Optional[jnp.dtype] = None
 
     def _bn(self, name: str, x: jax.Array, train: bool) -> jax.Array:
@@ -66,23 +67,42 @@ class SeparableConvSame(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         in_ch = x.shape[-1]
-        if self.stride > 1:
-            x = fixed_padding(x, self.kernel_size, rate=self.rate)
-            padding = "VALID"
+        if self.spatial_axis_name is not None:
+            # H-sharded depthwise: SpatialConv reproduces both padding phases
+            # (SAME for stride 1, fixed_padding+VALID for strides) exactly
+            from tensorflowdistributedlearning_tpu.models.layers import SpatialConv
+
+            x = SpatialConv(
+                in_ch,
+                self.kernel_size,
+                stride=self.stride,
+                rate=self.rate,
+                use_bias=False,
+                axis_name=self.spatial_axis_name,
+                feature_group_count=in_ch,
+                phase="fixed" if self.stride > 1 else "same",
+                kernel_init=nn.initializers.truncated_normal(stddev=0.33),
+                dtype=self.dtype,
+                name="depthwise",
+            )(x)
         else:
-            padding = "SAME"
-        x = nn.Conv(
-            in_ch,
-            (self.kernel_size, self.kernel_size),
-            strides=(self.stride, self.stride),
-            kernel_dilation=(self.rate, self.rate),
-            padding=padding,
-            feature_group_count=in_ch,
-            use_bias=False,
-            kernel_init=nn.initializers.truncated_normal(stddev=0.33),
-            dtype=self.dtype,
-            name="depthwise",
-        )(x)
+            if self.stride > 1:
+                x = fixed_padding(x, self.kernel_size, rate=self.rate)
+                padding = "VALID"
+            else:
+                padding = "SAME"
+            x = nn.Conv(
+                in_ch,
+                (self.kernel_size, self.kernel_size),
+                strides=(self.stride, self.stride),
+                kernel_dilation=(self.rate, self.rate),
+                padding=padding,
+                feature_group_count=in_ch,
+                use_bias=False,
+                kernel_init=nn.initializers.truncated_normal(stddev=0.33),
+                dtype=self.dtype,
+                name="depthwise",
+            )(x)
         x = self._bn("depthwise_bn", x, train)
         if self.activation_inside:
             x = nn.relu(x)
@@ -125,6 +145,7 @@ class XceptionUnit(nn.Module):
     bn_epsilon: float = 0.001
     bn_scale: bool = True
     bn_axis_name: Optional[str] = None
+    spatial_axis_name: Optional[str] = None
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -135,6 +156,7 @@ class XceptionUnit(nn.Module):
             bn_epsilon=self.bn_epsilon,
             bn_scale=self.bn_scale,
             bn_axis_name=self.bn_axis_name,
+            spatial_axis_name=self.spatial_axis_name,
             dtype=self.dtype,
         )
         residual = x
@@ -209,6 +231,7 @@ class XceptionBackbone(nn.Module):
     config: ModelConfig
     multi_grid: Tuple[int, int, int] = (1, 1, 1)
     bn_axis_name: Optional[str] = None
+    spatial_axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> Dict[str, jax.Array]:
@@ -220,6 +243,7 @@ class XceptionBackbone(nn.Module):
             bn_epsilon=cfg.batch_norm_epsilon,
             bn_scale=cfg.batch_norm_scale,
             bn_axis_name=self.bn_axis_name,
+            spatial_axis_name=self.spatial_axis_name,
             dtype=dtype,
         )
         output_stride = cfg.output_stride
@@ -272,6 +296,7 @@ class XceptionSegmentation(nn.Module):
 
     config: ModelConfig
     bn_axis_name: Optional[str] = None
+    spatial_axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -290,12 +315,24 @@ class XceptionSegmentation(nn.Module):
             cfg,
             multi_grid=(1, 2, 1),
             bn_axis_name=self.bn_axis_name,
+            spatial_axis_name=self.spatial_axis_name,
             name="backbone",
         )(x, train)
-        aspp = ASPP(cfg, bn_axis_name=self.bn_axis_name, name="aspp")(
-            end_points["features"], train
-        )
+        features = end_points["features"]
         skip = end_points["entry_block1"]
+        if self.spatial_axis_name is not None:
+            # backbone ran H-sharded; the head's bilinear upsamplings and the
+            # per-image loss need whole maps (same arrangement as the ResNet
+            # flagship, models/resnet.py)
+            from tensorflowdistributedlearning_tpu.parallel.spatial import (
+                spatial_gather,
+            )
+
+            features = spatial_gather(features, axis_name=self.spatial_axis_name)
+            skip = spatial_gather(skip, axis_name=self.spatial_axis_name)
+        aspp = ASPP(cfg, bn_axis_name=self.bn_axis_name, name="aspp")(
+            features, train
+        )
         aspp_up = upsample(aspp, skip.shape[1:3]).astype(dtype)
         decoder = ConvBN(cfg.base_depth, 1, name="decoder_conv_1x1", **common)(
             skip, train
@@ -320,15 +357,28 @@ class Xception41(nn.Module):
     config: ModelConfig
     keep_prob: float = 0.5
     bn_axis_name: Optional[str] = None
+    spatial_axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         cfg = self.config
         backbone_cfg = dataclasses.replace(cfg, output_stride=None)
         end_points = XceptionBackbone(
-            backbone_cfg, bn_axis_name=self.bn_axis_name, name="backbone"
+            backbone_cfg,
+            bn_axis_name=self.bn_axis_name,
+            spatial_axis_name=self.spatial_axis_name,
+            name="backbone",
         )(x, train)
-        pooled = jnp.mean(end_points["features"], axis=(1, 2)).astype(jnp.float32)
+        if self.spatial_axis_name is not None:
+            from tensorflowdistributedlearning_tpu.parallel.spatial import (
+                spatial_global_mean,
+            )
+
+            pooled = spatial_global_mean(
+                end_points["features"], axis_name=self.spatial_axis_name
+            ).astype(jnp.float32)
+        else:
+            pooled = jnp.mean(end_points["features"], axis=(1, 2)).astype(jnp.float32)
         if cfg.num_classes is None:
             return pooled
         pooled = nn.Dropout(rate=1.0 - self.keep_prob, deterministic=not train)(pooled)

@@ -92,19 +92,30 @@ def spatial_conv2d(
     rate: int = 1,
     axis_name: str = SEQUENCE_AXIS,
     feature_group_count: int = 1,
+    phase: str = "same",
 ) -> jax.Array:
-    """2-D (optionally atrous) convolution of an H-sharded NHWC batch, exact vs
-    the unsharded SAME op.
+    """2-D (optionally atrous, optionally grouped) convolution of an H-sharded
+    NHWC batch, exact vs the unsharded op.
 
-    ``x``: local shard [B, H_local, W, C_in]; ``kernel``: [kh, kw, C_in, C_out]
-    (odd kh). H is sharded over ``axis_name``; W is whole on every device. The op
-    halo-exchanges ``rate*(kh-1)/2`` rows, then convolves VALID along H / SAME
-    along W. With ``stride`` > 1, every shard's H_local must be divisible by the
-    stride so shard boundaries stay aligned with the global stride phase. When the
-    halo exceeds the local extent (deep atrous stages on small maps), it falls
-    back to an all-gather of H — exact, costlier in ICI bandwidth, and only hit
-    where the maps are smallest.
+    ``x``: local shard [B, H_local, W, C_in]; ``kernel``: [kh, kw, C_in/groups,
+    C_out] (odd kh). H is sharded over ``axis_name``; W is whole on every device.
+    The op halo-exchanges ``rate*(kh-1)/2`` rows, then convolves VALID along H
+    with the padding phase of the reference op:
+
+    - ``phase='same'``: XLA's SAME — total pad ``max(ek - stride, 0)``,
+      floor-split low/high;
+    - ``phase='fixed'``: slim's explicit ``fixed_padding`` + VALID (the Xception
+      strided separable convs, reference: core/xception.py:18-36) — total pad
+      ``ek - 1``, ``(ek-1)//2`` low.
+
+    With ``stride`` > 1, every shard's H_local must be divisible by the stride so
+    shard boundaries stay aligned with the global stride phase. When the halo
+    exceeds the local extent (deep atrous stages on small maps), it falls back to
+    an all-gather of H — exact, costlier in ICI bandwidth, and only hit where the
+    maps are smallest.
     """
+    if phase not in ("same", "fixed"):
+        raise ValueError(f"Unknown padding phase {phase!r}")
     kh, kw = kernel.shape[0], kernel.shape[1]
     if kh % 2 != 1:
         raise ValueError(f"spatial_conv2d requires odd kernel height, got {kh}")
@@ -118,52 +129,66 @@ def spatial_conv2d(
     ekh = kh + (kh - 1) * (rate - 1)
     ekw = kw + (kw - 1) * (rate - 1)
     halo = (ekh - 1) // 2
-    out_rows = h_local // stride
 
-    # W is unsharded: XLA's actual SAME split (low gets the floor)
+    # padding phase along H (sharded) and W (whole)
+    if phase == "same":
+        total_h = max(ekh - stride, 0)
+        total_w_pad = None  # computed from out_cols below
+    else:
+        total_h = ekh - 1
+        total_w_pad = ekw - 1
+    pad_lo = total_h // 2
+
     w = x.shape[2]
-    out_cols = -(-w // stride)
-    total_w = max((out_cols - 1) * stride + ekw - w, 0)
+    if total_w_pad is None:
+        out_cols = -(-w // stride)
+        total_w = max((out_cols - 1) * stride + ekw - w, 0)
+    else:
+        total_w = total_w_pad
     pw_lo = total_w // 2
     pw_hi = total_w - pw_lo
 
+    # rows of global output owned by this shard; identical for both phases when
+    # H_local is stride-aligned (out rows = H_local / stride)
+    out_rows = h_local // stride
+
+    conv_kwargs = dict(
+        window_strides=(stride, stride),
+        rhs_dilation=(rate, rate),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=feature_group_count,
+    )
+
     if halo > h_local:
         # single-hop halo cannot reach beyond the adjacent shard: gather H whole,
-        # run the global SAME conv, keep this shard's output rows
-        n = lax.axis_size(axis_name)
+        # run the global conv, keep this shard's output rows
         idx = lax.axis_index(axis_name)
         full = lax.all_gather(x, axis_name, axis=1, tiled=True)
+        hg = full.shape[1]
+        total_hg = total_h if phase == "fixed" else max(
+            (-(-hg // stride) - 1) * stride + ekh - hg, 0
+        )
         out = lax.conv_general_dilated(
             full,
             kernel,
-            window_strides=(stride, stride),
-            padding="SAME",
-            rhs_dilation=(rate, rate),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=feature_group_count,
+            padding=[(total_hg // 2, total_hg - total_hg // 2), (pw_lo, pw_hi)],
+            **conv_kwargs,
         )
         return lax.dynamic_slice_in_dim(out, idx * out_rows, out_rows, axis=1)
 
     padded = halo_exchange(x, halo, axis_name=axis_name, spatial_axis=1)
-    # Reproduce XLA's SAME padding phase exactly: with global H divisible by the
-    # stride, SAME pads a total of max(ekh - stride, 0) rows, floor-split low/high —
-    # NOT (ekh-1)/2 each side when stride > 1. The first tap of this shard's first
-    # output row therefore sits `pad_lo` rows above the shard start, i.e. at offset
-    # (halo - pad_lo) inside the halo-extended block; VALID conv from there with
-    # the same stride reproduces the global output rows owned by this shard.
-    total_pad = max(ekh - stride, 0)
-    pad_lo = total_pad // 2
+    # The first tap of this shard's first output row sits `pad_lo` rows above the
+    # shard start, i.e. at offset (halo - pad_lo) inside the halo-extended block;
+    # VALID conv from there with the same stride reproduces the global output
+    # rows owned by this shard.
     offset = halo - pad_lo
     window = (out_rows - 1) * stride + ekh
     sliced = lax.slice_in_dim(padded, offset, offset + window, axis=1)
     return lax.conv_general_dilated(
         sliced,
         kernel,
-        window_strides=(stride, stride),
         padding=[(0, 0), (pw_lo, pw_hi)],
-        rhs_dilation=(rate, rate),
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        feature_group_count=feature_group_count,
+        **conv_kwargs,
     )
 
 
@@ -304,3 +329,30 @@ def shard_spatial(x: np.ndarray, mesh: Mesh, *, spatial_axis: int = 1):
 
 def sequence_parallel_degree(mesh: Mesh) -> int:
     return mesh.shape[SEQUENCE_AXIS]
+
+
+def validate_spatial_config(model_config, sequence_parallel: int) -> None:
+    """Fail fast when a model/input combination cannot run H-sharded.
+
+    Every strided stage needs its per-shard H divisible by the stride (shard
+    boundaries must stay aligned with the global stride phase), which holds for
+    the whole network iff the input height is divisible by
+    ``overall_stride * sequence_parallel`` (overall stride = ``output_stride``
+    for the atrous configs, else the full stride-32 trunk). Catching it here
+    gives a clear config-time error instead of a trace-time failure deep inside
+    ``spatial_conv2d`` — e.g. 224x224 classification at sequence_parallel=2
+    reaches H_local=7 at the last strided stage and cannot shard; 256x256 can.
+    """
+    if sequence_parallel <= 1:
+        return
+    overall = model_config.output_stride or 32
+    required = overall * sequence_parallel
+    h = model_config.input_shape[0]
+    if h % required != 0:
+        raise ValueError(
+            f"sequence_parallel={sequence_parallel} requires the input height "
+            f"to be divisible by overall_stride*sequence_parallel = "
+            f"{overall}*{sequence_parallel} = {required}, got {h}. Pad/resize "
+            f"the input (e.g. {-(-h // required) * required}) or lower the "
+            "sequence-parallel degree."
+        )

@@ -81,6 +81,11 @@ class ClassifierTrainer:
         )
         # sequence_parallel > 1: H-sharded backbone (halo-exchange convs,
         # sequence-synced BN) exactly as in the K-fold Trainer
+        from tensorflowdistributedlearning_tpu.parallel.spatial import (
+            validate_spatial_config,
+        )
+
+        validate_spatial_config(model_config, tcfg.sequence_parallel)
         self._spatial = tcfg.sequence_parallel > 1
         axis = mesh_lib.SEQUENCE_AXIS if self._spatial else None
         self.model = build_model(
@@ -154,7 +159,9 @@ class ClassifierTrainer:
         the two was a round-1 weak spot)."""
         tcfg = self.train_config
         mesh_lib.local_batch_size(batch_size, self.mesh)
-        eval_every = eval_every_steps or tcfg.checkpoint_every_steps
+        eval_every = (
+            eval_every_steps or tcfg.eval_every_steps or tcfg.checkpoint_every_steps
+        )
 
         state = self._init_state()
         ckpt = CheckpointManager(

@@ -103,6 +103,11 @@ class Trainer:
         # sequence_parallel > 1: H-sharded backbone with halo-exchange convs and
         # sequence-synced BN (parallel/spatial.py; a TPU-first capability — the
         # reference was data-parallel only, model.py:115-116)
+        from tensorflowdistributedlearning_tpu.parallel.spatial import (
+            validate_spatial_config,
+        )
+
+        validate_spatial_config(self.model_config, tcfg.sequence_parallel)
         self._spatial = tcfg.sequence_parallel > 1
         axis = mesh_lib.SEQUENCE_AXIS if self._spatial else None
         self.model = build_model(
@@ -264,9 +269,17 @@ class Trainer:
                 # one extra inference-mode forward per log interval
                 if jax.process_count() == 1:
                     self._write_image_summaries(tb_train, state, batch, step_no)
-            if ckpt.maybe_save(state, step=step_no) and (
-                time.time() - last_eval_time >= tcfg.eval_throttle_secs
-            ):
+            saved = ckpt.maybe_save(state, step=step_no)
+            # eval cadence: an explicit eval_every_steps knob decouples eval from
+            # checkpointing AND bypasses the time throttle (explicit user intent,
+            # same semantics as fit()); the default preserves the reference's
+            # train_and_evaluate shape — eval when a checkpoint lands and the
+            # >=eval_throttle_secs window passed (reference: model.py:214)
+            if tcfg.eval_every_steps:
+                due = step_no % tcfg.eval_every_steps == 0
+            else:
+                due = saved and time.time() - last_eval_time >= tcfg.eval_throttle_secs
+            if due:
                 last_eval_time = time.time()
                 last_eval_step = step_no
                 final_metrics = self._evaluate(

@@ -142,6 +142,51 @@ def test_fit_synthetic_without_data_dir(tmp_path):
     assert "metrics/top1" in result.final_metrics
 
 
+def test_fit_sequence_parallel_end_to_end(tmp_path):
+    """fit() honors TrainConfig.sequence_parallel: one training step over a
+    (4, 1, 2) mesh with the H-sharded backbone."""
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    trainer = ClassifierTrainer(
+        str(tmp_path),
+        None,
+        ModelConfig(
+            num_classes=N_CLASSES,
+            input_shape=(64, 64),  # divisible by overall_stride(32) x sp(2)
+            input_channels=3,
+            n_blocks=(1, 1, 1),
+            base_depth=16,
+            output_stride=None,
+        ),
+        TrainConfig(seed=0, sequence_parallel=2, checkpoint_every_steps=100),
+    )
+    assert trainer.mesh.shape == {"batch": 4, "model": 1, "sequence": 2}
+    result = trainer.fit(batch_size=8, steps=1)
+    assert result.steps == 1
+    assert "metrics/top1" in result.final_metrics
+
+
+def test_fit_rejects_unshardable_spatial_config(tmp_path):
+    """224x224 stride-32 trunks cannot H-shard at sequence_parallel=2 — the
+    config-time validation catches it (code review r2 finding)."""
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    with pytest.raises(ValueError, match="divisible by overall_stride"):
+        ClassifierTrainer(
+            str(tmp_path),
+            None,
+            ModelConfig(
+                num_classes=10,
+                input_shape=(224, 224),
+                input_channels=3,
+                output_stride=None,
+            ),
+            TrainConfig(sequence_parallel=2),
+        )
+
+
 def test_fit_rejects_segmentation_config(tmp_path):
     from tensorflowdistributedlearning_tpu.config import ModelConfig
     from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer

@@ -271,7 +271,42 @@ def test_spatial_classifier_forward_matches(models_and_state):
     )
 
 
-def test_build_model_rejects_spatial_xception():
-    cfg = ModelConfig(backbone="xception")
-    with pytest.raises(ValueError, match="resnet backbone only"):
-        build_model(cfg, spatial_axis_name=SEQUENCE_AXIS)
+def test_spatial_xception_forward_matches():
+    """Xception spatial support: strided separable convs use the fixed_padding
+    phase; forward parity with the unsharded model on a (4, 1, 2) mesh."""
+    cfg = ModelConfig(
+        backbone="xception", input_shape=(64, 64), base_depth=16
+    )
+    plain = build_model(cfg)
+    spatial = build_model(
+        cfg, bn_axis_name=SEQUENCE_AXIS, spatial_axis_name=SEQUENCE_AXIS
+    )
+    state = create_train_state(
+        plain,
+        step_lib.make_optimizer(TrainConfig()),
+        jax.random.PRNGKey(2),
+        np.zeros((1, 64, 64, 2), np.float32),
+    )
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+    rng = np.random.default_rng(6)
+    images = rng.normal(0, 1, (4, 64, 64, 2)).astype(np.float32)
+    ref = jax.jit(lambda v, im: plain.apply(v, im, train=False))(variables, images)
+
+    mesh = make_mesh(8, sequence_parallel=2)
+
+    def fwd(v, im):
+        out = spatial.apply(v, im, train=False)
+        return jax.lax.pmean(out, SEQUENCE_AXIS)
+
+    f = jax.jit(
+        jax.shard_map(
+            fwd,
+            mesh=mesh,
+            in_specs=(P(), P("batch", SEQUENCE_AXIS, None, None)),
+            out_specs=P("batch", None, None, None),
+        )
+    )
+    out = f(mesh_lib.replicate(variables, mesh), sp.shard_spatial(images, mesh))
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(out)), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )

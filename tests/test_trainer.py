@@ -218,6 +218,33 @@ def test_predict_refuses_untrained_fold(trained):
         trainer.predict(test, batch_size=8, folds=[7])
 
 
+def test_eval_every_steps_decoupled_from_checkpointing(salt_dirs, tmp_path_factory):
+    """TrainConfig.eval_every_steps evaluates on its own cadence even when the
+    checkpoint cadence never fires mid-run (round-1 weak spot: eval was only
+    considered when a periodic checkpoint landed)."""
+    data, _, ids = salt_dirs
+    model_dir = str(tmp_path_factory.mktemp("eval_cadence"))
+    tcfg = TrainConfig(
+        n_folds=2,
+        seed=0,
+        checkpoint_every_steps=100,  # never fires in a 4-step run
+        eval_every_steps=2,
+        eval_throttle_secs=0,
+        train_log_every_steps=2,
+    )
+    trainer = Trainer(
+        model_dir, data, train_config=tcfg,
+        input_shape=SHAPE, n_blocks=(1, 1, 1), base_depth=16,
+    )
+    trainer.train(ids, batch_size=8, steps=4)
+    events = glob.glob(
+        os.path.join(model_dir, "fold0", "eval", "events.out.tfevents.*")
+    )
+    assert events
+    steps = sorted({s for s, _ in read_events(events[0])})
+    assert steps == [2, 4]
+
+
 def test_model_alias():
     assert Model is Trainer
 
