@@ -196,6 +196,55 @@ def run_benchmark(platform: str | None = None) -> dict:
             result["depthwise_kernels"] = bench_depthwise(iters=20, warmup=3)
         except Exception as e:  # noqa: BLE001
             result["depthwise_kernels"] = {"error": str(e)[:200]}
+
+        # Secondary metric: the reference's ACTUAL production workload — the
+        # TGS-salt segmentation flagship (ResNet-v2-beta + DeepLabV3+ head,
+        # 101x101x2, Lovász hinge) at the reference's global batch of 64
+        # (reference: Untitled.ipynb cells 7-8). Best-effort.
+        try:
+            from tensorflowdistributedlearning_tpu.train.step import (
+                SegmentationTask,
+            )
+
+            seg_cfg = ModelConfig()  # reference defaults
+            seg_model = build_model(seg_cfg)
+            seg_state = replicate(
+                create_train_state(
+                    seg_model,
+                    make_optimizer(TrainConfig()),
+                    jax.random.PRNGKey(1),
+                    np.zeros((1, 101, 101, 2), np.float32),
+                ),
+                mesh,
+            )
+            seg_batch = shard_batch(
+                {
+                    "images": rng_np.normal(0, 1, (64 * n, 101, 101, 2)).astype(
+                        np.float32
+                    ),
+                    "labels": (
+                        rng_np.uniform(0, 1, (64 * n, 101, 101, 1)) > 0.5
+                    ).astype(np.float32),
+                },
+                mesh,
+            )
+            seg_step = make_train_step(mesh, SegmentationTask(), donate=False)
+            seg_compiled = seg_step.lower(seg_state, seg_batch).compile()
+            for _ in range(3):
+                seg_state, seg_metrics = seg_compiled(seg_state, seg_batch)
+            sync(seg_metrics)
+            t0 = time.perf_counter()
+            for _ in range(10):
+                seg_state, seg_metrics = seg_compiled(seg_state, seg_batch)
+            sync(seg_metrics)
+            seg_dt = time.perf_counter() - t0
+            result["segmentation_flagship"] = {
+                "images_per_sec_per_chip": round(64 * n * 10 / seg_dt / n, 2),
+                "global_batch": 64 * n,
+                "step_time_ms": round(seg_dt / 10 * 1000, 2),
+            }
+        except Exception as e:  # noqa: BLE001
+            result["segmentation_flagship"] = {"error": str(e)[:200]}
     return result
 
 

@@ -8,7 +8,7 @@ dataset into RAM" (data/pipeline.py InMemoryDataset) is not an option. This modu
 streams instead:
 
 - the file list (not pixel data) is what lives in memory: ``{root}/{split}/{class}/
-  {id}.png``, the standard ImageFolder layout, scanned once;
+  {id}.{png|jpg|jpeg}``, the standard ImageFolder layout, scanned once;
 - each process keeps only its round-robin shard of the file list (the per-host
   generalization of the reference's per-tower input_fn contract, model.py:156-159,
   298-299);
@@ -23,7 +23,6 @@ streams instead:
 from __future__ import annotations
 
 import os
-from glob import glob
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -56,13 +55,24 @@ class ImageFolder:
             )
             if not class_names:
                 raise ValueError(f"No class directories under {root}")
+            exts = {".png", ".jpg", ".jpeg"}
             paths, labels_list = [], []
             for k, name in enumerate(class_names):
-                files = sorted(glob(os.path.join(root, name, "*.png")))
+                class_dir = os.path.join(root, name)
+                # one directory scan with case-normalized extension filtering:
+                # no duplicate matches on case-insensitive filesystems, and
+                # uppercase .JPG/.PNG/.JPEG (camera/ImageNet conventions) count
+                files = sorted(
+                    os.path.join(class_dir, f)
+                    for f in os.listdir(class_dir)
+                    if os.path.splitext(f)[1].lower() in exts
+                )
                 paths.extend(files)
                 labels_list.extend([k] * len(files))
             if not paths:
-                raise ValueError(f"No .png files under {root}/<class>/")
+                raise ValueError(
+                    f"No .png/.jpg/.jpeg files under {root}/<class>/"
+                )
             labels = np.asarray(labels_list, np.int32)
         self.paths = list(paths)
         self.labels = np.asarray(labels, np.int32)
@@ -94,11 +104,12 @@ class ImageFolder:
 
     def decode(self, rows: Sequence[int]) -> np.ndarray:
         """Decode the given rows to [n, H, W, C] float32 in [0, 1] via the native
-        batch decoder (PIL fallback inside)."""
-        from tensorflowdistributedlearning_tpu.native import decode_png_batch
+        batch decoder (PNG/JPEG, any source size, bilinear resize to the target;
+        PIL fallback inside)."""
+        from tensorflowdistributedlearning_tpu.native import decode_image_batch
 
         h, w = self.image_size
-        return decode_png_batch(
+        return decode_image_batch(
             [self.paths[i] for i in rows], h, w, channels=self.channels
         )
 

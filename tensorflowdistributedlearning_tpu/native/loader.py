@@ -33,35 +33,34 @@ _tried = False
 
 def _build() -> bool:
     tmp = f"{_LIB}.{os.getpid()}.tmp"  # pid-unique: parallel builders never collide
-    cmd = [
-        "g++",
-        "-O3",
-        "-shared",
-        "-fPIC",
-        "-std=c++17",
-        "-pthread",
-        _SRC,
-        "-lpng",
-        "-o",
-        tmp,
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", _SRC]
+    # Prefer full PNG+JPEG support; on hosts without libjpeg fall back to a
+    # PNG-only build (TFDL_NO_JPEG) so the native PNG fast path survives —
+    # decode_image_batch then PIL-decodes JPEG files one at a time.
+    variants = [
+        base + ["-lpng", "-ljpeg", "-o", tmp],
+        base + ["-DTFDL_NO_JPEG", "-lpng", "-o", tmp],
     ]
-    try:
-        os.makedirs(_BUILD_DIR, exist_ok=True)
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _LIB)  # atomic install; concurrent winners are identical
-    except (
-        subprocess.CalledProcessError,
-        subprocess.TimeoutExpired,
-        OSError,  # includes read-only package dirs (makedirs/replace)
-    ) as e:
-        detail = getattr(e, "stderr", b"")
-        logger.warning(
-            "native IO build failed (%s); falling back to PIL decode. %s",
-            e,
-            detail.decode()[:500] if detail else "",
-        )
-        return False
-    return True
+    last_err: Exception | None = None
+    for cmd in variants:
+        try:
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, _LIB)  # atomic install; concurrent winners are identical
+            return True
+        except (
+            subprocess.CalledProcessError,
+            subprocess.TimeoutExpired,
+            OSError,  # includes read-only package dirs (makedirs/replace)
+        ) as e:
+            last_err = e
+    detail = getattr(last_err, "stderr", b"")
+    logger.warning(
+        "native IO build failed (%s); falling back to PIL decode. %s",
+        last_err,
+        detail.decode()[:500] if detail else "",
+    )
+    return False
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -80,8 +79,7 @@ def _load() -> Optional[ctypes.CDLL]:
         except OSError as e:
             logger.warning("native IO load failed (%s); using PIL fallback", e)
             return None
-        lib.tfdl_decode_png_batch.restype = ctypes.c_int
-        lib.tfdl_decode_png_batch.argtypes = [
+        batch_sig = [
             ctypes.POINTER(ctypes.c_char_p),
             ctypes.c_int,
             ctypes.POINTER(ctypes.c_float),
@@ -90,6 +88,10 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int,
             ctypes.c_int,
         ]
+        lib.tfdl_decode_png_batch.restype = ctypes.c_int
+        lib.tfdl_decode_png_batch.argtypes = batch_sig
+        lib.tfdl_decode_image_batch.restype = ctypes.c_int
+        lib.tfdl_decode_image_batch.argtypes = batch_sig
         lib.tfdl_version.restype = ctypes.c_char_p
         _lib = lib
         return _lib
@@ -116,6 +118,38 @@ def _decode_pil(paths: Sequence[str], h: int, w: int, channels: int) -> np.ndarr
     return out
 
 
+def _decode_pil_resize(
+    paths: Sequence[str], h: int, w: int, channels: int
+) -> np.ndarray:
+    from PIL import Image
+
+    out = np.empty((len(paths), h, w, channels), np.float32)
+    for i, p in enumerate(paths):
+        with Image.open(p) as im:
+            im = im.convert("L" if channels == 1 else "RGB")
+            if im.size != (w, h):
+                im = im.resize((w, h), Image.BILINEAR)
+            arr = np.asarray(im, np.float32) / 255.0
+        out[i] = arr[:, :, None] if channels == 1 else arr
+    return out
+
+
+def _run_batch(fn, paths, out, h, w, channels, n_threads, what):
+    c_paths = (ctypes.c_char_p * len(paths))(*[os.fsencode(p) for p in paths])
+    rc = fn(
+        c_paths,
+        len(paths),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        h,
+        w,
+        channels,
+        n_threads,
+    )
+    if rc != 0:
+        raise ValueError(f"native {what} decode failed for {paths[rc - 1]!r}")
+    return out
+
+
 def decode_png_batch(
     paths: Sequence[str],
     h: int,
@@ -123,10 +157,11 @@ def decode_png_batch(
     channels: int = 1,
     n_threads: Optional[int] = None,
 ) -> np.ndarray:
-    """Decode ``paths`` into [N, h, w, channels] float32 in [0, 1].
+    """Decode fixed-size PNGs into [N, h, w, channels] float32 in [0, 1].
 
     Uses the native multithreaded decoder when available (GIL-free, one thread per
-    core by default), else PIL.
+    core by default), else PIL. Files must already be h x w — the TGS-salt
+    contract; use ``decode_image_batch`` for variable-size/JPEG sources.
     """
     paths = list(paths)
     if not paths:
@@ -137,18 +172,50 @@ def decode_png_batch(
     if n_threads is None:
         n_threads = min(len(paths), os.cpu_count() or 1)
     out = np.empty((len(paths), h, w, channels), np.float32)
-    c_paths = (ctypes.c_char_p * len(paths))(
-        *[os.fsencode(p) for p in paths]
+    return _run_batch(
+        lib.tfdl_decode_png_batch, paths, out, h, w, channels, n_threads, "PNG"
     )
-    rc = lib.tfdl_decode_png_batch(
-        c_paths,
-        len(paths),
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        h,
-        w,
-        channels,
-        n_threads,
-    )
-    if rc != 0:
-        raise ValueError(f"native PNG decode failed for {paths[rc - 1]!r}")
+
+
+def decode_image_batch(
+    paths: Sequence[str],
+    h: int,
+    w: int,
+    channels: int = 3,
+    n_threads: Optional[int] = None,
+) -> np.ndarray:
+    """Decode PNG/JPEG files of ANY size into [N, h, w, channels] float32 in
+    [0, 1], antialias-bilinearly resized — the ImageNet-class decode path.
+
+    Native multithreaded when available, else PIL. Files the native decoder
+    cannot handle (exotic encodings; JPEGs on a PNG-only build) fall back to PIL
+    ONE AT A TIME instead of failing the batch — real-world datasets always
+    contain a few oddballs."""
+    paths = list(paths)
+    if not paths:
+        return np.empty((0, h, w, channels), np.float32)
+    lib = _load()
+    if lib is None:
+        return _decode_pil_resize(paths, h, w, channels)
+    if n_threads is None:
+        n_threads = min(len(paths), os.cpu_count() or 1)
+    out = np.empty((len(paths), h, w, channels), np.float32)
+    start = 0
+    while start < len(paths):
+        chunk = paths[start:]
+        c_paths = (ctypes.c_char_p * len(chunk))(*[os.fsencode(p) for p in chunk])
+        rc = lib.tfdl_decode_image_batch(
+            c_paths,
+            len(chunk),
+            out[start:].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            h,
+            w,
+            channels,
+            n_threads,
+        )
+        if rc == 0:
+            break
+        bad = start + rc - 1  # absolute index of the first failing file
+        out[bad] = _decode_pil_resize([paths[bad]], h, w, channels)[0]
+        start = bad + 1
     return out

@@ -197,11 +197,19 @@ class ClassifierTrainer:
         step_no = start_step
         last_eval_step = -1
         final_metrics: Dict[str, float] = {}
+        window_t0 = time.perf_counter()
+        window_start = step_no
         for batch in batches:
             state, metrics = train_step(state, batch)
             step_no += 1
             if tb_train is not None and step_no % tcfg.train_log_every_steps == 0:
                 scalars = step_lib.compute_metrics(jax.device_get(metrics))
+                now = time.perf_counter()
+                if step_no > window_start:
+                    scalars["throughput/images_per_sec"] = (
+                        (step_no - window_start) * batch_size / (now - window_t0)
+                    )
+                window_t0, window_start = now, step_no
                 tb_train.scalars(scalars, step_no)
             ckpt.maybe_save(state, step=step_no)
             if step_no % eval_every == 0:

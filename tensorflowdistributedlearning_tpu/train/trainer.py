@@ -256,12 +256,22 @@ class Trainer:
         )
         step_no = start_step
         last_eval_step = -1
+        window_t0 = time.perf_counter()
+        window_start = step_no
         for raw in batches:
             batch = prepare(jnp.asarray(step_no), raw)
             state, metrics = train_step(state, batch)
             step_no += 1
             if tb_train is not None and step_no % tcfg.train_log_every_steps == 0:
                 scalars = step_lib.compute_metrics(jax.device_get(metrics))
+                # wall-clock throughput over the log window (the device_get
+                # above synchronized on this step, so the window is real time)
+                now = time.perf_counter()
+                if step_no > window_start:
+                    scalars["throughput/images_per_sec"] = (
+                        (step_no - window_start) * batch_size / (now - window_t0)
+                    )
+                window_t0, window_start = now, step_no
                 tb_train.scalars(scalars, step_no)
                 # train-phase image grids every train_log_every_steps — the
                 # reference's SummarySaverHook wrote input/label/probability/
