@@ -199,8 +199,10 @@ def run_benchmark(platform: str | None = None) -> dict:
 
         # Secondary metric: the reference's ACTUAL production workload — the
         # TGS-salt segmentation flagship (ResNet-v2-beta + DeepLabV3+ head,
-        # 101x101x2, Lovász hinge) at the reference's global batch of 64
-        # (reference: Untitled.ipynb cells 7-8). Best-effort.
+        # 101x101x2, Lovász hinge) at 64 images PER CHIP — the reference's
+        # whole-run global batch on its 2-GPU setup was 64 (Untitled.ipynb
+        # cells 7-8), i.e. 32/chip; per-chip 64 keeps the per-chip workload
+        # comparable across pod sizes (global batch scales with n).
         try:
             from tensorflowdistributedlearning_tpu.train.step import (
                 SegmentationTask,

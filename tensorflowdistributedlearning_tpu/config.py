@@ -102,6 +102,9 @@ class TrainConfig:
     eval_every_steps: Optional[int] = None
     # train summaries every N steps / eval summaries every step (reference: model.py:470-481)
     train_log_every_steps: int = 20
+    # overlap periodic Orbax saves with subsequent train steps (background
+    # serialization); best exports and resume points still synchronize
+    async_checkpointing: bool = False
 
     def __post_init__(self):
         if self.data_format not in ("NCHW", "NHWC"):

@@ -91,7 +91,7 @@ PRESETS: Dict[str, Preset] = {
     # BASELINE.json "ResNet-50 bfloat16 large-batch (8k) on v5e-64 pod"
     "resnet50_bf16_8k": Preset(
         model=_imagenet_model(n_blocks=(3, 4, 6), remat=True),
-        train=TrainConfig(lr=0.008),  # linear-scaled for the 8x batch
+        train=TrainConfig(lr=0.008, async_checkpointing=True),  # lr linear-scaled for the 8x batch
         global_batch=8192,
         description="ResNet-50 bf16 large-batch (8k) pod config (v5e-64: 128/chip)",
     ),

@@ -288,6 +288,36 @@ def augment_batch(
     return {"images": add_laplace_channel(aug_images), "labels": aug_masks}
 
 
+def augment_classification_batch(
+    key: jax.Array,
+    images: jax.Array,
+    crop_padding: int = 4,
+) -> jax.Array:
+    """Jittable standard classification augmentation: per-image random horizontal
+    flip + reflect-padded random crop (the ImageNet/CIFAR recipe), on device.
+
+    The classification twin of ``augment_batch``: geometry runs as one fused XLA
+    computation on the accelerator, so the host feed never bottlenecks the MXU
+    (the host pipeline only decodes and normalizes)."""
+    b, h, w, _ = images.shape
+    kf, ky, kx = jax.random.split(key, 3)
+    flips = jax.random.bernoulli(kf, 0.5, (b,))
+    images = jnp.where(flips[:, None, None, None], images[:, :, ::-1, :], images)
+    if crop_padding > 0:
+        p = crop_padding
+        padded = jnp.pad(
+            images, ((0, 0), (p, p), (p, p), (0, 0)), mode="reflect"
+        )
+        ys = jax.random.randint(ky, (b,), 0, 2 * p + 1)
+        xs = jax.random.randint(kx, (b,), 0, 2 * p + 1)
+        images = jax.vmap(
+            lambda img, y, x: jax.lax.dynamic_slice(
+                img, (y, x, 0), (h, w, img.shape[-1])
+            )
+        )(padded, ys, xs)
+    return images
+
+
 def prepare_eval_batch(images: jax.Array, masks: jax.Array) -> Dict[str, jax.Array]:
     """Eval-mode preparation: no geometry, just the Laplacian channel (the reference's
     non-augmenting input_fn path, preprocessing/preprocessing.py:243-246)."""

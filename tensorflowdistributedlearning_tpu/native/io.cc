@@ -364,7 +364,14 @@ bool DecodeImageOne(const char* path, float* out, int h, int w, int channels) {
 }
 
 // Shared work-stealing thread harness for both batch entry points: decode each
-// file with `decode_one`, stop at the first failure, report its index.
+// file with `decode_one` and report the MINIMAL failing index.
+//
+// Contract relied on by the Python per-file fallback (loader.decode_image_batch):
+// every index below the returned failure index HAS been decoded. Workers
+// therefore process every index they claim (no early bail-out — a worker that
+// returned after another thread's failure would leave its just-claimed row as
+// uninitialized memory that the fallback would then trust), and failures fold
+// into an atomic minimum rather than first-to-CAS.
 using DecodeFn = bool (*)(const char*, float*, int, int, int);
 
 int DecodeBatch(DecodeFn decode_one, const char** paths, int n, float* out,
@@ -374,16 +381,18 @@ int DecodeBatch(DecodeFn decode_one, const char** paths, int n, float* out,
   if (n_threads > n) n_threads = n;
 
   std::atomic<int> next(0);
-  std::atomic<int> first_error(-1);
+  std::atomic<int> min_error(n);  // n = "no failure yet"
   const int64_t stride = static_cast<int64_t>(h) * w * channels;
 
   auto worker = [&]() {
     for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) {
-      if (first_error.load(std::memory_order_relaxed) >= 0) return;
+      // Skip only indices ABOVE the current minimal failure: they are beyond
+      // the contract's guarantee and will be revisited by the fallback loop.
+      if (i > min_error.load(std::memory_order_relaxed)) continue;
       if (!decode_one(paths[i], out + i * stride, h, w, channels)) {
-        int expected = -1;
-        first_error.compare_exchange_strong(expected, i);
-        return;
+        int cur = min_error.load();
+        while (i < cur && !min_error.compare_exchange_weak(cur, i)) {
+        }
       }
     }
   };
@@ -393,8 +402,8 @@ int DecodeBatch(DecodeFn decode_one, const char** paths, int n, float* out,
   for (int t = 0; t < n_threads; ++t) threads.emplace_back(worker);
   for (auto& t : threads) t.join();
 
-  const int err = first_error.load();
-  return err < 0 ? 0 : 1 + err;
+  const int err = min_error.load();
+  return err >= n ? 0 : 1 + err;
 }
 
 }  // namespace

@@ -54,16 +54,22 @@ class CheckpointManager:
         save_best: int = 5,
         best_metric: str = "metrics/mean_iou",
         greater_is_better: bool = True,
+        async_checkpointing: bool = False,
     ):
         self.directory = os.path.abspath(directory)
         self.save_every_steps = save_every_steps
         self.best_metric = best_metric
+        # async: periodic saves overlap the next train steps (device->host copy
+        # happens synchronously, serialization in a background thread — the knob
+        # the large-batch pod configs want); best exports stay synchronous since
+        # they follow an eval anyway.
+        self._async = async_checkpointing
         self._ckpt = ocp.CheckpointManager(
             os.path.join(self.directory, "checkpoints"),
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 save_interval_steps=1,  # cadence enforced by maybe_save
-                enable_async_checkpointing=False,
+                enable_async_checkpointing=async_checkpointing,
             ),
         )
         self._best = ocp.CheckpointManager(
@@ -87,7 +93,8 @@ class CheckpointManager:
         saved = self._ckpt.save(
             step, args=ocp.args.StandardSave(_state_pytree(state)), force=force
         )
-        self._ckpt.wait_until_finished()
+        if not self._async:
+            self._ckpt.wait_until_finished()
         return saved
 
     def maybe_save(self, state: TrainState, step: Optional[int] = None) -> bool:
@@ -110,6 +117,7 @@ class CheckpointManager:
         """Estimator-style auto-resume: if a checkpoint exists, restore it into the
         template's shardings; else return the template unchanged (reference: implicit
         in per-fold Estimator construction, model.py:164-167)."""
+        self._ckpt.wait_until_finished()  # async saves must land before reading
         step = self._ckpt.latest_step()
         if step is None:
             return template

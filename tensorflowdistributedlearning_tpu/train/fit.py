@@ -137,11 +137,15 @@ class ClassifierTrainer:
                 channels=cfg.input_channels,
                 num_classes=cfg.num_classes,
             )
+        # augment=False: geometry (flip + padded random crop) runs ON DEVICE in
+        # the jitted prepare step (augment_classification_batch) — the host only
+        # decodes and normalizes, mirroring the segmentation trainer's split
         return imagefolder.train_batches(
             train_split.host_shard(),
             local_bs,
             seed=tcfg.seed + jax.process_index(),
             steps=steps,
+            augment=False,
         )
 
     # -- training ---------------------------------------------------------
@@ -169,6 +173,7 @@ class ClassifierTrainer:
             save_every_steps=tcfg.checkpoint_every_steps,
             save_best=tcfg.save_best,
             best_metric="metrics/top1",
+            async_checkpointing=tcfg.async_checkpointing,
         )
         state = ckpt.restore_latest(state)
         start_step = int(jax.device_get(state.step))
@@ -197,21 +202,27 @@ class ClassifierTrainer:
         step_no = start_step
         last_eval_step = -1
         final_metrics: Dict[str, float] = {}
+        prepare = self._make_prepare_train()
         window_t0 = time.perf_counter()
         window_start = step_no
-        for batch in batches:
+        # first window contains the compile; eval/save windows are not training
+        # time either — dirty windows skip their throughput point
+        window_dirty = True
+        for raw in batches:
+            batch = prepare(jax.numpy.asarray(step_no), raw)
             state, metrics = train_step(state, batch)
             step_no += 1
             if tb_train is not None and step_no % tcfg.train_log_every_steps == 0:
                 scalars = step_lib.compute_metrics(jax.device_get(metrics))
                 now = time.perf_counter()
-                if step_no > window_start:
+                if not window_dirty and step_no > window_start:
                     scalars["throughput/images_per_sec"] = (
                         (step_no - window_start) * batch_size / (now - window_t0)
                     )
-                window_t0, window_start = now, step_no
+                window_t0, window_start, window_dirty = now, step_no, False
                 tb_train.scalars(scalars, step_no)
-            ckpt.maybe_save(state, step=step_no)
+            if ckpt.maybe_save(state, step=step_no):
+                window_dirty = True
             if step_no % eval_every == 0:
                 last_eval_step = step_no
                 final_metrics = self._evaluate(state, batch_size)
@@ -219,6 +230,7 @@ class ClassifierTrainer:
                     tb_eval.scalars(final_metrics, step_no)
                     tb_eval.flush()
                 ckpt.export_best(state, final_metrics)
+                window_dirty = True
         ckpt.save(state, force=True)
         if last_eval_step != step_no:
             final_metrics = self._evaluate(state, batch_size)
@@ -232,6 +244,26 @@ class ClassifierTrainer:
             tb_eval.close()
         ckpt.close()
         return FitResult(final_metrics, self.params, step_no)
+
+    def _make_prepare_train(self):
+        """Jitted on-device classification augmentation keyed by (seed, step) —
+        random horizontal flip + reflect-padded random crop
+        (data/augment.py:augment_classification_batch)."""
+        from tensorflowdistributedlearning_tpu.data import augment as augment_lib
+
+        tcfg = self.train_config
+
+        @jax.jit
+        def prepare(step: jax.Array, batch):
+            key = jax.random.fold_in(jax.random.PRNGKey(tcfg.seed), step)
+            return {
+                "images": augment_lib.augment_classification_batch(
+                    key, batch["images"]
+                ),
+                "labels": batch["labels"],
+            }
+
+        return prepare
 
     def _init_state(self) -> TrainState:
         cfg, tcfg = self.model_config, self.train_config

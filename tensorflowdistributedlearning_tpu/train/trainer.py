@@ -163,6 +163,7 @@ class Trainer:
             self._fold_dir(fold),
             save_every_steps=tcfg.checkpoint_every_steps,
             save_best=tcfg.save_best,
+            async_checkpointing=tcfg.async_checkpointing,
         )
 
     # -- training ---------------------------------------------------------
@@ -258,6 +259,10 @@ class Trainer:
         last_eval_step = -1
         window_t0 = time.perf_counter()
         window_start = step_no
+        # the first window contains the train-step compile; windows containing
+        # an eval pass or a synchronous checkpoint save are likewise not
+        # training time — mark them dirty and skip their throughput point
+        window_dirty = True
         for raw in batches:
             batch = prepare(jnp.asarray(step_no), raw)
             state, metrics = train_step(state, batch)
@@ -267,11 +272,11 @@ class Trainer:
                 # wall-clock throughput over the log window (the device_get
                 # above synchronized on this step, so the window is real time)
                 now = time.perf_counter()
-                if step_no > window_start:
+                if not window_dirty and step_no > window_start:
                     scalars["throughput/images_per_sec"] = (
                         (step_no - window_start) * batch_size / (now - window_t0)
                     )
-                window_t0, window_start = now, step_no
+                window_t0, window_start, window_dirty = now, step_no, False
                 tb_train.scalars(scalars, step_no)
                 # train-phase image grids every train_log_every_steps — the
                 # reference's SummarySaverHook wrote input/label/probability/
@@ -280,6 +285,8 @@ class Trainer:
                 if jax.process_count() == 1:
                     self._write_image_summaries(tb_train, state, batch, step_no)
             saved = ckpt.maybe_save(state, step=step_no)
+            if saved:
+                window_dirty = True
             # eval cadence: an explicit eval_every_steps knob decouples eval from
             # checkpointing AND bypasses the time throttle (explicit user intent,
             # same semantics as fit()); the default preserves the reference's
@@ -297,6 +304,7 @@ class Trainer:
                     global_n=eval_global_n,
                 )
                 ckpt.export_best(state, final_metrics)
+                window_dirty = True
         # end of training: final checkpoint + eval + export (train_and_evaluate's
         # final-eval contract) — skipped when the last loop iteration already
         # checkpointed and evaluated at this exact step

@@ -82,3 +82,32 @@ def test_restore_best_falls_back_to_latest(state, tmp_path):
     restored = ckpt.restore_best(state)  # no best export yet
     assert int(restored.step) == 1
     ckpt.close()
+
+
+def test_async_checkpointing_roundtrip(tmp_path):
+    """async_checkpointing=True: saves overlap training, and restore_latest
+    waits for in-flight saves before reading."""
+    import jax
+    import numpy as np
+
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.models import build_model
+    from tensorflowdistributedlearning_tpu.train import step as step_lib
+    from tensorflowdistributedlearning_tpu.train.checkpoint import CheckpointManager
+    from tensorflowdistributedlearning_tpu.train.state import create_train_state
+
+    cfg = ModelConfig(input_shape=(16, 16), n_blocks=(1, 1, 1), base_depth=8)
+    model = build_model(cfg)
+    state = create_train_state(
+        model,
+        step_lib.make_optimizer(TrainConfig()),
+        jax.random.PRNGKey(0),
+        np.zeros((1, 16, 16, 2), np.float32),
+    )
+    ckpt = CheckpointManager(
+        str(tmp_path), save_every_steps=1, async_checkpointing=True
+    )
+    assert ckpt.save(state, force=True)
+    restored = ckpt.restore_latest(state.replace(step=state.step + 99))
+    assert int(jax.device_get(restored.step)) == int(jax.device_get(state.step))
+    ckpt.close()
