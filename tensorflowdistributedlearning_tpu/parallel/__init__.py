@@ -24,6 +24,11 @@ from tensorflowdistributedlearning_tpu.parallel.spatial import (
     ring_all_gather,
     spatial_conv2d,
 )
+from tensorflowdistributedlearning_tpu.parallel.tensor import (
+    make_train_step_gspmd,
+    shard_state_tensor_parallel,
+    tensor_parallel_specs,
+)
 from tensorflowdistributedlearning_tpu.parallel.multihost import (
     global_shard_batch,
     initialize as initialize_multihost,
@@ -36,6 +41,9 @@ __all__ = [
     "ring_all_gather",
     "spatial_conv2d",
     "global_shard_batch",
+    "make_train_step_gspmd",
+    "shard_state_tensor_parallel",
+    "tensor_parallel_specs",
     "initialize_multihost",
     "process_info",
     "vma_of",
