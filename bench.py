@@ -164,6 +164,11 @@ def run_benchmark(platform: str | None = None) -> dict:
         "global_batch": global_batch,
         "step_time_ms": round(dt / timed_steps * 1000, 2),
     }
+    # The headline number exists NOW — print it immediately so that even if the
+    # optional extras below (MFU, kernel microbench, segmentation bench) push a
+    # slow backend past the supervisor's timeout, the killed child still leaves
+    # a parseable measurement on stdout (the supervisor reads partial output).
+    print(json.dumps(result), flush=True)
 
     # MFU: XLA's own FLOP count for the compiled step vs chip peak. cost_analysis
     # is best-effort across backends — fall back to the analytic ResNet-50 figure
@@ -262,18 +267,41 @@ def _run_child(platform: str, timeout: int) -> dict | None:
             timeout=timeout,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # the child prints its headline line as soon as it is measured; a child
+        # killed during the optional extras still yielded a usable number
+        partial = e.stdout
+        if isinstance(partial, bytes):
+            partial = partial.decode(errors="replace")
+        for line in reversed((partial or "").strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    parsed = json.loads(line)
+                    parsed["partial"] = True
+                    return parsed
+                except json.JSONDecodeError:
+                    continue
         return {"__error__": f"{platform} child timed out after {timeout}s"}
-    if proc.returncode != 0:
-        tail = (proc.stderr or proc.stdout or "").strip()[-400:]
-        return {"__error__": f"{platform} child rc={proc.returncode}: {tail}"}
-    for line in reversed(proc.stdout.strip().splitlines()):
+    parsed = None
+    for line in reversed((proc.stdout or "").strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
-                return json.loads(line)
+                parsed = json.loads(line)
+                break
             except json.JSONDecodeError:
                 continue
+    if proc.returncode != 0:
+        # a child killed mid-extras (OOM, libtpu abort) may still have printed
+        # its headline line — salvage it rather than burning more attempts
+        if parsed is not None:
+            parsed["partial"] = True
+            return parsed
+        tail = (proc.stderr or proc.stdout or "").strip()[-400:]
+        return {"__error__": f"{platform} child rc={proc.returncode}: {tail}"}
+    if parsed is not None:
+        return parsed
     return {"__error__": f"{platform} child produced no JSON line"}
 
 

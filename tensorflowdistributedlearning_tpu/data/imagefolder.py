@@ -149,17 +149,17 @@ def train_batches(
     batch_size: int,
     seed: int,
     steps: Optional[int] = None,
-    augment: bool = False,
+    augment: bool = True,
     crop_padding: int = 4,
 ) -> Iterator[Dict[str, np.ndarray]]:
     """Infinite (or ``steps``-bounded) shuffled {'images','labels'} stream, decoded
     per batch. Epoch permutations chain like data.pipeline.train_batches.
 
-    ``augment=True`` applies the HOST-SIDE numpy flip/crop — a fallback for
-    library users feeding non-jax consumers. The production path (train/fit.py)
-    keeps this off and runs the same recipe ON DEVICE
-    (data/augment.py:augment_classification_batch); change the recipe in both
-    places or not at all."""
+    ``augment=True`` (the default — library users get an augmented stream out of
+    the box) applies the HOST-SIDE numpy flip/crop. The production path
+    (train/fit.py) passes ``augment=False`` and runs the same recipe ON DEVICE
+    instead (data/augment.py:augment_classification_batch); change the recipe in
+    both places or not at all."""
     n = len(dataset)
     if n == 0:
         raise ValueError("Empty dataset")

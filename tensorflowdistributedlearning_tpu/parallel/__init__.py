@@ -27,6 +27,7 @@ from tensorflowdistributedlearning_tpu.parallel.spatial import (
 from tensorflowdistributedlearning_tpu.parallel.tensor import (
     make_train_step_gspmd,
     shard_state_tensor_parallel,
+    shard_state_weight_update,
     tensor_parallel_specs,
 )
 from tensorflowdistributedlearning_tpu.parallel.multihost import (
@@ -43,6 +44,7 @@ __all__ = [
     "global_shard_batch",
     "make_train_step_gspmd",
     "shard_state_tensor_parallel",
+    "shard_state_weight_update",
     "tensor_parallel_specs",
     "initialize_multihost",
     "process_info",

@@ -44,27 +44,32 @@ from tensorflowdistributedlearning_tpu.parallel.mesh import (
 )
 
 
-def _spec_for_leaf(path: Tuple, leaf, tp: int) -> P:
-    """Sharding spec for one param/stat leaf under model-axis degree ``tp``."""
+def _spec_for_leaf(leaf, axes: Tuple[Tuple[str, int], ...]) -> P:
+    """Shard a leaf's trailing (output-channel/feature) dimension over the given
+    (axis_name, degree) mesh axes — the single eligibility rule every sharding
+    path here uses. Axes with degree 1 are dropped; if the trailing dim does not
+    divide by the combined degree, axes are dropped from the right until it
+    does (so TP+ZeRO degrades to TP-only, then to replicated)."""
     shape = jnp.shape(leaf)
-    if not shape or tp == 1:
-        return P()
-    # the trailing dimension is the output-channel/feature axis in every
-    # kernel, bias, scale, offset, mean and var this model family produces
-    if shape[-1] % tp != 0:
-        return P()  # unshardable width (e.g. the 1-channel segmentation head)
-    spec: list = [None] * len(shape)
-    spec[-1] = MODEL_AXIS
-    return P(*spec)
+    usable = [(a, d) for a, d in axes if d > 1]
+    while usable:
+        total = 1
+        for _, d in usable:
+            total *= d
+        if shape and shape[-1] % total == 0:
+            spec: list = [None] * len(shape)
+            names = tuple(a for a, _ in usable)
+            spec[-1] = names if len(names) > 1 else names[0]
+            return P(*spec)
+        usable = usable[:-1]
+    return P()
 
 
 def tensor_parallel_specs(tree: Any, mesh: Mesh) -> Any:
     """PartitionSpec pytree sharding every eligible leaf's trailing (channel)
     dimension over the ``model`` mesh axis."""
-    tp = mesh.shape[MODEL_AXIS]
-    return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: _spec_for_leaf(path, leaf, tp), tree
-    )
+    axes = ((MODEL_AXIS, mesh.shape[MODEL_AXIS]),)
+    return jax.tree.map(lambda leaf: _spec_for_leaf(leaf, axes), tree)
 
 
 def shard_state_tensor_parallel(state, mesh: Mesh):
@@ -90,6 +95,36 @@ def shard_state_tensor_parallel(state, mesh: Mesh):
         params=place_tree(state.params),
         batch_stats=place_tree(state.batch_stats),
         opt_state=place_tree(state.opt_state),
+    )
+
+
+def shard_state_weight_update(state, mesh: Mesh):
+    """Cross-replica weight-update (ZeRO-style optimizer-state) sharding: the
+    Adam moments additionally shard their channel dimension over the ``batch``
+    axis, so each data-parallel replica stores and updates only 1/dp of the
+    optimizer state — GSPMD inserts the reduce-scatter before and all-gather
+    after the update (the technique of "Automatic Cross-Replica Sharding of
+    Weight Update in Data-Parallel Training", arXiv:2004.13336, which XLA
+    implements natively on TPU). Composes with tensor parallelism: params and
+    batch stats keep their model-axis sharding, and moments shard over
+    (model, batch) together where the width divides; numerics are identical to
+    the replicated update."""
+    tp_axes = ((MODEL_AXIS, mesh.shape[MODEL_AXIS]),)
+    zero_axes = tp_axes + ((BATCH_AXIS, mesh.shape[BATCH_AXIS]),)
+
+    def place(tree, axes):
+        return jax.tree.map(
+            lambda x: jax.device_put(
+                x, NamedSharding(mesh, _spec_for_leaf(x, axes))
+            ),
+            tree,
+        )
+
+    return state.replace(
+        step=jax.device_put(state.step, NamedSharding(mesh, P())),
+        params=place(state.params, tp_axes),
+        batch_stats=place(state.batch_stats, tp_axes),
+        opt_state=place(state.opt_state, zero_axes),
     )
 
 

@@ -6,6 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from contextlib import nullcontext
 from jax.sharding import PartitionSpec as P
 
 from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
@@ -90,6 +91,42 @@ def test_gspmd_train_step_runs_and_keeps_sharding(tp_mesh, state):
     assert MODEL_AXIS in tuple(leaf.sharding.spec), leaf.sharding.spec
 
 
+def test_weight_update_sharding_zero_style(state):
+    """ZeRO-style optimizer sharding over the DATA axis (arXiv:2004.13336):
+    moments shard 1/dp per replica, params stay replicated, and one training
+    step matches the fully-replicated update bitwise-closely."""
+    from tensorflowdistributedlearning_tpu.parallel.mesh import BATCH_AXIS
+
+    mesh = make_mesh(8)  # (8, 1, 1) pure DP
+    placed = tp_lib.shard_state_weight_update(state, mesh)
+    adam_mu = placed.opt_state[0].mu
+    mu_leaf = adam_mu["backbone"]["conv1_3"]["conv"]["kernel"]
+    assert BATCH_AXIS in tuple(mu_leaf.sharding.spec)
+    assert {s.data.shape for s in mu_leaf.addressable_shards} == {(3, 3, 64, 16)}
+    # params replicated
+    assert placed.params["backbone"]["conv1_3"]["conv"]["kernel"].sharding.spec == P()
+
+    batch = synthetic_classification_batch(
+        np.random.default_rng(3), 8, input_shape=(16, 16), channels=3, num_classes=8
+    )
+    step = tp_lib.make_train_step_gspmd(
+        mesh, step_lib.ClassificationTask(), donate=False
+    )
+    new_zero, m_zero = step(placed, tp_lib.place_batch_gspmd(batch, mesh))
+
+    replicated = tp_lib.shard_state_tensor_parallel(state, mesh)  # tp=1 ⇒ replicated
+    new_rep, m_rep = step(replicated, tp_lib.place_batch_gspmd(batch, mesh))
+    v_zero = step_lib.compute_metrics(jax.device_get(m_zero))
+    v_rep = step_lib.compute_metrics(jax.device_get(m_rep))
+    assert v_zero["loss"] == pytest.approx(v_rep["loss"], rel=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(new_zero.params["backbone"]["conv1_3"]["conv"]["kernel"])),
+        np.asarray(jax.device_get(new_rep.params["backbone"]["conv1_3"]["conv"]["kernel"])),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
 def test_gspmd_forward_matches_unsharded(tp_mesh, state):
     """Eval-mode logits with model-axis-sharded params match the single-device
     forward (GSPMD inserts the collectives; numerics agree to reduction-order
@@ -102,7 +139,12 @@ def test_gspmd_forward_matches_unsharded(tp_mesh, state):
 
     placed = tp_lib.shard_state_tensor_parallel(state, tp_mesh)
     sharded_vars = {"params": placed.params, "batch_stats": placed.batch_stats}
-    with jax.sharding.use_mesh(tp_mesh) if hasattr(jax.sharding, "use_mesh") else _nullcontext():
+    ctx = (
+        jax.sharding.use_mesh(tp_mesh)
+        if hasattr(jax.sharding, "use_mesh")
+        else nullcontext()
+    )
+    with ctx:
         out = jax.jit(lambda v, im: model.apply(v, im, train=False))(
             sharded_vars,
             tp_lib.place_batch_gspmd({"images": images}, tp_mesh)["images"],
@@ -110,11 +152,3 @@ def test_gspmd_forward_matches_unsharded(tp_mesh, state):
     np.testing.assert_allclose(
         np.asarray(jax.device_get(out)), np.asarray(ref), rtol=2e-4, atol=2e-4
     )
-
-
-class _nullcontext:
-    def __enter__(self):
-        return None
-
-    def __exit__(self, *a):
-        return False
