@@ -24,6 +24,11 @@ from tensorflowdistributedlearning_tpu.parallel.spatial import (
     ring_all_gather,
     spatial_conv2d,
 )
+from tensorflowdistributedlearning_tpu.parallel.pipeline import (
+    make_pipeline_fn,
+    pipeline_apply,
+    stack_stage_params,
+)
 from tensorflowdistributedlearning_tpu.parallel.tensor import (
     make_train_step_gspmd,
     shard_state_tensor_parallel,
@@ -42,7 +47,10 @@ __all__ = [
     "ring_all_gather",
     "spatial_conv2d",
     "global_shard_batch",
+    "make_pipeline_fn",
     "make_train_step_gspmd",
+    "pipeline_apply",
+    "stack_stage_params",
     "shard_state_tensor_parallel",
     "shard_state_weight_update",
     "tensor_parallel_specs",

@@ -13,3 +13,33 @@ def eight_devices():
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+def make_salt_dataset(root, n_images=16, n_test=6, shape=(32, 32), seed=0):
+    """Write a tiny TGS-salt-layout dataset: ``{root}/data/images+masks`` and
+    ``{root}/test/images`` (uint8 PNGs; every third mask empty — the
+    stratification edge case). Shared by the trainer end-to-end suites."""
+    import os
+
+    from PIL import Image
+
+    root = str(root)
+    data, test = os.path.join(root, "data"), os.path.join(root, "test")
+    os.makedirs(os.path.join(data, "images"), exist_ok=True)
+    os.makedirs(os.path.join(data, "masks"), exist_ok=True)
+    os.makedirs(os.path.join(test, "images"), exist_ok=True)
+    rng = np.random.default_rng(seed)
+    ids = [f"im{i:02d}" for i in range(n_images)]
+    for i, id_ in enumerate(ids):
+        img = rng.uniform(0, 255, shape).astype(np.uint8)
+        Image.fromarray(img).save(os.path.join(data, "images", f"{id_}.png"))
+        mask = (
+            np.zeros(shape)
+            if i % 3 == 0
+            else (rng.uniform(0, 1, shape) > 0.5) * 255
+        ).astype(np.uint8)
+        Image.fromarray(mask).save(os.path.join(data, "masks", f"{id_}.png"))
+    for i in range(n_test):
+        img = rng.uniform(0, 255, shape).astype(np.uint8)
+        Image.fromarray(img).save(os.path.join(test, "images", f"t{i}.png"))
+    return data, test, ids

@@ -1,0 +1,94 @@
+"""GPipe-style pipeline parallelism (parallel/pipeline.py): exactness of the
+scan+ppermute schedule against sequential stage application, gradient parity
+through the pipelined computation, and microbatch-count flexibility."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.parallel import pipeline as pp
+from tensorflowdistributedlearning_tpu.parallel.mesh import make_mesh
+
+K = 4  # pipeline stages (model-axis size of the (2, 4, 1) mesh)
+
+
+def stage_fn(params, x):
+    """One homogeneous stage: 3x3 same-width conv + bias + relu."""
+    y = jax.lax.conv_general_dilated(
+        x, params["w"], (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+    return jax.nn.relu(y + params["b"])
+
+
+@pytest.fixture(scope="module")
+def setup():
+    mesh = make_mesh(8, model_parallel=K)  # (batch=2, model=4, sequence=1)
+    rng = np.random.default_rng(0)
+    stages = [
+        {
+            "w": rng.normal(0, 0.3, (3, 3, 4, 4)).astype(np.float32),
+            "b": rng.normal(0, 0.1, (4,)).astype(np.float32),
+        }
+        for _ in range(K)
+    ]
+    stacked = pp.stack_stage_params([jax.tree.map(jnp.asarray, s) for s in stages])
+    x = rng.normal(0, 1, (6, 2, 8, 8, 4)).astype(np.float32)  # [M=6, mb=2, ...]
+    return mesh, stages, stacked, x
+
+
+def _sequential(stages, x_micro):
+    out = []
+    for m in range(x_micro.shape[0]):
+        h = x_micro[m]
+        for s in stages:
+            h = stage_fn(s, h)
+        out.append(h)
+    return np.stack(out)
+
+
+def test_pipeline_matches_sequential(setup):
+    mesh, stages, stacked, x = setup
+    run = pp.make_pipeline_fn(stage_fn, mesh)
+    out = np.asarray(jax.device_get(run(stacked, x)))
+    ref = _sequential(stages, x)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_single_microbatch(setup):
+    mesh, stages, stacked, x = setup
+    run = pp.make_pipeline_fn(stage_fn, mesh)
+    out = np.asarray(jax.device_get(run(stacked, x[:1])))
+    np.testing.assert_allclose(out, _sequential(stages, x[:1]), rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_gradients_match_sequential(setup):
+    """Reverse-mode autodiff through the scan+ppermute schedule: the compiler-
+    derived backward pipeline produces the same parameter gradients as the
+    sequential composition."""
+    mesh, stages, stacked, x = setup
+    run = pp.make_pipeline_fn(stage_fn, mesh)
+
+    def loss_pipelined(params):
+        return jnp.sum(run(params, x) ** 2)
+
+    def loss_sequential(params_list):
+        total = 0.0
+        for m in range(x.shape[0]):
+            h = jnp.asarray(x[m])
+            for k in range(K):
+                h = stage_fn(jax.tree.map(lambda p: p[k], params_list), h)
+            total = total + jnp.sum(h**2)
+        return total
+
+    g_pipe = jax.grad(loss_pipelined)(stacked)
+    g_seq = jax.grad(loss_sequential)(stacked)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(g_pipe):
+        ref = dict(jax.tree_util.tree_leaves_with_path(g_seq))[path]
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(leaf)),
+            np.asarray(jax.device_get(ref)),
+            rtol=2e-4,
+            atol=2e-4,
+            err_msg=str(path),
+        )

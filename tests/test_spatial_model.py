@@ -271,6 +271,43 @@ def test_spatial_classifier_forward_matches(models_and_state):
     )
 
 
+def test_trainer_end_to_end_with_sequence_parallel(tmp_path):
+    """The full K-fold Trainer on a (4, 1, 2) mesh: training, eval, best export,
+    TTA predict — every phase running the H-sharded spatial path (32x32 inputs
+    divide overall_stride(8) x sp(2))."""
+    from tests.conftest import make_salt_dataset
+
+    from tensorflowdistributedlearning_tpu.train.trainer import Trainer
+
+    data, test, ids = make_salt_dataset(
+        tmp_path, n_images=12, n_test=4, shape=(32, 32)
+    )
+
+    trainer = Trainer(
+        str(tmp_path / "model"),
+        str(data),
+        train_config=TrainConfig(
+            n_folds=2,
+            seed=0,
+            sequence_parallel=2,
+            checkpoint_every_steps=2,
+            eval_throttle_secs=0,
+            train_log_every_steps=2,
+        ),
+        input_shape=(32, 32),
+        n_blocks=(1, 1, 1),
+        base_depth=16,
+    )
+    assert trainer.mesh.shape == {"batch": 4, "model": 1, "sequence": 2}
+    results = trainer.train(ids, batch_size=8, steps=2)
+    assert len(results) == 2
+    assert all(np.isfinite(r["loss"]) for r in results)
+
+    pred = trainer.predict(str(test), batch_size=8, tta=True)
+    assert pred["probabilities"].shape == (4, 32, 32, 1)
+    assert np.all((pred["probabilities"] >= 0) & (pred["probabilities"] <= 1))
+
+
 def test_spatial_xception_forward_matches():
     """Xception spatial support: strided separable convs use the fixed_padding
     phase; forward parity with the unsharded model on a (4, 1, 2) mesh."""

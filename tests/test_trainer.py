@@ -20,26 +20,11 @@ SHAPE = (32, 32)
 @pytest.fixture(scope="module")
 def salt_dirs(tmp_path_factory):
     """Tiny TGS-salt-layout dataset: {data}/images+masks, {test}/images."""
-    root = tmp_path_factory.mktemp("salt")
-    data, test = str(root / "data"), str(root / "test")
-    os.makedirs(os.path.join(data, "images"))
-    os.makedirs(os.path.join(data, "masks"))
-    os.makedirs(os.path.join(test, "images"))
-    rng = np.random.default_rng(0)
-    ids = [f"im{i:02d}" for i in range(N_IMAGES)]
-    for i, id_ in enumerate(ids):
-        img = rng.uniform(0, 255, SHAPE).astype(np.uint8)
-        Image.fromarray(img).save(os.path.join(data, "images", f"{id_}.png"))
-        mask = (
-            np.zeros(SHAPE)
-            if i % 3 == 0
-            else (rng.uniform(0, 1, SHAPE) > 0.5) * 255
-        ).astype(np.uint8)
-        Image.fromarray(mask).save(os.path.join(data, "masks", f"{id_}.png"))
-    for i in range(6):
-        img = rng.uniform(0, 255, SHAPE).astype(np.uint8)
-        Image.fromarray(img).save(os.path.join(test, "images", f"t{i}.png"))
-    return data, test, ids
+    from tests.conftest import make_salt_dataset
+
+    return make_salt_dataset(
+        tmp_path_factory.mktemp("salt"), n_images=N_IMAGES, shape=SHAPE
+    )
 
 
 @pytest.fixture(scope="module")
