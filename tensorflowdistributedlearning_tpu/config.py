@@ -78,9 +78,15 @@ class TrainConfig:
     # distinction the reference hand-managed (model.py:344-351) does not exist.
     data_format: str = "NHWC"
     lr: float = 0.001
-    # lr halves every `lr_decay_steps` steps (reference: model.py:457-459)
+    # "exponential" reproduces the reference's continuous decay (model.py:457-459);
+    # "cosine" is the standard ImageNet recipe (linear warmup to `lr` over
+    # `lr_warmup_steps`, cosine decay to ~0 over `lr_decay_steps`)
+    lr_schedule: str = "exponential"
+    # exponential: lr halves every `lr_decay_steps` (reference: model.py:457-459);
+    # cosine: total decay horizon in steps
     lr_decay_steps: int = 10_000
     lr_decay_rate: float = 0.5
+    lr_warmup_steps: int = 0
     # number of devices to use; None = all (reference: n_gpus, model.py:33)
     n_devices: Optional[int] = None
     # sequence (spatial) parallel degree: shard the image H dimension over this
@@ -115,3 +121,5 @@ class TrainConfig:
             raise ValueError(
                 f"sequence_parallel must be >= 1, got {self.sequence_parallel}"
             )
+        if self.lr_schedule not in ("exponential", "cosine"):
+            raise ValueError(f"Unknown lr_schedule {self.lr_schedule!r}")

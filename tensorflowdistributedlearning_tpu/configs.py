@@ -35,6 +35,15 @@ def _imagenet_model(**kw) -> ModelConfig:
     return ModelConfig(**base)
 
 
+# 90 epochs of ImageNet-1k at global batch 1024 (1.28M images): the standard
+# warmup+cosine recipe (5-epoch warmup)
+_IMAGENET_1K_TRAIN = TrainConfig(
+    lr=0.001,
+    lr_schedule="cosine",
+    lr_warmup_steps=6_255,
+    lr_decay_steps=112_590,
+)
+
 PRESETS: Dict[str, Preset] = {
     # the reference's production config: TGS salt segmentation, 5-fold, batch 64,
     # Adam 1e-3 halving each 10k steps (reference: model.py:33, 457-462;
@@ -63,27 +72,27 @@ PRESETS: Dict[str, Preset] = {
     # BASELINE.json "ResNet-50 multi-tower data-parallel (ImageNet-1k)"
     "resnet50_imagenet": Preset(
         model=_imagenet_model(n_blocks=(3, 4, 6)),
-        train=TrainConfig(lr=0.001),
+        train=_IMAGENET_1K_TRAIN,
         global_batch=1024,
         description="ResNet-50 ImageNet-1k data-parallel, bf16",
     ),
     # BASELINE.json "ResNet-101 / ResNet-152 deeper variants"
     "resnet101_imagenet": Preset(
         model=_imagenet_model(n_blocks=(3, 4, 23)),
-        train=TrainConfig(lr=0.001),
+        train=_IMAGENET_1K_TRAIN,
         global_batch=1024,
         description="ResNet-101 ImageNet-1k data-parallel, bf16",
     ),
     "resnet152_imagenet": Preset(
         model=_imagenet_model(n_blocks=(3, 8, 36)),
-        train=TrainConfig(lr=0.001),
+        train=_IMAGENET_1K_TRAIN,
         global_batch=1024,
         description="ResNet-152 ImageNet-1k data-parallel, bf16",
     ),
     # BASELINE.json "Xception multi-tower data-parallel (ImageNet-1k)"
     "xception41_imagenet": Preset(
         model=_imagenet_model(backbone="xception"),
-        train=TrainConfig(lr=0.001),
+        train=_IMAGENET_1K_TRAIN,
         global_batch=1024,
         description="Xception-41 ImageNet-1k data-parallel, bf16 (the backbone the "
         "reference shipped broken, fixed here — SURVEY §2.4.8-10)",
@@ -91,7 +100,14 @@ PRESETS: Dict[str, Preset] = {
     # BASELINE.json "ResNet-50 bfloat16 large-batch (8k) on v5e-64 pod"
     "resnet50_bf16_8k": Preset(
         model=_imagenet_model(n_blocks=(3, 4, 6), remat=True),
-        train=TrainConfig(lr=0.008, async_checkpointing=True),  # lr linear-scaled for the 8x batch
+        # lr linear-scaled for the 8x batch; 90 epochs at 8192 = ~14.1k steps
+        train=TrainConfig(
+            lr=0.008,
+            lr_schedule="cosine",
+            lr_warmup_steps=782,     # 5 epochs
+            lr_decay_steps=14_080,
+            async_checkpointing=True,
+        ),
         global_batch=8192,
         description="ResNet-50 bf16 large-batch (8k) pod config (v5e-64: 128/chip)",
     ),

@@ -122,3 +122,19 @@ def mean_accuracy(
 def top1_accuracy_scores(logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Per-example top-1 hits for the classification path, shape [B]."""
     return (jnp.argmax(logits, axis=-1) == labels.astype(jnp.int32)).astype(jnp.float32)
+
+
+def topk_accuracy_scores(
+    logits: jax.Array, labels: jax.Array, k: int = 5
+) -> jax.Array:
+    """Per-example top-k hits (ImageNet's standard companion metric), shape [B].
+
+    Degrades to TOP-1 when the class count is <= k — clamping k to the class
+    count instead would make the metric a constant 1.0 (every class in the top
+    set), a perfect-looking but vacuous number."""
+    if k >= logits.shape[-1]:
+        return top1_accuracy_scores(logits, labels)
+    _, top = jax.lax.top_k(logits, k)
+    return jnp.any(top == labels.astype(jnp.int32)[:, None], axis=-1).astype(
+        jnp.float32
+    )

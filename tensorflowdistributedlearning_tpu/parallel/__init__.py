@@ -24,6 +24,10 @@ from tensorflowdistributedlearning_tpu.parallel.spatial import (
     ring_all_gather,
     spatial_conv2d,
 )
+from tensorflowdistributedlearning_tpu.parallel.expert import (
+    moe_apply,
+    top1_dispatch,
+)
 from tensorflowdistributedlearning_tpu.parallel.pipeline import (
     make_pipeline_fn,
     pipeline_apply,
@@ -48,6 +52,8 @@ __all__ = [
     "spatial_conv2d",
     "global_shard_batch",
     "make_pipeline_fn",
+    "moe_apply",
+    "top1_dispatch",
     "make_train_step_gspmd",
     "pipeline_apply",
     "stack_stage_params",

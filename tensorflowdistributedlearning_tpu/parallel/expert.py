@@ -1,0 +1,106 @@
+"""Expert parallelism: top-1-gated mixture-of-experts with all-to-all dispatch.
+
+The reference had no experts (SURVEY §2.3: data parallelism only), so — like the
+tensor, sequence, and pipeline axes — this is a beyond-parity primitive that
+completes the framework's strategy set (dp / tp / pp / sp / ep). It is built the
+TPU way: experts live one-per-shard on a mesh axis, and tokens move to their
+expert and back via ``lax.all_to_all`` — the single collective XLA lowers to the
+ICI all-to-all that makes MoE practical on pods.
+
+Design (the Switch-style top-1 regime, fixed shapes throughout):
+
+- ``gate``: a linear router produces per-token expert logits; top-1 assignment
+  with a per-expert capacity ``C = ceil(tokens/E * capacity_factor)``;
+- tokens are bucketed into a dense [E, C, D] dispatch buffer per shard (dropped
+  beyond capacity — the standard fixed-shape trade), sent with all-to-all so
+  each shard holds every shard's tokens for ITS expert, processed by the local
+  expert, and returned by the inverse all-to-all;
+- combine scales by the gate probability; dropped tokens fall back to a zero
+  update (residual-style callers add the input back).
+
+Everything is shape-static and jit/shard_map-compatible; autodiff flows through
+both all-to-alls (their transpose is the reverse all-to-all).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tensorflowdistributedlearning_tpu.parallel.mesh import MODEL_AXIS
+
+
+def top1_dispatch(
+    gate_logits: jax.Array, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Greedy top-1 routing with per-expert capacity.
+
+    ``gate_logits``: [T, E]. Returns ``(expert, slot, keep, prob)`` each [T]:
+    the chosen expert, the token's slot within that expert's capacity buffer,
+    whether it fit (slot < capacity), and the softmax gate probability.
+    """
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    expert = jnp.argmax(gate_logits, axis=-1)
+    prob = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    # position of each token within its expert's arrival order
+    one_hot = jax.nn.one_hot(expert, gate_logits.shape[-1], dtype=jnp.int32)
+    slot = jnp.cumsum(one_hot, axis=0) * one_hot  # [T, E], 1-based where chosen
+    slot = jnp.sum(slot, axis=-1) - 1  # [T], 0-based
+    keep = slot < capacity
+    return expert, slot, keep, prob
+
+
+def moe_apply(
+    expert_fn: Callable[[Any, jax.Array], jax.Array],
+    my_expert_params: Any,
+    gate_kernel: jax.Array,
+    x: jax.Array,
+    *,
+    capacity_factor: float = 1.25,
+    axis_name: str = MODEL_AXIS,
+) -> jax.Array:
+    """Expert-parallel MoE layer inside ``shard_map``.
+
+    ``x``: this shard's tokens [T, D] (e.g. a data-parallel shard's flattened
+    activations); ``my_expert_params``: THIS shard's expert parameters (one
+    expert per shard on ``axis_name``); ``gate_kernel``: [D, E] router weights,
+    replicated. Returns [T, D]: each token processed by its chosen expert and
+    scaled by the gate probability (zero where dropped by capacity).
+    """
+    import math
+
+    n_experts = lax.axis_size(axis_name)
+    t, d = x.shape
+    if gate_kernel.shape[-1] != n_experts:
+        raise ValueError(
+            f"gate_kernel routes over {gate_kernel.shape[-1]} experts but the "
+            f"{axis_name!r} mesh axis has {n_experts} shards (one expert each); "
+            "an over-wide router would dispatch out of the capacity buffer"
+        )
+    # the documented C = ceil(tokens/E * capacity_factor); >= 1 for any t >= 1
+    capacity = max(1, math.ceil(t * capacity_factor / n_experts))
+
+    gate_logits = x @ gate_kernel  # [T, E]
+    expert, slot, keep, prob = top1_dispatch(gate_logits, capacity)
+
+    # dense dispatch buffer [E, C, D]: token -> (its expert, its slot)
+    flat_idx = expert * capacity + jnp.minimum(slot, capacity - 1)
+    buffer = jnp.zeros((n_experts * capacity, d), x.dtype)
+    buffer = buffer.at[flat_idx].add(jnp.where(keep[:, None], x, 0.0))
+    buffer = buffer.reshape(n_experts, capacity, d)
+
+    # all-to-all: shard e receives every shard's bucket for expert e ->
+    # [n_shards, C, D] worth of tokens for MY expert
+    incoming = lax.all_to_all(buffer, axis_name, split_axis=0, concat_axis=0)
+    processed = expert_fn(
+        my_expert_params, incoming.reshape(n_experts * capacity, d)
+    ).reshape(n_experts, capacity, d)
+    # inverse all-to-all returns each shard its own tokens, expert-processed
+    returned = lax.all_to_all(processed, axis_name, split_axis=0, concat_axis=0)
+    returned = returned.reshape(n_experts * capacity, d)
+
+    out = returned[flat_idx]  # [T, D] gather back to token order
+    return jnp.where(keep[:, None], out * prob[:, None], 0.0)

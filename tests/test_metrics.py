@@ -92,3 +92,62 @@ def test_mean_weighted_update_excludes_padding():
     # unweighted stream merged with a weighted one
     m2 = m.merge(Mean.empty().update(jnp.asarray([2.0])))
     assert float(m2.compute()) == pytest.approx(2.0)
+
+
+def test_topk_accuracy_scores():
+    import jax.numpy as jnp
+
+    from tensorflowdistributedlearning_tpu.ops.metrics import (
+        top1_accuracy_scores,
+        topk_accuracy_scores,
+    )
+
+    logits = jnp.asarray(
+        [
+            [5.0, 4.0, 3.0, 2.0, 1.0, 0.0],  # label 1: top-1 miss, top-5 hit
+            [0.0, 1.0, 2.0, 3.0, 4.0, 5.0],  # label 5: top-1 hit
+            [5.0, 4.0, 3.0, 2.0, 1.0, 0.0],  # label 5: top-5 miss
+        ],
+        jnp.float32,
+    )
+    labels = jnp.asarray([1, 5, 5])
+    np.testing.assert_array_equal(
+        np.asarray(topk_accuracy_scores(logits, labels, k=5)), [1.0, 1.0, 0.0]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(top1_accuracy_scores(logits, labels)), [0.0, 1.0, 0.0]
+    )
+    # k >= class count degrades to TOP-1 (a clamped k would be a vacuous 1.0)
+    np.testing.assert_array_equal(
+        np.asarray(topk_accuracy_scores(logits, labels, k=10)), [0.0, 1.0, 0.0]
+    )
+
+
+def test_cosine_schedule_warmup_and_decay():
+    """Asserts on the schedule make_lr_schedule actually builds from the config
+    (not a hand-made optax schedule), so wiring regressions are caught."""
+    from tensorflowdistributedlearning_tpu.config import TrainConfig
+    from tensorflowdistributedlearning_tpu.train.step import make_lr_schedule
+
+    sched = make_lr_schedule(
+        TrainConfig(lr=0.4, lr_schedule="cosine", lr_warmup_steps=10, lr_decay_steps=100)
+    )
+    assert float(sched(0)) == 0.0
+    assert float(sched(10)) == pytest.approx(0.4)
+    assert float(sched(100)) < 1e-3
+    # warmup=0: the first step runs at PEAK lr, not zero
+    no_warmup = make_lr_schedule(
+        TrainConfig(lr=0.4, lr_schedule="cosine", lr_warmup_steps=0, lr_decay_steps=100)
+    )
+    assert float(no_warmup(0)) == pytest.approx(0.4)
+    assert float(no_warmup(100)) < 1e-3
+    # exponential default: reference semantics (halves at lr_decay_steps)
+    exp = make_lr_schedule(TrainConfig(lr=0.4, lr_decay_steps=100))
+    assert float(exp(100)) == pytest.approx(0.2)
+
+
+def test_unknown_lr_schedule_rejected():
+    from tensorflowdistributedlearning_tpu.config import TrainConfig
+
+    with pytest.raises(ValueError, match="lr_schedule"):
+        TrainConfig(lr_schedule="linear")
