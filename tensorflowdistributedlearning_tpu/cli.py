@@ -34,6 +34,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--block-type", choices=("bottleneck", "basic_block"),
                    default="bottleneck")
     p.add_argument("--dtype", choices=("float32", "bfloat16"), default="float32")
+    p.add_argument("--sequence-parallel", type=int, default=1,
+                   help="H-shard the backbone over this many devices per "
+                   "data-parallel replica (halo-exchange spatial parallelism)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -84,6 +87,7 @@ def build_parser() -> argparse.ArgumentParser:
     p_fit.add_argument("--batch-size", type=int, default=None,
                        help="global batch (default: the preset's)")
     p_fit.add_argument("--eval-every", type=int, default=None)
+    p_fit.add_argument("--sequence-parallel", type=int, default=1)
 
     sub.add_parser("presets", help="list the named BASELINE config presets")
     return parser
@@ -101,6 +105,7 @@ def _trainer(args):
         save_best=getattr(args, "save_best", 5),
         checkpoint_every_steps=getattr(args, "checkpoint_every", 500),
         eval_throttle_secs=getattr(args, "eval_throttle_secs", 300),
+        sequence_parallel=getattr(args, "sequence_parallel", 1),
     )
     return Trainer(
         args.model_dir,
@@ -204,6 +209,7 @@ def cmd_fit(args) -> int:
         steps=args.steps,
         batch_size=args.batch_size,
         eval_every_steps=args.eval_every,
+        sequence_parallel=args.sequence_parallel,
     )
     print(json.dumps({
         "preset": args.preset,

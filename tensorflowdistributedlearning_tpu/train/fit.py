@@ -334,6 +334,7 @@ def fit_preset(
     steps: int = 100,
     batch_size: Optional[int] = None,
     eval_every_steps: Optional[int] = None,
+    sequence_parallel: int = 1,
 ) -> FitResult:
     """Train a named config preset end-to-end (the CLI `fit` entry point)."""
     from tensorflowdistributedlearning_tpu.configs import get_preset
@@ -344,8 +345,13 @@ def fit_preset(
             f"Preset {preset_name!r} is a segmentation config; use the `train` "
             "command (K-fold Trainer) for it"
         )
+    train_cfg = preset.train
+    if sequence_parallel != 1:
+        train_cfg = dataclasses.replace(
+            train_cfg, sequence_parallel=sequence_parallel
+        )
     trainer = ClassifierTrainer(
-        model_dir, data_dir, preset.model, preset.train
+        model_dir, data_dir, preset.model, train_cfg
     )
     return trainer.fit(
         batch_size=batch_size or preset.global_batch,

@@ -124,12 +124,16 @@ class ClassificationTask:
     def metric_scores(
         self, logits: jax.Array, batch: Dict[str, jax.Array]
     ) -> Dict[str, jax.Array]:
-        return {
-            "metrics/top1": metrics_lib.top1_accuracy_scores(logits, batch["labels"]),
-            "metrics/top5": metrics_lib.topk_accuracy_scores(
-                logits, batch["labels"], k=5
-            ),
+        scores = {
+            "metrics/top1": metrics_lib.top1_accuracy_scores(logits, batch["labels"])
         }
+        # only meaningful with more than 5 classes (otherwise it would just
+        # repeat top-1 under a misleading name — class count is trace-static)
+        if logits.shape[-1] > 5:
+            scores["metrics/top5"] = metrics_lib.topk_accuracy_scores(
+                logits, batch["labels"], k=5
+            )
+        return scores
 
     def predictions(self, logits: jax.Array) -> Dict[str, jax.Array]:
         probs = jax.nn.softmax(logits, axis=-1)
