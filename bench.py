@@ -67,6 +67,20 @@ def run_benchmark(platform: str | None = None) -> dict:
 
     if platform is not None:
         jax.config.update("jax_platforms", platform)
+    # Persistent compile cache: the ResNet-50 train-step compile through the
+    # tunneled TPU backend has been measured at several MINUTES — most of the
+    # supervisor's per-attempt budget. Serialized executables keyed by HLO hash
+    # make the second run (and the driver's end-of-round run on this machine)
+    # nearly compile-free. Best-effort: unsupported backends just skip caching.
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache_tpu"),
+        )
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:  # noqa: BLE001
+        pass
     import numpy as np
 
     from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
@@ -221,6 +235,9 @@ def run_benchmark(platform: str | None = None) -> dict:
         achieved = flops_per_step / (dt / timed_steps) / n
         result["mfu"] = round(achieved / peak, 4)
         result["model_tflops_per_step"] = round(flops_per_step / 1e12, 3)
+        # re-print after every completed extra: the supervisor keeps the LAST
+        # parseable line, so a timeout mid-extras costs only the unfinished ones
+        print(json.dumps(result), flush=True)
 
     if on_tpu:
         # Pallas-vs-XLA depthwise decision data at the flagship's ASPP shapes
@@ -232,6 +249,7 @@ def run_benchmark(platform: str | None = None) -> dict:
             result["depthwise_kernels"] = bench_depthwise(iters=20, warmup=3)
         except Exception as e:  # noqa: BLE001
             result["depthwise_kernels"] = {"error": str(e)[:200]}
+        print(json.dumps(result), flush=True)
 
         # Secondary metric: the reference's ACTUAL production workload — the
         # TGS-salt segmentation flagship (ResNet-v2-beta + DeepLabV3+ head,

@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import sys
 from typing import List, Optional
 
@@ -167,7 +168,12 @@ def cmd_smoke(args) -> int:
     from tensorflowdistributedlearning_tpu.train import step as step_lib
     from tensorflowdistributedlearning_tpu.train.state import create_train_state
 
-    cfg = ModelConfig(input_shape=(32, 32), n_blocks=(1, 1, 1), base_depth=32)
+    # same tiny architecture the test suite standardizes on: a smoke run checks
+    # wiring (mesh, SPMD step, metrics), not model capacity — and matching the
+    # suite's canonical config lets one compiled executable serve both
+    cfg = ModelConfig(
+        input_shape=(32, 32), n_blocks=(1, 1, 1), base_depth=8, width_multiplier=0.0625
+    )
     tcfg = TrainConfig(n_devices=args.n_devices)
     mesh = mesh_lib.make_mesh(args.n_devices)
     model = build_model(cfg)
@@ -244,6 +250,17 @@ def cmd_presets(args) -> int:
 
 def main(argv: Optional[List[str]] = None) -> int:
     logging.basicConfig(level=logging.INFO)
+    # Honor JAX_PLATFORMS even when a site hook pre-imported jax with another
+    # platform: env vars alone are too late once the backend choice is cached,
+    # but the config route works because backend init itself is lazy.
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", platforms)
+        except Exception:  # noqa: BLE001 — never block the CLI on a config nicety
+            pass
     args = build_parser().parse_args(argv)
     return {
         "train": cmd_train,

@@ -53,6 +53,12 @@ class ModelConfig:
     # rematerialize residual units on the backward pass (jax.checkpoint): trades
     # recompute FLOPs for activation HBM — enables large per-chip batches.
     remat: bool = False
+    # uniform channel-width scale for every backbone stage (root convs, residual
+    # stages, Xception flows). 1.0 keeps the reference widths (core/resnet.py:333-344,
+    # core/xception.py:405-465); fractional values give width-scaled variants
+    # (Wide-ResNet-style scaling, and the knob that makes tiny CI models actually
+    # tiny — the stage widths are otherwise fixed constants).
+    width_multiplier: float = 1.0
 
     def __post_init__(self):
         if self.backbone not in ("resnet", "xception"):
@@ -61,6 +67,8 @@ class ModelConfig:
             raise ValueError(f"Unknown block type {self.block_type!r}")
         if self.dtype not in ("float32", "bfloat16"):
             raise ValueError(f"Unknown dtype {self.dtype!r}")
+        if self.width_multiplier <= 0:
+            raise ValueError("width_multiplier must be positive")
 
 
 @dataclasses.dataclass(frozen=True)

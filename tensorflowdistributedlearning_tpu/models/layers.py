@@ -19,6 +19,12 @@ import jax.numpy as jnp
 conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_in", "truncated_normal")
 
 
+def scaled_width(channels: int, multiplier: float) -> int:
+    """Stage width under ``ModelConfig.width_multiplier`` (>=1 channel); shared by
+    both backbone families."""
+    return max(1, int(round(channels * multiplier)))
+
+
 def fixed_padding(
     x: jax.Array, kernel_size: int, mode: str = "constant", rate: int = 1
 ) -> jax.Array:

@@ -24,6 +24,7 @@ Re-design notes (TPU-first, not a translation):
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Dict, Optional, Tuple
 
 import flax.linen as nn
@@ -32,6 +33,7 @@ import jax.numpy as jnp
 
 from tensorflowdistributedlearning_tpu.config import ModelConfig
 from tensorflowdistributedlearning_tpu.models.layers import (
+    scaled_width,
     ConvBN,
     SplitSeparableConv2D,
     conv_kernel_init,
@@ -62,27 +64,34 @@ class BlockSpec:
 def resnet_block_specs(
     n_blocks: Tuple[int, ...],
     multi_grid: Tuple[int, int, int] = SEGMENTATION_MULTI_GRID,
+    width_multiplier: float = 1.0,
 ) -> Tuple[BlockSpec, ...]:
     """Block layout of the reference's ``resnet_v2`` (core/resnet.py:330-344):
     three stages with the stride-2 unit LAST (v2-beta convention), then an atrous
     multi-grid stage of three units (depth 1024 / bottleneck 256 / stride 1).
+    All widths scale by ``width_multiplier`` (1.0 = reference widths).
     """
     if len(n_blocks) != 3:
         raise ValueError("Expect n_blocks to have length 3.")
     if len(multi_grid) != 3:
         raise ValueError("Expect multi_grid to have length 3.")
 
+    def w(c: int) -> int:
+        return scaled_width(c, width_multiplier)
+
     def stage(name: str, base_depth: int, num_units: int) -> BlockSpec:
         units = tuple(
-            UnitSpec(depth=base_depth * 4, depth_bottleneck=base_depth, stride=1)
+            UnitSpec(depth=w(base_depth * 4), depth_bottleneck=w(base_depth), stride=1)
             for _ in range(num_units - 1)
-        ) + (UnitSpec(depth=base_depth * 4, depth_bottleneck=base_depth, stride=2),)
+        ) + (
+            UnitSpec(depth=w(base_depth * 4), depth_bottleneck=w(base_depth), stride=2),
+        )
         return BlockSpec(name, units)
 
     block4 = BlockSpec(
         "block4",
         tuple(
-            UnitSpec(depth=1024, depth_bottleneck=256, stride=1, unit_rate=r)
+            UnitSpec(depth=w(1024), depth_bottleneck=w(256), stride=1, unit_rate=r)
             for r in multi_grid
         ),
     )
@@ -284,10 +293,11 @@ class ResNetBackbone(nn.Module):
             target_stride = None
 
         end_points: Dict[str, jax.Array] = {}
+        wm = cfg.width_multiplier
         # root (reference: core/resnet.py:155-168, 241-242)
-        x = ConvBN(64, 3, stride=2, name="conv1_1", **common)(x, train)
-        x = ConvBN(64, 3, name="conv1_2", **common)(x, train)
-        x = ConvBN(128, 3, name="conv1_3", **common)(x, train)
+        x = ConvBN(scaled_width(64, wm), 3, stride=2, name="conv1_1", **common)(x, train)
+        x = ConvBN(scaled_width(64, wm), 3, name="conv1_2", **common)(x, train)
+        x = ConvBN(scaled_width(128, wm), 3, name="conv1_3", **common)(x, train)
         if self.spatial_axis_name is not None:
             from tensorflowdistributedlearning_tpu.parallel.spatial import (
                 spatial_max_pool,
@@ -318,7 +328,7 @@ class ResNetBackbone(nn.Module):
             # large-batch pod configs rely on (a TPU-first capability; the reference
             # had no memory-saving story). `train` is static (BN mode selection).
             unit_cls = nn.remat(unit_cls, static_argnums=(2,))
-        blocks = resnet_block_specs(cfg.n_blocks, self.multi_grid)
+        blocks = resnet_block_specs(cfg.n_blocks, self.multi_grid, wm)
 
         # slim stack_blocks_dense semantics (reference: core/resnet.py:244): strides
         # apply until the target stride is hit, after which they accumulate into rates.
@@ -504,7 +514,23 @@ def build_model(
     ``spatial_axis_name`` builds the model for H-sharded sequence-parallel
     execution inside ``shard_map`` (parallel/spatial.py); pair it with
     ``bn_axis_name`` on the same axis so BN statistics span the full spatial
-    extent. Supported by both backbone families."""
+    extent. Supported by both backbone families.
+
+    Memoized: flax modules are immutable, and returning the SAME instance for the
+    same arguments makes ``model.apply``/``model.init`` compare equal as jit
+    statics, so compiled executables are shared across folds, Trainer instances,
+    and tests (bound methods of two equal-but-distinct modules do NOT compare
+    equal). The public wrapper normalizes positional/keyword call styles so every
+    spelling shares one cache entry."""
+    return _build_model_cached(config, bn_axis_name, spatial_axis_name)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_model_cached(
+    config: ModelConfig,
+    bn_axis_name: Optional[str],
+    spatial_axis_name: Optional[str],
+) -> nn.Module:
     if config.backbone == "resnet":
         if config.num_classes is None:
             return ResNetSegmentation(

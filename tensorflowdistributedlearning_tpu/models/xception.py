@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from tensorflowdistributedlearning_tpu.config import ModelConfig
 from tensorflowdistributedlearning_tpu.models.layers import (
+    scaled_width,
     ConvBN,
     conv_kernel_init,
     fixed_padding,
@@ -200,12 +201,13 @@ class XceptionUnit(nn.Module):
 
 def xception_41_block_specs(
     multi_grid: Tuple[int, int, int] = (1, 1, 1),
+    width_multiplier: float = 1.0,
 ) -> Tuple[XceptionBlockSpec, ...]:
-    """Xception-41 block table (reference: core/xception.py:405-465)."""
-
+    """Xception-41 block table (reference: core/xception.py:405-465); widths
+    scale by ``width_multiplier`` (1.0 = reference widths)."""
     def block(name, depths, skip, num_units, stride, rates=(1, 1, 1), act_inside=False):
         unit = XceptionUnitSpec(
-            depth_list=tuple(depths),
+            depth_list=tuple(scaled_width(d, width_multiplier) for d in depths),
             skip_connection_type=skip,
             stride=stride,
             unit_rate_list=tuple(rates),
@@ -255,14 +257,15 @@ class XceptionBackbone(nn.Module):
         else:
             target_stride = None
 
+        wm = cfg.width_multiplier
         end_points: Dict[str, jax.Array] = {}
-        x = ConvBN(32, 3, stride=2, name="conv1_1", **common)(x, train)
-        x = ConvBN(64, 3, name="conv1_2", **common)(x, train)
+        x = ConvBN(scaled_width(32, wm), 3, stride=2, name="conv1_1", **common)(x, train)
+        x = ConvBN(scaled_width(64, wm), 3, name="conv1_2", **common)(x, train)
         end_points["root"] = x
 
         current_stride = 1
         rate = 1
-        for blk in xception_41_block_specs(self.multi_grid):
+        for blk in xception_41_block_specs(self.multi_grid, cfg.width_multiplier):
             for i, unit in enumerate(blk.units):
                 if target_stride is not None and current_stride == target_stride:
                     applied = dataclasses.replace(unit, stride=1)

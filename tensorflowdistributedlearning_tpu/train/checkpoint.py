@@ -37,6 +37,19 @@ def _state_pytree(state: TrainState) -> Dict:
     }
 
 
+def _save_pytree(state: TrainState) -> Dict:
+    """The pytree handed to Orbax for SAVING.
+
+    Single-process: materialize to host numpy first — one bulk ``device_get`` is
+    ~0.01s, while Orbax's jax.Array path walks every leaf's sharding (measured
+    ~20x slower for a small replicated state). Multi-process keeps jax.Arrays so
+    Orbax can coordinate the per-host writes of sharded leaves."""
+    tree = _state_pytree(state)
+    if jax.process_count() == 1:
+        return jax.device_get(tree)
+    return tree
+
+
 class CheckpointManager:
     """Periodic + best-k checkpointing for one fold directory.
 
@@ -91,7 +104,7 @@ class CheckpointManager:
         if step in self._ckpt.all_steps():
             return False
         saved = self._ckpt.save(
-            step, args=ocp.args.StandardSave(_state_pytree(state)), force=force
+            step, args=ocp.args.StandardSave(_save_pytree(state)), force=force
         )
         if not self._async:
             self._ckpt.wait_until_finished()
@@ -133,7 +146,7 @@ class CheckpointManager:
             return False
         saved = self._best.save(
             step,
-            args=ocp.args.StandardSave(_state_pytree(state)),
+            args=ocp.args.StandardSave(_save_pytree(state)),
             metrics={self.best_metric: float(metrics[self.best_metric])},
             force=True,
         )

@@ -17,6 +17,7 @@ export, TensorBoard summaries — but with no folds, streaming on-disk input
 from __future__ import annotations
 
 import dataclasses
+import functools
 import logging
 import os
 import time
@@ -39,6 +40,21 @@ from tensorflowdistributedlearning_tpu.utils.params import count_params
 from tensorflowdistributedlearning_tpu.utils.summary import SummaryWriter
 
 logger = logging.getLogger(__name__)
+
+
+@functools.lru_cache(maxsize=None)
+def _prepare_classification_cached():
+    from tensorflowdistributedlearning_tpu.data import augment as augment_lib
+
+    @jax.jit
+    def prepare(base_key, step, batch):
+        key = jax.random.fold_in(base_key, step)
+        return {
+            "images": augment_lib.augment_classification_batch(key, batch["images"]),
+            "labels": batch["labels"],
+        }
+
+    return prepare
 
 
 @dataclasses.dataclass
@@ -248,22 +264,15 @@ class ClassifierTrainer:
     def _make_prepare_train(self):
         """Jitted on-device classification augmentation keyed by (seed, step) —
         random horizontal flip + reflect-padded random crop
-        (data/augment.py:augment_classification_batch)."""
-        from tensorflowdistributedlearning_tpu.data import augment as augment_lib
+        (data/augment.py:augment_classification_batch). The seed rides in through
+        the traced base key so runs with different seeds share one executable."""
+        base_key = jax.random.PRNGKey(self.train_config.seed)
+        prepare = _prepare_classification_cached()
 
-        tcfg = self.train_config
+        def bound(step: jax.Array, batch):
+            return prepare(base_key, step, batch)
 
-        @jax.jit
-        def prepare(step: jax.Array, batch):
-            key = jax.random.fold_in(jax.random.PRNGKey(tcfg.seed), step)
-            return {
-                "images": augment_lib.augment_classification_batch(
-                    key, batch["images"]
-                ),
-                "labels": batch["labels"],
-            }
-
-        return prepare
+        return bound
 
     def _init_state(self) -> TrainState:
         cfg, tcfg = self.model_config, self.train_config

@@ -41,7 +41,12 @@ def create_train_state(
     model, tx: optax.GradientTransformation, rng: jax.Array, sample_input: jax.Array
 ) -> TrainState:
     """Initialize parameters/BN stats from a sample input and wrap them with the
-    optimizer state."""
+    optimizer state.
+
+    Init runs EAGERLY on purpose: op-by-op dispatch hits jax's process-wide
+    primitive cache (shared across all architectures), whereas a jitted init
+    compiles a fresh ~10s executable per architecture — the wrong trade for
+    K-fold loops and test suites that build many small model variants."""
     variables = model.init(rng, sample_input, train=False)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", core.FrozenDict())

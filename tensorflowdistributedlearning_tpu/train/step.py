@@ -21,6 +21,7 @@ in-place buffer reuse on TPU.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -66,7 +67,34 @@ def make_lr_schedule(cfg: TrainConfig) -> optax.Schedule:
 
 
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
-    """Adam under the configured lr schedule (see ``make_lr_schedule``)."""
+    """Adam under the configured lr schedule (see ``make_lr_schedule``).
+
+    Memoized on the lr-relevant fields only: optax transformations are pure
+    function pairs, and ``TrainState.tx`` is a static pytree field compared by
+    ``==`` inside jax.jit — returning the SAME object for equivalent schedules is
+    what lets the jitted train step's cache hit across K-fold iterations, Trainer
+    instances, and configs that differ only in orchestration knobs (checkpoint
+    cadence, fold count, ...), instead of recompiling per fold."""
+    return _make_optimizer_cached(
+        cfg.lr,
+        cfg.lr_schedule,
+        cfg.lr_decay_steps,
+        cfg.lr_decay_rate,
+        cfg.lr_warmup_steps,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _make_optimizer_cached(
+    lr: float, schedule: str, decay_steps: int, decay_rate: float, warmup_steps: int
+) -> optax.GradientTransformation:
+    cfg = TrainConfig(
+        lr=lr,
+        lr_schedule=schedule,
+        lr_decay_steps=decay_steps,
+        lr_decay_rate=decay_rate,
+        lr_warmup_steps=warmup_steps,
+    )
     return optax.adam(make_lr_schedule(cfg))
 
 
@@ -245,6 +273,15 @@ def make_train_step(
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Metrics]]:
     """Build the jitted SPMD train step.
 
+    Memoized on its (hashable) arguments: the reference rebuilt its graph per fold
+    and per Estimator (model.py:164-172); here repeated calls — across K-fold
+    iterations, Trainer instances, and tests — return the SAME jitted callable, so
+    XLA compiles each (mesh, task, model, shapes) combination exactly once per
+    process. jax.jit's own cache handles different models/shapes arriving through
+    the returned callable (the model rides in as ``state.apply_fn``, a static
+    pytree field; ``build_model`` is memoized so equal configs share one module
+    instance and therefore one ``apply`` bound method).
+
     ``apply_weight_decay`` exists because the reference *declared* an l2 regularizer on
     every conv but minimized only the Lovász loss (reference: model.py:462-467 — the
     REGULARIZATION_LOSSES collection was never added). Default False reproduces the
@@ -256,7 +293,20 @@ def make_train_step(
     sequence mesh axis with halo exchanges; outputs are gathered inside the model,
     so loss/metrics math below is unchanged.
     """
+    return _make_train_step_cached(
+        mesh, task, weight_decay, apply_weight_decay, donate, spatial
+    )
 
+
+@functools.lru_cache(maxsize=None)
+def _make_train_step_cached(
+    mesh: Mesh,
+    task,
+    weight_decay: float,
+    apply_weight_decay: bool,
+    donate: bool,
+    spatial: bool,
+):
     def step(state: TrainState, batch: Dict[str, jax.Array]):
         def loss_fn(params):
             outputs, mutated = state.apply_fn(
@@ -307,8 +357,13 @@ def make_eval_step(
     mesh: Mesh, task, *, spatial: bool = False, with_valid: bool = True
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Metrics]:
     """Jitted SPMD eval step: forward in inference mode (BN running stats), streaming
-    metric deltas (the reference's EVAL branch, model.py:391-403)."""
+    metric deltas (the reference's EVAL branch, model.py:391-403). Memoized — see
+    ``make_train_step``."""
+    return _make_eval_step_cached(mesh, task, spatial, with_valid)
 
+
+@functools.lru_cache(maxsize=None)
+def _make_eval_step_cached(mesh: Mesh, task, spatial: bool, with_valid: bool):
     def step(state: TrainState, batch: Dict[str, jax.Array]) -> Metrics:
         outputs = state.apply_fn(
             {"params": state.params, "batch_stats": state.batch_stats},
@@ -337,8 +392,12 @@ def make_predict_step(
     mesh: Mesh, task, *, spatial: bool = False
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Dict[str, jax.Array]]:
     """Jitted SPMD predict step (the reference's PREDICT branch, model.py:371-387);
-    outputs stay sharded on the batch axis."""
+    outputs stay sharded on the batch axis. Memoized — see ``make_train_step``."""
+    return _make_predict_step_cached(mesh, task, spatial)
 
+
+@functools.lru_cache(maxsize=None)
+def _make_predict_step_cached(mesh: Mesh, task, spatial: bool):
     def step(state: TrainState, batch: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
         outputs = state.apply_fn(
             {"params": state.params, "batch_stats": state.batch_stats},

@@ -25,6 +25,7 @@ per-fold Estimators:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import logging
 import os
 import time
@@ -50,6 +51,48 @@ from tensorflowdistributedlearning_tpu.utils.summary import SummaryWriter
 logger = logging.getLogger(__name__)
 
 _MODEL_FIELDS = {f.name for f in dataclasses.fields(ModelConfig)}
+
+
+@functools.lru_cache(maxsize=None)
+def _prepare_train_cached(cfg: augment_lib.AugmentConfig):
+    """One compiled augmentation executable per AugmentConfig (shared across folds
+    and Trainer instances — the per-fold randomness rides in through the key)."""
+
+    @jax.jit
+    def prepare(base_key, step, batch):
+        key = jax.random.fold_in(base_key, step)
+        return augment_lib.augment_batch(key, batch["images"], batch["masks"], cfg)
+
+    return prepare
+
+
+@functools.lru_cache(maxsize=None)
+def _prepare_eval_cached():
+    @jax.jit
+    def prepare(batch):
+        out = augment_lib.prepare_eval_batch(batch["images"], batch["masks"])
+        if "valid" in batch:
+            out["valid"] = batch["valid"]
+        return out
+
+    return prepare
+
+
+@functools.lru_cache(maxsize=None)
+def _forward_cached(model):
+    """Single-device inference forward, one executable per model architecture
+    (build_model returns a shared instance per config, so this caches across
+    Trainer instances)."""
+
+    @jax.jit
+    def forward(state, images):
+        return model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images,
+            train=False,
+        )
+
+    return forward
 
 
 class Trainer:
@@ -325,20 +368,16 @@ class Trainer:
     def _make_prepare_train(self, fold: int):
         """Jitted on-device augmentation: {'images','masks'} -> {'images','labels'}
         with the Laplacian channel (the reference's augmenting input_fn map,
-        model.py:315-317, run on TPU instead of the host)."""
-        cfg = self.augment_config
-        tcfg = self.train_config
+        model.py:315-317, run on TPU instead of the host). The fold's base PRNG key
+        is a traced argument, so every fold (and every Trainer with the same
+        augment config) shares ONE compiled executable."""
+        base_key = jax.random.PRNGKey(self.train_config.seed + fold)
+        prepare = _prepare_train_cached(self.augment_config)
 
-        @jax.jit
-        def prepare(step: jax.Array, batch: Dict[str, jax.Array]):
-            key = jax.random.fold_in(
-                jax.random.PRNGKey(tcfg.seed + fold), step
-            )
-            return augment_lib.augment_batch(
-                key, batch["images"], batch["masks"], cfg
-            )
+        def bound(step: jax.Array, batch: Dict[str, jax.Array]):
+            return prepare(base_key, step, batch)
 
-        return prepare
+        return bound
 
     def _evaluate(
         self,
@@ -424,36 +463,11 @@ class Trainer:
 
     @property
     def _prepare_eval(self):
-        if not hasattr(self, "_prepare_eval_fn"):
-
-            @jax.jit
-            def prepare(batch):
-                out = augment_lib.prepare_eval_batch(
-                    batch["images"], batch["masks"]
-                )
-                if "valid" in batch:
-                    out["valid"] = batch["valid"]
-                return out
-
-            self._prepare_eval_fn = prepare
-        return self._prepare_eval_fn
+        return _prepare_eval_cached()
 
     @property
     def _forward(self):
-        if not hasattr(self, "_forward_fn"):
-
-            plain_apply = self._plain_model.apply
-
-            @jax.jit
-            def forward(state, images):
-                return plain_apply(
-                    {"params": state.params, "batch_stats": state.batch_stats},
-                    images,
-                    train=False,
-                )
-
-            self._forward_fn = forward
-        return self._forward_fn
+        return _forward_cached(self._plain_model)
 
     # -- prediction -------------------------------------------------------
 

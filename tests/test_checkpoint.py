@@ -13,7 +13,9 @@ from tensorflowdistributedlearning_tpu.parallel import make_mesh, replicate
 from tensorflowdistributedlearning_tpu.train import create_train_state, make_optimizer
 from tensorflowdistributedlearning_tpu.train.checkpoint import CheckpointManager
 
-TINY = ModelConfig(n_blocks=(1, 1, 1), input_shape=(33, 33), base_depth=16)
+TINY = ModelConfig(
+    n_blocks=(1, 1, 1), input_shape=(32, 32), base_depth=8, width_multiplier=0.0625
+)
 
 
 @pytest.fixture(scope="module")
@@ -21,7 +23,7 @@ def state(eight_devices_module=None):
     cfg = TINY
     model = build_model(cfg)
     tx = make_optimizer(TrainConfig())
-    sample = np.zeros((1, 33, 33, 2), np.float32)
+    sample = np.zeros((1, 32, 32, 2), np.float32)
     mesh = make_mesh(8)
     return replicate(
         create_train_state(model, tx, jax.random.PRNGKey(0), sample), mesh

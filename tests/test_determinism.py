@@ -26,7 +26,9 @@ def _run_losses(seed: int) -> list:
     """The trainer's full per-step recipe (on-device augmentation keyed by
     fold_in(seed, step) -> SPMD train step) on tiny shapes, returning the float32
     loss value of every step."""
-    cfg = ModelConfig(input_shape=(16, 16), n_blocks=(1, 1, 1), base_depth=8)
+    cfg = ModelConfig(
+        input_shape=(16, 16), n_blocks=(1, 1, 1), base_depth=8, width_multiplier=0.0625
+    )
     tcfg = TrainConfig(seed=seed)
     mesh = mesh_lib.make_mesh(8)
     model = build_model(cfg)
@@ -91,5 +93,6 @@ def test_golden_loss_after_k_steps(runs):
     )
 
 
-# Recorded 2026-07-29, jax 0.9.0, 8-device CPU mesh (see test_golden_loss_after_k_steps)
-GOLDEN_LOSSES = [1.3584579229354858, 1.4773142337799072, 1.2754160165786743]
+# Recorded 2026-07-30, jax 0.9.0, 8-device CPU mesh, width_multiplier=1/16 fixture
+# (re-recorded when the fixture architecture gained width_multiplier)
+GOLDEN_LOSSES = [1.5637928247451782, 1.5359129905700684, 1.3671655654907227]

@@ -89,7 +89,8 @@ def fitted(folder, tmp_path_factory):
             input_shape=SHAPE,
             input_channels=3,
             n_blocks=(1, 1, 1),
-            base_depth=16,
+            base_depth=8,
+            width_multiplier=0.0625,
             output_stride=None,
         ),
         TrainConfig(seed=0, checkpoint_every_steps=2, train_log_every_steps=2),
@@ -132,7 +133,8 @@ def test_fit_synthetic_without_data_dir(tmp_path):
             input_shape=SHAPE,
             input_channels=3,
             n_blocks=(1, 1, 1),
-            base_depth=16,
+            base_depth=8,
+            width_multiplier=0.0625,
             output_stride=None,
         ),
         TrainConfig(seed=0, checkpoint_every_steps=100),
@@ -156,7 +158,8 @@ def test_fit_sequence_parallel_end_to_end(tmp_path):
             input_shape=(64, 64),  # divisible by overall_stride(32) x sp(2)
             input_channels=3,
             n_blocks=(1, 1, 1),
-            base_depth=16,
+            base_depth=8,
+            width_multiplier=0.0625,
             output_stride=None,
         ),
         TrainConfig(seed=0, sequence_parallel=2, checkpoint_every_steps=100),
@@ -241,7 +244,7 @@ def test_fit_loop_accepts_imagenet_preset_architecture(tmp_path):
 
     preset = get_preset("resnet50_imagenet")
     small = dataclasses.replace(
-        preset.model, input_shape=SHAPE, n_blocks=(1, 1, 1), base_depth=32,
+        preset.model, input_shape=SHAPE, n_blocks=(1, 1, 1), base_depth=16,
         num_classes=N_CLASSES,
     )
     trainer = ClassifierTrainer(str(tmp_path), None, small, preset.train)

@@ -23,7 +23,9 @@ from tensorflowdistributedlearning_tpu.parallel.mesh import (
 from tensorflowdistributedlearning_tpu.train import step as step_lib
 from tensorflowdistributedlearning_tpu.train.state import create_train_state
 
-CFG = ModelConfig(input_shape=(32, 32), n_blocks=(1, 1, 1), base_depth=16)
+CFG = ModelConfig(
+    input_shape=(32, 32), n_blocks=(1, 1, 1), base_depth=8, width_multiplier=0.0625
+)
 
 
 @pytest.fixture(scope="module")
@@ -233,7 +235,8 @@ def test_spatial_classifier_forward_matches(models_and_state):
         input_shape=(64, 64),
         input_channels=3,
         n_blocks=(1, 1, 1),
-        base_depth=16,
+        base_depth=8,
+        width_multiplier=0.0625,
         output_stride=None,
     )
     plain = build_model(cfg)
@@ -296,7 +299,7 @@ def test_trainer_end_to_end_with_sequence_parallel(tmp_path):
         ),
         input_shape=(32, 32),
         n_blocks=(1, 1, 1),
-        base_depth=16,
+        base_depth=8,
     )
     assert trainer.mesh.shape == {"batch": 4, "model": 1, "sequence": 2}
     results = trainer.train(ids, batch_size=8, steps=2)
@@ -312,7 +315,8 @@ def test_spatial_xception_forward_matches():
     """Xception spatial support: strided separable convs use the fixed_padding
     phase; forward parity with the unsharded model on a (4, 1, 2) mesh."""
     cfg = ModelConfig(
-        backbone="xception", input_shape=(64, 64), base_depth=16
+        backbone="xception", input_shape=(64, 64), base_depth=8,
+        width_multiplier=0.0625
     )
     plain = build_model(cfg)
     spatial = build_model(

@@ -25,12 +25,16 @@ from tensorflowdistributedlearning_tpu.train.step import (
     merge_metrics,
 )
 
-SMALL_SEG = ModelConfig(n_blocks=(1, 1, 1), input_shape=(33, 33), base_depth=32)
+SMALL_SEG = ModelConfig(
+    n_blocks=(1, 1, 1), input_shape=(32, 32), base_depth=8, width_multiplier=0.0625
+)
 SMALL_CLS = ModelConfig(
     n_blocks=(1, 1, 1),
     input_shape=(32, 32),
     input_channels=3,
     num_classes=4,
+    base_depth=8,
+    width_multiplier=0.0625,
     output_stride=None,
 )
 
@@ -48,16 +52,16 @@ def _setup(cfg, task, mesh, batch_shape):
 def test_segmentation_loss_decreases_on_mesh():
     mesh = make_mesh(8)
     task = SegmentationTask()
-    state = _setup(SMALL_SEG, task, mesh, (1, 33, 33, 2))
+    state = _setup(SMALL_SEG, task, mesh, (1, 32, 32, 2))
     train_step = make_train_step(mesh, task)
     batches = synthetic_batches(
-        "segmentation", 16, seed=1, input_shape=(33, 33), steps=8
+        "segmentation", 16, seed=1, input_shape=(32, 32), steps=6
     )
     losses = []
     for batch in batches:
         state, metrics = train_step(state, shard_batch(batch, mesh))
         losses.append(compute_metrics(metrics)["loss"])
-    assert int(state.step) == 8
+    assert int(state.step) == 6
     assert np.isfinite(losses).all()
     assert np.mean(losses[-3:]) < np.mean(losses[:3])
 
@@ -65,10 +69,10 @@ def test_segmentation_loss_decreases_on_mesh():
 def test_eval_and_predict_steps():
     mesh = make_mesh(8)
     task = SegmentationTask()
-    state = _setup(SMALL_SEG, task, mesh, (1, 33, 33, 2))
+    state = _setup(SMALL_SEG, task, mesh, (1, 32, 32, 2))
     eval_step = make_eval_step(mesh, task)
     predict_step = make_predict_step(mesh, task)
-    batch = next(synthetic_batches("segmentation", 8, seed=2, input_shape=(33, 33)))
+    batch = next(synthetic_batches("segmentation", 8, seed=2, input_shape=(32, 32)))
     sharded = shard_batch(batch, mesh)
 
     acc = None
@@ -79,8 +83,8 @@ def test_eval_and_predict_steps():
     assert acc["metrics/mean_iou"].count == 16  # 8 images x 2 passes
 
     preds = predict_step(state, sharded)
-    assert preds["probabilities"].shape == (8, 33, 33, 1)
-    assert preds["mask"].shape == (8, 33, 33, 1)
+    assert preds["probabilities"].shape == (8, 32, 32, 1)
+    assert preds["mask"].shape == (8, 32, 32, 1)
     probs = np.asarray(preds["probabilities"])
     assert np.all((probs >= 0) & (probs <= 1))
 
@@ -91,7 +95,7 @@ def test_classification_loss_decreases_on_mesh():
     state = _setup(SMALL_CLS, task, mesh, (1, 32, 32, 3))
     train_step = make_train_step(mesh, task)
     batches = synthetic_batches(
-        "classification", 16, seed=3, input_shape=(32, 32), num_classes=4, steps=10
+        "classification", 16, seed=3, input_shape=(32, 32), num_classes=4, steps=12
     )
     losses = []
     for batch in batches:
@@ -112,10 +116,10 @@ def test_sharded_step_matches_single_device():
     """
     mesh = make_mesh(8)
     task = SegmentationTask()
-    state_a = _setup(SMALL_SEG, task, mesh, (1, 33, 33, 2))
-    state_b = _setup(SMALL_SEG, task, mesh, (1, 33, 33, 2))
+    state_a = _setup(SMALL_SEG, task, mesh, (1, 32, 32, 2))
+    state_b = _setup(SMALL_SEG, task, mesh, (1, 32, 32, 2))
     train_step = make_train_step(mesh, task, donate=False)
-    batch = next(synthetic_batches("segmentation", 16, seed=4, input_shape=(33, 33)))
+    batch = next(synthetic_batches("segmentation", 16, seed=4, input_shape=(32, 32)))
     sharded = shard_batch(batch, mesh)
     new_a, m_a = train_step(state_a, sharded)
     new_b, m_b = train_step(state_b, sharded)
@@ -170,9 +174,9 @@ def test_cross_degree_grads():
 def test_state_stays_replicated_after_step():
     mesh = make_mesh(8)
     task = SegmentationTask()
-    state = _setup(SMALL_SEG, task, mesh, (1, 33, 33, 2))
+    state = _setup(SMALL_SEG, task, mesh, (1, 32, 32, 2))
     train_step = make_train_step(mesh, task)
-    batch = next(synthetic_batches("segmentation", 8, seed=5, input_shape=(33, 33)))
+    batch = next(synthetic_batches("segmentation", 8, seed=5, input_shape=(32, 32)))
     state, _ = train_step(state, shard_batch(batch, mesh))
     leaf = jax.tree.leaves(state.params)[0]
     assert leaf.sharding.is_fully_replicated
@@ -183,9 +187,9 @@ def test_eval_step_valid_mask_excludes_padding():
     valid rows — the wrap-around-padding exclusion contract of eval_batches."""
     mesh = make_mesh(8)
     task = SegmentationTask()
-    state = _setup(SMALL_SEG, task, mesh, (1, 33, 33, 2))
+    state = _setup(SMALL_SEG, task, mesh, (1, 32, 32, 2))
     eval_step = make_eval_step(mesh, task)
-    batch = next(synthetic_batches("segmentation", 16, seed=6, input_shape=(33, 33)))
+    batch = next(synthetic_batches("segmentation", 16, seed=6, input_shape=(32, 32)))
 
     # full batch, but only the first 10 rows are real
     valid = np.zeros(16, np.float32)

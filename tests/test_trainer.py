@@ -45,7 +45,8 @@ def trained(salt_dirs, tmp_path_factory):
         train_config=tcfg,
         input_shape=SHAPE,
         n_blocks=(1, 1, 1),
-        base_depth=16,
+        base_depth=8,
+        width_multiplier=0.0625,
     )
     results = trainer.train(ids, batch_size=8, steps=4)
     return trainer, results, model_dir, test, ids
@@ -153,7 +154,8 @@ def test_serving_fn_nchw_boundary(trained, salt_dirs):
         seed=0,
         input_shape=SHAPE,
         n_blocks=(1, 1, 1),
-        base_depth=16,
+        base_depth=8,
+        width_multiplier=0.0625,
     )
     serve = t2.serving_fn(fold=0)
     images = jnp.zeros((2, 2, *SHAPE), jnp.float32)  # [B, C, H, W]
@@ -219,7 +221,7 @@ def test_eval_every_steps_decoupled_from_checkpointing(salt_dirs, tmp_path_facto
     )
     trainer = Trainer(
         model_dir, data, train_config=tcfg,
-        input_shape=SHAPE, n_blocks=(1, 1, 1), base_depth=16,
+        input_shape=SHAPE, n_blocks=(1, 1, 1), base_depth=8, width_multiplier=0.0625,
     )
     trainer.train(ids, batch_size=8, steps=4)
     events = glob.glob(
