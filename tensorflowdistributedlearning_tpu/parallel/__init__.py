@@ -28,6 +28,11 @@ from tensorflowdistributedlearning_tpu.parallel.expert import (
     moe_apply,
     top1_dispatch,
 )
+from tensorflowdistributedlearning_tpu.parallel.ring_attention import (
+    attention_reference,
+    make_ring_attention,
+    ring_attention,
+)
 from tensorflowdistributedlearning_tpu.parallel.pipeline import (
     make_pipeline_fn,
     pipeline_apply,
@@ -50,6 +55,9 @@ __all__ = [
     "reduce_scatter",
     "ring_all_gather",
     "spatial_conv2d",
+    "attention_reference",
+    "make_ring_attention",
+    "ring_attention",
     "global_shard_batch",
     "make_pipeline_fn",
     "moe_apply",

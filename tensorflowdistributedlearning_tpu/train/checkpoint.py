@@ -37,15 +37,18 @@ def _state_pytree(state: TrainState) -> Dict:
     }
 
 
-def _save_pytree(state: TrainState) -> Dict:
+def _save_pytree(state: TrainState, *, to_host: bool) -> Dict:
     """The pytree handed to Orbax for SAVING.
 
-    Single-process: materialize to host numpy first — one bulk ``device_get`` is
-    ~0.01s, while Orbax's jax.Array path walks every leaf's sharding (measured
-    ~20x slower for a small replicated state). Multi-process keeps jax.Arrays so
-    Orbax can coordinate the per-host writes of sharded leaves."""
+    ``to_host=True`` materializes to host numpy first — one bulk ``device_get``
+    is ~0.01s for small states, while Orbax's jax.Array path walks every leaf's
+    sharding (measured ~20x slower for a small replicated state). Callers must
+    keep jax.Arrays (``to_host=False``) when Orbax needs them: multi-process
+    runs (coordinated per-host writes of sharded leaves) and async saves (a
+    synchronous bulk copy here would stall the training thread for exactly the
+    device-to-host transfer async checkpointing exists to overlap)."""
     tree = _state_pytree(state)
-    if jax.process_count() == 1:
+    if to_host and jax.process_count() == 1:
         return jax.device_get(tree)
     return tree
 
@@ -104,7 +107,9 @@ class CheckpointManager:
         if step in self._ckpt.all_steps():
             return False
         saved = self._ckpt.save(
-            step, args=ocp.args.StandardSave(_save_pytree(state)), force=force
+            step,
+            args=ocp.args.StandardSave(_save_pytree(state, to_host=not self._async)),
+            force=force,
         )
         if not self._async:
             self._ckpt.wait_until_finished()
@@ -146,7 +151,7 @@ class CheckpointManager:
             return False
         saved = self._best.save(
             step,
-            args=ocp.args.StandardSave(_save_pytree(state)),
+            args=ocp.args.StandardSave(_save_pytree(state, to_host=True)),
             metrics={self.best_metric: float(metrics[self.best_metric])},
             force=True,
         )

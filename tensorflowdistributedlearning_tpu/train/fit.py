@@ -329,11 +329,7 @@ class ClassifierTrainer:
 
     @property
     def _eval_step(self):
-        if not hasattr(self, "_eval_step_fn"):
-            self._eval_step_fn = step_lib.make_eval_step(
-                self.mesh, self.task, spatial=self._spatial
-            )
-        return self._eval_step_fn
+        return step_lib.make_eval_step(self.mesh, self.task, spatial=self._spatial)
 
 
 def fit_preset(

@@ -447,19 +447,11 @@ class Trainer:
 
     @property
     def _eval_step(self):
-        if not hasattr(self, "_eval_step_fn"):
-            self._eval_step_fn = step_lib.make_eval_step(
-                self.mesh, self.task, spatial=self._spatial
-            )
-        return self._eval_step_fn
+        return step_lib.make_eval_step(self.mesh, self.task, spatial=self._spatial)
 
     @property
     def _predict_step(self):
-        if not hasattr(self, "_predict_step_fn"):
-            self._predict_step_fn = step_lib.make_predict_step(
-                self.mesh, self.task, spatial=self._spatial
-            )
-        return self._predict_step_fn
+        return step_lib.make_predict_step(self.mesh, self.task, spatial=self._spatial)
 
     @property
     def _prepare_eval(self):
