@@ -54,14 +54,23 @@ class ModelConfig:
     # recompute FLOPs for activation HBM — enables large per-chip batches.
     remat: bool = False
     # uniform channel-width scale for every backbone stage (root convs, residual
-    # stages, Xception flows). 1.0 keeps the reference widths (core/resnet.py:333-344,
-    # core/xception.py:405-465); fractional values give width-scaled variants
-    # (Wide-ResNet-style scaling, and the knob that makes tiny CI models actually
-    # tiny — the stage widths are otherwise fixed constants).
+    # stages, Xception flows, ViT embed dim). 1.0 keeps the reference widths
+    # (core/resnet.py:333-344, core/xception.py:405-465); fractional values give
+    # width-scaled variants (Wide-ResNet-style scaling, and the knob that makes
+    # tiny CI models actually tiny — the stage widths are otherwise fixed
+    # constants).
     width_multiplier: float = 1.0
+    # ViT family knobs (backbone="vit" — beyond-parity: the transformer
+    # classifier that consumes parallel/ring_attention.py under sequence
+    # parallelism; defaults are ViT-S/16).
+    patch_size: int = 16
+    embed_dim: int = 384
+    vit_layers: int = 12
+    num_heads: int = 6
+    mlp_ratio: float = 4.0
 
     def __post_init__(self):
-        if self.backbone not in ("resnet", "xception"):
+        if self.backbone not in ("resnet", "xception", "vit"):
             raise ValueError(f"Unknown backbone {self.backbone!r}")
         if self.block_type not in ("bottleneck", "basic_block"):
             raise ValueError(f"Unknown block type {self.block_type!r}")

@@ -97,6 +97,21 @@ PRESETS: Dict[str, Preset] = {
         description="Xception-41 ImageNet-1k data-parallel, bf16 (the backbone the "
         "reference shipped broken, fixed here — SURVEY §2.4.8-10)",
     ),
+    # Beyond-parity: ViT-S/16 — the transformer classifier whose attention runs
+    # as ring attention under sequence_parallel (parallel/ring_attention.py)
+    "vit_s16_imagenet": Preset(
+        model=_imagenet_model(
+            backbone="vit",
+            patch_size=16,
+            embed_dim=384,
+            vit_layers=12,
+            num_heads=6,
+        ),
+        train=_IMAGENET_1K_TRAIN,
+        global_batch=1024,
+        description="ViT-S/16 ImageNet-1k, bf16; sequence-parallelizable via "
+        "ring attention (--sequence-parallel)",
+    ),
     # BASELINE.json "ResNet-50 bfloat16 large-batch (8k) on v5e-64 pod"
     "resnet50_bf16_8k": Preset(
         model=_imagenet_model(n_blocks=(3, 4, 6), remat=True),

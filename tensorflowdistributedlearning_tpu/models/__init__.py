@@ -10,6 +10,10 @@ from tensorflowdistributedlearning_tpu.models.resnet import (
     ResNetSegmentation,
     build_model,
 )
+from tensorflowdistributedlearning_tpu.models.vit import (
+    TransformerBlock,
+    ViTClassifier,
+)
 from tensorflowdistributedlearning_tpu.models.xception import (
     Xception41,
     XceptionBackbone,
@@ -25,6 +29,8 @@ __all__ = [
     "ResNetClassifier",
     "ResNetSegmentation",
     "build_model",
+    "TransformerBlock",
+    "ViTClassifier",
     "Xception41",
     "XceptionBackbone",
     "XceptionSegmentation",

@@ -531,6 +531,14 @@ def _build_model_cached(
     bn_axis_name: Optional[str],
     spatial_axis_name: Optional[str],
 ) -> nn.Module:
+    if config.backbone == "vit":
+        from tensorflowdistributedlearning_tpu.models.vit import ViTClassifier
+
+        return ViTClassifier(
+            config,
+            bn_axis_name=bn_axis_name,
+            spatial_axis_name=spatial_axis_name,
+        )
     if config.backbone == "resnet":
         if config.num_classes is None:
             return ResNetSegmentation(

@@ -1,0 +1,196 @@
+"""Vision Transformer classifier — the model family that consumes ring attention.
+
+Beyond-parity: the reference framework is CNN-only (SURVEY §5.7 — no attention op
+anywhere), but this framework's long-context story (``parallel/ring_attention.py``)
+needs a first-class consumer in the training stack, not a standalone demo. This is
+a standard pre-LN ViT (Dosovitskiy et al., arXiv:2010.11929): patch-embed conv,
+learned position embeddings, N transformer blocks, global-average-pool head —
+trainable through the same SPMD train step and ``fit`` loop as the CNN classifiers
+(``ClassificationTask``; no BatchNorm, so the batch_stats pytree is empty).
+
+Sequence parallelism: with ``spatial_axis_name`` set, the input arrives H-sharded
+(``shard_batch_spatial``), each shard patch-embeds its own rows into a contiguous
+block of the row-major token sequence, attention runs as exact blockwise RING
+attention over the sequence axis (K/V rotating one ppermute hop per step), and the
+pooled head ``pmean``s across shards — so one chip never materializes the full
+token sequence. MLPs and LayerNorms are token-local and need no communication.
+
+TPU notes: matmul-dominated (QKV/proj/MLP ride the MXU), compute dtype follows
+``ModelConfig.dtype`` with float32 params and float32 softmax accumulation,
+``remat`` wraps each block in ``jax.checkpoint`` for activation memory.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tensorflowdistributedlearning_tpu.config import ModelConfig
+from tensorflowdistributedlearning_tpu.models.layers import scaled_width
+from tensorflowdistributedlearning_tpu.parallel.ring_attention import (
+    attention_reference,
+    ring_attention,
+)
+
+
+class MultiHeadSelfAttention(nn.Module):
+    """QKV projection + exact attention + output projection. ``spatial_axis_name``
+    selects the ring formulation over the sequence mesh axis; both paths share the
+    same float32-softmax math, so sharded and unsharded forwards agree to
+    reassociation tolerance."""
+
+    embed_dim: int
+    num_heads: int
+    spatial_axis_name: Optional[str] = None
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, t, d = x.shape
+        head_dim = self.embed_dim // self.num_heads
+        qkv = nn.Dense(3 * self.embed_dim, dtype=self.dtype, name="qkv")(x)
+        qkv = qkv.reshape(b, t, 3, self.num_heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, T, H, hd]
+        if self.spatial_axis_name is not None:
+            out = ring_attention(q, k, v, axis_name=self.spatial_axis_name)
+        else:
+            out = attention_reference(q, k, v)
+        out = out.reshape(b, t, self.embed_dim)
+        return nn.Dense(self.embed_dim, dtype=self.dtype, name="proj")(out)
+
+
+class TransformerBlock(nn.Module):
+    """Pre-LN block: x + MHSA(LN(x)); x + MLP(LN(x))."""
+
+    embed_dim: int
+    num_heads: int
+    mlp_dim: int
+    spatial_axis_name: Optional[str] = None
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        x = x + MultiHeadSelfAttention(
+            self.embed_dim,
+            self.num_heads,
+            spatial_axis_name=self.spatial_axis_name,
+            dtype=self.dtype,
+            name="attn",
+        )(h)
+        h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_in")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.embed_dim, dtype=self.dtype, name="mlp_out")(h)
+        return x + h
+
+
+class ViTClassifier(nn.Module):
+    """ViT classification network: [B, H, W, C] -> [B, num_classes] float32 logits.
+
+    Under ``spatial_axis_name`` the input is the device's H-shard; its patches form
+    tokens ``[axis_index * T_local, (axis_index + 1) * T_local)`` of the row-major
+    global sequence (matching ring attention's block-order convention), and the
+    position-embedding table is sliced accordingly."""
+
+    config: ModelConfig
+    bn_axis_name: Optional[str] = None  # accepted for factory symmetry; ViT has no BN
+    spatial_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        cfg = self.config
+        if cfg.num_classes is None:
+            raise ValueError(
+                "backbone='vit' supports the classification head only "
+                "(set num_classes)"
+            )
+        p = cfg.patch_size
+        embed = scaled_width(cfg.embed_dim, cfg.width_multiplier)
+        if embed % cfg.num_heads != 0:
+            raise ValueError(
+                f"scaled embed_dim {embed} not divisible by num_heads "
+                f"{cfg.num_heads}"
+            )
+        h_total, w_total = cfg.input_shape
+        if h_total % p or w_total % p:
+            raise ValueError(
+                f"input_shape {cfg.input_shape} not divisible by patch_size {p}"
+            )
+        # Validate the ACTUAL input against the configured geometry: the position
+        # table is laid out row-major for input_shape's patch grid, so a
+        # different-sized input would silently index wrong embeddings.
+        h_local, w_actual = x.shape[1], x.shape[2]
+        if w_actual != w_total:
+            raise ValueError(
+                f"input width {w_actual} != configured input_shape width {w_total}"
+            )
+        if self.spatial_axis_name is not None:
+            degree = lax.axis_size(self.spatial_axis_name)
+            if h_local * degree != h_total:
+                raise ValueError(
+                    f"per-shard height {h_local} x sequence degree {degree} != "
+                    f"configured input height {h_total}"
+                )
+        elif h_local != h_total:
+            raise ValueError(
+                f"input height {h_local} != configured input_shape height {h_total}"
+            )
+        if h_local % p:
+            raise ValueError(
+                f"per-shard height {h_local} not divisible by patch_size {p} — "
+                "lower sequence_parallel or the patch size"
+            )
+        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        x = x.astype(dtype)
+
+        tokens = nn.Conv(
+            embed,
+            (p, p),
+            strides=(p, p),
+            padding="VALID",
+            dtype=dtype,
+            name="patch_embed",
+        )(x)
+        b = tokens.shape[0]
+        t_local = tokens.shape[1] * tokens.shape[2]
+        tokens = tokens.reshape(b, t_local, embed)
+
+        t_global = (h_total // p) * (w_total // p)
+        pos = self.param(
+            "pos_embedding",
+            nn.initializers.normal(stddev=0.02),
+            (t_global, embed),
+            jnp.float32,
+        )
+        if self.spatial_axis_name is not None:
+            offset = lax.axis_index(self.spatial_axis_name) * t_local
+            pos_local = lax.dynamic_slice_in_dim(pos, offset, t_local, axis=0)
+        else:
+            pos_local = pos[:t_local]
+        tokens = tokens + pos_local.astype(dtype)[None]
+
+        block_cls = TransformerBlock
+        if cfg.remat:
+            block_cls = nn.remat(block_cls, static_argnums=(2,))
+        mlp_dim = int(embed * cfg.mlp_ratio)
+        for i in range(cfg.vit_layers):
+            tokens = block_cls(
+                embed,
+                cfg.num_heads,
+                mlp_dim,
+                spatial_axis_name=self.spatial_axis_name,
+                dtype=dtype,
+                name=f"block{i + 1}",
+            )(tokens, train)
+
+        tokens = nn.LayerNorm(dtype=dtype, name="ln_final")(tokens)
+        pooled = jnp.mean(tokens.astype(jnp.float32), axis=1)
+        if self.spatial_axis_name is not None:
+            # equal-sized shards: the global token mean is the pmean of locals
+            pooled = lax.pmean(pooled, self.spatial_axis_name)
+        return nn.Dense(cfg.num_classes, name="logits")(pooled)

@@ -33,7 +33,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tensorflowdistributedlearning_tpu.parallel.mesh import BATCH_AXIS, SEQUENCE_AXIS
 
 # Large-negative mask value: -inf would poison rows whose every key is masked
-# (exp(-inf - -inf) = nan); a finite sentinel keeps those rows exactly zero.
+# (exp(-inf - -inf) = nan). NOTE: a row with NO visible key degrades to a uniform
+# softmax (output = mean of V) — identical in both the ring and reference
+# formulations, and unreachable for causal SELF-attention (the diagonal is always
+# visible). Anyone adding padding/document masks must zero such rows explicitly.
 _MASK_VALUE = -1e30
 
 
@@ -93,8 +96,7 @@ def ring_attention(
 
     q_pos = my_idx * s_loc + jnp.arange(s_loc)  # global query positions
 
-    def step(carry, step_no):
-        o, m, l, k_blk, v_blk = carry
+    def block_update(o, m, l, k_blk, v_blk, step_no):
         # the block held at ring step t originated on device (my_idx - t) mod n
         src = (my_idx - step_no) % n
         scores = (
@@ -111,13 +113,24 @@ def ring_attention(
         o = o * correction + jnp.einsum(
             "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
         )
+        return o, m_new, l
+
+    # step 0 attends to the locally-held block before any rotation; the scan
+    # then does [rotate, attend] for steps 1..n-1 — so exactly n-1 rotations
+    # happen and no ppermute's result is discarded
+    o, m, l = block_update(o0, m0, l0, k, v, 0)
+
+    def step(carry, step_no):
+        o, m, l, k_blk, v_blk = carry
         k_blk = lax.ppermute(k_blk, axis_name, _ring_perm(n))
         v_blk = lax.ppermute(v_blk, axis_name, _ring_perm(n))
-        return (o, m_new, l, k_blk, v_blk), None
+        o, m, l = block_update(o, m, l, k_blk, v_blk, step_no)
+        return (o, m, l, k_blk, v_blk), None
 
-    (o, _, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
-    # rows with no visible key (impossible for causal self-attention, but cheap
-    # to guard) divide by 1 instead of 0
+    if n > 1:
+        (o, _, l, _, _), _ = lax.scan(step, (o, m, l, k, v), jnp.arange(1, n))
+    # the guard only engages for rows with no visible key under future mask
+    # extensions (see _MASK_VALUE note); causal self-attention never hits it
     out = o / jnp.maximum(l, 1e-30)
     return jnp.transpose(out, (0, 2, 1, 3)).astype(orig_dtype)  # [B, S/n, H, D]
 

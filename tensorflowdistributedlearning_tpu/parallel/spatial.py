@@ -345,13 +345,18 @@ def validate_spatial_config(model_config, sequence_parallel: int) -> None:
     """
     if sequence_parallel <= 1:
         return
-    overall = model_config.output_stride or 32
+    if getattr(model_config, "backbone", None) == "vit":
+        # ViT: each shard patch-embeds its own rows, so the only constraint is
+        # whole patches per shard (attention itself is the ring — degree-free)
+        overall = model_config.patch_size
+    else:
+        overall = model_config.output_stride or 32
     required = overall * sequence_parallel
     h = model_config.input_shape[0]
     if h % required != 0:
         raise ValueError(
             f"sequence_parallel={sequence_parallel} requires the input height "
-            f"to be divisible by overall_stride*sequence_parallel = "
+            f"to be divisible by stride*sequence_parallel = "
             f"{overall}*{sequence_parallel} = {required}, got {h}. Pad/resize "
             f"the input (e.g. {-(-h // required) * required}) or lower the "
             "sequence-parallel degree."

@@ -206,7 +206,7 @@ def test_fit_rejects_unshardable_spatial_config(tmp_path):
     from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
     from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
 
-    with pytest.raises(ValueError, match="divisible by overall_stride"):
+    with pytest.raises(ValueError, match="divisible by stride"):
         ClassifierTrainer(
             str(tmp_path),
             None,
