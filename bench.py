@@ -251,6 +251,16 @@ def run_benchmark(platform: str | None = None) -> dict:
             result["depthwise_kernels"] = {"error": str(e)[:200]}
         print(json.dumps(result), flush=True)
 
+        # Pallas-vs-XLA fused attention at ViT-S shapes: the decision data for
+        # use_fused_attention, same contract as the depthwise column.
+        try:
+            from bench_kernels import bench_attention
+
+            result["attention_kernels"] = bench_attention(iters=20, warmup=3)
+        except Exception as e:  # noqa: BLE001
+            result["attention_kernels"] = {"error": str(e)[:200]}
+        print(json.dumps(result), flush=True)
+
         # Secondary metric: the reference's ACTUAL production workload — the
         # TGS-salt segmentation flagship (ResNet-v2-beta + DeepLabV3+ head,
         # 101x101x2, Lovász hinge) at 64 images PER CHIP — the reference's

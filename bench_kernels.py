@@ -20,6 +20,24 @@ import time
 from typing import Dict
 
 
+def _timed_us(fn, args, iters: int, warmup: int) -> float:
+    """Shared measurement protocol for every kernel comparison in this file:
+    compile once, warm up, then one synchronized timed loop (microseconds per
+    call). Keeping one copy keeps the pallas/XLA decision columns comparable."""
+    import jax
+
+    out = fn(*args)  # compile
+    jax.block_until_ready(out)
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e6
+
+
 def bench_depthwise(
     batch: int = 32,
     hw: int = 13,
@@ -41,24 +59,16 @@ def bench_depthwise(
     w = rng.normal(0, 0.3, (3, 3, channels)).astype(np.float32)
     x, w = jax.device_put(x), jax.device_put(w)
 
-    def timed(fn) -> float:
-        out = fn(x, w)  # compile
-        jax.block_until_ready(out)
-        for _ in range(warmup):
-            out = fn(x, w)
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(x, w)
-        jax.block_until_ready(out)
-        return (time.perf_counter() - t0) / iters * 1e6  # us
-
     results: Dict = {}
     wins = 0
     for rate in rates:
-        pallas_us = timed(jax.jit(lambda a, b, r=rate: depthwise_conv2d(a, b, r)))
-        xla_us = timed(
-            jax.jit(lambda a, b, r=rate: depthwise_conv2d_reference(a, b, r))
+        pallas_us = _timed_us(
+            jax.jit(lambda a, b, r=rate: depthwise_conv2d(a, b, r)),
+            (x, w), iters, warmup,
+        )
+        xla_us = _timed_us(
+            jax.jit(lambda a, b, r=rate: depthwise_conv2d_reference(a, b, r)),
+            (x, w), iters, warmup,
         )
         results[f"rate{rate}"] = {
             "pallas_us": round(pallas_us, 1),
@@ -71,6 +81,55 @@ def bench_depthwise(
     return results
 
 
+def bench_attention(
+    batch: int = 32,
+    heads: int = 6,
+    head_dim: int = 64,
+    seq_lens=(196, 1024),
+    iters: int = 30,
+    warmup: int = 5,
+) -> Dict:
+    """Fused Pallas block attention vs the XLA einsum path at ViT-S shapes
+    (T=196 is ViT-S/16 at 224x224; T=1024 is the long-block regime the ring
+    hands each device). bf16 inputs, float32 softmax both ways.
+    ``use_fused_attention`` should be flipped on iff the Pallas column wins."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowdistributedlearning_tpu.ops.flash_attention import flash_attention
+    from tensorflowdistributedlearning_tpu.parallel.ring_attention import (
+        attention_reference,
+    )
+
+    rng = np.random.default_rng(1)
+    results: Dict = {}
+    wins = 0
+    for t in seq_lens:
+        q, k, v = (
+            jax.device_put(
+                rng.normal(0, 1, (batch, t, heads, head_dim)).astype(np.float32)
+            ).astype(jnp.bfloat16)
+            for _ in range(3)
+        )
+
+        pallas_us = _timed_us(
+            jax.jit(lambda a, b, c: flash_attention(a, b, c)), (q, k, v), iters, warmup
+        )
+        xla_us = _timed_us(
+            jax.jit(lambda a, b, c: attention_reference(a, b, c)), (q, k, v), iters, warmup
+        )
+        results[f"seq{t}"] = {
+            "pallas_us": round(pallas_us, 1),
+            "xla_us": round(xla_us, 1),
+            "speedup": round(xla_us / pallas_us, 3),
+        }
+        wins += pallas_us < xla_us
+    results["pallas_wins"] = bool(wins > len(seq_lens) / 2)
+    results["shape"] = [batch, "T", heads, head_dim]
+    return results
+
+
 def main() -> None:
     import jax
 
@@ -79,6 +138,15 @@ def main() -> None:
     out = bench_depthwise()
     out["platform"] = jax.default_backend()
     print(json.dumps(out), flush=True)
+    if jax.default_backend() == "tpu":
+        attn = bench_attention()
+    else:
+        # off-TPU the kernel runs in the (slow) Pallas interpreter; tiny shapes
+        # keep the smoke run bounded — the decision data only means anything on
+        # real hardware anyway
+        attn = bench_attention(batch=2, seq_lens=(64,), iters=3, warmup=1)
+    attn["platform"] = jax.default_backend()
+    print(json.dumps({"attention": attn}), flush=True)
 
 
 if __name__ == "__main__":

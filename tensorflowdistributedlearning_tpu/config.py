@@ -68,6 +68,12 @@ class ModelConfig:
     vit_layers: int = 12
     num_heads: int = 6
     mlp_ratio: float = 4.0
+    # route ViT attention through the fused Pallas block-attention kernel
+    # (ops/flash_attention.py) instead of the XLA einsum path; parameter trees
+    # are identical, so this is a pure execution-path switch. Ignored (with a
+    # warning) under sequence_parallel>1, where the ring formulation owns the
+    # attention math.
+    use_fused_attention: bool = False
 
     def __post_init__(self):
         if self.backbone not in ("resnet", "xception", "vit"):

@@ -41,12 +41,14 @@ class MultiHeadSelfAttention(nn.Module):
     """QKV projection + exact attention + output projection. ``spatial_axis_name``
     selects the ring formulation over the sequence mesh axis; both paths share the
     same float32-softmax math, so sharded and unsharded forwards agree to
-    reassociation tolerance."""
+    reassociation tolerance. ``use_fused`` swaps the XLA einsum path for the
+    Pallas fused block-attention kernel (same contract, VMEM-resident scores)."""
 
     embed_dim: int
     num_heads: int
     spatial_axis_name: Optional[str] = None
     dtype: Optional[jnp.dtype] = None
+    use_fused: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -56,7 +58,21 @@ class MultiHeadSelfAttention(nn.Module):
         qkv = qkv.reshape(b, t, 3, self.num_heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, T, H, hd]
         if self.spatial_axis_name is not None:
+            if self.use_fused:
+                import warnings
+
+                warnings.warn(
+                    "use_fused_attention is ignored under sequence parallelism: "
+                    "the ring formulation owns the attention math there",
+                    stacklevel=2,
+                )
             out = ring_attention(q, k, v, axis_name=self.spatial_axis_name)
+        elif self.use_fused:
+            from tensorflowdistributedlearning_tpu.ops.flash_attention import (
+                flash_attention,
+            )
+
+            out = flash_attention(q, k, v)
         else:
             out = attention_reference(q, k, v)
         out = out.reshape(b, t, self.embed_dim)
@@ -71,6 +87,7 @@ class TransformerBlock(nn.Module):
     mlp_dim: int
     spatial_axis_name: Optional[str] = None
     dtype: Optional[jnp.dtype] = None
+    use_fused: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -80,6 +97,7 @@ class TransformerBlock(nn.Module):
             self.num_heads,
             spatial_axis_name=self.spatial_axis_name,
             dtype=self.dtype,
+            use_fused=self.use_fused,
             name="attn",
         )(h)
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
@@ -185,6 +203,7 @@ class ViTClassifier(nn.Module):
                 mlp_dim,
                 spatial_axis_name=self.spatial_axis_name,
                 dtype=dtype,
+                use_fused=cfg.use_fused_attention,
                 name=f"block{i + 1}",
             )(tokens, train)
 
