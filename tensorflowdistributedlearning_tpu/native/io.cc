@@ -337,14 +337,10 @@ bool ResizeToFloat(const unsigned char* src, int src_h, int src_w, int src_c,
 }
 
 // PNG or JPEG (magic-byte sniff) at any size -> float32 [h, w, channels].
-bool DecodeImageOne(const char* path, float* out, int h, int w, int channels) {
-  FILE* fp = std::fopen(path, "rb");
-  if (!fp) return false;
+// Decode an already-open PNG/JPEG stream (file or fmemopen'd record blob).
+bool DecodeImageStream(FILE* fp, float* out, int h, int w, int channels) {
   unsigned char magic[2];
-  if (std::fread(magic, 1, 2, fp) != 2) {
-    std::fclose(fp);
-    return false;
-  }
+  if (std::fread(magic, 1, 2, fp) != 2) return false;
   std::rewind(fp);
   std::vector<unsigned char> pixels;
   int img_h = 0, img_w = 0, img_c = 0;
@@ -358,9 +354,16 @@ bool DecodeImageOne(const char* path, float* out, int h, int w, int channels) {
   } else {
     ok = DecodePngNative(fp, &pixels, &img_h, &img_w, &img_c);
   }
-  std::fclose(fp);
   if (!ok) return false;
   return ResizeToFloat(pixels.data(), img_h, img_w, img_c, out, h, w, channels);
+}
+
+bool DecodeImageOne(const char* path, float* out, int h, int w, int channels) {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) return false;
+  bool ok = DecodeImageStream(fp, out, h, w, channels);
+  std::fclose(fp);
+  return ok;
 }
 
 // Shared work-stealing thread harness for both batch entry points: decode each
@@ -418,6 +421,46 @@ int tfdl_decode_png_batch(const char** paths, int n, float* out, int h, int w,
 int tfdl_decode_image_batch(const char** paths, int n, float* out, int h, int w,
                             int channels, int n_threads) {
   return DecodeBatch(DecodeImageOne, paths, n, out, h, w, channels, n_threads);
+}
+
+// In-memory twin of tfdl_decode_image_batch for record payloads: each blob is
+// wrapped with fmemopen so the stream decoders run unchanged. Same minimal-
+// failing-index contract as DecodeBatch.
+int tfdl_decode_image_blob_batch(const unsigned char** blobs,
+                                 const unsigned long long* sizes, int n,
+                                 float* out, int h, int w, int channels,
+                                 int n_threads) {
+  if (n <= 0) return 0;
+  if (n_threads <= 0) n_threads = 1;
+  if (n_threads > n) n_threads = n;
+
+  std::atomic<int> next(0);
+  std::atomic<int> min_error(n);
+  const int64_t stride = static_cast<int64_t>(h) * w * channels;
+
+  auto worker = [&]() {
+    for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) {
+      if (i > min_error.load(std::memory_order_relaxed)) continue;
+      FILE* fp = fmemopen(const_cast<unsigned char*>(blobs[i]),
+                          static_cast<size_t>(sizes[i]), "rb");
+      bool ok = fp != nullptr &&
+                DecodeImageStream(fp, out + i * stride, h, w, channels);
+      if (fp) std::fclose(fp);
+      if (!ok) {
+        int cur = min_error.load();
+        while (i < cur && !min_error.compare_exchange_weak(cur, i)) {
+        }
+      }
+    }
+  };
+
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  for (int t = 0; t < n_threads; ++t) threads.emplace_back(worker);
+  for (auto& t : threads) t.join();
+
+  const int err = min_error.load();
+  return err >= n ? 0 : 1 + err;
 }
 
 const char* tfdl_version() { return "tfdl-io 0.2.0"; }

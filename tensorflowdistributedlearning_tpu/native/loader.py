@@ -31,23 +31,22 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
-    tmp = f"{_LIB}.{os.getpid()}.tmp"  # pid-unique: parallel builders never collide
-    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", _SRC]
-    # Prefer full PNG+JPEG support; on hosts without libjpeg fall back to a
-    # PNG-only build (TFDL_NO_JPEG) so the native PNG fast path survives —
-    # decode_image_batch then PIL-decodes JPEG files one at a time.
-    variants = [
-        base + ["-lpng", "-ljpeg", "-o", tmp],
-        base + ["-DTFDL_NO_JPEG", "-lpng", "-o", tmp],
-    ]
+def _build_library(
+    src: str, target: str, variant_flags: Sequence[Sequence[str]]
+) -> Optional[str]:
+    """Compile ``src`` into ``target`` trying flag variants in order (pid-unique
+    temp + atomic install — the shared build core for every native library in
+    this package). Returns the install path, or None with a warning."""
+    tmp = f"{target}.{os.getpid()}.tmp"  # pid-unique: parallel builders never collide
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", src]
     last_err: Exception | None = None
-    for cmd in variants:
+    for flags in variant_flags:
+        cmd = base + list(flags) + ["-o", tmp]
         try:
-            os.makedirs(_BUILD_DIR, exist_ok=True)
+            os.makedirs(os.path.dirname(target), exist_ok=True)
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-            os.replace(tmp, _LIB)  # atomic install; concurrent winners are identical
-            return True
+            os.replace(tmp, target)  # atomic; concurrent winners are identical
+            return target
         except (
             subprocess.CalledProcessError,
             subprocess.TimeoutExpired,
@@ -56,11 +55,24 @@ def _build() -> bool:
             last_err = e
     detail = getattr(last_err, "stderr", b"")
     logger.warning(
-        "native IO build failed (%s); falling back to PIL decode. %s",
+        "native build of %s failed (%s); using Python fallback. %s",
+        os.path.basename(src),
         last_err,
         detail.decode()[:500] if detail else "",
     )
-    return False
+    return None
+
+
+def _build() -> bool:
+    # Prefer full PNG+JPEG support; on hosts without libjpeg fall back to a
+    # PNG-only build (TFDL_NO_JPEG) so the native PNG fast path survives —
+    # decode_image_batch then PIL-decodes JPEG files one at a time.
+    return (
+        _build_library(
+            _SRC, _LIB, [["-lpng", "-ljpeg"], ["-DTFDL_NO_JPEG", "-lpng"]]
+        )
+        is not None
+    )
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -219,3 +231,100 @@ def decode_image_batch(
         out[bad] = _decode_pil_resize([paths[bad]], h, w, channels)[0]
         start = bad + 1
     return out
+
+
+def _decode_pil_blobs(
+    blobs: Sequence[bytes], h: int, w: int, channels: int
+) -> np.ndarray:
+    import io as io_lib
+
+    from PIL import Image
+
+    out = np.empty((len(blobs), h, w, channels), np.float32)
+    for i, blob in enumerate(blobs):
+        with Image.open(io_lib.BytesIO(blob)) as im:
+            im = im.convert("L" if channels == 1 else "RGB")
+            if im.size != (w, h):
+                im = im.resize((w, h), Image.BILINEAR)
+            arr = np.asarray(im, np.float32) / 255.0
+        out[i] = arr[:, :, None] if channels == 1 else arr
+    return out
+
+
+def decode_image_blobs(
+    blobs: Sequence[bytes],
+    shape,
+    channels: int = 3,
+    n_threads: Optional[int] = None,
+) -> np.ndarray:
+    """Decode in-memory PNG/JPEG byte strings (record payloads) into
+    [N, h, w, channels] float32 in [0, 1], antialias-resized — the blob twin of
+    ``decode_image_batch``. Native multithreaded when available (fmemopen'd
+    streams, GIL-free), else PIL; native per-blob failures fall back to PIL one
+    at a time under the same minimal-failing-index contract."""
+    h, w = shape
+    blobs = list(blobs)
+    if not blobs:
+        return np.empty((0, h, w, channels), np.float32)
+    lib = _load()
+    if lib is None or not hasattr(lib, "tfdl_decode_image_blob_batch"):
+        return _decode_pil_blobs(blobs, h, w, channels)
+    if n_threads is None:
+        n_threads = min(len(blobs), os.cpu_count() or 1)
+    out = np.empty((len(blobs), h, w, channels), np.float32)
+    bufs = [np.frombuffer(b, np.uint8) for b in blobs]  # keep refs alive
+    start = 0
+    while start < len(blobs):
+        chunk = bufs[start:]
+        ptrs = (ctypes.POINTER(ctypes.c_ubyte) * len(chunk))(
+            *[b.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)) for b in chunk]
+        )
+        sizes = (ctypes.c_ulonglong * len(chunk))(*[b.size for b in chunk])
+        rc = lib.tfdl_decode_image_blob_batch(
+            ptrs,
+            sizes,
+            len(chunk),
+            out[start:].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            h,
+            w,
+            channels,
+            n_threads,
+        )
+        if rc == 0:
+            break
+        bad = start + rc - 1
+        out[bad] = _decode_pil_blobs([blobs[bad]], h, w, channels)[0]
+        start = bad + 1
+    return out
+
+
+_extra_lock = threading.Lock()
+_extra_libs: dict = {}
+
+
+def load_extra_library(
+    src_name: str, lib_name: str, *, link_png: bool = False
+) -> Optional[ctypes.CDLL]:
+    """Build-and-load another single-source native library from this package
+    directory via the shared build core (mtime-checked, atomic install); None
+    when no toolchain is available."""
+    with _extra_lock:
+        if src_name in _extra_libs:
+            return _extra_libs[src_name]
+        src = os.path.join(_HERE, src_name)
+        target = os.path.join(_BUILD_DIR, lib_name)
+        lib = None
+        try:
+            fresh = os.path.exists(target) and os.path.getmtime(
+                target
+            ) >= os.path.getmtime(src)
+            if fresh or _build_library(
+                src, target, [["-lpng"] if link_png else []]
+            ):
+                lib = ctypes.CDLL(target)
+        except OSError as e:
+            logger.warning("native %s load failed (%s); using Python fallback",
+                           src_name, e)
+            lib = None
+        _extra_libs[src_name] = lib
+        return lib

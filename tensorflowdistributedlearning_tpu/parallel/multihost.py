@@ -99,6 +99,20 @@ def eval_num_batches(global_n: int, per_process_batch: int) -> int:
     return max(1, -(-max_shard // per_process_batch))
 
 
+def all_processes_max_batches(local_n: int, per_process_batch: int) -> int:
+    """Equalized eval step count when each process holds its OWN record shards
+    (sizes unknown globally): every process contributes ceil(local_n / batch)
+    and all run the cross-process maximum, padding with valid=0 batches
+    (``data.records.ClassificationRecords.batches(pad_to_batches=...)``)."""
+    mine = max(1, -(-local_n // per_process_batch)) if local_n else 1
+    if jax.process_count() == 1:
+        return mine
+    from jax.experimental import multihost_utils
+
+    counts = multihost_utils.process_allgather(np.asarray(mine, np.int32))
+    return int(np.max(counts))
+
+
 def process_local_rows(global_batch: int, mesh: Mesh) -> np.ndarray:
     """Row indices of a batch-axis-sharded global batch owned by THIS process.
 

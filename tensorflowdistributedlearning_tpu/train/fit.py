@@ -119,6 +119,35 @@ class ClassifierTrainer:
 
     # -- data -------------------------------------------------------------
 
+    def _open_records(self, split: str):
+        """Record-sharded source for ``split`` ({data_dir}/{split}-*.tfrecord),
+        already reduced to this process's shard subset; None when absent."""
+        if self.data_dir is None:
+            return None
+        from tensorflowdistributedlearning_tpu.data import records as records_lib
+
+        cfg = self.model_config
+        try:
+            ds = records_lib.ClassificationRecords(
+                self.data_dir,
+                split=split,
+                image_shape=cfg.input_shape,
+                channels=cfg.input_channels,
+                num_classes=cfg.num_classes,
+            )
+        except ValueError:  # no shards for this split
+            return None
+        n_shards = len(ds.paths)
+        ds.paths = records_lib.host_shard_paths(ds.paths)
+        if not ds.paths:
+            raise ValueError(
+                f"{split} has {n_shards} record shard(s) for "
+                f"{jax.process_count()} processes — every process needs at "
+                "least one; re-shard the dataset (write_classification_shards"
+                "(shards>=process_count))"
+            )
+        return ds
+
     def _open_split(self, split: str) -> Optional[imagefolder.ImageFolder]:
         if self.data_dir is None:
             return None
@@ -141,6 +170,16 @@ class ClassifierTrainer:
     ) -> Iterator[Dict[str, np.ndarray]]:
         tcfg = self.train_config
         local_bs = multihost.per_process_batch_size(batch_size)
+        # record-sharded source first: {data_dir}/train-*.tfrecord (the
+        # ImageNet-scale on-disk form; native threaded reader + blob decode,
+        # data/records.py). Each process streams its own shard subset.
+        records_ds = self._open_records("train")
+        if records_ds is not None:
+            return records_ds.batches(
+                local_bs,
+                seed=tcfg.seed + jax.process_index(),
+                steps=steps,
+            )
         train_split = self._open_split("train")
         if train_split is None:
             cfg = self.model_config
@@ -290,10 +329,20 @@ class ClassifierTrainer:
         return mesh_lib.replicate(state, self.mesh)
 
     def _evaluate(self, state: TrainState, batch_size: int) -> Dict[str, float]:
-        """One eval pass: the ``val`` split when present, else ``train`` (read in
-        order, no augmentation), else one synthetic pass."""
+        """One eval pass: the ``val`` split when present (ImageFolder or record
+        shards), else ``train`` (read in order, no augmentation), else one
+        synthetic pass — EXCEPT when training came from record shards, where a
+        synthetic fallback would drive best-checkpoint selection with accuracy
+        on noise; that case evaluates one pass over the train records instead."""
         tcfg = self.train_config
         local_bs = multihost.per_process_batch_size(batch_size)
+        eval_records = self._open_records("val")
+        if eval_records is None and self._open_split("val") is None:
+            # no val split at all: records-trained runs eval on their train
+            # records rather than silently on synthetic noise
+            eval_records = self._open_records("train")
+        if eval_records is not None:
+            return self._evaluate_records(state, eval_records, local_bs)
         eval_split = self._open_split("val") or self._open_split("train")
         eval_step = self._eval_step
         acc = None
@@ -317,6 +366,36 @@ class ClassifierTrainer:
             batches = imagefolder.eval_batches(
                 eval_split.host_shard(), local_bs, num_batches=num
             )
+        for raw in batches:
+            batch = multihost.global_shard_batch(
+                raw, self.mesh, spatial=self._spatial
+            )
+            metrics = eval_step(state, batch)
+            acc = step_lib.merge_metrics(acc, jax.device_get(metrics))
+        result = step_lib.compute_metrics(acc)
+        logger.info("eval @ %d: %s", int(jax.device_get(state.step)), result)
+        return result
+
+    def _evaluate_records(
+        self, state: TrainState, ds, local_bs: int
+    ) -> Dict[str, float]:
+        """One streaming eval pass over record shards. Every process runs the
+        same number of collective-bearing steps: batch counts are equalized to
+        the cross-process MAXIMUM (counted from the record framing, cheap header
+        scan), with wrap-around refill and `valid` masking excluding both the
+        wrapped rows and the final batch's padding from the metrics."""
+        from tensorflowdistributedlearning_tpu.data import records as records_lib
+
+        eval_step = self._eval_step
+        my_n = records_lib.count_records(ds.paths)
+        if jax.process_count() > 1:
+            from tensorflowdistributedlearning_tpu.parallel import multihost as mh
+
+            num = mh.all_processes_max_batches(my_n, local_bs)
+        else:
+            num = -(-my_n // local_bs) if my_n else 1
+        acc = None
+        batches = ds.batches(local_bs, repeat=False, pad_to_batches=num)
         for raw in batches:
             batch = multihost.global_shard_batch(
                 raw, self.mesh, spatial=self._spatial
