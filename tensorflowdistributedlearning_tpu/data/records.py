@@ -186,6 +186,11 @@ class RecordStream:
                 )
                 if rc == 0:
                     return
+                if rc == -2:
+                    raise IOError(
+                        "failed to open/read a TFRecord shard (missing file or "
+                        "permissions) among " + ", ".join(self.paths)
+                    )
                 if rc < 0:
                     raise ValueError(
                         "corrupt TFRecord stream (crc/framing mismatch) in "
@@ -362,9 +367,11 @@ class ClassificationRecords:
                 shuffle_buffer=shuffle_buffer if repeat else 1,
                 seed=seed + epoch,
             )
+            seen_any = False
             labels: List[int] = []
             blobs: List[bytes] = []
             for payload in stream:
+                seen_any = True
                 label, img = decode_classification_record(payload)
                 labels.append(label)
                 blobs.append(img)
@@ -380,6 +387,10 @@ class ClassificationRecords:
                         and emitted >= pad_to_batches
                     ):
                         return
+            if not seen_any:
+                raise ValueError(
+                    "record shards contain zero records: " + ", ".join(self.paths)
+                )
             if not repeat:
                 tail_valid = len(blobs)
                 if blobs or (pad_to_batches or 0) > emitted:

@@ -375,24 +375,22 @@ bool DecodeImageOne(const char* path, float* out, int h, int w, int channels) {
 // returned after another thread's failure would leave its just-claimed row as
 // uninitialized memory that the fallback would then trust), and failures fold
 // into an atomic minimum rather than first-to-CAS.
-using DecodeFn = bool (*)(const char*, float*, int, int, int);
-
-int DecodeBatch(DecodeFn decode_one, const char** paths, int n, float* out,
-                int h, int w, int channels, int n_threads) {
+// Generalized over any per-index decode callable (file paths, memory blobs).
+template <typename DecodeIndexFn>
+int DecodeBatchIndexed(DecodeIndexFn decode_index, int n, int n_threads) {
   if (n <= 0) return 0;
   if (n_threads <= 0) n_threads = 1;
   if (n_threads > n) n_threads = n;
 
   std::atomic<int> next(0);
   std::atomic<int> min_error(n);  // n = "no failure yet"
-  const int64_t stride = static_cast<int64_t>(h) * w * channels;
 
   auto worker = [&]() {
     for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) {
       // Skip only indices ABOVE the current minimal failure: they are beyond
       // the contract's guarantee and will be revisited by the fallback loop.
       if (i > min_error.load(std::memory_order_relaxed)) continue;
-      if (!decode_one(paths[i], out + i * stride, h, w, channels)) {
+      if (!decode_index(i)) {
         int cur = min_error.load();
         while (i < cur && !min_error.compare_exchange_weak(cur, i)) {
         }
@@ -407,6 +405,16 @@ int DecodeBatch(DecodeFn decode_one, const char** paths, int n, float* out,
 
   const int err = min_error.load();
   return err >= n ? 0 : 1 + err;
+}
+
+using DecodeFn = bool (*)(const char*, float*, int, int, int);
+
+int DecodeBatch(DecodeFn decode_one, const char** paths, int n, float* out,
+                int h, int w, int channels, int n_threads) {
+  const int64_t stride = static_cast<int64_t>(h) * w * channels;
+  return DecodeBatchIndexed(
+      [&](int i) { return decode_one(paths[i], out + i * stride, h, w, channels); },
+      n, n_threads);
 }
 
 }  // namespace
@@ -430,37 +438,17 @@ int tfdl_decode_image_blob_batch(const unsigned char** blobs,
                                  const unsigned long long* sizes, int n,
                                  float* out, int h, int w, int channels,
                                  int n_threads) {
-  if (n <= 0) return 0;
-  if (n_threads <= 0) n_threads = 1;
-  if (n_threads > n) n_threads = n;
-
-  std::atomic<int> next(0);
-  std::atomic<int> min_error(n);
   const int64_t stride = static_cast<int64_t>(h) * w * channels;
-
-  auto worker = [&]() {
-    for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) {
-      if (i > min_error.load(std::memory_order_relaxed)) continue;
-      FILE* fp = fmemopen(const_cast<unsigned char*>(blobs[i]),
-                          static_cast<size_t>(sizes[i]), "rb");
-      bool ok = fp != nullptr &&
-                DecodeImageStream(fp, out + i * stride, h, w, channels);
-      if (fp) std::fclose(fp);
-      if (!ok) {
-        int cur = min_error.load();
-        while (i < cur && !min_error.compare_exchange_weak(cur, i)) {
-        }
-      }
-    }
-  };
-
-  std::vector<std::thread> threads;
-  threads.reserve(n_threads);
-  for (int t = 0; t < n_threads; ++t) threads.emplace_back(worker);
-  for (auto& t : threads) t.join();
-
-  const int err = min_error.load();
-  return err >= n ? 0 : 1 + err;
+  return DecodeBatchIndexed(
+      [&](int i) {
+        FILE* fp = fmemopen(const_cast<unsigned char*>(blobs[i]),
+                            static_cast<size_t>(sizes[i]), "rb");
+        bool ok = fp != nullptr &&
+                  DecodeImageStream(fp, out + i * stride, h, w, channels);
+        if (fp) std::fclose(fp);
+        return ok;
+      },
+      n, n_threads);
 }
 
 const char* tfdl_version() { return "tfdl-io 0.2.0"; }

@@ -68,7 +68,7 @@ struct Reader {
   std::condition_variable cv_pop, cv_push;
   std::deque<std::vector<uint8_t>> queue;
   bool done = false;       // producer finished (or error)
-  bool error = false;      // framing/crc corruption
+  int error = 0;           // 0 ok, 1 crc/framing corruption, 2 file IO failure
   bool closing = false;    // consumer asked to stop
 
   std::vector<std::vector<uint8_t>> pool;  // shuffle pool
@@ -80,7 +80,7 @@ struct Reader {
     for (const auto& path : paths) {
       FILE* f = std::fopen(path.c_str(), "rb");
       if (!f) {
-        SetDone(true);
+        SetDone(2);  // IO failure, not corruption
         return;
       }
       while (true) {
@@ -89,17 +89,24 @@ struct Reader {
         if (got == 0) break;  // clean end of shard
         if (got != 12) {
           std::fclose(f);
-          SetDone(true);
+          SetDone(1);
           return;
         }
         uint64_t len;
         std::memcpy(&len, header, 8);
+        // length sanity is NOT optional: a garbage 64-bit length would make the
+        // vector allocation below throw in this background thread -> terminate
+        if (len > (1ull << 31)) {
+          std::fclose(f);
+          SetDone(1);
+          return;
+        }
         if (verify) {
           uint32_t want;
           std::memcpy(&want, header + 8, 4);
-          if (MaskedCrc(header, 8) != want || len > (1ull << 31)) {
+          if (MaskedCrc(header, 8) != want) {
             std::fclose(f);
-            SetDone(true);
+            SetDone(1);
             return;
           }
         }
@@ -108,7 +115,7 @@ struct Reader {
         if (std::fread(rec.data(), 1, len, f) != len ||
             std::fread(footer, 1, 4, f) != 4) {
           std::fclose(f);
-          SetDone(true);
+          SetDone(1);
           return;
         }
         if (verify) {
@@ -116,7 +123,7 @@ struct Reader {
           std::memcpy(&want, footer, 4);
           if (MaskedCrc(rec.data(), len) != want) {
             std::fclose(f);
-            SetDone(true);
+            SetDone(1);
             return;
           }
         }
@@ -131,10 +138,10 @@ struct Reader {
       }
       std::fclose(f);
     }
-    SetDone(false);
+    SetDone(0);
   }
 
-  void SetDone(bool err) {
+  void SetDone(int err) {
     std::lock_guard<std::mutex> lk(mu);
     done = true;
     error = err;
@@ -152,7 +159,7 @@ struct Reader {
     return true;
   }
 
-  // 1 = record in `current`, 0 = end, -1 = corruption.
+  // 1 = record in `current`, 0 = end, -1 = corruption, -2 = file IO failure.
   int Next() {
     // top up the shuffle pool
     while (pool.size() < shuffle_buf) {
@@ -162,7 +169,7 @@ struct Reader {
     }
     if (pool.empty()) {
       std::lock_guard<std::mutex> lk(mu);
-      return error ? -1 : 0;
+      return error ? -error : 0;
     }
     size_t idx =
         shuffle_buf > 1 ? std::uniform_int_distribution<size_t>(0, pool.size() - 1)(rng)

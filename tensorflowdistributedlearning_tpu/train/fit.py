@@ -221,6 +221,10 @@ class ClassifierTrainer:
         eval_every = (
             eval_every_steps or tcfg.eval_every_steps or tcfg.checkpoint_every_steps
         )
+        # fail fast on data-layout problems EVERY split will hit, before any
+        # training happens (e.g. fewer val record shards than processes would
+        # otherwise only surface at the first eval, potentially hours in)
+        self._open_records("val")
 
         state = self._init_state()
         ckpt = CheckpointManager(
@@ -336,14 +340,15 @@ class ClassifierTrainer:
         on noise; that case evaluates one pass over the train records instead."""
         tcfg = self.train_config
         local_bs = multihost.per_process_batch_size(batch_size)
+        val_folder = self._open_split("val")
         eval_records = self._open_records("val")
-        if eval_records is None and self._open_split("val") is None:
+        if eval_records is None and val_folder is None:
             # no val split at all: records-trained runs eval on their train
             # records rather than silently on synthetic noise
             eval_records = self._open_records("train")
         if eval_records is not None:
             return self._evaluate_records(state, eval_records, local_bs)
-        eval_split = self._open_split("val") or self._open_split("train")
+        eval_split = val_folder or self._open_split("train")
         eval_step = self._eval_step
         acc = None
         if eval_split is None:

@@ -1,0 +1,99 @@
+"""Worker for tests/test_multiprocess.py: one rank of a REAL 2-process
+jax.distributed training step over gloo CPU collectives.
+
+Runs the production multi-host path end to end — ``multihost.initialize`` with
+explicit coordinator args, per-process batch math, ``global_shard_batch``
+assembly from process-local rows, and one collective-bearing SPMD train step —
+then prints ``RESULT <loss> <step>`` for the parent to compare across ranks and
+against the single-process oracle."""
+
+import os
+import sys
+
+
+def main() -> int:
+    rank = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    devices_per_proc = 4
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devices_per_proc}"
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from tensorflowdistributedlearning_tpu.parallel import multihost
+
+    multihost.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=rank,
+    )
+    assert jax.process_count() == nproc, jax.process_count()
+
+    import numpy as np
+
+    from tensorflowdistributedlearning_tpu.parallel import mesh as mesh_lib
+    from tensorflowdistributedlearning_tpu.train import step as step_lib
+    from tensorflowdistributedlearning_tpu.train.state import create_train_state
+    from tensorflowdistributedlearning_tpu.config import TrainConfig
+
+    mesh = mesh_lib.make_mesh(None)  # all 8 global devices
+    model = tiny_model()
+    state = mesh_lib.replicate(
+        create_train_state(
+            model,
+            step_lib.make_optimizer(TrainConfig(lr=0.01)),
+            jax.random.PRNGKey(0),
+            np.zeros((1, 8, 8, 3), np.float32),
+        ),
+        mesh,
+    )
+
+    global_batch = 16
+    local_bs = multihost.per_process_batch_size(global_batch)
+    assert local_bs == global_batch // nproc
+    # deterministic global batch; THIS process contributes only its local rows
+    batch = make_global_batch(global_batch)
+    rows = multihost.process_local_rows(global_batch, mesh)
+    local = {k: v[rows] for k, v in batch.items()}
+    sharded = multihost.global_shard_batch(local, mesh)
+
+    train_step = step_lib.make_train_step(
+        mesh, step_lib.ClassificationTask(), donate=False
+    )
+    new_state, metrics = train_step(state, sharded)
+    loss = step_lib.compute_metrics(jax.device_get(metrics))["loss"]
+    print(f"RESULT {loss:.8f} {int(jax.device_get(new_state.step))}", flush=True)
+    return 0
+
+
+def tiny_model():
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.Conv(8, (3, 3), padding="SAME")(x)
+            x = nn.relu(x)
+            x = x.mean(axis=(1, 2))
+            return nn.Dense(4)(x)
+
+    return Tiny()
+
+
+def make_global_batch(n: int):
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    return {
+        "images": rng.normal(0, 1, (n, 8, 8, 3)).astype(np.float32),
+        "labels": rng.integers(0, 4, n).astype(np.int32),
+    }
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
