@@ -89,6 +89,9 @@ def build_parser() -> argparse.ArgumentParser:
                        help="global batch (default: the preset's)")
     p_fit.add_argument("--eval-every", type=int, default=None)
     p_fit.add_argument("--sequence-parallel", type=int, default=1)
+    p_fit.add_argument("--model-parallel", type=int, default=1,
+                       help="GSPMD tensor parallelism: shard params/optimizer "
+                       "over this many devices per replica")
 
     sub.add_parser("presets", help="list the named BASELINE config presets")
     return parser
@@ -216,6 +219,7 @@ def cmd_fit(args) -> int:
         batch_size=args.batch_size,
         eval_every_steps=args.eval_every,
         sequence_parallel=args.sequence_parallel,
+        model_parallel=args.model_parallel,
     )
     print(json.dumps({
         "preset": args.preset,

@@ -117,6 +117,12 @@ class TrainConfig:
     # parallel/spatial.py). 1 = pure data parallelism (the reference's only mode).
     # A TPU-first capability for feature maps too large for one chip's HBM.
     sequence_parallel: int = 1
+    # tensor (model) parallel degree: shard parameters/optimizer state over the
+    # mesh's model axis via GSPMD annotations (parallel/tensor.py) — per-chip
+    # param+optimizer memory drops by this factor; XLA places the collectives.
+    # fit() only; mutually exclusive with sequence_parallel>1 (the GSPMD step
+    # and the shard_map spatial step are different execution strategies).
+    model_parallel: int = 1
     n_folds: int = 5
     seed: int = 42
     # best-model exports to keep (reference: model.py:37, 196-202)
@@ -143,6 +149,16 @@ class TrainConfig:
         if self.sequence_parallel < 1:
             raise ValueError(
                 f"sequence_parallel must be >= 1, got {self.sequence_parallel}"
+            )
+        if self.model_parallel < 1:
+            raise ValueError(
+                f"model_parallel must be >= 1, got {self.model_parallel}"
+            )
+        if self.model_parallel > 1 and self.sequence_parallel > 1:
+            raise ValueError(
+                "model_parallel and sequence_parallel cannot both exceed 1: "
+                "the GSPMD tensor-parallel step and the shard_map spatial step "
+                "are different execution strategies"
             )
         if self.lr_schedule not in ("exponential", "cosine"):
             raise ValueError(f"Unknown lr_schedule {self.lr_schedule!r}")

@@ -31,6 +31,8 @@ difference from the shard_map data-parallel step's per-tower BN, noted in
 
 from __future__ import annotations
 
+import functools
+
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -136,6 +138,10 @@ def make_train_step_gspmd(
 ) -> Callable:
     """jit (auto-SPMD) train step for meshes with a ``model`` axis degree > 1.
 
+    Memoized on its arguments (like train/step.py's builders): repeated calls —
+    across evals, trainer instances, tests — return the same jitted callable so
+    each (mesh, task, model, shapes) combination compiles once per process.
+
     Differences from the shard_map step (train/step.py:make_train_step):
 
     - parallelism is derived by XLA's SPMD partitioner from the input shardings
@@ -146,7 +152,11 @@ def make_train_step_gspmd(
       variant; use the shard_map step when exact per-tower BN parity with the
       reference is required.
     """
+    return _make_train_step_gspmd_cached(mesh, task, donate)
 
+
+@functools.lru_cache(maxsize=None)
+def _make_train_step_gspmd_cached(mesh: Mesh, task, donate: bool) -> Callable:
     def step(state, batch: Dict[str, jax.Array]):
         def loss_fn(params):
             outputs, mutated = state.apply_fn(
@@ -177,6 +187,46 @@ def make_train_step_gspmd(
     def run(state, batch: Dict[str, jax.Array]):
         # bind the step to its mesh: fail fast on batch/axis mismatches instead
         # of letting GSPMD quietly replicate an indivisible batch
+        from tensorflowdistributedlearning_tpu.parallel import mesh as mesh_lib
+
+        mesh_lib.local_batch_size(int(batch["images"].shape[0]), mesh)
+        return jitted(state, batch)
+
+    return run
+
+
+def make_eval_step_gspmd(mesh: Mesh, task) -> Callable:
+    """jit (auto-SPMD) eval step for tensor-parallel state: inference forward,
+    per-example loss so an optional ``valid`` mask weights correctly, Mean
+    metric pytrees — the GSPMD twin of train/step.py:make_eval_step. Memoized —
+    see ``make_train_step_gspmd``."""
+    return _make_eval_step_gspmd_cached(mesh, task)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_eval_step_gspmd_cached(mesh: Mesh, task) -> Callable:
+    def step(state, batch: Dict[str, jax.Array]):
+        outputs = state.apply_fn(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            batch["images"],
+            train=False,
+        )
+        loss = task.loss_per_example(outputs, batch)
+        weights = batch.get("valid")
+
+        from tensorflowdistributedlearning_tpu.ops import metrics as metrics_lib
+
+        scores = task.metric_scores(outputs, batch)
+        metrics = {
+            name: metrics_lib.Mean.empty().update(s, weights)
+            for name, s in scores.items()
+        }
+        metrics["loss"] = metrics_lib.Mean.empty().update(loss, weights)
+        return metrics
+
+    jitted = jax.jit(step)
+
+    def run(state, batch: Dict[str, jax.Array]):
         from tensorflowdistributedlearning_tpu.parallel import mesh as mesh_lib
 
         mesh_lib.local_batch_size(int(batch["images"].shape[0]), mesh)

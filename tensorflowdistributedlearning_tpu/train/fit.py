@@ -93,8 +93,17 @@ class ClassifierTrainer:
         self.task = step_lib.ClassificationTask()
         tcfg = self.train_config
         self.mesh = mesh_lib.make_mesh(
-            tcfg.n_devices, sequence_parallel=tcfg.sequence_parallel
+            tcfg.n_devices,
+            model_parallel=tcfg.model_parallel,
+            sequence_parallel=tcfg.sequence_parallel,
         )
+        # tensor parallelism (GSPMD param/optimizer sharding, parallel/tensor.py)
+        self._tp = tcfg.model_parallel > 1
+        if self._tp and jax.process_count() > 1:
+            raise NotImplementedError(
+                "model_parallel>1 is single-host for now (place_batch_gspmd "
+                "assembles the full global batch per process)"
+            )
         # sequence_parallel > 1: H-sharded backbone (halo-exchange convs,
         # sequence-synced BN) exactly as in the K-fold Trainer
         from tensorflowdistributedlearning_tpu.parallel.spatial import (
@@ -242,21 +251,23 @@ class ClassifierTrainer:
             ckpt.close()
             return FitResult(metrics, self.params, start_step)
 
-        train_step = step_lib.make_train_step(
-            self.mesh,
-            self.task,
-            weight_decay=self.model_config.weight_decay,
-            spatial=self._spatial,
-        )
+        if self._tp:
+            from tensorflowdistributedlearning_tpu.parallel import tensor as tp_lib
+
+            train_step = tp_lib.make_train_step_gspmd(self.mesh, self.task)
+        else:
+            train_step = step_lib.make_train_step(
+                self.mesh,
+                self.task,
+                weight_decay=self.model_config.weight_decay,
+                spatial=self._spatial,
+            )
         is_main = jax.process_index() == 0
         tb_train = SummaryWriter(os.path.join(self.model_dir, "train")) if is_main else None
         tb_eval = SummaryWriter(os.path.join(self.model_dir, "eval")) if is_main else None
 
         batches = pipeline_lib.device_prefetch(
-            self._train_stream(batch_size, steps - start_step),
-            lambda b: multihost.global_shard_batch(
-                b, self.mesh, spatial=self._spatial
-            ),
+            self._train_stream(batch_size, steps - start_step), self._place_eval
         )
         step_no = start_step
         last_eval_step = -1
@@ -330,6 +341,10 @@ class ClassifierTrainer:
         if self._spatial:
             state = state.replace(apply_fn=self.model.apply)
         self._n_params = count_params(state.params)
+        if self._tp:
+            from tensorflowdistributedlearning_tpu.parallel import tensor as tp_lib
+
+            return tp_lib.shard_state_tensor_parallel(state, self.mesh)
         return mesh_lib.replicate(state, self.mesh)
 
     def _evaluate(self, state: TrainState, batch_size: int) -> Dict[str, float]:
@@ -372,10 +387,7 @@ class ClassifierTrainer:
                 eval_split.host_shard(), local_bs, num_batches=num
             )
         for raw in batches:
-            batch = multihost.global_shard_batch(
-                raw, self.mesh, spatial=self._spatial
-            )
-            metrics = eval_step(state, batch)
+            metrics = eval_step(state, self._place_eval(raw))
             acc = step_lib.merge_metrics(acc, jax.device_get(metrics))
         result = step_lib.compute_metrics(acc)
         logger.info("eval @ %d: %s", int(jax.device_get(state.step)), result)
@@ -402,10 +414,7 @@ class ClassifierTrainer:
         acc = None
         batches = ds.batches(local_bs, repeat=False, pad_to_batches=num)
         for raw in batches:
-            batch = multihost.global_shard_batch(
-                raw, self.mesh, spatial=self._spatial
-            )
-            metrics = eval_step(state, batch)
+            metrics = eval_step(state, self._place_eval(raw))
             acc = step_lib.merge_metrics(acc, jax.device_get(metrics))
         result = step_lib.compute_metrics(acc)
         logger.info("eval @ %d: %s", int(jax.device_get(state.step)), result)
@@ -413,7 +422,21 @@ class ClassifierTrainer:
 
     @property
     def _eval_step(self):
+        if self._tp:
+            from tensorflowdistributedlearning_tpu.parallel import tensor as tp_lib
+
+            return tp_lib.make_eval_step_gspmd(self.mesh, self.task)
         return step_lib.make_eval_step(self.mesh, self.task, spatial=self._spatial)
+
+    def _place_eval(self, raw):
+        """Device placement for one host batch — shared by the train loop and
+        both eval paths (GSPMD placement under tensor parallelism, per-process
+        global assembly otherwise)."""
+        if self._tp:
+            from tensorflowdistributedlearning_tpu.parallel import tensor as tp_lib
+
+            return tp_lib.place_batch_gspmd(raw, self.mesh)
+        return multihost.global_shard_batch(raw, self.mesh, spatial=self._spatial)
 
 
 def fit_preset(
@@ -424,6 +447,7 @@ def fit_preset(
     batch_size: Optional[int] = None,
     eval_every_steps: Optional[int] = None,
     sequence_parallel: int = 1,
+    model_parallel: int = 1,
 ) -> FitResult:
     """Train a named config preset end-to-end (the CLI `fit` entry point)."""
     from tensorflowdistributedlearning_tpu.configs import get_preset
@@ -435,9 +459,11 @@ def fit_preset(
             "command (K-fold Trainer) for it"
         )
     train_cfg = preset.train
-    if sequence_parallel != 1:
+    if sequence_parallel != 1 or model_parallel != 1:
         train_cfg = dataclasses.replace(
-            train_cfg, sequence_parallel=sequence_parallel
+            train_cfg,
+            sequence_parallel=sequence_parallel,
+            model_parallel=model_parallel,
         )
     trainer = ClassifierTrainer(
         model_dir, data_dir, preset.model, train_cfg

@@ -140,6 +140,12 @@ class Trainer:
         )
         self.task = step_lib.SegmentationTask()
         tcfg = self.train_config
+        if tcfg.model_parallel > 1:
+            raise NotImplementedError(
+                "model_parallel applies to the classification fit() loop "
+                "(GSPMD tensor parallelism); the K-fold segmentation Trainer "
+                "supports data + sequence parallelism"
+            )
         self.mesh = mesh_lib.make_mesh(
             tcfg.n_devices, sequence_parallel=tcfg.sequence_parallel
         )

@@ -47,7 +47,10 @@ def worker_results():
         for p in procs:
             out, err = p.communicate(timeout=420)
             if p.returncode != 0:
-                if "no gloo" in out + err or "gloo" in err.lower():
+                # the worker exits 3 with a "no gloo:" marker ONLY when the
+                # collectives-implementation config itself is unsupported;
+                # anything else is a real failure this test exists to catch
+                if p.returncode == 3 and "no gloo:" in out:
                     pytest.skip("gloo CPU collectives unavailable")
                 raise AssertionError(
                     f"worker rc={p.returncode}\nstdout:{out[-2000:]}\n"
