@@ -365,6 +365,37 @@ def _run_child(platform: str, timeout: int) -> dict | None:
     return {"__error__": f"{platform} child produced no JSON line"}
 
 
+# Last successful TPU measurement, persisted across runs: the tunneled backend
+# in this environment goes down for hours at a time, and a dead tunnel at
+# measurement time should not erase the perf evidence a live run produced.
+# Degraded outputs carry the cached result (clearly labeled with its
+# timestamp) alongside the fresh failure.
+TPU_CACHE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_CACHE.json"
+)
+
+
+def _save_tpu_cache(result: dict) -> None:
+    try:
+        cached = dict(result)
+        cached["measured_at_unix"] = int(time.time())
+        cached["measured_at"] = time.strftime(
+            "%Y-%m-%d %H:%M:%S UTC", time.gmtime()
+        )
+        with open(TPU_CACHE_PATH, "w") as f:
+            json.dump(cached, f, indent=1)
+    except OSError:
+        pass  # read-only checkout: caching is best-effort
+
+
+def _load_tpu_cache() -> dict | None:
+    try:
+        with open(TPU_CACHE_PATH) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
 def main() -> None:
     if "--child" in sys.argv:
         # Child mode: do the measurement; any crash surfaces via rc + stderr.
@@ -378,34 +409,38 @@ def main() -> None:
     for attempt in range(TPU_ATTEMPTS):
         result = _run_child("tpu", TPU_TIMEOUT_SECS)
         if result is not None and "__error__" not in result:
+            if result.get("platform") == "tpu":
+                _save_tpu_cache(result)
             print(json.dumps(result), flush=True)
             return
         errors.append(result["__error__"] if result else "no result")
         if attempt < TPU_ATTEMPTS - 1:  # no pointless backoff before the fallback
             time.sleep(min(30 * (attempt + 1), 60))
 
+    cached = _load_tpu_cache()
+
     # Degraded: CPU fallback still yields a real (if unimpressive) measurement.
     result = _run_child("cpu", CPU_TIMEOUT_SECS)
     if result is not None and "__error__" not in result:
         result["error"] = "TPU unavailable: " + " | ".join(errors)
         result["degraded"] = True
+        if cached is not None:
+            result["last_known_tpu"] = cached
         print(json.dumps(result), flush=True)
         return
     errors.append(result["__error__"] if result else "no result")
 
     # Last resort: a syntactically valid JSON line with the failure recorded.
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_imagenet_train_throughput_per_chip",
-                "value": 0.0,
-                "unit": "images/sec/chip",
-                "vs_baseline": 0.0,
-                "error": " | ".join(errors),
-            }
-        ),
-        flush=True,
-    )
+    fallback = {
+        "metric": "resnet50_imagenet_train_throughput_per_chip",
+        "value": 0.0,
+        "unit": "images/sec/chip",
+        "vs_baseline": 0.0,
+        "error": " | ".join(errors),
+    }
+    if cached is not None:
+        fallback["last_known_tpu"] = cached
+    print(json.dumps(fallback), flush=True)
 
 
 if __name__ == "__main__":

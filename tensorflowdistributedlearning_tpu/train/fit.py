@@ -267,7 +267,7 @@ class ClassifierTrainer:
         tb_eval = SummaryWriter(os.path.join(self.model_dir, "eval")) if is_main else None
 
         batches = pipeline_lib.device_prefetch(
-            self._train_stream(batch_size, steps - start_step), self._place_eval
+            self._train_stream(batch_size, steps - start_step), self._place_batch
         )
         step_no = start_step
         last_eval_step = -1
@@ -387,7 +387,7 @@ class ClassifierTrainer:
                 eval_split.host_shard(), local_bs, num_batches=num
             )
         for raw in batches:
-            metrics = eval_step(state, self._place_eval(raw))
+            metrics = eval_step(state, self._place_batch(raw))
             acc = step_lib.merge_metrics(acc, jax.device_get(metrics))
         result = step_lib.compute_metrics(acc)
         logger.info("eval @ %d: %s", int(jax.device_get(state.step)), result)
@@ -414,7 +414,7 @@ class ClassifierTrainer:
         acc = None
         batches = ds.batches(local_bs, repeat=False, pad_to_batches=num)
         for raw in batches:
-            metrics = eval_step(state, self._place_eval(raw))
+            metrics = eval_step(state, self._place_batch(raw))
             acc = step_lib.merge_metrics(acc, jax.device_get(metrics))
         result = step_lib.compute_metrics(acc)
         logger.info("eval @ %d: %s", int(jax.device_get(state.step)), result)
@@ -428,7 +428,7 @@ class ClassifierTrainer:
             return tp_lib.make_eval_step_gspmd(self.mesh, self.task)
         return step_lib.make_eval_step(self.mesh, self.task, spatial=self._spatial)
 
-    def _place_eval(self, raw):
+    def _place_batch(self, raw):
         """Device placement for one host batch — shared by the train loop and
         both eval paths (GSPMD placement under tensor parallelism, per-process
         global assembly otherwise)."""
