@@ -409,11 +409,19 @@ def main() -> None:
     for attempt in range(TPU_ATTEMPTS):
         result = _run_child("tpu", TPU_TIMEOUT_SECS)
         if result is not None and "__error__" not in result:
-            if result.get("platform") == "tpu":
+            if result.get("platform") != "tpu":
+                # the child initialized some other backend (tunnel down but jax
+                # found a fallback): that is a FAILED TPU attempt — routing it
+                # through the degraded path keeps the headline honest
+                errors.append(
+                    f"tpu child ran on platform={result.get('platform')!r}"
+                )
+            else:
                 _save_tpu_cache(result)
-            print(json.dumps(result), flush=True)
-            return
-        errors.append(result["__error__"] if result else "no result")
+                print(json.dumps(result), flush=True)
+                return
+        else:
+            errors.append(result["__error__"] if result else "no result")
         if attempt < TPU_ATTEMPTS - 1:  # no pointless backoff before the fallback
             time.sleep(min(30 * (attempt + 1), 60))
 

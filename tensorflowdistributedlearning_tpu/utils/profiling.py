@@ -101,3 +101,42 @@ class StepTimer:
         if self.items_per_step:
             out["items_per_sec"] = self.items_per_step / out["mean_s"]
         return out
+
+
+def memory_stats() -> Dict[str, Dict[str, int]]:
+    """Per-device live memory statistics (bytes) — the HBM observability knob
+    for sizing batch/remat/parallelism choices. Keys are device strings; values
+    are whatever the backend reports (TPU: ``bytes_in_use``, ``peak_bytes_in_use``,
+    ``bytes_limit``, ...). Devices whose runtime does not implement the query
+    (e.g. some CPU builds) are simply absent."""
+    import jax
+
+    out: Dict[str, Dict[str, int]] = {}
+    for device in jax.local_devices():
+        stats = getattr(device, "memory_stats", None)
+        if stats is None:
+            continue
+        try:
+            value = stats()
+        except Exception:  # noqa: BLE001 — unsupported backend
+            continue
+        if value:
+            out[str(device)] = dict(value)
+    return out
+
+
+def log_memory(logger_fn=None) -> Dict[str, Dict[str, int]]:
+    """Log (and return) a compact per-device HBM summary: in-use / peak / limit."""
+    import logging as _logging
+
+    log = logger_fn or _logging.getLogger(__name__).info
+    stats = memory_stats()
+    for dev, s in stats.items():
+        in_use = s.get("bytes_in_use", 0)
+        peak = s.get("peak_bytes_in_use", 0)
+        limit = s.get("bytes_limit", 0)
+        log(
+            "%s: %.1f MiB in use (peak %.1f MiB, limit %.1f MiB)",
+            dev, in_use / 2**20, peak / 2**20, limit / 2**20,
+        )
+    return stats

@@ -188,3 +188,33 @@ def test_vit_requires_num_classes():
         )
         model = build_model(cfg)
         model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 2)), train=False)
+
+
+def test_fixed_seed_bitwise_stable():
+    """Two identical-seed ViT training runs produce bitwise-equal loss
+    sequences (the determinism contract extended to the transformer family)."""
+    def run():
+        mesh = make_mesh(8)
+        model = build_model(TINY_VIT)
+        state = mesh_lib.replicate(
+            create_train_state(
+                model,
+                step_lib.make_optimizer(TrainConfig(lr=0.003)),
+                jax.random.PRNGKey(0),
+                np.zeros((1, 16, 16, 3), np.float32),
+            ),
+            mesh,
+        )
+        train_step = step_lib.make_train_step(
+            mesh, step_lib.ClassificationTask(), donate=False
+        )
+        losses = []
+        for batch in synthetic_batches(
+            "classification", 16, seed=13, input_shape=(16, 16), num_classes=4,
+            steps=3,
+        ):
+            state, metrics = train_step(state, mesh_lib.shard_batch(batch, mesh))
+            losses.append(step_lib.compute_metrics(metrics)["loss"])
+        return losses
+
+    assert run() == run()
