@@ -31,6 +31,7 @@ from jax import lax
 
 from tensorflowdistributedlearning_tpu.config import ModelConfig
 from tensorflowdistributedlearning_tpu.models.layers import scaled_width
+from tensorflowdistributedlearning_tpu.parallel.pipeline import stack_stage_params
 from tensorflowdistributedlearning_tpu.parallel.ring_attention import (
     attention_reference,
     ring_attention,
@@ -213,3 +214,36 @@ class ViTClassifier(nn.Module):
             # equal-sized shards: the global token mean is the pmean of locals
             pooled = lax.pmean(pooled, self.spatial_axis_name)
         return nn.Dense(cfg.num_classes, name="logits")(pooled)
+
+
+def pipeline_stage_fn(config: ModelConfig):
+    """Stage function for GPipe pipeline parallelism over ViT blocks
+    (parallel/pipeline.py): applies ONE TransformerBlock given its param tree.
+
+    Takes the ``ModelConfig`` and derives embed width, MLP width, and compute
+    dtype exactly as ``ViTClassifier.__call__`` does, so the pipelined blocks
+    are numerically identical to the trained model's (a hand-passed dtype or
+    width mismatch would diverge silently — params are float32 either way).
+
+    ViT's repeated blocks are exactly the homogeneous-stage regime the pipeline
+    runner targets (identical computation + param shapes per layer); pair with
+    ``stack_vit_block_params`` to turn a trained ViT's variables into the
+    stacked [K, ...] stage params the runner shards over the model axis."""
+    embed = scaled_width(config.embed_dim, config.width_multiplier)
+    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    block = TransformerBlock(
+        embed, config.num_heads, int(embed * config.mlp_ratio), dtype=dtype
+    )
+
+    def stage_fn(params, x):
+        return block.apply({"params": params}, x, False)
+
+    return stage_fn
+
+
+def stack_vit_block_params(params, n_layers: int):
+    """Stack a ViTClassifier's per-layer block params ([K, ...] leading stage
+    axis) for the pipeline runner; layers must exist as ``block1..blockN``."""
+    return stack_stage_params(
+        [params[f"block{i + 1}"] for i in range(n_layers)]
+    )
