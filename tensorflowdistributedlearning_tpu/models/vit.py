@@ -232,7 +232,11 @@ def pipeline_stage_fn(config: ModelConfig):
     embed = scaled_width(config.embed_dim, config.width_multiplier)
     dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
     block = TransformerBlock(
-        embed, config.num_heads, int(embed * config.mlp_ratio), dtype=dtype
+        embed,
+        config.num_heads,
+        int(embed * config.mlp_ratio),
+        dtype=dtype,
+        use_fused=config.use_fused_attention,
     )
 
     def stage_fn(params, x):

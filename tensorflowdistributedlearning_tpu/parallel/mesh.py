@@ -65,6 +65,18 @@ def make_mesh(
         raise ValueError(
             f"{n} devices not divisible by model_parallel*sequence_parallel={denom}"
         )
+    # Multi-host: every data-parallel (batch-axis) shard must live within ONE
+    # process — per-process data feeding (host_shard + global_shard_batch)
+    # assumes each process's examples land on its own devices. A batch shard
+    # spanning processes would silently assemble inconsistent data.
+    if jax.process_count() > 1 and jax.local_device_count() % denom != 0:
+        raise ValueError(
+            f"model_parallel*sequence_parallel={denom} does not divide the "
+            f"{jax.local_device_count()} devices local to each process; a "
+            "data-parallel shard would span processes and per-process batch "
+            "feeding would assemble inconsistent data. Lower the degree or "
+            "use more chips per host."
+        )
     dp = n // denom
     dev_array = np.asarray(devices).reshape(dp, model_parallel, sequence_parallel)
     return Mesh(dev_array, (BATCH_AXIS, MODEL_AXIS, SEQUENCE_AXIS))

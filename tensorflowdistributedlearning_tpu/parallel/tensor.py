@@ -74,17 +74,31 @@ def tensor_parallel_specs(tree: Any, mesh: Mesh) -> Any:
     return jax.tree.map(lambda leaf: _spec_for_leaf(leaf, axes), tree)
 
 
+def _place_full_value(x, sharding: NamedSharding):
+    """Place a host value (identical on every process — e.g. a seeded init)
+    under ``sharding``. Single-process this is a plain device_put; multi-process
+    it assembles the global array from each process's addressable slices via
+    ``make_array_from_callback`` (device_put cannot target non-addressable
+    devices)."""
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
+
+
 def shard_state_tensor_parallel(state, mesh: Mesh):
     """Place a TrainState with params/batch_stats/opt_state sharded over the
     model axis (and replicated over batch/sequence); ``step`` stays replicated.
 
     The optimizer state mirrors the param tree structure (Adam's mu/nu), so the
-    param specs apply leaf-for-leaf wherever shapes match."""
+    param specs apply leaf-for-leaf wherever shapes match. Works multi-host:
+    every process holds the same seeded init, and each contributes its
+    addressable shards."""
 
     def place_tree(tree):
         specs = tensor_parallel_specs(tree, mesh)
         return jax.tree.map(
-            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            lambda x, s: _place_full_value(x, NamedSharding(mesh, s)),
             tree,
             specs,
         )
@@ -93,7 +107,7 @@ def shard_state_tensor_parallel(state, mesh: Mesh):
     # (Adam mu/nu — shard like it) or are scalars/counters (replicated by the
     # per-leaf rule)
     return state.replace(
-        step=jax.device_put(state.step, NamedSharding(mesh, P())),
+        step=_place_full_value(state.step, NamedSharding(mesh, P())),
         params=place_tree(state.params),
         batch_stats=place_tree(state.batch_stats),
         opt_state=place_tree(state.opt_state),
@@ -116,14 +130,14 @@ def shard_state_weight_update(state, mesh: Mesh):
 
     def place(tree, axes):
         return jax.tree.map(
-            lambda x: jax.device_put(
+            lambda x: _place_full_value(
                 x, NamedSharding(mesh, _spec_for_leaf(x, axes))
             ),
             tree,
         )
 
     return state.replace(
-        step=jax.device_put(state.step, NamedSharding(mesh, P())),
+        step=_place_full_value(state.step, NamedSharding(mesh, P())),
         params=place(state.params, tp_axes),
         batch_stats=place(state.batch_stats, tp_axes),
         opt_state=place(state.opt_state, zero_axes),

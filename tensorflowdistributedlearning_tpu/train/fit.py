@@ -97,13 +97,10 @@ class ClassifierTrainer:
             model_parallel=tcfg.model_parallel,
             sequence_parallel=tcfg.sequence_parallel,
         )
-        # tensor parallelism (GSPMD param/optimizer sharding, parallel/tensor.py)
+        # tensor parallelism (GSPMD param/optimizer sharding, parallel/tensor.py);
+        # multi-host works too: state placement assembles global arrays from
+        # per-process shards, batches ride the same global_shard_batch path as DP
         self._tp = tcfg.model_parallel > 1
-        if self._tp and jax.process_count() > 1:
-            raise NotImplementedError(
-                "model_parallel>1 is single-host for now (place_batch_gspmd "
-                "assembles the full global batch per process)"
-            )
         # sequence_parallel > 1: H-sharded backbone (halo-exchange convs,
         # sequence-synced BN) exactly as in the K-fold Trainer
         from tensorflowdistributedlearning_tpu.parallel.spatial import (
@@ -430,12 +427,10 @@ class ClassifierTrainer:
 
     def _place_batch(self, raw):
         """Device placement for one host batch — shared by the train loop and
-        both eval paths (GSPMD placement under tensor parallelism, per-process
-        global assembly otherwise)."""
-        if self._tp:
-            from tensorflowdistributedlearning_tpu.parallel import tensor as tp_lib
-
-            return tp_lib.place_batch_gspmd(raw, self.mesh)
+        both eval paths. One path for every strategy: per-process global
+        assembly sharded on the batch axis (under tensor parallelism the model
+        axis stays replicated for activations and GSPMD re-shards internally —
+        the same layout place_batch_gspmd produces, but multi-host capable)."""
         return multihost.global_shard_batch(raw, self.mesh, spatial=self._spatial)
 
 

@@ -15,6 +15,7 @@ def main() -> int:
     rank = int(sys.argv[1])
     nproc = int(sys.argv[2])
     port = sys.argv[3]
+    mode = sys.argv[4] if len(sys.argv) > 4 else "dp"
     devices_per_proc = 4
     os.environ["XLA_FLAGS"] = (
         f"--xla_force_host_platform_device_count={devices_per_proc}"
@@ -45,17 +46,29 @@ def main() -> int:
     from tensorflowdistributedlearning_tpu.train.state import create_train_state
     from tensorflowdistributedlearning_tpu.config import TrainConfig
 
-    mesh = mesh_lib.make_mesh(None)  # all 8 global devices
-    model = tiny_model()
-    state = mesh_lib.replicate(
-        create_train_state(
-            model,
-            step_lib.make_optimizer(TrainConfig(lr=0.01)),
-            jax.random.PRNGKey(0),
-            np.zeros((1, 8, 8, 3), np.float32),
-        ),
-        mesh,
+    raw_state = create_train_state(
+        tiny_model(),
+        step_lib.make_optimizer(TrainConfig(lr=0.01)),
+        jax.random.PRNGKey(0),
+        np.zeros((1, 8, 8, 3), np.float32),
     )
+    if mode == "tp":
+        # multi-host TENSOR parallelism: (batch=4, model=2) global mesh, params
+        # and optimizer sharded over the model axis spanning both processes'
+        # devices, GSPMD train step
+        from tensorflowdistributedlearning_tpu.parallel import tensor as tp_lib
+
+        mesh = mesh_lib.make_mesh(None, model_parallel=2)
+        state = tp_lib.shard_state_tensor_parallel(raw_state, mesh)
+        train_step = tp_lib.make_train_step_gspmd(
+            mesh, step_lib.ClassificationTask(), donate=False
+        )
+    else:
+        mesh = mesh_lib.make_mesh(None)  # all 8 global devices, pure DP
+        state = mesh_lib.replicate(raw_state, mesh)
+        train_step = step_lib.make_train_step(
+            mesh, step_lib.ClassificationTask(), donate=False
+        )
 
     global_batch = 16
     local_bs = multihost.per_process_batch_size(global_batch)
@@ -66,9 +79,6 @@ def main() -> int:
     local = {k: v[rows] for k, v in batch.items()}
     sharded = multihost.global_shard_batch(local, mesh)
 
-    train_step = step_lib.make_train_step(
-        mesh, step_lib.ClassificationTask(), donate=False
-    )
     new_state, metrics = train_step(state, sharded)
     loss = step_lib.compute_metrics(jax.device_get(metrics))["loss"]
     print(f"RESULT {loss:.8f} {int(jax.device_get(new_state.step))}", flush=True)
