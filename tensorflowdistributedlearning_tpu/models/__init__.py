@@ -13,6 +13,8 @@ from tensorflowdistributedlearning_tpu.models.resnet import (
 from tensorflowdistributedlearning_tpu.models.vit import (
     TransformerBlock,
     ViTClassifier,
+    pipeline_stage_fn,
+    stack_vit_block_params,
 )
 from tensorflowdistributedlearning_tpu.models.xception import (
     Xception41,
@@ -31,6 +33,8 @@ __all__ = [
     "build_model",
     "TransformerBlock",
     "ViTClassifier",
+    "pipeline_stage_fn",
+    "stack_vit_block_params",
     "Xception41",
     "XceptionBackbone",
     "XceptionSegmentation",

@@ -53,9 +53,10 @@ def main() -> int:
         np.zeros((1, 8, 8, 3), np.float32),
     )
     if mode == "tp":
-        # multi-host TENSOR parallelism: (batch=4, model=2) global mesh, params
-        # and optimizer sharded over the model axis spanning both processes'
-        # devices, GSPMD train step
+        # multi-host TENSOR parallelism: (batch=4, model=2) global mesh —
+        # model-axis groups are intra-process (make_mesh requires it), the
+        # BATCH axis spans the processes; params and optimizer are sharded
+        # over the model axis and assembled from per-process shards
         from tensorflowdistributedlearning_tpu.parallel import tensor as tp_lib
 
         mesh = mesh_lib.make_mesh(None, model_parallel=2)

@@ -110,12 +110,43 @@ def test_matches_single_process_oracle(worker_results):
     assert loss0 == pytest.approx(oracle, rel=1e-6)
 
 
+def _oracle_loss():
+    """Single-process 8-device loss on the identical seeded batch/model (no BN,
+    so the DP shard_map step and the GSPMD TP step agree to reassociation)."""
+    import jax
+
+    from tensorflowdistributedlearning_tpu.config import TrainConfig
+    from tensorflowdistributedlearning_tpu.parallel import mesh as mesh_lib
+    from tensorflowdistributedlearning_tpu.train import step as step_lib
+    from tensorflowdistributedlearning_tpu.train.state import create_train_state
+    from tests.mp_train_worker import make_global_batch, tiny_model
+
+    mesh = mesh_lib.make_mesh(8)
+    state = mesh_lib.replicate(
+        create_train_state(
+            tiny_model(),
+            step_lib.make_optimizer(TrainConfig(lr=0.01)),
+            jax.random.PRNGKey(0),
+            np.zeros((1, 8, 8, 3), np.float32),
+        ),
+        mesh,
+    )
+    train_step = step_lib.make_train_step(
+        mesh, step_lib.ClassificationTask(), donate=False
+    )
+    _, metrics = train_step(
+        state, mesh_lib.shard_batch(make_global_batch(16), mesh)
+    )
+    return step_lib.compute_metrics(jax.device_get(metrics))["loss"]
+
+
 def test_tensor_parallel_across_processes():
-    """Multi-host TENSOR parallelism with real processes: a (4, 2) dp x tp mesh
-    whose model axis spans both processes' devices — params assembled from
-    per-process shards, GSPMD train step over gloo — agrees bitwise across
-    ranks and stays finite."""
+    """Multi-host TENSOR parallelism with real processes: a (4, 2, 1) dp x tp
+    mesh — each model-axis group is intra-process (make_mesh requires
+    it), the BATCH axis spans the two processes — with params/optimizer
+    assembled from per-process shards and the GSPMD train step over gloo.
+    Ranks must agree bitwise AND match the single-process oracle loss."""
     (loss0, step0), (loss1, step1) = _run_workers("tp")
     assert step0 == step1 == 1
     assert loss0 == pytest.approx(loss1, abs=0.0)
-    assert np.isfinite(loss0)
+    assert loss0 == pytest.approx(_oracle_loss(), rel=1e-5)
