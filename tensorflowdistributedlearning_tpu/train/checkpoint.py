@@ -169,6 +169,17 @@ class CheckpointManager:
             return self.restore_latest(template)
         return self._restore(self._best, step, template)
 
+    def restore_best_or_raise(self, template: TrainState, hint: str = "") -> TrainState:
+        """``restore_best`` that refuses to hand back a fresh init: raises with
+        ``hint`` when neither a best export nor a periodic checkpoint exists
+        (the shared guard of every serving/predict path)."""
+        if self.best_step() is None and self.latest_step() is None:
+            raise RuntimeError(
+                f"no trained checkpoint under {self.directory}"
+                + (f" — {hint}" if hint else "")
+            )
+        return self.restore_best(template)
+
     # -- shared -----------------------------------------------------------
 
     def _restore(self, manager: ocp.CheckpointManager, step: int, template: TrainState) -> TrainState:

@@ -326,15 +326,9 @@ class ClassifierTrainer:
         return bound
 
     def _init_state(self) -> TrainState:
-        cfg, tcfg = self.model_config, self.train_config
-        tx = step_lib.make_optimizer(tcfg)
-        h, w = cfg.input_shape
-        sample = np.zeros((1, h, w, cfg.input_channels), np.float32)
         # init via the unsharded twin (identical param tree — SpatialConv is
         # nn.Conv-compatible); spatial collectives cannot run outside shard_map
-        state = create_train_state(
-            self._plain_model, tx, jax.random.PRNGKey(tcfg.seed), sample
-        )
+        state = self._host_template()
         if self._spatial:
             state = state.replace(apply_fn=self.model.apply)
         self._n_params = count_params(state.params)
@@ -416,6 +410,88 @@ class ClassifierTrainer:
         result = step_lib.compute_metrics(acc)
         logger.info("eval @ %d: %s", int(jax.device_get(state.step)), result)
         return result
+
+    # -- serving ----------------------------------------------------------
+
+    def _host_template(self) -> TrainState:
+        """Fresh unsharded state on the host template — the single recipe shared
+        by _init_state and the serving restore."""
+        cfg, tcfg = self.model_config, self.train_config
+        return create_train_state(
+            self._plain_model,
+            step_lib.make_optimizer(tcfg),
+            jax.random.PRNGKey(tcfg.seed),
+            np.zeros((1, *cfg.input_shape, cfg.input_channels), np.float32),
+        )
+
+    def _restore_best_host(self) -> TrainState:
+        """Best exported state (falling back to latest), restored UNSHARDED onto
+        the host template. Single-process only: multi-process checkpoints are
+        written as sharded jax.Arrays and serving wants one addressable copy —
+        export from a single-process session instead."""
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                "serving_fn/export_serving run single-process (multi-process "
+                "checkpoints restore into sharded layouts); load the model_dir "
+                "from a single-process session to export"
+            )
+        tcfg = self.train_config
+        ckpt = CheckpointManager(
+            self.model_dir,
+            save_every_steps=tcfg.checkpoint_every_steps,
+            save_best=tcfg.save_best,
+            best_metric="metrics/top1",
+        )
+        try:
+            return ckpt.restore_best_or_raise(self._host_template(), hint="fit() first")
+        finally:
+            ckpt.close()
+
+    def serving_fn(self):
+        """Jitted single-model inference for deployment: ``serve(images) ->
+        {'probabilities', 'class'}`` on the best state — the classification twin
+        of the K-fold Trainer's serving_fn (reference exported SavedModels via
+        BestExporter, model.py:190-204). Honors ``data_format='NCHW'`` at the
+        boundary exactly like the segmentation path."""
+        from tensorflowdistributedlearning_tpu.train.trainer import _forward_cached
+
+        # serving reads params/batch_stats only; drop the Adam moments
+        state = self._restore_best_host().replace(opt_state=None)
+        task = self.task
+        forward = _forward_cached(self._plain_model)
+        nchw = self.train_config.data_format == "NCHW"
+
+        def serve(images):
+            if nchw:
+                images = jax.numpy.transpose(images, (0, 2, 3, 1))
+            return task.predictions(forward(state, images))
+
+        return serve
+
+    def export_serving(self, directory: Optional[str] = None) -> str:
+        """Standalone serialized-StableHLO serving artifact for the best state
+        (see train/serving.py); default location ``{model_dir}/export/serving``."""
+        from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+        directory = directory or os.path.join(self.model_dir, "export", "serving")
+        cfg = self.model_config
+        h, w = cfg.input_shape
+        shape = (
+            (1, cfg.input_channels, h, w)
+            if self.train_config.data_format == "NCHW"
+            else (1, h, w, cfg.input_channels)
+        )
+        return serving_lib.export_serving_artifact(
+            self.serving_fn(),
+            shape,
+            directory,
+            metadata={
+                "task": "classification",
+                "num_classes": cfg.num_classes,
+                "backbone": cfg.backbone,
+                "data_format": self.train_config.data_format,
+            },
+        )
 
     @property
     def _eval_step(self):

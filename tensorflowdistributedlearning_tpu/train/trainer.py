@@ -516,13 +516,11 @@ class Trainer:
         checkpoint); raises if the fold was never trained."""
         ckpt = self._checkpointer(fold)
         try:
-            if ckpt.best_step() is None and ckpt.latest_step() is None:
-                raise RuntimeError(
-                    f"fold {fold} has no trained checkpoint under "
-                    f"{self._fold_dir(fold)} — train it first or pass "
-                    f"folds=[...] with only the trained folds"
-                )
-            return ckpt.restore_best(template)
+            return ckpt.restore_best_or_raise(
+                template,
+                hint=f"train fold {fold} first or pass folds=[...] with only "
+                "the trained folds",
+            )
         finally:
             ckpt.close()
 
@@ -542,6 +540,9 @@ class Trainer:
         the internal layout, so the transpose happens exactly once, here).
         """
         state = self._restore_fold_or_raise(fold, self._init_state())
+        # serving reads params/batch_stats only; dropping the Adam moments
+        # frees ~2x parameter memory for the closure's lifetime
+        state = state.replace(opt_state=None)
         task = self.task
         forward = self._forward
         nchw = self.train_config.data_format == "NCHW"

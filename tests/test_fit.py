@@ -276,3 +276,31 @@ def test_cli_fit_cifar10_smoke(folder, tmp_path):
     assert rc == 0
     assert os.path.isdir(os.path.join(model_dir, "checkpoints"))
     shutil.rmtree(model_dir)
+
+
+def test_fit_serving_fn_and_export_roundtrip(fitted):
+    """The classification twin of the K-fold serving path: best-state inference
+    closure + standalone StableHLO artifact that reloads without the trainer."""
+    import jax.numpy as jnp
+
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    trainer, _, model_dir = fitted
+    serve = trainer.serving_fn()
+    images = jnp.zeros((2, *SHAPE, 3), jnp.float32)
+    out = serve(images)
+    assert out["probabilities"].shape == (2, N_CLASSES)
+    assert out["class"].shape == (2,)
+
+    path = trainer.export_serving()
+    assert os.path.isfile(path)
+    directory = os.path.dirname(path)
+    loaded = serving_lib.load_serving_artifact(directory)
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (3, *SHAPE, 3)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(loaded(x)["probabilities"]),
+        np.asarray(serve(jnp.asarray(x))["probabilities"]),
+        rtol=1e-5,
+        atol=1e-6,
+    )
