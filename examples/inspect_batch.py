@@ -1,0 +1,90 @@
+"""Visual batch inspection — the reference's matplotlib notebook cells as a script.
+
+The reference eyeballed its input pipeline by pulling one batch through a
+one-shot iterator and imshow-ing image/mask pairs (reference: Untitled.ipynb
+cells 11-17, SURVEY §4 "visual spot checks"). This driver does the same against
+this framework's pipeline: load a salt-layout dataset, run the ON-DEVICE
+augmentation exactly as the trainer does (composed affine warp + Laplacian
+channel, data/augment.py), and write a tiled PNG grid of
+[raw image | augmented image | Laplacian channel | mask] per row.
+
+Usage:
+    python examples/inspect_batch.py --data-dir /path/to/train \
+        [--out batch.png] [--n 8] [--seed 0] [--no-augment]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--data-dir", required=True,
+                        help="salt layout: {data}/images/*.png + masks/*.png")
+    parser.add_argument("--out", default="batch.png")
+    parser.add_argument("--n", type=int, default=8)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--no-augment", action="store_true",
+                        help="show the eval-path preprocessing instead")
+    args = parser.parse_args()
+
+    from tensorflowdistributedlearning_tpu.utils.devices import apply_platform_env
+
+    apply_platform_env()
+
+    import jax
+    import numpy as np
+    from PIL import Image
+
+    from tensorflowdistributedlearning_tpu.data import augment as augment_lib
+    from tensorflowdistributedlearning_tpu.data import pipeline as pipeline_lib
+
+    dataset = pipeline_lib.InMemoryDataset.from_directory(args.data_dir)
+    batch = next(
+        pipeline_lib.train_batches(dataset, args.n, seed=args.seed, steps=1)
+    )
+    raw_images = np.asarray(batch["images"])  # [N, H, W, 1] in [0, 1]
+    if args.no_augment:
+        prepared = augment_lib.prepare_eval_batch(batch["images"], batch["masks"])
+    else:
+        prepared = augment_lib.augment_batch(
+            jax.random.PRNGKey(args.seed),
+            batch["images"],
+            batch["masks"],
+            augment_lib.AugmentConfig(crop_probability=0.0),
+        )
+    images = np.asarray(jax.device_get(prepared["images"]))  # [N, H, W, 2]
+    masks = np.asarray(jax.device_get(prepared["labels"]))   # [N, H, W, 1]
+
+    def to_u8(x: np.ndarray) -> np.ndarray:
+        lo, hi = float(x.min()), float(x.max())
+        return ((x - lo) / max(hi - lo, 1e-6) * 255).astype(np.uint8)
+
+    n, h, w = images.shape[0], images.shape[1], images.shape[2]
+    pad = 2
+    grid = np.full((n * (h + pad), 4 * (w + pad)), 32, np.uint8)
+    for i in range(n):
+        r = i * (h + pad)
+        cells = [
+            to_u8(raw_images[i, :, :, 0]),
+            to_u8(images[i, :, :, 0]),       # normalized/warped image channel
+            to_u8(images[i, :, :, 1]),       # Laplacian feature channel
+            to_u8(masks[i, :, :, 0]),
+        ]
+        for j, cell in enumerate(cells):
+            if cell.shape != (h, w):  # raw may differ from augmented size
+                cell = np.asarray(
+                    Image.fromarray(cell).resize((w, h), Image.NEAREST)
+                )
+            grid[r : r + h, j * (w + pad) : j * (w + pad) + w] = cell
+    Image.fromarray(grid).save(args.out)
+    print(
+        f"wrote {args.out}: {n} rows x [raw | augmented | laplacian | mask] "
+        f"({grid.shape[1]}x{grid.shape[0]})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

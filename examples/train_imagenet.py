@@ -43,6 +43,10 @@ def main() -> int:
     parser.add_argument("--sequence-parallel", type=int, default=1)
     args = parser.parse_args()
 
+    from tensorflowdistributedlearning_tpu.utils.devices import apply_platform_env
+
+    apply_platform_env()
+
     logging.basicConfig(level=logging.INFO)
 
     from tensorflowdistributedlearning_tpu.configs import get_preset

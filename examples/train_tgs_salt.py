@@ -50,6 +50,10 @@ def main() -> int:
     p.add_argument("--base-depth", type=int, default=256)
     args = p.parse_args()
 
+    from tensorflowdistributedlearning_tpu.utils.devices import apply_platform_env
+
+    apply_platform_env()
+
     train_dir = os.path.join(args.data_root, "train")
     train_csv = os.path.join(args.data_root, "train.csv")
     ids, classes = load_tgs_training_set(

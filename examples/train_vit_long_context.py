@@ -45,6 +45,10 @@ def main() -> int:
     parser.add_argument("--num-classes", type=int, default=1000)
     parser.add_argument("--eval-every", type=int, default=None)
     args = parser.parse_args()
+
+    from tensorflowdistributedlearning_tpu.utils.devices import apply_platform_env
+
+    apply_platform_env()
     logging.basicConfig(level=logging.INFO)
 
     from tensorflowdistributedlearning_tpu.configs import get_preset

@@ -15,7 +15,6 @@ from __future__ import annotations
 import argparse
 import json
 import logging
-import os
 import sys
 from typing import List, Optional
 
@@ -254,17 +253,9 @@ def cmd_presets(args) -> int:
 
 def main(argv: Optional[List[str]] = None) -> int:
     logging.basicConfig(level=logging.INFO)
-    # Honor JAX_PLATFORMS even when a site hook pre-imported jax with another
-    # platform: env vars alone are too late once the backend choice is cached,
-    # but the config route works because backend init itself is lazy.
-    platforms = os.environ.get("JAX_PLATFORMS")
-    if platforms:
-        import jax
+    from tensorflowdistributedlearning_tpu.utils.devices import apply_platform_env
 
-        try:
-            jax.config.update("jax_platforms", platforms)
-        except Exception:  # noqa: BLE001 — never block the CLI on a config nicety
-            pass
+    apply_platform_env()
     args = build_parser().parse_args(argv)
     return {
         "train": cmd_train,

@@ -233,13 +233,7 @@ class ClassifierTrainer:
         self._open_records("val")
 
         state = self._init_state()
-        ckpt = CheckpointManager(
-            self.model_dir,
-            save_every_steps=tcfg.checkpoint_every_steps,
-            save_best=tcfg.save_best,
-            best_metric="metrics/top1",
-            async_checkpointing=tcfg.async_checkpointing,
-        )
+        ckpt = self._checkpointer()
         state = ckpt.restore_latest(state)
         start_step = int(jax.device_get(state.step))
         if start_step >= steps:
@@ -413,6 +407,19 @@ class ClassifierTrainer:
 
     # -- serving ----------------------------------------------------------
 
+    def _checkpointer(self) -> CheckpointManager:
+        """The ONE manager configuration for this run directory — fit() and the
+        serving restore must agree on cadence/best-metric or serving would
+        silently select a different 'best' than training exported."""
+        tcfg = self.train_config
+        return CheckpointManager(
+            self.model_dir,
+            save_every_steps=tcfg.checkpoint_every_steps,
+            save_best=tcfg.save_best,
+            best_metric="metrics/top1",
+            async_checkpointing=tcfg.async_checkpointing,
+        )
+
     def _host_template(self) -> TrainState:
         """Fresh unsharded state on the host template — the single recipe shared
         by _init_state and the serving restore."""
@@ -435,13 +442,7 @@ class ClassifierTrainer:
                 "checkpoints restore into sharded layouts); load the model_dir "
                 "from a single-process session to export"
             )
-        tcfg = self.train_config
-        ckpt = CheckpointManager(
-            self.model_dir,
-            save_every_steps=tcfg.checkpoint_every_steps,
-            save_best=tcfg.save_best,
-            best_metric="metrics/top1",
-        )
+        ckpt = self._checkpointer()
         try:
             return ckpt.restore_best_or_raise(self._host_template(), hint="fit() first")
         finally:

@@ -514,6 +514,14 @@ class Trainer:
     def _restore_fold_or_raise(self, fold: int, template: TrainState) -> TrainState:
         """Best exported state for ``fold`` (falling back to the latest periodic
         checkpoint); raises if the fold was never trained."""
+        if jax.process_count() > 1:
+            # multi-process checkpoints restore into sharded/global layouts;
+            # serving and TTA prediction want one addressable copy (same
+            # contract as ClassifierTrainer._restore_best_host)
+            raise RuntimeError(
+                "serving/predict restore runs single-process; load this "
+                "model_dir from a single-process session"
+            )
         ckpt = self._checkpointer(fold)
         try:
             return ckpt.restore_best_or_raise(

@@ -15,3 +15,19 @@ def get_available_devices(platform: Optional[str] = None) -> List[str]:
     """
     devices = jax.devices() if platform is None else jax.devices(platform)
     return [f"{d.platform.upper()}:{d.id}" for d in devices]
+
+
+def apply_platform_env() -> None:
+    """Honor ``JAX_PLATFORMS`` even when a site hook pre-imported jax with
+    another platform (this image's axon sitecustomize does): env vars alone are
+    too late once the platform choice is cached, but the config route works
+    because backend initialization itself is lazy. Call at the top of any
+    standalone driver/script; the CLI does this automatically."""
+    import os
+
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        try:
+            jax.config.update("jax_platforms", platforms)
+        except Exception:  # noqa: BLE001 — never block a driver on this nicety
+            pass
