@@ -70,7 +70,9 @@ def main() -> int:
             to_u8(raw_images[i, :, :, 0]),
             to_u8(images[i, :, :, 0]),       # normalized/warped image channel
             to_u8(images[i, :, :, 1]),       # Laplacian feature channel
-            to_u8(masks[i, :, :, 0]),
+            # masks are binary: fixed scale, NOT per-cell min-max (an all-salt
+            # mask must render white, not black like an empty one)
+            (np.clip(masks[i, :, :, 0], 0, 1) * 255).astype(np.uint8),
         ]
         for j, cell in enumerate(cells):
             if cell.shape != (h, w):  # raw may differ from augmented size
