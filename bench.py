@@ -218,28 +218,58 @@ def run_benchmark(platform: str | None = None) -> dict:
     # MFU: XLA's own FLOP count for the compiled step vs chip peak. cost_analysis
     # is best-effort across backends — fall back to the analytic ResNet-50 figure
     # (~2x 4.1e9 MAC-derived FLOPs fwd, x3 for fwd+bwd) when unavailable.
-    flops_per_step = None
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        f = float(cost.get("flops", 0.0))
-        if f > 0:
-            flops_per_step = f
-    except Exception:
-        pass
-    if flops_per_step is None and on_tpu:
-        flops_per_step = 3 * 2 * 4.1e9 * global_batch
+    def _flops_of(executable, global_b: int):
+        try:
+            cost = executable.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            f = float(cost.get("flops", 0.0))
+            if f > 0:
+                return f
+        except Exception:  # noqa: BLE001 — cost_analysis is best-effort
+            pass
+        return 3 * 2 * 4.1e9 * global_b if on_tpu else None
+
     peak = _peak_flops(devices[0])
-    if flops_per_step is not None and peak is not None:
-        achieved = flops_per_step / (dt / timed_steps) / n
-        result["mfu"] = round(achieved / peak, 4)
-        result["model_tflops_per_step"] = round(flops_per_step / 1e12, 3)
+
+    def _mfu_fields(executable, global_b: int, step_dt: float) -> dict:
+        flops = _flops_of(executable, global_b)
+        if flops is None or peak is None:
+            return {}
+        return {
+            "mfu": round(flops / step_dt / n / peak, 4),
+            "model_tflops_per_step": round(flops / 1e12, 3),
+        }
+
+    mfu_fields = _mfu_fields(compiled, global_batch, dt / timed_steps)
+    if mfu_fields:
+        result.update(mfu_fields)
         # re-print after every completed extra: the supervisor keeps the LAST
         # parseable line, so a timeout mid-extras costs only the unfinished ones
         print(json.dumps(result), flush=True)
 
     if on_tpu:
+        # Batch-x2 upside probe: larger per-chip batches often lift MXU
+        # utilization. Doubles the size that actually SUCCEEDED (the OOM ladder
+        # may have halved the configured one). Only a BETTER number replaces
+        # the headline (printed last = what the supervisor records); a worse or
+        # OOM probe is recorded as an annotation without touching the headline.
+        try:
+            global_b2, dt2, compiled2 = measure(global_batch // n * 2)
+            ips2 = global_b2 * timed_steps / dt2 / n
+            if ips2 > images_per_sec_per_chip:
+                result.update(
+                    value=round(ips2, 2),
+                    vs_baseline=round(ips2 / V100_FP32_RESNET50_IMAGES_PER_SEC, 3),
+                    global_batch=global_b2,
+                    step_time_ms=round(dt2 / timed_steps * 1000, 2),
+                    **_mfu_fields(compiled2, global_b2, dt2 / timed_steps),
+                )
+            result["batch_x2_images_per_sec_per_chip"] = round(ips2, 2)
+            print(json.dumps(result), flush=True)
+        except Exception as e:  # noqa: BLE001 — OOM/compile issues: keep headline
+            result["batch_x2_probe"] = {"error": str(e)[:160]}
+
         # Pallas-vs-XLA depthwise decision data at the flagship's ASPP shapes
         # (VERDICT r1 #5): recorded so use_pallas_depthwise can be flipped on
         # the evidence. Best-effort — the headline number stands without it.
