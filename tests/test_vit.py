@@ -218,3 +218,24 @@ def test_fixed_seed_bitwise_stable():
         return losses
 
     assert run() == run()
+
+
+def test_fit_end_to_end_with_model_parallel(tmp_path):
+    """ViT under GSPMD tensor parallelism: qkv/proj/mlp kernels shard over the
+    model axis through the same fit loop (no ViT-specific TP code — the
+    channel-dim spec rule covers Dense layers)."""
+    from tensorflowdistributedlearning_tpu.parallel.mesh import MODEL_AXIS
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    trainer = ClassifierTrainer(
+        str(tmp_path),
+        None,
+        TINY_VIT,
+        TrainConfig(seed=0, model_parallel=2, checkpoint_every_steps=100),
+    )
+    state = trainer._init_state()
+    qkv = state.params["block1"]["attn"]["qkv"]["kernel"]
+    assert MODEL_AXIS in tuple(qkv.sharding.spec)
+    result = trainer.fit(batch_size=8, steps=2)
+    assert result.steps == 2
+    assert np.isfinite(result.final_metrics["loss"])
