@@ -115,3 +115,19 @@ def test_memory_stats_graceful():
     logged = profiling.log_memory(lambda *a: None)
     # live counters can drift between snapshots on TPU; the contract is shape
     assert set(logged) == set(stats)
+
+
+def test_profiler_trace_context_writes_logdir(tmp_path):
+    """utils.profiling.trace wraps jax.profiler: the context manager runs the
+    body and leaves a trace directory behind (CPU backend suffices)."""
+    import jax.numpy as jnp
+
+    from tensorflowdistributedlearning_tpu.utils import profiling
+
+    import os
+
+    logdir = str(tmp_path / "trace")
+    with profiling.trace(logdir):
+        jnp.ones((64, 64)).sum().block_until_ready()
+    assert os.path.isdir(logdir)
+    assert any(os.scandir(logdir))  # plugins/profile/... written

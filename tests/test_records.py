@@ -153,3 +153,22 @@ def test_fit_trains_from_record_shards(tmp_path):
     result = trainer.fit(batch_size=8, steps=2)
     assert result.steps == 2
     assert np.isfinite(result.final_metrics["loss"])
+
+
+def test_python_fallback_reader_matches_native(tmp_path, monkeypatch):
+    """With the native library forced unavailable, RecordStream's pure-Python
+    path (shuffle pool included) yields the same multiset — the documented
+    no-toolchain fallback actually exercised."""
+    from tensorflowdistributedlearning_tpu.data import records as records_mod
+
+    paths = []
+    for s in range(2):
+        p = str(tmp_path / f"s{s}.tfrecord")
+        rec.write_records(p, [f"{s}-{i}".encode() for i in range(9)])
+        paths.append(p)
+    native = sorted(rec.RecordStream(paths, shuffle_buffer=4, seed=1))
+    monkeypatch.setattr(records_mod, "_records_lib", lambda: None)
+    fallback_plain = list(rec.RecordStream(paths, shuffle_buffer=1, seed=1))
+    fallback_shuffled = sorted(rec.RecordStream(paths, shuffle_buffer=4, seed=1))
+    assert sorted(fallback_plain) == fallback_shuffled == native
+    assert len(fallback_plain) == 18
