@@ -91,6 +91,9 @@ def build_parser() -> argparse.ArgumentParser:
     p_fit.add_argument("--model-parallel", type=int, default=1,
                        help="GSPMD tensor parallelism: shard params/optimizer "
                        "over this many devices per replica")
+    p_fit.add_argument("--optimizer", choices=("adam", "sgd"), default=None,
+                       help="override the preset's optimizer (sgd = Nesterov "
+                       "momentum, the standard ImageNet recipe)")
 
     sub.add_parser("presets", help="list the named BASELINE config presets")
     return parser
@@ -219,6 +222,7 @@ def cmd_fit(args) -> int:
         eval_every_steps=args.eval_every,
         sequence_parallel=args.sequence_parallel,
         model_parallel=args.model_parallel,
+        optimizer=args.optimizer,
     )
     print(json.dumps({
         "preset": args.preset,

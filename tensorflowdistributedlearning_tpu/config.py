@@ -100,6 +100,11 @@ class TrainConfig:
     # compute is always NHWC internally — on TPU, XLA picks layouts and the NCHW-vs-NHWC
     # distinction the reference hand-managed (model.py:344-351) does not exist.
     data_format: str = "NHWC"
+    # "adam" reproduces the reference (tf.contrib AdamOptimizer, model.py:462);
+    # "sgd" is Nesterov momentum — the standard ImageNet recipe behind the
+    # 76%-top-1 north star (BASELINE.md).
+    optimizer: str = "adam"
+    sgd_momentum: float = 0.9
     lr: float = 0.001
     # "exponential" reproduces the reference's continuous decay (model.py:457-459);
     # "cosine" is the standard ImageNet recipe (linear warmup to `lr` over
@@ -162,3 +167,5 @@ class TrainConfig:
             )
         if self.lr_schedule not in ("exponential", "cosine"):
             raise ValueError(f"Unknown lr_schedule {self.lr_schedule!r}")
+        if self.optimizer not in ("adam", "sgd"):
+            raise ValueError(f"Unknown optimizer {self.optimizer!r}")

@@ -184,7 +184,20 @@ class CheckpointManager:
 
     def _restore(self, manager: ocp.CheckpointManager, step: int, template: TrainState) -> TrainState:
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, _state_pytree(template))
-        restored = manager.restore(step, args=ocp.args.StandardRestore(abstract))
+        try:
+            restored = manager.restore(step, args=ocp.args.StandardRestore(abstract))
+        except Exception as e:  # noqa: BLE001 — surface structure mismatches clearly
+            msg = str(e)
+            if "tree" in msg.lower() or "structure" in msg.lower() or "KeyError" in msg:
+                raise RuntimeError(
+                    f"checkpoint at step {step} under {self.directory} does not "
+                    "match the current training state structure — most often "
+                    "the optimizer or model configuration changed since the "
+                    "checkpoint was written (e.g. --optimizer adam -> sgd "
+                    "changes the opt_state pytree). Use a fresh model_dir or "
+                    f"restore with the original configuration. ({msg[:300]})"
+                ) from e
+            raise
         return template.replace(
             step=restored["step"],
             params=restored["params"],

@@ -520,6 +520,7 @@ def fit_preset(
     eval_every_steps: Optional[int] = None,
     sequence_parallel: int = 1,
     model_parallel: int = 1,
+    optimizer: Optional[str] = None,
 ) -> FitResult:
     """Train a named config preset end-to-end (the CLI `fit` entry point)."""
     from tensorflowdistributedlearning_tpu.configs import get_preset
@@ -531,11 +532,12 @@ def fit_preset(
             "command (K-fold Trainer) for it"
         )
     train_cfg = preset.train
-    if sequence_parallel != 1 or model_parallel != 1:
+    if sequence_parallel != 1 or model_parallel != 1 or optimizer is not None:
         train_cfg = dataclasses.replace(
             train_cfg,
             sequence_parallel=sequence_parallel,
             model_parallel=model_parallel,
+            optimizer=optimizer or train_cfg.optimizer,
         )
     trainer = ClassifierTrainer(
         model_dir, data_dir, preset.model, train_cfg

@@ -113,3 +113,38 @@ def test_async_checkpointing_roundtrip(tmp_path):
     restored = ckpt.restore_latest(state.replace(step=state.step + 99))
     assert int(jax.device_get(restored.step)) == int(jax.device_get(state.step))
     ckpt.close()
+
+
+def test_optimizer_change_on_resume_raises_clearly(tmp_path):
+    """Resuming a checkpoint with a different optimizer (Adam vs SGD changes the
+    opt_state pytree) fails with an explanation, not a raw orbax tree error."""
+    import jax
+
+    from tensorflowdistributedlearning_tpu.config import TrainConfig
+
+    mesh = make_mesh(8)
+    model = build_model(TINY)
+    adam_state = replicate(
+        create_train_state(
+            model,
+            make_optimizer(TrainConfig(optimizer="adam")),
+            jax.random.PRNGKey(0),
+            np.zeros((1, 32, 32, 2), np.float32),
+        ),
+        mesh,
+    )
+    ck = CheckpointManager(str(tmp_path), save_every_steps=1)
+    ck.save(adam_state.replace(step=adam_state.step + 1), force=True)
+
+    sgd_template = replicate(
+        create_train_state(
+            model,
+            make_optimizer(TrainConfig(optimizer="sgd")),
+            jax.random.PRNGKey(0),
+            np.zeros((1, 32, 32, 2), np.float32),
+        ),
+        mesh,
+    )
+    with pytest.raises((RuntimeError, ValueError), match="optimizer|structure"):
+        ck.restore_latest(sgd_template)
+    ck.close()

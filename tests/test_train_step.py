@@ -234,3 +234,32 @@ def test_bfloat16_train_step_close_to_float32():
         )
     assert np.isfinite(losses["bfloat16"])
     assert losses["bfloat16"] == pytest.approx(losses["float32"], rel=0.05)
+
+
+def test_sgd_optimizer_trains():
+    """TrainConfig.optimizer='sgd' (Nesterov momentum, the ImageNet recipe):
+    loss decreases on the mesh like the Adam default."""
+    mesh = make_mesh(8)
+    task = ClassificationTask()
+    model = build_model(SMALL_CLS)
+    tx = make_optimizer(TrainConfig(optimizer="sgd", lr=0.05))
+    state = replicate(
+        create_train_state(
+            model, tx, jax.random.key(1), jnp.ones((1, 32, 32, 3), jnp.float32)
+        ),
+        mesh,
+    )
+    train_step = make_train_step(mesh, task)
+    losses = []
+    for batch in synthetic_batches(
+        "classification", 16, seed=30, input_shape=(32, 32), num_classes=4, steps=12
+    ):
+        state, metrics = train_step(state, shard_batch(batch, mesh))
+        losses.append(compute_metrics(metrics)["loss"])
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])
+
+
+def test_unknown_optimizer_rejected():
+    with pytest.raises(ValueError, match="Unknown optimizer"):
+        TrainConfig(optimizer="adagrad")
