@@ -188,7 +188,10 @@ class CheckpointManager:
             restored = manager.restore(step, args=ocp.args.StandardRestore(abstract))
         except Exception as e:  # noqa: BLE001 — surface structure mismatches clearly
             msg = str(e)
-            if "tree" in msg.lower() or "structure" in msg.lower() or "KeyError" in msg:
+            mismatch = isinstance(e, (KeyError, TypeError)) or (
+                "pytree" in msg.lower() or "tree structure" in msg.lower()
+            )
+            if mismatch:
                 raise RuntimeError(
                     f"checkpoint at step {step} under {self.directory} does not "
                     "match the current training state structure — most often "
