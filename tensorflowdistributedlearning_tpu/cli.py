@@ -93,7 +93,10 @@ def build_parser() -> argparse.ArgumentParser:
                        "over this many devices per replica")
     p_fit.add_argument("--optimizer", choices=("adam", "sgd"), default=None,
                        help="override the preset's optimizer (sgd = Nesterov "
-                       "momentum, the standard ImageNet recipe)")
+                       "momentum, the standard ImageNet recipe); requires "
+                       "--lr when it differs from the preset's pairing")
+    p_fit.add_argument("--lr", type=float, default=None,
+                       help="override the preset's learning rate")
 
     sub.add_parser("presets", help="list the named BASELINE config presets")
     return parser
@@ -223,6 +226,7 @@ def cmd_fit(args) -> int:
         sequence_parallel=args.sequence_parallel,
         model_parallel=args.model_parallel,
         optimizer=args.optimizer,
+        lr=args.lr,
     )
     print(json.dumps({
         "preset": args.preset,

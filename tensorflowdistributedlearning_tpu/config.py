@@ -105,6 +105,9 @@ class TrainConfig:
     # 76%-top-1 north star (BASELINE.md).
     optimizer: str = "adam"
     sgd_momentum: float = 0.9
+    # classification train-loss label smoothing (0.1 in the standard ImageNet
+    # recipe, arXiv:1512.00567); eval metrics stay plain CE
+    label_smoothing: float = 0.0
     lr: float = 0.001
     # "exponential" reproduces the reference's continuous decay (model.py:457-459);
     # "cosine" is the standard ImageNet recipe (linear warmup to `lr` over

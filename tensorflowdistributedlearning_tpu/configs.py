@@ -45,6 +45,7 @@ _IMAGENET_1K_TRAIN = TrainConfig(
     lr_schedule="cosine",
     lr_warmup_steps=6_255,
     lr_decay_steps=112_590,
+    label_smoothing=0.1,
 )
 
 PRESETS: Dict[str, Preset] = {
@@ -134,6 +135,7 @@ PRESETS: Dict[str, Preset] = {
             lr_schedule="cosine",
             lr_warmup_steps=1_564,   # 10 epochs
             lr_decay_steps=14_080,
+            label_smoothing=0.1,
             async_checkpointing=True,
         ),
         global_batch=8192,

@@ -128,14 +128,31 @@ def sigmoid_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
 
 
 def softmax_cross_entropy_per_example(
-    logits: jax.Array, labels: jax.Array
+    logits: jax.Array, labels: jax.Array, label_smoothing: float = 0.0
 ) -> jax.Array:
-    """Per-example softmax cross entropy with integer labels, shape [B]."""
+    """Per-example softmax cross entropy with integer labels, shape [B].
+
+    ``label_smoothing`` mixes the one-hot target with the uniform distribution
+    (Szegedy et al., arXiv:1512.00567) — the standard ImageNet regularizer
+    (0.1 in the 76%-top-1 recipe); 0.0 is plain cross entropy."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    return -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    true_logp = jnp.take_along_axis(
+        logp, labels[:, None].astype(jnp.int32), axis=-1
+    )[:, 0]
+    if label_smoothing:
+        k = logits.shape[-1]
+        # target = (1-s)*onehot + s/k: CE = -(1-s)*logp_true - s/k*sum(logp)
+        return -(1.0 - label_smoothing) * true_logp - (
+            label_smoothing / k
+        ) * jnp.sum(logp, axis=-1)
+    return -true_logp
 
 
-def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+def softmax_cross_entropy(
+    logits: jax.Array, labels: jax.Array, label_smoothing: float = 0.0
+) -> jax.Array:
     """Mean softmax cross entropy with integer labels, for the classification path the
     reference kept alongside segmentation (reference: core/resnet.py:246-256)."""
-    return jnp.mean(softmax_cross_entropy_per_example(logits, labels))
+    return jnp.mean(
+        softmax_cross_entropy_per_example(logits, labels, label_smoothing)
+    )

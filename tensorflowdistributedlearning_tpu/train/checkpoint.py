@@ -188,7 +188,7 @@ class CheckpointManager:
             restored = manager.restore(step, args=ocp.args.StandardRestore(abstract))
         except Exception as e:  # noqa: BLE001 — surface structure mismatches clearly
             msg = str(e)
-            mismatch = isinstance(e, (KeyError, TypeError)) or (
+            mismatch = isinstance(e, KeyError) or (
                 "pytree" in msg.lower() or "tree structure" in msg.lower()
             )
             if mismatch:

@@ -90,7 +90,9 @@ class ClassifierTrainer:
         self.data_dir = data_dir
         self.model_config = model_config
         self.train_config = train_config or TrainConfig()
-        self.task = step_lib.ClassificationTask()
+        self.task = step_lib.ClassificationTask(
+            label_smoothing=self.train_config.label_smoothing
+        )
         tcfg = self.train_config
         self.mesh = mesh_lib.make_mesh(
             tcfg.n_devices,
@@ -521,6 +523,7 @@ def fit_preset(
     sequence_parallel: int = 1,
     model_parallel: int = 1,
     optimizer: Optional[str] = None,
+    lr: Optional[float] = None,
 ) -> FitResult:
     """Train a named config preset end-to-end (the CLI `fit` entry point)."""
     from tensorflowdistributedlearning_tpu.configs import get_preset
@@ -532,12 +535,27 @@ def fit_preset(
             "command (K-fold Trainer) for it"
         )
     train_cfg = preset.train
-    if sequence_parallel != 1 or model_parallel != 1 or optimizer is not None:
+    if optimizer is not None and optimizer != train_cfg.optimizer and lr is None:
+        # preset learning rates are tuned FOR their optimizer (SGD presets run
+        # linearly-scaled lr ~0.4-3.2; Adam wants ~1e-3): swapping one without
+        # the other silently diverges
+        raise ValueError(
+            f"preset {preset_name!r} pairs optimizer={train_cfg.optimizer!r} "
+            f"with lr={train_cfg.lr}; overriding --optimizer requires an "
+            "explicit --lr tuned for it"
+        )
+    if (
+        sequence_parallel != 1
+        or model_parallel != 1
+        or optimizer is not None
+        or lr is not None
+    ):
         train_cfg = dataclasses.replace(
             train_cfg,
             sequence_parallel=sequence_parallel,
             model_parallel=model_parallel,
             optimizer=optimizer or train_cfg.optimizer,
+            lr=lr if lr is not None else train_cfg.lr,
         )
     trainer = ClassifierTrainer(
         model_dir, data_dir, preset.model, train_cfg

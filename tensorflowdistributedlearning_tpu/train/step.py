@@ -155,10 +155,16 @@ class SegmentationTask:
 @dataclasses.dataclass(frozen=True)
 class ClassificationTask:
     """Softmax classification objective for the ImageNet/CIFAR configs (the
-    classification path the reference kept in its backbone, core/resnet.py:246-256)."""
+    classification path the reference kept in its backbone, core/resnet.py:246-256).
+    ``label_smoothing`` (train loss only — eval stays plain CE so metrics remain
+    comparable across smoothing settings) is the standard ImageNet regularizer."""
+
+    label_smoothing: float = 0.0
 
     def loss(self, logits: jax.Array, batch: Dict[str, jax.Array]) -> jax.Array:
-        return losses_lib.softmax_cross_entropy(logits, batch["labels"])
+        return losses_lib.softmax_cross_entropy(
+            logits, batch["labels"], self.label_smoothing
+        )
 
     def loss_per_example(
         self, logits: jax.Array, batch: Dict[str, jax.Array]

@@ -127,3 +127,31 @@ def test_aux_losses(rng):
     cls_logits = jnp.asarray(rng.normal(size=(4, 10)).astype(np.float32))
     cls_labels = jnp.asarray([1, 2, 3, 4])
     assert np.isfinite(float(softmax_cross_entropy(cls_logits, cls_labels)))
+
+
+def test_label_smoothing_cross_entropy():
+    """Smoothed CE matches the closed form against a one-hot/uniform mixture
+    oracle; s=0 reduces to plain CE; perfect predictions keep nonzero loss."""
+    import numpy as np
+
+    from tensorflowdistributedlearning_tpu.ops import losses as L
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(0, 2, (6, 5)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 5, 6).astype(np.int32))
+    s = 0.1
+    got = np.asarray(L.softmax_cross_entropy_per_example(logits, labels, s))
+
+    logp = np.asarray(jax.nn.log_softmax(logits, axis=-1))
+    onehot = np.eye(5)[np.asarray(labels)]
+    target = (1 - s) * onehot + s / 5
+    want = -(target * logp).sum(-1)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    plain = np.asarray(L.softmax_cross_entropy_per_example(logits, labels, 0.0))
+    np.testing.assert_allclose(
+        plain, -(onehot * logp).sum(-1), rtol=1e-6, atol=1e-6
+    )
+    # smoothing keeps a loss floor even for confident-correct predictions
+    confident = jnp.asarray(onehot * 50.0, jnp.float32)
+    assert float(L.softmax_cross_entropy(confident, labels, s)) > 0.01
