@@ -304,3 +304,14 @@ def test_fit_serving_fn_and_export_roundtrip(fitted):
         rtol=1e-5,
         atol=1e-6,
     )
+
+
+def test_fit_preset_optimizer_override_requires_lr(tmp_path):
+    """Swapping a preset's optimizer without an lr tuned for it is refused
+    (SGD presets carry linearly-scaled rates that diverge under Adam)."""
+    from tensorflowdistributedlearning_tpu.train.fit import fit_preset
+
+    with pytest.raises(ValueError, match="requires an explicit"):
+        fit_preset(
+            "resnet50_imagenet", str(tmp_path), steps=1, optimizer="adam"
+        )
