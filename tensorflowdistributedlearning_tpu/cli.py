@@ -91,9 +91,24 @@ def build_parser() -> argparse.ArgumentParser:
     p_fit.add_argument("--model-parallel", type=int, default=1,
                        help="GSPMD tensor parallelism: shard params/optimizer "
                        "over this many devices per replica")
-    p_fit.add_argument("--optimizer", choices=("adam", "sgd"), default=None,
+    p_fit.add_argument("--pipeline-parallel", type=int, default=1,
+                       help="GPipe pipeline parallelism over ViT blocks: this "
+                       "many stages per replica (backbone=vit presets only)")
+    p_fit.add_argument("--pipeline-microbatches", type=int, default=None,
+                       help="microbatches per local batch for the pipeline "
+                       "schedule (default: one per stage; set >> stages to "
+                       "shrink the fill/drain bubble)")
+    p_fit.add_argument("--expert-parallel", type=int, default=1,
+                       help="expert parallelism for MoE presets: one expert "
+                       "per shard with all-to-all dispatch (must equal the "
+                       "preset's moe_experts)")
+    p_fit.add_argument("--eval-holdout-fraction", type=float, default=None,
+                       help="with record shards and no val split: hold out "
+                       "this fraction of train shards as the eval split")
+    p_fit.add_argument("--optimizer", choices=("adam", "sgd", "lars"), default=None,
                        help="override the preset's optimizer (sgd = Nesterov "
-                       "momentum, the standard ImageNet recipe); requires "
+                       "momentum, the standard ImageNet recipe; lars = "
+                       "large-batch layer-wise scaling); requires "
                        "--lr when it differs from the preset's pairing")
     p_fit.add_argument("--lr", type=float, default=None,
                        help="override the preset's learning rate")
@@ -225,8 +240,12 @@ def cmd_fit(args) -> int:
         eval_every_steps=args.eval_every,
         sequence_parallel=args.sequence_parallel,
         model_parallel=args.model_parallel,
+        pipeline_parallel=args.pipeline_parallel,
+        pipeline_microbatches=args.pipeline_microbatches,
+        expert_parallel=args.expert_parallel,
         optimizer=args.optimizer,
         lr=args.lr,
+        eval_holdout_fraction=args.eval_holdout_fraction,
     )
     print(json.dumps({
         "preset": args.preset,

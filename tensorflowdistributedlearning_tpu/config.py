@@ -74,6 +74,18 @@ class ModelConfig:
     # warning) under sequence_parallel>1, where the ring formulation owns the
     # attention math.
     use_fused_attention: bool = False
+    # Switch-style mixture-of-experts (arXiv:2101.03961): every OTHER ViT
+    # block's FFN becomes a top-1-routed MoE with this many experts (0 = dense;
+    # backbone="vit" only). Trains with the load-balancing auxiliary loss on
+    # any mesh (all experts local); TrainConfig.expert_parallel places one
+    # expert per shard with all-to-all dispatch (parallel/expert.py).
+    moe_experts: int = 0
+    # per-expert capacity = ceil(tokens/E * factor); beyond-capacity tokens
+    # pass through the residual (the standard fixed-shape trade)
+    moe_capacity_factor: float = 1.25
+    # weight of the sown load-balancing loss in the training objective (the
+    # Switch paper's alpha = 0.01)
+    moe_aux_weight: float = 0.01
 
     def __post_init__(self):
         if self.backbone not in ("resnet", "xception", "vit"):
@@ -84,6 +96,19 @@ class ModelConfig:
             raise ValueError(f"Unknown dtype {self.dtype!r}")
         if self.width_multiplier <= 0:
             raise ValueError("width_multiplier must be positive")
+        if self.moe_experts < 0:
+            raise ValueError(f"moe_experts must be >= 0, got {self.moe_experts}")
+        if self.moe_experts:
+            if self.backbone != "vit":
+                raise ValueError(
+                    "moe_experts requires backbone='vit' (the MoE FFN replaces "
+                    "transformer-block MLPs)"
+                )
+            if self.vit_layers < 2:
+                raise ValueError(
+                    "moe_experts needs vit_layers >= 2 (every OTHER block is "
+                    "MoE; a 1-layer stack would have none)"
+                )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -96,15 +121,31 @@ class TrainConfig:
     (reference: model.py:118), eval throttled to >= 300 s (reference: model.py:214).
     """
 
-    # "NHWC" | "NCHW" accepted at the API boundary for parity (reference: model.py:58-61);
-    # compute is always NHWC internally — on TPU, XLA picks layouts and the NCHW-vs-NHWC
-    # distinction the reference hand-managed (model.py:344-351) does not exist.
+    # "NHWC" | "NCHW" accepted at the API boundary for parity (reference: model.py:58-61).
+    # NCHW is a SERVING/PREDICT boundary layout: serving_fn/export_serving take and
+    # return [B, C, H, W] and predict() returns NCHW outputs. Training REJECTS it
+    # (validate_training_data_format): the input pipelines feed NHWC by construction,
+    # and on TPU the compute-layout motivation behind the reference's NCHW mode
+    # ("about 10% faster" on GPU, model.py:45-46, transposed at model_fn top,
+    # model.py:344-351) does not exist — XLA owns the internal layout.
     data_format: str = "NHWC"
     # "adam" reproduces the reference (tf.contrib AdamOptimizer, model.py:462);
     # "sgd" is Nesterov momentum — the standard ImageNet recipe behind the
-    # 76%-top-1 north star (BASELINE.md).
+    # 76%-top-1 north star (BASELINE.md); "lars" is layer-wise adaptive rate
+    # scaling for large-batch training (You et al., arXiv:1708.03888 — the
+    # published stabilizer for the 8k-batch preset).
     optimizer: str = "adam"
     sgd_momentum: float = 0.9
+    # decoupled-from-the-loss weight decay applied inside the optimizer chain,
+    # masked to conv/dense kernels only (BN scale/bias and biases stay
+    # undecayed — the standard recipe, arXiv:1706.02677). For sgd it enters
+    # before momentum+lr scaling, i.e. exactly the classic l2-SGD form; for
+    # adam it switches the chain to AdamW; for lars it rides the trust-ratio
+    # update. 0.0 reproduces the reference's EFFECTIVE objective (it declared
+    # an l2 regularizer but never minimized it — reference: model.py:462-467,
+    # core/resnet.py:357-376); the ImageNet presets set 1e-4 per their cited
+    # recipe (configs.py).
+    weight_decay: float = 0.0
     # classification train-loss label smoothing (0.1 in the standard ImageNet
     # recipe, arXiv:1512.00567); eval metrics stay plain CE
     label_smoothing: float = 0.0
@@ -131,6 +172,24 @@ class TrainConfig:
     # fit() only; mutually exclusive with sequence_parallel>1 (the GSPMD step
     # and the shard_map spatial step are different execution strategies).
     model_parallel: int = 1
+    # pipeline parallel degree: run the ViT block stack as a K-stage GPipe
+    # pipeline over the mesh's model axis (parallel/pipeline.py;
+    # train/pipeline_step.py), each stage holding vit_layers/K consecutive
+    # blocks, microbatches flowing stage-to-stage over one ppermute ICI hop
+    # per tick. fit() + backbone="vit" only; mutually exclusive with
+    # model_parallel>1 and sequence_parallel>1 (different execution
+    # strategies over the same axes).
+    pipeline_parallel: int = 1
+    # microbatches per local batch for the GPipe schedule (bubble fraction
+    # (K-1)/(M+K-1): set M >> K in production). None = pipeline_parallel
+    # (correctness default).
+    pipeline_microbatches: Optional[int] = None
+    # expert parallel degree: place the MoE blocks' experts one-per-shard on
+    # the mesh's model axis with all-to-all dispatch (parallel/expert.py).
+    # Requires ModelConfig.moe_experts == expert_parallel and backbone="vit";
+    # 1 computes every expert locally (dense dispatch, any mesh). Mutually
+    # exclusive with the other model-axis strategies.
+    expert_parallel: int = 1
     n_folds: int = 5
     seed: int = 42
     # best-model exports to keep (reference: model.py:37, 196-202)
@@ -148,6 +207,13 @@ class TrainConfig:
     # overlap periodic Orbax saves with subsequent train steps (background
     # serialization); best exports and resume points still synchronize
     async_checkpointing: bool = False
+    # fit() with record shards and NO val split: hold out this fraction of the
+    # train record shards (at least one) as the eval split, so best-checkpoint
+    # selection runs on data the model never trains on. 0.0 keeps every shard
+    # in training and falls back to evaluating one pass over the train records
+    # (with a loud warning — train-set top-1 as the selection signal silently
+    # overfits).
+    eval_holdout_fraction: float = 0.0
 
     def __post_init__(self):
         if self.data_format not in ("NCHW", "NHWC"):
@@ -168,7 +234,72 @@ class TrainConfig:
                 "the GSPMD tensor-parallel step and the shard_map spatial step "
                 "are different execution strategies"
             )
+        if self.pipeline_parallel < 1:
+            raise ValueError(
+                f"pipeline_parallel must be >= 1, got {self.pipeline_parallel}"
+            )
+        if self.pipeline_parallel > 1 and (
+            self.model_parallel > 1 or self.sequence_parallel > 1
+        ):
+            raise ValueError(
+                "pipeline_parallel cannot combine with model_parallel or "
+                "sequence_parallel: the GPipe stage runner, the GSPMD "
+                "tensor-parallel step, and the shard_map spatial step are "
+                "different execution strategies over the same mesh axes"
+            )
+        if self.pipeline_microbatches is not None and (
+            self.pipeline_microbatches < self.pipeline_parallel
+            or self.pipeline_parallel == 1
+        ):
+            raise ValueError(
+                "pipeline_microbatches requires pipeline_parallel > 1 and at "
+                "least one microbatch per stage "
+                f"(got microbatches={self.pipeline_microbatches}, "
+                f"stages={self.pipeline_parallel})"
+            )
+        if self.expert_parallel < 1:
+            raise ValueError(
+                f"expert_parallel must be >= 1, got {self.expert_parallel}"
+            )
+        if self.expert_parallel > 1 and (
+            self.model_parallel > 1
+            or self.sequence_parallel > 1
+            or self.pipeline_parallel > 1
+        ):
+            raise ValueError(
+                "expert_parallel cannot combine with model_parallel, "
+                "sequence_parallel, or pipeline_parallel: each owns the "
+                "model/sequence mesh axes as a different execution strategy"
+            )
         if self.lr_schedule not in ("exponential", "cosine"):
             raise ValueError(f"Unknown lr_schedule {self.lr_schedule!r}")
-        if self.optimizer not in ("adam", "sgd"):
+        if self.optimizer not in ("adam", "sgd", "lars"):
             raise ValueError(f"Unknown optimizer {self.optimizer!r}")
+        if self.weight_decay < 0:
+            raise ValueError(f"weight_decay must be >= 0, got {self.weight_decay}")
+        if not 0.0 <= self.eval_holdout_fraction < 1.0:
+            raise ValueError(
+                "eval_holdout_fraction must be in [0, 1), got "
+                f"{self.eval_holdout_fraction}"
+            )
+
+
+def validate_training_data_format(cfg: TrainConfig) -> None:
+    """Reject NCHW at the TRAINING boundary (serving/predict honor it).
+
+    The reference trained in NCHW because it was ~10% faster on its GPUs
+    (reference: model.py:45-46, 344-351). On TPU that motivation does not
+    exist — XLA chooses the internal layout — and the framework's input
+    pipelines feed NHWC by construction, so accepting NCHW for training would
+    be a silently-ignored knob. Train NHWC; NCHW remains fully honored where
+    user-facing arrays actually cross the boundary: ``serving_fn``,
+    ``export_serving``, and ``predict`` outputs."""
+    if cfg.data_format == "NCHW":
+        raise ValueError(
+            "data_format='NCHW' applies to the serving/predict boundary only; "
+            "training input is NHWC by construction (on TPU, XLA owns the "
+            "compute layout — the reference's NCHW-for-speed mode, "
+            "model.py:45-46, has no TPU analogue). Train with NHWC, then "
+            "construct a Trainer with data_format='NCHW' over the same "
+            "model_dir for NCHW serving/prediction."
+        )

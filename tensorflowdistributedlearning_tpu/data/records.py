@@ -191,6 +191,11 @@ class RecordStream:
                         "failed to open/read a TFRecord shard (missing file or "
                         "permissions) among " + ", ".join(self.paths)
                     )
+                if rc == -3:
+                    raise RuntimeError(
+                        "RecordStream handle is invalid or already closed "
+                        "(handle-lifecycle bug, not data corruption)"
+                    )
                 if rc < 0:
                     raise ValueError(
                         "corrupt TFRecord stream (crc/framing mismatch) in "
@@ -265,6 +270,7 @@ def count_records(paths: Sequence[str]) -> int:
     payloads — no crc, no decode; cheap even for large shards)."""
     total = 0
     for path in paths:
+        size = os.path.getsize(path)
         with open(path, "rb") as f:
             while True:
                 header = f.read(12)
@@ -274,6 +280,12 @@ def count_records(paths: Sequence[str]) -> int:
                     raise ValueError(f"{path}: truncated record header")
                 (length,) = struct.unpack("<Q", header[:8])
                 f.seek(length + 4, os.SEEK_CUR)
+                # seeking past EOF succeeds silently — without this check a
+                # shard truncated mid-record would be COUNTED as whole while
+                # the verifying reader later fails, desynchronizing the eval
+                # batch count from what the stream can deliver
+                if f.tell() > size:
+                    raise ValueError(f"{path}: truncated record body")
                 total += 1
     return total
 
@@ -353,7 +365,10 @@ class ClassificationRecords:
         """Batched {'images','labels','valid'} stream.
 
         ``repeat=True``: infinite (or ``steps``-bounded) shuffled training
-        stream, every row valid. ``repeat=False``: one ordered pass; with
+        stream, every row valid. A partial batch at an epoch boundary is
+        CARRIED into the next epoch (batches may span epochs; no records are
+        dropped, and datasets smaller than ``batch_size`` still emit batches
+        instead of spinning forever). ``repeat=False``: one ordered pass; with
         ``pad_to_batches`` the stream is EXTENDED to exactly that many batches
         by wrapping around to the start with ``valid=0`` rows (the streaming
         analogue of pipeline.eval_batches' wrap-around padding — metrics
@@ -361,6 +376,8 @@ class ClassificationRecords:
         number of collective-bearing eval steps)."""
         emitted = 0
         epoch = 0
+        labels: List[int] = []
+        blobs: List[bytes] = []
         while True:
             stream = RecordStream(
                 self.paths,
@@ -368,8 +385,6 @@ class ClassificationRecords:
                 seed=seed + epoch,
             )
             seen_any = False
-            labels: List[int] = []
-            blobs: List[bytes] = []
             for payload in stream:
                 seen_any = True
                 label, img = decode_classification_record(payload)

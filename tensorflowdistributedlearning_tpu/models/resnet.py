@@ -506,6 +506,7 @@ def build_model(
     config: ModelConfig,
     bn_axis_name: Optional[str] = None,
     spatial_axis_name: Optional[str] = None,
+    expert_axis_name: Optional[str] = None,
 ) -> nn.Module:
     """Factory selecting backbone family and head from the config (the reference chose
     via ``resnet_model(...)`` arguments, model.py:356-370; Xception existed but was dead
@@ -514,7 +515,9 @@ def build_model(
     ``spatial_axis_name`` builds the model for H-sharded sequence-parallel
     execution inside ``shard_map`` (parallel/spatial.py); pair it with
     ``bn_axis_name`` on the same axis so BN statistics span the full spatial
-    extent. Supported by both backbone families.
+    extent. Supported by both backbone families. ``expert_axis_name`` (ViT with
+    ``moe_experts`` only) runs the MoE blocks expert-parallel: one expert per
+    shard on that mesh axis with all-to-all dispatch (parallel/expert.py).
 
     Memoized: flax modules are immutable, and returning the SAME instance for the
     same arguments makes ``model.apply``/``model.init`` compare equal as jit
@@ -522,7 +525,9 @@ def build_model(
     and tests (bound methods of two equal-but-distinct modules do NOT compare
     equal). The public wrapper normalizes positional/keyword call styles so every
     spelling shares one cache entry."""
-    return _build_model_cached(config, bn_axis_name, spatial_axis_name)
+    return _build_model_cached(
+        config, bn_axis_name, spatial_axis_name, expert_axis_name
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -530,6 +535,7 @@ def _build_model_cached(
     config: ModelConfig,
     bn_axis_name: Optional[str],
     spatial_axis_name: Optional[str],
+    expert_axis_name: Optional[str],
 ) -> nn.Module:
     if config.backbone == "vit":
         from tensorflowdistributedlearning_tpu.models.vit import ViTClassifier
@@ -538,6 +544,11 @@ def _build_model_cached(
             config,
             bn_axis_name=bn_axis_name,
             spatial_axis_name=spatial_axis_name,
+            expert_axis_name=expert_axis_name,
+        )
+    if expert_axis_name is not None:
+        raise ValueError(
+            "expert_axis_name applies to backbone='vit' MoE models only"
         )
     if config.backbone == "resnet":
         if config.num_classes is None:

@@ -80,8 +80,127 @@ class MultiHeadSelfAttention(nn.Module):
         return nn.Dense(self.embed_dim, dtype=self.dtype, name="proj")(out)
 
 
+class MoEMlp(nn.Module):
+    """Switch-style top-1 mixture-of-experts FFN (arXiv:2101.03961) replacing a
+    TransformerBlock's dense MLP.
+
+    The router (float32, like the softmax accumulations elsewhere) picks one
+    expert per token under a per-expert capacity; dropped tokens contribute a
+    zero update (the residual carries them through). Training adds the
+    load-balancing auxiliary loss, sown into the ``aux_loss`` collection —
+    the train steps add every sown value to the objective; without it, top-1
+    routing + capacity drops collapse onto few experts. Dispatch fractions are
+    also sown into ``intermediates`` for utilization monitoring.
+
+    ``expert_axis_name=None`` computes every expert locally
+    (``dense_moe_apply`` — trainable on any mesh); with an axis name set, THIS
+    shard's expert slice runs under the ``moe_apply`` all-to-all (one expert
+    per shard on the mesh axis), with identical numerics — the final pmean
+    clears the axis-varying type (every shard reconstructs the same combined
+    tokens because the token batch is replicated across the expert axis)."""
+
+    embed_dim: int
+    mlp_dim: int
+    n_experts: int
+    capacity_factor: float = 1.25
+    aux_weight: float = 0.01
+    expert_axis_name: Optional[str] = None
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from tensorflowdistributedlearning_tpu.parallel.expert import (
+            dense_moe_apply,
+            load_balance_loss,
+            moe_apply,
+        )
+
+        b, t, d = x.shape
+        tokens = x.reshape(b * t, d)
+        router = self.param(
+            "router",
+            nn.initializers.normal(stddev=0.02),
+            (d, self.n_experts),
+            jnp.float32,
+        )
+        init = nn.initializers.lecun_normal(batch_axis=(0,))
+        w_in = self.param(
+            "w_in", init, (self.n_experts, d, self.mlp_dim), jnp.float32
+        )
+        b_in = self.param(
+            "b_in", nn.initializers.zeros, (self.n_experts, self.mlp_dim), jnp.float32
+        )
+        w_out = self.param(
+            "w_out", init, (self.n_experts, self.mlp_dim, d), jnp.float32
+        )
+        b_out = self.param(
+            "b_out", nn.initializers.zeros, (self.n_experts, d), jnp.float32
+        )
+
+        # ONE float32 routing, shared by the aux-loss statistics AND the
+        # dispatch below (passing gate_logits through keeps near-tie argmax
+        # decisions identical between what the balance loss optimizes and
+        # where tokens actually go, regardless of compute dtype)
+        gate_logits = tokens.astype(jnp.float32) @ router
+        if not self.is_initializing():  # init would bake stale sown values
+            self.sow(
+                "aux_loss",
+                "load_balance",
+                self.aux_weight * load_balance_loss(gate_logits),
+            )
+            chosen = jnp.argmax(gate_logits, axis=-1)
+            fractions = jnp.mean(
+                jax.nn.one_hot(chosen, self.n_experts, dtype=jnp.float32), axis=0
+            )
+            self.sow("intermediates", "expert_fraction", fractions)
+
+        dtype = self.dtype or jnp.float32
+        stacked = {
+            "w_in": w_in.astype(dtype),
+            "b_in": b_in.astype(dtype),
+            "w_out": w_out.astype(dtype),
+            "b_out": b_out.astype(dtype),
+        }
+
+        def expert_fn(p, xs):
+            h = xs @ p["w_in"] + p["b_in"]
+            h = nn.gelu(h)
+            return h @ p["w_out"] + p["b_out"]
+
+        tokens_c = tokens.astype(dtype)
+        if self.expert_axis_name is None:
+            out = dense_moe_apply(
+                expert_fn,
+                stacked,
+                router,
+                tokens_c,
+                capacity_factor=self.capacity_factor,
+                gate_logits=gate_logits,
+            )
+        else:
+            idx = lax.axis_index(self.expert_axis_name)
+            mine = jax.tree.map(
+                lambda p: lax.dynamic_index_in_dim(p, idx, 0, keepdims=False),
+                stacked,
+            )
+            out = moe_apply(
+                expert_fn,
+                mine,
+                router,
+                tokens_c,
+                capacity_factor=self.capacity_factor,
+                axis_name=self.expert_axis_name,
+                gate_logits=gate_logits,
+            )
+            # every shard combines the same tokens (batch replicated across
+            # the expert axis): numerically an identity, clears the varying type
+            out = lax.pmean(out, self.expert_axis_name)
+        return out.reshape(b, t, d)
+
+
 class TransformerBlock(nn.Module):
-    """Pre-LN block: x + MHSA(LN(x)); x + MLP(LN(x))."""
+    """Pre-LN block: x + MHSA(LN(x)); x + MLP(LN(x)). With ``moe_experts`` set,
+    the MLP is the Switch-style ``MoEMlp`` instead of the dense pair."""
 
     embed_dim: int
     num_heads: int
@@ -89,6 +208,10 @@ class TransformerBlock(nn.Module):
     spatial_axis_name: Optional[str] = None
     dtype: Optional[jnp.dtype] = None
     use_fused: bool = False
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    expert_axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -102,6 +225,17 @@ class TransformerBlock(nn.Module):
             name="attn",
         )(h)
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        if self.moe_experts:
+            return x + MoEMlp(
+                self.embed_dim,
+                self.mlp_dim,
+                self.moe_experts,
+                capacity_factor=self.moe_capacity_factor,
+                aux_weight=self.moe_aux_weight,
+                expert_axis_name=self.expert_axis_name,
+                dtype=self.dtype,
+                name="moe",
+            )(h)
         h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_in")(h)
         h = nn.gelu(h)
         h = nn.Dense(self.embed_dim, dtype=self.dtype, name="mlp_out")(h)
@@ -119,6 +253,10 @@ class ViTClassifier(nn.Module):
     config: ModelConfig
     bn_axis_name: Optional[str] = None  # accepted for factory symmetry; ViT has no BN
     spatial_axis_name: Optional[str] = None
+    # expert-parallel execution for the MoE blocks (config.moe_experts > 0):
+    # one expert per shard on this mesh axis, all-to-all dispatch; None runs
+    # every expert locally (trainable on any mesh)
+    expert_axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -198,6 +336,10 @@ class ViTClassifier(nn.Module):
             block_cls = nn.remat(block_cls, static_argnums=(2,))
         mlp_dim = int(embed * cfg.mlp_ratio)
         for i in range(cfg.vit_layers):
+            # Switch-style placement: every OTHER block's FFN is a top-1 MoE
+            # (block2, block4, ... — arXiv:2101.03961 alternates too); the
+            # interleaved dense blocks stabilize training
+            is_moe = cfg.moe_experts > 0 and i % 2 == 1
             tokens = block_cls(
                 embed,
                 cfg.num_heads,
@@ -205,6 +347,10 @@ class ViTClassifier(nn.Module):
                 spatial_axis_name=self.spatial_axis_name,
                 dtype=dtype,
                 use_fused=cfg.use_fused_attention,
+                moe_experts=cfg.moe_experts if is_moe else 0,
+                moe_capacity_factor=cfg.moe_capacity_factor,
+                moe_aux_weight=cfg.moe_aux_weight,
+                expert_axis_name=self.expert_axis_name if is_moe else None,
                 name=f"block{i + 1}",
             )(tokens, train)
 
@@ -245,9 +391,66 @@ def pipeline_stage_fn(config: ModelConfig):
     return stage_fn
 
 
-def stack_vit_block_params(params, n_layers: int):
-    """Stack a ViTClassifier's per-layer block params ([K, ...] leading stage
-    axis) for the pipeline runner; layers must exist as ``block1..blockN``."""
-    return stack_stage_params(
+def grouped_pipeline_stage_fn(config: ModelConfig, layers_per_stage: int):
+    """Stage function over the GROUPED stacking [layers_per_stage, ...] —
+    always expects the group axis, even when it is 1 (the form
+    ``stack_vit_block_params(..., n_stages=K)`` produces per stage). Used by
+    train/pipeline_step.py so stage params slice uniformly."""
+    base = pipeline_stage_fn(config)
+
+    def stage_fn(params, x):
+        for i in range(layers_per_stage):
+            x = base(jax.tree.map(lambda p, i=i: p[i], params), x)
+        return x
+
+    return stage_fn
+
+
+def stack_vit_block_params(params, n_layers: int, n_stages: Optional[int] = None):
+    """Stack a ViTClassifier's per-layer block params for the pipeline runner;
+    layers must exist as ``block1..blockN``.
+
+    ``n_stages=None``: [L, ...] leading stage axis (one layer per stage).
+    ``n_stages=K``: grouped form [K, L/K, ...] — consecutive layers share a
+    stage, matching ``pipeline_stage_fn(config, layers_per_stage=L//K)``."""
+    stacked = stack_stage_params(
         [params[f"block{i + 1}"] for i in range(n_layers)]
     )
+    if n_stages is None:
+        return stacked
+    if n_layers % n_stages:
+        raise ValueError(
+            f"{n_layers} ViT layers not divisible into {n_stages} pipeline stages"
+        )
+    group = n_layers // n_stages
+    return jax.tree.map(
+        lambda leaf: leaf.reshape((n_stages, group) + leaf.shape[1:]), stacked
+    )
+
+
+def embed_tokens(config: ModelConfig, params, x: jax.Array) -> jax.Array:
+    """Patch-embed + position embeddings outside the module — the pre-block
+    half of ``ViTClassifier.__call__`` (unsharded layout), applied from a
+    trained model's param tree. Used by the pipeline-parallel train step, which
+    runs the blocks through the GPipe runner instead of the module loop."""
+    embed = scaled_width(config.embed_dim, config.width_multiplier)
+    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    p = config.patch_size
+    x = x.astype(dtype)
+    conv = nn.Conv(
+        embed, (p, p), strides=(p, p), padding="VALID", dtype=dtype
+    )
+    tokens = conv.apply({"params": params["patch_embed"]}, x)
+    b = tokens.shape[0]
+    tokens = tokens.reshape(b, -1, embed)
+    pos = params["pos_embedding"][: tokens.shape[1]]
+    return tokens + pos.astype(dtype)[None]
+
+
+def head_logits(config: ModelConfig, params, tokens: jax.Array) -> jax.Array:
+    """Final LayerNorm + mean-pool + logits head — the post-block half of
+    ``ViTClassifier.__call__`` (unsharded layout), for the pipeline step."""
+    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    tokens = nn.LayerNorm(dtype=dtype).apply({"params": params["ln_final"]}, tokens)
+    pooled = jnp.mean(tokens.astype(jnp.float32), axis=1)
+    return nn.Dense(config.num_classes).apply({"params": params["logits"]}, pooled)

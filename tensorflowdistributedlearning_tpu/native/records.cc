@@ -212,7 +212,9 @@ int tfdl_rec_next(int64_t handle, const uint8_t** data, uint64_t* len) {
   {
     std::lock_guard<std::mutex> lk(g_mu);
     auto it = g_readers.find(handle);
-    if (it == g_readers.end()) return -1;
+    // -3 = unknown/closed handle (a caller lifecycle bug), distinct from the
+    // -1 corruption and -2 IO codes so the binding can raise the right error
+    if (it == g_readers.end()) return -3;
     r = it->second;
   }
   int rc = r->Next();

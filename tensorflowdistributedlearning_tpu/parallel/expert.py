@@ -53,6 +53,37 @@ def top1_dispatch(
     return expert, slot, keep, prob
 
 
+def _dispatch_buffers(
+    gate_logits: jax.Array, x: jax.Array, n_experts: int, capacity_factor: float
+):
+    """Shared routing + dispatch-buffer build for BOTH execution strategies
+    (one source of truth — the dense/EP numerical parity the tests assert
+    depends on these staying in lockstep).
+
+    Returns ``(buffer [E, C, D], flat_idx, keep, prob)``: the dense per-expert
+    capacity buffer, each token's slot index, its keep mask, and its gate
+    probability. Capacity is the documented ``C = ceil(tokens/E * factor)``."""
+    import math
+
+    t, d = x.shape
+    capacity = max(1, math.ceil(t * capacity_factor / n_experts))
+    expert, slot, keep, prob = top1_dispatch(gate_logits, capacity)
+    # dense dispatch buffer [E, C, D]: token -> (its expert, its slot)
+    flat_idx = expert * capacity + jnp.minimum(slot, capacity - 1)
+    buffer = jnp.zeros((n_experts * capacity, d), x.dtype)
+    buffer = buffer.at[flat_idx].add(jnp.where(keep[:, None], x, 0.0))
+    return buffer.reshape(n_experts, capacity, d), flat_idx, keep, prob
+
+
+def _combine(
+    returned: jax.Array, flat_idx: jax.Array, keep: jax.Array, prob: jax.Array
+) -> jax.Array:
+    """Gather expert outputs back to token order, scale by the gate
+    probability, zero the capacity-dropped tokens (shared by both paths)."""
+    out = returned[flat_idx]
+    return jnp.where(keep[:, None], out * prob[:, None].astype(out.dtype), 0.0)
+
+
 def moe_apply(
     expert_fn: Callable[[Any, jax.Array], jax.Array],
     my_expert_params: Any,
@@ -61,6 +92,7 @@ def moe_apply(
     *,
     capacity_factor: float = 1.25,
     axis_name: str = MODEL_AXIS,
+    gate_logits: jax.Array = None,
 ) -> jax.Array:
     """Expert-parallel MoE layer inside ``shard_map``.
 
@@ -69,28 +101,25 @@ def moe_apply(
     expert per shard on ``axis_name``); ``gate_kernel``: [D, E] router weights,
     replicated. Returns [T, D]: each token processed by its chosen expert and
     scaled by the gate probability (zero where dropped by capacity).
-    """
-    import math
 
+    ``gate_logits`` ([T, E], optional) supplies precomputed router logits —
+    e.g. a caller's float32 routing that must agree exactly with its
+    load-balancing statistics; default recomputes ``x @ gate_kernel``.
+    """
     n_experts = lax.axis_size(axis_name)
-    t, d = x.shape
     if gate_kernel.shape[-1] != n_experts:
         raise ValueError(
             f"gate_kernel routes over {gate_kernel.shape[-1]} experts but the "
             f"{axis_name!r} mesh axis has {n_experts} shards (one expert each); "
             "an over-wide router would dispatch out of the capacity buffer"
         )
-    # the documented C = ceil(tokens/E * capacity_factor); >= 1 for any t >= 1
-    capacity = max(1, math.ceil(t * capacity_factor / n_experts))
-
-    gate_logits = x @ gate_kernel  # [T, E]
-    expert, slot, keep, prob = top1_dispatch(gate_logits, capacity)
-
-    # dense dispatch buffer [E, C, D]: token -> (its expert, its slot)
-    flat_idx = expert * capacity + jnp.minimum(slot, capacity - 1)
-    buffer = jnp.zeros((n_experts * capacity, d), x.dtype)
-    buffer = buffer.at[flat_idx].add(jnp.where(keep[:, None], x, 0.0))
-    buffer = buffer.reshape(n_experts, capacity, d)
+    if gate_logits is None:
+        gate_logits = x @ gate_kernel  # [T, E]
+    buffer, flat_idx, keep, prob = _dispatch_buffers(
+        gate_logits, x, n_experts, capacity_factor
+    )
+    capacity = buffer.shape[1]
+    d = buffer.shape[-1]
 
     # all-to-all: shard e receives every shard's bucket for expert e ->
     # [n_shards, C, D] worth of tokens for MY expert
@@ -100,7 +129,48 @@ def moe_apply(
     ).reshape(n_experts, capacity, d)
     # inverse all-to-all returns each shard its own tokens, expert-processed
     returned = lax.all_to_all(processed, axis_name, split_axis=0, concat_axis=0)
-    returned = returned.reshape(n_experts * capacity, d)
+    return _combine(returned.reshape(n_experts * capacity, d), flat_idx, keep, prob)
 
-    out = returned[flat_idx]  # [T, D] gather back to token order
-    return jnp.where(keep[:, None], out * prob[:, None], 0.0)
+
+def dense_moe_apply(
+    expert_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_expert_params: Any,
+    gate_kernel: jax.Array,
+    x: jax.Array,
+    *,
+    capacity_factor: float = 1.25,
+    gate_logits: jax.Array = None,
+) -> jax.Array:
+    """The all-experts-local twin of ``moe_apply``: identical routing, capacity,
+    and combine semantics (shared helpers above), with every expert computed
+    on-device (vmap over the stacked [E, ...] param tree) instead of
+    one-expert-per-shard all-to-alls.
+
+    This is what makes MoE *trainable on any mesh* (pure data parallelism, the
+    CPU test mesh, a single chip) with numerics identical to the
+    expert-parallel execution — the strategies differ only in where the expert
+    FLOPs run."""
+    n_experts = gate_kernel.shape[-1]
+    if gate_logits is None:
+        gate_logits = x @ gate_kernel
+    buffer, flat_idx, keep, prob = _dispatch_buffers(
+        gate_logits, x, n_experts, capacity_factor
+    )
+    capacity = buffer.shape[1]
+    d = buffer.shape[-1]
+    processed = jax.vmap(expert_fn)(stacked_expert_params, buffer)  # [E, C, D]
+    return _combine(processed.reshape(n_experts * capacity, d), flat_idx, keep, prob)
+
+
+def load_balance_loss(gate_logits: jax.Array) -> jax.Array:
+    """Switch Transformer load-balancing auxiliary loss (arXiv:2101.03961 eq. 4):
+    ``E * sum_e f_e * P_e`` where ``f_e`` is the fraction of tokens whose top-1
+    choice is expert ``e`` and ``P_e`` the mean router probability for ``e``.
+    Minimized (value 1) at a uniform distribution; without it, top-1 routing
+    with capacity drops collapses onto few experts."""
+    n_experts = gate_logits.shape[-1]
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    chosen = jnp.argmax(gate_logits, axis=-1)
+    f = jnp.mean(jax.nn.one_hot(chosen, n_experts, dtype=jnp.float32), axis=0)
+    p = jnp.mean(probs, axis=0)
+    return n_experts * jnp.sum(f * p)

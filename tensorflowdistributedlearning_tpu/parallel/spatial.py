@@ -345,6 +345,12 @@ def validate_spatial_config(model_config, sequence_parallel: int) -> None:
     """
     if sequence_parallel <= 1:
         return
+    if getattr(model_config, "moe_experts", 0):
+        raise ValueError(
+            "sequence_parallel and moe_experts cannot combine: per-shard MoE "
+            "routing under H-sharded tokens is unvalidated (capacity and the "
+            "load-balancing loss would be computed per sequence shard)"
+        )
     if getattr(model_config, "backbone", None) == "vit":
         # ViT: each shard patch-embeds its own rows, so the only constraint is
         # whole patches per shard (attention itself is the ring — degree-free)

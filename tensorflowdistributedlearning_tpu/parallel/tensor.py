@@ -177,9 +177,13 @@ def _make_train_step_gspmd_cached(mesh: Mesh, task, donate: bool) -> Callable:
                 {"params": params, "batch_stats": state.batch_stats},
                 batch["images"],
                 train=True,
-                mutable=["batch_stats"],
+                mutable=["batch_stats", "aux_loss"],
             )
             loss = task.loss(outputs, batch)
+            # model-sown auxiliary losses (MoE load balancing) — empty
+            # collection for every non-MoE model
+            for aux in jax.tree_util.tree_leaves(mutated.get("aux_loss", {})):
+                loss = loss + aux
             return loss, (outputs, mutated.get("batch_stats", state.batch_stats))
 
         (loss, (outputs, new_stats)), grads = jax.value_and_grad(

@@ -1,0 +1,203 @@
+"""Jitted SPMD train/eval steps for PIPELINE parallelism over ViT blocks.
+
+The reference had no pipeline parallelism (SURVEY §2.3 — data parallel was its
+only strategy); this is the trainable form of the beyond-parity GPipe runner
+(parallel/pipeline.py). The mesh is (batch=dp, model=K): each data-parallel
+replica is a K-stage pipeline whose stages each hold ``vit_layers/K``
+consecutive transformer blocks. One train step:
+
+- patch-embed + position-embed run replicated on every stage (token-local,
+  cheap — the heavy per-layer compute is what pipelines);
+- the local batch splits into M microbatches and flows through the
+  ``lax.scan``-scheduled GPipe fill/drain with one ``ppermute`` hop per tick;
+  autodiff derives the reversed-pipeline backward automatically;
+- the head (final LN + pool + logits) runs on the gathered output, loss and
+  metrics exactly as the plain classification step.
+
+Parameters stay in the canonical ``ViTClassifier`` tree, REPLICATED across the
+mesh — checkpoints, serving export, and eval are interchangeable with every
+other execution strategy; inside the step each stage dynamically slices its own
+block group. Gradient assembly rides shard_map's varying-manual-axes-aware
+transposition (verified empirically: raw cotangents arrive at exactly
+``dp x`` the single-device global-mean gradient for EVERY leaf):
+
+- block params: stage k's cotangent is nonzero only in slot k; the model-axis
+  reduction assembles the slots without over-counting;
+- shared params (embed/head): the forward is unvarying on the model axis, so
+  the cotangent is taken once, not K times — vma tracking knows an unvarying
+  primal has an unvarying cotangent.
+
+What remains is the per-tower mean over data-parallel shards — the same
+``_mean_grads`` normalization as the plain step.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tensorflowdistributedlearning_tpu.config import ModelConfig
+from tensorflowdistributedlearning_tpu.models import vit as vit_lib
+from tensorflowdistributedlearning_tpu.ops import metrics as metrics_lib
+from tensorflowdistributedlearning_tpu.parallel.mesh import BATCH_AXIS, MODEL_AXIS
+from tensorflowdistributedlearning_tpu.parallel.pipeline import pipeline_apply
+from tensorflowdistributedlearning_tpu.train.state import TrainState
+from tensorflowdistributedlearning_tpu.train import step as step_lib
+from tensorflowdistributedlearning_tpu.train.step import Metrics, _metric_deltas
+
+
+def validate_pipeline_config(
+    config: ModelConfig, pipeline_parallel: int, microbatches: int
+) -> None:
+    """Config-time checks so misconfiguration fails before any compile."""
+    if config.backbone != "vit":
+        raise ValueError(
+            "pipeline_parallel requires backbone='vit' (homogeneous "
+            "transformer blocks are the GPipe runner's stage regime); got "
+            f"backbone={config.backbone!r}"
+        )
+    if config.moe_experts:
+        raise ValueError(
+            "pipeline_parallel and moe_experts cannot combine: MoE blocks "
+            "break the homogeneous-stage regime the GPipe runner requires "
+            "(dense and MoE blocks have different param shapes)"
+        )
+    if config.vit_layers % pipeline_parallel:
+        raise ValueError(
+            f"vit_layers={config.vit_layers} not divisible by "
+            f"pipeline_parallel={pipeline_parallel}: stages must hold equal "
+            "block groups"
+        )
+    if microbatches < pipeline_parallel:
+        raise ValueError(
+            f"pipeline_microbatches={microbatches} < pipeline stages "
+            f"{pipeline_parallel}: the fill/drain schedule needs at least one "
+            "microbatch per stage (and wants many more — bubble fraction is "
+            "(K-1)/(M+K-1))"
+        )
+
+
+def _pipelined_forward(
+    config: ModelConfig, stage_fn, microbatches: int, params, images: jax.Array
+) -> jax.Array:
+    """Full ViT forward with the block stack routed through the GPipe runner.
+    Runs inside shard_map; ``images`` is the local batch shard."""
+    k = lax.axis_size(MODEL_AXIS)
+    tokens = vit_lib.embed_tokens(config, params, images)
+    b, t, d = tokens.shape
+    if b % microbatches:
+        raise ValueError(
+            f"local batch {b} not divisible into {microbatches} microbatches"
+        )
+    x = tokens.reshape(microbatches, b // microbatches, t, d)
+    stacked = vit_lib.stack_vit_block_params(params, config.vit_layers, n_stages=k)
+    my_stage = jax.tree.map(
+        lambda p: lax.dynamic_index_in_dim(
+            p, lax.axis_index(MODEL_AXIS), 0, keepdims=False
+        ),
+        stacked,
+    )
+    out = pipeline_apply(stage_fn, my_stage, x)
+    return vit_lib.head_logits(config, params, out.reshape(b, t, d))
+
+
+def _reduce_metrics(metrics: Metrics) -> Metrics:
+    """Sum metric contributions over batch shards; the model-axis pmean is
+    numerically an identity (every stage computes identical metrics from the
+    replicated pipeline output) but clears the varying type."""
+
+    def reduce(x):
+        x = lax.psum(x, BATCH_AXIS)
+        return lax.pmean(x, MODEL_AXIS)
+
+    return jax.tree.map(reduce, metrics)
+
+
+def make_train_step_pipeline(
+    mesh: Mesh,
+    task,
+    config: ModelConfig,
+    microbatches: int,
+    *,
+    donate: bool = True,
+) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Metrics]]:
+    """Build the jitted pipeline-parallel train step. Memoized like the
+    builders in train/step.py so K-fold loops / evals / tests share one
+    executable per configuration."""
+    return _make_train_step_pipeline_cached(mesh, task, config, microbatches, donate)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_train_step_pipeline_cached(
+    mesh: Mesh, task, config: ModelConfig, microbatches: int, donate: bool
+):
+    k = mesh.shape[MODEL_AXIS]
+    stage_fn = vit_lib.grouped_pipeline_stage_fn(config, config.vit_layers // k)
+
+    def step(state: TrainState, batch: Dict[str, jax.Array]):
+        def loss_fn(params):
+            logits = _pipelined_forward(
+                config, stage_fn, microbatches, params, batch["images"]
+            )
+            return task.loss(logits, batch), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        # raw cotangents are dp x the global-mean gradient (module docstring);
+        # the vma-aware division in _mean_grads restores the tower mean
+        grads = step_lib._mean_grads(grads)
+        # ViT has no BatchNorm: batch_stats is an empty pytree, passed through
+        new_state = state.apply_gradients(grads, state.batch_stats)
+        metrics = _reduce_metrics(
+            _metric_deltas(task.metric_scores(logits, batch), loss)
+        )
+        return new_state, metrics
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(), P(BATCH_AXIS)),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step_pipeline(
+    mesh: Mesh, task, config: ModelConfig, microbatches: int
+) -> Callable[[TrainState, Dict[str, jax.Array]], Metrics]:
+    """Jitted pipeline-parallel eval step: the pipelined forward in inference
+    mode, per-example loss so the ``valid`` wrap-around mask weights correctly
+    (same contract as train/step.py:make_eval_step)."""
+    return _make_eval_step_pipeline_cached(mesh, task, config, microbatches)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_eval_step_pipeline_cached(
+    mesh: Mesh, task, config: ModelConfig, microbatches: int
+):
+    k = mesh.shape[MODEL_AXIS]
+    stage_fn = vit_lib.grouped_pipeline_stage_fn(config, config.vit_layers // k)
+
+    def step(state: TrainState, batch: Dict[str, jax.Array]) -> Metrics:
+        logits = _pipelined_forward(
+            config, stage_fn, microbatches, state.params, batch["images"]
+        )
+        loss = task.loss_per_example(logits, batch)
+        weights = batch.get("valid")
+        return _reduce_metrics(
+            _metric_deltas(task.metric_scores(logits, batch), loss, weights)
+        )
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(), P(BATCH_AXIS)),
+        out_specs=P(),
+    )
+    return jax.jit(sharded)

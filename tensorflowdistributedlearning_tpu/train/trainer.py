@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tensorflowdistributedlearning_tpu import config as config_lib
 from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
 from tensorflowdistributedlearning_tpu.data import augment as augment_lib
 from tensorflowdistributedlearning_tpu.data import folds as folds_lib
@@ -232,6 +233,7 @@ class Trainer:
         divide the data-parallel degree (reference: model.py:156-159).
         """
         tcfg = self.train_config
+        config_lib.validate_training_data_format(tcfg)
         mesh_lib.local_batch_size(batch_size, self.mesh)  # divisibility check
         dataset = pipeline_lib.InMemoryDataset.from_directory(
             self.data_directory, ids=list(X)
@@ -485,7 +487,10 @@ class Trainer:
         ``tta=True`` really enables all four transforms (the reference's ``tti`` flag
         was inverted, SURVEY §2.4.3).
 
-        Returns ``{"ids", "probabilities" [N,H,W,1], "masks" [N,H,W,1]}``.
+        Returns ``{"ids", "probabilities" [N,H,W,1], "masks" [N,H,W,1]}`` —
+        ``[N,1,H,W]`` under ``data_format="NCHW"`` (prediction is a user-facing
+        array boundary, honored like ``serving_fn``; the reference's NCHW mode
+        produced NCHW predictions, model.py:344-351, 384-387).
         """
         transforms = augment_lib.TTA_TRANSFORMS if tta else ("none",)
         mesh_lib.local_batch_size(batch_size, self.mesh)  # fail fast, clear message
@@ -505,6 +510,8 @@ class Trainer:
                 total = probs if total is None else total + probs
                 n_members += 1
         mean_probs = total / n_members
+        if self.train_config.data_format == "NCHW":
+            mean_probs = np.transpose(mean_probs, (0, 3, 1, 2))
         return {
             "ids": list(test_ds.ids),
             "probabilities": mean_probs,

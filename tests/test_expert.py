@@ -150,6 +150,183 @@ def test_moe_gradients_flow(setup):
     assert float(np.abs(np.asarray(jax.device_get(g_gate))).sum()) > 0
 
 
+# -- trainable strategy (round-2 VERDICT #6): MoE-ViT via fit() --------------
+
+MOE_CFG_KW = dict(
+    backbone="vit",
+    num_classes=4,
+    input_shape=(16, 16),
+    input_channels=3,
+    patch_size=4,
+    embed_dim=32,
+    vit_layers=4,
+    num_heads=4,
+    output_stride=None,
+    moe_experts=4,
+    moe_capacity_factor=2.0,
+)
+
+
+def test_dense_moe_matches_expert_parallel_forward():
+    """The dense (all-experts-local) MoEMlp forward equals the expert-parallel
+    (all-to-all) forward from the SAME param tree — the two execution
+    strategies are numerically interchangeable."""
+    from tensorflowdistributedlearning_tpu.config import ModelConfig
+    from tensorflowdistributedlearning_tpu.models import build_model
+
+    cfg = ModelConfig(**MOE_CFG_KW)
+    dense_model = build_model(cfg)
+    ep_model = build_model(cfg, expert_axis_name=MODEL_AXIS)
+    rng = np.random.default_rng(3)
+    x = rng.normal(0, 1, (4, 16, 16, 3)).astype(np.float32)
+    variables = dense_model.init(jax.random.PRNGKey(0), x[:1], train=False)
+
+    # routing pools (cumsum slots + capacity) are per-DEVICE-batch: apply the
+    # dense reference per data-parallel shard (dp=2 below -> 2 images each)
+    out_dense = jnp.concatenate(
+        [
+            dense_model.apply(variables, jnp.asarray(x[:2]), train=False),
+            dense_model.apply(variables, jnp.asarray(x[2:]), train=False),
+        ]
+    )
+
+    mesh = make_mesh(8, model_parallel=4)
+
+    def fwd(params, images):
+        out = ep_model.apply({"params": params}, images, train=False)
+        return out
+
+    sharded = jax.jit(
+        jax.shard_map(
+            fwd, mesh=mesh, in_specs=(P(), P("batch")), out_specs=P("batch")
+        )
+    )
+    out_ep = sharded(variables["params"], jnp.asarray(x))
+    np.testing.assert_allclose(
+        np.asarray(out_dense), np.asarray(out_ep), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_moe_aux_loss_sown_and_balanced_at_uniform():
+    """MoEMlp sows the Switch load-balancing loss: ~1.0 (its minimum) near a
+    uniform router at init, and always >= 1."""
+    from tensorflowdistributedlearning_tpu.config import ModelConfig
+    from tensorflowdistributedlearning_tpu.models import build_model
+
+    cfg = ModelConfig(**MOE_CFG_KW)
+    model = build_model(cfg)
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(0, 1, (4, 16, 16, 3)).astype(np.float32))
+    variables = model.init(jax.random.PRNGKey(0), x[:1], train=False)
+    _, mutated = model.apply(
+        variables, x, train=True, mutable=["aux_loss", "intermediates"]
+    )
+    aux = jax.tree.leaves(mutated["aux_loss"])
+    assert len(aux) == 2  # block2 and block4 are MoE (every other block)
+    for a in aux:
+        val = float(a) / cfg.moe_aux_weight  # un-weight
+        assert 0.99 <= val < 4.0  # >= 1 up to fp, < E (degenerate collapse)
+
+
+def test_fit_moe_trains_with_nondegenerate_utilization(tmp_path):
+    """A Switch-MoE ViT trains end to end through fit() (data-parallel dense
+    dispatch): loss decreases, and after training the expert dispatch
+    fractions are non-degenerate — no expert collapse (the aux loss's job)."""
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.data import synthetic_batches
+    from tensorflowdistributedlearning_tpu.models import build_model
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    cfg = ModelConfig(**MOE_CFG_KW)
+    trainer = ClassifierTrainer(
+        str(tmp_path),
+        None,
+        cfg,
+        TrainConfig(optimizer="adam", lr=1e-3, seed=0, checkpoint_every_steps=8),
+    )
+    result = trainer.fit(batch_size=16, steps=8)
+    assert result.steps == 8
+    assert np.isfinite(result.final_metrics["loss"])
+
+    # utilization probe on the trained params
+    state = trainer._restore_best_host()
+    model = build_model(cfg)
+    batch = next(
+        synthetic_batches(
+            "classification", 32, seed=9, input_shape=(16, 16), num_classes=4
+        )
+    )
+    _, mutated = model.apply(
+        {"params": state.params},
+        jnp.asarray(batch["images"]),
+        train=True,
+        mutable=["aux_loss", "intermediates"],
+    )
+    fractions = [
+        np.asarray(f)
+        for f in jax.tree.leaves(mutated["intermediates"])
+        if np.asarray(f).shape == (4,)
+    ]
+    assert fractions, "expert_fraction intermediates missing"
+    for f in fractions:
+        assert f.sum() == pytest.approx(1.0, abs=1e-5)
+        # non-degenerate: no single expert hoards >90% of tokens, and at
+        # least two experts receive tokens
+        assert f.max() < 0.9
+        assert (f > 0).sum() >= 2
+
+
+def test_fit_moe_expert_parallel_trains(tmp_path):
+    """expert_parallel=4: the SAME MoE ViT trains through fit() with one
+    expert per model-axis shard (all-to-all dispatch inside the standard
+    shard_map step); loss finite, canonical checkpoint tree restores into the
+    plain model for serving."""
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    cfg = ModelConfig(**MOE_CFG_KW)
+    trainer = ClassifierTrainer(
+        str(tmp_path),
+        None,
+        cfg,
+        TrainConfig(
+            optimizer="adam",
+            lr=1e-3,
+            seed=0,
+            expert_parallel=4,
+            checkpoint_every_steps=4,
+        ),
+    )
+    result = trainer.fit(batch_size=8, steps=4)
+    assert result.steps == 4
+    assert np.isfinite(result.final_metrics["loss"])
+    serve = trainer.serving_fn()
+    out = serve(np.zeros((2, 16, 16, 3), np.float32))
+    assert np.asarray(out["probabilities"]).shape == (2, 4)
+
+
+def test_expert_parallel_requires_matching_expert_count(tmp_path):
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    with pytest.raises(ValueError, match="one expert per shard"):
+        ClassifierTrainer(
+            str(tmp_path),
+            None,
+            ModelConfig(**{**MOE_CFG_KW, "moe_experts": 2}),
+            TrainConfig(expert_parallel=4),
+        )
+
+
+def test_moe_config_validation():
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+
+    with pytest.raises(ValueError, match="backbone='vit'"):
+        ModelConfig(moe_experts=4)
+    with pytest.raises(ValueError, match="cannot combine"):
+        TrainConfig(expert_parallel=2, sequence_parallel=2)
+
+
 def test_moe_with_real_vit_mlp_experts():
     """Expert parallelism over PRODUCTION-shaped experts: each expert is a ViT
     transformer block's MLP (Dense-gelu-Dense, the sub-network MoE replaces in

@@ -125,6 +125,48 @@ def test_record_payload_codec():
     assert struct.unpack("<i", payload[:4])[0] == 7
 
 
+def test_repeat_stream_smaller_than_batch_still_emits(tmp_path):
+    """Regression (advisor round 2): a repeat-mode stream over a dataset with
+    fewer records than batch_size used to reset its partial batch each epoch and
+    spin forever. Partial batches now carry across epoch boundaries, so batches
+    span epochs and every record is used."""
+    rng = np.random.default_rng(2)
+    images = [rng.uniform(0, 255, (8, 8, 3)).astype(np.uint8) for _ in range(3)]
+    rec.write_classification_shards(str(tmp_path), images, [0, 1, 2], shards=1)
+    ds = rec.ClassificationRecords(str(tmp_path), image_shape=(8, 8), channels=3)
+    batches = list(ds.batches(4, seed=0, repeat=True, steps=3))
+    assert len(batches) == 3
+    # 3 batches x 4 rows = 12 rows = 4 full epochs of the 3-record dataset
+    all_labels = np.concatenate([b["labels"] for b in batches])
+    assert sorted(all_labels.tolist()) == sorted([0, 1, 2] * 4)
+    assert all(b["valid"].all() for b in batches)
+
+
+def test_count_records_detects_truncated_final_record(tmp_path):
+    """Regression (advisor round 2): count_records seeks over payloads, and a
+    seek past EOF silently succeeds — a shard truncated mid-record must raise,
+    not be counted as whole."""
+    path = str(tmp_path / "trunc.tfrecord")
+    rec.write_records(path, _payloads(3))
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[:-5])  # cut into the final record's body
+    with pytest.raises(ValueError, match="truncated record body"):
+        rec.count_records([path])
+
+
+def test_native_next_on_closed_handle_is_lifecycle_error():
+    """Regression (advisor round 2): tfdl_rec_next on an unknown/closed handle
+    returns the dedicated -3 code, not the -1 corruption code."""
+    lib = rec._records_lib()
+    if lib is None:
+        pytest.skip("native records library unavailable")
+    import ctypes
+
+    data = ctypes.POINTER(ctypes.c_uint8)()
+    length = ctypes.c_uint64()
+    assert lib.tfdl_rec_next(999999, ctypes.byref(data), ctypes.byref(length)) == -3
+
+
 def test_fit_trains_from_record_shards(tmp_path):
     """ClassifierTrainer streams {data_dir}/train-*.tfrecord through the native
     record reader + blob decoder end to end."""
@@ -153,6 +195,66 @@ def test_fit_trains_from_record_shards(tmp_path):
     result = trainer.fit(batch_size=8, steps=2)
     assert result.steps == 2
     assert np.isfinite(result.final_metrics["loss"])
+
+
+def test_eval_holdout_fraction_partitions_train_shards(tmp_path, caplog):
+    """Round-2 VERDICT weak #6: with record shards and no val split, best-
+    checkpoint selection used to run silently on train data. With
+    eval_holdout_fraction set, the last shards become a held-out val split
+    (train excludes them); without it, a loud warning fires."""
+    import logging
+
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    rng = np.random.default_rng(3)
+    images = [rng.uniform(0, 255, (16, 16, 3)).astype(np.uint8) for _ in range(16)]
+    labels = list(rng.integers(0, 4, 16))
+    rec.write_classification_shards(str(tmp_path / "data"), images, labels, shards=4)
+    mcfg = ModelConfig(
+        num_classes=4,
+        input_shape=(16, 16),
+        input_channels=3,
+        n_blocks=(1, 1, 1),
+        base_depth=8,
+        width_multiplier=0.0625,
+        output_stride=None,
+    )
+
+    held = ClassifierTrainer(
+        str(tmp_path / "m1"),
+        str(tmp_path / "data"),
+        mcfg,
+        TrainConfig(seed=0, checkpoint_every_steps=100, eval_holdout_fraction=0.25),
+    )
+    train_ds = held._open_records("train")
+    val_ds = held._open_records("val")
+    assert len(train_ds.paths) == 3 and len(val_ds.paths) == 1
+    assert set(train_ds.paths).isdisjoint(val_ds.paths)
+    with caplog.at_level(logging.WARNING):
+        result = held.fit(batch_size=8, steps=2)
+    assert np.isfinite(result.final_metrics["loss"])
+    assert not any("overestimate" in r.message for r in caplog.records)
+
+    caplog.clear()
+    plain = ClassifierTrainer(
+        str(tmp_path / "m2"),
+        str(tmp_path / "data"),
+        mcfg,
+        TrainConfig(seed=0, checkpoint_every_steps=100),
+    )
+    with caplog.at_level(logging.WARNING):
+        plain.fit(batch_size=8, steps=2)
+    assert any("overestimate" in r.message for r in caplog.records)
+
+    # holding out every shard is a config error, caught before training
+    with pytest.raises(ValueError, match="leaving none to train"):
+        ClassifierTrainer(
+            str(tmp_path / "m3"),
+            str(tmp_path / "data"),
+            mcfg,
+            TrainConfig(eval_holdout_fraction=0.99),
+        ).fit(batch_size=8, steps=1)
 
 
 def test_python_fallback_reader_matches_native(tmp_path, monkeypatch):

@@ -263,3 +263,111 @@ def test_sgd_optimizer_trains():
 def test_unknown_optimizer_rejected():
     with pytest.raises(ValueError, match="Unknown optimizer"):
         TrainConfig(optimizer="adagrad")
+
+
+def _toy_params():
+    return {
+        "conv": {"kernel": jnp.ones((3, 3, 2, 4), jnp.float32)},
+        "bn": {"scale": jnp.ones((4,), jnp.float32), "bias": jnp.zeros((4,), jnp.float32)},
+    }
+
+
+def test_weight_decay_update_differs_and_masks_kernels():
+    """The decayed SGD chain produces a different update from the undecayed one
+    (VERDICT round-2 task #2), and the decay touches ONLY kernel leaves: with
+    zero gradients the kernel shrinks toward zero while BN scale/bias —
+    excluded by the mask, per the recipe (arXiv:1706.02677 §5.3) — stay put."""
+    params = _toy_params()
+    grads = jax.tree.map(jnp.zeros_like, params)
+
+    plain = make_optimizer(TrainConfig(optimizer="sgd", lr=0.1))
+    decayed = make_optimizer(TrainConfig(optimizer="sgd", lr=0.1, weight_decay=1e-2))
+
+    up_plain, _ = plain.update(grads, plain.init(params), params)
+    up_decayed, _ = decayed.update(grads, decayed.init(params), params)
+
+    # undecayed + zero grads = zero update everywhere
+    assert all(np.all(leaf == 0) for leaf in jax.tree.leaves(up_plain))
+    # decayed: kernel moves (toward zero), non-kernels still untouched
+    assert np.all(np.asarray(up_decayed["conv"]["kernel"]) < 0)
+    assert np.all(np.asarray(up_decayed["bn"]["scale"]) == 0)
+    assert np.all(np.asarray(up_decayed["bn"]["bias"]) == 0)
+
+
+def test_weight_decay_mask_covers_moe_expert_weights():
+    """The decay mask treats MoE expert matrices (w_in/w_out) and the router as
+    weight matrices — they replace dense mlp kernels and must regularize like
+    them — while expert biases stay excluded (code review r3)."""
+    from tensorflowdistributedlearning_tpu.train.step import kernel_decay_mask
+
+    params = {
+        "moe": {
+            "w_in": jnp.ones((2, 4, 8)),
+            "b_in": jnp.zeros((2, 8)),
+            "w_out": jnp.ones((2, 8, 4)),
+            "b_out": jnp.zeros((2, 4)),
+            "router": jnp.ones((4, 2)),
+        },
+        "ln": {"scale": jnp.ones((4,))},
+    }
+    mask = kernel_decay_mask(params)
+    assert mask["moe"]["w_in"] and mask["moe"]["w_out"] and mask["moe"]["router"]
+    assert not mask["moe"]["b_in"] and not mask["moe"]["b_out"]
+    assert not mask["ln"]["scale"]
+
+
+def test_weight_decay_adam_is_adamw():
+    """weight_decay>0 with adam switches the chain to AdamW (decoupled decay),
+    again masked to kernels only."""
+    params = _toy_params()
+    grads = jax.tree.map(jnp.zeros_like, params)
+    tx = make_optimizer(TrainConfig(optimizer="adam", lr=0.1, weight_decay=1e-2))
+    updates, _ = tx.update(grads, tx.init(params), params)
+    assert np.all(np.asarray(updates["conv"]["kernel"]) < 0)
+    assert np.all(np.asarray(updates["bn"]["scale"]) == 0)
+
+
+def test_imagenet_presets_carry_weight_decay():
+    """Every ImageNet preset ships the weight decay its cited recipe requires
+    (Goyal et al. 1e-4 for the SGD/LARS ResNets, DeiT 0.1 for ViT); the
+    reference-parity presets keep 0 — the reference never minimized its
+    declared l2 (reference: model.py:462-467)."""
+    from tensorflowdistributedlearning_tpu.configs import PRESETS
+
+    assert PRESETS["resnet50_imagenet"].train.weight_decay == 1e-4
+    assert PRESETS["resnet101_imagenet"].train.weight_decay == 1e-4
+    assert PRESETS["resnet152_imagenet"].train.weight_decay == 1e-4
+    assert PRESETS["xception41_imagenet"].train.weight_decay == 1e-4
+    assert PRESETS["vit_s16_imagenet"].train.weight_decay == 0.1
+    assert PRESETS["resnet50_bf16_8k"].train.weight_decay == 1e-4
+    assert PRESETS["resnet50_bf16_8k"].train.optimizer == "lars"
+    assert PRESETS["tgs_salt"].train.weight_decay == 0.0
+
+
+def test_lars_optimizer_trains():
+    """TrainConfig.optimizer='lars' (large-batch layer-wise scaling,
+    arXiv:1708.03888 — the 8k preset's optimizer) trains on the CPU mesh:
+    loss decreases and stays finite."""
+    mesh = make_mesh(8)
+    task = ClassificationTask()
+    model = build_model(SMALL_CLS)
+    # kernels ride the trust-ratio-scaled update; BN/bias (excluded from trust
+    # scaling, per the recipe) take the raw lr — keep it moderate, and use a
+    # real per-shard batch (8): LARS is a large-batch method, and per-shard
+    # BN over 2 images makes the raw-lr BN updates noisy enough to diverge
+    tx = make_optimizer(TrainConfig(optimizer="lars", lr=0.2, weight_decay=1e-4))
+    state = replicate(
+        create_train_state(
+            model, tx, jax.random.key(1), jnp.ones((1, 32, 32, 3), jnp.float32)
+        ),
+        mesh,
+    )
+    train_step = make_train_step(mesh, task)
+    losses = []
+    for batch in synthetic_batches(
+        "classification", 64, seed=31, input_shape=(32, 32), num_classes=4, steps=12
+    ):
+        state, metrics = train_step(state, shard_batch(batch, mesh))
+        losses.append(compute_metrics(metrics)["loss"])
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])
