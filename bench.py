@@ -103,17 +103,15 @@ def run_benchmark(platform: str | None = None) -> dict:
     n = len(devices)
 
     if on_tpu:
-        # ResNet-50 ImageNet config, bfloat16 on the MXU. output_stride=None is the
-        # standard stride-32 classification architecture (the atrous output_stride=8
-        # default is the segmentation flagship and does ~3x the FLOPs/image).
-        cfg = ModelConfig(
-            num_classes=1000,
-            input_shape=(224, 224),
-            input_channels=3,
-            n_blocks=(3, 4, 6),
-            dtype="bfloat16",
-            output_stride=None,
-        )
+        # STANDARD ResNet-50 (classic 64/128/256/512 widths, 25.6M params,
+        # ~4.1 GMACs fwd) — the architecture the V100 baseline figure actually
+        # quotes, bfloat16 on the MXU, taken from the preset registry so the
+        # benchmark can't drift from what users train. The reference's own
+        # wider layout (~3x the FLOPs/image) is measured separately below as
+        # ``reference_family_wide`` so both numbers stay on record.
+        from tensorflowdistributedlearning_tpu.configs import PRESETS
+
+        cfg = PRESETS["resnet50_classic_imagenet"].model
         per_chip_batch = 256
         timed_steps, warmup = 20, 3
     else:
@@ -129,23 +127,24 @@ def run_benchmark(platform: str | None = None) -> dict:
         timed_steps, warmup = 3, 1
 
     mesh = make_mesh(n)
-    model = build_model(cfg)
     tx = make_optimizer(TrainConfig())
-    h, w = cfg.input_shape
     rng = jax.random.PRNGKey(0)
-    sample = np.zeros((1, h, w, cfg.input_channels), np.float32)
 
-    def measure(per_chip: int):
+    def measure(per_chip: int, mcfg: ModelConfig | None = None):
         """(global_batch, dt, compiled_step) for one batch size; raises on OOM."""
+        mcfg = cfg if mcfg is None else mcfg
+        mmodel = build_model(mcfg)
+        mh, mw = mcfg.input_shape
+        msample = np.zeros((1, mh, mw, mcfg.input_channels), np.float32)
         global_b = per_chip * n
-        state = replicate(create_train_state(model, tx, rng, sample), mesh)
+        state = replicate(create_train_state(mmodel, tx, rng, msample), mesh)
         gen = np.random.default_rng(0)
         batch = shard_batch(
             {
                 "images": gen.normal(
-                    0, 1, (global_b, h, w, cfg.input_channels)
+                    0, 1, (global_b, mh, mw, mcfg.input_channels)
                 ).astype(np.float32),
-                "labels": gen.integers(0, cfg.num_classes, global_b).astype(
+                "labels": gen.integers(0, mcfg.num_classes, global_b).astype(
                     np.int32
                 ),
             },
@@ -218,7 +217,7 @@ def run_benchmark(platform: str | None = None) -> dict:
     # MFU: XLA's own FLOP count for the compiled step vs chip peak. cost_analysis
     # is best-effort across backends — fall back to the analytic ResNet-50 figure
     # (~2x 4.1e9 MAC-derived FLOPs fwd, x3 for fwd+bwd) when unavailable.
-    def _flops_of(executable, global_b: int):
+    def _flops_of(executable, global_b: int, analytic_per_image: float):
         try:
             cost = executable.cost_analysis()
             if isinstance(cost, (list, tuple)):
@@ -228,12 +227,23 @@ def run_benchmark(platform: str | None = None) -> dict:
                 return f
         except Exception:  # noqa: BLE001 — cost_analysis is best-effort
             pass
-        return 3 * 2 * 4.1e9 * global_b if on_tpu else None
+        return analytic_per_image * global_b if on_tpu else None
 
     peak = _peak_flops(devices[0])
 
-    def _mfu_fields(executable, global_b: int, step_dt: float) -> dict:
-        flops = _flops_of(executable, global_b)
+    # analytic fwd+bwd FLOPs/image fallbacks when cost_analysis is unavailable:
+    # classic ResNet-50 is the textbook ~4.1 GMACs fwd x2 x3; the reference's
+    # wide layout measures 7.2e10 by XLA cost analysis (CPU, this repo, r3)
+    CLASSIC50_FLOPS_PER_IMAGE = 3 * 2 * 4.1e9
+    WIDE_FLOPS_PER_IMAGE = 7.2e10
+
+    def _mfu_fields(
+        executable,
+        global_b: int,
+        step_dt: float,
+        analytic_per_image: float = CLASSIC50_FLOPS_PER_IMAGE,
+    ) -> dict:
+        flops = _flops_of(executable, global_b, analytic_per_image)
         if flops is None or peak is None:
             return {}
         return {
@@ -268,6 +278,46 @@ def run_benchmark(platform: str | None = None) -> dict:
             result["attention_kernels"] = bench_attention(iters=20, warmup=3)
         except Exception as e:  # noqa: BLE001
             result["attention_kernels"] = {"error": str(e)[:200]}
+        print(json.dumps(result), flush=True)
+
+        # Secondary metric: the reference's own wide ResNet layout (doubled
+        # stage widths + 1024-wide atrous stage, ~3x classic-ResNet-50 FLOPs,
+        # 40.9M params) — the architecture the parity presets train, and the
+        # highest-MFU config measured (0.45-0.46 at batch 256/512, r3 probes:
+        # wide channels keep the MXU full).
+        try:
+            wide_cfg = PRESETS["resnet50_imagenet"].model
+            # start from the batch the headline actually survived at (the OOM
+            # ladder may have backed off per_chip_batch) and keep the same
+            # halving ladder: the wide model is ~3x the activations, so the
+            # headline's size only proves the 1x model fits
+            wide_err: str | None = None
+            for wb in (global_batch // n, global_batch // (2 * n),
+                       global_batch // (4 * n)):
+                if wb < 1:
+                    continue
+                try:
+                    wide_gb, wide_dt, wide_comp = measure(wb, wide_cfg)
+                    break
+                except Exception as e:  # noqa: BLE001 — OOM: halve and retry
+                    msg = str(e)
+                    if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
+                        wide_err = msg[:200]
+                        continue
+                    raise
+            else:
+                raise RuntimeError(wide_err or "no viable wide batch size")
+            wide_ips = wide_gb * timed_steps / wide_dt / n
+            result["reference_family_wide"] = {
+                "images_per_sec_per_chip": round(wide_ips, 2),
+                "global_batch": wide_gb,
+                "step_time_ms": round(wide_dt / timed_steps * 1000, 2),
+                **_mfu_fields(
+                    wide_comp, wide_gb, wide_dt / timed_steps, WIDE_FLOPS_PER_IMAGE
+                ),
+            }
+        except Exception as e:  # noqa: BLE001
+            result["reference_family_wide"] = {"error": str(e)[:200]}
         print(json.dumps(result), flush=True)
 
         # Secondary metric: the reference's ACTUAL production workload — the

@@ -23,18 +23,22 @@ from typing import Dict
 def _timed_us(fn, args, iters: int, warmup: int) -> float:
     """Shared measurement protocol for every kernel comparison in this file:
     compile once, warm up, then one synchronized timed loop (microseconds per
-    call). Keeping one copy keeps the pallas/XLA decision columns comparable."""
-    import jax
+    call). Keeping one copy keeps the pallas/XLA decision columns comparable.
+
+    Synchronizes via ``profiling.sync`` (a real value fetch): on the tunneled
+    TPU backend ``block_until_ready`` alone has been observed to return before
+    execution finishes, inflating throughput ~10x (see bench.py's measure)."""
+    from tensorflowdistributedlearning_tpu.utils.profiling import sync
 
     out = fn(*args)  # compile
-    jax.block_until_ready(out)
+    sync(out)
     for _ in range(warmup):
         out = fn(*args)
-    jax.block_until_ready(out)
+    sync(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    sync(out)
     return (time.perf_counter() - t0) / iters * 1e6
 
 

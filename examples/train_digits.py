@@ -1,0 +1,141 @@
+"""Train a real model on real data to a real accuracy: sklearn's handwritten
+digits (1797 genuine 8x8 scans from the UCI optical-recognition corpus — the
+only real image dataset present in this zero-egress environment; CIFAR/ImageNet
+would need a download).
+
+The reference's notebooks were real end-to-end runs on real Kaggle data
+(reference: Untitled.ipynb cells 7-8). This driver is that proof for the
+streaming fit() path: the raw bitmaps are written as PNG TFRecord shards
+(data/records.py), streamed through the native reader into a ResNet classifier,
+trained on the device mesh, and evaluated on a held-out split the model never
+saw. Default budget reaches ~97% top-1 in under a minute of step time.
+
+Usage:
+    python examples/train_digits.py --model-dir /tmp/digits_run \
+        [--data-dir /tmp/digits_data] [--steps 600] [--batch-size 64]
+        [--json-out DIGITS.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import time
+
+
+def prepare_digits(data_dir: str, *, upscale: int = 4, val_fraction: float = 0.2,
+                   seed: int = 0, shards: int = 4) -> None:
+    """Write the digits corpus as classification record shards.
+
+    8x8 inputs are nearest-upscaled (np.kron) so the stride-32 trunk retains
+    spatial extent; intensities (0..16) rescale to uint8. The split is a seeded
+    permutation — deterministic, so train/val never overlap across runs."""
+    import numpy as np
+    from sklearn.datasets import load_digits
+
+    from tensorflowdistributedlearning_tpu.data.records import (
+        write_classification_shards,
+    )
+
+    digits = load_digits()
+    images = np.kron(
+        (digits.images * (255.0 / 16.0)).astype(np.uint8),
+        np.ones((upscale, upscale), np.uint8),
+    )
+    labels = digits.target.astype(np.int64)
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(images))
+    n_val = int(len(images) * val_fraction)
+    val_idx, train_idx = order[:n_val], order[n_val:]
+    os.makedirs(data_dir, exist_ok=True)
+    write_classification_shards(
+        data_dir, images[train_idx], labels[train_idx], shards=shards,
+        prefix="train",
+    )
+    write_classification_shards(
+        data_dir, images[val_idx], labels[val_idx], shards=1, prefix="val"
+    )
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--model-dir", required=True)
+    parser.add_argument("--data-dir", default=None,
+                        help="record-shard dir (default: {model-dir}/data)")
+    parser.add_argument("--steps", type=int, default=600)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--eval-every", type=int, default=200)
+    parser.add_argument("--json-out", default=None,
+                        help="write the run record (metrics/config/wall time) here")
+    args = parser.parse_args()
+
+    from tensorflowdistributedlearning_tpu.utils.devices import apply_platform_env
+
+    apply_platform_env()
+    logging.basicConfig(level=logging.INFO)
+
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    data_dir = args.data_dir or os.path.join(args.model_dir, "data")
+    if not any(f.startswith("train-") for f in
+               (os.listdir(data_dir) if os.path.isdir(data_dir) else [])):
+        prepare_digits(data_dir)
+
+    # small reference-family trunk at half width: 32x32x1 inputs, ~2.7M params
+    model_cfg = ModelConfig(
+        num_classes=10,
+        input_shape=(32, 32),
+        input_channels=1,
+        n_blocks=(1, 1, 1),
+        width_multiplier=0.5,
+        output_stride=None,
+        dtype="bfloat16",
+        # eval runs on BN running stats; 0.99 lags a short run (it needs ~500
+        # steps to converge) — 0.95 keeps the exported metrics honest
+        batch_norm_decay=0.95,
+    )
+    train_cfg = TrainConfig(
+        optimizer="adam",
+        lr=1e-3,
+        lr_schedule="cosine",
+        lr_decay_steps=args.steps,
+        weight_decay=1e-4,
+        checkpoint_every_steps=max(args.steps // 3, 1),
+        # mirrored digits are other glyphs (or garbage): crop-only augmentation
+        augmentation="crop",
+    )
+    trainer = ClassifierTrainer(args.model_dir, data_dir, model_cfg, train_cfg)
+    t0 = time.perf_counter()
+    result = trainer.fit(
+        batch_size=args.batch_size,
+        steps=args.steps,
+        eval_every_steps=args.eval_every,
+    )
+    wall = time.perf_counter() - t0
+    record = {
+        "dataset": "sklearn load_digits (1797 real 8x8 scans, 80/20 split)",
+        "val_metrics": result.final_metrics,
+        "params": result.n_params,
+        "steps": result.steps,
+        "global_batch": args.batch_size,
+        "wall_time_s": round(wall, 1),
+        "model_config": {"n_blocks": list(model_cfg.n_blocks),
+                         "width_multiplier": model_cfg.width_multiplier,
+                         "input_shape": list(model_cfg.input_shape),
+                         "dtype": model_cfg.dtype},
+        "train_config": {"optimizer": train_cfg.optimizer, "lr": train_cfg.lr,
+                         "lr_schedule": train_cfg.lr_schedule,
+                         "weight_decay": train_cfg.weight_decay},
+    }
+    print(json.dumps(record))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(record, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -38,6 +38,15 @@ class ModelConfig:
     base_depth: int = 256
     # residual units per stage before the atrous stage (reference: model.py:101-103)
     n_blocks: Tuple[int, ...] = (3, 4, 6)
+    # residual-stage width family (backbone="resnet"): "reference" keeps the
+    # reference's doubled stage widths — bottleneck 128/256/512 plus the
+    # 1024-wide atrous multi-grid stage (reference: core/resnet.py:330-344),
+    # ~3x the FLOPs of the standard model; "classic" is the standard
+    # ResNet-50/101/152 ladder (bottleneck 64/128/256/512, four plain stages,
+    # stride-32, no atrous stage) — the apples-to-apples architecture for
+    # ImageNet benchmarks quoted against published ResNet-50 numbers. With
+    # "classic", n_blocks has length 4 (e.g. (3, 4, 6, 3) = ResNet-50).
+    block_layout: str = "reference"
     # "bottleneck" | "basic_block" (reference: model.py:104-106)
     block_type: str = "bottleneck"
     # Classification-path knobs (reference: core/resnet.py:246-256 kept a num_classes /
@@ -94,6 +103,16 @@ class ModelConfig:
             raise ValueError(f"Unknown block type {self.block_type!r}")
         if self.dtype not in ("float32", "bfloat16"):
             raise ValueError(f"Unknown dtype {self.dtype!r}")
+        if self.block_layout not in ("reference", "classic"):
+            raise ValueError(f"Unknown block_layout {self.block_layout!r}")
+        if self.block_layout == "classic":
+            if self.backbone != "resnet":
+                raise ValueError("block_layout='classic' applies to backbone='resnet' only")
+            if len(self.n_blocks) != 4:
+                raise ValueError(
+                    "block_layout='classic' expects n_blocks of length 4, "
+                    f"e.g. (3, 4, 6, 3) for ResNet-50; got {self.n_blocks}"
+                )
         if self.width_multiplier <= 0:
             raise ValueError("width_multiplier must be positive")
         if self.moe_experts < 0:
@@ -149,6 +168,11 @@ class TrainConfig:
     # classification train-loss label smoothing (0.1 in the standard ImageNet
     # recipe, arXiv:1512.00567); eval metrics stay plain CE
     label_smoothing: float = 0.0
+    # fit()'s on-device train augmentation policy: "flip_crop" (random mirror +
+    # reflect-padded random crop — the ImageNet/CIFAR recipe and the default),
+    # "crop" (no mirror — for chirality-sensitive classes: digits, text,
+    # signage), or "none" (stream batches untouched). Eval is never augmented.
+    augmentation: str = "flip_crop"
     lr: float = 0.001
     # "exponential" reproduces the reference's continuous decay (model.py:457-459);
     # "cosine" is the standard ImageNet recipe (linear warmup to `lr` over
@@ -271,6 +295,8 @@ class TrainConfig:
                 "sequence_parallel, or pipeline_parallel: each owns the "
                 "model/sequence mesh axes as a different execution strategy"
             )
+        if self.augmentation not in ("flip_crop", "crop", "none"):
+            raise ValueError(f"Unknown augmentation {self.augmentation!r}")
         if self.lr_schedule not in ("exponential", "cosine"):
             raise ValueError(f"Unknown lr_schedule {self.lr_schedule!r}")
         if self.optimizer not in ("adam", "sgd", "lars"):

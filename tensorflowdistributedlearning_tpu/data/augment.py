@@ -292,17 +292,22 @@ def augment_classification_batch(
     key: jax.Array,
     images: jax.Array,
     crop_padding: int = 4,
+    flip: bool = True,
 ) -> jax.Array:
     """Jittable standard classification augmentation: per-image random horizontal
     flip + reflect-padded random crop (the ImageNet/CIFAR recipe), on device.
 
     The classification twin of ``augment_batch``: geometry runs as one fused XLA
     computation on the accelerator, so the host feed never bottlenecks the MXU
-    (the host pipeline only decodes and normalizes)."""
+    (the host pipeline only decodes and normalizes). ``flip=False`` drops the
+    mirror for chirality-sensitive classes (text, digits, signage)."""
     b, h, w, _ = images.shape
     kf, ky, kx = jax.random.split(key, 3)
-    flips = jax.random.bernoulli(kf, 0.5, (b,))
-    images = jnp.where(flips[:, None, None, None], images[:, :, ::-1, :], images)
+    if flip:
+        flips = jax.random.bernoulli(kf, 0.5, (b,))
+        images = jnp.where(
+            flips[:, None, None, None], images[:, :, ::-1, :], images
+        )
     if crop_padding > 0:
         p = crop_padding
         padded = jnp.pad(

@@ -103,6 +103,40 @@ def resnet_block_specs(
     )
 
 
+def classic_block_specs(
+    n_blocks: Tuple[int, ...],
+    width_multiplier: float = 1.0,
+) -> Tuple[BlockSpec, ...]:
+    """Standard ResNet-50/101/152 stage ladder: four stages at bottleneck widths
+    64/128/256/512 (outputs 256/512/1024/2048), stride-2 unit LAST per the
+    family's v2-beta convention, final stage unstrided — overall stride 32 with
+    the root's 4. This is the published architecture ImageNet numbers quote
+    (``n_blocks=(3, 4, 6, 3)`` = ResNet-50); the reference's own layout
+    (``resnet_block_specs``) runs ~3x these FLOPs (doubled widths + the
+    1024-wide atrous stage, reference: core/resnet.py:330-344)."""
+    if len(n_blocks) != 4:
+        raise ValueError("classic layout expects n_blocks of length 4, e.g. (3, 4, 6, 3)")
+
+    def w(c: int) -> int:
+        return scaled_width(c, width_multiplier)
+
+    specs = []
+    for name, base, num_units, last_stride in zip(
+        ("block1", "block2", "block3", "block4"),
+        (64, 128, 256, 512),
+        n_blocks,
+        (2, 2, 2, 1),
+    ):
+        units = tuple(
+            UnitSpec(depth=w(base * 4), depth_bottleneck=w(base), stride=1)
+            for _ in range(num_units - 1)
+        ) + (
+            UnitSpec(depth=w(base * 4), depth_bottleneck=w(base), stride=last_stride),
+        )
+        specs.append(BlockSpec(name, units))
+    return tuple(specs)
+
+
 class BottleneckUnit(nn.Module):
     """Pre-activation bottleneck residual unit (reference: core/resnet.py:94-152).
 
@@ -328,7 +362,10 @@ class ResNetBackbone(nn.Module):
             # large-batch pod configs rely on (a TPU-first capability; the reference
             # had no memory-saving story). `train` is static (BN mode selection).
             unit_cls = nn.remat(unit_cls, static_argnums=(2,))
-        blocks = resnet_block_specs(cfg.n_blocks, self.multi_grid, wm)
+        if cfg.block_layout == "classic":
+            blocks = classic_block_specs(cfg.n_blocks, wm)
+        else:
+            blocks = resnet_block_specs(cfg.n_blocks, self.multi_grid, wm)
 
         # slim stack_blocks_dense semantics (reference: core/resnet.py:244): strides
         # apply until the target stride is hit, after which they accumulate into rates.

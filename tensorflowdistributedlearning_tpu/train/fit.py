@@ -43,14 +43,20 @@ logger = logging.getLogger(__name__)
 
 
 @functools.lru_cache(maxsize=None)
-def _prepare_classification_cached():
+def _prepare_classification_cached(policy: str = "flip_crop"):
     from tensorflowdistributedlearning_tpu.data import augment as augment_lib
 
     @jax.jit
     def prepare(base_key, step, batch):
         key = jax.random.fold_in(base_key, step)
+        # jitter scales with the input (h/8) up to the CIFAR-standard 4px —
+        # a fixed 4 is a 25% displacement on a 16x16 input
+        pad = min(4, max(batch["images"].shape[1] // 8, 1))
         return {
-            "images": augment_lib.augment_classification_batch(key, batch["images"]),
+            "images": augment_lib.augment_classification_batch(
+                key, batch["images"], crop_padding=pad,
+                flip=policy == "flip_crop",
+            ),
             "labels": batch["labels"],
         }
 
@@ -398,12 +404,15 @@ class ClassifierTrainer:
         return FitResult(final_metrics, self.params, step_no)
 
     def _make_prepare_train(self):
-        """Jitted on-device classification augmentation keyed by (seed, step) —
-        random horizontal flip + reflect-padded random crop
-        (data/augment.py:augment_classification_batch). The seed rides in through
+        """Jitted on-device classification augmentation keyed by (seed, step),
+        under ``TrainConfig.augmentation`` ("flip_crop" | "crop" | "none" —
+        data/augment.py:augment_classification_batch). The seed rides in through
         the traced base key so runs with different seeds share one executable."""
+        policy = self.train_config.augmentation
+        if policy == "none":
+            return lambda step, batch: batch
         base_key = jax.random.PRNGKey(self.train_config.seed)
-        prepare = _prepare_classification_cached()
+        prepare = _prepare_classification_cached(policy)
 
         def bound(step: jax.Array, batch):
             return prepare(base_key, step, batch)

@@ -1,0 +1,316 @@
+"""XPlane profile reader: per-op device-time breakdown without TensorFlow.
+
+``jax.profiler.start_trace`` writes its device timeline as an ``XSpace``
+protocol buffer (``*.xplane.pb``). The stock consumer is TensorBoard's profile
+plugin — a TensorFlow dependency this framework doesn't carry. This module
+reads the wire format directly (protobuf is length-delimited tag/value pairs;
+the XPlane schema is public: tensorflow/tsl ``profiler/protos/xplane.proto``)
+and aggregates per-op device time, so "where does the step time go" is
+answerable on any machine the trace was captured on.
+
+The reference had no profiler story at all (SURVEY §5.1); TensorBoard-free
+trace reading is the subsystem that closes the loop the other way — not just
+writing traces (``utils.profiling.trace``) but deciding from them.
+
+Usage::
+
+    from tensorflowdistributedlearning_tpu.utils import profiling, xplane
+    with profiling.trace(logdir):
+        run_steps()
+    for row in xplane.op_breakdown(logdir)[:20]:
+        print(row.name, row.total_ms, row.occurrences)
+
+or ``python -m tensorflowdistributedlearning_tpu.utils.xplane <logdir>``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+# -- protobuf wire-format scanner -------------------------------------------
+
+_WIRE_VARINT = 0
+_WIRE_FIXED64 = 1
+_WIRE_BYTES = 2
+_WIRE_FIXED32 = 5
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift >= 70:  # negative int64s legitimately take 10 bytes
+            raise ValueError("varint overflow (corrupt protobuf)")
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value) over a serialized message.
+    BYTES fields yield memoryview slices (zero-copy — traces reach 100s of MB)."""
+    view = memoryview(buf)
+    pos = 0
+    end = len(buf)
+    while pos < end:
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == _WIRE_VARINT:
+            value, pos = _read_varint(buf, pos)
+        elif wire == _WIRE_BYTES:
+            length, pos = _read_varint(buf, pos)
+            value = view[pos : pos + length]
+            pos += length
+        elif wire == _WIRE_FIXED64:
+            value = int.from_bytes(view[pos : pos + 8], "little")
+            pos += 8
+        elif wire == _WIRE_FIXED32:
+            value = int.from_bytes(view[pos : pos + 4], "little")
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, value
+
+
+# -- XPlane schema (field numbers from tsl's xplane.proto) -------------------
+
+# XSpace: planes = 1
+# XPlane: id=1, name=2, lines=3, event_metadata=4 (map), stat_metadata=5 (map)
+# XLine:  id=1, name=2, timestamp_ns=3, events=4
+# XEvent: metadata_id=1, offset_ps=2, duration_ps=3, stats=4, num_occurrences=5
+# XEventMetadata: id=1, name=2
+# map entry: key=1, value=2
+
+
+def _parse_event_metadata(plane_buf) -> Dict[int, str]:
+    names: Dict[int, str] = {}
+    for field, _, value in _fields(bytes(plane_buf)):
+        if field != 4:
+            continue
+        key = None
+        meta_name = ""
+        for f2, _, v2 in _fields(bytes(value)):
+            if f2 == 1:
+                key = v2
+            elif f2 == 2:
+                meta_id = None
+                for f3, _, v3 in _fields(bytes(v2)):
+                    if f3 == 1:
+                        meta_id = v3
+                    elif f3 == 2:
+                        meta_name = bytes(v3).decode("utf-8", "replace")
+                if key is None:
+                    key = meta_id
+        if key is not None:
+            names[key] = meta_name
+    return names
+
+
+@dataclasses.dataclass
+class OpTime:
+    name: str
+    total_ms: float
+    occurrences: int
+    fraction: float  # of the plane's total op time
+
+
+@dataclasses.dataclass
+class PlaneBreakdown:
+    plane: str
+    total_ms: float
+    ops: List[OpTime]
+
+
+def _parse_plane(
+    plane_buf,
+) -> Tuple[str, Dict[str, Dict[str, List[float]]]]:
+    """(plane_name, {line_name: {event_name: [duration_ms, occurrences]}}).
+
+    Lines stay SEPARATE: a device plane carries hierarchical timelines
+    ("Steps" > "XLA Modules" > "XLA Ops") whose events nest — summing across
+    lines would double-count every op inside its module inside its step."""
+    raw = bytes(plane_buf)
+    name = ""
+    metadata = _parse_event_metadata(raw)
+    lines: Dict[str, Dict[str, List[float]]] = {}
+    for field, _, value in _fields(raw):
+        if field == 2:
+            name = bytes(value).decode("utf-8", "replace")
+        elif field == 3:  # XLine
+            line_name = ""
+            line_raw = bytes(value)
+            for f2, _, v2 in _fields(line_raw):
+                if f2 == 2:
+                    line_name = bytes(v2).decode("utf-8", "replace")
+                elif f2 == 11 and not line_name:  # display_name fallback
+                    line_name = bytes(v2).decode("utf-8", "replace")
+            agg = lines.setdefault(line_name, {})
+            for f2, _, v2 in _fields(line_raw):
+                if f2 != 4:  # XEvent
+                    continue
+                meta_id = 0
+                dur_ps = 0
+                occurrences = 1
+                for f3, _, v3 in _fields(bytes(v2)):
+                    if f3 == 1:
+                        meta_id = v3
+                    elif f3 == 3:
+                        dur_ps = v3
+                    elif f3 == 5:
+                        occurrences = v3
+                op = metadata.get(meta_id, f"#{meta_id}")
+                entry = agg.setdefault(op, [0.0, 0])
+                entry[0] += dur_ps / 1e9  # ps -> ms
+                entry[1] += occurrences
+    return name, lines
+
+
+def find_xplane_files(logdir: str) -> List[str]:
+    """All ``*.xplane.pb`` under ``logdir`` (jax writes
+    ``plugins/profile/<run>/<host>.xplane.pb``)."""
+    return sorted(
+        glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True)
+    )
+
+
+def op_breakdown(
+    logdir: str,
+    *,
+    plane_filter: str = "TPU",
+    line_filter: Optional[str] = None,
+    top: Optional[int] = None,
+) -> List[OpTime]:
+    """Aggregate per-op device time across every matching device plane under
+    ``logdir``, sorted by total time descending.
+
+    ``plane_filter`` substring-matches plane names (``"/device:TPU:0"`` etc.);
+    pass ``""`` to aggregate every plane (host threads included).
+
+    ``line_filter`` substring-matches timeline (XLine) names within a plane.
+    Device planes nest their timelines ("Steps" > "XLA Modules" > "XLA Ops"),
+    so summing every line would count each op again inside its module and its
+    step. The default (None) auto-selects PER PLANE: a plane with an
+    "XLA Ops" line contributes only its op-level lines; planes without one
+    (host planes — flat thread lines) contribute every line. ``fraction`` is
+    each op's share of the aggregated time — with op-level lines and one
+    traced step per capture this reads directly as "share of the step"."""
+    paths = find_xplane_files(logdir)
+    if not paths:
+        raise FileNotFoundError(f"no *.xplane.pb under {logdir}")
+    plane_lines: List[Dict[str, Dict[str, List[float]]]] = []
+    for path in paths:
+        with open(path, "rb") as f:
+            space = f.read()
+        for field, _, value in _fields(space):
+            if field != 1:
+                continue
+            name, lines = _parse_plane(value)
+            if plane_filter and plane_filter not in name:
+                continue
+            plane_lines.append(lines)
+    agg: Dict[str, List[float]] = {}
+    for lines in plane_lines:
+        effective_filter = line_filter
+        if effective_filter is None and any("XLA Ops" in line for line in lines):
+            effective_filter = "XLA Ops"
+        for line_name, line_agg in lines.items():
+            if effective_filter and effective_filter not in line_name:
+                continue
+            for op, (ms, cnt) in line_agg.items():
+                entry = agg.setdefault(op, [0.0, 0])
+                entry[0] += ms
+                entry[1] += cnt
+    total = sum(ms for ms, _ in agg.values()) or 1.0
+    rows = [
+        OpTime(name=op, total_ms=round(ms, 4), occurrences=int(cnt),
+               fraction=round(ms / total, 4))
+        for op, (ms, cnt) in agg.items()
+    ]
+    rows.sort(key=lambda r: -r.total_ms)
+    return rows[:top] if top else rows
+
+
+def plane_names(logdir: str) -> List[str]:
+    """Every plane name in the capture (pick the device plane to filter on)."""
+    names = []
+    for path in find_xplane_files(logdir):
+        with open(path, "rb") as f:
+            space = f.read()
+        for field, _, value in _fields(space):
+            if field == 1:
+                for f2, _, v2 in _fields(bytes(value)):
+                    if f2 == 2:
+                        names.append(bytes(v2).decode("utf-8", "replace"))
+                        break
+    return names
+
+
+def grouped_breakdown(
+    rows: List[OpTime], groups: Optional[Dict[str, Tuple[str, ...]]] = None
+) -> Dict[str, float]:
+    """Fold an op breakdown into coarse buckets by substring match (first hit
+    wins, in insertion order) — the "where does the time go" summary."""
+    groups = groups or {
+        "conv": ("convolution", "conv"),
+        "matmul": ("dot", "einsum"),
+        "fusion(elementwise/bn)": ("fusion",),
+        "reduce": ("reduce", "all-reduce"),
+        "copy/transpose": ("copy", "transpose", "bitcast"),
+        "infeed/outfeed": ("infeed", "outfeed"),
+    }
+    out = {k: 0.0 for k in groups}
+    out["other"] = 0.0
+    for row in rows:
+        lowered = row.name.lower()
+        for bucket, needles in groups.items():
+            if any(n in lowered for n in needles):
+                out[bucket] += row.total_ms
+                break
+        else:
+            out["other"] += row.total_ms
+    return {k: round(v, 3) for k, v in out.items() if v}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("logdir")
+    parser.add_argument("--plane", default="TPU", help="plane-name substring filter")
+    parser.add_argument(
+        "--line", default=None,
+        help="timeline-name substring filter (default: auto — op-level lines "
+        "only when the plane has an 'XLA Ops' line)",
+    )
+    parser.add_argument("--top", type=int, default=25)
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args(argv)
+
+    rows = op_breakdown(args.logdir, plane_filter=args.plane, line_filter=args.line)
+    if args.json:
+        print(json.dumps({
+            "planes": plane_names(args.logdir),
+            "groups": grouped_breakdown(rows),
+            "top_ops": [dataclasses.asdict(r) for r in rows[: args.top]],
+        }))
+        return 0
+    print("planes:", ", ".join(plane_names(args.logdir)))
+    print("\nbuckets (ms):")
+    for bucket, ms in grouped_breakdown(rows).items():
+        print(f"  {bucket:<24} {ms:>10.3f}")
+    print(f"\ntop {args.top} ops:")
+    for row in rows[: args.top]:
+        print(f"  {row.total_ms:>10.3f} ms  x{row.occurrences:<6} "
+              f"{row.fraction:>6.1%}  {row.name}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,94 @@
+"""Real-data end-to-end proof: train on sklearn's handwritten digits (genuine
+8x8 scans, the one real image corpus available without network access) through
+the full record-shard -> native reader -> fit() -> eval path, and assert the
+held-out accuracy of a REAL trained model (loose tolerance — the reference's
+own real-data proof was its notebook runs, Untitled.ipynb cells 7-8)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_digits_trains_to_real_accuracy(tmp_path):
+    """A tiny trunk on 16x16 upscaled digits reaches >=85% held-out top-1 in a
+    short budget (a linear model scores ~95% on this corpus; the loose bar
+    keeps the test robust to init noise while still proving the pipeline
+    learns real structure from real data)."""
+    from sklearn.datasets import load_digits
+
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.data.records import (
+        write_classification_shards,
+    )
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    digits = load_digits()
+    images = np.kron(
+        (digits.images * (255.0 / 16.0)).astype(np.uint8),
+        np.ones((2, 2), np.uint8),
+    )
+    labels = digits.target
+    rng = np.random.default_rng(0)
+    order = rng.permutation(len(images))
+    val_idx, train_idx = order[:360], order[360:]
+
+    data_dir = str(tmp_path / "data")
+    os.makedirs(data_dir)
+    write_classification_shards(
+        data_dir, images[train_idx], labels[train_idx], shards=2, prefix="train"
+    )
+    write_classification_shards(
+        data_dir, images[val_idx], labels[val_idx], shards=1, prefix="val"
+    )
+
+    model_cfg = ModelConfig(
+        num_classes=10,
+        input_shape=(16, 16),
+        input_channels=1,
+        n_blocks=(1, 1, 1),
+        block_type="basic_block",
+        width_multiplier=0.25,
+        output_stride=None,
+        # eval runs on BN running stats: the 0.99 default needs ~500 steps to
+        # converge, lagging a short run's real accuracy — 0.9 tracks it
+        batch_norm_decay=0.9,
+    )
+    train_cfg = TrainConfig(
+        optimizer="adam",
+        lr=3e-3,
+        lr_schedule="cosine",
+        lr_decay_steps=250,
+        weight_decay=1e-4,
+        checkpoint_every_steps=250,
+        n_devices=1,
+        # digits are chirality-sensitive: mirrored digits are other glyphs (or
+        # garbage), so the default random flip destroys label signal
+        augmentation="crop",
+    )
+    trainer = ClassifierTrainer(
+        str(tmp_path / "run"), data_dir, model_cfg, train_cfg
+    )
+    result = trainer.fit(batch_size=64, steps=250, eval_every_steps=250)
+    assert result.final_metrics["metrics/top1"] >= 0.85, result.final_metrics
+    # the val split is genuinely held out: 360 + 1437 partition the corpus
+    assert result.steps == 250
+
+
+def test_train_digits_driver_help():
+    """The example driver exists and its CLI parses (full runs are covered by
+    the in-process test above; the driver itself is exercised in-session)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "train_digits.py"),
+         "--help"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0
+    assert "--model-dir" in proc.stdout

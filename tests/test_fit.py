@@ -198,6 +198,42 @@ def test_augment_classification_batch_on_device():
         same = np.array_equal(flip_only[i], images[i])
         mirrored = np.array_equal(flip_only[i], images[i, :, ::-1])
         assert same or mirrored
+    # flip=False (TrainConfig.augmentation="crop"): never mirrors — with no
+    # padding either, the batch passes through untouched
+    no_aug = np.asarray(
+        jax.jit(
+            lambda k, im: augment_classification_batch(
+                k, im, crop_padding=0, flip=False
+            )
+        )(jax.random.PRNGKey(3), images)
+    )
+    np.testing.assert_array_equal(no_aug, images)
+
+
+def test_augmentation_policy_validation_and_none_passthrough(tmp_path):
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    with pytest.raises(ValueError, match="augmentation"):
+        TrainConfig(augmentation="mixup")
+    trainer = ClassifierTrainer(
+        str(tmp_path / "m"),
+        None,
+        ModelConfig(
+            num_classes=N_CLASSES,
+            input_shape=SHAPE,
+            input_channels=3,
+            n_blocks=(1, 1, 1),
+            base_depth=16,
+            width_multiplier=0.125,
+            output_stride=None,
+        ),
+        TrainConfig(augmentation="none", n_devices=8),
+    )
+    prepare = trainer._make_prepare_train()
+    batch = {"images": np.ones((4, 8, 8, 3), np.float32),
+             "labels": np.zeros((4,), np.int32)}
+    assert prepare(0, batch) is batch
 
 
 def test_fit_rejects_unshardable_spatial_config(tmp_path):

@@ -145,6 +145,73 @@ def test_classifier_logits():
     assert logits.shape == (2, 10)
 
 
+def test_classic_layout_block_specs():
+    from tensorflowdistributedlearning_tpu.models.resnet import classic_block_specs
+
+    specs = classic_block_specs((3, 4, 6, 3))
+    assert [s.name for s in specs] == ["block1", "block2", "block3", "block4"]
+    assert [len(s.units) for s in specs] == [3, 4, 6, 3]
+    # standard bottleneck ladder 64/128/256/512, outputs x4
+    assert [s.units[0].depth_bottleneck for s in specs] == [64, 128, 256, 512]
+    assert [s.units[0].depth for s in specs] == [256, 512, 1024, 2048]
+    # v2-beta convention: stride-2 unit LAST; final stage unstrided (stride 32
+    # overall with the root's 4)
+    for spec, last_stride in zip(specs, (2, 2, 2, 1)):
+        assert [u.stride for u in spec.units[:-1]] == [1] * (len(spec.units) - 1)
+        assert spec.units[-1].stride == last_stride
+    with pytest.raises(ValueError, match="length 4"):
+        classic_block_specs((3, 4, 6))
+
+
+def test_classic_classifier_shapes_and_params():
+    """block_layout='classic' is the published 25.6M-param ResNet-50: standard
+    stage widths, stride-32 features, ~25-26M params at 1000 classes (the
+    reference family's wide layout is 40.9M)."""
+    cfg = ModelConfig(
+        num_classes=10,
+        input_shape=(64, 64),
+        input_channels=3,
+        n_blocks=(3, 4, 6, 3),
+        block_layout="classic",
+        output_stride=None,
+    )
+    model = ResNetClassifier(cfg)
+    x = jnp.ones((2, 64, 64, 3))
+    _, logits = init_and_apply(model, x)
+    assert logits.shape == (2, 10)
+
+    # full ImageNet-config param count via eval_shape (no real compute)
+    inet = ModelConfig(
+        num_classes=1000,
+        input_shape=(224, 224),
+        input_channels=3,
+        n_blocks=(3, 4, 6, 3),
+        block_layout="classic",
+        output_stride=None,
+    )
+    shapes = jax.eval_shape(
+        lambda k, x: build_model(inet).init(k, x, train=False),
+        jax.random.key(0),
+        jnp.zeros((1, 224, 224, 3)),
+    )
+    n_params = sum(
+        int(np.prod(s.shape)) for s in jax.tree.leaves(shapes["params"])
+    )
+    assert 24e6 < n_params < 27e6
+
+
+def test_classic_layout_validation():
+    with pytest.raises(ValueError, match="length 4"):
+        ModelConfig(block_layout="classic", n_blocks=(3, 4, 6), num_classes=10)
+    with pytest.raises(ValueError, match="resnet"):
+        ModelConfig(
+            backbone="vit", block_layout="classic", n_blocks=(3, 4, 6, 3),
+            num_classes=10,
+        )
+    with pytest.raises(ValueError, match="block_layout"):
+        ModelConfig(block_layout="wide")
+
+
 def test_xception_classifier():
     cfg = ModelConfig(
         backbone="xception", num_classes=10, input_shape=(64, 64), input_channels=3
