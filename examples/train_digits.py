@@ -25,40 +25,6 @@ import os
 import time
 
 
-def prepare_digits(data_dir: str, *, upscale: int = 4, val_fraction: float = 0.2,
-                   seed: int = 0, shards: int = 4) -> None:
-    """Write the digits corpus as classification record shards.
-
-    8x8 inputs are nearest-upscaled (np.kron) so the stride-32 trunk retains
-    spatial extent; intensities (0..16) rescale to uint8. The split is a seeded
-    permutation — deterministic, so train/val never overlap across runs."""
-    import numpy as np
-    from sklearn.datasets import load_digits
-
-    from tensorflowdistributedlearning_tpu.data.records import (
-        write_classification_shards,
-    )
-
-    digits = load_digits()
-    images = np.kron(
-        (digits.images * (255.0 / 16.0)).astype(np.uint8),
-        np.ones((upscale, upscale), np.uint8),
-    )
-    labels = digits.target.astype(np.int64)
-    rng = np.random.default_rng(seed)
-    order = rng.permutation(len(images))
-    n_val = int(len(images) * val_fraction)
-    val_idx, train_idx = order[:n_val], order[n_val:]
-    os.makedirs(data_dir, exist_ok=True)
-    write_classification_shards(
-        data_dir, images[train_idx], labels[train_idx], shards=shards,
-        prefix="train",
-    )
-    write_classification_shards(
-        data_dir, images[val_idx], labels[val_idx], shards=1, prefix="val"
-    )
-
-
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--model-dir", required=True)
@@ -77,6 +43,7 @@ def main() -> int:
     logging.basicConfig(level=logging.INFO)
 
     from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.data.digits import prepare_digits
     from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
 
     data_dir = args.data_dir or os.path.join(args.model_dir, "data")

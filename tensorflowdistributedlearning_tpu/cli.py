@@ -112,6 +112,13 @@ def build_parser() -> argparse.ArgumentParser:
                        "--lr when it differs from the preset's pairing")
     p_fit.add_argument("--lr", type=float, default=None,
                        help="override the preset's learning rate")
+    p_fit.add_argument("--augmentation",
+                       choices=("flip_crop", "crop", "none", "mixup", "cutmix"),
+                       default=None,
+                       help="override the preset's train augmentation policy "
+                       "(crop drops the mirror — digits/text; none streams "
+                       "batches untouched; mixup/cutmix add image/label "
+                       "mixing on top of flip_crop)")
 
     sub.add_parser("presets", help="list the named BASELINE config presets")
     return parser
@@ -246,6 +253,7 @@ def cmd_fit(args) -> int:
         optimizer=args.optimizer,
         lr=args.lr,
         eval_holdout_fraction=args.eval_holdout_fraction,
+        augmentation=args.augmentation,
     )
     print(json.dumps({
         "preset": args.preset,

@@ -171,7 +171,12 @@ class TrainConfig:
     # fit()'s on-device train augmentation policy: "flip_crop" (random mirror +
     # reflect-padded random crop — the ImageNet/CIFAR recipe and the default),
     # "crop" (no mirror — for chirality-sensitive classes: digits, text,
-    # signage), or "none" (stream batches untouched). Eval is never augmented.
+    # signage), "none" (stream batches untouched), "mixup" (flip_crop then
+    # Beta(0.2)-convex image/label mixing, arXiv:1710.09412), or "cutmix"
+    # (flip_crop then area-weighted box pasting, arXiv:1905.04899). The mixing
+    # policies train against per-example paired CE (no soft-label buffers) and
+    # require the standard data-parallel/tensor-parallel step (not
+    # sequence/pipeline parallel). Eval is never augmented.
     augmentation: str = "flip_crop"
     lr: float = 0.001
     # "exponential" reproduces the reference's continuous decay (model.py:457-459);
@@ -295,8 +300,17 @@ class TrainConfig:
                 "sequence_parallel, or pipeline_parallel: each owns the "
                 "model/sequence mesh axes as a different execution strategy"
             )
-        if self.augmentation not in ("flip_crop", "crop", "none"):
+        if self.augmentation not in ("flip_crop", "crop", "none", "mixup", "cutmix"):
             raise ValueError(f"Unknown augmentation {self.augmentation!r}")
+        if self.augmentation in ("mixup", "cutmix") and (
+            self.sequence_parallel > 1 or self.pipeline_parallel > 1
+        ):
+            raise ValueError(
+                f"augmentation={self.augmentation!r} pairs examples through "
+                "extra per-example batch fields (labels_b/lam), which the "
+                "sequence-parallel and pipeline execution strategies do not "
+                "thread; use the data/tensor-parallel step"
+            )
         if self.lr_schedule not in ("exponential", "cosine"):
             raise ValueError(f"Unknown lr_schedule {self.lr_schedule!r}")
         if self.optimizer not in ("adam", "sgd", "lars"):

@@ -323,6 +323,78 @@ def augment_classification_batch(
     return images
 
 
+def mixup_batch(
+    key: jax.Array,
+    images: jax.Array,
+    labels: jax.Array,
+    alpha: float = 0.2,
+) -> Dict[str, jax.Array]:
+    """Mixup (arXiv:1710.09412): convex-combine each image with a permuted
+    partner, lambda ~ Beta(alpha, alpha) per example. Returns the training
+    batch with pairing info instead of materialized soft labels —
+    ``labels``/``labels_b``/``lam`` — so the loss mixes per-example CE terms
+    (algebraically identical to CE against the mixed one-hot target, without
+    a [B, num_classes] buffer)."""
+    kp, kl = jax.random.split(key)
+    b = images.shape[0]
+    perm = jax.random.permutation(kp, b)
+    lam = jax.random.beta(kl, alpha, alpha, (b,)).astype(images.dtype)
+    # fold toward the larger half so lam >= 0.5: keeps "labels" the majority
+    # target (pure convention; CE mix is symmetric)
+    lam = jnp.maximum(lam, 1.0 - lam)
+    mixed = lam[:, None, None, None] * images + (
+        1.0 - lam[:, None, None, None]
+    ) * images[perm]
+    return {
+        "images": mixed,
+        "labels": labels,
+        "labels_b": labels[perm],
+        "lam": lam.astype(jnp.float32),
+    }
+
+
+def cutmix_batch(
+    key: jax.Array,
+    images: jax.Array,
+    labels: jax.Array,
+    alpha: float = 1.0,
+) -> Dict[str, jax.Array]:
+    """CutMix (arXiv:1905.04899): paste a random rectangle from a permuted
+    partner image; the label mixes by surviving area. Boxes are realized as
+    iota-comparison masks (no dynamic slicing — XLA-friendly fixed shapes);
+    ``lam`` is each example's ACTUAL surviving-area fraction after edge
+    clamping, so the loss mix matches the pixels exactly."""
+    kp, kl, ky, kx = jax.random.split(key, 4)
+    b, h, w, _ = images.shape
+    perm = jax.random.permutation(kp, b)
+    lam0 = jax.random.beta(kl, alpha, alpha, (b,))
+    cut = jnp.sqrt(1.0 - lam0)  # box side fraction
+    bh = (cut * h).astype(jnp.int32)
+    bw = (cut * w).astype(jnp.int32)
+    cy = jax.random.randint(ky, (b,), 0, h)
+    cx = jax.random.randint(kx, (b,), 0, w)
+    y0 = jnp.clip(cy - bh // 2, 0, h)
+    y1 = jnp.clip(cy + (bh + 1) // 2, 0, h)
+    x0 = jnp.clip(cx - bw // 2, 0, w)
+    x1 = jnp.clip(cx + (bw + 1) // 2, 0, w)
+    rows = jnp.arange(h)[None, :, None]  # [1, H, 1]
+    cols = jnp.arange(w)[None, None, :]  # [1, 1, W]
+    in_box = (
+        (rows >= y0[:, None, None])
+        & (rows < y1[:, None, None])
+        & (cols >= x0[:, None, None])
+        & (cols < x1[:, None, None])
+    )  # [B, H, W]
+    mixed = jnp.where(in_box[..., None], images[perm], images)
+    box_frac = jnp.mean(in_box.astype(jnp.float32), axis=(1, 2))
+    return {
+        "images": mixed,
+        "labels": labels,
+        "labels_b": labels[perm],
+        "lam": 1.0 - box_frac,
+    }
+
+
 def prepare_eval_batch(images: jax.Array, masks: jax.Array) -> Dict[str, jax.Array]:
     """Eval-mode preparation: no geometry, just the Laplacian channel (the reference's
     non-augmenting input_fn path, preprocessing/preprocessing.py:243-246)."""

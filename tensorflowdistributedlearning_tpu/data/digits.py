@@ -1,0 +1,63 @@
+"""sklearn handwritten-digits corpus -> classification record shards.
+
+The one REAL image dataset available without network access (1797 genuine 8x8
+scans from the UCI optical-recognition corpus, bundled with scikit-learn).
+Used by ``examples/train_digits.py`` and the end-to-end real-data test
+(``tests/test_digits_e2e.py``) — one copy of the rescale/split/shard logic so
+the shipped example and the suite's accuracy assertion cannot diverge.
+
+The reference's real-data path was its Kaggle download + notebook runs
+(reference: Untitled.ipynb cells 7-8); this is the zero-egress equivalent."""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+
+
+def load_digit_arrays(
+    *, upscale: int = 4, val_fraction: float = 0.2, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """(train_images, train_labels, val_images, val_labels) as uint8 HxW arrays.
+
+    8x8 inputs are nearest-upscaled by ``upscale`` (np.kron) so stride-32
+    trunks retain spatial extent; intensities (0..16) rescale to uint8. The
+    split is a seeded permutation — deterministic, so train/val never overlap
+    across runs."""
+    from sklearn.datasets import load_digits
+
+    digits = load_digits()
+    images = np.kron(
+        (digits.images * (255.0 / 16.0)).astype(np.uint8),
+        np.ones((upscale, upscale), np.uint8),
+    )
+    labels = digits.target.astype(np.int64)
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(images))
+    n_val = int(len(images) * val_fraction)
+    val_idx, train_idx = order[:n_val], order[n_val:]
+    return images[train_idx], labels[train_idx], images[val_idx], labels[val_idx]
+
+
+def prepare_digits(
+    data_dir: str,
+    *,
+    upscale: int = 4,
+    val_fraction: float = 0.2,
+    seed: int = 0,
+    shards: int = 4,
+) -> None:
+    """Write the corpus as ``train-*/val-*`` record shards under ``data_dir``
+    (the layout ``fit()`` auto-discovers)."""
+    from tensorflowdistributedlearning_tpu.data.records import (
+        write_classification_shards,
+    )
+
+    tr_x, tr_y, va_x, va_y = load_digit_arrays(
+        upscale=upscale, val_fraction=val_fraction, seed=seed
+    )
+    os.makedirs(data_dir, exist_ok=True)
+    write_classification_shards(data_dir, tr_x, tr_y, shards=shards, prefix="train")
+    write_classification_shards(data_dir, va_x, va_y, shards=1, prefix="val")

@@ -49,16 +49,19 @@ def _prepare_classification_cached(policy: str = "flip_crop"):
     @jax.jit
     def prepare(base_key, step, batch):
         key = jax.random.fold_in(base_key, step)
+        kg, km = jax.random.split(key)
         # jitter scales with the input (h/8) up to the CIFAR-standard 4px —
         # a fixed 4 is a 25% displacement on a 16x16 input
         pad = min(4, max(batch["images"].shape[1] // 8, 1))
-        return {
-            "images": augment_lib.augment_classification_batch(
-                key, batch["images"], crop_padding=pad,
-                flip=policy == "flip_crop",
-            ),
-            "labels": batch["labels"],
-        }
+        images = augment_lib.augment_classification_batch(
+            kg, batch["images"], crop_padding=pad,
+            flip=policy in ("flip_crop", "mixup", "cutmix"),
+        )
+        if policy == "mixup":
+            return augment_lib.mixup_batch(km, images, batch["labels"])
+        if policy == "cutmix":
+            return augment_lib.cutmix_batch(km, images, batch["labels"])
+        return {"images": images, "labels": batch["labels"]}
 
     return prepare
 
@@ -655,6 +658,7 @@ def fit_preset(
     optimizer: Optional[str] = None,
     lr: Optional[float] = None,
     eval_holdout_fraction: Optional[float] = None,
+    augmentation: Optional[str] = None,
 ) -> FitResult:
     """Train a named config preset end-to-end (the CLI `fit` entry point)."""
     from tensorflowdistributedlearning_tpu.configs import get_preset
@@ -684,6 +688,7 @@ def fit_preset(
         or optimizer is not None
         or lr is not None
         or eval_holdout_fraction is not None
+        or augmentation is not None
     ):
         train_cfg = dataclasses.replace(
             train_cfg,
@@ -703,6 +708,7 @@ def fit_preset(
                 if eval_holdout_fraction is not None
                 else train_cfg.eval_holdout_fraction
             ),
+            augmentation=augmentation or train_cfg.augmentation,
         )
     trainer = ClassifierTrainer(
         model_dir, data_dir, preset.model, train_cfg

@@ -211,6 +211,20 @@ class ClassificationTask:
     label_smoothing: float = 0.0
 
     def loss(self, logits: jax.Array, batch: Dict[str, jax.Array]) -> jax.Array:
+        if "lam" in batch:
+            # mixup/cutmix pairing (data/augment.py:mixup_batch/cutmix_batch):
+            # lam-weighted sum of the two per-example CE terms == CE against
+            # the mixed target, without materializing soft labels. Label
+            # smoothing applies to both terms (each target one-hot smooths
+            # independently; the mix is linear).
+            ce_a = losses_lib.softmax_cross_entropy_per_example(
+                logits, batch["labels"], self.label_smoothing
+            )
+            ce_b = losses_lib.softmax_cross_entropy_per_example(
+                logits, batch["labels_b"], self.label_smoothing
+            )
+            lam = batch["lam"]
+            return jnp.mean(lam * ce_a + (1.0 - lam) * ce_b)
         return losses_lib.softmax_cross_entropy(
             logits, batch["labels"], self.label_smoothing
         )

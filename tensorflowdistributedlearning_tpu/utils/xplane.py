@@ -52,9 +52,11 @@ def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
             raise ValueError("varint overflow (corrupt protobuf)")
 
 
-def _fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
-    """Yield (field_number, wire_type, value) over a serialized message.
-    BYTES fields yield memoryview slices (zero-copy — traces reach 100s of MB)."""
+def _fields(buf) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value) over a serialized message
+    (``bytes`` or ``memoryview``). BYTES fields yield memoryview slices, and
+    nested messages feed them straight back in — zero-copy end to end (traces
+    reach 100s of MB)."""
     view = memoryview(buf)
     pos = 0
     end = len(buf)
@@ -90,17 +92,17 @@ def _fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
 
 def _parse_event_metadata(plane_buf) -> Dict[int, str]:
     names: Dict[int, str] = {}
-    for field, _, value in _fields(bytes(plane_buf)):
+    for field, _, value in _fields(plane_buf):
         if field != 4:
             continue
         key = None
         meta_name = ""
-        for f2, _, v2 in _fields(bytes(value)):
+        for f2, _, v2 in _fields(value):
             if f2 == 1:
                 key = v2
             elif f2 == 2:
                 meta_id = None
-                for f3, _, v3 in _fields(bytes(v2)):
+                for f3, _, v3 in _fields(v2):
                     if f3 == 1:
                         meta_id = v3
                     elif f3 == 2:
@@ -117,7 +119,9 @@ class OpTime:
     name: str
     total_ms: float
     occurrences: int
-    fraction: float  # of the plane's total op time
+    # share of the aggregated op time across every matched plane/file (on a
+    # multi-chip capture that is fleet time, not one chip's step time)
+    fraction: float
 
 
 @dataclasses.dataclass
@@ -135,39 +139,42 @@ def _parse_plane(
     Lines stay SEPARATE: a device plane carries hierarchical timelines
     ("Steps" > "XLA Modules" > "XLA Ops") whose events nest — summing across
     lines would double-count every op inside its module inside its step."""
-    raw = bytes(plane_buf)
     name = ""
-    metadata = _parse_event_metadata(raw)
+    metadata = _parse_event_metadata(plane_buf)
     lines: Dict[str, Dict[str, List[float]]] = {}
-    for field, _, value in _fields(raw):
+    for field, _, value in _fields(plane_buf):
         if field == 2:
             name = bytes(value).decode("utf-8", "replace")
-        elif field == 3:  # XLine
+        elif field == 3:  # XLine — one pass; field order is not guaranteed,
+            # so aggregate locally and resolve the line name at the end
             line_name = ""
-            line_raw = bytes(value)
-            for f2, _, v2 in _fields(line_raw):
+            display_name = ""
+            line_agg: Dict[str, List[float]] = {}
+            for f2, _, v2 in _fields(value):
                 if f2 == 2:
                     line_name = bytes(v2).decode("utf-8", "replace")
-                elif f2 == 11 and not line_name:  # display_name fallback
-                    line_name = bytes(v2).decode("utf-8", "replace")
-            agg = lines.setdefault(line_name, {})
-            for f2, _, v2 in _fields(line_raw):
-                if f2 != 4:  # XEvent
-                    continue
-                meta_id = 0
-                dur_ps = 0
-                occurrences = 1
-                for f3, _, v3 in _fields(bytes(v2)):
-                    if f3 == 1:
-                        meta_id = v3
-                    elif f3 == 3:
-                        dur_ps = v3
-                    elif f3 == 5:
-                        occurrences = v3
-                op = metadata.get(meta_id, f"#{meta_id}")
+                elif f2 == 11:
+                    display_name = bytes(v2).decode("utf-8", "replace")
+                elif f2 == 4:  # XEvent
+                    meta_id = 0
+                    dur_ps = 0
+                    occurrences = 1
+                    for f3, _, v3 in _fields(v2):
+                        if f3 == 1:
+                            meta_id = v3
+                        elif f3 == 3:
+                            dur_ps = v3
+                        elif f3 == 5:
+                            occurrences = v3
+                    op = metadata.get(meta_id, f"#{meta_id}")
+                    entry = line_agg.setdefault(op, [0.0, 0])
+                    entry[0] += dur_ps / 1e9  # ps -> ms
+                    entry[1] += occurrences
+            agg = lines.setdefault(line_name or display_name, {})
+            for op, (ms, cnt) in line_agg.items():
                 entry = agg.setdefault(op, [0.0, 0])
-                entry[0] += dur_ps / 1e9  # ps -> ms
-                entry[1] += occurrences
+                entry[0] += ms
+                entry[1] += cnt
     return name, lines
 
 
@@ -244,7 +251,7 @@ def plane_names(logdir: str) -> List[str]:
             space = f.read()
         for field, _, value in _fields(space):
             if field == 1:
-                for f2, _, v2 in _fields(bytes(value)):
+                for f2, _, v2 in _fields(value):
                     if f2 == 2:
                         names.append(bytes(v2).decode("utf-8", "replace"))
                         break

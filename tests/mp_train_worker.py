@@ -46,43 +46,54 @@ def main() -> int:
     from tensorflowdistributedlearning_tpu.train.state import create_train_state
     from tensorflowdistributedlearning_tpu.config import TrainConfig
 
-    raw_state = create_train_state(
-        tiny_model(),
-        step_lib.make_optimizer(TrainConfig(lr=0.01)),
-        jax.random.PRNGKey(0),
-        np.zeros((1, 8, 8, 3), np.float32),
-    )
-    if mode == "tp":
-        # multi-host TENSOR parallelism: (batch=4, model=2) global mesh —
-        # model-axis groups are intra-process (make_mesh requires it), the
-        # BATCH axis spans the processes; params and optimizer are sharded
-        # over the model axis and assembled from per-process shards
-        from tensorflowdistributedlearning_tpu.parallel import tensor as tp_lib
-
-        mesh = mesh_lib.make_mesh(None, model_parallel=2)
-        state = tp_lib.shard_state_tensor_parallel(raw_state, mesh)
-        train_step = tp_lib.make_train_step_gspmd(
-            mesh, step_lib.ClassificationTask(), donate=False
+    def run(strategy: str):
+        raw_state = create_train_state(
+            tiny_model(),
+            step_lib.make_optimizer(TrainConfig(lr=0.01)),
+            jax.random.PRNGKey(0),
+            np.zeros((1, 8, 8, 3), np.float32),
         )
-    else:
-        mesh = mesh_lib.make_mesh(None)  # all 8 global devices, pure DP
-        state = mesh_lib.replicate(raw_state, mesh)
-        train_step = step_lib.make_train_step(
-            mesh, step_lib.ClassificationTask(), donate=False
+        if strategy == "tp":
+            # multi-host TENSOR parallelism: (batch=4, model=2) global mesh —
+            # model-axis groups are intra-process (make_mesh requires it), the
+            # BATCH axis spans the processes; params and optimizer are sharded
+            # over the model axis and assembled from per-process shards
+            from tensorflowdistributedlearning_tpu.parallel import tensor as tp_lib
+
+            mesh = mesh_lib.make_mesh(None, model_parallel=2)
+            state = tp_lib.shard_state_tensor_parallel(raw_state, mesh)
+            train_step = tp_lib.make_train_step_gspmd(
+                mesh, step_lib.ClassificationTask(), donate=False
+            )
+        else:
+            mesh = mesh_lib.make_mesh(None)  # all 8 global devices, pure DP
+            state = mesh_lib.replicate(raw_state, mesh)
+            train_step = step_lib.make_train_step(
+                mesh, step_lib.ClassificationTask(), donate=False
+            )
+
+        global_batch = 16
+        local_bs = multihost.per_process_batch_size(global_batch)
+        assert local_bs == global_batch // nproc
+        # deterministic global batch; THIS process contributes its local rows
+        batch = make_global_batch(global_batch)
+        rows = multihost.process_local_rows(global_batch, mesh)
+        local = {k: v[rows] for k, v in batch.items()}
+        sharded = multihost.global_shard_batch(local, mesh)
+
+        new_state, metrics = train_step(state, sharded)
+        loss = step_lib.compute_metrics(jax.device_get(metrics))["loss"]
+        print(
+            f"RESULT_{strategy.upper()} {loss:.8f} "
+            f"{int(jax.device_get(new_state.step))}",
+            flush=True,
         )
 
-    global_batch = 16
-    local_bs = multihost.per_process_batch_size(global_batch)
-    assert local_bs == global_batch // nproc
-    # deterministic global batch; THIS process contributes only its local rows
-    batch = make_global_batch(global_batch)
-    rows = multihost.process_local_rows(global_batch, mesh)
-    local = {k: v[rows] for k, v in batch.items()}
-    sharded = multihost.global_shard_batch(local, mesh)
-
-    new_state, metrics = train_step(state, sharded)
-    loss = step_lib.compute_metrics(jax.device_get(metrics))["loss"]
-    print(f"RESULT {loss:.8f} {int(jax.device_get(new_state.step))}", flush=True)
+    # "both" amortizes the expensive part (process spawn + jax.distributed
+    # init, ~15 s per 2-process pair) across the dp AND tp strategies —
+    # collectives run in the same jax.distributed session either way
+    for strategy in ("dp", "tp") if mode == "both" else (mode,):
+        run(strategy)
     return 0
 
 

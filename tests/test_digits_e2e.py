@@ -9,7 +9,6 @@ import os
 import subprocess
 import sys
 
-import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -20,32 +19,14 @@ def test_digits_trains_to_real_accuracy(tmp_path):
     short budget (a linear model scores ~95% on this corpus; the loose bar
     keeps the test robust to init noise while still proving the pipeline
     learns real structure from real data)."""
-    from sklearn.datasets import load_digits
-
     from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
-    from tensorflowdistributedlearning_tpu.data.records import (
-        write_classification_shards,
-    )
+    from tensorflowdistributedlearning_tpu.data.digits import prepare_digits
     from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
 
-    digits = load_digits()
-    images = np.kron(
-        (digits.images * (255.0 / 16.0)).astype(np.uint8),
-        np.ones((2, 2), np.uint8),
-    )
-    labels = digits.target
-    rng = np.random.default_rng(0)
-    order = rng.permutation(len(images))
-    val_idx, train_idx = order[:360], order[360:]
-
     data_dir = str(tmp_path / "data")
-    os.makedirs(data_dir)
-    write_classification_shards(
-        data_dir, images[train_idx], labels[train_idx], shards=2, prefix="train"
-    )
-    write_classification_shards(
-        data_dir, images[val_idx], labels[val_idx], shards=1, prefix="val"
-    )
+    # one shared prep path with examples/train_digits.py; 2x upscale keeps the
+    # test model small (the example's default is 4x at 32x32)
+    prepare_digits(data_dir, upscale=2, val_fraction=0.2, seed=0, shards=2)
 
     model_cfg = ModelConfig(
         num_classes=10,
@@ -76,7 +57,8 @@ def test_digits_trains_to_real_accuracy(tmp_path):
     )
     result = trainer.fit(batch_size=64, steps=250, eval_every_steps=250)
     assert result.final_metrics["metrics/top1"] >= 0.85, result.final_metrics
-    # the val split is genuinely held out: 360 + 1437 partition the corpus
+    # the val split is genuinely held out: prepare_digits partitions the
+    # corpus by a seeded permutation (359 val + 1438 train)
     assert result.steps == 250
 
 

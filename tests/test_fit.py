@@ -210,12 +210,112 @@ def test_augment_classification_batch_on_device():
     np.testing.assert_array_equal(no_aug, images)
 
 
+def test_mixup_and_cutmix_batches():
+    import jax
+
+    from tensorflowdistributedlearning_tpu.data.augment import (
+        cutmix_batch,
+        mixup_batch,
+    )
+
+    rng = np.random.default_rng(3)
+    images = rng.normal(0, 1, (16, 12, 12, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, 16).astype(np.int32)
+
+    mixed = jax.jit(mixup_batch)(jax.random.PRNGKey(0), images, labels)
+    assert set(mixed) == {"images", "labels", "labels_b", "lam"}
+    assert mixed["images"].shape == images.shape
+    lam = np.asarray(mixed["lam"])
+    assert np.all((lam >= 0.5) & (lam <= 1.0))  # majority-target convention
+    # each mixed image is the stated convex combination of its pair
+    # (recover the permutation by matching labels_b rows)
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(mixed["labels_b"])), np.sort(labels)
+    )
+
+    # unique labels recover the permutation, so fixed points (an image paired
+    # with itself) are excluded from the area check
+    uniq = np.arange(16, dtype=np.int32)
+    cut = jax.jit(cutmix_batch)(jax.random.PRNGKey(1), images, uniq)
+    cl = np.asarray(cut["lam"])
+    assert np.all((cl >= 0.0) & (cl <= 1.0))
+    out = np.asarray(cut["images"])
+    perm = np.asarray(cut["labels_b"])
+    # lam is the exact surviving-area fraction: pixels equal to the original
+    # image occupy lam of each map (partner pixels differ a.s. for gaussians)
+    checked = 0
+    for i in range(16):
+        if perm[i] == i:
+            continue
+        same = np.isclose(out[i], images[i]).all(axis=-1).mean()
+        assert same == pytest.approx(cl[i], abs=1e-6)
+        checked += 1
+    assert checked >= 8  # a random 16-permutation has few fixed points
+
+
+def test_mixup_loss_mixes_per_example_ce():
+    import jax.numpy as jnp
+
+    from tensorflowdistributedlearning_tpu.ops import losses
+    from tensorflowdistributedlearning_tpu.train.step import ClassificationTask
+
+    task = ClassificationTask()
+    logits = jnp.asarray(np.random.default_rng(0).normal(0, 1, (6, 5)), jnp.float32)
+    labels = jnp.asarray([0, 1, 2, 3, 4, 0], jnp.int32)
+    labels_b = jnp.asarray([4, 3, 2, 1, 0, 2], jnp.int32)
+    lam = jnp.asarray([1.0, 0.5, 0.75, 1.0, 0.25, 0.6], jnp.float32)
+    batch = {"labels": labels, "labels_b": labels_b, "lam": lam}
+    got = float(task.loss(logits, batch))
+    ce_a = np.asarray(losses.softmax_cross_entropy_per_example(logits, labels))
+    ce_b = np.asarray(losses.softmax_cross_entropy_per_example(logits, labels_b))
+    want = float(np.mean(np.asarray(lam) * ce_a + (1 - np.asarray(lam)) * ce_b))
+    assert got == pytest.approx(want, rel=1e-6)
+    # lam == 1 everywhere degenerates to plain CE
+    ones = {"labels": labels, "labels_b": labels_b,
+            "lam": jnp.ones_like(lam)}
+    assert float(task.loss(logits, ones)) == pytest.approx(
+        float(np.mean(ce_a)), rel=1e-6
+    )
+
+
+def test_fit_trains_with_mixup(tmp_path):
+    """mixup flows through the real SPMD train step (extra per-example batch
+    fields ride the batch-axis specs) and the loss decreases training on one
+    repeated batch."""
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    trainer = ClassifierTrainer(
+        str(tmp_path),
+        None,
+        ModelConfig(
+            num_classes=N_CLASSES,
+            input_shape=SHAPE,
+            input_channels=3,
+            n_blocks=(1, 1, 1),
+            base_depth=16,
+            width_multiplier=0.125,
+            output_stride=None,
+        ),
+        TrainConfig(augmentation="mixup", checkpoint_every_steps=4, n_devices=8),
+    )
+    result = trainer.fit(batch_size=8, steps=4, eval_every_steps=4)
+    assert result.steps == 4
+    assert np.isfinite(result.final_metrics["loss"])
+    # mixing policies refuse the execution strategies that don't thread the
+    # pairing fields
+    with pytest.raises(ValueError, match="mixup"):
+        TrainConfig(augmentation="mixup", sequence_parallel=2)
+    with pytest.raises(ValueError, match="cutmix"):
+        TrainConfig(augmentation="cutmix", pipeline_parallel=2)
+
+
 def test_augmentation_policy_validation_and_none_passthrough(tmp_path):
     from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
     from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
 
     with pytest.raises(ValueError, match="augmentation"):
-        TrainConfig(augmentation="mixup")
+        TrainConfig(augmentation="randaug")
     trainer = ClassifierTrainer(
         str(tmp_path / "m"),
         None,
@@ -305,7 +405,7 @@ def test_fit_loop_accepts_imagenet_preset_architecture(tmp_path):
     preset = get_preset("resnet50_imagenet")
     small = dataclasses.replace(
         preset.model, input_shape=SHAPE, n_blocks=(1, 1, 1), base_depth=16,
-        num_classes=N_CLASSES,
+        num_classes=N_CLASSES, width_multiplier=0.25,
     )
     trainer = ClassifierTrainer(str(tmp_path), None, small, preset.train)
     result = trainer.fit(batch_size=8, steps=1)

@@ -31,6 +31,20 @@ def init_and_apply(model, x, train=False):
     return variables, model.apply(variables, x, train=False)
 
 
+def abstract_init_and_apply(model, x):
+    """Shape-level twin of ``init_and_apply``: traces init+apply under
+    ``jax.eval_shape`` — full param trees and output ShapeDtypeStructs with
+    identical .shape/.dtype assertions, but no XLA compile and no compute
+    (shape-parity tests on the full-width reference configs would otherwise
+    dominate suite wall time)."""
+
+    def both(key, inp):
+        variables = model.init(key, inp, train=False)
+        return variables, model.apply(variables, inp, train=False)
+
+    return jax.eval_shape(both, jax.random.key(0), x)
+
+
 def test_upsample_shape():
     x = jnp.ones((2, 13, 13, 8))
     assert upsample(x, (26, 26)).shape == (2, 26, 26, 8)
@@ -61,7 +75,7 @@ def test_backbone_endpoint_shapes_output_stride_8():
     cfg = ModelConfig()
     model = ResNetBackbone(cfg)
     x = jnp.ones((1, 101, 101, 2))
-    _, eps = init_and_apply(model, x)
+    _, eps = abstract_init_and_apply(model, x)
     assert eps["root"].shape == (1, 26, 26, 128)
     assert eps["block1_unit1_residual"].shape == (1, 26, 26, 512)
     assert eps["block1"].shape == (1, 13, 13, 512)
@@ -74,7 +88,7 @@ def test_backbone_no_output_stride_is_stride_32():
     cfg = ModelConfig(output_stride=None, input_shape=(64, 64), input_channels=3)
     model = ResNetBackbone(cfg)
     x = jnp.ones((1, 64, 64, 3))
-    _, eps = init_and_apply(model, x)
+    _, eps = abstract_init_and_apply(model, x)
     assert eps["features"].shape == (1, 2, 2, 1024)
 
 
@@ -88,7 +102,7 @@ def test_segmentation_logits_shape_and_dtype():
     cfg = ModelConfig()
     model = ResNetSegmentation(cfg)
     x = jnp.ones((1, 101, 101, 2))
-    variables, logits = init_and_apply(model, x)
+    variables, logits = abstract_init_and_apply(model, x)
     assert logits.shape == (1, 101, 101, 1)
     assert logits.dtype == jnp.float32
     assert count_params(variables["params"]) > 1_000_000
@@ -99,7 +113,7 @@ def test_segmentation_other_input_size():
     cfg = ModelConfig(input_shape=(128, 128))
     model = ResNetSegmentation(cfg)
     x = jnp.ones((1, 128, 128, 2))
-    _, logits = init_and_apply(model, x)
+    _, logits = abstract_init_and_apply(model, x)
     assert logits.shape == (1, 128, 128, 1)
 
 
@@ -107,7 +121,7 @@ def test_segmentation_basic_block():
     cfg = ModelConfig(block_type="basic_block", n_blocks=(2, 2, 2))
     model = ResNetSegmentation(cfg)
     x = jnp.ones((1, 101, 101, 2))
-    _, logits = init_and_apply(model, x)
+    _, logits = abstract_init_and_apply(model, x)
     assert logits.shape == (1, 101, 101, 1)
 
 
@@ -141,7 +155,7 @@ def test_classifier_logits():
     cfg = ModelConfig(num_classes=10, input_shape=(64, 64), input_channels=3)
     model = ResNetClassifier(cfg)
     x = jnp.ones((2, 64, 64, 3))
-    _, logits = init_and_apply(model, x)
+    _, logits = abstract_init_and_apply(model, x)
     assert logits.shape == (2, 10)
 
 
@@ -189,15 +203,10 @@ def test_classic_classifier_shapes_and_params():
         block_layout="classic",
         output_stride=None,
     )
-    shapes = jax.eval_shape(
-        lambda k, x: build_model(inet).init(k, x, train=False),
-        jax.random.key(0),
-        jnp.zeros((1, 224, 224, 3)),
+    variables, _ = abstract_init_and_apply(
+        build_model(inet), jnp.zeros((1, 224, 224, 3))
     )
-    n_params = sum(
-        int(np.prod(s.shape)) for s in jax.tree.leaves(shapes["params"])
-    )
-    assert 24e6 < n_params < 27e6
+    assert 24e6 < count_params(variables["params"]) < 27e6
 
 
 def test_classic_layout_validation():
@@ -218,7 +227,7 @@ def test_xception_classifier():
     )
     model = Xception41(cfg)
     x = jnp.ones((2, 64, 64, 3))
-    variables, logits = init_and_apply(model, x)
+    variables, logits = abstract_init_and_apply(model, x)
     assert logits.shape == (2, 10)
     # all 8 middle-flow units must exist — the reference's dedented loop built only one
     # (SURVEY §2.4.8)
@@ -235,7 +244,7 @@ def test_xception_atrous_output_stride():
 
     model = XceptionBackbone(cfg)
     x = jnp.ones((1, 64, 64, 3))
-    _, eps = init_and_apply(model, x)
+    _, eps = abstract_init_and_apply(model, x)
     assert eps["features"].shape[1:3] == (4, 4)  # 64/16
 
 

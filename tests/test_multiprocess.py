@@ -62,19 +62,26 @@ def _run_workers(mode: str):
                 p.kill()
     results = []
     for out in outs:
-        line = [ln for ln in out.splitlines() if ln.startswith("RESULT")][0]
-        _, loss, step = line.split()
-        results.append((float(loss), int(step)))
+        per_mode = {}
+        for ln in out.splitlines():
+            if ln.startswith("RESULT_"):
+                tag, loss, step = ln.split()
+                per_mode[tag.removeprefix("RESULT_").lower()] = (
+                    float(loss), int(step),
+                )
+        results.append(per_mode)
     return results
 
 
 @pytest.fixture(scope="module")
 def worker_results():
-    return _run_workers("dp")
+    """One 2-process spawn runs BOTH strategies (dp then tp) — the spawn +
+    jax.distributed init dominates the test's cost, so it is paid once."""
+    return _run_workers("both")
 
 
 def test_ranks_agree(worker_results):
-    (loss0, step0), (loss1, step1) = worker_results
+    (loss0, step0), (loss1, step1) = (r["dp"] for r in worker_results)
     assert step0 == step1 == 1
     assert loss0 == pytest.approx(loss1, abs=0.0)  # bitwise across processes
 
@@ -106,7 +113,7 @@ def test_matches_single_process_oracle(worker_results):
     )
     _, metrics = train_step(state, mesh_lib.shard_batch(batch, mesh))
     oracle = step_lib.compute_metrics(jax.device_get(metrics))["loss"]
-    (loss0, _), _ = worker_results
+    loss0, _ = worker_results[0]["dp"]
     assert loss0 == pytest.approx(oracle, rel=1e-6)
 
 
@@ -140,13 +147,13 @@ def _oracle_loss():
     return step_lib.compute_metrics(jax.device_get(metrics))["loss"]
 
 
-def test_tensor_parallel_across_processes():
+def test_tensor_parallel_across_processes(worker_results):
     """Multi-host TENSOR parallelism with real processes: a (4, 2, 1) dp x tp
     mesh — each model-axis group is intra-process (make_mesh requires
     it), the BATCH axis spans the two processes — with params/optimizer
     assembled from per-process shards and the GSPMD train step over gloo.
     Ranks must agree bitwise AND match the single-process oracle loss."""
-    (loss0, step0), (loss1, step1) = _run_workers("tp")
+    (loss0, step0), (loss1, step1) = (r["tp"] for r in worker_results)
     assert step0 == step1 == 1
     assert loss0 == pytest.approx(loss1, abs=0.0)
     assert loss0 == pytest.approx(_oracle_loss(), rel=1e-5)

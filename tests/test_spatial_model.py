@@ -295,7 +295,11 @@ def test_trainer_end_to_end_with_sequence_parallel(tmp_path):
             sequence_parallel=2,
             checkpoint_every_steps=2,
             eval_throttle_secs=0,
-            train_log_every_steps=2,
+            # > steps: skips the train-phase image-summary forward (a whole
+            # extra spatial-mesh trace; that path is covered on the plain mesh
+            # by test_trainer.py) — this test's job is train/eval/predict
+            # phases running H-sharded
+            train_log_every_steps=5,
         ),
         input_shape=(32, 32),
         n_blocks=(1, 1, 1),
