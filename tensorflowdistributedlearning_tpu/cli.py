@@ -112,6 +112,9 @@ def build_parser() -> argparse.ArgumentParser:
                        "--lr when it differs from the preset's pairing")
     p_fit.add_argument("--lr", type=float, default=None,
                        help="override the preset's learning rate")
+    p_fit.add_argument("--ema-decay", type=float, default=None,
+                       help="track a parameter EMA at this decay (e.g. 0.9999) "
+                       "and evaluate/export the averaged weights; 0 disables")
     p_fit.add_argument("--augmentation",
                        choices=("flip_crop", "crop", "none", "mixup", "cutmix"),
                        default=None,
@@ -254,6 +257,7 @@ def cmd_fit(args) -> int:
         lr=args.lr,
         eval_holdout_fraction=args.eval_holdout_fraction,
         augmentation=args.augmentation,
+        ema_decay=args.ema_decay,
     )
     print(json.dumps({
         "preset": args.preset,

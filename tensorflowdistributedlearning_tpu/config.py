@@ -62,6 +62,13 @@ class ModelConfig:
     # rematerialize residual units on the backward pass (jax.checkpoint): trades
     # recompute FLOPs for activation HBM — enables large per-chip batches.
     remat: bool = False
+    # execute the root 3x3 stride-2 conv as a 2x2 conv on the
+    # space-to-depth(2) input transform (models/layers.py:SpaceToDepthConv) —
+    # numerically identical, but the MXU contracts over 4x the input channels
+    # (12 vs 3 for RGB), the standard TPU stem trick. resnet/xception only;
+    # requires even input dims; checkpoint-compatible with the plain stem
+    # (the canonical 3x3 kernel is the stored parameter either way).
+    stem_space_to_depth: bool = False
     # uniform channel-width scale for every backbone stage (root convs, residual
     # stages, Xception flows, ViT embed dim). 1.0 keeps the reference widths
     # (core/resnet.py:333-344, core/xception.py:405-465); fractional values give
@@ -115,6 +122,18 @@ class ModelConfig:
                 )
         if self.width_multiplier <= 0:
             raise ValueError("width_multiplier must be positive")
+        if self.stem_space_to_depth:
+            if self.backbone == "vit":
+                raise ValueError(
+                    "stem_space_to_depth applies to conv stems "
+                    "(backbone='resnet'/'xception'); ViT patchification already "
+                    "folds pixels into the contraction"
+                )
+            if self.input_shape[0] % 2 or self.input_shape[1] % 2:
+                raise ValueError(
+                    "stem_space_to_depth needs even input dims, got "
+                    f"{self.input_shape}"
+                )
         if self.moe_experts < 0:
             raise ValueError(f"moe_experts must be >= 0, got {self.moe_experts}")
         if self.moe_experts:
@@ -165,6 +184,13 @@ class TrainConfig:
     # core/resnet.py:357-376); the ImageNet presets set 1e-4 per their cited
     # recipe (configs.py).
     weight_decay: float = 0.0
+    # exponential moving average of the parameters, tracked inside the
+    # optimizer chain (train/step.py:ema_tracker) and used automatically for
+    # eval and best-export when > 0 (train/step.py:with_ema_params). 0.0
+    # disables (the reference's behavior: TF1/slim with no weight averaging);
+    # ~0.9999 is the modern recipe value at ImageNet scale. Costs one extra
+    # params-sized buffer in opt_state.
+    ema_decay: float = 0.0
     # classification train-loss label smoothing (0.1 in the standard ImageNet
     # recipe, arXiv:1512.00567); eval metrics stay plain CE
     label_smoothing: float = 0.0
@@ -317,6 +343,10 @@ class TrainConfig:
             raise ValueError(f"Unknown optimizer {self.optimizer!r}")
         if self.weight_decay < 0:
             raise ValueError(f"weight_decay must be >= 0, got {self.weight_decay}")
+        if not 0.0 <= self.ema_decay < 1.0:
+            raise ValueError(
+                f"ema_decay must be in [0, 1), got {self.ema_decay}"
+            )
         if not 0.0 <= self.eval_holdout_fraction < 1.0:
             raise ValueError(
                 "eval_holdout_fraction must be in [0, 1), got "

@@ -25,6 +25,63 @@ def scaled_width(channels: int, multiplier: float) -> int:
     return max(1, int(round(channels * multiplier)))
 
 
+def space_to_depth(x: jax.Array, block: int = 2) -> jax.Array:
+    """[B, H, W, C] -> [B, H/block, W/block, block*block*C], channel order
+    (dy, dx, c) — the TPU input transform for thin-channel stem convs: the MXU
+    tiles the contracting (input-channel) dimension, so C=3 convs waste most of
+    each tile; folding a 2x2 pixel block into channels quadruples the
+    contraction depth at identical FLOPs (the standard MLPerf TPU ResNet
+    trick)."""
+    b, h, w, c = x.shape
+    if h % block or w % block:
+        raise ValueError(
+            f"space_to_depth needs H, W divisible by {block}, got {h}x{w}"
+        )
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        b, h // block, w // block, block * block * c
+    )
+
+
+class SpaceToDepthConv(nn.Module):
+    """A 3x3 stride-2 SAME conv executed as a 2x2 stride-1 conv on the
+    space-to-depth(2) transform of its input — numerically identical output,
+    ~4x deeper MXU contraction for thin-channel stems.
+
+    The parameter is the CANONICAL [3, 3, C_in, features] kernel (same name and
+    shape as ``nn.Conv``), transformed at apply time, so checkpoints move
+    freely between this and the plain conv path. Derivation: flax SAME with
+    k=3, s=2, even H pads (0, 1), so out(i) covers input rows 2i..2i+2; pad the
+    kernel to 4x4 at the high edge, split each spatial 4 as (block di, offset
+    dy) with r = 2*di + dy, and fold (dy, dx, c) into the contraction to match
+    ``space_to_depth`` channel order. The 2x2 conv then needs cells i..i+1 —
+    explicit (0, 1) padding."""
+
+    features: int
+    kernel_init: Callable = conv_kernel_init
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = x.shape[-1]
+        kernel = self.param("kernel", self.kernel_init, (3, 3, c, self.features))
+        dtype = self.dtype or x.dtype
+        k44 = jnp.pad(kernel, ((0, 1), (0, 1), (0, 0), (0, 0)))
+        k2 = (
+            k44.reshape(2, 2, 2, 2, c, self.features)
+            .transpose(0, 2, 1, 3, 4, 5)
+            .reshape(2, 2, 4 * c, self.features)
+        )
+        y = space_to_depth(x.astype(dtype), 2)
+        return jax.lax.conv_general_dilated(
+            y,
+            k2.astype(dtype),
+            window_strides=(1, 1),
+            padding=((0, 1), (0, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+
 def fixed_padding(
     x: jax.Array, kernel_size: int, mode: str = "constant", rate: int = 1
 ) -> jax.Array:
@@ -134,11 +191,30 @@ class ConvBN(nn.Module):
     bn_scale: bool = True
     bn_axis_name: Optional[str] = None
     spatial_axis_name: Optional[str] = None
+    space_to_depth: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
-        if self.spatial_axis_name is not None and self.kernel_size > 1:
+        if self.space_to_depth:
+            if self.kernel_size != 3 or self.stride != 2 or self.rate != 1:
+                raise ValueError(
+                    "space_to_depth implements exactly the 3x3 stride-2 rate-1 "
+                    f"stem conv; got kernel_size={self.kernel_size}, "
+                    f"stride={self.stride}, rate={self.rate}"
+                )
+            if self.spatial_axis_name is not None:
+                raise ValueError(
+                    "space_to_depth reshapes H into channels and cannot compose "
+                    "with an H-sharded (sequence-parallel) conv"
+                )
+            if not self.use_bn:
+                raise ValueError(
+                    "space_to_depth supports the BN stem form only "
+                    "(SpaceToDepthConv declares no bias parameter)"
+                )
+            x = SpaceToDepthConv(self.features, dtype=self.dtype, name="conv")(x)
+        elif self.spatial_axis_name is not None and self.kernel_size > 1:
             x = SpatialConv(
                 self.features,
                 self.kernel_size,

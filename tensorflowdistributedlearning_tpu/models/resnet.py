@@ -329,7 +329,14 @@ class ResNetBackbone(nn.Module):
         end_points: Dict[str, jax.Array] = {}
         wm = cfg.width_multiplier
         # root (reference: core/resnet.py:155-168, 241-242)
-        x = ConvBN(scaled_width(64, wm), 3, stride=2, name="conv1_1", **common)(x, train)
+        x = ConvBN(
+            scaled_width(64, wm),
+            3,
+            stride=2,
+            space_to_depth=cfg.stem_space_to_depth,
+            name="conv1_1",
+            **common,
+        )(x, train)
         x = ConvBN(scaled_width(64, wm), 3, name="conv1_2", **common)(x, train)
         x = ConvBN(scaled_width(128, wm), 3, name="conv1_3", **common)(x, train)
         if self.spatial_axis_name is not None:

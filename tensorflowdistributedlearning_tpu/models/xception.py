@@ -259,7 +259,14 @@ class XceptionBackbone(nn.Module):
 
         wm = cfg.width_multiplier
         end_points: Dict[str, jax.Array] = {}
-        x = ConvBN(scaled_width(32, wm), 3, stride=2, name="conv1_1", **common)(x, train)
+        x = ConvBN(
+            scaled_width(32, wm),
+            3,
+            stride=2,
+            space_to_depth=cfg.stem_space_to_depth,
+            name="conv1_1",
+            **common,
+        )(x, train)
         x = ConvBN(scaled_width(64, wm), 3, name="conv1_2", **common)(x, train)
         end_points["root"] = x
 

@@ -390,7 +390,10 @@ class ClassifierTrainer:
                 if tb_eval is not None:
                     tb_eval.scalars(final_metrics, step_no)
                     tb_eval.flush()
-                ckpt.export_best(state, final_metrics)
+                # best-export stores the eval view: EMA params when tracked
+                ckpt.export_best(
+                    step_lib.with_ema_params(state), final_metrics
+                )
                 window_dirty = True
         ckpt.save(state, force=True)
         if last_eval_step != step_no:
@@ -398,7 +401,7 @@ class ClassifierTrainer:
             if tb_eval is not None:
                 tb_eval.scalars(final_metrics, step_no)
                 tb_eval.flush()
-            ckpt.export_best(state, final_metrics)
+            ckpt.export_best(step_lib.with_ema_params(state), final_metrics)
         if tb_train is not None:
             tb_train.close()
         if tb_eval is not None:
@@ -444,6 +447,9 @@ class ClassifierTrainer:
         synthetic fallback would drive best-checkpoint selection with accuracy
         on noise; that case evaluates one pass over the train records instead."""
         tcfg = self.train_config
+        # evaluate the EMA view when one is tracked (TrainConfig.ema_decay>0) —
+        # the same params best-export stores, so selection and serving agree
+        state = step_lib.with_ema_params(state)
         local_bs = multihost.per_process_batch_size(batch_size)
         val_folder = self._open_split("val")
         eval_records = self._open_records("val")
@@ -659,6 +665,7 @@ def fit_preset(
     lr: Optional[float] = None,
     eval_holdout_fraction: Optional[float] = None,
     augmentation: Optional[str] = None,
+    ema_decay: Optional[float] = None,
 ) -> FitResult:
     """Train a named config preset end-to-end (the CLI `fit` entry point)."""
     from tensorflowdistributedlearning_tpu.configs import get_preset
@@ -689,6 +696,7 @@ def fit_preset(
         or lr is not None
         or eval_holdout_fraction is not None
         or augmentation is not None
+        or ema_decay is not None
     ):
         train_cfg = dataclasses.replace(
             train_cfg,
@@ -709,6 +717,9 @@ def fit_preset(
                 else train_cfg.eval_holdout_fraction
             ),
             augmentation=augmentation or train_cfg.augmentation,
+            ema_decay=(
+                ema_decay if ema_decay is not None else train_cfg.ema_decay
+            ),
         )
     trainer = ClassifierTrainer(
         model_dir, data_dir, preset.model, train_cfg

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -87,6 +87,68 @@ def kernel_decay_mask(params: Any) -> Any:
     return jax.tree_util.tree_unflatten(treedef, mask_leaves)
 
 
+class EmaTrackerState(NamedTuple):
+    """State of ``ema_tracker``: the parameter EMA (same pytree as params)."""
+
+    ema: Any
+
+
+def ema_tracker(decay: float) -> optax.GradientTransformation:
+    """Pass-through transformation that maintains an exponential moving average
+    of the PARAMETERS (not the gradients) in its own state.
+
+    Appended after the real optimizer in the chain, its ``update`` sees the
+    final updates and the current params, so ``params + updates`` is exactly
+    the post-step parameter value: ``ema <- decay * ema + (1 - decay) * new``.
+    The EMA initializes AT the initial params (no zero-init debias needed) and
+    rides ``opt_state`` — so checkpointing, donation, replication, and every
+    execution strategy (shard_map, GSPMD tensor-parallel, pipeline) carry it
+    with zero extra plumbing. Updates pass through UNCHANGED; evaluation opts
+    in via ``with_ema_params``. Beyond-parity: the reference had no weight
+    averaging (its slim arg_scope declared none); this is the standard modern
+    ImageNet/ViT recipe component (e.g. arXiv:1706.02677-era baselines ship
+    without it, RandAug/EffNet-era recipes with it)."""
+
+    def init_fn(params):
+        # a REAL copy, not jnp.asarray: the EMA must not alias the param
+        # buffers, or donating TrainState would donate each buffer twice
+        return EmaTrackerState(ema=jax.tree.map(jnp.copy, params))
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("ema_tracker needs params in tx.update()")
+        new_ema = jax.tree.map(
+            lambda e, p, u: e * decay + (p + u) * (1.0 - decay),
+            state.ema,
+            params,
+            updates,
+        )
+        return updates, EmaTrackerState(ema=new_ema)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def find_ema_params(opt_state: Any) -> Optional[Any]:
+    """The tracked parameter EMA inside ``opt_state``, or None when the
+    optimizer chain has no ``ema_tracker``."""
+    if isinstance(opt_state, EmaTrackerState):
+        return opt_state.ema
+    if isinstance(opt_state, (tuple, list)):
+        for sub in opt_state:
+            found = find_ema_params(sub)
+            if found is not None:
+                return found
+    return None
+
+
+def with_ema_params(state: TrainState) -> TrainState:
+    """``state`` with params swapped for their EMA when one is tracked (the
+    eval/export view); identity otherwise. The EMA tree matches the params
+    tree exactly, so jitted eval/predict executables cache-hit either way."""
+    ema = find_ema_params(state.opt_state)
+    return state if ema is None else state.replace(params=ema)
+
+
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     """The configured optimizer under the configured lr schedule: ``adam``
     (the reference's choice, model.py:462), ``sgd`` (Nesterov momentum —
@@ -118,6 +180,7 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
         cfg.lr_decay_rate,
         cfg.lr_warmup_steps,
         cfg.weight_decay,
+        cfg.ema_decay,
     )
 
 
@@ -131,6 +194,7 @@ def _make_optimizer_cached(
     decay_rate: float,
     warmup_steps: int,
     weight_decay: float,
+    ema_decay: float = 0.0,
 ) -> optax.GradientTransformation:
     cfg = TrainConfig(
         lr=lr,
@@ -141,7 +205,7 @@ def _make_optimizer_cached(
     )
     sched = make_lr_schedule(cfg)
     if optimizer == "lars":
-        return optax.lars(
+        tx = optax.lars(
             sched,
             weight_decay=weight_decay,
             weight_decay_mask=kernel_decay_mask,
@@ -149,18 +213,23 @@ def _make_optimizer_cached(
             momentum=momentum,
             nesterov=True,
         )
-    if optimizer == "sgd":
+    elif optimizer == "sgd":
         if weight_decay:
             # decay BEFORE momentum+lr scaling == the classic coupled l2-SGD
             # update the 76%-top-1 recipe trains with (arXiv:1706.02677)
-            return optax.chain(
+            tx = optax.chain(
                 optax.add_decayed_weights(weight_decay, mask=kernel_decay_mask),
                 optax.sgd(sched, momentum=momentum, nesterov=True),
             )
-        return optax.sgd(sched, momentum=momentum, nesterov=True)
-    if weight_decay:
-        return optax.adamw(sched, weight_decay=weight_decay, mask=kernel_decay_mask)
-    return optax.adam(sched)
+        else:
+            tx = optax.sgd(sched, momentum=momentum, nesterov=True)
+    elif weight_decay:
+        tx = optax.adamw(sched, weight_decay=weight_decay, mask=kernel_decay_mask)
+    else:
+        tx = optax.adam(sched)
+    if ema_decay:
+        tx = optax.chain(tx, ema_tracker(ema_decay))
+    return tx
 
 
 @dataclasses.dataclass(frozen=True)

@@ -354,7 +354,10 @@ class Trainer:
                     state, eval_ds, batch_size, fold, writer=tb_eval,
                     global_n=eval_global_n,
                 )
-                ckpt.export_best(state, final_metrics)
+                # best-export stores the eval view: EMA params when tracked
+                ckpt.export_best(
+                    step_lib.with_ema_params(state), final_metrics
+                )
                 window_dirty = True
         # end of training: final checkpoint + eval + export (train_and_evaluate's
         # final-eval contract) — skipped when the last loop iteration already
@@ -365,7 +368,7 @@ class Trainer:
                 state, eval_ds, batch_size, fold, writer=tb_eval,
                 global_n=eval_global_n,
             )
-            ckpt.export_best(state, final_metrics)
+            ckpt.export_best(step_lib.with_ema_params(state), final_metrics)
         if tb_train is not None:
             tb_train.close()
         if tb_eval is not None:
@@ -405,6 +408,8 @@ class Trainer:
         size) pins the step count so every process runs the same number of
         collective-bearing steps."""
         mesh_lib.local_batch_size(batch_size, self.mesh)  # fail fast, clear message
+        # evaluate the EMA view when one is tracked (TrainConfig.ema_decay>0)
+        state = step_lib.with_ema_params(state)
         local_bs = multihost.per_process_batch_size(batch_size)
         num = multihost.eval_num_batches(
             global_n if global_n is not None else len(eval_ds), local_bs

@@ -1,0 +1,178 @@
+"""Parameter EMA (train/step.py:ema_tracker): pass-through optimizer stage
+whose state is the exponential moving average of the parameter trajectory,
+consumed by eval/best-export through with_ema_params."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tensorflowdistributedlearning_tpu.config import TrainConfig
+from tensorflowdistributedlearning_tpu.train.step import (
+    ema_tracker,
+    find_ema_params,
+    make_optimizer,
+    with_ema_params,
+)
+
+
+def test_ema_tracker_matches_manual_trajectory():
+    """After k sgd steps, the tracked EMA equals the hand-rolled recurrence
+    over the post-update parameter values — and the updates themselves are
+    UNCHANGED by the tracker (identical final params with or without it)."""
+    decay = 0.9
+    params = {"w": jnp.array([1.0, -2.0]), "b": jnp.array(0.5)}
+    grads = [
+        {"w": jnp.array([0.1, 0.2]), "b": jnp.array(-0.3)},
+        {"w": jnp.array([-0.4, 0.0]), "b": jnp.array(0.2)},
+        {"w": jnp.array([0.05, -0.1]), "b": jnp.array(0.0)},
+    ]
+    plain = optax.sgd(0.1, momentum=0.9)
+    tracked = optax.chain(optax.sgd(0.1, momentum=0.9), ema_tracker(decay))
+
+    p_plain, s_plain = dict(params), plain.init(params)
+    p_track, s_track = dict(params), tracked.init(params)
+    ema_manual = jax.tree.map(lambda x: x, params)
+    for g in grads:
+        u, s_plain = plain.update(g, s_plain, p_plain)
+        p_plain = optax.apply_updates(p_plain, u)
+        u, s_track = tracked.update(g, s_track, p_track)
+        p_track = optax.apply_updates(p_track, u)
+        ema_manual = jax.tree.map(
+            lambda e, p: decay * e + (1 - decay) * p, ema_manual, p_plain
+        )
+    # updates pass through unchanged
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6), p_plain, p_track
+    )
+    ema = find_ema_params(s_track)
+    assert ema is not None
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6), ema_manual, ema
+    )
+
+
+def test_ema_initializes_at_params():
+    params = {"w": jnp.array([3.0])}
+    tx = optax.chain(optax.sgd(0.1), ema_tracker(0.99))
+    state = tx.init(params)
+    np.testing.assert_allclose(find_ema_params(state)["w"], params["w"])
+
+
+def test_find_ema_none_without_tracker():
+    params = {"w": jnp.array([1.0])}
+    assert find_ema_params(optax.adam(1e-3).init(params)) is None
+
+
+def test_make_optimizer_wires_ema_for_every_family():
+    params = {"kernel": jnp.ones((2, 2))}
+    for opt in ("adam", "sgd", "lars"):
+        cfg = TrainConfig(optimizer=opt, lr=0.1, ema_decay=0.999)
+        state = make_optimizer(cfg).init(params)
+        assert find_ema_params(state) is not None, opt
+        off = make_optimizer(TrainConfig(optimizer=opt, lr=0.1))
+        assert find_ema_params(off.init(params)) is None, opt
+
+
+def test_with_ema_params_swaps_eval_view():
+    """with_ema_params returns the SAME treedef with EMA leaf values (jit
+    executables cache-hit), and is the identity when nothing is tracked."""
+    import numpy as _np
+
+    from tensorflowdistributedlearning_tpu.config import ModelConfig
+    from tensorflowdistributedlearning_tpu.models import build_model
+    from tensorflowdistributedlearning_tpu.train.state import create_train_state
+
+    cfg = ModelConfig(
+        num_classes=3,
+        input_shape=(8, 8),
+        input_channels=1,
+        n_blocks=(1, 1, 1),
+        block_type="basic_block",
+        width_multiplier=0.25,
+        output_stride=None,
+    )
+    model = build_model(cfg)
+    sample = _np.zeros((1, 8, 8, 1), _np.float32)
+    tx = make_optimizer(TrainConfig(optimizer="sgd", lr=0.5, ema_decay=0.5))
+    state = create_train_state(model, tx, jax.random.PRNGKey(0), sample)
+    # one synthetic update moves params away from the (param-initialized) EMA
+    grads = jax.tree.map(jnp.ones_like, state.params)
+    state = state.apply_gradients(grads, state.batch_stats)
+    view = with_ema_params(state)
+    assert jax.tree.structure(view.params) == jax.tree.structure(state.params)
+    moved = jax.tree.leaves(
+        jax.tree.map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))), view.params, state.params
+        )
+    )
+    assert max(moved) > 0  # the eval view differs from the live params
+    # identity without a tracker
+    tx0 = make_optimizer(TrainConfig(optimizer="sgd", lr=0.5))
+    state0 = create_train_state(model, tx0, jax.random.PRNGKey(0), sample)
+    assert with_ema_params(state0) is state0
+
+
+def test_fit_best_export_carries_ema_params(tmp_path):
+    """End to end: with ema_decay set, the best-exported checkpoint's params
+    are the EMA (differ from the live params), and restore_best serves them."""
+    import numpy as _np
+
+    from tensorflowdistributedlearning_tpu.config import ModelConfig
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    model_cfg = ModelConfig(
+        num_classes=3,
+        input_shape=(8, 8),
+        input_channels=1,
+        n_blocks=(1, 1, 1),
+        block_type="basic_block",
+        width_multiplier=0.25,
+        output_stride=None,
+    )
+    train_cfg = TrainConfig(
+        optimizer="sgd",
+        lr=0.5,  # big steps keep params visibly away from their EMA
+        ema_decay=0.9,
+        checkpoint_every_steps=4,
+        n_devices=1,
+    )
+    trainer = ClassifierTrainer(
+        str(tmp_path / "run"), None, model_cfg, train_cfg
+    )
+    trainer.fit(batch_size=8, steps=4, eval_every_steps=4)
+    # same step, two lanes: the periodic checkpoint holds the LIVE params,
+    # the best export holds the EMA view
+    template = trainer._host_template()
+    ckpt = trainer._checkpointer()
+    try:
+        live = ckpt.restore_latest(template)
+        best = ckpt.restore_best(template)
+    finally:
+        ckpt.close()
+    assert int(jax.device_get(live.step)) == int(jax.device_get(best.step)) == 4
+    diffs = jax.tree.leaves(
+        jax.tree.map(
+            lambda a, b: float(jnp.max(jnp.abs(jnp.asarray(a) - jnp.asarray(b)))),
+            best.params,
+            live.params,
+        )
+    )
+    assert max(diffs) > 1e-6, "best export should store the EMA view"
+    # and the stored EMA view equals the EMA tracked in the live opt_state
+    ema = find_ema_params(live.opt_state)
+    jax.tree.map(
+        lambda a, b: _np.testing.assert_allclose(
+            _np.asarray(a), _np.asarray(b), rtol=1e-6
+        ),
+        best.params,
+        ema,
+    )
+
+
+def test_ema_decay_validation():
+    with pytest.raises(ValueError, match="ema_decay"):
+        TrainConfig(ema_decay=1.0)
+    with pytest.raises(ValueError, match="ema_decay"):
+        TrainConfig(ema_decay=-0.1)

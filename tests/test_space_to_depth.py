@@ -1,0 +1,121 @@
+"""Space-to-depth stem (models/layers.py:SpaceToDepthConv): the TPU stem trick
+must be numerically identical to the plain 3x3 stride-2 SAME conv it replaces,
+and checkpoint-compatible with it (same parameter tree)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.config import ModelConfig
+from tensorflowdistributedlearning_tpu.models import build_model
+from tensorflowdistributedlearning_tpu.models.layers import (
+    ConvBN,
+    SpaceToDepthConv,
+    space_to_depth,
+)
+
+
+def test_space_to_depth_layout():
+    """Channel order is (dy, dx, c): cell (i, j) holds rows 2i..2i+1."""
+    x = jnp.arange(2 * 4 * 4 * 3, dtype=jnp.float32).reshape(2, 4, 4, 3)
+    y = space_to_depth(x, 2)
+    assert y.shape == (2, 2, 2, 12)
+    # output cell (0, 0), channel block (dy=1, dx=0) == input pixel (1, 0)
+    np.testing.assert_array_equal(y[0, 0, 0, 6:9], x[0, 1, 0, :])
+    # output cell (1, 1), channel block (dy=0, dx=1) == input pixel (2, 3)
+    np.testing.assert_array_equal(y[1, 1, 1, 3:6], x[1, 2, 3, :])
+
+
+def test_space_to_depth_rejects_odd():
+    with pytest.raises(ValueError, match="divisible"):
+        space_to_depth(jnp.zeros((1, 5, 4, 3)), 2)
+
+
+@pytest.mark.parametrize("hw", [(8, 8), (14, 10)])
+def test_s2d_conv_matches_plain_conv(hw):
+    """SpaceToDepthConv(k) == nn.Conv 3x3/2 SAME with the same kernel."""
+    import flax.linen as nn
+
+    h, w = hw
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (2, h, w, 3), jnp.float32)
+    s2d = SpaceToDepthConv(16)
+    params = s2d.init(jax.random.PRNGKey(1), x)
+    ref = nn.Conv(
+        16, (3, 3), strides=(2, 2), padding="SAME", use_bias=False
+    )
+    got = s2d.apply(params, x)
+    want = ref.apply(params, x)
+    assert got.shape == want.shape == (2, h // 2, w // 2, 16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_convbn_s2d_checkpoint_compatible():
+    """The SAME params drive both ConvBN stems to the SAME output — switching
+    stem_space_to_depth on a trained checkpoint changes nothing."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 3), jnp.float32)
+    plain = ConvBN(8, 3, stride=2)
+    fast = ConvBN(8, 3, stride=2, space_to_depth=True)
+    params = plain.init(jax.random.PRNGKey(1), x, True)
+    assert jax.tree.structure(params) == jax.tree.structure(
+        fast.init(jax.random.PRNGKey(1), x, True)
+    )
+    a, _ = plain.apply(params, x, True, mutable=["batch_stats"])
+    b, _ = fast.apply(params, x, True, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_classifier_forward_parity_with_s2d_stem():
+    """Whole-model parity: a classic-layout classifier's logits are unchanged
+    by the stem transform (same params, fp32)."""
+    base = dict(
+        num_classes=5,
+        input_shape=(16, 16),
+        input_channels=3,
+        n_blocks=(1, 1, 1, 1),
+        block_layout="classic",
+        width_multiplier=0.25,
+        output_stride=None,
+    )
+    cfg_a = ModelConfig(**base)
+    cfg_b = ModelConfig(**base, stem_space_to_depth=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 16, 3), jnp.float32)
+    model_a, model_b = build_model(cfg_a), build_model(cfg_b)
+    params = model_a.init(jax.random.PRNGKey(1), x, False)
+    logits_a = model_a.apply(params, x, False)
+    logits_b = model_b.apply(params, x, False)
+    np.testing.assert_allclose(
+        np.asarray(logits_a), np.asarray(logits_b), atol=1e-4
+    )
+
+
+def test_s2d_config_validation():
+    with pytest.raises(ValueError, match="even input dims"):
+        ModelConfig(
+            num_classes=10,
+            input_shape=(101, 101),
+            input_channels=3,
+            stem_space_to_depth=True,
+        )
+    with pytest.raises(ValueError, match="conv stems"):
+        ModelConfig(
+            backbone="vit",
+            num_classes=10,
+            input_shape=(32, 32),
+            input_channels=3,
+            output_stride=None,
+            stem_space_to_depth=True,
+        )
+
+
+def test_convbn_s2d_guards():
+    x = jnp.zeros((1, 8, 8, 3))
+    with pytest.raises(ValueError, match="3x3 stride-2"):
+        ConvBN(8, 3, stride=1, space_to_depth=True).init(
+            jax.random.PRNGKey(0), x, True
+        )
+    with pytest.raises(ValueError, match="sequence-parallel"):
+        ConvBN(
+            8, 3, stride=2, space_to_depth=True, spatial_axis_name="sequence"
+        ).init(jax.random.PRNGKey(0), x, True)
