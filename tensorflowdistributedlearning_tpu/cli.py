@@ -115,6 +115,14 @@ def build_parser() -> argparse.ArgumentParser:
     p_fit.add_argument("--ema-decay", type=float, default=None,
                        help="track a parameter EMA at this decay (e.g. 0.9999) "
                        "and evaluate/export the averaged weights; 0 disables")
+    p_fit.add_argument("--grad-accum", type=int, default=None,
+                       help="accumulate gradients over this many sequential "
+                       "microbatches per step (one optimizer update on their "
+                       "mean): effective batch = accum x batch at one "
+                       "microbatch's activation memory")
+    p_fit.add_argument("--grad-clip", type=float, default=None,
+                       help="clip gradients to this global l2 norm before the "
+                       "optimizer update; 0 disables")
     p_fit.add_argument("--augmentation",
                        choices=("flip_crop", "crop", "none", "mixup", "cutmix"),
                        default=None,
@@ -258,6 +266,8 @@ def cmd_fit(args) -> int:
         eval_holdout_fraction=args.eval_holdout_fraction,
         augmentation=args.augmentation,
         ema_decay=args.ema_decay,
+        grad_accum_steps=args.grad_accum,
+        grad_clip_norm=args.grad_clip,
     )
     print(json.dumps({
         "preset": args.preset,

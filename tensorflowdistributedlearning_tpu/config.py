@@ -58,6 +58,11 @@ class ModelConfig:
     # route the ASPP's atrous depthwise convs through the Pallas VMEM kernel
     # (ops/pallas_kernels.py) instead of XLA's grouped conv; parameter trees are
     # identical between the two paths, so this is a pure execution-path switch.
+    # Rate-aware: v5e microbenches measured XLA faster below atrous rate 4 and
+    # the Pallas kernel 1.2-1.43x faster at rates 4/8, so the dispatch
+    # (models/layers.py:DepthwiseConv2D) engages Pallas only at rate >= 4 —
+    # enabling this takes the measured-winning path per branch. Off by default
+    # because off-TPU the kernel runs in the (slow) Pallas interpreter.
     use_pallas_depthwise: bool = False
     # rematerialize residual units on the backward pass (jax.checkpoint): trades
     # recompute FLOPs for activation HBM — enables large per-chip batches.
@@ -191,6 +196,22 @@ class TrainConfig:
     # ~0.9999 is the modern recipe value at ImageNet scale. Costs one extra
     # params-sized buffer in opt_state.
     ema_decay: float = 0.0
+    # clip gradients to this global l2 norm before the optimizer update
+    # (optax.clip_by_global_norm at the head of the chain, so decay/momentum
+    # see the clipped gradient). 0.0 disables (the reference never clipped);
+    # 1.0 is the standard ViT/large-LR stabilizer. Applies to every execution
+    # strategy because it rides TrainState.tx.
+    grad_clip_norm: float = 0.0
+    # accumulate gradients over this many sequential microbatches inside each
+    # train step (lax.scan), then apply ONE optimizer update on their mean —
+    # effective batch = grad_accum_steps x fed batch at one microbatch's
+    # activation memory. The optimizer step count (and therefore the lr
+    # schedule) advances once per UPDATE, matching the semantics of feeding
+    # the large batch directly. BN batch statistics are computed per
+    # microbatch sequentially (the same per-shard locality the reference's
+    # per-tower BN had). Standard data-parallel/spatial step only (the GSPMD
+    # tensor-parallel and pipeline strategies define their own batch math).
+    grad_accum_steps: int = 1
     # classification train-loss label smoothing (0.1 in the standard ImageNet
     # recipe, arXiv:1512.00567); eval metrics stay plain CE
     label_smoothing: float = 0.0
@@ -346,6 +367,22 @@ class TrainConfig:
         if not 0.0 <= self.ema_decay < 1.0:
             raise ValueError(
                 f"ema_decay must be in [0, 1), got {self.ema_decay}"
+            )
+        if self.grad_clip_norm < 0:
+            raise ValueError(
+                f"grad_clip_norm must be >= 0, got {self.grad_clip_norm}"
+            )
+        if self.grad_accum_steps < 1:
+            raise ValueError(
+                f"grad_accum_steps must be >= 1, got {self.grad_accum_steps}"
+            )
+        if self.grad_accum_steps > 1 and (
+            self.model_parallel > 1 or self.pipeline_parallel > 1
+        ):
+            raise ValueError(
+                "grad_accum_steps > 1 runs inside the shard_map "
+                "data/spatial-parallel step; the GSPMD tensor-parallel and "
+                "pipeline strategies define their own batch math"
             )
         if not 0.0 <= self.eval_holdout_fraction < 1.0:
             raise ValueError(

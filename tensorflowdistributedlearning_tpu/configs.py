@@ -136,6 +136,9 @@ PRESETS: Dict[str, Preset] = {
             lr=0.001,
             lr_warmup_steps=10_000,
             weight_decay=0.1,
+            # global-norm clip 1.0 — the ViT/DeiT training stabilizer
+            # (arXiv:2010.11929 App. B.1; rides the optimizer chain)
+            grad_clip_norm=1.0,
         ),
         global_batch=1024,
         description="ViT-S/16 ImageNet-1k, bf16; sequence-parallelizable via "
@@ -161,6 +164,9 @@ PRESETS: Dict[str, Preset] = {
             lr=0.001,
             lr_warmup_steps=10_000,
             weight_decay=0.1,
+            # global-norm clip 1.0 — the ViT/DeiT training stabilizer
+            # (arXiv:2010.11929 App. B.1; rides the optimizer chain)
+            grad_clip_norm=1.0,
         ),
         global_batch=1024,
         description="ViT-S/16 Switch-MoE (8 experts, top-1 routing + load-"

@@ -281,11 +281,19 @@ class DepthwiseConv2D(nn.Module):
         dtype = self.dtype or x.dtype
         x = x.astype(dtype)
         from tensorflowdistributedlearning_tpu.ops.pallas_kernels import (
+            PALLAS_DEPTHWISE_MIN_RATE,
             depthwise_conv2d,
             depthwise_conv2d_reference,
         )
 
-        dw = depthwise_conv2d if self.use_pallas else depthwise_conv2d_reference
+        # rate-aware dispatch: hardware microbenches (see
+        # PALLAS_DEPTHWISE_MIN_RATE) show XLA wins below rate 4 and the Pallas
+        # kernel wins at 4+, so the flag engages only where measured to win
+        dw = (
+            depthwise_conv2d
+            if self.use_pallas and self.rate >= PALLAS_DEPTHWISE_MIN_RATE
+            else depthwise_conv2d_reference
+        )
         out = dw(x, kernel[:, :, 0, :].astype(dtype), self.rate)
         return out + bias.astype(dtype)
 

@@ -32,6 +32,15 @@ from tensorflowdistributedlearning_tpu.parallel.collectives import vma_of
 # alongside double-buffering; beyond this the public wrapper falls back to XLA.
 _VMEM_BLOCK_LIMIT_BYTES = 4 * 1024 * 1024
 
+# Measured on a v5e chip (bench_kernels.py via bench.py, 2026-07-31, ASPP shape
+# [32, 13, 13, 1024]): Pallas vs XLA grouped conv speedup by atrous rate —
+# rate 1: 0.90x, rate 2: 0.71x, rate 4: 1.20x, rate 8: 1.43x. XLA's lowering
+# wins while the dilated footprint is small; once the gather spreads past
+# rate 4 the shift-accumulate VMEM kernel wins. Models gate their Pallas
+# dispatch on this threshold (models/layers.py:DepthwiseConv2D), so enabling
+# `use_pallas_depthwise` only ever takes the measured-winning path.
+PALLAS_DEPTHWISE_MIN_RATE = 4
+
 
 def depthwise_conv2d_reference(
     x: jax.Array, w: jax.Array, rate: int = 1

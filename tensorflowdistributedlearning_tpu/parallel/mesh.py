@@ -150,3 +150,20 @@ def local_batch_size(global_batch: int, mesh: Mesh) -> int:
 
 def data_parallel_degree(mesh: Mesh) -> int:
     return mesh.shape[BATCH_AXIS]
+
+
+def check_accum_divisibility(
+    global_batch: int, mesh: Mesh, grad_accum_steps: int
+) -> int:
+    """Fail fast (before any compile) when the per-shard batch cannot split
+    into ``grad_accum_steps`` equal microbatches; returns the per-shard batch.
+    Shared by both trainers so the contract and message cannot drift."""
+    local_bs = local_batch_size(global_batch, mesh)
+    if local_bs % grad_accum_steps:
+        raise ValueError(
+            f"per-shard batch {local_bs} (global {global_batch} over "
+            f"{data_parallel_degree(mesh)} data-parallel shards) is not "
+            f"divisible by grad_accum_steps={grad_accum_steps}; raise the "
+            "batch size or lower the accumulation factor"
+        )
+    return local_bs

@@ -312,7 +312,9 @@ class ClassifierTrainer:
 
         tcfg = self.train_config
         config_lib.validate_training_data_format(tcfg)
-        local_bs = mesh_lib.local_batch_size(batch_size, self.mesh)
+        local_bs = mesh_lib.check_accum_divisibility(
+            batch_size, self.mesh, tcfg.grad_accum_steps
+        )
         if self._pp and local_bs % self._pp_microbatches:
             raise ValueError(
                 f"per-replica batch {local_bs} not divisible into "
@@ -352,6 +354,7 @@ class ClassifierTrainer:
                 self.task,
                 weight_decay=self.model_config.weight_decay,
                 spatial=self._spatial,
+                accum=self.train_config.grad_accum_steps,
             )
         is_main = jax.process_index() == 0
         tb_train = SummaryWriter(os.path.join(self.model_dir, "train")) if is_main else None
@@ -588,8 +591,12 @@ class ClassifierTrainer:
         boundary exactly like the segmentation path."""
         from tensorflowdistributedlearning_tpu.train.trainer import _forward_cached
 
-        # serving reads params/batch_stats only; drop the Adam moments
-        state = self._restore_best_host().replace(opt_state=None)
+        # EMA-trained models serve the averaged weights even when restore fell
+        # back to a periodic (live-trajectory) checkpoint (identity otherwise);
+        # then drop the optimizer moments — serving reads params/batch_stats only
+        state = step_lib.with_ema_params(self._restore_best_host()).replace(
+            opt_state=None
+        )
         task = self.task
         forward = _forward_cached(self._plain_model)
         nchw = self.train_config.data_format == "NCHW"
@@ -666,6 +673,8 @@ def fit_preset(
     eval_holdout_fraction: Optional[float] = None,
     augmentation: Optional[str] = None,
     ema_decay: Optional[float] = None,
+    grad_accum_steps: Optional[int] = None,
+    grad_clip_norm: Optional[float] = None,
 ) -> FitResult:
     """Train a named config preset end-to-end (the CLI `fit` entry point)."""
     from tensorflowdistributedlearning_tpu.configs import get_preset
@@ -697,6 +706,8 @@ def fit_preset(
         or eval_holdout_fraction is not None
         or augmentation is not None
         or ema_decay is not None
+        or grad_accum_steps is not None
+        or grad_clip_norm is not None
     ):
         train_cfg = dataclasses.replace(
             train_cfg,
@@ -719,6 +730,16 @@ def fit_preset(
             augmentation=augmentation or train_cfg.augmentation,
             ema_decay=(
                 ema_decay if ema_decay is not None else train_cfg.ema_decay
+            ),
+            grad_accum_steps=(
+                grad_accum_steps
+                if grad_accum_steps is not None
+                else train_cfg.grad_accum_steps
+            ),
+            grad_clip_norm=(
+                grad_clip_norm
+                if grad_clip_norm is not None
+                else train_cfg.grad_clip_norm
             ),
         )
     trainer = ClassifierTrainer(

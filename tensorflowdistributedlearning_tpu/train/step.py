@@ -181,6 +181,7 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
         cfg.lr_warmup_steps,
         cfg.weight_decay,
         cfg.ema_decay,
+        cfg.grad_clip_norm,
     )
 
 
@@ -195,6 +196,7 @@ def _make_optimizer_cached(
     warmup_steps: int,
     weight_decay: float,
     ema_decay: float = 0.0,
+    grad_clip_norm: float = 0.0,
 ) -> optax.GradientTransformation:
     cfg = TrainConfig(
         lr=lr,
@@ -227,6 +229,10 @@ def _make_optimizer_cached(
         tx = optax.adamw(sched, weight_decay=weight_decay, mask=kernel_decay_mask)
     else:
         tx = optax.adam(sched)
+    if grad_clip_norm:
+        # clip FIRST so decay/momentum/trust-ratio all see the clipped gradient
+        # (the standard ViT/large-LR stabilizer placement)
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
     if ema_decay:
         tx = optax.chain(tx, ema_tracker(ema_decay))
     return tx
@@ -424,6 +430,7 @@ def make_train_step(
     apply_weight_decay: bool = False,
     donate: bool = True,
     spatial: bool = False,
+    accum: int = 1,
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Metrics]]:
     """Build the jitted SPMD train step.
 
@@ -446,9 +453,16 @@ def make_train_step(
     ``mesh.shard_batch_spatial``. The model's forward runs H-sharded over the
     sequence mesh axis with halo exchanges; outputs are gathered inside the model,
     so loss/metrics math below is unchanged.
+
+    ``accum > 1`` splits each shard's batch into that many equal microbatches,
+    runs them sequentially under ``lax.scan`` (one microbatch's activation
+    memory), and applies ONE optimizer update on the mean gradient — the
+    effective global batch is ``accum`` times what the loop feeds, with the lr
+    schedule advancing per update. BN statistics flow microbatch-to-microbatch
+    sequentially, then average across shards as usual.
     """
     return _make_train_step_cached(
-        mesh, task, weight_decay, apply_weight_decay, donate, spatial
+        mesh, task, weight_decay, apply_weight_decay, donate, spatial, accum
     )
 
 
@@ -460,30 +474,84 @@ def _make_train_step_cached(
     apply_weight_decay: bool,
     donate: bool,
     spatial: bool,
+    accum: int = 1,
 ):
     def step(state: TrainState, batch: Dict[str, jax.Array]):
-        def loss_fn(params):
-            outputs, mutated = state.apply_fn(
-                {"params": params, "batch_stats": state.batch_stats},
-                batch["images"],
-                train=True,
-                mutable=["batch_stats", "aux_loss"],
-            )
-            loss = task.loss(outputs, batch)
-            # auxiliary losses sown by the model (MoE load balancing,
-            # models/vit.py:MoEMlp) join the training objective; the
-            # collection is empty for every non-MoE model
-            for aux in jax.tree.leaves(mutated.get("aux_loss", {})):
-                loss = loss + aux
-            if apply_weight_decay and weight_decay:
-                loss = loss + weight_decay * _l2_penalty(params)
-            # BN-free models mutate nothing; keep the (empty) pytree structure
-            new_stats = mutated.get("batch_stats", state.batch_stats)
-            return loss, (outputs, new_stats)
+        def grads_of(batch_stats, chunk):
+            """value_and_grad of one microbatch against the CURRENT params,
+            threading BN state in (not closed over) so scan can carry it."""
 
-        (loss, (outputs, new_batch_stats)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
+            def loss_fn(params):
+                outputs, mutated = state.apply_fn(
+                    {"params": params, "batch_stats": batch_stats},
+                    chunk["images"],
+                    train=True,
+                    mutable=["batch_stats", "aux_loss"],
+                )
+                loss = task.loss(outputs, chunk)
+                # auxiliary losses sown by the model (MoE load balancing,
+                # models/vit.py:MoEMlp) join the training objective; the
+                # collection is empty for every non-MoE model
+                for aux in jax.tree.leaves(mutated.get("aux_loss", {})):
+                    loss = loss + aux
+                if apply_weight_decay and weight_decay:
+                    loss = loss + weight_decay * _l2_penalty(params)
+                # BN-free models mutate nothing; keep the (empty) pytree structure
+                new_stats = mutated.get("batch_stats", batch_stats)
+                return loss, (outputs, new_stats)
+
+            return jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+
+        if accum == 1:
+            (loss, (outputs, new_batch_stats)), grads = grads_of(
+                state.batch_stats, batch
+            )
+            metrics = _metric_deltas(task.metric_scores(outputs, batch), loss)
+        else:
+            local = batch["images"].shape[0]
+            if local % accum:
+                raise ValueError(
+                    f"grad accumulation needs the per-shard batch ({local}) "
+                    f"divisible by grad_accum_steps ({accum})"
+                )
+            chunks = jax.tree.map(
+                lambda x: x.reshape((accum, local // accum) + x.shape[1:]), batch
+            )
+            # scan carries must keep a stable varying-axes type: BN stats start
+            # unvarying (replicated) but each microbatch's updated stats are
+            # batch-shard varying — pre-varying the initial carry keeps the
+            # types fixed across iterations. lax.pcast replaced the deprecated
+            # lax.pvary; support both across jax versions (as
+            # parallel/pipeline.py does).
+            def pvary_leaf(x):
+                axes = (BATCH_AXIS, SEQUENCE_AXIS)
+                if hasattr(jax.lax, "pcast"):
+                    return jax.lax.pcast(x, axes, to="varying")
+                return jax.lax.pvary(x, axes)  # pragma: no cover - older jax
+
+            def body(carry, chunk):
+                stats, grads_acc = carry
+                (loss, (outputs, new_stats)), grads = grads_of(stats, chunk)
+                grads_acc = jax.tree.map(
+                    lambda a, g: a + g / accum, grads_acc, grads
+                )
+                deltas = _metric_deltas(task.metric_scores(outputs, chunk), loss)
+                return (new_stats, grads_acc), deltas
+
+            # unfreeze so the carry's pytree TYPE matches what flax's mutable
+            # apply returns (plain dict), keeping scan's carry structure stable
+            from flax.core import unfreeze
+
+            init = (
+                jax.tree.map(pvary_leaf, unfreeze(state.batch_stats)),
+                # grads of replicated params arrive cross-shard psum'd, i.e.
+                # unvarying — the accumulator stays unvarying to match
+                jax.tree.map(jnp.zeros_like, state.params),
+            )
+            (new_batch_stats, grads), stacked = jax.lax.scan(body, init, chunks)
+            # stacked Mean states carry a leading [accum] dim on total/count;
+            # summing merges the streams (Mean.merge is addition)
+            metrics = jax.tree.map(lambda x: jnp.sum(x, axis=0), stacked)
 
         # MirroredStrategy's gradient MEAN across towers. Under shard_map's
         # varying-manual-axes tracking, autodiff of replicated params already
@@ -500,8 +568,7 @@ def _make_train_step_cached(
         new_batch_stats = jax.lax.pmean(new_batch_stats, SEQUENCE_AXIS)
 
         new_state = state.apply_gradients(grads, new_batch_stats)
-        metrics = _psum_metrics(_metric_deltas(task.metric_scores(outputs, batch), loss))
-        return new_state, metrics
+        return new_state, _psum_metrics(metrics)
 
     sharded = jax.shard_map(
         step,

@@ -234,7 +234,9 @@ class Trainer:
         """
         tcfg = self.train_config
         config_lib.validate_training_data_format(tcfg)
-        mesh_lib.local_batch_size(batch_size, self.mesh)  # divisibility check
+        mesh_lib.check_accum_divisibility(
+            batch_size, self.mesh, tcfg.grad_accum_steps
+        )
         dataset = pipeline_lib.InMemoryDataset.from_directory(
             self.data_directory, ids=list(X)
         )
@@ -288,6 +290,7 @@ class Trainer:
             self.task,
             weight_decay=self.model_config.weight_decay,
             spatial=self._spatial,
+            accum=self.train_config.grad_accum_steps,
         )
         prepare = self._make_prepare_train(fold)
 
@@ -509,7 +512,11 @@ class Trainer:
         total = None
         n_members = 0
         for fold in folds:
-            state = self._restore_fold_or_raise(fold, template)
+            # EMA-trained folds predict with the averaged weights even when the
+            # restore fell back to a periodic checkpoint; identity otherwise
+            state = step_lib.with_ema_params(
+                self._restore_fold_or_raise(fold, template)
+            )
             for transformation in transforms:
                 probs = self._predict_one(state, test_ds, batch_size, transformation)
                 total = probs if total is None else total + probs
@@ -560,6 +567,9 @@ class Trainer:
         the internal layout, so the transpose happens exactly once, here).
         """
         state = self._restore_fold_or_raise(fold, self._init_state())
+        # EMA-trained models serve the averaged weights even when restore fell
+        # back to a periodic (live-trajectory) checkpoint; identity otherwise
+        state = step_lib.with_ema_params(state)
         # serving reads params/batch_stats only; dropping the Adam moments
         # frees ~2x parameter memory for the closure's lifetime
         state = state.replace(opt_state=None)

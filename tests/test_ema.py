@@ -176,3 +176,78 @@ def test_ema_decay_validation():
         TrainConfig(ema_decay=1.0)
     with pytest.raises(ValueError, match="ema_decay"):
         TrainConfig(ema_decay=-0.1)
+
+
+def test_serving_falls_back_to_ema_without_best_export(tmp_path):
+    """Interrupt before any best export: restore falls back to the periodic
+    checkpoint (live trajectory), and serving_fn must still serve the EMA
+    weights (train/trainer.py + train/fit.py apply with_ema_params before
+    dropping opt_state)."""
+    import numpy as _np
+
+    from tensorflowdistributedlearning_tpu.config import ModelConfig
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    model_cfg = ModelConfig(
+        num_classes=3,
+        input_shape=(8, 8),
+        input_channels=1,
+        n_blocks=(1, 1, 1),
+        block_type="basic_block",
+        width_multiplier=0.25,
+        output_stride=None,
+    )
+    train_cfg = TrainConfig(
+        optimizer="sgd",
+        lr=0.5,
+        ema_decay=0.9,
+        checkpoint_every_steps=2,
+        n_devices=1,
+    )
+    trainer = ClassifierTrainer(str(tmp_path / "run"), None, model_cfg, train_cfg)
+    trainer.fit(batch_size=8, steps=2, eval_every_steps=100)
+    # simulate an interrupted run: periodic checkpoints landed but the final
+    # best export never happened
+    import shutil
+
+    shutil.rmtree(tmp_path / "run" / "export" / "best")
+
+    template = trainer._host_template()
+    ckpt = trainer._checkpointer()
+    try:
+        # restore_best now falls back to the latest PERIODIC checkpoint, whose
+        # params are the live trajectory — exactly the hazard under test
+        live = ckpt.restore_latest(template)
+        fallback = ckpt.restore_best(template)
+    finally:
+        ckpt.close()
+    jax.tree.map(
+        lambda a, b: _np.testing.assert_array_equal(_np.asarray(a), _np.asarray(b)),
+        fallback.params,
+        live.params,
+    )
+    ema = find_ema_params(live.opt_state)
+    diffs = jax.tree.leaves(
+        jax.tree.map(
+            lambda a, b: float(jnp.max(jnp.abs(jnp.asarray(a) - jnp.asarray(b)))),
+            ema,
+            live.params,
+        )
+    )
+    assert max(diffs) > 1e-6, "precondition: EMA visibly differs from live"
+
+    served = trainer.serving_fn()
+    # the closure's weights are not directly reachable; compare served logits
+    # against forwarding the EMA params explicitly
+    x = _np.random.default_rng(0).normal(0, 1, (2, 8, 8, 1)).astype(_np.float32)
+    out = served(x)["probabilities"]
+    from tensorflowdistributedlearning_tpu.models import build_model
+
+    model = build_model(model_cfg)
+    logits = model.apply(
+        {"params": ema, "batch_stats": live.batch_stats}, jnp.asarray(x), train=False
+    )
+    expect = jax.nn.softmax(logits, axis=-1)
+    _np.testing.assert_allclose(
+        _np.asarray(out), _np.asarray(expect), rtol=1e-5, atol=1e-5
+    )

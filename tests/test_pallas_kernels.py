@@ -112,3 +112,28 @@ def test_validation():
         depthwise_conv2d(x, jnp.zeros((2, 2, 8)), interpret=True)
     with pytest.raises(ValueError, match="channel mismatch"):
         depthwise_conv2d(x, jnp.zeros((3, 3, 4)), interpret=True)
+
+
+def test_rate_gate_dispatch(monkeypatch):
+    """The layer engages the Pallas kernel only at measured-winning rates
+    (>= PALLAS_DEPTHWISE_MIN_RATE, per the v5e microbenches) even when
+    use_pallas=True; below the threshold it stays on XLA's grouped conv."""
+    import tensorflowdistributedlearning_tpu.ops.pallas_kernels as pk
+    from tensorflowdistributedlearning_tpu.models.layers import DepthwiseConv2D
+
+    taken = []
+    real = pk.depthwise_conv2d
+    monkeypatch.setattr(
+        pk,
+        "depthwise_conv2d",
+        lambda *a, **k: taken.append("pallas") or real(*a, **k),
+    )
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(0, 1, (1, 16, 16, 8)), jnp.float32
+    )
+    # init() traces the layer too, so each engaged rate records two calls
+    for rate, expect in ((1, 0), (2, 0), (4, 2), (8, 4)):
+        layer = DepthwiseConv2D(rate=rate, use_pallas=True)
+        variables = layer.init(jax.random.PRNGKey(0), x)
+        layer.apply(variables, x)
+        assert len(taken) == expect, (rate, taken)

@@ -1,0 +1,40 @@
+"""Run ONLY the attention kernel microbench (bench_kernels.bench_attention) on
+the current backend, printing one JSON line. Split from bench_kernels.py's main
+so a Pallas remote-compile hang here cannot cost the depthwise numbers, and so
+a supervisor can bound just this measurement.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    import jax
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache_tpu")
+        )
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass
+
+    from bench_kernels import bench_attention
+
+    if jax.default_backend() == "tpu":
+        out = bench_attention()
+    else:
+        out = bench_attention(batch=2, seq_lens=(64,), iters=3, warmup=1)
+    out["platform"] = jax.default_backend()
+    print(json.dumps({"attention": out}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    main()

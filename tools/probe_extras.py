@@ -1,0 +1,193 @@
+"""Standalone TPU probes for the bench extras that keep getting cut by tunnel
+windows: the segmentation flagship throughput and the space-to-depth stem
+variant of the classic ResNet-50 headline.
+
+Each probe prints one JSON line as it completes (so a hang mid-script still
+yields the earlier numbers) using bench.py's exact protocol: AOT-compiled
+shard_map step, 3 warmup steps, value-fetch sync barrier, cost_analysis MFU.
+
+Usage:  python tools/probe_extras.py [--seg] [--s2d] [--steps 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import PEAK_BF16_TFLOPS  # noqa: E402
+
+
+def _peak(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, tflops in PEAK_BF16_TFLOPS.items():
+        if key in kind:
+            return tflops * 1e12
+    return None
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--seg", action="store_true")
+    parser.add_argument("--s2d", action="store_true")
+    parser.add_argument(
+        "--s2d-true-only",
+        action="store_true",
+        help="probe only the stem_space_to_depth=True variant (retry helper "
+        "when the baseline already measured and the fresh compile timed out)",
+    )
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--batch", type=int, default=256)
+    args = parser.parse_args()
+
+    import jax
+    import numpy as np
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache_tpu")
+        )
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass
+
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.models import build_model
+    from tensorflowdistributedlearning_tpu.parallel import (
+        make_mesh,
+        replicate,
+        shard_batch,
+    )
+    from tensorflowdistributedlearning_tpu.train import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+    from tensorflowdistributedlearning_tpu.train.step import (
+        ClassificationTask,
+        SegmentationTask,
+    )
+    from tensorflowdistributedlearning_tpu.utils.profiling import sync
+
+    dev = jax.devices()[0]
+    n = 1
+    mesh = make_mesh(n)
+    print(
+        json.dumps({"platform": jax.default_backend(), "device": dev.device_kind}),
+        flush=True,
+    )
+
+    if args.seg:
+        seg_cfg = ModelConfig()  # reference defaults: 101x101x2 seg flagship
+        seg_model = build_model(seg_cfg)
+        seg_state = replicate(
+            create_train_state(
+                seg_model,
+                make_optimizer(TrainConfig()),
+                jax.random.PRNGKey(1),
+                np.zeros((1, 101, 101, 2), np.float32),
+            ),
+            mesh,
+        )
+        gen = np.random.default_rng(1)
+        seg_batch = shard_batch(
+            {
+                "images": gen.normal(0, 1, (64 * n, 101, 101, 2)).astype(np.float32),
+                "labels": (gen.uniform(0, 1, (64 * n, 101, 101, 1)) > 0.5).astype(
+                    np.float32
+                ),
+            },
+            mesh,
+        )
+        step = make_train_step(mesh, SegmentationTask(), donate=False)
+        compiled = step.lower(seg_state, seg_batch).compile()
+        for _ in range(3):
+            seg_state, m = compiled(seg_state, seg_batch)
+        sync(m)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            seg_state, m = compiled(seg_state, seg_batch)
+        sync(m)
+        dt = time.perf_counter() - t0
+        print(
+            json.dumps(
+                {
+                    "segmentation_flagship": {
+                        "images_per_sec_per_chip": round(64 * 10 / dt, 2),
+                        "global_batch": 64 * n,
+                        "step_time_ms": round(dt / 10 * 1000, 2),
+                    }
+                }
+            ),
+            flush=True,
+        )
+
+    if args.s2d or args.s2d_true_only:
+        from tensorflowdistributedlearning_tpu.configs import get_preset
+
+        for s2d in ((True,) if args.s2d_true_only else (False, True)):
+            preset = get_preset("resnet50_classic_imagenet")
+            import dataclasses
+
+            mcfg = dataclasses.replace(preset.model, stem_space_to_depth=s2d)
+            model = build_model(mcfg)
+            state = replicate(
+                create_train_state(
+                    model,
+                    make_optimizer(preset.train),
+                    jax.random.PRNGKey(0),
+                    np.zeros((1, 224, 224, 3), np.float32),
+                ),
+                mesh,
+            )
+            gen = np.random.default_rng(0)
+            batch = shard_batch(
+                {
+                    "images": gen.normal(0, 1, (args.batch, 224, 224, 3)).astype(
+                        np.float32
+                    ),
+                    "labels": gen.integers(0, 1000, args.batch).astype(np.int32),
+                },
+                mesh,
+            )
+            task = ClassificationTask(label_smoothing=preset.train.label_smoothing)
+            step = make_train_step(
+                mesh, task, donate=False, weight_decay=mcfg.weight_decay
+            )
+            compiled = step.lower(state, batch).compile()
+            for _ in range(3):
+                state, m = compiled(state, batch)
+            sync(m)
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                state, m = compiled(state, batch)
+            sync(m)
+            dt = time.perf_counter() - t0
+            step_s = dt / args.steps
+            out = {
+                "stem_space_to_depth": s2d,
+                "images_per_sec_per_chip": round(args.batch * args.steps / dt, 2),
+                "step_time_ms": round(step_s * 1000, 2),
+            }
+            try:
+                cost = compiled.cost_analysis()
+                flops = (cost or {}).get("flops", 0.0)
+                peak = _peak(dev)
+                if flops and peak:
+                    out["mfu"] = round(flops / step_s / peak, 4)
+                    out["model_tflops_per_step"] = round(flops / 1e12, 3)
+            except Exception:
+                pass
+            print(json.dumps(out), flush=True)
+            del compiled, state, batch
+
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
