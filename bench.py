@@ -270,16 +270,6 @@ def run_benchmark(platform: str | None = None) -> dict:
             result["depthwise_kernels"] = {"error": str(e)[:200]}
         print(json.dumps(result), flush=True)
 
-        # Pallas-vs-XLA fused attention at ViT-S shapes: the decision data for
-        # use_fused_attention, same contract as the depthwise column.
-        try:
-            from bench_kernels import bench_attention
-
-            result["attention_kernels"] = bench_attention(iters=20, warmup=3)
-        except Exception as e:  # noqa: BLE001
-            result["attention_kernels"] = {"error": str(e)[:200]}
-        print(json.dumps(result), flush=True)
-
         # Secondary metric: the reference's own wide ResNet layout (doubled
         # stage widths + 1024-wide atrous stage, ~3x classic-ResNet-50 FLOPs,
         # 40.9M params) — the architecture the parity presets train, and the
@@ -378,8 +368,9 @@ def run_benchmark(platform: str | None = None) -> dict:
             result["segmentation_flagship"] = {"error": str(e)[:200]}
         print(json.dumps(result), flush=True)
 
-        # Batch-x2 upside probe — LAST extra (lowest decision value; a timeout
-        # here costs nothing else). Only fires when the headline ran at the
+        # Batch-x2 upside probe — late extra (low decision value; only the
+        # hang-prone attention microbench, deliberately placed after it,
+        # rides on its success). Only fires when the headline ran at the
         # full configured batch: if the OOM ladder already halved it, doubling
         # re-measures a size proven to exhaust HBM. Doubles the size that
         # actually succeeded; only a BETTER number replaces the headline
@@ -406,6 +397,19 @@ def run_benchmark(platform: str | None = None) -> dict:
                 print(json.dumps(result), flush=True)
             except Exception as e:  # noqa: BLE001 — OOM/compile: keep headline
                 result["batch_x2_probe"] = {"error": str(e)[:160]}
+
+        # Pallas-vs-XLA fused attention at ViT-S shapes: the decision data for
+        # use_fused_attention, same contract as the depthwise column. LAST of
+        # the extras ON PURPOSE: this environment's remote Pallas compile has
+        # hung twice (r3 windows, starving whatever followed it) — at the end
+        # of the child a hang costs nothing but itself.
+        try:
+            from bench_kernels import bench_attention
+
+            result["attention_kernels"] = bench_attention(iters=20, warmup=3)
+        except Exception as e:  # noqa: BLE001
+            result["attention_kernels"] = {"error": str(e)[:200]}
+        print(json.dumps(result), flush=True)
 
     return result
 

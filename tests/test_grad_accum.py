@@ -164,3 +164,39 @@ def test_config_validation():
         TrainConfig(grad_accum_steps=2, pipeline_parallel=2)
     # spatial parallelism composes with accumulation (same shard_map step)
     TrainConfig(grad_accum_steps=2, sequence_parallel=2)
+
+
+def test_fit_end_to_end_with_accum(tmp_path):
+    """ClassifierTrainer.fit() trains, checkpoints, and evaluates through the
+    accumulation path (TrainConfig.grad_accum_steps wired at the call site)."""
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    model_cfg = ModelConfig(
+        num_classes=3,
+        input_shape=(8, 8),
+        input_channels=1,
+        n_blocks=(1, 1, 1),
+        block_type="basic_block",
+        width_multiplier=0.25,
+        output_stride=None,
+    )
+    train_cfg = TrainConfig(
+        optimizer="sgd",
+        lr=0.05,
+        grad_accum_steps=2,
+        grad_clip_norm=1.0,
+        checkpoint_every_steps=2,
+        n_devices=1,
+    )
+    trainer = ClassifierTrainer(str(tmp_path / "run"), None, model_cfg, train_cfg)
+    result = trainer.fit(batch_size=8, steps=3, eval_every_steps=3)
+    assert result.steps == 3
+    assert np.isfinite(result.final_metrics["loss"])
+    # the step counter counts UPDATES, not microbatches
+    template = trainer._host_template()
+    ckpt = trainer._checkpointer()
+    try:
+        latest = ckpt.restore_latest(template)
+    finally:
+        ckpt.close()
+    assert int(jax.device_get(latest.step)) == 3
