@@ -1,0 +1,134 @@
+"""One-shot TPU window sprint: run every pending hardware probe in strict
+priority order with per-section subprocess timeouts, so a short tunnel window
+yields the most decision value before it closes.
+
+Sections (each its own subprocess; a hang costs only its own budget):
+  1. XPlane profile of the classic ResNet-50 step (cached HLO — fast) —
+     the "where does the time go" breakdown VERDICT r2 #1 asks for.
+  2. Pallas fused-attention microbench (hang-prone remote compile).
+  3. stem_space_to_depth=True headline variant (fresh HLO — may starve).
+  4. digits real-data training on the chip (fresh small HLO).
+
+Writes one JSON line per completed section to stdout AND appends to
+WINDOW_SPRINT.jsonl so partial windows still leave a record.
+
+Usage: python tools/window_sprint.py [--skip profile,attention,s2d,digits]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "WINDOW_SPRINT.jsonl")
+
+SECTIONS = [
+    (
+        "profile",
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "profile_step.py"),
+            "--preset",
+            "resnet50_classic_imagenet",
+            "--batch",
+            "256",
+            "--steps",
+            "5",
+            "--logdir",
+            "/tmp/tfdl_sprint_prof",
+        ],
+        1200,
+    ),
+    (
+        "attention",
+        [sys.executable, os.path.join(REPO, "tools", "probe_attention.py")],
+        1200,
+    ),
+    (
+        "s2d",
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "probe_extras.py"),
+            "--s2d-true-only",
+        ],
+        1800,
+    ),
+    (
+        "digits",
+        [
+            sys.executable,
+            os.path.join(REPO, "examples", "train_digits.py"),
+            "--model-dir",
+            "/tmp/tfdl_digits_tpu",
+            "--steps",
+            "400",
+            "--json-out",
+            "/tmp/tfdl_digits_tpu_record.json",
+        ],
+        1800,
+    ),
+]
+
+
+def record(entry: dict) -> None:
+    entry["ts"] = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
+    line = json.dumps(entry)
+    print(line, flush=True)
+    with open(OUT, "a") as f:
+        f.write(line + "\n")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--skip", default="", help="comma-separated section names")
+    args = parser.parse_args()
+    skip = {s.strip() for s in args.skip.split(",") if s.strip()}
+
+    for name, cmd, budget in SECTIONS:
+        if name in skip:
+            record({"section": name, "skipped": True})
+            continue
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                cmd,
+                capture_output=True,
+                text=True,
+                timeout=budget,
+                cwd=REPO,
+            )
+            out_lines = [
+                ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")
+            ]
+            record(
+                {
+                    "section": name,
+                    "rc": proc.returncode,
+                    "secs": round(time.time() - t0, 1),
+                    "output": [json.loads(ln) for ln in out_lines[-4:]],
+                    "stderr_tail": proc.stderr[-300:] if proc.returncode else "",
+                }
+            )
+        except subprocess.TimeoutExpired as e:
+            partial = [
+                ln
+                for ln in (e.stdout or "").strip().splitlines()
+                if ln.startswith("{")
+            ]
+            record(
+                {
+                    "section": name,
+                    "timeout": budget,
+                    "partial_output": [json.loads(ln) for ln in partial[-4:]],
+                }
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
