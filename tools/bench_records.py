@@ -1,0 +1,118 @@
+"""Microbenchmark: native C++ record streaming + image decode vs the pure
+Python fallback, on host CPU (no TPU needed).
+
+This is the quantitative record for the framework's native IO subsystem
+(native/records.cc background-producer TFRecord reader + native/io.cc
+multithreaded GIL-free image decode) against the same API driven through the
+Python/PIL fallback — the tf.data-class capability the reference inherited
+from TensorFlow's C++ runtime (SURVEY §2.2).
+
+Writes synthetic PNG classification shards, then times two stages:
+  records:  raw framed-record streaming (RecordStream native vs Python iter)
+  end2end:  shards -> decoded [B, H, W, C] float batches
+            (ClassificationRecords.batches, native io.cc vs forced PIL)
+
+Prints one JSON line. Usage: python tools/bench_records.py [--n 2000] [--hw 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--n", type=int, default=2000, help="images")
+    parser.add_argument("--hw", type=int, default=64, help="image side")
+    parser.add_argument("--batch", type=int, default=64)
+    parser.add_argument("--shards", type=int, default=4)
+    args = parser.parse_args()
+
+    import numpy as np
+
+    from tensorflowdistributedlearning_tpu.data import records as rec
+    from tensorflowdistributedlearning_tpu.native import loader
+
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 255, (args.n, args.hw, args.hw, 3), dtype=np.uint8)
+    labels = rng.integers(0, 10, args.n).astype(np.int64)
+
+    out: dict = {
+        "n_images": args.n,
+        "image": f"{args.hw}x{args.hw}x3 png",
+        "native_available": loader.native_available(),
+    }
+
+    with tempfile.TemporaryDirectory() as tmp:
+        paths = rec.write_classification_shards(
+            tmp, list(imgs), list(labels), shards=args.shards, prefix="train"
+        )
+
+        def time_stream(native: bool) -> float:
+            count = 0
+            t0 = time.perf_counter()
+            for p in paths:
+                stream = rec.RecordStream([p])
+                if native:
+                    lib = rec._records_lib()
+                    assert lib is not None, "native records lib unavailable"
+                    it = stream._iter_native(lib)
+                else:
+                    it = stream._iter_python()
+                for _ in it:
+                    count += 1
+            dt = time.perf_counter() - t0
+            assert count == args.n, (count, args.n)
+            return dt
+
+        # warm once (the native lib builds/loads lazily), then measure
+        time_stream(native=True)
+        native_s = time_stream(native=True)
+        python_s = time_stream(native=False)
+        out["records_stream"] = {
+            "native_recs_per_sec": round(args.n / native_s, 1),
+            "python_recs_per_sec": round(args.n / python_s, 1),
+            "speedup": round(python_s / native_s, 2),
+        }
+
+        def time_end2end(force_pil: bool) -> float:
+            src = rec.ClassificationRecords(
+                tmp, split="train", image_shape=(args.hw, args.hw), channels=3
+            )
+            saved = loader._load
+            if force_pil:
+                loader._load = lambda: None  # type: ignore[assignment]
+            try:
+                seen = 0
+                t0 = time.perf_counter()
+                for batch in src.batches(args.batch, seed=0, repeat=False):
+                    seen += int(batch["valid"].sum())
+                dt = time.perf_counter() - t0
+                assert seen == args.n, (seen, args.n)
+                return dt
+            finally:
+                loader._load = saved  # type: ignore[assignment]
+
+        time_end2end(force_pil=False)  # warm
+        native_e = time_end2end(force_pil=False)
+        pil_e = time_end2end(force_pil=True)
+        out["end2end_decode"] = {
+            "native_images_per_sec": round(args.n / native_e, 1),
+            "pil_images_per_sec": round(args.n / pil_e, 1),
+            "speedup": round(pil_e / native_e, 2),
+        }
+
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
