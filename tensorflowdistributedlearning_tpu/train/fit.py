@@ -372,6 +372,7 @@ class ClassifierTrainer:
         # first window contains the compile; eval/save windows are not training
         # time either — dirty windows skip their throughput point
         window_dirty = True
+        lr_sched = step_lib.make_lr_schedule(tcfg)
         for raw in batches:
             batch = prepare(jax.numpy.asarray(step_no), raw)
             state, metrics = train_step(state, batch)
@@ -384,6 +385,10 @@ class ClassifierTrainer:
                         (step_no - window_start) * batch_size / (now - window_t0)
                     )
                 window_t0, window_start, window_dirty = now, step_no, False
+                # the lr the NEXT update will use — exact, the schedule is
+                # step-driven (observability the reference's TB summaries
+                # never had)
+                scalars["lr"] = float(lr_sched(step_no))
                 tb_train.scalars(scalars, step_no)
             if ckpt.maybe_save(state, step=step_no):
                 window_dirty = True

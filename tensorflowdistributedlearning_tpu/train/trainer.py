@@ -317,6 +317,7 @@ class Trainer:
         # an eval pass or a synchronous checkpoint save are likewise not
         # training time — mark them dirty and skip their throughput point
         window_dirty = True
+        lr_sched = step_lib.make_lr_schedule(tcfg)
         for raw in batches:
             batch = prepare(jnp.asarray(step_no), raw)
             state, metrics = train_step(state, batch)
@@ -331,6 +332,8 @@ class Trainer:
                         (step_no - window_start) * batch_size / (now - window_t0)
                     )
                 window_t0, window_start, window_dirty = now, step_no, False
+                # exact lr of the next update (host-side schedule eval)
+                scalars["lr"] = float(lr_sched(step_no))
                 tb_train.scalars(scalars, step_no)
                 # train-phase image grids every train_log_every_steps — the
                 # reference's SummarySaverHook wrote input/label/probability/

@@ -96,6 +96,10 @@ def test_event_files_parse(trained):
     steps = [s for s, _ in read_events(train_events[0])]
     assert steps and all(s % 2 == 0 for s in steps)  # train_log_every_steps=2
     assert any("loss" in v for _, v in read_events(eval_events[0]))
+    # exact lr of the next update rides the train scalars (observability the
+    # reference's TB summaries never had)
+    lr_points = [v["lr"] for _, v in read_events(train_events[0]) if "lr" in v]
+    assert lr_points and all(p > 0 for p in lr_points)
 
 
 def test_resume_is_idempotent(trained, salt_dirs):
