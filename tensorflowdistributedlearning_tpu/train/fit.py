@@ -258,10 +258,17 @@ class ClassifierTrainer:
         return ds
 
     def _train_stream(
-        self, batch_size: int, steps: int
+        self, batch_size: int, steps: int, start_step: int = 0
     ) -> Iterator[Dict[str, np.ndarray]]:
         tcfg = self.train_config
         local_bs = multihost.per_process_batch_size(batch_size)
+        # fold the resume point into the shuffle seed: a restarted stream
+        # would otherwise replay the SAME shuffled order from the beginning,
+        # re-training on the earliest examples (the reference had exactly
+        # this behavior — Estimator input_fns restart on resume — but there
+        # is no reason to keep it). Every process shifts identically, so
+        # multi-host batch assembly stays aligned.
+        seed = tcfg.seed + jax.process_index() + 7919 * start_step
         # record-sharded source first: {data_dir}/train-*.tfrecord (the
         # ImageNet-scale on-disk form; native threaded reader + blob decode,
         # data/records.py). Each process streams its own shard subset.
@@ -269,7 +276,7 @@ class ClassifierTrainer:
         if records_ds is not None:
             return records_ds.batches(
                 local_bs,
-                seed=tcfg.seed + jax.process_index(),
+                seed=seed,
                 steps=steps,
             )
         train_split = self._open_split("train")
@@ -278,7 +285,7 @@ class ClassifierTrainer:
             return synthetic_lib.synthetic_batches(
                 "classification",
                 local_bs,
-                seed=tcfg.seed + jax.process_index(),
+                seed=seed,
                 steps=steps,
                 input_shape=cfg.input_shape,
                 channels=cfg.input_channels,
@@ -290,7 +297,7 @@ class ClassifierTrainer:
         return imagefolder.train_batches(
             train_split.host_shard(),
             local_bs,
-            seed=tcfg.seed + jax.process_index(),
+            seed=seed,
             steps=steps,
             augment=False,
         )
@@ -361,7 +368,8 @@ class ClassifierTrainer:
         tb_eval = SummaryWriter(os.path.join(self.model_dir, "eval")) if is_main else None
 
         batches = pipeline_lib.device_prefetch(
-            self._train_stream(batch_size, steps - start_step), self._place_batch
+            self._train_stream(batch_size, steps - start_step, start_step),
+            self._place_batch,
         )
         step_no = start_step
         last_eval_step = -1

@@ -301,7 +301,13 @@ class Trainer:
         final_metrics: Dict[str, float] = {}
 
         batches = pipeline_lib.train_batches(
-            train_ds, local_bs, seed=tcfg.seed + fold, steps=steps - start_step
+            train_ds,
+            local_bs,
+            # fold the resume point into the shuffle seed so a resumed run
+            # does not replay the same shuffled order from the beginning
+            # (see ClassifierTrainer._train_stream)
+            seed=tcfg.seed + fold + 7919 * start_step,
+            steps=steps - start_step,
         )
         batches = pipeline_lib.device_prefetch(
             batches,
