@@ -132,6 +132,22 @@ def build_parser() -> argparse.ArgumentParser:
                        "mixing on top of flip_crop)")
 
     sub.add_parser("presets", help="list the named BASELINE config presets")
+
+    p_doc = sub.add_parser(
+        "doctor",
+        help="diagnose the environment and (optionally) a dataset layout",
+    )
+    p_doc.add_argument("--data-dir", default=None,
+                       help="dataset root to analyze: ImageFolder "
+                       "({root}/train/{class}/*.png), record shards "
+                       "({root}/train-*.tfrecord), or TGS-salt layout "
+                       "({root}/images + {root}/masks)")
+    p_doc.add_argument("--batch-size", type=int, default=None,
+                       help="intended global batch: checked against the "
+                       "device count and --grad-accum")
+    p_doc.add_argument("--n-devices", type=int, default=None)
+    p_doc.add_argument("--grad-accum", type=int, default=1)
+
     return parser
 
 
@@ -300,6 +316,122 @@ def cmd_presets(args) -> int:
     return 0
 
 
+def cmd_doctor(args) -> int:
+    """Environment + dataset diagnosis: one JSON report, no side effects
+    beyond a lazy native-library build attempt. The closest reference
+    analogue is `utils.get_available_gpus` (utils.py:6-8) — this covers the
+    whole stack a training run depends on."""
+    import glob
+    import os
+
+    report: dict = {"ok": True}
+
+    def problem(msg: str) -> None:
+        report["ok"] = False
+        report.setdefault("problems", []).append(msg)
+
+    import jax
+
+    devices = jax.devices()
+    report["backend"] = {
+        "platform": jax.default_backend(),
+        "n_devices": len(devices),
+        "device_kind": devices[0].device_kind,
+        "process_count": jax.process_count(),
+    }
+
+    from tensorflowdistributedlearning_tpu.data.records import _records_lib
+    from tensorflowdistributedlearning_tpu.native import loader
+
+    report["native"] = {
+        "decode_io_cc": loader.native_available(),
+        "records_cc": _records_lib() is not None,
+    }
+    for lib, present in report["native"].items():
+        if not present:
+            problem(
+                f"native {lib} unavailable — the pure-Python fallback works "
+                "but streams records/decodes images far slower (RECORDS_BENCH.json)"
+            )
+
+    n = args.n_devices or len(devices)
+    if args.batch_size is not None:
+        batch: dict = {"global_batch": args.batch_size, "data_parallel": n}
+        if args.batch_size % n:
+            problem(
+                f"batch {args.batch_size} not divisible by {n} devices "
+                "(reference contract, model.py:156-159)"
+            )
+        elif args.grad_accum > 1 and (args.batch_size // n) % args.grad_accum:
+            problem(
+                f"per-shard batch {args.batch_size // n} not divisible by "
+                f"grad_accum_steps={args.grad_accum}"
+            )
+        else:
+            batch["per_shard"] = args.batch_size // n // args.grad_accum
+        report["batch"] = batch
+
+    if args.data_dir:
+        d = args.data_dir
+        data: dict = {"root": d}
+        if not os.path.isdir(d):
+            problem(f"data dir {d} does not exist")
+        elif glob.glob(os.path.join(d, "train-*.tfrecord")):
+            from tensorflowdistributedlearning_tpu.data import records as rec
+
+            data["layout"] = "record-shards"
+            for split in ("train", "val"):
+                paths = sorted(
+                    glob.glob(os.path.join(d, f"{split}-*.tfrecord"))
+                )
+                if not paths:
+                    continue
+                info = {"shards": len(paths)}
+                try:
+                    info["records"] = rec.count_records(paths)
+                except ValueError as e:
+                    problem(f"{split} shards corrupt: {e}")
+                if split == "train" and len(paths) < jax.process_count():
+                    problem(
+                        f"{len(paths)} train shards < {jax.process_count()} "
+                        "processes — every process needs at least one"
+                    )
+                data[split] = info
+        elif os.path.isdir(os.path.join(d, "train")):
+            from tensorflowdistributedlearning_tpu.data import imagefolder
+
+            data["layout"] = "imagefolder"
+            try:
+                ds = imagefolder.ImageFolder(
+                    os.path.join(d, "train"), (32, 32), channels=3
+                )
+                data["train"] = {
+                    "examples": len(ds),
+                    "classes": ds.num_classes,
+                }
+            except Exception as e:  # noqa: BLE001 — report, don't crash
+                problem(f"imagefolder scan failed: {e}")
+        elif os.path.isdir(os.path.join(d, "images")):
+            imgs = glob.glob(os.path.join(d, "images", "*.png"))
+            masks = glob.glob(os.path.join(d, "masks", "*.png"))
+            data["layout"] = "tgs-salt"
+            data["images"], data["masks"] = len(imgs), len(masks)
+            if len(imgs) != len(masks):
+                problem(
+                    f"{len(imgs)} images vs {len(masks)} masks — every "
+                    "training image needs its mask"
+                )
+        else:
+            problem(
+                f"{d}: no recognized layout (expected train-*.tfrecord, "
+                "train/{class}/, or images/ + masks/)"
+            )
+        report["data"] = data
+
+    print(json.dumps(report, indent=2))
+    return 0 if report["ok"] else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     logging.basicConfig(level=logging.INFO)
     from tensorflowdistributedlearning_tpu.utils.devices import apply_platform_env
@@ -312,6 +444,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "smoke": cmd_smoke,
         "fit": cmd_fit,
         "presets": cmd_presets,
+        "doctor": cmd_doctor,
     }[args.command](args)
 
 

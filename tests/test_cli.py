@@ -44,3 +44,55 @@ def test_train_command_missing_data(tmp_path, capsys):
         ]
     )
     assert rc == 1
+
+
+def test_doctor_healthy_imagefolder(tmp_path, capsys):
+    from tensorflowdistributedlearning_tpu.data import imagefolder
+
+    root = str(tmp_path / "data")
+    imagefolder.write_synthetic_imagefolder(
+        root + "/train", 3, 4, (16, 16), channels=3
+    )
+    rc = main(["doctor", "--data-dir", root, "--batch-size", "16"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0 and report["ok"]
+    assert report["data"]["layout"] == "imagefolder"
+    assert report["data"]["train"] == {"examples": 12, "classes": 3}
+    assert report["backend"]["n_devices"] == 8
+    assert report["batch"]["per_shard"] == 2
+
+
+def test_doctor_reports_problems(tmp_path, capsys):
+    rc = main(
+        ["doctor", "--data-dir", str(tmp_path / "nope"), "--batch-size", "17"]
+    )
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1 and not report["ok"]
+    assert any("not divisible" in p for p in report["problems"])
+    assert any("does not exist" in p for p in report["problems"])
+
+
+def test_doctor_detects_corrupt_shard(tmp_path, capsys):
+    import numpy as np
+
+    from tensorflowdistributedlearning_tpu.data import records as rec
+
+    root = str(tmp_path / "recs")
+    rng = np.random.default_rng(0)
+    rec.write_classification_shards(
+        root,
+        list(rng.integers(0, 255, (6, 8, 8, 3), dtype=np.uint8)),
+        [0, 1, 2, 0, 1, 2],
+        shards=2,
+        prefix="train",
+    )
+    shard = sorted(
+        p for p in __import__("os").listdir(root) if p.startswith("train-")
+    )[0]
+    path = root + "/" + shard
+    with open(path, "r+b") as f:  # truncate mid-record
+        f.truncate(max(f.seek(0, 2) - 7, 1))
+    rc = main(["doctor", "--data-dir", root])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1 and not report["ok"]
+    assert any("corrupt" in p for p in report["problems"])

@@ -75,9 +75,10 @@ def main() -> int:
             assert count == args.n, (count, args.n)
             return dt
 
+        time_stream(native=False)  # untimed warm pass (page cache, imports)
         python_s = time_stream(native=False)
         if records_lib is not None:
-            time_stream(native=True)  # warm
+            time_stream(native=True)  # warm the native lib load
             native_s = time_stream(native=True)
             out["records_stream"] = {
                 "native_recs_per_sec": round(args.n / native_s, 1),
@@ -108,9 +109,12 @@ def main() -> int:
             finally:
                 loader._load = saved  # type: ignore[assignment]
 
+        # warm the OS page cache + lazy imports with an UNTIMED pass before
+        # timing either side, so neither path pays cold-file costs
+        time_end2end(force_pil=True)
         pil_e = time_end2end(force_pil=True)
         if loader.native_available():
-            time_end2end(force_pil=False)  # warm
+            time_end2end(force_pil=False)  # warm the native lib load
             native_e = time_end2end(force_pil=False)
             out["end2end_decode"] = {
                 "native_images_per_sec": round(args.n / native_e, 1),
