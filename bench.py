@@ -477,6 +477,20 @@ TPU_CACHE_PATH = os.path.join(
 def _save_tpu_cache(result: dict) -> None:
     try:
         cached = dict(result)
+        # MERGE with the existing record rather than replacing it: a partial
+        # run (tunnel cut mid-extras) must not clobber sections an earlier
+        # window DID land (segmentation_flagship, reference_family_wide,
+        # kernel microbenches...). Fresh keys win; missing keys survive.
+        prior = _load_tpu_cache()
+        if prior:
+            for key, value in prior.items():
+                if key not in cached or (
+                    isinstance(value, dict)
+                    and isinstance(cached.get(key), dict)
+                    and "error" in cached[key]
+                    and "error" not in value
+                ):
+                    cached[key] = value
         cached["measured_at_unix"] = int(time.time())
         cached["measured_at"] = time.strftime(
             "%Y-%m-%d %H:%M:%S UTC", time.gmtime()

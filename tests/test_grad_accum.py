@@ -10,8 +10,6 @@ equal-size microbatch gradients equals the full-batch gradient, so the updated
 parameters must match the accum=1 step bitwise-closely.
 """
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 import numpy as np
