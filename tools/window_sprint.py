@@ -72,6 +72,13 @@ SECTIONS = [
         ],
         1800,
     ),
+    # full bench last: refreshes the headline + extras under the
+    # merge-preserving cache (its own supervisor bounds the children)
+    (
+        "bench",
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        1700,
+    ),
 ]
 
 
