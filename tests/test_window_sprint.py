@@ -1,0 +1,58 @@
+"""The window-sprint supervisor (tools/window_sprint.py) runs unattended when
+a TPU tunnel window opens; these tests pin its orchestration contract so a
+regression cannot silently waste a window: sections run in order under their
+own budgets, JSON lines from children are captured, timeouts/skips are
+recorded, and every outcome lands in WINDOW_SPRINT.jsonl."""
+
+import importlib.util
+import json
+import os
+import sys
+
+
+def _load(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "window_sprint",
+        os.path.join(os.path.dirname(__file__), "..", "tools", "window_sprint.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.OUT = str(tmp_path / "sprint.jsonl")
+    return mod
+
+
+def test_sections_record_output_skip_and_timeout(tmp_path, capsys, monkeypatch):
+    mod = _load(tmp_path)
+    mod.SECTIONS = [
+        (
+            "ok",
+            [sys.executable, "-c", "print('{\"hello\": 1}')"],
+            30,
+        ),
+        ("skipme", [sys.executable, "-c", "print('never')"], 30),
+        (
+            "fails",
+            [sys.executable, "-c", "import sys; sys.exit(3)"],
+            30,
+        ),
+        (
+            "hangs",
+            [sys.executable, "-c", "import time; time.sleep(60)"],
+            1,
+        ),
+    ]
+    monkeypatch.setattr(sys, "argv", ["window_sprint.py", "--skip", "skipme"])
+    assert mod.main() == 0
+
+    entries = [
+        json.loads(line) for line in open(mod.OUT).read().strip().splitlines()
+    ]
+    by_name = {e["section"]: e for e in entries}
+    assert by_name["ok"]["rc"] == 0
+    assert by_name["ok"]["output"] == [{"hello": 1}]
+    assert by_name["skipme"]["skipped"] is True
+    assert by_name["fails"]["rc"] == 3
+    assert by_name["fails"]["output"] == []
+    assert by_name["hangs"]["timeout"] == 1
+    # stdout mirrors the file (the live view during a window)
+    assert capsys.readouterr().out.count('"section"') == 4
