@@ -33,18 +33,26 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tensorflowdistributedlearning_tpu.parallel.mesh import BATCH_AXIS, SEQUENCE_AXIS
 
 # Large-negative mask value: -inf would poison rows whose every key is masked
-# (exp(-inf - -inf) = nan). NOTE: a row with NO visible key degrades to a uniform
-# softmax (output = mean of V) — identical in both the ring and reference
-# formulations, and unreachable for causal SELF-attention (the diagonal is always
-# visible). Anyone adding padding/document masks must zero such rows explicitly.
+# (exp(-inf - -inf) = nan). A row with NO visible key returns exact zeros in
+# both formulations: the reference zeroes it explicitly, the ring zeroes
+# masked probability columns so the denominator stays 0 and the final guard
+# maps 0/0 to 0. Unreachable for causal SELF-attention (the diagonal is
+# always visible) — it only engages under ``kv_mask`` padding masks.
 _MASK_VALUE = -1e30
 
 
 def attention_reference(
-    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    kv_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Plain full-sequence softmax attention (the oracle ring_attention must
-    reproduce). Shapes [B, S, H, D]; accumulates in float32."""
+    reproduce). Shapes [B, S, H, D]; accumulates in float32. ``kv_mask``
+    ([B, S] bool, True = real key) excludes padding keys; a query row whose
+    every key is masked returns zeros (the padding-row convention)."""
     orig_dtype = q.dtype
     q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
     scale = 1.0 / jnp.sqrt(q.shape[-1])
@@ -53,8 +61,27 @@ def attention_reference(
         s_q, s_k = scores.shape[-2], scores.shape[-1]
         mask = jnp.tril(jnp.ones((s_q, s_k), bool))
         scores = jnp.where(mask, scores, _MASK_VALUE)
+    if kv_mask is not None:
+        scores = jnp.where(kv_mask[:, None, None, :], scores, _MASK_VALUE)
     weights = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", weights, v).astype(orig_dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    if kv_mask is not None:
+        # rows with NO visible key would otherwise be a uniform softmax over
+        # masked slots; zero them explicitly (see _MASK_VALUE note)
+        if causal:
+            # under causality a query sees keys <= its position; visibility is
+            # per (batch, query-position)
+            s = kv_mask.shape[-1]
+            tril = jnp.tril(jnp.ones((s, s), bool))
+            any_visible = jnp.einsum(
+                "qk,bk->bq", tril.astype(jnp.float32), kv_mask.astype(jnp.float32)
+            ) > 0
+        else:
+            any_visible = jnp.broadcast_to(
+                kv_mask.any(axis=-1)[:, None], out.shape[:2]
+            )
+        out = jnp.where(any_visible[:, :, None, None], out, 0.0)
+    return out.astype(orig_dtype)
 
 
 def _ring_perm(n: int):
@@ -69,6 +96,7 @@ def ring_attention(
     *,
     axis_name: str = SEQUENCE_AXIS,
     causal: bool = False,
+    kv_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Exact attention with Q/K/V sharded [B, S/n, H, D] on ``axis_name``.
 
@@ -78,6 +106,11 @@ def ring_attention(
     GLOBAL positions: query ``axis_index*S_loc + i`` may only attend to keys at
     global positions <= its own, so the sharded result matches
     ``attention_reference(causal=True)`` on the gathered sequence exactly.
+
+    ``kv_mask`` ([B, S/n] bool, sharded like K on ``axis_name``; True = real
+    key) excludes padding keys — the variable-length-batch form. The mask
+    rotates around the ring WITH its K/V block. A query row whose every
+    visible key is masked returns zeros, matching ``attention_reference``.
     """
     n = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
@@ -96,19 +129,31 @@ def ring_attention(
 
     q_pos = my_idx * s_loc + jnp.arange(s_loc)  # global query positions
 
-    def block_update(o, m, l, k_blk, v_blk, step_no):
+    def block_update(o, m, l, k_blk, v_blk, mask_blk, step_no):
         # the block held at ring step t originated on device (my_idx - t) mod n
         src = (my_idx - step_no) % n
         scores = (
             jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
         )
+        causal_mask = None
         if causal:
             k_pos = src * s_loc + jnp.arange(s_loc)
-            mask = q_pos[:, None] >= k_pos[None, :]  # [s_q, s_k]
-            scores = jnp.where(mask[None, None], scores, _MASK_VALUE)
+            causal_mask = q_pos[:, None] >= k_pos[None, :]  # [s_q, s_k]
+            scores = jnp.where(causal_mask[None, None], scores, _MASK_VALUE)
+        if mask_blk is not None:
+            scores = jnp.where(
+                mask_blk[:, None, None, :], scores, _MASK_VALUE
+            )
         m_new = jnp.maximum(m, scores.max(axis=-1, keepdims=True))
         correction = jnp.exp(m - m_new)
         p = jnp.exp(scores - m_new)
+        if mask_blk is not None:
+            # exp(MASK - MASK) = 1 would leak masked slots into rows whose
+            # running max is still _MASK_VALUE (no visible key yet); zero the
+            # masked columns outright so l counts only real keys
+            p = p * mask_blk[:, None, None, :].astype(p.dtype)
+            if causal_mask is not None:
+                p = p * causal_mask[None, None].astype(p.dtype)
         l = l * correction + p.sum(axis=-1, keepdims=True)
         o = o * correction + jnp.einsum(
             "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
@@ -118,19 +163,30 @@ def ring_attention(
     # step 0 attends to the locally-held block before any rotation; the scan
     # then does [rotate, attend] for steps 1..n-1 — so exactly n-1 rotations
     # happen and no ppermute's result is discarded
-    o, m, l = block_update(o0, m0, l0, k, v, 0)
+    o, m, l = block_update(o0, m0, l0, k, v, kv_mask, 0)
 
     def step(carry, step_no):
-        o, m, l, k_blk, v_blk = carry
+        if kv_mask is not None:
+            o, m, l, k_blk, v_blk, mask_blk = carry
+            mask_blk = lax.ppermute(mask_blk, axis_name, _ring_perm(n))
+        else:
+            o, m, l, k_blk, v_blk = carry
+            mask_blk = None
         k_blk = lax.ppermute(k_blk, axis_name, _ring_perm(n))
         v_blk = lax.ppermute(v_blk, axis_name, _ring_perm(n))
-        o, m, l = block_update(o, m, l, k_blk, v_blk, step_no)
+        o, m, l = block_update(o, m, l, k_blk, v_blk, mask_blk, step_no)
+        if kv_mask is not None:
+            return (o, m, l, k_blk, v_blk, mask_blk), None
         return (o, m, l, k_blk, v_blk), None
 
     if n > 1:
-        (o, _, l, _, _), _ = lax.scan(step, (o, m, l, k, v), jnp.arange(1, n))
-    # the guard only engages for rows with no visible key under future mask
-    # extensions (see _MASK_VALUE note); causal self-attention never hits it
+        carry = (
+            (o, m, l, k, v, kv_mask) if kv_mask is not None else (o, m, l, k, v)
+        )
+        carry, _ = lax.scan(step, carry, jnp.arange(1, n))
+        o, _, l = carry[0], carry[1], carry[2]
+    # rows with no visible key (all keys masked) have l == 0: the guard turns
+    # their 0/0 into exact zeros, matching attention_reference's convention
     out = o / jnp.maximum(l, 1e-30)
     return jnp.transpose(out, (0, 2, 1, 3)).astype(orig_dtype)  # [B, S/n, H, D]
 
@@ -139,13 +195,35 @@ def make_ring_attention(
     mesh: Mesh,
     *,
     causal: bool = False,
+    masked: bool = False,
     batch_axis: Optional[str] = BATCH_AXIS,
     sequence_axis: str = SEQUENCE_AXIS,
 ):
     """Jitted sequence-parallel attention over ``mesh``: takes GLOBAL [B, S, H, D]
     arrays (sharded batch over ``batch_axis``, sequence over ``sequence_axis``)
-    and returns the global attention output with the same sharding."""
+    and returns the global attention output with the same sharding.
+
+    ``masked=True`` returns ``fn(q, k, v, kv_mask)`` where ``kv_mask`` is a
+    GLOBAL [B, S] bool (True = real key), sharded like the sequence — the
+    variable-length-batch form."""
     spec = P(batch_axis, sequence_axis, None, None)
+
+    if masked:
+        mask_spec = P(batch_axis, sequence_axis)
+
+        def fn_masked(q, k, v, kv_mask):
+            return ring_attention(
+                q, k, v, axis_name=sequence_axis, causal=causal, kv_mask=kv_mask
+            )
+
+        return jax.jit(
+            jax.shard_map(
+                fn_masked,
+                mesh=mesh,
+                in_specs=(spec, spec, spec, mask_spec),
+                out_specs=spec,
+            )
+        )
 
     def fn(q, k, v):
         return ring_attention(q, k, v, axis_name=sequence_axis, causal=causal)
