@@ -13,7 +13,16 @@ Usage:
         [--out batch.png] [--n 8] [--seed 0] [--no-augment]
 """
 
+
 from __future__ import annotations
+
+import os
+import sys
+
+# runnable straight from a checkout: python examples/<name>.py (no install,
+# no PYTHONPATH needed)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 import argparse
 

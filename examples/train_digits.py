@@ -8,7 +8,9 @@ The reference's notebooks were real end-to-end runs on real Kaggle data
 streaming fit() path: the raw bitmaps are written as PNG TFRecord shards
 (data/records.py), streamed through the native reader into a ResNet classifier,
 trained on the device mesh, and evaluated on a held-out split the model never
-saw. Default budget reaches ~97% top-1 in under a minute of step time.
+saw. Measured with the default budget: 95.5% held-out top-1 on an 8-device
+CPU mesh (600 steps, bf16, per-shard BN — `DIGITS_RUN.json` at the repo root
+is that run's committed record).
 
 Usage:
     python examples/train_digits.py --model-dir /tmp/digits_run \
@@ -16,7 +18,16 @@ Usage:
         [--json-out DIGITS.json]
 """
 
+
 from __future__ import annotations
+
+import os
+import sys
+
+# runnable straight from a checkout: python examples/<name>.py (no install,
+# no PYTHONPATH needed)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 import argparse
 import json
@@ -61,12 +72,14 @@ def main() -> int:
         output_stride=None,
         dtype="bfloat16",
         # eval runs on BN running stats; 0.99 lags a short run (it needs ~500
-        # steps to converge) — 0.95 keeps the exported metrics honest
-        batch_norm_decay=0.95,
+        # steps to converge) — 0.9 tracks the short budget honestly
+        batch_norm_decay=0.9,
     )
     train_cfg = TrainConfig(
         optimizer="adam",
-        lr=1e-3,
+        # 3e-3 (not the ImageNet-ish 1e-3): 1797 examples, ~28 steps/epoch —
+        # the short-budget recipe the e2e test validates
+        lr=3e-3,
         lr_schedule="cosine",
         lr_decay_steps=args.steps,
         weight_decay=1e-4,

@@ -24,7 +24,16 @@ height must then be divisible by overall_stride*N (so the stride-32 224x224
 trunks need a 256x256-style input; the validation error says exactly what fits).
 """
 
+
 from __future__ import annotations
+
+import os
+import sys
+
+# runnable straight from a checkout: python examples/<name>.py (no install,
+# no PYTHONPATH needed)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 import argparse
 import dataclasses

@@ -15,7 +15,16 @@ Usage:
         [--batch-size 64] [--steps 10000] [--predict --submission sub.csv]
 """
 
+
 from __future__ import annotations
+
+import os
+import sys
+
+# runnable straight from a checkout: python examples/<name>.py (no install,
+# no PYTHONPATH needed)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 import argparse
 import json
