@@ -1,0 +1,11 @@
+"""Shared example bootstrap: make the repo importable when a driver runs
+straight from a checkout (``python examples/<name>.py`` — no install, no
+PYTHONPATH). Imported as ``import _bootstrap`` because the script's own
+directory is always ``sys.path[0]``."""
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)

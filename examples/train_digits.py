@@ -21,12 +21,7 @@ Usage:
 
 from __future__ import annotations
 
-import os
-import sys
-
-# runnable straight from a checkout: python examples/<name>.py (no install,
-# no PYTHONPATH needed)
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _bootstrap  # noqa: F401  (repo-root sys.path setup)
 
 
 import argparse
@@ -53,8 +48,12 @@ def main() -> int:
     apply_platform_env()
     logging.basicConfig(level=logging.INFO)
 
-    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
-    from tensorflowdistributedlearning_tpu.data.digits import prepare_digits
+    from tensorflowdistributedlearning_tpu.config import ModelConfig
+    from tensorflowdistributedlearning_tpu.data.digits import (
+        SHORT_BUDGET_BN_DECAY,
+        prepare_digits,
+        short_budget_train_config,
+    )
     from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
 
     data_dir = args.data_dir or os.path.join(args.model_dir, "data")
@@ -71,22 +70,11 @@ def main() -> int:
         width_multiplier=0.5,
         output_stride=None,
         dtype="bfloat16",
-        # eval runs on BN running stats; 0.99 lags a short run (it needs ~500
-        # steps to converge) — 0.9 tracks the short budget honestly
-        batch_norm_decay=0.9,
+        batch_norm_decay=SHORT_BUDGET_BN_DECAY,
     )
-    train_cfg = TrainConfig(
-        optimizer="adam",
-        # 3e-3 (not the ImageNet-ish 1e-3): 1797 examples, ~28 steps/epoch —
-        # the short-budget recipe the e2e test validates
-        lr=3e-3,
-        lr_schedule="cosine",
-        lr_decay_steps=args.steps,
-        weight_decay=1e-4,
-        checkpoint_every_steps=max(args.steps // 3, 1),
-        # mirrored digits are other glyphs (or garbage): crop-only augmentation
-        augmentation="crop",
-    )
+    # the shared validated recipe (data/digits.py) — the e2e test asserts
+    # accuracy on exactly these settings
+    train_cfg = short_budget_train_config(args.steps)
     trainer = ClassifierTrainer(args.model_dir, data_dir, model_cfg, train_cfg)
     t0 = time.perf_counter()
     result = trainer.fit(

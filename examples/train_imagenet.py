@@ -27,12 +27,7 @@ trunks need a 256x256-style input; the validation error says exactly what fits).
 
 from __future__ import annotations
 
-import os
-import sys
-
-# runnable straight from a checkout: python examples/<name>.py (no install,
-# no PYTHONPATH needed)
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _bootstrap  # noqa: F401  (repo-root sys.path setup)
 
 
 import argparse

@@ -48,38 +48,38 @@ def attention_reference(
     *,
     causal: bool = False,
     kv_mask: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Plain full-sequence softmax attention (the oracle ring_attention must
-    reproduce). Shapes [B, S, H, D]; accumulates in float32. ``kv_mask``
-    ([B, S] bool, True = real key) excludes padding keys; a query row whose
-    every key is masked returns zeros (the padding-row convention)."""
+    reproduce). Shapes [B, S, H, D]; accumulates in float32.
+
+    ``kv_mask`` ([B, S] bool, True = real key) excludes padding keys.
+    ``segment_ids`` ([B, S] int) isolates packed documents: a query attends
+    only to keys with ITS OWN segment id. Both masks compose with ``causal``;
+    a query row with no visible key returns zeros."""
     orig_dtype = q.dtype
     q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
     scale = 1.0 / jnp.sqrt(q.shape[-1])
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    b = scores.shape[0]
+    s_q, s_k = scores.shape[-2], scores.shape[-1]
+    # visibility [B, s_q, s_k]: causality AND padding AND segment identity
+    visible = jnp.ones((b, s_q, s_k), bool)
     if causal:
-        s_q, s_k = scores.shape[-2], scores.shape[-1]
-        mask = jnp.tril(jnp.ones((s_q, s_k), bool))
-        scores = jnp.where(mask, scores, _MASK_VALUE)
+        visible = visible & jnp.tril(jnp.ones((s_q, s_k), bool))[None]
     if kv_mask is not None:
-        scores = jnp.where(kv_mask[:, None, None, :], scores, _MASK_VALUE)
+        visible = visible & kv_mask[:, None, :]
+    if segment_ids is not None:
+        visible = visible & (
+            segment_ids[:, :, None] == segment_ids[:, None, :]
+        )
+    scores = jnp.where(visible[:, None], scores, _MASK_VALUE)
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
-    if kv_mask is not None:
+    if kv_mask is not None or segment_ids is not None:
         # rows with NO visible key would otherwise be a uniform softmax over
         # masked slots; zero them explicitly (see _MASK_VALUE note)
-        if causal:
-            # under causality a query sees keys <= its position; visibility is
-            # per (batch, query-position)
-            s = kv_mask.shape[-1]
-            tril = jnp.tril(jnp.ones((s, s), bool))
-            any_visible = jnp.einsum(
-                "qk,bk->bq", tril.astype(jnp.float32), kv_mask.astype(jnp.float32)
-            ) > 0
-        else:
-            any_visible = jnp.broadcast_to(
-                kv_mask.any(axis=-1)[:, None], out.shape[:2]
-            )
+        any_visible = visible.any(axis=-1)
         out = jnp.where(any_visible[:, :, None, None], out, 0.0)
     return out.astype(orig_dtype)
 
@@ -97,6 +97,7 @@ def ring_attention(
     axis_name: str = SEQUENCE_AXIS,
     causal: bool = False,
     kv_mask: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Exact attention with Q/K/V sharded [B, S/n, H, D] on ``axis_name``.
 
@@ -108,9 +109,12 @@ def ring_attention(
     ``attention_reference(causal=True)`` on the gathered sequence exactly.
 
     ``kv_mask`` ([B, S/n] bool, sharded like K on ``axis_name``; True = real
-    key) excludes padding keys — the variable-length-batch form. The mask
-    rotates around the ring WITH its K/V block. A query row whose every
-    visible key is masked returns zeros, matching ``attention_reference``.
+    key) excludes padding keys — the variable-length-batch form.
+    ``segment_ids`` ([B, S/n] int, sharded the same way) isolates packed
+    documents: a query attends only to keys sharing ITS segment id. Both
+    rotate around the ring WITH their K/V block (the key-side slice travels;
+    the query-side slice stays local). A query row whose every visible key is
+    masked returns zeros, matching ``attention_reference``.
     """
     n = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
@@ -128,32 +132,37 @@ def ring_attention(
     l0 = zeros_bhsd[..., :1]
 
     q_pos = my_idx * s_loc + jnp.arange(s_loc)  # global query positions
+    q_seg = segment_ids  # this device's query-side segment ids (never rotate)
 
-    def block_update(o, m, l, k_blk, v_blk, mask_blk, step_no):
+    def block_update(o, m, l, k_blk, v_blk, mask_blk, seg_blk, step_no):
         # the block held at ring step t originated on device (my_idx - t) mod n
         src = (my_idx - step_no) % n
         scores = (
             jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
         )
-        causal_mask = None
+        # visibility [B, s_q, s_k] (True = may attend); None = all visible
+        visible = None
         if causal:
             k_pos = src * s_loc + jnp.arange(s_loc)
-            causal_mask = q_pos[:, None] >= k_pos[None, :]  # [s_q, s_k]
-            scores = jnp.where(causal_mask[None, None], scores, _MASK_VALUE)
-        if mask_blk is not None:
-            scores = jnp.where(
-                mask_blk[:, None, None, :], scores, _MASK_VALUE
+            visible = jnp.broadcast_to(
+                (q_pos[:, None] >= k_pos[None, :])[None], (b, s_loc, s_loc)
             )
+        if mask_blk is not None:
+            pad = jnp.broadcast_to(mask_blk[:, None, :], (b, s_loc, s_loc))
+            visible = pad if visible is None else visible & pad
+        if seg_blk is not None:
+            same = q_seg[:, :, None] == seg_blk[:, None, :]
+            visible = same if visible is None else visible & same
+        if visible is not None:
+            scores = jnp.where(visible[:, None], scores, _MASK_VALUE)
         m_new = jnp.maximum(m, scores.max(axis=-1, keepdims=True))
         correction = jnp.exp(m - m_new)
         p = jnp.exp(scores - m_new)
-        if mask_blk is not None:
+        if visible is not None and (mask_blk is not None or seg_blk is not None):
             # exp(MASK - MASK) = 1 would leak masked slots into rows whose
             # running max is still _MASK_VALUE (no visible key yet); zero the
             # masked columns outright so l counts only real keys
-            p = p * mask_blk[:, None, None, :].astype(p.dtype)
-            if causal_mask is not None:
-                p = p * causal_mask[None, None].astype(p.dtype)
+            p = p * visible[:, None].astype(p.dtype)
         l = l * correction + p.sum(axis=-1, keepdims=True)
         o = o * correction + jnp.einsum(
             "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
@@ -163,30 +172,28 @@ def ring_attention(
     # step 0 attends to the locally-held block before any rotation; the scan
     # then does [rotate, attend] for steps 1..n-1 — so exactly n-1 rotations
     # happen and no ppermute's result is discarded
-    o, m, l = block_update(o0, m0, l0, k, v, kv_mask, 0)
+    o, m, l = block_update(o0, m0, l0, k, v, kv_mask, segment_ids, 0)
 
     def step(carry, step_no):
-        if kv_mask is not None:
-            o, m, l, k_blk, v_blk, mask_blk = carry
-            mask_blk = lax.ppermute(mask_blk, axis_name, _ring_perm(n))
-        else:
-            o, m, l, k_blk, v_blk = carry
-            mask_blk = None
+        o, m, l, k_blk, v_blk, mask_blk, seg_blk = carry
         k_blk = lax.ppermute(k_blk, axis_name, _ring_perm(n))
         v_blk = lax.ppermute(v_blk, axis_name, _ring_perm(n))
-        o, m, l = block_update(o, m, l, k_blk, v_blk, mask_blk, step_no)
-        if kv_mask is not None:
-            return (o, m, l, k_blk, v_blk, mask_blk), None
-        return (o, m, l, k_blk, v_blk), None
+        if mask_blk is not None:
+            mask_blk = lax.ppermute(mask_blk, axis_name, _ring_perm(n))
+        if seg_blk is not None:
+            seg_blk = lax.ppermute(seg_blk, axis_name, _ring_perm(n))
+        o, m, l = block_update(o, m, l, k_blk, v_blk, mask_blk, seg_blk, step_no)
+        return (o, m, l, k_blk, v_blk, mask_blk, seg_blk), None
 
     if n > 1:
-        carry = (
-            (o, m, l, k, v, kv_mask) if kv_mask is not None else (o, m, l, k, v)
-        )
+        # None carries are fine: their slots stay None through every iteration
+        # (scan treats None as an empty pytree)
+        carry = (o, m, l, k, v, kv_mask, segment_ids)
         carry, _ = lax.scan(step, carry, jnp.arange(1, n))
         o, _, l = carry[0], carry[1], carry[2]
     # rows with no visible key (all keys masked) have l == 0: the guard turns
     # their 0/0 into exact zeros, matching attention_reference's convention
+    # (and is a no-op on the unmasked path, where l >= exp(0) per real key)
     out = o / jnp.maximum(l, 1e-30)
     return jnp.transpose(out, (0, 2, 1, 3)).astype(orig_dtype)  # [B, S/n, H, D]
 
@@ -196,6 +203,7 @@ def make_ring_attention(
     *,
     causal: bool = False,
     masked: bool = False,
+    segmented: bool = False,
     batch_axis: Optional[str] = BATCH_AXIS,
     sequence_axis: str = SEQUENCE_AXIS,
 ):
@@ -203,31 +211,34 @@ def make_ring_attention(
     arrays (sharded batch over ``batch_axis``, sequence over ``sequence_axis``)
     and returns the global attention output with the same sharding.
 
-    ``masked=True`` returns ``fn(q, k, v, kv_mask)`` where ``kv_mask`` is a
-    GLOBAL [B, S] bool (True = real key), sharded like the sequence — the
-    variable-length-batch form."""
+    Extra per-token inputs (GLOBAL [B, S], sequence-sharded) are appended to
+    the signature in declaration order:
+      ``masked=True``    -> ``kv_mask`` (bool, True = real key; padding form)
+      ``segmented=True`` -> ``segment_ids`` (int; packed-document isolation)
+    e.g. both flags give ``fn(q, k, v, kv_mask, segment_ids)``."""
     spec = P(batch_axis, sequence_axis, None, None)
+    tok_spec = P(batch_axis, sequence_axis)
+    extra_specs = ([tok_spec] if masked else []) + ([tok_spec] if segmented else [])
 
-    if masked:
-        mask_spec = P(batch_axis, sequence_axis)
-
-        def fn_masked(q, k, v, kv_mask):
-            return ring_attention(
-                q, k, v, axis_name=sequence_axis, causal=causal, kv_mask=kv_mask
-            )
-
-        return jax.jit(
-            jax.shard_map(
-                fn_masked,
-                mesh=mesh,
-                in_specs=(spec, spec, spec, mask_spec),
-                out_specs=spec,
-            )
+    def fn(q, k, v, *extras):
+        it = iter(extras)
+        kv_mask = next(it) if masked else None
+        segment_ids = next(it) if segmented else None
+        return ring_attention(
+            q,
+            k,
+            v,
+            axis_name=sequence_axis,
+            causal=causal,
+            kv_mask=kv_mask,
+            segment_ids=segment_ids,
         )
 
-    def fn(q, k, v):
-        return ring_attention(q, k, v, axis_name=sequence_axis, causal=causal)
-
     return jax.jit(
-        jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, *extra_specs),
+            out_specs=spec,
+        )
     )

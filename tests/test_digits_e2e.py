@@ -19,8 +19,12 @@ def test_digits_trains_to_real_accuracy(tmp_path):
     short budget (a linear model scores ~95% on this corpus; the loose bar
     keeps the test robust to init noise while still proving the pipeline
     learns real structure from real data)."""
-    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
-    from tensorflowdistributedlearning_tpu.data.digits import prepare_digits
+    from tensorflowdistributedlearning_tpu.config import ModelConfig
+    from tensorflowdistributedlearning_tpu.data.digits import (
+        SHORT_BUDGET_BN_DECAY,
+        prepare_digits,
+        short_budget_train_config,
+    )
     from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
 
     data_dir = str(tmp_path / "data")
@@ -36,22 +40,11 @@ def test_digits_trains_to_real_accuracy(tmp_path):
         block_type="basic_block",
         width_multiplier=0.25,
         output_stride=None,
-        # eval runs on BN running stats: the 0.99 default needs ~500 steps to
-        # converge, lagging a short run's real accuracy — 0.9 tracks it
-        batch_norm_decay=0.9,
+        batch_norm_decay=SHORT_BUDGET_BN_DECAY,
     )
-    train_cfg = TrainConfig(
-        optimizer="adam",
-        lr=3e-3,
-        lr_schedule="cosine",
-        lr_decay_steps=250,
-        weight_decay=1e-4,
-        checkpoint_every_steps=250,
-        n_devices=1,
-        # digits are chirality-sensitive: mirrored digits are other glyphs (or
-        # garbage), so the default random flip destroys label signal
-        augmentation="crop",
-    )
+    # the SHARED recipe the example's committed DIGITS_RUN.json ran (the two
+    # once drifted apart — lr 1e-3 vs 3e-3 — costing 24 points of top-1)
+    train_cfg = short_budget_train_config(250, n_devices=1)
     trainer = ClassifierTrainer(
         str(tmp_path / "run"), data_dir, model_cfg, train_cfg
     )

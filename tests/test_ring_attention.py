@@ -157,3 +157,94 @@ def test_kv_mask_gradients_match(seq_mesh):
     g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_ref, g_ring):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=3e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_segment_ids_isolate_packed_documents(seq_mesh, causal):
+    """Packed multi-document batches: segment ids rotate with their K/V block
+    and a query attends only within its own document — exact vs the oracle."""
+    q, k, v = _qkv(21)
+    # documents of uneven length spanning ring-block boundaries
+    segment_ids = jnp.asarray(
+        np.concatenate(
+            [
+                np.repeat([0, 1, 2], [10, 14, 8]),  # example 0
+                np.repeat([0, 1], [5, 27]),  # example 1
+            ]
+        ).reshape(B, S)
+    )
+    ref = attention_reference(q, k, v, causal=causal, segment_ids=segment_ids)
+    out = make_ring_attention(seq_mesh, causal=causal, segmented=True)(
+        q, k, v, segment_ids
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    # cross-document isolation, verified independently of the ring: attending
+    # within document 1 of example 0 must equal attending over ONLY its slice
+    lo, hi = 10, 24
+    sliced = attention_reference(
+        q[:, lo:hi], k[:, lo:hi], v[:, lo:hi], causal=causal
+    )
+    np.testing.assert_allclose(
+        np.asarray(out)[0, lo:hi], np.asarray(sliced)[0], atol=2e-5
+    )
+
+
+def test_segment_ids_compose_with_kv_mask(seq_mesh):
+    """masked + segmented: padding inside a document is excluded, documents
+    stay isolated, fully-padded documents return zeros."""
+    q, k, v = _qkv(22)
+    rng = np.random.default_rng(9)
+    segment_ids = jnp.asarray(
+        np.repeat([[0, 1]], B, axis=0).repeat([16, 16], axis=1)
+    )
+    kv_mask = jnp.asarray(rng.uniform(size=(B, S)) > 0.25)
+    # example 1: document 0 entirely padding
+    kv_mask = kv_mask.at[1, :16].set(False)
+
+    ref = attention_reference(
+        q, k, v, causal=False, kv_mask=kv_mask, segment_ids=segment_ids
+    )
+    out = make_ring_attention(seq_mesh, masked=True, segmented=True)(
+        q, k, v, kv_mask, segment_ids
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # every query of the fully-padded document sees no visible key
+    assert np.all(np.asarray(out)[1, :16] == 0.0)
+
+
+def test_segment_ids_gradients_match(seq_mesh):
+    """Backward pass through the segment-mask path matches the oracle (the
+    PARITY 'differentiable end to end' claim, per mask kind)."""
+    q, k, v = _qkv(23)
+    segment_ids = jnp.asarray(
+        np.repeat([[0, 1, 2, 3]], B, axis=0).repeat([8, 8, 8, 8], axis=1)
+    )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            attention_reference(q, k, v, causal=True, segment_ids=segment_ids)
+            ** 2
+        )
+
+    spec = P(BATCH_AXIS, SEQUENCE_AXIS, None, None)
+
+    @jax.jit
+    def loss_ring(q, k, v):
+        def inner(q, k, v, seg):
+            out = ring_attention(q, k, v, causal=True, segment_ids=seg)
+            return jax.lax.psum(
+                jax.lax.psum(jnp.sum(out**2), SEQUENCE_AXIS), BATCH_AXIS
+            )
+
+        return jax.shard_map(
+            inner,
+            mesh=seq_mesh,
+            in_specs=(spec, spec, spec, P(BATCH_AXIS, SEQUENCE_AXIS)),
+            out_specs=P(),
+        )(q, k, v, segment_ids)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_ring):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=3e-4)
