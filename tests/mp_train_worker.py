@@ -47,12 +47,18 @@ def main() -> int:
     from tensorflowdistributedlearning_tpu.config import TrainConfig
 
     def run(strategy: str):
+        # init always uses the plain twin (the spatial model only applies
+        # inside shard_map); identical param trees let the values drop in
         raw_state = create_train_state(
             tiny_model(),
             step_lib.make_optimizer(TrainConfig(lr=0.01)),
             jax.random.PRNGKey(0),
             np.zeros((1, 8, 8, 3), np.float32),
         )
+        if strategy == "sp":
+            raw_state = raw_state.replace(
+                apply_fn=tiny_model(spatial=True).apply
+            )
         if strategy == "tp":
             # multi-host TENSOR parallelism: (batch=4, model=2) global mesh —
             # model-axis groups are intra-process (make_mesh requires it), the
@@ -64,6 +70,15 @@ def main() -> int:
             state = tp_lib.shard_state_tensor_parallel(raw_state, mesh)
             train_step = tp_lib.make_train_step_gspmd(
                 mesh, step_lib.ClassificationTask(), donate=False
+            )
+        elif strategy == "sp":
+            # multi-host SPATIAL parallelism: (batch=4, 1, sequence=2) global
+            # mesh — sequence groups intra-process, halo-exchange convs run
+            # over gloo collectives; images are additionally H-sharded
+            mesh = mesh_lib.make_mesh(None, sequence_parallel=2)
+            state = mesh_lib.replicate(raw_state, mesh)
+            train_step = step_lib.make_train_step(
+                mesh, step_lib.ClassificationTask(), donate=False, spatial=True
             )
         else:
             mesh = mesh_lib.make_mesh(None)  # all 8 global devices, pure DP
@@ -79,7 +94,9 @@ def main() -> int:
         batch = make_global_batch(global_batch)
         rows = multihost.process_local_rows(global_batch, mesh)
         local = {k: v[rows] for k, v in batch.items()}
-        sharded = multihost.global_shard_batch(local, mesh)
+        sharded = multihost.global_shard_batch(
+            local, mesh, spatial=(strategy == "sp")
+        )
 
         new_state, metrics = train_step(state, sharded)
         loss = step_lib.compute_metrics(jax.device_get(metrics))["loss"]
@@ -90,25 +107,55 @@ def main() -> int:
         )
 
     # "both" amortizes the expensive part (process spawn + jax.distributed
-    # init, ~15 s per 2-process pair) across the dp AND tp strategies —
-    # collectives run in the same jax.distributed session either way
-    for strategy in ("dp", "tp") if mode == "both" else (mode,):
+    # init, ~15 s per 2-process pair) across ALL strategies — collectives run
+    # in the same jax.distributed session either way
+    for strategy in ("dp", "tp", "sp") if mode == "both" else (mode,):
         run(strategy)
     return 0
 
 
-def tiny_model():
+def tiny_model(spatial: bool = False):
+    """Plain model, or its H-sharded twin with the IDENTICAL param tree
+    (layers share names and init fns, so the plain model's init values drop
+    straight into the spatial apply — the SpatialConv checkpoint contract).
+    The spatial twin can only APPLY inside shard_map (halo exchange needs the
+    bound sequence axis); init always uses the plain twin."""
     import flax.linen as nn
 
+    from tensorflowdistributedlearning_tpu.models.layers import (
+        SpatialConv,
+        conv_kernel_init,
+    )
+    from tensorflowdistributedlearning_tpu.parallel.mesh import SEQUENCE_AXIS
+    from tensorflowdistributedlearning_tpu.parallel.spatial import (
+        spatial_global_mean,
+    )
+
     class Tiny(nn.Module):
+        spatial: bool = False
+
         @nn.compact
         def __call__(self, x, train=False):
-            x = nn.Conv(8, (3, 3), padding="SAME")(x)
+            if self.spatial:
+                x = SpatialConv(
+                    8, kernel_size=3, axis_name=SEQUENCE_AXIS, name="conv"
+                )(x)
+            else:
+                x = nn.Conv(
+                    8,
+                    (3, 3),
+                    padding="SAME",
+                    kernel_init=conv_kernel_init,
+                    name="conv",
+                )(x)
             x = nn.relu(x)
-            x = x.mean(axis=(1, 2))
-            return nn.Dense(4)(x)
+            if self.spatial:
+                x = spatial_global_mean(x, axis_name=SEQUENCE_AXIS)
+            else:
+                x = x.mean(axis=(1, 2))
+            return nn.Dense(4, name="head")(x)
 
-    return Tiny()
+    return Tiny(spatial=spatial)
 
 
 def make_global_batch(n: int):

@@ -75,7 +75,7 @@ def _run_workers(mode: str):
 
 @pytest.fixture(scope="module")
 def worker_results():
-    """One 2-process spawn runs BOTH strategies (dp then tp) — the spawn +
+    """One 2-process spawn runs ALL strategies (dp, tp, sp) — the spawn +
     jax.distributed init dominates the test's cost, so it is paid once."""
     return _run_workers("both")
 
@@ -117,9 +117,11 @@ def test_matches_single_process_oracle(worker_results):
     assert loss0 == pytest.approx(oracle, rel=1e-6)
 
 
-def _oracle_loss():
+def _oracle_loss(spatial: bool = False):
     """Single-process 8-device loss on the identical seeded batch/model (no BN,
-    so the DP shard_map step and the GSPMD TP step agree to reassociation)."""
+    so the DP shard_map step, the GSPMD TP step, and the exactness-guaranteed
+    spatial step all agree to reassociation). One recipe serves every
+    strategy's oracle so they cannot diverge."""
     import jax
 
     from tensorflowdistributedlearning_tpu.config import TrainConfig
@@ -128,22 +130,21 @@ def _oracle_loss():
     from tensorflowdistributedlearning_tpu.train.state import create_train_state
     from tests.mp_train_worker import make_global_batch, tiny_model
 
-    mesh = mesh_lib.make_mesh(8)
-    state = mesh_lib.replicate(
-        create_train_state(
-            tiny_model(),
-            step_lib.make_optimizer(TrainConfig(lr=0.01)),
-            jax.random.PRNGKey(0),
-            np.zeros((1, 8, 8, 3), np.float32),
-        ),
-        mesh,
+    mesh = mesh_lib.make_mesh(8, sequence_parallel=2 if spatial else 1)
+    state = create_train_state(
+        tiny_model(),
+        step_lib.make_optimizer(TrainConfig(lr=0.01)),
+        jax.random.PRNGKey(0),
+        np.zeros((1, 8, 8, 3), np.float32),
     )
+    if spatial:
+        state = state.replace(apply_fn=tiny_model(spatial=True).apply)
+    state = mesh_lib.replicate(state, mesh)
     train_step = step_lib.make_train_step(
-        mesh, step_lib.ClassificationTask(), donate=False
+        mesh, step_lib.ClassificationTask(), donate=False, spatial=spatial
     )
-    _, metrics = train_step(
-        state, mesh_lib.shard_batch(make_global_batch(16), mesh)
-    )
+    shard = mesh_lib.shard_batch_spatial if spatial else mesh_lib.shard_batch
+    _, metrics = train_step(state, shard(make_global_batch(16), mesh))
     return step_lib.compute_metrics(jax.device_get(metrics))["loss"]
 
 
@@ -157,3 +158,15 @@ def test_tensor_parallel_across_processes(worker_results):
     assert step0 == step1 == 1
     assert loss0 == pytest.approx(loss1, abs=0.0)
     assert loss0 == pytest.approx(_oracle_loss(), rel=1e-5)
+
+
+def test_spatial_parallel_across_processes(worker_results):
+    """Multi-host SPATIAL parallelism with real processes: a (4, 1, 2) dp x sp
+    mesh — sequence groups intra-process, the BATCH axis spanning both
+    processes — running halo-exchange convs + sequence-pmean'd global pooling
+    over gloo. Ranks agree bitwise and match the single-process spatial
+    oracle."""
+    (loss0, step0), (loss1, step1) = (r["sp"] for r in worker_results)
+    assert step0 == step1 == 1
+    assert loss0 == pytest.approx(loss1, abs=0.0)
+    assert loss0 == pytest.approx(_oracle_loss(spatial=True), rel=1e-5)
