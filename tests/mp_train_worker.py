@@ -47,10 +47,11 @@ def main() -> int:
     from tensorflowdistributedlearning_tpu.config import TrainConfig
 
     def run(strategy: str):
-        # init always uses the plain twin (the spatial model only applies
-        # inside shard_map); identical param trees let the values drop in
+        # init always uses a twin that applies OUTSIDE shard_map (plain conv /
+        # dense MoE); identical param trees let the values drop into the
+        # collective twin, whose apply_fn is swapped in below
         raw_state = create_train_state(
-            tiny_model(),
+            tiny_model(moe=(strategy == "ep")),
             step_lib.make_optimizer(TrainConfig(lr=0.01)),
             jax.random.PRNGKey(0),
             np.zeros((1, 8, 8, 3), np.float32),
@@ -58,6 +59,10 @@ def main() -> int:
         if strategy == "sp":
             raw_state = raw_state.replace(
                 apply_fn=tiny_model(spatial=True).apply
+            )
+        elif strategy == "ep":
+            raw_state = raw_state.replace(
+                apply_fn=tiny_model(moe=True, ep=True).apply
             )
         if strategy == "tp":
             # multi-host TENSOR parallelism: (batch=4, model=2) global mesh —
@@ -79,6 +84,16 @@ def main() -> int:
             state = mesh_lib.replicate(raw_state, mesh)
             train_step = step_lib.make_train_step(
                 mesh, step_lib.ClassificationTask(), donate=False, spatial=True
+            )
+        elif strategy == "ep":
+            # multi-host EXPERT parallelism: (batch=4, model=2) global mesh —
+            # one expert per model shard (intra-process groups), the top-1
+            # all-to-all dispatch + load-balancing aux loss running with the
+            # batch axis spanning both processes
+            mesh = mesh_lib.make_mesh(None, model_parallel=2)
+            state = mesh_lib.replicate(raw_state, mesh)
+            train_step = step_lib.make_train_step(
+                mesh, step_lib.ClassificationTask(), donate=False
             )
         else:
             mesh = mesh_lib.make_mesh(None)  # all 8 global devices, pure DP
@@ -109,30 +124,39 @@ def main() -> int:
     # "both" amortizes the expensive part (process spawn + jax.distributed
     # init, ~15 s per 2-process pair) across ALL strategies — collectives run
     # in the same jax.distributed session either way
-    for strategy in ("dp", "tp", "sp") if mode == "both" else (mode,):
+    for strategy in ("dp", "tp", "sp", "ep") if mode == "both" else (mode,):
         run(strategy)
     return 0
 
 
-def tiny_model(spatial: bool = False):
-    """Plain model, or its H-sharded twin with the IDENTICAL param tree
-    (layers share names and init fns, so the plain model's init values drop
-    straight into the spatial apply — the SpatialConv checkpoint contract).
-    The spatial twin can only APPLY inside shard_map (halo exchange needs the
-    bound sequence axis); init always uses the plain twin."""
+def tiny_model(spatial: bool = False, moe: bool = False, ep: bool = False):
+    """Plain model, or a collective twin with the IDENTICAL param tree
+    (layers share names and init fns, so the simple twin's init values drop
+    straight into the sharded apply — the checkpoint-compatibility contract).
+
+    ``spatial``: SpatialConv + sequence-pmean'd pooling (apply only inside
+    shard_map — halo exchange binds the sequence axis).
+    ``moe``: the production Switch-style MoE layer (models/vit.py:MoEMlp, 2
+    experts) on the pooled features; ``ep=True`` runs its all-to-all
+    expert-parallel path over the model axis (apply only inside shard_map)."""
     import flax.linen as nn
 
     from tensorflowdistributedlearning_tpu.models.layers import (
         SpatialConv,
         conv_kernel_init,
     )
-    from tensorflowdistributedlearning_tpu.parallel.mesh import SEQUENCE_AXIS
+    from tensorflowdistributedlearning_tpu.parallel.mesh import (
+        MODEL_AXIS,
+        SEQUENCE_AXIS,
+    )
     from tensorflowdistributedlearning_tpu.parallel.spatial import (
         spatial_global_mean,
     )
 
     class Tiny(nn.Module):
         spatial: bool = False
+        moe: bool = False
+        ep: bool = False
 
         @nn.compact
         def __call__(self, x, train=False):
@@ -153,9 +177,19 @@ def tiny_model(spatial: bool = False):
                 x = spatial_global_mean(x, axis_name=SEQUENCE_AXIS)
             else:
                 x = x.mean(axis=(1, 2))
+            if self.moe:
+                from tensorflowdistributedlearning_tpu.models.vit import MoEMlp
+
+                x = MoEMlp(
+                    embed_dim=8,
+                    mlp_dim=8,
+                    n_experts=2,
+                    expert_axis_name=MODEL_AXIS if self.ep else None,
+                    name="moe",
+                )(x[:, None, :])[:, 0, :]
             return nn.Dense(4, name="head")(x)
 
-    return Tiny(spatial=spatial)
+    return Tiny(spatial=spatial, moe=moe, ep=ep)
 
 
 def make_global_batch(n: int):

@@ -75,7 +75,7 @@ def _run_workers(mode: str):
 
 @pytest.fixture(scope="module")
 def worker_results():
-    """One 2-process spawn runs ALL strategies (dp, tp, sp) — the spawn +
+    """One 2-process spawn runs ALL strategies (dp, tp, sp, ep) — the spawn +
     jax.distributed init dominates the test's cost, so it is paid once."""
     return _run_workers("both")
 
@@ -89,39 +89,17 @@ def test_ranks_agree(worker_results):
 def test_matches_single_process_oracle(worker_results):
     """The 2-process run must equal a 1-process 8-device run on the identical
     global batch (the MirroredStrategy invariance, generalized per host)."""
-    import jax
-
-    from tensorflowdistributedlearning_tpu.config import TrainConfig
-    from tensorflowdistributedlearning_tpu.parallel import mesh as mesh_lib
-    from tensorflowdistributedlearning_tpu.train import step as step_lib
-    from tensorflowdistributedlearning_tpu.train.state import create_train_state
-    from tests.mp_train_worker import make_global_batch, tiny_model
-
-    mesh = mesh_lib.make_mesh(8)
-    state = mesh_lib.replicate(
-        create_train_state(
-            tiny_model(),
-            step_lib.make_optimizer(TrainConfig(lr=0.01)),
-            jax.random.PRNGKey(0),
-            np.zeros((1, 8, 8, 3), np.float32),
-        ),
-        mesh,
-    )
-    batch = make_global_batch(16)
-    train_step = step_lib.make_train_step(
-        mesh, step_lib.ClassificationTask(), donate=False
-    )
-    _, metrics = train_step(state, mesh_lib.shard_batch(batch, mesh))
-    oracle = step_lib.compute_metrics(jax.device_get(metrics))["loss"]
     loss0, _ = worker_results[0]["dp"]
-    assert loss0 == pytest.approx(oracle, rel=1e-6)
+    assert loss0 == pytest.approx(_oracle_loss(), rel=1e-6)
 
 
-def _oracle_loss(spatial: bool = False):
+def _oracle_loss(spatial: bool = False, ep: bool = False):
     """Single-process 8-device loss on the identical seeded batch/model (no BN,
-    so the DP shard_map step, the GSPMD TP step, and the exactness-guaranteed
-    spatial step all agree to reassociation). One recipe serves every
-    strategy's oracle so they cannot diverge."""
+    so the DP shard_map step, the GSPMD TP step, the exactness-guaranteed
+    spatial step, and the all-to-all MoE step all agree to reassociation).
+    One recipe serves every strategy's oracle so they cannot diverge; the
+    oracle mesh matches the workers' dp degree so per-shard routing pools
+    (MoE capacity) are identical."""
     import jax
 
     from tensorflowdistributedlearning_tpu.config import TrainConfig
@@ -130,15 +108,21 @@ def _oracle_loss(spatial: bool = False):
     from tensorflowdistributedlearning_tpu.train.state import create_train_state
     from tests.mp_train_worker import make_global_batch, tiny_model
 
-    mesh = mesh_lib.make_mesh(8, sequence_parallel=2 if spatial else 1)
+    mesh = mesh_lib.make_mesh(
+        8,
+        sequence_parallel=2 if spatial else 1,
+        model_parallel=2 if ep else 1,
+    )
     state = create_train_state(
-        tiny_model(),
+        tiny_model(moe=ep),
         step_lib.make_optimizer(TrainConfig(lr=0.01)),
         jax.random.PRNGKey(0),
         np.zeros((1, 8, 8, 3), np.float32),
     )
     if spatial:
         state = state.replace(apply_fn=tiny_model(spatial=True).apply)
+    elif ep:
+        state = state.replace(apply_fn=tiny_model(moe=True, ep=True).apply)
     state = mesh_lib.replicate(state, mesh)
     train_step = step_lib.make_train_step(
         mesh, step_lib.ClassificationTask(), donate=False, spatial=spatial
@@ -170,3 +154,15 @@ def test_spatial_parallel_across_processes(worker_results):
     assert step0 == step1 == 1
     assert loss0 == pytest.approx(loss1, abs=0.0)
     assert loss0 == pytest.approx(_oracle_loss(spatial=True), rel=1e-5)
+
+
+def test_expert_parallel_across_processes(worker_results):
+    """Multi-host EXPERT parallelism with real processes: a (4, 2, 1) dp x ep
+    mesh — one expert per intra-process model shard, the batch axis spanning
+    both ranks — running the production MoE layer's top-1 all-to-all dispatch
+    + load-balancing aux loss over gloo. Ranks agree bitwise and match the
+    single-process oracle on the same dp degree (identical capacity pools)."""
+    (loss0, step0), (loss1, step1) = (r["ep"] for r in worker_results)
+    assert step0 == step1 == 1
+    assert loss0 == pytest.approx(loss1, abs=0.0)
+    assert loss0 == pytest.approx(_oracle_loss(ep=True), rel=1e-5)
