@@ -95,6 +95,32 @@ def main() -> int:
             train_step = step_lib.make_train_step(
                 mesh, step_lib.ClassificationTask(), donate=False
             )
+        elif strategy == "pp":
+            # multi-host PIPELINE parallelism: (batch=4, model=2) global mesh —
+            # a tiny ViT's 2 blocks run as 2 GPipe stages (intra-process
+            # model-axis groups), microbatches ticking over ppermute while the
+            # batch axis spans both processes
+            from tensorflowdistributedlearning_tpu.models import build_model
+            from tensorflowdistributedlearning_tpu.train import (
+                pipeline_step as pp_step,
+            )
+
+            cfg = tiny_vit_cfg()
+            raw_state = create_train_state(
+                build_model(cfg),
+                step_lib.make_optimizer(TrainConfig(lr=0.01)),
+                jax.random.PRNGKey(0),
+                np.zeros((1, 8, 8, 3), np.float32),
+            )
+            mesh = mesh_lib.make_mesh(None, model_parallel=2)
+            state = mesh_lib.replicate(raw_state, mesh)
+            train_step = pp_step.make_train_step_pipeline(
+                mesh,
+                step_lib.ClassificationTask(),
+                cfg,
+                microbatches=2,
+                donate=False,
+            )
         else:
             mesh = mesh_lib.make_mesh(None)  # all 8 global devices, pure DP
             state = mesh_lib.replicate(raw_state, mesh)
@@ -124,9 +150,28 @@ def main() -> int:
     # "both" amortizes the expensive part (process spawn + jax.distributed
     # init, ~15 s per 2-process pair) across ALL strategies — collectives run
     # in the same jax.distributed session either way
-    for strategy in ("dp", "tp", "sp", "ep") if mode == "both" else (mode,):
+    for strategy in (
+        ("dp", "tp", "sp", "ep", "pp") if mode == "both" else (mode,)
+    ):
         run(strategy)
     return 0
+
+
+def tiny_vit_cfg():
+    """Tiny ViT for the pipeline strategy: 2 blocks -> 2 GPipe stages."""
+    from tensorflowdistributedlearning_tpu.config import ModelConfig
+
+    return ModelConfig(
+        backbone="vit",
+        num_classes=4,
+        input_shape=(8, 8),
+        input_channels=3,
+        patch_size=4,
+        embed_dim=16,
+        vit_layers=2,
+        num_heads=2,
+        output_stride=None,
+    )
 
 
 def tiny_model(spatial: bool = False, moe: bool = False, ep: bool = False):

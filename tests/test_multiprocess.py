@@ -75,8 +75,9 @@ def _run_workers(mode: str):
 
 @pytest.fixture(scope="module")
 def worker_results():
-    """One 2-process spawn runs ALL strategies (dp, tp, sp, ep) — the spawn +
-    jax.distributed init dominates the test's cost, so it is paid once."""
+    """One 2-process spawn runs ALL strategies (dp, tp, sp, ep, pp) — the
+    spawn + jax.distributed init dominates the test's cost, so it is paid
+    once."""
     return _run_workers("both")
 
 
@@ -166,3 +167,44 @@ def test_expert_parallel_across_processes(worker_results):
     assert step0 == step1 == 1
     assert loss0 == pytest.approx(loss1, abs=0.0)
     assert loss0 == pytest.approx(_oracle_loss(ep=True), rel=1e-5)
+
+
+def test_pipeline_parallel_across_processes(worker_results):
+    """Multi-host PIPELINE parallelism with real processes: a (4, 2, 1) dp x pp
+    mesh — a tiny ViT's 2 blocks as 2 GPipe stages in intra-process model
+    groups, microbatches ticking stage-to-stage over ppermute while the batch
+    axis spans both ranks. Ranks agree bitwise and match the single-process
+    pipeline oracle."""
+    import jax
+
+    from tensorflowdistributedlearning_tpu.config import TrainConfig
+    from tensorflowdistributedlearning_tpu.models import build_model
+    from tensorflowdistributedlearning_tpu.parallel import mesh as mesh_lib
+    from tensorflowdistributedlearning_tpu.train import pipeline_step as pp_step
+    from tensorflowdistributedlearning_tpu.train import step as step_lib
+    from tensorflowdistributedlearning_tpu.train.state import create_train_state
+    from tests.mp_train_worker import make_global_batch, tiny_vit_cfg
+
+    (loss0, step0), (loss1, step1) = (r["pp"] for r in worker_results)
+    assert step0 == step1 == 1
+    assert loss0 == pytest.approx(loss1, abs=0.0)
+
+    cfg = tiny_vit_cfg()
+    mesh = mesh_lib.make_mesh(8, model_parallel=2)
+    state = mesh_lib.replicate(
+        create_train_state(
+            build_model(cfg),
+            step_lib.make_optimizer(TrainConfig(lr=0.01)),
+            jax.random.PRNGKey(0),
+            np.zeros((1, 8, 8, 3), np.float32),
+        ),
+        mesh,
+    )
+    train_step = pp_step.make_train_step_pipeline(
+        mesh, step_lib.ClassificationTask(), cfg, microbatches=2, donate=False
+    )
+    _, metrics = train_step(
+        state, mesh_lib.shard_batch(make_global_batch(16), mesh)
+    )
+    oracle = step_lib.compute_metrics(jax.device_get(metrics))["loss"]
+    assert loss0 == pytest.approx(oracle, rel=1e-5)
