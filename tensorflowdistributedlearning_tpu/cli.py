@@ -330,15 +330,42 @@ def cmd_doctor(args) -> int:
         report["ok"] = False
         report.setdefault("problems", []).append(msg)
 
-    import jax
+    # backend probe in a BOUNDED child: a down TPU tunnel makes jax.devices()
+    # hang indefinitely in-process (observed on this environment for hours),
+    # and a diagnosis tool that hangs on the most common failure is useless.
+    # The child inherits the environment, so it probes the same backend the
+    # training commands would use.
+    import subprocess
 
-    devices = jax.devices()
-    report["backend"] = {
-        "platform": jax.default_backend(),
-        "n_devices": len(devices),
-        "device_kind": devices[0].device_kind,
-        "process_count": jax.process_count(),
-    }
+    probe = (
+        "import jax, json; d = jax.devices(); "
+        "print(json.dumps({'platform': jax.default_backend(), "
+        "'n_devices': len(d), 'device_kind': d[0].device_kind, "
+        "'process_count': jax.process_count()}))"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", probe],
+            capture_output=True,
+            text=True,
+            timeout=90,
+        )
+        lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+        if out.returncode == 0 and lines:
+            report["backend"] = json.loads(lines[-1])
+        else:
+            problem(
+                "backend probe failed: "
+                + (out.stderr.strip().splitlines() or ["no output"])[-1][:200]
+            )
+            report["backend"] = {"error": "probe failed"}
+    except subprocess.TimeoutExpired:
+        problem(
+            "backend init timed out after 90s — on this environment that "
+            "means the TPU tunnel is down (jax.devices() hangs); retry "
+            "later or force JAX_PLATFORMS=cpu"
+        )
+        report["backend"] = {"error": "init timeout (tunnel down?)"}
 
     from tensorflowdistributedlearning_tpu.data.records import _records_lib
     from tensorflowdistributedlearning_tpu.native import loader
@@ -354,7 +381,7 @@ def cmd_doctor(args) -> int:
                 "but streams records/decodes images far slower (RECORDS_BENCH.json)"
             )
 
-    n = args.n_devices or len(devices)
+    n = args.n_devices or report["backend"].get("n_devices", 1)
     if args.batch_size is not None:
         batch: dict = {"global_batch": args.batch_size, "data_parallel": n}
         if args.batch_size % n:
@@ -391,9 +418,10 @@ def cmd_doctor(args) -> int:
                     info["records"] = rec.count_records(paths)
                 except ValueError as e:
                     problem(f"{split} shards corrupt: {e}")
-                if split == "train" and len(paths) < jax.process_count():
+                nproc = report["backend"].get("process_count", 1)
+                if split == "train" and len(paths) < nproc:
                     problem(
-                        f"{len(paths)} train shards < {jax.process_count()} "
+                        f"{len(paths)} train shards < {nproc} "
                         "processes — every process needs at least one"
                     )
                 data[split] = info

@@ -48,14 +48,17 @@ def main() -> int:
 
     def run(strategy: str):
         # init always uses a twin that applies OUTSIDE shard_map (plain conv /
-        # dense MoE); identical param trees let the values drop into the
-        # collective twin, whose apply_fn is swapped in below
-        raw_state = create_train_state(
-            tiny_model(moe=(strategy == "ep")),
-            step_lib.make_optimizer(TrainConfig(lr=0.01)),
-            jax.random.PRNGKey(0),
-            np.zeros((1, 8, 8, 3), np.float32),
-        )
+        # dense MoE / plain ViT); identical param trees let the values drop
+        # into the collective twin, whose apply_fn is swapped in below. The
+        # pp strategy builds its own (ViT) state in its branch.
+        raw_state = None
+        if strategy != "pp":
+            raw_state = create_train_state(
+                tiny_model(moe=(strategy == "ep")),
+                step_lib.make_optimizer(TrainConfig(lr=0.01)),
+                jax.random.PRNGKey(0),
+                np.zeros((1, 8, 8, 3), np.float32),
+            )
         if strategy == "sp":
             raw_state = raw_state.replace(
                 apply_fn=tiny_model(spatial=True).apply

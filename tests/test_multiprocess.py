@@ -94,7 +94,7 @@ def test_matches_single_process_oracle(worker_results):
     assert loss0 == pytest.approx(_oracle_loss(), rel=1e-6)
 
 
-def _oracle_loss(spatial: bool = False, ep: bool = False):
+def _oracle_loss(spatial: bool = False, ep: bool = False, pp: bool = False):
     """Single-process 8-device loss on the identical seeded batch/model (no BN,
     so the DP shard_map step, the GSPMD TP step, the exactness-guaranteed
     spatial step, and the all-to-all MoE step all agree to reassociation).
@@ -112,22 +112,41 @@ def _oracle_loss(spatial: bool = False, ep: bool = False):
     mesh = mesh_lib.make_mesh(
         8,
         sequence_parallel=2 if spatial else 1,
-        model_parallel=2 if ep else 1,
+        model_parallel=2 if (ep or pp) else 1,
     )
-    state = create_train_state(
-        tiny_model(moe=ep),
-        step_lib.make_optimizer(TrainConfig(lr=0.01)),
-        jax.random.PRNGKey(0),
-        np.zeros((1, 8, 8, 3), np.float32),
-    )
-    if spatial:
-        state = state.replace(apply_fn=tiny_model(spatial=True).apply)
-    elif ep:
-        state = state.replace(apply_fn=tiny_model(moe=True, ep=True).apply)
+    if pp:
+        from tensorflowdistributedlearning_tpu.models import build_model
+        from tensorflowdistributedlearning_tpu.train import (
+            pipeline_step as pp_step,
+        )
+        from tests.mp_train_worker import tiny_vit_cfg
+
+        cfg = tiny_vit_cfg()
+        state = create_train_state(
+            build_model(cfg),
+            step_lib.make_optimizer(TrainConfig(lr=0.01)),
+            jax.random.PRNGKey(0),
+            np.zeros((1, 8, 8, 3), np.float32),
+        )
+        train_step = pp_step.make_train_step_pipeline(
+            mesh, step_lib.ClassificationTask(), cfg, microbatches=2,
+            donate=False,
+        )
+    else:
+        state = create_train_state(
+            tiny_model(moe=ep),
+            step_lib.make_optimizer(TrainConfig(lr=0.01)),
+            jax.random.PRNGKey(0),
+            np.zeros((1, 8, 8, 3), np.float32),
+        )
+        if spatial:
+            state = state.replace(apply_fn=tiny_model(spatial=True).apply)
+        elif ep:
+            state = state.replace(apply_fn=tiny_model(moe=True, ep=True).apply)
+        train_step = step_lib.make_train_step(
+            mesh, step_lib.ClassificationTask(), donate=False, spatial=spatial
+        )
     state = mesh_lib.replicate(state, mesh)
-    train_step = step_lib.make_train_step(
-        mesh, step_lib.ClassificationTask(), donate=False, spatial=spatial
-    )
     shard = mesh_lib.shard_batch_spatial if spatial else mesh_lib.shard_batch
     _, metrics = train_step(state, shard(make_global_batch(16), mesh))
     return step_lib.compute_metrics(jax.device_get(metrics))["loss"]
@@ -175,36 +194,7 @@ def test_pipeline_parallel_across_processes(worker_results):
     groups, microbatches ticking stage-to-stage over ppermute while the batch
     axis spans both ranks. Ranks agree bitwise and match the single-process
     pipeline oracle."""
-    import jax
-
-    from tensorflowdistributedlearning_tpu.config import TrainConfig
-    from tensorflowdistributedlearning_tpu.models import build_model
-    from tensorflowdistributedlearning_tpu.parallel import mesh as mesh_lib
-    from tensorflowdistributedlearning_tpu.train import pipeline_step as pp_step
-    from tensorflowdistributedlearning_tpu.train import step as step_lib
-    from tensorflowdistributedlearning_tpu.train.state import create_train_state
-    from tests.mp_train_worker import make_global_batch, tiny_vit_cfg
-
     (loss0, step0), (loss1, step1) = (r["pp"] for r in worker_results)
     assert step0 == step1 == 1
     assert loss0 == pytest.approx(loss1, abs=0.0)
-
-    cfg = tiny_vit_cfg()
-    mesh = mesh_lib.make_mesh(8, model_parallel=2)
-    state = mesh_lib.replicate(
-        create_train_state(
-            build_model(cfg),
-            step_lib.make_optimizer(TrainConfig(lr=0.01)),
-            jax.random.PRNGKey(0),
-            np.zeros((1, 8, 8, 3), np.float32),
-        ),
-        mesh,
-    )
-    train_step = pp_step.make_train_step_pipeline(
-        mesh, step_lib.ClassificationTask(), cfg, microbatches=2, donate=False
-    )
-    _, metrics = train_step(
-        state, mesh_lib.shard_batch(make_global_batch(16), mesh)
-    )
-    oracle = step_lib.compute_metrics(jax.device_get(metrics))["loss"]
-    assert loss0 == pytest.approx(oracle, rel=1e-5)
+    assert loss0 == pytest.approx(_oracle_loss(pp=True), rel=1e-5)
