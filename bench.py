@@ -481,8 +481,19 @@ def _save_tpu_cache(result: dict) -> None:
         # run (tunnel cut mid-extras) must not clobber sections an earlier
         # window DID land (segmentation_flagship, reference_family_wide,
         # kernel microbenches...). Fresh keys win; missing keys survive.
+        now_unix = int(time.time())
+        now = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
+        # Stamp every fresh dict section with its own measurement time so
+        # sections carried over from an earlier window keep THEIR stamp and
+        # stale data is distinguishable from this run's.
+        for key, value in list(cached.items()):
+            if isinstance(value, dict) and "measured_at" not in value:
+                # stamped COPY: the caller's result dict (printed as the
+                # benchmark's own output) must not grow cache-only keys
+                cached[key] = {**value, "measured_at": now}
         prior = _load_tpu_cache()
         if prior:
+            prior_stamp = prior.get("measured_at")
             for key, value in prior.items():
                 if key not in cached or (
                     isinstance(value, dict)
@@ -490,11 +501,15 @@ def _save_tpu_cache(result: dict) -> None:
                     and "error" in cached[key]
                     and "error" not in value
                 ):
+                    if (
+                        isinstance(value, dict)
+                        and "measured_at" not in value
+                        and prior_stamp
+                    ):
+                        value = {**value, "measured_at": prior_stamp}
                     cached[key] = value
-        cached["measured_at_unix"] = int(time.time())
-        cached["measured_at"] = time.strftime(
-            "%Y-%m-%d %H:%M:%S UTC", time.gmtime()
-        )
+        cached["measured_at_unix"] = now_unix
+        cached["measured_at"] = now
         with open(TPU_CACHE_PATH, "w") as f:
             json.dump(cached, f, indent=1)
     except OSError:

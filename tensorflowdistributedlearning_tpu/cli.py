@@ -337,8 +337,18 @@ def cmd_doctor(args) -> int:
     # training commands would use.
     import subprocess
 
+    # Mirror utils/devices.apply_platform_env in the child: env vars alone are
+    # too late once the axon sitecustomize has pre-imported jax, so route
+    # JAX_PLATFORMS through jax.config before touching the backend. Without
+    # this the probe initializes the tunnel platform even under
+    # JAX_PLATFORMS=cpu and burns the full timeout.
     probe = (
-        "import jax, json; d = jax.devices(); "
+        "import os, jax, json\n"
+        "_p = os.environ.get('JAX_PLATFORMS')\n"
+        "if _p:\n"
+        "    try: jax.config.update('jax_platforms', _p)\n"
+        "    except Exception: pass\n"
+        "d = jax.devices(); "
         "print(json.dumps({'platform': jax.default_backend(), "
         "'n_devices': len(d), 'device_kind': d[0].device_kind, "
         "'process_count': jax.process_count()}))"
@@ -381,8 +391,17 @@ def cmd_doctor(args) -> int:
                 "but streams records/decodes images far slower (RECORDS_BENCH.json)"
             )
 
-    n = args.n_devices or report["backend"].get("n_devices", 1)
-    if args.batch_size is not None:
+    n = args.n_devices or report["backend"].get("n_devices")
+    if args.batch_size is not None and n is None:
+        # Backend probe failed and the user gave no --n-devices: validating
+        # divisibility against a guessed n=1 would bless batches the real
+        # device count rejects. Report the section as unchecked instead.
+        report["batch"] = {
+            "global_batch": args.batch_size,
+            "unchecked": "device count unknown (backend probe failed; "
+            "pass --n-devices to check divisibility)",
+        }
+    elif args.batch_size is not None:
         batch: dict = {"global_batch": args.batch_size, "data_parallel": n}
         if args.batch_size % n:
             problem(

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Probe the axon TPU tunnel every ~5 min; the moment jax.devices() answers,
+# run tools/window_sprint.py (the standing order: first window goes to the
+# pending hardware probes). Appends a status line per probe to the log so a
+# supervisor can see liveness; exits after window_sprint completes so the
+# driver can decide what the NEXT window is for.
+#
+# Usage: setsid nohup bash tools/tunnel_watcher.sh >> /tmp/tunnel_watcher.log 2>&1 &
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+while true; do
+  ts=$(date -u '+%Y-%m-%d %H:%M:%S')
+  if timeout 75 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; then
+    echo "[$ts] TUNNEL UP - launching window_sprint"
+    python tools/window_sprint.py
+    rc=$?
+    echo "[$(date -u '+%Y-%m-%d %H:%M:%S')] window_sprint finished rc=$rc"
+    exit 0
+  fi
+  echo "[$ts] tunnel down"
+  sleep 300
+done
