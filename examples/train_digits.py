@@ -41,6 +41,11 @@ def main() -> int:
     parser.add_argument("--eval-every", type=int, default=200)
     parser.add_argument("--json-out", default=None,
                         help="write the run record (metrics/config/wall time) here")
+    parser.add_argument("--recipe", choices=("adam", "sgd"), default="adam",
+                        help="adam = the validated short-budget recipe; sgd = "
+                        "the ImageNet production recipe (Nesterov + linear-"
+                        "scaled lr + warmup-cosine + wd + label smoothing) "
+                        "at digits scale")
     args = parser.parse_args()
 
     from tensorflowdistributedlearning_tpu.utils.devices import apply_platform_env
@@ -52,6 +57,7 @@ def main() -> int:
     from tensorflowdistributedlearning_tpu.data.digits import (
         SHORT_BUDGET_BN_DECAY,
         prepare_digits,
+        production_recipe_train_config,
         short_budget_train_config,
     )
     from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
@@ -72,9 +78,12 @@ def main() -> int:
         dtype="bfloat16",
         batch_norm_decay=SHORT_BUDGET_BN_DECAY,
     )
-    # the shared validated recipe (data/digits.py) — the e2e test asserts
+    # the shared validated recipes (data/digits.py) — the e2e test asserts
     # accuracy on exactly these settings
-    train_cfg = short_budget_train_config(args.steps)
+    if args.recipe == "sgd":
+        train_cfg = production_recipe_train_config(args.steps, args.batch_size)
+    else:
+        train_cfg = short_budget_train_config(args.steps)
     trainer = ClassifierTrainer(args.model_dir, data_dir, model_cfg, train_cfg)
     t0 = time.perf_counter()
     result = trainer.fit(
@@ -94,9 +103,12 @@ def main() -> int:
                          "width_multiplier": model_cfg.width_multiplier,
                          "input_shape": list(model_cfg.input_shape),
                          "dtype": model_cfg.dtype},
-        "train_config": {"optimizer": train_cfg.optimizer, "lr": train_cfg.lr,
+        "train_config": {"recipe": args.recipe,
+                         "optimizer": train_cfg.optimizer, "lr": train_cfg.lr,
                          "lr_schedule": train_cfg.lr_schedule,
-                         "weight_decay": train_cfg.weight_decay},
+                         "lr_warmup_steps": train_cfg.lr_warmup_steps,
+                         "weight_decay": train_cfg.weight_decay,
+                         "label_smoothing": train_cfg.label_smoothing},
     }
     print(json.dumps(record))
     if args.json_out:

@@ -477,7 +477,20 @@ def _make_train_step_cached(
     accum: int = 1,
 ):
     def step(state: TrainState, batch: Dict[str, jax.Array]):
-        def grads_of(batch_stats, chunk):
+        # Deterministic per-(step, batch-shard) dropout stream for the models
+        # that have a stochastic layer (Xception41's pre-logits dropout — the
+        # reference declared keep_prob but never used it; here it is live, so
+        # train-mode apply needs a PRNG). Folding in the batch index gives each
+        # tower its own masks; the sequence/spatial axis is deliberately NOT
+        # folded in — spatially-sharded towers compute the same replicated
+        # post-pool activations and must agree on one mask. Models without
+        # dropout simply never draw from the stream.
+        dropout_rng = jax.random.fold_in(
+            jax.random.fold_in(jax.random.key(0), state.step),
+            jax.lax.axis_index(BATCH_AXIS),
+        )
+
+        def grads_of(batch_stats, chunk, chunk_idx):
             """value_and_grad of one microbatch against the CURRENT params,
             threading BN state in (not closed over) so scan can carry it."""
 
@@ -487,6 +500,7 @@ def _make_train_step_cached(
                     chunk["images"],
                     train=True,
                     mutable=["batch_stats", "aux_loss"],
+                    rngs={"dropout": jax.random.fold_in(dropout_rng, chunk_idx)},
                 )
                 loss = task.loss(outputs, chunk)
                 # auxiliary losses sown by the model (MoE load balancing,
@@ -504,7 +518,7 @@ def _make_train_step_cached(
 
         if accum == 1:
             (loss, (outputs, new_batch_stats)), grads = grads_of(
-                state.batch_stats, batch
+                state.batch_stats, batch, 0
             )
             metrics = _metric_deltas(task.metric_scores(outputs, batch), loss)
         else:
@@ -529,9 +543,12 @@ def _make_train_step_cached(
                     return jax.lax.pcast(x, axes, to="varying")
                 return jax.lax.pvary(x, axes)  # pragma: no cover - older jax
 
-            def body(carry, chunk):
+            def body(carry, chunk_with_idx):
+                chunk, chunk_idx = chunk_with_idx
                 stats, grads_acc = carry
-                (loss, (outputs, new_stats)), grads = grads_of(stats, chunk)
+                (loss, (outputs, new_stats)), grads = grads_of(
+                    stats, chunk, chunk_idx
+                )
                 grads_acc = jax.tree.map(
                     lambda a, g: a + g / accum, grads_acc, grads
                 )
@@ -548,7 +565,9 @@ def _make_train_step_cached(
                 # unvarying — the accumulator stays unvarying to match
                 jax.tree.map(jnp.zeros_like, state.params),
             )
-            (new_batch_stats, grads), stacked = jax.lax.scan(body, init, chunks)
+            (new_batch_stats, grads), stacked = jax.lax.scan(
+                body, init, (chunks, jnp.arange(accum))
+            )
             # stacked Mean states carry a leading [accum] dim on total/count;
             # summing merges the streams (Mean.merge is addition)
             metrics = jax.tree.map(lambda x: jnp.sum(x, axis=0), stacked)

@@ -55,6 +55,42 @@ def test_digits_trains_to_real_accuracy(tmp_path):
     assert result.steps == 250
 
 
+def test_digits_production_recipe_trains_to_real_accuracy(tmp_path):
+    """The ImageNet PRODUCTION recipe (SGD Nesterov + linear-scaled lr +
+    warmup-cosine + kernels-only wd + label smoothing — the knobs behind the
+    resnet50_imagenet preset) learns real data: >=80% held-out top-1 at the
+    same tiny budget as the adam test above (the committed full-budget run is
+    DIGITS_RUN.json's 'sgd' entry: 93.9% at 600 steps). Loose bar — SGD
+    converges slower than adam at short budgets; the assertion is that the
+    recipe HELPS on real data, not that it matches adam here."""
+    from tensorflowdistributedlearning_tpu.config import ModelConfig
+    from tensorflowdistributedlearning_tpu.data.digits import (
+        SHORT_BUDGET_BN_DECAY,
+        prepare_digits,
+        production_recipe_train_config,
+    )
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    data_dir = str(tmp_path / "data")
+    prepare_digits(data_dir, upscale=2, val_fraction=0.2, seed=0, shards=2)
+    model_cfg = ModelConfig(
+        num_classes=10,
+        input_shape=(16, 16),
+        input_channels=1,
+        n_blocks=(1, 1, 1),
+        block_type="basic_block",
+        width_multiplier=0.25,
+        output_stride=None,
+        batch_norm_decay=SHORT_BUDGET_BN_DECAY,
+    )
+    train_cfg = production_recipe_train_config(250, 64, n_devices=1)
+    trainer = ClassifierTrainer(
+        str(tmp_path / "run_sgd"), data_dir, model_cfg, train_cfg
+    )
+    result = trainer.fit(batch_size=64, steps=250, eval_every_steps=250)
+    assert result.final_metrics["metrics/top1"] >= 0.80, result.final_metrics
+
+
 def test_train_digits_driver_help():
     """The example driver exists and its CLI parses (full runs are covered by
     the in-process test above; the driver itself is exercised in-session)."""
