@@ -395,3 +395,178 @@ class Xception41(nn.Module):
         return nn.Dense(cfg.num_classes, kernel_init=conv_kernel_init, name="logits")(
             pooled
         )
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-parallel decomposition of the Xception-41 CLASSIFIER.
+#
+# The middle flow — 8 identical 728-wide sum-skip units, the documented
+# homogeneous-stage case of the GPipe runner (parallel/pipeline.py) — pipelines
+# over the model mesh axis; the entry flow (root + 3 conv-skip blocks) and the
+# exit flow + head run replicated on every stage, mirroring how the ViT
+# pipeline replicates embed/head (train/pipeline_step.py). The wrapper modules
+# below reuse the SAME submodule classes under the SAME names as
+# XceptionBackbone, so a canonical Xception41 param/batch-stats tree slices
+# directly into them: checkpoints, serving export, and eval stay
+# interchangeable with every other execution strategy.
+# ---------------------------------------------------------------------------
+
+MIDDLE_FLOW_UNITS = 8
+MIDDLE_FLOW_PREFIX = "middle_block1_unit"
+
+
+def _common_bn_kwargs(cfg: ModelConfig, dtype) -> dict:
+    return dict(
+        bn_decay=cfg.batch_norm_decay,
+        bn_epsilon=cfg.batch_norm_epsilon,
+        bn_scale=cfg.batch_norm_scale,
+        bn_axis_name=None,
+        spatial_axis_name=None,
+        dtype=dtype,
+    )
+
+
+class XceptionEntryFlow(nn.Module):
+    """Root convs + entry blocks 1-3 of the classifier layout (output_stride
+    None), submodule names matching ``XceptionBackbone`` so the canonical
+    ``params['backbone']`` subtree applies directly."""
+
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        cfg = self.config
+        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        common = _common_bn_kwargs(cfg, dtype)
+        wm = cfg.width_multiplier
+        x = x.astype(dtype)
+        x = ConvBN(
+            scaled_width(32, wm),
+            3,
+            stride=2,
+            space_to_depth=cfg.stem_space_to_depth,
+            name="conv1_1",
+            **common,
+        )(x, train)
+        x = ConvBN(scaled_width(64, wm), 3, name="conv1_2", **common)(x, train)
+        for blk in xception_41_block_specs((1, 1, 1), wm)[:3]:
+            for i, unit in enumerate(blk.units):
+                x = XceptionUnit(
+                    spec=unit, rate=1, name=f"{blk.name}_unit{i + 1}", **common
+                )(x, train)
+        return x
+
+
+class XceptionExitHead(nn.Module):
+    """Exit blocks 1-2 + global pool + dropout + logits dense, names matching
+    the canonical tree (units from ``XceptionBackbone``, head from
+    ``Xception41``); apply with the union of the backbone's exit-unit subtrees
+    and the top-level ``logits`` params."""
+
+    config: ModelConfig
+    keep_prob: float = 0.5
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        cfg = self.config
+        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        common = _common_bn_kwargs(cfg, dtype)
+        for blk in xception_41_block_specs((1, 1, 1), cfg.width_multiplier)[4:]:
+            for i, unit in enumerate(blk.units):
+                x = XceptionUnit(
+                    spec=unit, rate=1, name=f"{blk.name}_unit{i + 1}", **common
+                )(x, train)
+        pooled = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+        pooled = nn.Dropout(rate=1.0 - self.keep_prob, deterministic=not train)(
+            pooled
+        )
+        return nn.Dense(
+            cfg.num_classes, kernel_init=conv_kernel_init, name="logits"
+        )(pooled)
+
+
+def middle_unit_module(config: ModelConfig) -> XceptionUnit:
+    """One 728-wide sum-skip middle-flow unit (classifier layout: stride 1,
+    rate 1) — identical computation and param shapes for all 8 units, the
+    pipeline runner's homogeneous-stage requirement."""
+    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    wm = config.width_multiplier
+    spec = XceptionUnitSpec(
+        depth_list=tuple(scaled_width(d, wm) for d in (728, 728, 728)),
+        skip_connection_type="sum",
+        stride=1,
+        unit_rate_list=(1, 1, 1),
+        activation_inside=False,
+    )
+    return XceptionUnit(spec=spec, rate=1, **_common_bn_kwargs(config, dtype))
+
+
+def stack_middle_unit_tree(backbone_tree, n_stages: int):
+    """Stack the 8 middle-unit subtrees (params OR batch_stats — any tree
+    keyed ``middle_block1_unit{1..8}``) into the grouped [K, 8/K, ...] form the
+    pipeline shards over the model axis."""
+    if MIDDLE_FLOW_UNITS % n_stages:
+        raise ValueError(
+            f"{MIDDLE_FLOW_UNITS} middle-flow units not divisible into "
+            f"{n_stages} pipeline stages"
+        )
+    units = [
+        backbone_tree[f"{MIDDLE_FLOW_PREFIX}{i + 1}"]
+        for i in range(MIDDLE_FLOW_UNITS)
+    ]
+    stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *units)
+    group = MIDDLE_FLOW_UNITS // n_stages
+    return jax.tree.map(
+        lambda l: l.reshape((n_stages, group) + l.shape[1:]), stacked
+    )
+
+
+def unstack_middle_unit_tree(stacked_tree) -> dict:
+    """Reverse ``stack_middle_unit_tree``: [K, G, ...] -> the canonical
+    ``{middle_block1_unit{n}: subtree}`` dict."""
+    flat = jax.tree.map(
+        lambda l: l.reshape((MIDDLE_FLOW_UNITS,) + l.shape[2:]), stacked_tree
+    )
+    return {
+        f"{MIDDLE_FLOW_PREFIX}{i + 1}": jax.tree.map(lambda l, i=i: l[i], flat)
+        for i in range(MIDDLE_FLOW_UNITS)
+    }
+
+
+def grouped_middle_stage_fn(config: ModelConfig, units_per_stage: int, train: bool):
+    """Stage function over the grouped stacking: applies this stage's
+    ``units_per_stage`` consecutive middle units in sequence.
+
+    Train form (for ``pipeline_apply_aux``): ``stage_fn((params_g, stats_g), x)
+    -> (y, new_stats_g)`` — BN normalizes with the current microbatch's
+    statistics (per-microbatch BN, the standard GPipe regime; exact parity with
+    the plain step when microbatches share statistics) and emits the
+    per-microbatch running-stat update for the runner to average.
+    Eval form (for plain ``pipeline_apply``): same bundled params, running
+    stats, no mutation."""
+    module = middle_unit_module(config)
+
+    def train_stage_fn(bundle, x):
+        params_g, stats_g = bundle
+        new_stats = []
+        for i in range(units_per_stage):
+            p = jax.tree.map(lambda l, i=i: l[i], params_g)
+            s = jax.tree.map(lambda l, i=i: l[i], stats_g)
+            x, mutated = module.apply(
+                {"params": p, "batch_stats": s},
+                x,
+                True,
+                mutable=["batch_stats"],
+            )
+            new_stats.append(mutated["batch_stats"])
+        return x, jax.tree.map(lambda *ls: jnp.stack(ls), *new_stats)
+
+    def eval_stage_fn(bundle, x):
+        params_g, stats_g = bundle
+        for i in range(units_per_stage):
+            p = jax.tree.map(lambda l, i=i: l[i], params_g)
+            s = jax.tree.map(lambda l, i=i: l[i], stats_g)
+            x = module.apply({"params": p, "batch_stats": s}, x, False)
+        return x
+
+    return train_stage_fn if train else eval_stage_fn

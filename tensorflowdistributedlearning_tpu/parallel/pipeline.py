@@ -49,44 +49,73 @@ def pipeline_apply(
 
     Stage ``k`` processes microbatch ``m`` at tick ``t = m + k``; activations
     move to stage ``k+1`` via a neighbor ``ppermute`` each tick.
+
+    Delegates to ``pipeline_apply_aux`` (the one copy of the fill/drain
+    schedule) with an empty aux stream.
+    """
+    out, _ = pipeline_apply_aux(
+        lambda p, x: (stage_fn(p, x), ()),
+        my_stage_params,
+        x_microbatches,
+        axis_name=axis_name,
+    )
+    return out
+
+
+def pipeline_apply_aux(
+    stage_fn: Callable[[Any, jax.Array], tuple],
+    my_stage_params: Any,
+    x_microbatches: jax.Array,
+    *,
+    axis_name: str = MODEL_AXIS,
+) -> tuple:
+    """``pipeline_apply`` for stages that also EMIT per-tick auxiliary state:
+    ``stage_fn(params, x) -> (y, aux)``. Returns ``(out, aux_mean)`` where
+    ``aux_mean`` averages this stage's aux over its M REAL microbatch ticks —
+    stage ``k`` processes real work at ticks ``k .. k+M-1``; fill/drain ticks
+    (whose input is the zero padding or a neighbor's garbage) are excluded.
+
+    Built for BatchNorm-bearing pipeline stages (Xception's middle flow): the
+    aux is the per-microbatch updated running stats, and because flax's update
+    is affine in the batch statistic (``new = m*old + (1-m)*mu_i``), the MEAN
+    of per-microbatch updates equals ONE update with the microbatch-averaged
+    statistic — the same single-update-per-step bookkeeping as the plain step.
     """
     k_stages = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     m_micro = x_microbatches.shape[0]
     ticks = m_micro + k_stages - 1
 
-    # pad the injection stream to the tick count (zeros feed the drain phase)
     pad = jnp.zeros((k_stages - 1,) + x_microbatches.shape[1:], x_microbatches.dtype)
     inject = jnp.concatenate([x_microbatches, pad], axis=0)
-
     perm = [(i, i + 1) for i in range(k_stages - 1)]
 
     def tick(buf, x_t):
-        # stage 0 reads from the injection stream; every other stage reads the
-        # activation its predecessor sent last tick
         inp = jnp.where(idx == 0, x_t, buf)
-        y = stage_fn(my_stage_params, inp)
+        y, aux = stage_fn(my_stage_params, inp)
         buf_next = lax.ppermute(y, axis_name, perm)
-        return buf_next, y
+        return buf_next, (y, aux)
 
-    # the carry is device-varying (each shard holds a different activation);
-    # mark the zero init as varying so scan's carry types line up. lax.pcast
-    # replaced the deprecated lax.pvary; support both across jax versions.
     zero = jnp.zeros_like(x_microbatches[0])
     if hasattr(lax, "pcast"):
         buf0 = lax.pcast(zero, axis_name, to="varying")
     else:  # pragma: no cover - older jax
         buf0 = lax.pvary(zero, (axis_name,))
-    _, ys = lax.scan(tick, buf0, inject[:ticks])
+    _, (ys, auxs) = lax.scan(tick, buf0, inject[:ticks])
 
-    # the last stage's outputs at ticks K-1 .. T-1 are the results, in
-    # microbatch order; psum-masked broadcast replicates them across the axis
-    # (numerically a copy — only one shard contributes each slot)
     tail = lax.dynamic_slice_in_dim(ys, k_stages - 1, m_micro, axis=0)
     out = lax.psum(
         jnp.where(idx == k_stages - 1, tail, jnp.zeros_like(tail)), axis_name
     )
-    return out
+    # this stage's real ticks: a device-varying dynamic slice (each shard
+    # starts at its own stage index), then the microbatch mean
+    aux_mean = jax.tree.map(
+        lambda a: jnp.mean(
+            lax.dynamic_slice_in_dim(a, idx, m_micro, axis=0), axis=0
+        ),
+        auxs,
+    )
+    return out, aux_mean
 
 
 def stack_stage_params(param_trees) -> Any:
