@@ -437,8 +437,13 @@ def cmd_doctor(args) -> int:
                     info["records"] = rec.count_records(paths)
                 except ValueError as e:
                     problem(f"{split} shards corrupt: {e}")
-                nproc = report["backend"].get("process_count", 1)
-                if split == "train" and len(paths) < nproc:
+                # like the batch check: when the probe failed the process
+                # count is UNKNOWN — guessing 1 would bless a layout a real
+                # multi-process run rejects; mark unchecked instead
+                nproc = report["backend"].get("process_count")
+                if split == "train" and nproc is None:
+                    info["shards_per_process"] = "unchecked (backend probe failed)"
+                elif split == "train" and len(paths) < nproc:
                     problem(
                         f"{len(paths)} train shards < {nproc} "
                         "processes — every process needs at least one"

@@ -54,7 +54,7 @@ def main() -> int:
         raw_state = None
         if strategy != "pp":
             raw_state = create_train_state(
-                tiny_model(moe=(strategy == "ep")),
+                tiny_model(moe=(strategy in ("ep", "3ax"))),
                 step_lib.make_optimizer(TrainConfig(lr=0.01)),
                 jax.random.PRNGKey(0),
                 np.zeros((1, 8, 8, 3), np.float32),
@@ -66,6 +66,10 @@ def main() -> int:
         elif strategy == "ep":
             raw_state = raw_state.replace(
                 apply_fn=tiny_model(moe=True, ep=True).apply
+            )
+        elif strategy == "3ax":
+            raw_state = raw_state.replace(
+                apply_fn=tiny_model(spatial=True, moe=True, ep=True).apply
             )
         if strategy == "tp":
             # multi-host TENSOR parallelism: (batch=4, model=2) global mesh —
@@ -97,6 +101,20 @@ def main() -> int:
             state = mesh_lib.replicate(raw_state, mesh)
             train_step = step_lib.make_train_step(
                 mesh, step_lib.ClassificationTask(), donate=False
+            )
+        elif strategy == "3ax":
+            # THREE-axis composition dp x ep x sp: the full (batch=2, model=2,
+            # sequence=2) global mesh across both processes — halo-exchange
+            # convs over the sequence axis, MoE all-to-all over the model
+            # axis, gradient mean over the batch axis, all in ONE shard_map
+            # step (real pods run 3-axis layouts; pairwise proofs alone don't
+            # cover the interaction)
+            mesh = mesh_lib.make_mesh(
+                None, model_parallel=2, sequence_parallel=2
+            )
+            state = mesh_lib.replicate(raw_state, mesh)
+            train_step = step_lib.make_train_step(
+                mesh, step_lib.ClassificationTask(), donate=False, spatial=True
             )
         elif strategy == "pp":
             # multi-host PIPELINE parallelism: (batch=4, model=2) global mesh —
@@ -139,7 +157,7 @@ def main() -> int:
         rows = multihost.process_local_rows(global_batch, mesh)
         local = {k: v[rows] for k, v in batch.items()}
         sharded = multihost.global_shard_batch(
-            local, mesh, spatial=(strategy == "sp")
+            local, mesh, spatial=(strategy in ("sp", "3ax"))
         )
 
         new_state, metrics = train_step(state, sharded)
@@ -154,7 +172,7 @@ def main() -> int:
     # init, ~15 s per 2-process pair) across ALL strategies — collectives run
     # in the same jax.distributed session either way
     for strategy in (
-        ("dp", "tp", "sp", "ep", "pp") if mode == "both" else (mode,)
+        ("dp", "tp", "sp", "ep", "pp", "3ax") if mode == "both" else (mode,)
     ):
         run(strategy)
     return 0

@@ -1,0 +1,39 @@
+"""The partitioned suite runner's grouping logic (tools/run_suite.py) — the
+structural containment for the XLA:CPU cumulative-compile segfault must cover
+every test module exactly once and keep heavy modules spread across groups."""
+
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from run_suite import HEAVY, partition  # noqa: E402
+
+
+def test_partition_covers_all_files_exactly_once():
+    files = sorted(
+        glob.glob(os.path.join(os.path.dirname(__file__), "test_*.py"))
+    )
+    groups = partition(files, 4)
+    flat = [f for g in groups for f in g]
+    assert sorted(flat) == files
+    assert len(groups) <= 4
+
+
+def test_partition_spreads_heavy_modules():
+    files = [f"tests/{name}" for name in HEAVY] + [
+        f"tests/test_light_{i}.py" for i in range(6)
+    ]
+    groups = partition(files, 4)
+    # no group holds more than ceil(len(HEAVY)/4) heavy modules
+    for g in groups:
+        heavy_in_g = [f for f in g if os.path.basename(f) in HEAVY]
+        assert len(heavy_in_g) <= 2
+
+
+def test_heavy_list_names_real_modules():
+    here = os.path.dirname(__file__)
+    for name in HEAVY:
+        assert os.path.exists(os.path.join(here, name)), name
