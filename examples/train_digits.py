@@ -41,11 +41,14 @@ def main() -> int:
     parser.add_argument("--eval-every", type=int, default=200)
     parser.add_argument("--json-out", default=None,
                         help="write the run record (metrics/config/wall time) here")
-    parser.add_argument("--recipe", choices=("adam", "sgd"), default="adam",
+    parser.add_argument("--recipe", choices=("adam", "sgd", "lars"),
+                        default="adam",
                         help="adam = the validated short-budget recipe; sgd = "
                         "the ImageNet production recipe (Nesterov + linear-"
                         "scaled lr + warmup-cosine + wd + label smoothing) "
-                        "at digits scale")
+                        "at digits scale; lars = the large-batch 8k-preset "
+                        "recipe (layer-wise trust ratios), pair with a large "
+                        "--batch-size")
     args = parser.parse_args()
 
     from tensorflowdistributedlearning_tpu.utils.devices import apply_platform_env
@@ -56,6 +59,7 @@ def main() -> int:
     from tensorflowdistributedlearning_tpu.config import ModelConfig
     from tensorflowdistributedlearning_tpu.data.digits import (
         SHORT_BUDGET_BN_DECAY,
+        large_batch_recipe_train_config,
         prepare_digits,
         production_recipe_train_config,
         short_budget_train_config,
@@ -82,6 +86,8 @@ def main() -> int:
     # accuracy on exactly these settings
     if args.recipe == "sgd":
         train_cfg = production_recipe_train_config(args.steps, args.batch_size)
+    elif args.recipe == "lars":
+        train_cfg = large_batch_recipe_train_config(args.steps, args.batch_size)
     else:
         train_cfg = short_budget_train_config(args.steps)
     trainer = ClassifierTrainer(args.model_dir, data_dir, model_cfg, train_cfg)

@@ -23,6 +23,7 @@ import glob
 import json
 import os
 import re
+import shlex
 import subprocess
 import sys
 import time
@@ -60,9 +61,17 @@ def main() -> int:
     parser.add_argument("--json-out", default=None)
     parser.add_argument("--pytest-args", default="-q",
                         help="extra args passed to each pytest child")
+    parser.add_argument("--group-timeout", type=int, default=1500,
+                        help="seconds per pytest child before it is killed "
+                        "and recorded as a timeout (a hung group must not "
+                        "wedge the runner)")
     args = parser.parse_args()
 
     files = sorted(glob.glob(os.path.join(REPO, "tests", "test_*.py")))
+    if not files:
+        print("no tests/test_*.py found — refusing to report a vacuous pass",
+              file=sys.stderr)
+        return 2
     env = dict(os.environ)
     # strip the axon sitecustomize: when the TPU tunnel is down it SIGTERMs
     # long-lived python processes on this box (driver-box memory); pytest
@@ -76,13 +85,27 @@ def main() -> int:
         names = [os.path.basename(f) for f in group]
         print(f"=== group {i + 1}: {' '.join(names)}", flush=True)
         t0 = time.time()
-        proc = subprocess.run(
-            [sys.executable, "-m", "pytest", *group, *args.pytest_args.split()],
-            cwd=REPO,
-            env=env,
-            capture_output=True,
-            text=True,
-        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "pytest", *group,
+                 *shlex.split(args.pytest_args)],
+                cwd=REPO,
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=args.group_timeout,
+            )
+        except subprocess.TimeoutExpired as e:
+            secs = round(time.time() - t0, 1)
+            record["ok"] = False
+            tail = ((e.stdout or b"").decode(errors="replace")
+                    if isinstance(e.stdout, bytes) else (e.stdout or ""))[-2000:]
+            print(f"    TIMEOUT after {secs}s; partial output:\n{tail}",
+                  flush=True)
+            record["groups"].append(
+                {"files": names, "timeout": args.group_timeout, "secs": secs}
+            )
+            continue
         secs = round(time.time() - t0, 1)
         tail = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
         summary = re.search(r"(\d+ (?:passed|failed)[^\n]*)", tail)
