@@ -55,6 +55,31 @@ def test_digits_trains_to_real_accuracy(tmp_path):
     assert result.steps == 250
 
 
+def test_large_batch_recipe_config_contract():
+    """The LARS recipe's measured operating point (lr 0.8 @ batch 256, 10%
+    warmup — behind DIGITS_RUN.json's committed 97.2%/150-step run and the
+    README claim) must stay reproducible: assert the constructed config's
+    fields rather than retrain (a full LARS run is ~8 min on the 1-core CI
+    box; the field contract is free)."""
+    from tensorflowdistributedlearning_tpu.data.digits import (
+        large_batch_recipe_train_config,
+    )
+
+    cfg = large_batch_recipe_train_config(150, 256)
+    assert cfg.optimizer == "lars"
+    assert cfg.lr == pytest.approx(0.8)
+    assert cfg.lr_warmup_steps == 15
+    assert cfg.lr_schedule == "cosine"
+    assert cfg.lr_decay_steps == 150
+    assert cfg.weight_decay == 1e-4
+    assert cfg.label_smoothing == 0.1
+    assert cfg.augmentation == "crop"
+    # linear scaling in batch around the anchor
+    assert large_batch_recipe_train_config(150, 512).lr == pytest.approx(1.6)
+    # overrides win (the lr-probe path this recipe was calibrated with)
+    assert large_batch_recipe_train_config(150, 256, lr=0.5).lr == 0.5
+
+
 def test_digits_production_recipe_trains_to_real_accuracy(tmp_path):
     """The ImageNet PRODUCTION recipe (SGD Nesterov + linear-scaled lr +
     warmup-cosine + kernels-only wd + label smoothing — the knobs behind the

@@ -85,39 +85,53 @@ def main() -> int:
         names = [os.path.basename(f) for f in group]
         print(f"=== group {i + 1}: {' '.join(names)}", flush=True)
         t0 = time.time()
+        # own session + killpg on timeout: some modules (test_multiprocess)
+        # spawn grandchildren (gloo workers); killing only the pytest child
+        # would orphan them on the 1-core box and wedge the REMAINING groups
+        child = subprocess.Popen(
+            [sys.executable, "-m", "pytest", *group,
+             *shlex.split(args.pytest_args)],
+            cwd=REPO,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            start_new_session=True,
+        )
         try:
-            proc = subprocess.run(
-                [sys.executable, "-m", "pytest", *group,
-                 *shlex.split(args.pytest_args)],
-                cwd=REPO,
-                env=env,
-                capture_output=True,
-                text=True,
-                timeout=args.group_timeout,
-            )
-        except subprocess.TimeoutExpired as e:
+            out, err = child.communicate(timeout=args.group_timeout)
+        except subprocess.TimeoutExpired:
+            import signal
+
+            try:
+                os.killpg(child.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            out, err = child.communicate()
             secs = round(time.time() - t0, 1)
             record["ok"] = False
-            tail = ((e.stdout or b"").decode(errors="replace")
-                    if isinstance(e.stdout, bytes) else (e.stdout or ""))[-2000:]
-            print(f"    TIMEOUT after {secs}s; partial output:\n{tail}",
-                  flush=True)
+            print(
+                f"    TIMEOUT after {secs}s; partial output:\n{(out or '')[-2000:]}",
+                flush=True,
+            )
             record["groups"].append(
                 {"files": names, "timeout": args.group_timeout, "secs": secs}
             )
             continue
+
         secs = round(time.time() - t0, 1)
-        tail = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+        out = out or ""
+        tail = out.strip().splitlines()[-1] if out.strip() else ""
         summary = re.search(r"(\d+ (?:passed|failed)[^\n]*)", tail)
-        print(f"    rc={proc.returncode} {secs}s {tail}", flush=True)
-        if proc.returncode != 0:
+        print(f"    rc={child.returncode} {secs}s {tail}", flush=True)
+        if child.returncode != 0:
             record["ok"] = False
-            print(proc.stdout[-4000:], flush=True)
-            print(proc.stderr[-2000:], file=sys.stderr, flush=True)
+            print(out[-4000:], flush=True)
+            print((err or "")[-2000:], file=sys.stderr, flush=True)
         record["groups"].append(
             {
                 "files": names,
-                "rc": proc.returncode,
+                "rc": child.returncode,
                 "secs": secs,
                 "summary": summary.group(1) if summary else tail,
             }
