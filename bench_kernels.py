@@ -92,6 +92,7 @@ def bench_attention(
     seq_lens=(196, 1024),
     iters: int = 30,
     warmup: int = 5,
+    train_cols: bool = True,
 ) -> Dict:
     """Fused Pallas block attention vs the XLA einsum path at ViT-S shapes
     (T=196 is ViT-S/16 at 224x224; T=1024 is the long-block regime the ring
@@ -123,12 +124,43 @@ def bench_attention(
         xla_us = _timed_us(
             jax.jit(lambda a, b, c: attention_reference(a, b, c)), (q, k, v), iters, warmup
         )
+
         results[f"seq{t}"] = {
             "pallas_us": round(pallas_us, 1),
             "xla_us": round(xla_us, 1),
             "speedup": round(xla_us / pallas_us, 3),
         }
-        wins += pallas_us < xla_us
+        if not train_cols:
+            wins += pallas_us < xla_us
+            continue
+
+        # TRAINING cost: value+grad through each path. use_fused_attention
+        # rides the train step, so the flip decision must price the custom-vjp
+        # backward (which REBUILDS the score tile) against XLA's autodiff —
+        # a forward-only win that loses the backward is a net training loss.
+        # (These are the EXPENSIVE fresh-HLO compiles on the tunneled TPU;
+        # probe_attention records the forward-only numbers FIRST so a window
+        # that dies here still leaves decision data.)
+        def train_readout(fn):
+            def loss(a, b, c):
+                return jnp.sum(fn(a, b, c).astype(jnp.float32))
+
+            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+        pallas_train_us = _timed_us(
+            train_readout(flash_attention), (q, k, v), iters, warmup
+        )
+        xla_train_us = _timed_us(
+            train_readout(attention_reference), (q, k, v), iters, warmup
+        )
+        results[f"seq{t}"].update(
+            {
+                "pallas_train_us": round(pallas_train_us, 1),
+                "xla_train_us": round(xla_train_us, 1),
+                "speedup_train": round(xla_train_us / pallas_train_us, 3),
+            }
+        )
+        wins += (pallas_us < xla_us) and (pallas_train_us < xla_train_us)
     results["pallas_wins"] = bool(wins > len(seq_lens) / 2)
     results["shape"] = [batch, "T", heads, head_dim]
     return results

@@ -10,6 +10,11 @@ against the single-process oracle."""
 import os
 import sys
 
+# every strategy the "both" mode runs — the parent's completeness check
+# (tests/test_multiprocess.py:_run_workers) derives its expectation from this
+# tuple so adding a strategy here is automatically enforced there
+ALL_STRATEGIES = ("dp", "tp", "sp", "ep", "pp", "3ax")
+
 
 def main() -> int:
     rank = int(sys.argv[1])
@@ -171,9 +176,7 @@ def main() -> int:
     # "both" amortizes the expensive part (process spawn + jax.distributed
     # init, ~15 s per 2-process pair) across ALL strategies — collectives run
     # in the same jax.distributed session either way
-    for strategy in (
-        ("dp", "tp", "sp", "ep", "pp", "3ax") if mode == "both" else (mode,)
-    ):
+    for strategy in ALL_STRATEGIES if mode == "both" else (mode,):
         run(strategy)
     return 0
 

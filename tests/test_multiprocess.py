@@ -60,25 +60,29 @@ def _run_workers(mode: str):
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    expected = 6 if mode == "both" else 1
+    from tests.mp_train_worker import ALL_STRATEGIES
+
+    expected = len(ALL_STRATEGIES) if mode == "both" else 1
     results = []
     for out in outs:
         per_mode = {}
         for ln in out.splitlines():
             if ln.startswith("RESULT_"):
+                # a RESULT line mangled by interleaved child logging
+                # (observed transiently under full-suite load on the
+                # 1-core box) must not crash the parser mid-line; the
+                # completeness check below turns the gap into ONE readable
+                # failure with the raw output attached instead of an
+                # opaque unpack/parse ValueError
                 parts = ln.split()
                 if len(parts) != 3:
-                    # a RESULT line mangled by interleaved child logging
-                    # (observed transiently under full-suite load on the
-                    # 1-core box) must not crash the parser mid-line; the
-                    # completeness check below turns the gap into ONE
-                    # readable failure with the raw output attached instead
-                    # of an opaque unpack ValueError
                     continue
                 tag, loss, step = parts
-                per_mode[tag.removeprefix("RESULT_").lower()] = (
-                    float(loss), int(step),
-                )
+                try:
+                    parsed = (float(loss), int(step))
+                except ValueError:
+                    continue
+                per_mode[tag.removeprefix("RESULT_").lower()] = parsed
         if len(per_mode) < expected:
             raise AssertionError(
                 f"worker produced {sorted(per_mode)} of {expected} expected "

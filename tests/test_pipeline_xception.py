@@ -222,11 +222,13 @@ def test_fit_pipeline_parallel_xception_end_to_end(tmp_path):
             lr=1e-3,
             seed=0,
             pipeline_parallel=4,
-            pipeline_microbatches=4,
+            # M=8 > K=4 stages: the bubble-shrinking regime (fill/drain
+            # fraction (K-1)/(M+K-1) = 3/11), not just the M=K minimum
+            pipeline_microbatches=8,
             checkpoint_every_steps=4,
         ),
     )
-    result = trainer.fit(batch_size=8, steps=4)
+    result = trainer.fit(batch_size=16, steps=4)
     assert result.steps == 4
     assert np.isfinite(result.final_metrics["loss"])
     assert "metrics/top1" in result.final_metrics

@@ -27,10 +27,18 @@ def main() -> int:
 
     from bench_kernels import bench_attention
 
-    if jax.default_backend() == "tpu":
-        out = bench_attention()
-    else:
-        out = bench_attention(batch=2, seq_lens=(64,), iters=3, warmup=1)
+    kwargs = (
+        {}
+        if jax.default_backend() == "tpu"
+        else dict(batch=2, seq_lens=(64,), iters=3, warmup=1)
+    )
+    # forward-only FIRST and printed immediately: the train columns add the
+    # big fresh-HLO backward compiles, and a tunnel window that dies during
+    # them must still leave the forward decision data on stdout
+    fwd = bench_attention(train_cols=False, **kwargs)
+    fwd["platform"] = jax.default_backend()
+    print(json.dumps({"attention_fwd": fwd}), flush=True)
+    out = bench_attention(**kwargs)
     out["platform"] = jax.default_backend()
     print(json.dumps({"attention": out}), flush=True)
     return 0
