@@ -93,11 +93,21 @@ def bench_attention(
     iters: int = 30,
     warmup: int = 5,
     train_cols: bool = True,
+    on_forward_done=None,
 ) -> Dict:
     """Fused Pallas block attention vs the XLA einsum path at ViT-S shapes
     (T=196 is ViT-S/16 at 224x224; T=1024 is the long-block regime the ring
     hands each device). bf16 inputs, float32 softmax both ways.
-    ``use_fused_attention`` should be flipped on iff the Pallas column wins."""
+
+    Phase 1 measures the forward for EVERY seq_len, then calls
+    ``on_forward_done(snapshot)`` (probe_attention prints it immediately);
+    phase 2 adds the TRAINING value+grad columns — use_fused_attention rides
+    the train step, so the flip decision must price the custom-vjp backward
+    (which REBUILDS the score tile) against XLA's autodiff; a forward-only
+    win that loses the backward is a net training loss. The train compiles
+    are the big fresh-HLO work on the tunneled TPU, so a window that dies in
+    phase 2 still leaves the phase-1 data. ``use_fused_attention`` should be
+    flipped on iff ``pallas_wins`` (both phases won at most seq_lens)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -109,60 +119,61 @@ def bench_attention(
 
     rng = np.random.default_rng(1)
     results: Dict = {}
-    wins = 0
+    qkv = {}
+    fwd_wins = {}
     for t in seq_lens:
-        q, k, v = (
+        qkv[t] = tuple(
             jax.device_put(
                 rng.normal(0, 1, (batch, t, heads, head_dim)).astype(np.float32)
             ).astype(jnp.bfloat16)
             for _ in range(3)
         )
-
         pallas_us = _timed_us(
-            jax.jit(lambda a, b, c: flash_attention(a, b, c)), (q, k, v), iters, warmup
+            jax.jit(lambda a, b, c: flash_attention(a, b, c)), qkv[t], iters, warmup
         )
         xla_us = _timed_us(
-            jax.jit(lambda a, b, c: attention_reference(a, b, c)), (q, k, v), iters, warmup
+            jax.jit(lambda a, b, c: attention_reference(a, b, c)), qkv[t], iters, warmup
         )
-
         results[f"seq{t}"] = {
             "pallas_us": round(pallas_us, 1),
             "xla_us": round(xla_us, 1),
             "speedup": round(xla_us / pallas_us, 3),
         }
-        if not train_cols:
-            wins += pallas_us < xla_us
-            continue
+        fwd_wins[t] = pallas_us < xla_us
 
-        # TRAINING cost: value+grad through each path. use_fused_attention
-        # rides the train step, so the flip decision must price the custom-vjp
-        # backward (which REBUILDS the score tile) against XLA's autodiff —
-        # a forward-only win that loses the backward is a net training loss.
-        # (These are the EXPENSIVE fresh-HLO compiles on the tunneled TPU;
-        # probe_attention records the forward-only numbers FIRST so a window
-        # that dies here still leaves decision data.)
+    results["shape"] = [batch, "T", heads, head_dim]
+    if on_forward_done is not None:
+        results["pallas_wins_fwd"] = bool(
+            sum(fwd_wins.values()) > len(seq_lens) / 2
+        )
+        on_forward_done(dict(results))
+
+    wins = 0
+    if train_cols:
         def train_readout(fn):
             def loss(a, b, c):
                 return jnp.sum(fn(a, b, c).astype(jnp.float32))
 
             return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
 
-        pallas_train_us = _timed_us(
-            train_readout(flash_attention), (q, k, v), iters, warmup
-        )
-        xla_train_us = _timed_us(
-            train_readout(attention_reference), (q, k, v), iters, warmup
-        )
-        results[f"seq{t}"].update(
-            {
-                "pallas_train_us": round(pallas_train_us, 1),
-                "xla_train_us": round(xla_train_us, 1),
-                "speedup_train": round(xla_train_us / pallas_train_us, 3),
-            }
-        )
-        wins += (pallas_us < xla_us) and (pallas_train_us < xla_train_us)
+        for t in seq_lens:
+            pallas_train_us = _timed_us(
+                train_readout(flash_attention), qkv[t], iters, warmup
+            )
+            xla_train_us = _timed_us(
+                train_readout(attention_reference), qkv[t], iters, warmup
+            )
+            results[f"seq{t}"].update(
+                {
+                    "pallas_train_us": round(pallas_train_us, 1),
+                    "xla_train_us": round(xla_train_us, 1),
+                    "speedup_train": round(xla_train_us / pallas_train_us, 3),
+                }
+            )
+            wins += fwd_wins[t] and (pallas_train_us < xla_train_us)
+    else:
+        wins = sum(fwd_wins.values())
     results["pallas_wins"] = bool(wins > len(seq_lens) / 2)
-    results["shape"] = [batch, "T", heads, head_dim]
     return results
 
 

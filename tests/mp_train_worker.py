@@ -13,7 +13,7 @@ import sys
 # every strategy the "both" mode runs — the parent's completeness check
 # (tests/test_multiprocess.py:_run_workers) derives its expectation from this
 # tuple so adding a strategy here is automatically enforced there
-ALL_STRATEGIES = ("dp", "tp", "sp", "ep", "pp", "3ax")
+ALL_STRATEGIES = ("dp", "tp", "sp", "ep", "pp", "3ax", "zero")
 
 
 def main() -> int:
@@ -105,6 +105,18 @@ def main() -> int:
             mesh = mesh_lib.make_mesh(None, model_parallel=2)
             state = mesh_lib.replicate(raw_state, mesh)
             train_step = step_lib.make_train_step(
+                mesh, step_lib.ClassificationTask(), donate=False
+            )
+        elif strategy == "zero":
+            # multi-host ZeRO-style weight-update sharding
+            # (arXiv:2004.13336): optimizer moments shard 1/dp over the
+            # BATCH axis, which SPANS the two processes — the update's
+            # cross-replica gather rides gloo; params stay replicated
+            from tensorflowdistributedlearning_tpu.parallel import tensor as tp_lib
+
+            mesh = mesh_lib.make_mesh(None)  # pure DP global mesh
+            state = tp_lib.shard_state_weight_update(raw_state, mesh)
+            train_step = tp_lib.make_train_step_gspmd(
                 mesh, step_lib.ClassificationTask(), donate=False
             )
         elif strategy == "3ax":

@@ -94,9 +94,9 @@ def _run_workers(mode: str):
 
 @pytest.fixture(scope="module")
 def worker_results():
-    """One 2-process spawn runs ALL strategies (dp, tp, sp, ep, pp) — the
-    spawn + jax.distributed init dominates the test's cost, so it is paid
-    once."""
+    """One 2-process spawn runs ALL strategies in
+    ``mp_train_worker.ALL_STRATEGIES`` — the spawn + jax.distributed init
+    dominates the test's cost, so it is paid once."""
     return _run_workers("both")
 
 
@@ -223,6 +223,19 @@ def test_three_axis_composition_across_processes(worker_results):
     assert step0 == step1 == 1
     assert loss0 == pytest.approx(loss1, abs=0.0)
     assert loss0 == pytest.approx(_oracle_loss(spatial=True, ep=True), rel=1e-5)
+
+
+def test_zero_weight_update_sharding_across_processes(worker_results):
+    """Multi-host ZeRO-style weight-update sharding (arXiv:2004.13336):
+    optimizer moments shard 1/dp over the batch axis spanning BOTH
+    processes; the update's cross-replica gather rides gloo. Numerics are
+    identical to plain replication (the single-process proof is
+    tests/test_tensor_parallel.py::test_weight_update_sharding_zero_style),
+    so ranks agree bitwise and the loss equals the plain dp oracle."""
+    (loss0, step0), (loss1, step1) = (r["zero"] for r in worker_results)
+    assert step0 == step1 == 1
+    assert loss0 == pytest.approx(loss1, abs=0.0)
+    assert loss0 == pytest.approx(_oracle_loss(), rel=1e-5)
 
 
 def test_pipeline_parallel_across_processes(worker_results):

@@ -32,13 +32,15 @@ def main() -> int:
         if jax.default_backend() == "tpu"
         else dict(batch=2, seq_lens=(64,), iters=3, warmup=1)
     )
-    # forward-only FIRST and printed immediately: the train columns add the
-    # big fresh-HLO backward compiles, and a tunnel window that dies during
-    # them must still leave the forward decision data on stdout
-    fwd = bench_attention(train_cols=False, **kwargs)
-    fwd["platform"] = jax.default_backend()
-    print(json.dumps({"attention_fwd": fwd}), flush=True)
-    out = bench_attention(**kwargs)
+
+    # the forward snapshot prints the moment phase 1 completes: the train
+    # columns are the big fresh-HLO backward compiles, and a tunnel window
+    # that dies during them must still leave forward decision data on stdout
+    def emit_forward(snapshot):
+        snapshot["platform"] = jax.default_backend()
+        print(json.dumps({"attention_fwd": snapshot}), flush=True)
+
+    out = bench_attention(on_forward_done=emit_forward, **kwargs)
     out["platform"] = jax.default_backend()
     print(json.dumps({"attention": out}), flush=True)
     return 0
