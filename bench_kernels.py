@@ -142,11 +142,17 @@ def bench_attention(
         fwd_wins[t] = pallas_us < xla_us
 
     results["shape"] = [batch, "T", heads, head_dim]
+    results["pallas_wins_fwd"] = bool(sum(fwd_wins.values()) > len(seq_lens) / 2)
     if on_forward_done is not None:
-        results["pallas_wins_fwd"] = bool(
-            sum(fwd_wins.values()) > len(seq_lens) / 2
+        # deep-enough copy: phase 2 updates the nested per-seq dicts in
+        # place, and the snapshot must stay forward-only for a callback
+        # that retains it
+        on_forward_done(
+            {
+                key: (dict(value) if isinstance(value, dict) else value)
+                for key, value in results.items()
+            }
         )
-        on_forward_done(dict(results))
 
     wins = 0
     if train_cols:

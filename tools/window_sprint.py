@@ -80,6 +80,19 @@ SECTIONS = [
         ],
         1800,
     ),
+    # fresh-HLO remat probe (VERDICT r3 weak #2: batch 512 measured slower
+    # than 256 — does rematerialization recover it?) — after the cached
+    # probes, before bench
+    (
+        "remat512",
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "probe_extras.py"),
+            "--remat-batch",
+            "512",
+        ],
+        1500,
+    ),
     # full bench last: refreshes the headline + extras under the
     # merge-preserving cache (its own supervisor bounds the children)
     (
