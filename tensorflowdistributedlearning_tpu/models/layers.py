@@ -252,6 +252,18 @@ class ConvBN(nn.Module):
         return x
 
 
+def _pallas_platform_ok() -> bool:
+    """Compiled-Pallas gate for the depthwise dispatch — delegates to the one
+    shared decision (ops/pallas_kernels.pallas_platform_ok, also behind the
+    kernel's interpret auto-select). Module-level indirection so tests can
+    patch it and exercise the dispatch on the CPU mesh."""
+    from tensorflowdistributedlearning_tpu.ops.pallas_kernels import (
+        pallas_platform_ok,
+    )
+
+    return pallas_platform_ok()
+
+
 class DepthwiseConv2D(nn.Module):
     """Stride-1 SAME depthwise conv with an optional Pallas fast path.
 
@@ -286,12 +298,19 @@ class DepthwiseConv2D(nn.Module):
             depthwise_conv2d_reference,
         )
 
-        # rate-aware dispatch: hardware microbenches (see
+        # rate-aware, PLATFORM-aware dispatch: hardware microbenches (see
         # PALLAS_DEPTHWISE_MIN_RATE) show XLA wins below rate 4 and the Pallas
-        # kernel wins at 4+, so the flag engages only where measured to win
+        # kernel wins at 4+, so the flag engages only where measured to win —
+        # and only on TPU, where the kernel is compiled; everywhere else
+        # (the CPU test mesh) Pallas runs in the slow interpreter, so the
+        # flag safely degrades to XLA and presets/defaults can leave it on.
         dw = (
             depthwise_conv2d
-            if self.use_pallas and self.rate >= PALLAS_DEPTHWISE_MIN_RATE
+            if (
+                self.use_pallas
+                and self.rate >= PALLAS_DEPTHWISE_MIN_RATE
+                and _pallas_platform_ok()
+            )
             else depthwise_conv2d_reference
         )
         out = dw(x, kernel[:, :, 0, :].astype(dtype), self.rate)

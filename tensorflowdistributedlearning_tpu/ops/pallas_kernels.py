@@ -42,6 +42,14 @@ _VMEM_BLOCK_LIMIT_BYTES = 4 * 1024 * 1024
 PALLAS_DEPTHWISE_MIN_RATE = 4
 
 
+def pallas_platform_ok() -> bool:
+    """True where the Pallas kernels run COMPILED (TPU); elsewhere they only
+    have the slow interpreter. The ONE copy of this decision — the layer
+    dispatch gate (models/layers.py:DepthwiseConv2D) and the kernel's
+    interpret auto-select both consult it, so they can never disagree."""
+    return jax.default_backend() == "tpu"
+
+
 def depthwise_conv2d_reference(
     x: jax.Array, w: jax.Array, rate: int = 1
 ) -> jax.Array:
@@ -204,7 +212,7 @@ def depthwise_conv2d(
         # even a single 128-lane tile (or an unsplittable C) is too large spatially
         return depthwise_conv2d_reference(x, w, rate)
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = not pallas_platform_ok()
     if interpret and vma_of(x):
         # Pallas's HLO interpreter cannot run under shard_map's varying-manual-axes
         # tracking (its internal dynamic_slice mixes varying/unvarying operands and

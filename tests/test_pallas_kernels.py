@@ -88,14 +88,19 @@ def test_bfloat16_inputs():
     )
 
 
-def test_model_paths_agree():
+def test_model_paths_agree(monkeypatch):
     # the ASPP with use_pallas_depthwise on/off must produce identical outputs from
-    # the same parameters (pure execution-path switch)
+    # the same parameters (pure execution-path switch); the platform gate is
+    # patched open so the Pallas (interpreter) path actually runs on the CPU
+    # mesh — without the patch both models would take XLA and the check would
+    # be vacuous
+    import tensorflowdistributedlearning_tpu.models.layers as layers_mod
     from tensorflowdistributedlearning_tpu.config import ModelConfig
     from tensorflowdistributedlearning_tpu.models import build_model
 
+    monkeypatch.setattr(layers_mod, "_pallas_platform_ok", lambda: True)
     base = dict(input_shape=(33, 33), n_blocks=(1, 1, 1), base_depth=32)
-    m_xla = build_model(ModelConfig(**base))
+    m_xla = build_model(ModelConfig(use_pallas_depthwise=False, **base))
     m_pl = build_model(ModelConfig(use_pallas_depthwise=True, **base))
     x = jnp.asarray(np.random.default_rng(5).normal(0, 1, (1, 33, 33, 2)), jnp.float32)
     variables = m_xla.init(jax.random.PRNGKey(0), x, train=False)
@@ -104,6 +109,30 @@ def test_model_paths_agree():
     np.testing.assert_allclose(
         np.asarray(out_pl), np.asarray(out_xla), rtol=1e-4, atol=1e-4
     )
+
+
+@pytest.mark.skipif(
+    jax.default_backend() == "tpu",
+    reason="asserts the gate's off-TPU behavior; on TPU the kernel SHOULD engage",
+)
+def test_platform_gate_blocks_pallas_off_tpu():
+    """With the real (unpatched) gate on the CPU backend, use_pallas=True at
+    a winning rate still dispatches to XLA — the default-ON config can never
+    route CI or CPU-mesh users through the Pallas interpreter."""
+    import tensorflowdistributedlearning_tpu.ops.pallas_kernels as pk
+    from tensorflowdistributedlearning_tpu.models.layers import DepthwiseConv2D
+
+    calls = []
+    orig = pk.depthwise_conv2d
+    try:
+        pk.depthwise_conv2d = lambda *a, **k: calls.append(1) or orig(*a, **k)
+        layer = DepthwiseConv2D(rate=8, use_pallas=True)
+        x = jnp.zeros((1, 8, 8, 4), jnp.float32)
+        variables = layer.init(jax.random.PRNGKey(0), x)
+        layer.apply(variables, x)
+    finally:
+        pk.depthwise_conv2d = orig
+    assert not calls  # CPU backend: the gate kept everything on XLA
 
 
 def test_validation():
@@ -117,10 +146,14 @@ def test_validation():
 def test_rate_gate_dispatch(monkeypatch):
     """The layer engages the Pallas kernel only at measured-winning rates
     (>= PALLAS_DEPTHWISE_MIN_RATE, per the v5e microbenches) even when
-    use_pallas=True; below the threshold it stays on XLA's grouped conv."""
+    use_pallas=True; below the threshold it stays on XLA's grouped conv.
+    The platform gate is patched open so the dispatch logic runs on the CPU
+    test mesh (on real hardware it is True on TPU, False elsewhere)."""
+    import tensorflowdistributedlearning_tpu.models.layers as layers_mod
     import tensorflowdistributedlearning_tpu.ops.pallas_kernels as pk
     from tensorflowdistributedlearning_tpu.models.layers import DepthwiseConv2D
 
+    monkeypatch.setattr(layers_mod, "_pallas_platform_ok", lambda: True)
     taken = []
     real = pk.depthwise_conv2d
     monkeypatch.setattr(
