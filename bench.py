@@ -316,14 +316,21 @@ def run_benchmark(platform: str | None = None) -> dict:
         # whole-run global batch on its 2-GPU setup was 64 (Untitled.ipynb
         # cells 7-8), i.e. 32/chip; per-chip 64 keeps the per-chip workload
         # comparable across pod sizes (global batch scales with n).
-        def _seg_flagship() -> dict:
+        def _seg_flagship(dtype: str = "float32") -> dict:
             # nested so every HBM reference (state, batch, executable) dies on
             # return — the batch-x2 probe below must not compete with it
             from tensorflowdistributedlearning_tpu.train.step import (
                 SegmentationTask,
             )
 
-            seg_cfg = ModelConfig()  # reference defaults
+            # float32 = the tgs_salt preset (reference defaults, the
+            # parity-comparable number); bfloat16 = the tgs_salt_bf16 preset
+            # (same architecture at the MXU's bf16 rate) — both taken FROM
+            # the preset registry so the bench always prices the shipped
+            # configs
+            seg_cfg = PRESETS[
+                "tgs_salt_bf16" if dtype == "bfloat16" else "tgs_salt"
+            ].model
             seg_model = build_model(seg_cfg)
             seg_state = replicate(
                 create_train_state(
@@ -366,6 +373,11 @@ def run_benchmark(platform: str | None = None) -> dict:
             result["segmentation_flagship"] = _seg_flagship()
         except Exception as e:  # noqa: BLE001
             result["segmentation_flagship"] = {"error": str(e)[:200]}
+        print(json.dumps(result), flush=True)
+        try:
+            result["segmentation_flagship_bf16"] = _seg_flagship("bfloat16")
+        except Exception as e:  # noqa: BLE001
+            result["segmentation_flagship_bf16"] = {"error": str(e)[:200]}
         print(json.dumps(result), flush=True)
 
         # Batch-x2 upside probe — late extra (low decision value; only the

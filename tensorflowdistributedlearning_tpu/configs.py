@@ -61,6 +61,14 @@ PRESETS: Dict[str, Preset] = {
         description="Reference parity: ResNet-v2-beta + DeepLabV3+ head, 101x101x2, "
         "5-fold CV, Lovász hinge (reference: model.py defaults)",
     ),
+    "tgs_salt_bf16": Preset(
+        model=ModelConfig(dtype="bfloat16"),
+        train=TrainConfig(),
+        global_batch=64,
+        description="TPU-native variant of the reference workload: identical "
+        "architecture/loss with bf16 compute (params, loss, and metrics stay "
+        "f32; convs/matmuls run at the MXU's bf16 rate)",
+    ),
     # BASELINE.json "ResNet-50 single-tower CIFAR-10 (CPU smoke test)"
     "cifar10_smoke": Preset(
         model=ModelConfig(

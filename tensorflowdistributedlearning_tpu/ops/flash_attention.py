@@ -176,7 +176,11 @@ def flash_attention(
     if _vmem_estimate_bytes(t, d, block_q) > _VMEM_KV_LIMIT_BYTES:
         return attention_reference(q, k, v, causal=causal)
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from tensorflowdistributedlearning_tpu.ops.pallas_kernels import (
+            pallas_platform_ok,
+        )
+
+        interpret = not pallas_platform_ok()
     if interpret and (vma_of(q) | vma_of(k) | vma_of(v)):
         # the Pallas interpreter's block slicing trips shard_map's varying-axes
         # checks (same limitation as ops/pallas_kernels.py): inside shard_map

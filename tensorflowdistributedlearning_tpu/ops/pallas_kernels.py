@@ -45,8 +45,9 @@ PALLAS_DEPTHWISE_MIN_RATE = 4
 def pallas_platform_ok() -> bool:
     """True where the Pallas kernels run COMPILED (TPU); elsewhere they only
     have the slow interpreter. The ONE copy of this decision — the layer
-    dispatch gate (models/layers.py:DepthwiseConv2D) and the kernel's
-    interpret auto-select both consult it, so they can never disagree."""
+    dispatch gate (models/layers.py:DepthwiseConv2D) and the interpret
+    auto-selects of BOTH kernels (this module and ops/flash_attention.py)
+    consult it, so they can never disagree."""
     return jax.default_backend() == "tpu"
 
 
