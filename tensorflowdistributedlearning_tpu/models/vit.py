@@ -38,12 +38,22 @@ from tensorflowdistributedlearning_tpu.parallel.ring_attention import (
 )
 
 
+# compiled-Pallas gate for the fused-attention dispatch: an alias bound in
+# THIS module's globals so tests can patch vit._fused_platform_ok without
+# affecting the depthwise gate; both resolve to the one shared decision
+# (ops/pallas_kernels.pallas_platform_ok)
+from tensorflowdistributedlearning_tpu.models.layers import (  # noqa: E402
+    _pallas_platform_ok as _fused_platform_ok,
+)
+
+
 class MultiHeadSelfAttention(nn.Module):
     """QKV projection + exact attention + output projection. ``spatial_axis_name``
     selects the ring formulation over the sequence mesh axis; both paths share the
     same float32-softmax math, so sharded and unsharded forwards agree to
     reassociation tolerance. ``use_fused`` swaps the XLA einsum path for the
-    Pallas fused block-attention kernel (same contract, VMEM-resident scores)."""
+    Pallas fused block-attention kernel (same contract, VMEM-resident scores) —
+    on TPU only; elsewhere the flag degrades to the XLA path."""
 
     embed_dim: int
     num_heads: int
@@ -68,13 +78,17 @@ class MultiHeadSelfAttention(nn.Module):
                     stacklevel=2,
                 )
             out = ring_attention(q, k, v, axis_name=self.spatial_axis_name)
-        elif self.use_fused:
+        elif self.use_fused and _fused_platform_ok():
             from tensorflowdistributedlearning_tpu.ops.flash_attention import (
                 flash_attention,
             )
 
             out = flash_attention(q, k, v)
         else:
+            # use_fused off-TPU degrades to the XLA path rather than the
+            # Pallas interpreter (same platform gate as the depthwise
+            # dispatch, models/layers.py), so presets can carry the flag
+            # without slowing the CPU test mesh
             out = attention_reference(q, k, v)
         out = out.reshape(b, t, self.embed_dim)
         return nn.Dense(self.embed_dim, dtype=self.dtype, name="proj")(out)

@@ -83,11 +83,16 @@ def test_vmem_fallback_path(monkeypatch):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6)
 
 
-def test_vit_uses_fused_attention_when_enabled():
+def test_vit_uses_fused_attention_when_enabled(monkeypatch):
     """use_fused_attention is a pure execution-path switch: identical params,
-    matching outputs."""
+    matching outputs. The platform gate is patched open so the Pallas
+    (interpreter) path actually runs on the CPU mesh — unpatched, the gate
+    degrades the flag to XLA off-TPU and the check would be vacuous."""
+    import tensorflowdistributedlearning_tpu.models.vit as vit_mod
     from tensorflowdistributedlearning_tpu.config import ModelConfig
     from tensorflowdistributedlearning_tpu.models import build_model
+
+    monkeypatch.setattr(vit_mod, "_fused_platform_ok", lambda: True)
 
     base = ModelConfig(
         backbone="vit",
