@@ -19,5 +19,7 @@ while true; do
     exit 0
   fi
   echo "[$ts] tunnel down"
-  sleep 300
+  # 3-minute cadence: r3 windows lasted ~30 min — every minute of detection
+  # lag is a minute of lost hardware evidence; the down-probe itself is cheap
+  sleep 180
 done
