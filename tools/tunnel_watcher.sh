@@ -1,5 +1,5 @@
 #!/bin/bash
-# Probe the axon TPU tunnel every ~5 min; the moment jax.devices() answers,
+# Probe the axon TPU tunnel every ~3 min; the moment jax.devices() answers,
 # run tools/window_sprint.py (the standing order: first window goes to the
 # pending hardware probes). Appends a status line per probe to the log so a
 # supervisor can see liveness; exits after window_sprint completes so the
