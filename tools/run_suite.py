@@ -60,7 +60,10 @@ def main() -> int:
     parser.add_argument("--groups", type=int, default=4)
     parser.add_argument("--json-out", default=None)
     parser.add_argument("--pytest-args", default="-q",
-                        help="extra args passed to each pytest child")
+                        help="extra args passed to each pytest child; values "
+                        "starting with '-' need the = form "
+                        "(--pytest-args='-q --durations=10') or argparse "
+                        "rejects them as options")
     parser.add_argument("--group-timeout", type=int, default=1500,
                         help="seconds per pytest child before it is killed "
                         "and recorded as a timeout (a hung group must not "
