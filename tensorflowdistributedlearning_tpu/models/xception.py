@@ -359,13 +359,20 @@ class XceptionSegmentation(nn.Module):
         return upsample(decoder.astype(jnp.float32), cfg.input_shape)
 
 
+# Pre-logits dropout keep probability (the reference declared keep_prob=0.5
+# but never used it, core/xception.py:298). Single source for Xception41 AND
+# the pipelined XceptionExitHead — the two strategies interchange checkpoints,
+# so their train-mode dropout must never silently diverge.
+DEFAULT_KEEP_PROB = 0.5
+
+
 class Xception41(nn.Module):
     """Xception-41 classifier: backbone, global pool, pre-logits dropout (the
     reference declared ``keep_prob=0.5`` but never used it, core/xception.py:298),
     dense logits. With ``num_classes=None`` returns pooled features."""
 
     config: ModelConfig
-    keep_prob: float = 0.5
+    keep_prob: float = DEFAULT_KEEP_PROB
     bn_axis_name: Optional[str] = None
     spatial_axis_name: Optional[str] = None
 
@@ -464,7 +471,7 @@ class XceptionExitHead(nn.Module):
     and the top-level ``logits`` params."""
 
     config: ModelConfig
-    keep_prob: float = 0.5
+    keep_prob: float = DEFAULT_KEEP_PROB
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:

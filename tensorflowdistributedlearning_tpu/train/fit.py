@@ -353,7 +353,8 @@ class ClassifierTrainer:
             from tensorflowdistributedlearning_tpu.train import pipeline_step as pp_lib
 
             train_step = pp_lib.make_train_step_pipeline(
-                self.mesh, self.task, self.model_config, self._pp_microbatches
+                self.mesh, self.task, self.model_config, self._pp_microbatches,
+                seed=self.train_config.seed,
             )
         else:
             train_step = step_lib.make_train_step(
@@ -362,6 +363,7 @@ class ClassifierTrainer:
                 weight_decay=self.model_config.weight_decay,
                 spatial=self._spatial,
                 accum=self.train_config.grad_accum_steps,
+                seed=self.train_config.seed,
             )
         is_main = jax.process_index() == 0
         tb_train = SummaryWriter(os.path.join(self.model_dir, "train")) if is_main else None

@@ -58,13 +58,16 @@ def validate_pipeline_config(
     config: ModelConfig, pipeline_parallel: int, microbatches: int
 ) -> None:
     """Config-time checks so misconfiguration fails before any compile."""
-    if config.backbone == "resnet":
+    if config.backbone not in ("vit", "xception"):
+        # whitelist, not a resnet blacklist: a backbone added later must opt
+        # in explicitly rather than silently falling through to the ViT
+        # divisibility branch below and being built as a ViT pipeline
         raise ValueError(
-            "pipeline_parallel requires homogeneous stages (the GPipe "
-            "runner's regime): backbone='vit' (transformer blocks) or "
-            "backbone='xception' (the 8 identical 728-wide middle-flow "
-            "units); ResNet's bottleneck stages change width/stride and "
-            "cannot pipeline"
+            f"pipeline_parallel does not support backbone={config.backbone!r}: "
+            "it requires homogeneous stages (the GPipe runner's regime) — "
+            "backbone='vit' (transformer blocks) or backbone='xception' (the "
+            "8 identical 728-wide middle-flow units). ResNet's bottleneck "
+            "stages change width/stride and cannot pipeline"
         )
     if config.moe_experts:
         raise ValueError(
@@ -148,16 +151,24 @@ def make_train_step_pipeline(
     microbatches: int,
     *,
     donate: bool = True,
+    seed: int = 0,
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Metrics]]:
     """Build the jitted pipeline-parallel train step. Memoized like the
     builders in train/step.py so K-fold loops / evals / tests share one
     executable per configuration. Dispatches on the backbone family: ViT
     pipelines its transformer blocks; Xception pipelines the middle flow
     (8 identical 728-wide sum-skip units) with the entry/exit flows
-    replicated, BN normalizing per microbatch (the standard GPipe regime)."""
+    replicated, BN normalizing per microbatch (the standard GPipe regime).
+    ``seed`` roots the xception head's dropout PRNG stream exactly as in
+    train/step.py:make_train_step — the same value must be passed to both
+    builders for the cross-strategy mask parity the tests pin. The ViT
+    branch deliberately ignores it (no stochastic layer anywhere in its
+    pipelined forward, so keying its cache on seed would only force
+    pointless recompiles per seed); a future dropout-bearing ViT pipeline
+    must thread it into _make_train_step_pipeline_cached too."""
     if config.backbone == "xception":
         return _make_train_step_pipeline_xception_cached(
-            mesh, task, config, microbatches, donate
+            mesh, task, config, microbatches, donate, seed
         )
     return _make_train_step_pipeline_cached(mesh, task, config, microbatches, donate)
 
@@ -226,7 +237,8 @@ _XC_EXIT_KEYS = ("exit_block1_unit1", "exit_block2_unit1")
 
 @functools.lru_cache(maxsize=None)
 def _make_train_step_pipeline_xception_cached(
-    mesh: Mesh, task, config: ModelConfig, microbatches: int, donate: bool
+    mesh: Mesh, task, config: ModelConfig, microbatches: int, donate: bool,
+    seed: int = 0,
 ):
     from tensorflowdistributedlearning_tpu.models import xception as xc
 
@@ -246,7 +258,7 @@ def _make_train_step_pipeline_xception_cached(
         # the parity tests rely on it.
         dropout_rng = jax.random.fold_in(
             jax.random.fold_in(
-                jax.random.fold_in(jax.random.key(0), state.step),
+                jax.random.fold_in(jax.random.key(seed), state.step),
                 lax.axis_index(BATCH_AXIS),
             ),
             0,

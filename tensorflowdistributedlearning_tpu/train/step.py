@@ -431,6 +431,7 @@ def make_train_step(
     donate: bool = True,
     spatial: bool = False,
     accum: int = 1,
+    seed: int = 0,
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Metrics]]:
     """Build the jitted SPMD train step.
 
@@ -460,9 +461,15 @@ def make_train_step(
     effective global batch is ``accum`` times what the loop feeds, with the lr
     schedule advancing per update. BN statistics flow microbatch-to-microbatch
     sequentially, then average across shards as usual.
+
+    ``seed`` roots the dropout PRNG stream (TrainConfig.seed in the drivers):
+    runs configured with different seeds draw different dropout masks while the
+    (step, shard, chunk) fold-in structure — which the cross-strategy parity
+    tests rely on — is unchanged.
     """
     return _make_train_step_cached(
-        mesh, task, weight_decay, apply_weight_decay, donate, spatial, accum
+        mesh, task, weight_decay, apply_weight_decay, donate, spatial, accum,
+        seed,
     )
 
 
@@ -475,6 +482,7 @@ def _make_train_step_cached(
     donate: bool,
     spatial: bool,
     accum: int = 1,
+    seed: int = 0,
 ):
     def step(state: TrainState, batch: Dict[str, jax.Array]):
         # Deterministic per-(step, batch-shard) dropout stream for the models
@@ -486,7 +494,7 @@ def _make_train_step_cached(
         # post-pool activations and must agree on one mask. Models without
         # dropout simply never draw from the stream.
         dropout_rng = jax.random.fold_in(
-            jax.random.fold_in(jax.random.key(0), state.step),
+            jax.random.fold_in(jax.random.key(seed), state.step),
             jax.lax.axis_index(BATCH_AXIS),
         )
 

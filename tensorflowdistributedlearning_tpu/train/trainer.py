@@ -291,6 +291,7 @@ class Trainer:
             weight_decay=self.model_config.weight_decay,
             spatial=self._spatial,
             accum=self.train_config.grad_accum_steps,
+            seed=self.train_config.seed,
         )
         prepare = self._make_prepare_train(fold)
 

@@ -264,3 +264,24 @@ def test_xception_pipeline_validation():
             2,
             2,
         )
+    # whitelist, not a resnet blacklist: a backbone validate_pipeline_config
+    # has never heard of must be rejected, not silently built as a ViT
+    # pipeline (ModelConfig would refuse "densenet" at construction, so use a
+    # stub to model a future backbone added without pipeline support)
+    import types
+
+    stub = types.SimpleNamespace(
+        backbone="densenet", moe_experts=0, num_classes=4, vit_layers=4
+    )
+    with pytest.raises(ValueError, match="does not support backbone"):
+        validate_pipeline_config(stub, 2, 2)
+
+
+def test_exit_head_keep_prob_single_source():
+    """The pipelined head's dropout must track Xception41's — checkpoints
+    interchange between the strategies, so a drift here would silently change
+    train-mode behavior on one side only."""
+    from tensorflowdistributedlearning_tpu.models import xception as xc
+
+    assert xc.XceptionExitHead.keep_prob == xc.Xception41.keep_prob
+    assert xc.Xception41.keep_prob == xc.DEFAULT_KEEP_PROB
