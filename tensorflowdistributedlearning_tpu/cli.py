@@ -37,6 +37,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--sequence-parallel", type=int, default=1,
                    help="H-shard the backbone over this many devices per "
                    "data-parallel replica (halo-exchange spatial parallelism)")
+    p.add_argument("--model-parallel", type=int, default=1,
+                   help="channel-shard params/optimizer over this many devices "
+                   "per replica (tensor parallelism; the K-fold trainer runs "
+                   "it in shard_map's hybrid auto-model mode)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -164,6 +168,7 @@ def _trainer(args):
         checkpoint_every_steps=getattr(args, "checkpoint_every", 500),
         eval_throttle_secs=getattr(args, "eval_throttle_secs", 300),
         sequence_parallel=getattr(args, "sequence_parallel", 1),
+        model_parallel=getattr(args, "model_parallel", 1),
     )
     return Trainer(
         args.model_dir,

@@ -432,6 +432,7 @@ def make_train_step(
     spatial: bool = False,
     accum: int = 1,
     seed: int = 0,
+    auto_model: bool = False,
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Metrics]]:
     """Build the jitted SPMD train step.
 
@@ -466,10 +467,20 @@ def make_train_step(
     runs configured with different seeds draw different dropout masks while the
     (step, shard, chunk) fold-in structure — which the cross-strategy parity
     tests rely on — is unchanged.
+
+    ``auto_model=True`` runs the shard_map MANUAL over (batch, sequence) only,
+    leaving the ``model`` mesh axis to XLA's SPMD partitioner (shard_map's
+    hybrid ``axis_names`` mode). This composes the two execution strategies
+    that otherwise exclude each other: the halo-exchange spatial convs need
+    manual sequence-axis collectives, while GSPMD tensor parallelism
+    (parallel/tensor.py — params channel-sharded over ``model``) needs the
+    partitioner to derive its all-reduces. Pass state through
+    ``shard_state_tensor_parallel`` and GSPMD partitions the channel math
+    inside each manual shard — the dp x tp x sp layout real pods run.
     """
     return _make_train_step_cached(
         mesh, task, weight_decay, apply_weight_decay, donate, spatial, accum,
-        seed,
+        seed, auto_model,
     )
 
 
@@ -483,6 +494,7 @@ def _make_train_step_cached(
     spatial: bool,
     accum: int = 1,
     seed: int = 0,
+    auto_model: bool = False,
 ):
     def step(state: TrainState, batch: Dict[str, jax.Array]):
         # Deterministic per-(step, batch-shard) dropout stream for the models
@@ -597,26 +609,47 @@ def _make_train_step_cached(
         new_state = state.apply_gradients(grads, new_batch_stats)
         return new_state, _psum_metrics(metrics)
 
+    # hybrid mode: only (batch, sequence) are manual axes; the model axis is
+    # left to the SPMD partitioner, so channel-sharded params (GSPMD tensor
+    # parallelism) keep their sharding through the specs below, which describe
+    # manual axes only
     sharded = jax.shard_map(
         step,
         mesh=mesh,
         in_specs=(P(), _batch_in_specs(spatial, ("images", "labels"))),
         out_specs=(P(), P()),
+        **_hybrid_kwargs(auto_model),
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
 def make_eval_step(
-    mesh: Mesh, task, *, spatial: bool = False, with_valid: bool = True
+    mesh: Mesh,
+    task,
+    *,
+    spatial: bool = False,
+    with_valid: bool = True,
+    auto_model: bool = False,
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Metrics]:
     """Jitted SPMD eval step: forward in inference mode (BN running stats), streaming
     metric deltas (the reference's EVAL branch, model.py:391-403). Memoized — see
-    ``make_train_step``."""
-    return _make_eval_step_cached(mesh, task, spatial, with_valid)
+    ``make_train_step``; ``auto_model`` is the same hybrid mode (model axis left
+    to GSPMD for channel-sharded params)."""
+    return _make_eval_step_cached(mesh, task, spatial, with_valid, auto_model)
+
+
+def _hybrid_kwargs(auto_model: bool) -> dict:
+    """shard_map kwargs for hybrid mode: (batch, sequence) manual, model auto
+    (see make_train_step's ``auto_model``)."""
+    if not auto_model:
+        return {}
+    return {"axis_names": frozenset({BATCH_AXIS, SEQUENCE_AXIS})}
 
 
 @functools.lru_cache(maxsize=None)
-def _make_eval_step_cached(mesh: Mesh, task, spatial: bool, with_valid: bool):
+def _make_eval_step_cached(
+    mesh: Mesh, task, spatial: bool, with_valid: bool, auto_model: bool = False
+):
     def step(state: TrainState, batch: Dict[str, jax.Array]) -> Metrics:
         outputs = state.apply_fn(
             {"params": state.params, "batch_stats": state.batch_stats},
@@ -637,20 +670,24 @@ def _make_eval_step_cached(mesh: Mesh, task, spatial: bool, with_valid: bool):
         mesh=mesh,
         in_specs=(P(), _batch_in_specs(spatial, keys)),
         out_specs=P(),
+        **_hybrid_kwargs(auto_model),
     )
     return jax.jit(sharded)
 
 
 def make_predict_step(
-    mesh: Mesh, task, *, spatial: bool = False
+    mesh: Mesh, task, *, spatial: bool = False, auto_model: bool = False
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Dict[str, jax.Array]]:
     """Jitted SPMD predict step (the reference's PREDICT branch, model.py:371-387);
-    outputs stay sharded on the batch axis. Memoized — see ``make_train_step``."""
-    return _make_predict_step_cached(mesh, task, spatial)
+    outputs stay sharded on the batch axis. Memoized — see ``make_train_step``;
+    ``auto_model`` is the same hybrid mode."""
+    return _make_predict_step_cached(mesh, task, spatial, auto_model)
 
 
 @functools.lru_cache(maxsize=None)
-def _make_predict_step_cached(mesh: Mesh, task, spatial: bool):
+def _make_predict_step_cached(
+    mesh: Mesh, task, spatial: bool, auto_model: bool = False
+):
     def step(state: TrainState, batch: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
         outputs = state.apply_fn(
             {"params": state.params, "batch_stats": state.batch_stats},
@@ -674,5 +711,6 @@ def _make_predict_step_cached(mesh: Mesh, task, spatial: bool):
         mesh=mesh,
         in_specs=(P(), _batch_in_specs(spatial, ("images",))),
         out_specs=P(BATCH_AXIS),
+        **_hybrid_kwargs(auto_model),
     )
     return jax.jit(sharded)

@@ -141,14 +141,20 @@ class Trainer:
         )
         self.task = step_lib.SegmentationTask()
         tcfg = self.train_config
-        if tcfg.model_parallel > 1:
-            raise NotImplementedError(
-                "model_parallel applies to the classification fit() loop "
-                "(GSPMD tensor parallelism); the K-fold segmentation Trainer "
-                "supports data + sequence parallelism"
-            )
+        # model_parallel > 1: tensor parallelism via shard_map's hybrid
+        # ``axis_names`` mode — params/optimizer channel-sharded over the
+        # model axis (parallel/tensor.py) while the step stays manual over
+        # (batch, sequence), so GSPMD derives the tensor-parallel reductions
+        # inside the K-fold segmentation loop's own step
+        # (make_train_step(auto_model=True)). TrainConfig keeps tp and sp
+        # mutually exclusive at the config level (fit()'s whole-step GSPMD tp
+        # cannot compose with sp); the library-level 3-axis composition is
+        # proven in tests/test_tensor_parallel.py + tests/test_multiprocess.py.
+        self._tp = tcfg.model_parallel > 1
         self.mesh = mesh_lib.make_mesh(
-            tcfg.n_devices, sequence_parallel=tcfg.sequence_parallel
+            tcfg.n_devices,
+            model_parallel=tcfg.model_parallel,
+            sequence_parallel=tcfg.sequence_parallel,
         )
         # sequence_parallel > 1: H-sharded backbone with halo-exchange convs and
         # sequence-synced BN (parallel/spatial.py; a TPU-first capability — the
@@ -205,6 +211,10 @@ class Trainer:
         if self._spatial:
             state = state.replace(apply_fn=self.model.apply)
         self._n_params = count_params(state.params)
+        if self._tp:
+            from tensorflowdistributedlearning_tpu.parallel import tensor as tp_lib
+
+            return tp_lib.shard_state_tensor_parallel(state, self.mesh)
         return mesh_lib.replicate(state, self.mesh)
 
     def _checkpointer(self, fold: int) -> CheckpointManager:
@@ -292,6 +302,7 @@ class Trainer:
             spatial=self._spatial,
             accum=self.train_config.grad_accum_steps,
             seed=self.train_config.seed,
+            auto_model=self._tp,
         )
         prepare = self._make_prepare_train(fold)
 
@@ -457,6 +468,15 @@ class Trainer:
     ) -> None:
         """input/label/probability/prediction image grids (reference:
         model.py:405-426 summarized the same four tensors)."""
+        if self._tp:
+            # the single-device forward cannot consume model-axis-sharded
+            # params; pull one addressable copy of ONLY what it reads (the
+            # Adam moments are ~2x the param bytes and _forward never
+            # touches them)
+            state = state.replace(
+                params=jax.device_get(state.params),
+                batch_stats=jax.device_get(state.batch_stats),
+            )
         outputs = self._forward(state, batch["images"])
         probs = np.asarray(jax.device_get(jax.nn.sigmoid(outputs)))[..., 0]
         images = np.asarray(jax.device_get(batch["images"]))[..., 0]
@@ -473,11 +493,15 @@ class Trainer:
 
     @property
     def _eval_step(self):
-        return step_lib.make_eval_step(self.mesh, self.task, spatial=self._spatial)
+        return step_lib.make_eval_step(
+            self.mesh, self.task, spatial=self._spatial, auto_model=self._tp
+        )
 
     @property
     def _predict_step(self):
-        return step_lib.make_predict_step(self.mesh, self.task, spatial=self._spatial)
+        return step_lib.make_predict_step(
+            self.mesh, self.task, spatial=self._spatial, auto_model=self._tp
+        )
 
     @property
     def _prepare_eval(self):

@@ -13,7 +13,7 @@ import sys
 # every strategy the "both" mode runs — the parent's completeness check
 # (tests/test_multiprocess.py:_run_workers) derives its expectation from this
 # tuple so adding a strategy here is automatically enforced there
-ALL_STRATEGIES = ("dp", "tp", "sp", "ep", "pp", "3ax", "zero")
+ALL_STRATEGIES = ("dp", "tp", "sp", "ep", "pp", "3ax", "tpsp", "zero")
 
 
 def main() -> int:
@@ -64,7 +64,7 @@ def main() -> int:
                 jax.random.PRNGKey(0),
                 np.zeros((1, 8, 8, 3), np.float32),
             )
-        if strategy == "sp":
+        if strategy in ("sp", "tpsp"):
             raw_state = raw_state.replace(
                 apply_fn=tiny_model(spatial=True).apply
             )
@@ -133,6 +133,29 @@ def main() -> int:
             train_step = step_lib.make_train_step(
                 mesh, step_lib.ClassificationTask(), donate=False, spatial=True
             )
+        elif strategy == "tpsp":
+            # THREE-axis dp x tp x sp via shard_map's HYBRID mode: the
+            # (batch=2, model=2, sequence=2) global mesh with (batch,
+            # sequence) manual — halo-exchange convs + gradient mean as
+            # explicit collectives — while the model axis stays auto: params
+            # channel-shard over it (shard_state_tensor_parallel) and the
+            # SPMD partitioner derives the tensor-parallel all-reduces
+            # INSIDE each manual shard. The composition the pairwise dp x tp
+            # (GSPMD) and dp x sp (shard_map) proofs could not reach, since
+            # the two execution strategies exclude each other whole-step.
+            from tensorflowdistributedlearning_tpu.parallel import tensor as tp_lib
+
+            mesh = mesh_lib.make_mesh(
+                None, model_parallel=2, sequence_parallel=2
+            )
+            state = tp_lib.shard_state_tensor_parallel(raw_state, mesh)
+            train_step = step_lib.make_train_step(
+                mesh,
+                step_lib.ClassificationTask(),
+                donate=False,
+                spatial=True,
+                auto_model=True,
+            )
         elif strategy == "pp":
             # multi-host PIPELINE parallelism: (batch=4, model=2) global mesh —
             # a tiny ViT's 2 blocks run as 2 GPipe stages (intra-process
@@ -174,7 +197,7 @@ def main() -> int:
         rows = multihost.process_local_rows(global_batch, mesh)
         local = {k: v[rows] for k, v in batch.items()}
         sharded = multihost.global_shard_batch(
-            local, mesh, spatial=(strategy in ("sp", "3ax"))
+            local, mesh, spatial=(strategy in ("sp", "3ax", "tpsp"))
         )
 
         new_state, metrics = train_step(state, sharded)

@@ -185,3 +185,58 @@ def test_fit_end_to_end_with_model_parallel(tmp_path):
 def test_model_and_sequence_parallel_mutually_exclusive():
     with pytest.raises(ValueError, match="cannot both exceed 1"):
         TrainConfig(model_parallel=2, sequence_parallel=2)
+
+
+def test_hybrid_tp_sp_step_matches_spatial_oracle():
+    """dp x tp x sp in ONE train step via shard_map's hybrid ``axis_names``
+    mode (make_train_step(auto_model=True)): (batch, sequence) manual — halo
+    exchange + explicit gradient mean — while the model axis stays auto with
+    channel-sharded params (GSPMD derives the tensor-parallel reductions
+    inside each manual shard). Loss matches the plain spatial step with
+    replicated params (tensor parallelism is a layout, not a numerics change,
+    up to reassociation), and params stay model-axis sharded after the
+    update. The 2-process twin is tests/test_multiprocess.py::
+    test_tensor_spatial_composition_across_processes."""
+    from tensorflowdistributedlearning_tpu.parallel.mesh import (
+        replicate,
+        shard_batch_spatial,
+    )
+    from tests.mp_train_worker import make_global_batch, tiny_model
+
+    spatial_model = tiny_model(spatial=True)
+    raw = create_train_state(
+        tiny_model(),  # init OUTSIDE shard_map with the plain twin
+        step_lib.make_optimizer(TrainConfig(lr=0.01)),
+        jax.random.PRNGKey(0),
+        np.zeros((1, 8, 8, 3), np.float32),
+    ).replace(apply_fn=spatial_model.apply)
+    batch = make_global_batch(16)
+
+    mesh3 = make_mesh(8, model_parallel=2, sequence_parallel=2)  # (2, 2, 2)
+    placed = tp_lib.shard_state_tensor_parallel(raw, mesh3)
+    kernel_spec = tuple(placed.params["conv"]["kernel"].sharding.spec)
+    assert MODEL_AXIS in kernel_spec, kernel_spec  # genuinely channel-sharded
+    hybrid_step = step_lib.make_train_step(
+        mesh3,
+        step_lib.ClassificationTask(),
+        donate=False,
+        spatial=True,
+        auto_model=True,
+    )
+    new_state, metrics = hybrid_step(placed, shard_batch_spatial(batch, mesh3))
+    hybrid_loss = step_lib.compute_metrics(jax.device_get(metrics))["loss"]
+
+    mesh_sp = make_mesh(8, sequence_parallel=2)  # (4, 1, 2) — the sp oracle
+    plain_step = step_lib.make_train_step(
+        mesh_sp, step_lib.ClassificationTask(), donate=False, spatial=True
+    )
+    _, m_plain = plain_step(
+        replicate(raw, mesh_sp), shard_batch_spatial(batch, mesh_sp)
+    )
+    oracle_loss = step_lib.compute_metrics(jax.device_get(m_plain))["loss"]
+
+    assert np.isfinite(hybrid_loss)
+    assert hybrid_loss == pytest.approx(oracle_loss, rel=1e-5)
+    # the updated params keep their model-axis sharding (no silent gather)
+    new_spec = tuple(new_state.params["conv"]["kernel"].sharding.spec)
+    assert MODEL_AXIS in new_spec, new_spec

@@ -269,3 +269,48 @@ def test_model_alias():
 def test_unknown_kwarg_rejected(tmp_path):
     with pytest.raises(ValueError, match="Unknown model config keys"):
         Trainer(str(tmp_path), "", weight_decayy=0.1)
+
+
+def test_tensor_parallel_trainer_end_to_end(salt_dirs, tmp_path_factory):
+    """The K-fold segmentation Trainer with model_parallel=2: params/optimizer
+    channel-shard over the model axis and every step (train/eval/predict) runs
+    in shard_map's hybrid auto-model mode (make_train_step(auto_model=True)).
+    Replaces round-4's NotImplementedError guard with the real capability.
+    Checkpoint/restore, best-export, and the TTA ensemble must all survive the
+    sharded state."""
+    import jax
+
+    from tensorflowdistributedlearning_tpu.parallel.mesh import MODEL_AXIS
+
+    data, test, ids = salt_dirs
+    model_dir = str(tmp_path_factory.mktemp("model_tp"))
+    tcfg = TrainConfig(
+        n_folds=2,
+        seed=0,
+        save_best=2,
+        checkpoint_every_steps=2,
+        eval_throttle_secs=0,
+        model_parallel=2,
+    )
+    trainer = Trainer(
+        model_dir,
+        data,
+        train_config=tcfg,
+        input_shape=SHAPE,
+        n_blocks=(1, 1, 1),
+        base_depth=8,
+        width_multiplier=0.125,  # conv widths divisible by tp degree 2
+    )
+    # the initial state is genuinely channel-sharded over the model axis
+    state = trainer._init_state()
+    kernel = state.params["backbone"]["conv1_3"]["conv"]["kernel"]
+    assert MODEL_AXIS in tuple(kernel.sharding.spec), kernel.sharding.spec
+
+    results = trainer.train(ids, batch_size=8, steps=4)
+    assert len(results) == 2
+    for fold_metrics in results:
+        assert np.isfinite(fold_metrics["loss"])
+
+    pred = trainer.predict(test, batch_size=8)
+    assert pred["masks"].shape == (len(pred["ids"]),) + SHAPE + (1,)
+    assert np.isfinite(pred["probabilities"]).all()
