@@ -1,0 +1,143 @@
+"""Train the segmentation flagship on REAL pixels: foreground (ink) masks over
+the genuine 8x8 digit scans, through the full reference-parity loop — K-fold
+Trainer, Lovász hinge, thresholded mIOU, best-checkpoint export, and the
+fold x TTA ensemble predict (the method the reference left as a TODO,
+reference: model.py:229).
+
+The reference's production task was binary masks over real single-channel
+images (TGS salt, reference: model.py:138-227); its notebooks proved the loop
+learned on real data. This driver is that proof for this framework: every
+committed segmentation number before it came from synthetic masks. The run
+record (held-out TTA-ensemble mIOU + per-fold eval mIOU) lands in
+``SEG_RUN.json`` at the repo root when run with ``--json-out``.
+
+Usage (CPU mesh, ~tgs_salt architecture at reduced width for the 1-core box):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/train_digit_seg.py --model-dir /tmp/digit_seg \
+        --steps 200 --batch-size 32 --n-fold 2 --width-multiplier 0.25
+
+On a TPU chip the full-width preset is the default: drop --width-multiplier.
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo-root sys.path setup)
+
+import argparse
+import json
+import logging
+import os
+import time
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--model-dir", required=True)
+    parser.add_argument("--data-dir", default=None,
+                        help="salt-layout corpus dir (default: {model-dir}/data; "
+                        "prepared automatically when absent)")
+    parser.add_argument("--steps", type=int, default=200)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--n-fold", type=int, default=2)
+    parser.add_argument("--size", type=int, default=101,
+                        help="square input size; 101 = the tgs_salt preset shape")
+    parser.add_argument("--width-multiplier", type=float, default=1.0,
+                        help="channel-width scale; 1.0 = the full tgs_salt "
+                        "architecture (41.7M params — size for your chip)")
+    parser.add_argument("--dtype", choices=("float32", "bfloat16"),
+                        default="float32",
+                        help="bfloat16 = the tgs_salt_bf16 preset's compute dtype")
+    parser.add_argument("--limit", type=int, default=None,
+                        help="cap examples per split (CI budgets)")
+    parser.add_argument("--json-out", default=None,
+                        help="write the run record (metrics/config/wall time) here")
+    args = parser.parse_args()
+
+    from tensorflowdistributedlearning_tpu.utils.devices import apply_platform_env
+
+    apply_platform_env()
+    logging.basicConfig(level=logging.INFO)
+
+    import jax
+    import numpy as np
+
+    from tensorflowdistributedlearning_tpu.config import TrainConfig
+    from tensorflowdistributedlearning_tpu.data.digits import (
+        SHORT_BUDGET_BN_DECAY,
+        prepare_digit_segmentation,
+    )
+    from tensorflowdistributedlearning_tpu.data import pipeline as pipeline_lib
+    from tensorflowdistributedlearning_tpu.ops import metrics as metrics_lib
+    from tensorflowdistributedlearning_tpu.train.trainer import Trainer
+
+    data_dir = args.data_dir or os.path.join(args.model_dir, "data")
+    train_dir = os.path.join(data_dir, "train")
+    test_dir = os.path.join(data_dir, "test")
+    if not os.path.isdir(os.path.join(train_dir, "images")):
+        prepare_digit_segmentation(
+            data_dir, size=(args.size, args.size), limit=args.limit
+        )
+
+    t0 = time.time()
+    trainer = Trainer(
+        args.model_dir,
+        train_dir,
+        n_fold=args.n_fold,
+        # reference training defaults otherwise: Adam 1e-3 (model.py:33),
+        # Lovász hinge, best-export ladder
+        train_config=TrainConfig(
+            n_folds=args.n_fold,
+            checkpoint_every_steps=max(args.steps // 2, 1),
+            eval_every_steps=max(args.steps // 2, 1),
+            eval_throttle_secs=0,
+        ),
+        # tgs_salt preset architecture (default ModelConfig), scaled by the
+        # explicit knobs only
+        input_shape=(args.size, args.size),
+        width_multiplier=args.width_multiplier,
+        dtype=args.dtype,
+        # short budgets evaluate on BN running stats; the digits recipes'
+        # faster decay keeps them honest (data/digits.py)
+        batch_norm_decay=SHORT_BUDGET_BN_DECAY,
+    )
+    ids = pipeline_lib.discover_ids(train_dir)
+    fold_metrics = trainer.train(ids, batch_size=args.batch_size, steps=args.steps)
+
+    # Held-out scoring: fold x TTA ensemble over images the K-fold pool never
+    # contained, scored with the same thresholded-IoU the eval loop reports.
+    pred = trainer.predict(test_dir, batch_size=args.batch_size)
+    truth = pipeline_lib.load_masks(test_dir, pred["ids"])
+    ensemble_miou = float(
+        np.mean(np.asarray(metrics_lib.iou_scores(truth, pred["masks"])))
+    )
+
+    record = {
+        "task": "digit_foreground_segmentation",
+        "data": "sklearn load_digits: 1797 real 8x8 scans, ink-threshold masks, "
+                f"bilinear-upsampled to {args.size}x{args.size}",
+        "architecture": "tgs_salt preset (ResNet-v2-beta + DeepLabV3+ head, "
+                        "Lovász hinge)"
+                        + (f" at width x{args.width_multiplier}"
+                           if args.width_multiplier != 1.0 else ""),
+        "dtype": args.dtype,
+        "platform": jax.devices()[0].platform,
+        "n_devices": len(jax.devices()),
+        "steps": args.steps,
+        "global_batch": args.batch_size,
+        "n_folds": args.n_fold,
+        "fold_eval_mean_iou": [
+            round(m["metrics/mean_iou"], 4) for m in fold_metrics
+        ],
+        "tta_ensemble_test_mean_iou": round(ensemble_miou, 4),
+        "n_test": len(pred["ids"]),
+        "wall_time_secs": round(time.time() - t0, 1),
+    }
+    print(json.dumps(record))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(record, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -46,6 +46,12 @@ def main() -> int:
                         help="model family: the reference-family ResNet trunk "
                         "or the Xception-41 classifier (the family whose "
                         "training path round-4's dropout-PRNG fix unblocked)")
+    parser.add_argument("--pipeline-parallel", type=int, default=1,
+                        help="GPipe stages over the model mesh axis (xception "
+                        "backbone: the 8 middle-flow units split into stage "
+                        "groups; 1 = plain SPMD). The r5 learning proof for "
+                        "pipelined-conv BN runs --backbone xception "
+                        "--pipeline-parallel 2")
     parser.add_argument("--recipe", choices=("adam", "sgd", "lars"),
                         default="adam",
                         help="adam = the validated short-budget recipe; sgd = "
@@ -104,12 +110,18 @@ def main() -> int:
         )
     # the shared validated recipes (data/digits.py) — the e2e test asserts
     # accuracy on exactly these settings
+    pp = {"pipeline_parallel": args.pipeline_parallel} if (
+        args.pipeline_parallel > 1) else {}
     if args.recipe == "sgd":
-        train_cfg = production_recipe_train_config(args.steps, args.batch_size)
+        train_cfg = production_recipe_train_config(
+            args.steps, args.batch_size, **pp
+        )
     elif args.recipe == "lars":
-        train_cfg = large_batch_recipe_train_config(args.steps, args.batch_size)
+        train_cfg = large_batch_recipe_train_config(
+            args.steps, args.batch_size, **pp
+        )
     else:
-        train_cfg = short_budget_train_config(args.steps)
+        train_cfg = short_budget_train_config(args.steps, **pp)
     trainer = ClassifierTrainer(args.model_dir, data_dir, model_cfg, train_cfg)
     t0 = time.perf_counter()
     result = trainer.fit(
@@ -124,6 +136,10 @@ def main() -> int:
         "params": result.n_params,
         "steps": result.steps,
         "global_batch": args.batch_size,
+        # 1797 x 0.8 = 1437 train scans: how many passes over the corpus the
+        # budget amounts to — the axis that makes recipe rows comparable
+        "epochs_equivalent": round(result.steps * args.batch_size / 1437.0, 1),
+        "pipeline_parallel": args.pipeline_parallel,
         "wall_time_s": round(wall, 1),
         "model_config": {"backbone": model_cfg.backbone,
                          # n_blocks only shapes the resnet family; Xception-41

@@ -1,0 +1,67 @@
+"""Real-pixel segmentation end to end: foreground (ink) masks over the genuine
+8x8 digit scans through the FULL flagship loop — salt-layout PNGs, K-fold
+Trainer, Lovász hinge, thresholded mIOU, best-export, fold x TTA ensemble
+predict — asserting the loop LEARNS real image statistics (every other
+segmentation test in the suite fits synthetic masks). CI twin of
+``examples/train_digit_seg.py`` / the committed ``SEG_RUN.json``; same data
+code (``data/digits.py:prepare_digit_segmentation``), scaled-down budget.
+
+Reference analogue: its notebooks' real TGS-salt runs (reference:
+model.py:138-227, Untitled.ipynb cells 7-8) — the production proof its repo
+had and unit tests cannot substitute for."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.config import TrainConfig
+from tensorflowdistributedlearning_tpu.data import pipeline as pipeline_lib
+from tensorflowdistributedlearning_tpu.data.digits import (
+    SHORT_BUDGET_BN_DECAY,
+    prepare_digit_segmentation,
+)
+from tensorflowdistributedlearning_tpu.ops import metrics as metrics_lib
+from tensorflowdistributedlearning_tpu.train.trainer import Trainer
+
+STEPS = 40
+SIZE = 64
+
+
+def test_digit_segmentation_learns_real_pixels(tmp_path):
+    data_dir = str(tmp_path / "data")
+    train_dir, test_dir = prepare_digit_segmentation(
+        data_dir, size=(SIZE, SIZE), limit=256
+    )
+    trainer = Trainer(
+        str(tmp_path / "run"),
+        train_dir,
+        n_fold=2,
+        train_config=TrainConfig(
+            n_folds=2,
+            checkpoint_every_steps=STEPS // 2,
+            eval_every_steps=STEPS // 2,
+            eval_throttle_secs=0,
+        ),
+        input_shape=(SIZE, SIZE),
+        width_multiplier=0.125,
+        batch_norm_decay=SHORT_BUDGET_BN_DECAY,
+    )
+    ids = pipeline_lib.discover_ids(train_dir)
+    fold_metrics = trainer.train(ids, batch_size=16, steps=STEPS)
+    assert len(fold_metrics) == 2
+    for m in fold_metrics:
+        assert np.isfinite(m["loss"])
+
+    # fold x TTA ensemble on images the K-fold pool never contained; the
+    # loose floor asserts real learning (an all-background or all-foreground
+    # prediction scores ~0.0-0.1 on this corpus; the committed SEG_RUN.json
+    # run documents what the full budget reaches)
+    pred = trainer.predict(test_dir, batch_size=16)
+    truth = pipeline_lib.load_masks(test_dir, pred["ids"])
+    miou = float(np.mean(np.asarray(metrics_lib.iou_scores(truth, pred["masks"]))))
+    assert miou >= 0.2, f"TTA-ensemble mIOU {miou:.3f} on held-out real pixels"
+
+    # best-export artifacts exist for every fold (the predict path used them)
+    for fold in range(2):
+        assert os.path.isdir(str(tmp_path / "run" / f"fold{fold}" / "export" / "best"))

@@ -93,6 +93,27 @@ SECTIONS = [
         ],
         1500,
     ),
+    # real-pixel segmentation at FULL tgs_salt width on the chip (r5: the
+    # CPU-budget committed run in SEG_RUN.json is width x0.125; the chip can
+    # afford the real preset — Lovász + mIOU + TTA ensemble on real scans)
+    (
+        "seg",
+        [
+            sys.executable,
+            os.path.join(REPO, "examples", "train_digit_seg.py"),
+            "--model-dir",
+            "/tmp/tfdl_seg_tpu",
+            "--steps",
+            "400",
+            "--batch-size",
+            "64",
+            "--n-fold",
+            "2",
+            "--json-out",
+            "/tmp/tfdl_seg_tpu_record.json",
+        ],
+        1800,
+    ),
     # full bench last: refreshes the headline + extras under the
     # merge-preserving cache (its own supervisor bounds the children)
     (
