@@ -27,6 +27,7 @@ import argparse
 import json
 import logging
 import os
+import shutil
 import time
 
 
@@ -73,10 +74,39 @@ def main() -> int:
     data_dir = args.data_dir or os.path.join(args.model_dir, "data")
     train_dir = os.path.join(data_dir, "train")
     test_dir = os.path.join(data_dir, "test")
-    if not os.path.isdir(os.path.join(train_dir, "images")):
+    # the prepared corpus depends on --size/--limit: reuse it only when a
+    # manifest proves the flags match, else re-prepare — a silent reuse would
+    # make the committed run record describe a corpus it never trained on
+    prep_manifest = os.path.join(data_dir, "prep_manifest.json")
+    wanted = {"size": [args.size, args.size], "limit": args.limit}
+    corpus_exists = os.path.isdir(os.path.join(train_dir, "images"))
+    have = None
+    if corpus_exists:
+        try:
+            with open(prep_manifest) as f:
+                have = json.load(f)
+        except (OSError, ValueError):
+            have = None
+    if corpus_exists and have is None:
+        # a corpus without a manifest was NOT written by this guard (a
+        # hand-prepared --data-dir, possibly a custom seed/split): reuse it
+        # untouched — deleting data this script didn't create is never ok
+        logging.info(
+            "reusing unmanaged corpus at %s (no prep manifest; --size/--limit "
+            "not verified against it)", data_dir,
+        )
+    elif have != wanted:
+        # ours (manifest present but flags changed) or absent: (re)prepare.
+        # Clear the old splits first — the writer names files d0000.png...
+        # sequentially, so a shrunken --limit would otherwise leave extras
+        for split in (train_dir, test_dir):
+            if os.path.isdir(split):
+                shutil.rmtree(split)
         prepare_digit_segmentation(
             data_dir, size=(args.size, args.size), limit=args.limit
         )
+        with open(prep_manifest, "w") as f:
+            json.dump(wanted, f)
 
     t0 = time.time()
     trainer = Trainer(

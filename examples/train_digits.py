@@ -136,9 +136,10 @@ def main() -> int:
         "params": result.n_params,
         "steps": result.steps,
         "global_batch": args.batch_size,
-        # 1797 x 0.8 = 1437 train scans: how many passes over the corpus the
+        # 1797 - int(1797*0.2) = 1438 train scans (the split in
+        # data/digits.py): how many passes over the corpus the
         # budget amounts to — the axis that makes recipe rows comparable
-        "epochs_equivalent": round(result.steps * args.batch_size / 1437.0, 1),
+        "epochs_equivalent": round(result.steps * args.batch_size / 1438.0, 1),
         "pipeline_parallel": args.pipeline_parallel,
         "wall_time_s": round(wall, 1),
         "model_config": {"backbone": model_cfg.backbone,

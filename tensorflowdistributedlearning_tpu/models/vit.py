@@ -46,6 +46,15 @@ from tensorflowdistributedlearning_tpu.models.layers import (  # noqa: E402
     _pallas_platform_ok as _fused_platform_ok,
 )
 
+# Sequence-length ceiling for the fused kernel, from the 2026-08-01 TPU v5e
+# microbench (tools/probe_attention.py, WINDOW_SPRINT.jsonl): at [32,T,6,64]
+# the Pallas train step beats XLA 1.151x at T=196 but LOSES 0.739x at T=1024
+# — XLA's own fusion wins once the score matrix no longer fits comfortably in
+# VMEM blocks. Gate at the measured winning regime only; the crossover lies
+# somewhere in (196, 1024), so the flag degrades to the XLA path above 256
+# rather than extrapolating the win.
+_FUSED_MAX_SEQ = 256
+
 
 class MultiHeadSelfAttention(nn.Module):
     """QKV projection + exact attention + output projection. ``spatial_axis_name``
@@ -78,7 +87,7 @@ class MultiHeadSelfAttention(nn.Module):
                     stacklevel=2,
                 )
             out = ring_attention(q, k, v, axis_name=self.spatial_axis_name)
-        elif self.use_fused and _fused_platform_ok():
+        elif self.use_fused and t <= _FUSED_MAX_SEQ and _fused_platform_ok():
             from tensorflowdistributedlearning_tpu.ops.flash_attention import (
                 flash_attention,
             )

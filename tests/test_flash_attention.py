@@ -116,3 +116,50 @@ def test_vit_uses_fused_attention_when_enabled(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(out_fused), np.asarray(out_plain), rtol=2e-5, atol=2e-5
     )
+
+
+def test_fused_attention_seq_gate(monkeypatch):
+    """Above ``_FUSED_MAX_SEQ`` the flag degrades to the XLA path: the
+    2026-08-01 v5e microbench measured the Pallas train step LOSING to XLA at
+    long sequence (0.739x at T=1024) while winning at short (1.151x at T=196),
+    so the dispatch only takes the kernel in the measured winning regime."""
+    import tensorflowdistributedlearning_tpu.models.vit as vit_mod
+    from tensorflowdistributedlearning_tpu.config import ModelConfig
+    from tensorflowdistributedlearning_tpu.models import build_model
+    from tensorflowdistributedlearning_tpu.ops import flash_attention as fa
+
+    monkeypatch.setattr(vit_mod, "_fused_platform_ok", lambda: True)
+    monkeypatch.setattr(vit_mod, "_FUSED_MAX_SEQ", 8)
+
+    def _must_not_dispatch(*a, **k):  # pragma: no cover - failure path
+        raise AssertionError("fused kernel dispatched above the seq gate")
+
+    monkeypatch.setattr(fa, "flash_attention", _must_not_dispatch)
+
+    cfg = ModelConfig(
+        backbone="vit",
+        num_classes=4,
+        input_shape=(16, 16),
+        input_channels=3,
+        patch_size=4,  # 16 tokens + cls > the patched gate of 8
+        embed_dim=32,
+        vit_layers=1,
+        num_heads=4,
+        output_stride=None,
+        use_fused_attention=True,
+    )
+    model = build_model(cfg)
+    x = jnp.asarray(
+        np.random.default_rng(7).normal(0, 1, (2, 16, 16, 3)), jnp.float32
+    )
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    model.apply(variables, x, train=False)  # must not raise
+
+
+def test_tpu_vit_presets_carry_the_measured_flip():
+    """The 2026-08-01 attention verdict lives in the presets: ViT-family TPU
+    presets ship with use_fused_attention=True (seq-gated in the dispatch)."""
+    from tensorflowdistributedlearning_tpu.configs import PRESETS
+
+    for name in ("vit_s16_imagenet", "vit_s16_moe_imagenet"):
+        assert PRESETS[name].model.use_fused_attention, name

@@ -17,8 +17,8 @@ Usage:
     # check $?. Each compile is unique via a baked-in constant and varying
     # shapes, defeating every cache layer (in-memory and persistent).
 
-Observed crash point (r5, this box): see REPRO_XLA_SEGFAULT.json next to
-this script after a run — the wrapper mode below writes it.
+Observed crash point (r5, this box): see REPRO_XLA_SEGFAULT.json at the
+repo root after a run — the wrapper mode below writes it.
 
     python tools/repro_xla_segfault.py --supervise
     # spawns itself as a child, records rc + last progress line + env to
@@ -41,8 +41,11 @@ def run_compiles(max_compiles: int, report_every: int) -> int:
     import jax.numpy as jnp
 
     jax.config.update("jax_platforms", "cpu")
-    # match the suite's regime: no persistent cache, every HLO fresh
-    os.environ["JAX_COMPILATION_CACHE_DIR"] = ""
+    # match the suite's regime: no persistent cache, every HLO fresh — must go
+    # through jax.config (env mutation after `import jax` is ignored; a stray
+    # exported JAX_COMPILATION_CACHE_DIR would otherwise cache-hit run 2 and
+    # print a false-negative SURVIVED)
+    jax.config.update("jax_compilation_cache_dir", None)
 
     rss_path = "/proc/self/status"
 
@@ -89,21 +92,40 @@ def supervise(max_compiles: int, report_every: int) -> int:
         f"--report-every={report_every}",
     ]
     t0 = time.time()
-    proc = subprocess.run(args, capture_output=True, text=True)
-    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    # generous per-compile allowance; a wedged compile (the documented
+    # remote-hang failure mode) must still leave evidence, not block forever
+    budget_secs = max(600, max_compiles * 3)
+    hung = False
+    try:
+        proc = subprocess.run(
+            args, capture_output=True, text=True, timeout=budget_secs
+        )
+        returncode, stdout, stderr = proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        hung = True
+        returncode = None
+        stdout = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (
+            e.stdout or ""
+        )
+        stderr = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) else (
+            e.stderr or ""
+        )
+    lines = [ln for ln in stdout.splitlines() if ln.strip()]
     last = lines[-1] if lines else ""
     import jax
 
     record = {
         "script": "tools/repro_xla_segfault.py",
-        "returncode": proc.returncode,
-        "crashed": proc.returncode not in (0,),
-        "signal": -proc.returncode if proc.returncode < 0 else None,
+        "returncode": returncode,
+        # only a signal death is the repro; rc>0 is a setup failure, not a crash
+        "crashed": returncode is not None and returncode < 0,
+        "hung": hung,
+        "signal": -returncode if (returncode or 0) < 0 else None,
         "last_progress": last,
         "max_compiles": max_compiles,
         "wall_secs": round(time.time() - t0, 1),
         "jax_version": jax.__version__,
-        "stderr_tail": proc.stderr[-500:],
+        "stderr_tail": stderr[-500:],
     }
     out_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "..", "REPRO_XLA_SEGFAULT.json"
