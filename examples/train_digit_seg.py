@@ -80,6 +80,9 @@ def main() -> int:
     prep_manifest = os.path.join(data_dir, "prep_manifest.json")
     wanted = {"size": [args.size, args.size], "limit": args.limit}
     corpus_exists = os.path.isdir(os.path.join(train_dir, "images"))
+    # the default {model-dir}/data location is always ours to manage; an
+    # explicit --data-dir may hold a hand-prepared corpus we must not delete
+    managed = args.data_dir is None
     have = None
     if corpus_exists:
         try:
@@ -87,10 +90,10 @@ def main() -> int:
                 have = json.load(f)
         except (OSError, ValueError):
             have = None
-    if corpus_exists and have is None:
-        # a corpus without a manifest was NOT written by this guard (a
-        # hand-prepared --data-dir, possibly a custom seed/split): reuse it
-        # untouched — deleting data this script didn't create is never ok
+    if corpus_exists and have is None and not managed:
+        # a user-supplied corpus without a manifest was NOT written by this
+        # guard (possibly a custom seed/split): reuse it untouched — deleting
+        # data this script didn't create is never ok
         logging.info(
             "reusing unmanaged corpus at %s (no prep manifest; --size/--limit "
             "not verified against it)", data_dir,
@@ -102,6 +105,12 @@ def main() -> int:
         for split in (train_dir, test_dir):
             if os.path.isdir(split):
                 shutil.rmtree(split)
+        # in-progress sentinel first: an interrupted prepare leaves a manifest
+        # that can never equal `wanted`, so the next run re-prepares instead
+        # of silently reusing a truncated corpus
+        os.makedirs(data_dir, exist_ok=True)
+        with open(prep_manifest, "w") as f:
+            json.dump({"in_progress": True}, f)
         prepare_digit_segmentation(
             data_dir, size=(args.size, args.size), limit=args.limit
         )

@@ -226,8 +226,21 @@ def op_breakdown(
         effective_filter = line_filter
         if effective_filter is None and any("XLA Ops" in line for line in lines):
             effective_filter = "XLA Ops"
+        # TPU device planes carry BOTH an 'XLA Ops' line (the serialized
+        # TensorCore timeline — sums to the step wall) and an 'Async XLA
+        # Ops' line (copy-start/done spans that OVERLAP compute; on the
+        # 2026-08-01 v5e capture it summed to 7x the wall). A substring
+        # match would fold both and invent a giant copy bucket, so whenever
+        # the requested filter names an existing line EXACTLY — auto-selected
+        # or user-supplied — only that line contributes.
+        exact_only = effective_filter is not None and any(
+            line == effective_filter for line in lines
+        )
         for line_name, line_agg in lines.items():
-            if effective_filter and effective_filter not in line_name:
+            if exact_only:
+                if line_name != effective_filter:
+                    continue
+            elif effective_filter and effective_filter not in line_name:
                 continue
             for op, (ms, cnt) in line_agg.items():
                 entry = agg.setdefault(op, [0.0, 0])

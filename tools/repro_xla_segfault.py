@@ -104,11 +104,17 @@ def supervise(max_compiles: int, report_every: int) -> int:
     except subprocess.TimeoutExpired as e:
         hung = True
         returncode = None
-        stdout = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (
-            e.stdout or ""
+        # errors="replace": the kill can truncate output mid multi-byte char,
+        # and a decode crash here would lose the evidence record entirely
+        stdout = (
+            (e.stdout or b"").decode(errors="replace")
+            if isinstance(e.stdout, bytes)
+            else (e.stdout or "")
         )
-        stderr = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) else (
-            e.stderr or ""
+        stderr = (
+            (e.stderr or b"").decode(errors="replace")
+            if isinstance(e.stderr, bytes)
+            else (e.stderr or "")
         )
     lines = [ln for ln in stdout.splitlines() if ln.strip()]
     last = lines[-1] if lines else ""
