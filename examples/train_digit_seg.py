@@ -101,10 +101,14 @@ def main() -> int:
     elif have != wanted:
         # ours (manifest present but flags changed) or absent: (re)prepare.
         # Clear the old splits first — the writer names files d0000.png...
-        # sequentially, so a shrunken --limit would otherwise leave extras
-        for split in (train_dir, test_dir):
-            if os.path.isdir(split):
-                shutil.rmtree(split)
+        # sequentially, so a shrunken --limit would otherwise leave extras.
+        # Only dirs we PROVABLY wrote (default location, or manifest present)
+        # are deleted; an unmanaged --data-dir tree is written into, never
+        # cleared — deleting data this script didn't create is never ok
+        if managed or have is not None:
+            for split in (train_dir, test_dir):
+                if os.path.isdir(split):
+                    shutil.rmtree(split)
         # in-progress sentinel first: an interrupted prepare leaves a manifest
         # that can never equal `wanted`, so the next run re-prepares instead
         # of silently reusing a truncated corpus

@@ -224,15 +224,20 @@ def op_breakdown(
     agg: Dict[str, List[float]] = {}
     for lines in plane_lines:
         effective_filter = line_filter
+        auto_selected = False
         if effective_filter is None and any("XLA Ops" in line for line in lines):
             effective_filter = "XLA Ops"
+            auto_selected = True
         # TPU device planes carry BOTH an 'XLA Ops' line (the serialized
         # TensorCore timeline — sums to the step wall) and an 'Async XLA
         # Ops' line (copy-start/done spans that OVERLAP compute; on the
         # 2026-08-01 v5e capture it summed to 7x the wall). A substring
         # match would fold both and invent a giant copy bucket, so whenever
         # the requested filter names an existing line EXACTLY — auto-selected
-        # or user-supplied — only that line contributes.
+        # or user-supplied — only that line contributes; and the auto-select
+        # additionally never folds Async timelines even when no exact name
+        # matches (a plane with ONLY 'Async XLA Ops' contributes nothing
+        # rather than corrupting every fraction).
         exact_only = effective_filter is not None and any(
             line == effective_filter for line in lines
         )
@@ -241,6 +246,8 @@ def op_breakdown(
                 if line_name != effective_filter:
                     continue
             elif effective_filter and effective_filter not in line_name:
+                continue
+            elif auto_selected and "Async" in line_name:
                 continue
             for op, (ms, cnt) in line_agg.items():
                 entry = agg.setdefault(op, [0.0, 0])

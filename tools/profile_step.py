@@ -77,8 +77,12 @@ def main(argv=None) -> int:
         shard_batch,
     )
     from tensorflowdistributedlearning_tpu.train.state import create_train_state
+    from tensorflowdistributedlearning_tpu.data.synthetic import (
+        synthetic_segmentation_batch,
+    )
     from tensorflowdistributedlearning_tpu.train.step import (
         ClassificationTask,
+        SegmentationTask,
         make_optimizer,
         make_train_step,
     )
@@ -102,16 +106,24 @@ def main(argv=None) -> int:
     )
     gen = np.random.default_rng(0)
     global_b = args.batch * n
-    batch = shard_batch(
-        {
+    # segmentation presets (tgs_salt*) have no class count — dense [B,H,W,1]
+    # labels and the SegmentationTask loss; classification presets get the
+    # integer-label task bench.py's headline measures
+    if cfg.num_classes:
+        batch = {
             "images": gen.normal(0, 1, (global_b, h, w, cfg.input_channels)).astype(
                 np.float32
             ),
             "labels": gen.integers(0, cfg.num_classes, global_b).astype(np.int32),
-        },
-        mesh,
-    )
-    step = make_train_step(mesh, ClassificationTask(), donate=False)
+        }
+        task = ClassificationTask()
+    else:
+        batch = synthetic_segmentation_batch(
+            gen, global_b, input_shape=(h, w), channels=cfg.input_channels
+        )
+        task = SegmentationTask()
+    batch = shard_batch(batch, mesh)
+    step = make_train_step(mesh, task, donate=False)
     comp = step.lower(state, batch).compile()
     s = state
     for _ in range(max(args.warmup, 1)):  # >=1: the timed loop needs a synced start

@@ -97,8 +97,14 @@ def supervise(max_compiles: int, report_every: int) -> int:
     budget_secs = max(600, max_compiles * 3)
     hung = False
     try:
+        # errors="replace" on the normal path too: the child dies by SIGSEGV
+        # by design and can truncate output mid multi-byte char either way
         proc = subprocess.run(
-            args, capture_output=True, text=True, timeout=budget_secs
+            args,
+            capture_output=True,
+            text=True,
+            errors="replace",
+            timeout=budget_secs,
         )
         returncode, stdout, stderr = proc.returncode, proc.stdout, proc.stderr
     except subprocess.TimeoutExpired as e:
