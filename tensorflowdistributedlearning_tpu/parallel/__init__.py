@@ -12,6 +12,7 @@ from tensorflowdistributedlearning_tpu.parallel.mesh import (
     replicate,
     replicated_sharding,
     shard_batch,
+    shard_batch_stacked,
 )
 from tensorflowdistributedlearning_tpu.parallel.collectives import (
     pmean_tree,
@@ -81,6 +82,7 @@ __all__ = [
     "replicate",
     "replicated_sharding",
     "shard_batch",
+    "shard_batch_stacked",
     "pmean_tree",
     "psum_tree",
 ]

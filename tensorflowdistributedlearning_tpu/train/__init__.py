@@ -5,6 +5,7 @@ from tensorflowdistributedlearning_tpu.train.step import (
     make_eval_step,
     make_optimizer,
     make_predict_step,
+    make_multi_train_step,
     make_train_step,
 )
 
@@ -16,5 +17,6 @@ __all__ = [
     "make_eval_step",
     "make_optimizer",
     "make_predict_step",
+    "make_multi_train_step",
     "make_train_step",
 ]
