@@ -20,26 +20,80 @@ import time
 from typing import Dict
 
 
-def _timed_us(fn, args, iters: int, warmup: int) -> float:
-    """Shared measurement protocol for every kernel comparison in this file:
-    compile once, warm up, then one synchronized timed loop (microseconds per
-    call). Keeping one copy keeps the pallas/XLA decision columns comparable.
+def _chained(fn, repeats: int):
+    """``fn`` applied ``repeats`` times inside ONE jitted program, output fed
+    back as the first argument (every kernel here maps arg0's shape to
+    itself). This is the r5 dispatch-latency fix: a single kernel call over
+    the tunnel costs 35-135 ms of dispatch/sync for sub-millisecond device
+    work, so unchained microbenches measured the TUNNEL (ratios compressed
+    toward 1, earlier single-window swings of 0.9x-2.8x were pure dispatch
+    noise in both directions). Chaining makes device work dominate the
+    window; per-kernel time = call time / repeats. An rsqrt renorm keeps the
+    iterates bounded. The renorm is an ADDITIVE shared cost c on both
+    sides, which compresses ratios toward 1 by c/(kernel time); at these
+    shapes c is a single elementwise pass (~20-100 MB at 819 GB/s, 25-120us)
+    against per-kernel times of 4,600-26,000us — a <1% bias, far below the
+    decision margins quoted from this file."""
+    import jax
+    import jax.numpy as jnp
 
-    Synchronizes via ``profiling.sync`` (a real value fetch): on the tunneled
-    TPU backend ``block_until_ready`` alone has been observed to return before
-    execution finishes, inflating throughput ~10x (see bench.py's measure)."""
+    def run(x, *rest):
+        def body(_, acc):
+            y = fn(acc, *rest)
+            scale = jax.lax.rsqrt(jnp.mean(jnp.square(y).astype(jnp.float32)) + 1e-6)
+            return (y.astype(jnp.float32) * scale).astype(y.dtype)
+
+        return jax.lax.fori_loop(0, repeats, body, x)
+
+    return jax.jit(run)
+
+
+def _paired_us(fn_a, fn_b, args, iters: int, warmup: int, trials: int = 5,
+               repeats: int = 1):
+    """A/B comparison robust to tunnel drift: r5 observed the SAME depthwise
+    column swing 0.9x-2.8x across bench runs because each side got one
+    sequential window and the tunnel's throughput drifts minute-to-minute.
+    Here the two sides run in short INTERLEAVED trials (A,B,A,B,...) and the
+    decision column is the MEDIAN of per-trial ratios — drift hits adjacent
+    trials equally and cancels in the ratio; the median rejects stragglers.
+    ``repeats`` chains the kernel inside each call (see ``_chained``) so
+    device work dominates the tunnel's per-dispatch cost.
+    Returns (a_us, b_us, b_over_a) as medians of PER-KERNEL microseconds."""
     from tensorflowdistributedlearning_tpu.utils.profiling import sync
 
-    out = fn(*args)  # compile
-    sync(out)
-    for _ in range(warmup):
+    if repeats > 1:
+        fn_a = _chained(fn_a, repeats)
+        fn_b = _chained(fn_b, repeats)
+    else:
+        # repeats=1 must still time a compiled executable, not eager tracing
+        import jax
+
+        fn_a, fn_b = jax.jit(fn_a), jax.jit(fn_b)
+
+    for fn in (fn_a, fn_b):  # compile + warm both before any timing
         out = fn(*args)
-    sync(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    sync(out)
-    return (time.perf_counter() - t0) / iters * 1e6
+        sync(out)
+        for _ in range(warmup):
+            out = fn(*args)
+        sync(out)
+
+    def window(fn) -> float:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        sync(out)
+        return (time.perf_counter() - t0) / (iters * repeats) * 1e6
+
+    a_times, b_times, ratios = [], [], []
+    for _ in range(trials):
+        a = window(fn_a)
+        b = window(fn_b)
+        a_times.append(a)
+        b_times.append(b)
+        ratios.append(b / a)
+
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    return med(a_times), med(b_times), med(ratios)
 
 
 def bench_depthwise(
@@ -49,6 +103,7 @@ def bench_depthwise(
     rates=(1, 2, 4, 8),
     iters: int = 30,
     warmup: int = 5,
+    repeats: int = 64,
 ) -> Dict:
     import jax
     import numpy as np
@@ -66,20 +121,17 @@ def bench_depthwise(
     results: Dict = {}
     wins = 0
     for rate in rates:
-        pallas_us = _timed_us(
-            jax.jit(lambda a, b, r=rate: depthwise_conv2d(a, b, r)),
-            (x, w), iters, warmup,
-        )
-        xla_us = _timed_us(
-            jax.jit(lambda a, b, r=rate: depthwise_conv2d_reference(a, b, r)),
-            (x, w), iters, warmup,
+        pallas_us, xla_us, speedup = _paired_us(
+            lambda a, b, r=rate: depthwise_conv2d(a, b, r),
+            lambda a, b, r=rate: depthwise_conv2d_reference(a, b, r),
+            (x, w), max(2, iters // 10), warmup, repeats=repeats,
         )
         results[f"rate{rate}"] = {
             "pallas_us": round(pallas_us, 1),
             "xla_us": round(xla_us, 1),
-            "speedup": round(xla_us / pallas_us, 3),
+            "speedup": round(speedup, 3),
         }
-        wins += pallas_us < xla_us
+        wins += speedup > 1.0
     results["pallas_wins"] = bool(wins > len(rates) / 2)
     results["shape"] = [batch, hw, hw, channels]
     return results
@@ -94,6 +146,7 @@ def bench_attention(
     warmup: int = 5,
     train_cols: bool = True,
     on_forward_done=None,
+    repeats: int = 16,
 ) -> Dict:
     """Fused Pallas block attention vs the XLA einsum path at ViT-S shapes
     (T=196 is ViT-S/16 at 224x224; T=1024 is the long-block regime the ring
@@ -128,18 +181,17 @@ def bench_attention(
             ).astype(jnp.bfloat16)
             for _ in range(3)
         )
-        pallas_us = _timed_us(
-            jax.jit(lambda a, b, c: flash_attention(a, b, c)), qkv[t], iters, warmup
-        )
-        xla_us = _timed_us(
-            jax.jit(lambda a, b, c: attention_reference(a, b, c)), qkv[t], iters, warmup
+        pallas_us, xla_us, speedup = _paired_us(
+            lambda a, b, c: flash_attention(a, b, c),
+            lambda a, b, c: attention_reference(a, b, c),
+            qkv[t], max(2, iters // 10), warmup, repeats=repeats,
         )
         results[f"seq{t}"] = {
             "pallas_us": round(pallas_us, 1),
             "xla_us": round(xla_us, 1),
-            "speedup": round(xla_us / pallas_us, 3),
+            "speedup": round(speedup, 3),
         }
-        fwd_wins[t] = pallas_us < xla_us
+        fwd_wins[t] = speedup > 1.0
 
     results["shape"] = [batch, "T", heads, head_dim]
     results["pallas_wins_fwd"] = bool(sum(fwd_wins.values()) > len(seq_lens) / 2)
@@ -157,26 +209,39 @@ def bench_attention(
     wins = 0
     if train_cols:
         def train_readout(fn):
-            def loss(a, b, c):
-                return jnp.sum(fn(a, b, c).astype(jnp.float32))
+            """fwd+bwd per chained iteration: the grad tuple is not shape-
+            preserving, so the chain carries q through a tiny SGD-like update
+            (one forward + one backward per repeat — the quantity the train
+            step pays; same chain on both comparison sides)."""
+            grad_fn = jax.grad(
+                lambda a, b, c: jnp.sum(fn(a, b, c).astype(jnp.float32)),
+                argnums=(0, 1, 2),  # full backward — all of dq/dk/dv, as the
+                # train step pays; q/k/v share one shape so the sum chains
+            )
 
-            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            def one(a, b, c):
+                gq, gk, gv = grad_fn(a, b, c)
+                upd = (gq.astype(jnp.float32) + gk.astype(jnp.float32)
+                       + gv.astype(jnp.float32))
+                return (a.astype(jnp.float32) - 1e-3 * upd).astype(a.dtype)
+
+            return one
 
         for t in seq_lens:
-            pallas_train_us = _timed_us(
-                train_readout(flash_attention), qkv[t], iters, warmup
-            )
-            xla_train_us = _timed_us(
-                train_readout(attention_reference), qkv[t], iters, warmup
+            pallas_train_us, xla_train_us, speedup_train = _paired_us(
+                train_readout(flash_attention),
+                train_readout(attention_reference),
+                qkv[t], max(2, iters // 10), warmup,
+                repeats=max(repeats // 2, 1),
             )
             results[f"seq{t}"].update(
                 {
                     "pallas_train_us": round(pallas_train_us, 1),
                     "xla_train_us": round(xla_train_us, 1),
-                    "speedup_train": round(xla_train_us / pallas_train_us, 3),
+                    "speedup_train": round(speedup_train, 3),
                 }
             )
-            wins += fwd_wins[t] and (pallas_train_us < xla_train_us)
+            wins += fwd_wins[t] and speedup_train > 1.0
     else:
         wins = sum(fwd_wins.values())
     results["pallas_wins"] = bool(wins > len(seq_lens) / 2)
@@ -188,7 +253,13 @@ def main() -> None:
 
     if "--platform=cpu" in sys.argv:
         jax.config.update("jax_platforms", "cpu")
-    out = bench_depthwise()
+    if jax.default_backend() == "tpu":
+        out = bench_depthwise()
+    else:
+        # chained repeats through the Pallas interpreter are minutes-per-call;
+        # tiny everything keeps the CPU smoke bounded
+        out = bench_depthwise(batch=2, hw=5, channels=8, iters=2, warmup=1,
+                              repeats=2)
     out["platform"] = jax.default_backend()
     print(json.dumps(out), flush=True)
     if jax.default_backend() == "tpu":
@@ -197,7 +268,8 @@ def main() -> None:
         # off-TPU the kernel runs in the (slow) Pallas interpreter; tiny shapes
         # keep the smoke run bounded — the decision data only means anything on
         # real hardware anyway
-        attn = bench_attention(batch=2, seq_lens=(64,), iters=3, warmup=1)
+        attn = bench_attention(batch=2, seq_lens=(64,), iters=2, warmup=1,
+                               repeats=2)
     attn["platform"] = jax.default_backend()
     print(json.dumps({"attention": attn}), flush=True)
 

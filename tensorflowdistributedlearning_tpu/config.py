@@ -58,16 +58,16 @@ class ModelConfig:
     # route the ASPP's atrous depthwise convs through the Pallas VMEM kernel
     # (ops/pallas_kernels.py) instead of XLA's grouped conv; parameter trees are
     # identical between the two paths, so this is a pure execution-path switch.
-    # Rate-aware AND platform-aware: v5e microbenches measured XLA faster
-    # below atrous rate 4 and the Pallas kernel 1.2-1.43x faster at rates
-    # 4/8, so the dispatch (models/layers.py:DepthwiseConv2D) engages Pallas
-    # only at rate >= 4 and only on TPU (off-TPU it degrades to XLA rather
-    # than crawl through the Pallas interpreter). With both gates the flag
-    # only ever takes a measured-winning path, so it defaults ON — the
-    # rate-4 and rate-8 ASPP branches (models/resnet.py ASPP rates 2/4/8)
-    # get the kernel on hardware; the rate-2 branch and the decoder stay on
-    # XLA where XLA measured faster.
-    use_pallas_depthwise: bool = True
+    # Default OFF on STEP-LEVEL evidence (2026-08-01 v5e A/B, bf16 flagship,
+    # best-of-3 40-step windows): pure XLA 37.95 ms/step vs 41.03 (Pallas at
+    # rates >= 4, the old gate) vs 41.36 (all rates). The standalone kernel
+    # genuinely beats XLA's grouped conv 1.46-1.61x per kernel
+    # (bench_kernels.py, device-dominated protocol) — but inside the real
+    # step XLA fuses depthwise+BN+ReLU chains, and the custom call forces
+    # materialization that costs more than the kernel saves. The flag stays
+    # for non-fused contexts; the dispatch remains rate- and platform-aware
+    # (models/layers.py:DepthwiseConv2D).
+    use_pallas_depthwise: bool = False
     # rematerialize residual units on the backward pass (jax.checkpoint): trades
     # recompute FLOPs for activation HBM — enables large per-chip batches.
     remat: bool = False

@@ -140,10 +140,10 @@ PRESETS: Dict[str, Preset] = {
             embed_dim=384,
             vit_layers=12,
             num_heads=6,
-            # measured ON: Pallas fused attention beats XLA 1.151x on the
-            # train step at this preset's seq length (196+cls) on TPU v5e
-            # (2026-08-01 probe); the dispatch itself degrades to XLA above
-            # seq 256 and off-TPU (models/vit.py:_FUSED_MAX_SEQ)
+            # measured ON (2026-08-01 device-dominated microbench): train
+            # step is a tie, long-seq forward wins 1.14x, no measured
+            # downside; the dispatch degrades to XLA above seq 1024 and
+            # off-TPU (models/vit.py:_FUSED_MAX_SEQ)
             use_fused_attention=True,
         ),
         # transformers keep Adam (SGD momentum trains ViTs poorly); standard

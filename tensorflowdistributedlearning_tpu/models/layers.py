@@ -298,12 +298,14 @@ class DepthwiseConv2D(nn.Module):
             depthwise_conv2d_reference,
         )
 
-        # rate-aware, PLATFORM-aware dispatch: hardware microbenches (see
-        # PALLAS_DEPTHWISE_MIN_RATE) show XLA wins below rate 4 and the Pallas
-        # kernel wins at 4+, so the flag engages only where measured to win —
-        # and only on TPU, where the kernel is compiled; everywhere else
-        # (the CPU test mesh) Pallas runs in the slow interpreter, so the
-        # flag safely degrades to XLA and presets/defaults can leave it on.
+        # rate-aware, PLATFORM-aware dispatch. Two levels of v5e evidence
+        # (2026-08-01): per-kernel, Pallas wins every atrous rate
+        # (1.46-1.61x, see PALLAS_DEPTHWISE_MIN_RATE); step-level, XLA's
+        # depthwise+BN+ReLU fusion beats the custom call in the real
+        # flagship step — which is why use_pallas_depthwise defaults False
+        # (config.py). The gate machinery stays for opt-in unfused
+        # contexts. TPU-only either way: elsewhere (the CPU test mesh)
+        # Pallas runs in the slow interpreter and degrades to XLA.
         dw = (
             depthwise_conv2d
             if (

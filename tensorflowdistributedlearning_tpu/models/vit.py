@@ -46,14 +46,15 @@ from tensorflowdistributedlearning_tpu.models.layers import (  # noqa: E402
     _pallas_platform_ok as _fused_platform_ok,
 )
 
-# Sequence-length ceiling for the fused kernel, from the 2026-08-01 TPU v5e
-# microbench (tools/probe_attention.py, WINDOW_SPRINT.jsonl): at [32,T,6,64]
-# the Pallas train step beats XLA 1.151x at T=196 but LOSES 0.739x at T=1024
-# — XLA's own fusion wins once the score matrix no longer fits comfortably in
-# VMEM blocks. Gate at the measured winning regime only; the crossover lies
-# somewhere in (196, 1024), so the flag degrades to the XLA path above 256
-# rather than extrapolating the win.
-_FUSED_MAX_SEQ = 256
+# Sequence-length ceiling for the fused kernel. Under the 2026-08-01
+# DEVICE-DOMINATED protocol (bench_kernels._chained — single-call windows
+# over the tunnel were 97%+ dispatch latency, producing the earlier
+# contradictory 0.74x-1.15x train columns) the verdict at [32,T,6,64] is:
+# train-step TIE at both T=196 and T=1024 (1.003x/1.005x), forward 0.97x at
+# 196 and 1.14x at 1024. The gate sits at the measured ceiling — above it
+# the kernel is unmeasured, and ops/flash_attention.py's own VMEM-budget
+# fallback (_VMEM_KV_LIMIT_BYTES) already degrades oversized shapes to XLA.
+_FUSED_MAX_SEQ = 1024
 
 
 class MultiHeadSelfAttention(nn.Module):

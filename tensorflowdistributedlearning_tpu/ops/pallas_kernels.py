@@ -32,14 +32,18 @@ from tensorflowdistributedlearning_tpu.parallel.collectives import vma_of
 # alongside double-buffering; beyond this the public wrapper falls back to XLA.
 _VMEM_BLOCK_LIMIT_BYTES = 4 * 1024 * 1024
 
-# Measured on a v5e chip (bench_kernels.py via bench.py, 2026-07-31, ASPP shape
-# [32, 13, 13, 1024]): Pallas vs XLA grouped conv speedup by atrous rate —
-# rate 1: 0.90x, rate 2: 0.71x, rate 4: 1.20x, rate 8: 1.43x. XLA's lowering
-# wins while the dilated footprint is small; once the gather spreads past
-# rate 4 the shift-accumulate VMEM kernel wins. Models gate their Pallas
-# dispatch on this threshold (models/layers.py:DepthwiseConv2D), so enabling
-# `use_pallas_depthwise` only ever takes the measured-winning path.
-PALLAS_DEPTHWISE_MIN_RATE = 4
+# Measured on a v5e chip under the DEVICE-DOMINATED protocol
+# (bench_kernels.py `_chained` + interleaved median-of-ratios, 2026-08-01,
+# ASPP shape [32, 13, 13, 1024]): Pallas vs XLA grouped conv — rate 1:
+# 1.51x, rate 2: 1.46x, rate 4: 1.56x, rate 8: 1.61x. The shift-accumulate
+# VMEM kernel is rate-independent (~4.6 ms/chained-kernel) while XLA's
+# grouped-conv lowering sits at ~7.3 ms at every rate. The old threshold of
+# 4 came from per-call windows that were 97%+ tunnel dispatch latency for
+# sub-ms device work — those "XLA wins below rate 4" columns (0.71-0.90x)
+# were dispatch noise, later swinging to 2.8x in other windows; the chained
+# protocol cancels it. Models gate their Pallas dispatch on this threshold
+# (models/layers.py:DepthwiseConv2D); 1 = every rate takes the kernel.
+PALLAS_DEPTHWISE_MIN_RATE = 1
 
 
 def pallas_platform_ok() -> bool:

@@ -645,9 +645,12 @@ def make_multi_train_step(
     host cannot (slow drivers, per-step callbacks, very short steps) and as
     the steps-per-loop parity point with the reference.
 
-    Semantics are EXACTLY K sequential ``make_train_step`` calls — the scan
+    Semantics are those of K sequential ``make_train_step`` calls — the scan
     body IS the single step (same builder, same PRNG fold-in on
-    ``state.step``, same BN/metric math), pinned bitwise by
+    ``state.step``, same BN/metric math). Numerically equivalent, NOT
+    bitwise: scan inlining lets XLA fuse differently (Lovász tie-order
+    shifts bound the drift at ~1e-4 scale after 3 steps); pinned with a
+    reversed-order discriminator by
     ``tests/test_train_step.py::test_multi_step_matches_sequential``.
 
     Input contract: every batch leaf carries a leading ``[n_steps]`` axis —

@@ -119,10 +119,11 @@ def test_vit_uses_fused_attention_when_enabled(monkeypatch):
 
 
 def test_fused_attention_seq_gate(monkeypatch):
-    """Above ``_FUSED_MAX_SEQ`` the flag degrades to the XLA path: the
-    2026-08-01 v5e microbench measured the Pallas train step LOSING to XLA at
-    long sequence (0.739x at T=1024) while winning at short (1.151x at T=196),
-    so the dispatch only takes the kernel in the measured winning regime."""
+    """Above ``_FUSED_MAX_SEQ`` the flag degrades to the XLA path: the gate
+    sits at the measured ceiling (1024 under the device-dominated protocol —
+    beyond it the kernel is unmeasured and the VMEM fallback applies), and
+    this test pins the MACHINERY by patching the gate low and confirming the
+    kernel is not dispatched above it."""
     import tensorflowdistributedlearning_tpu.models.vit as vit_mod
     from tensorflowdistributedlearning_tpu.config import ModelConfig
     from tensorflowdistributedlearning_tpu.models import build_model
@@ -157,8 +158,9 @@ def test_fused_attention_seq_gate(monkeypatch):
 
 
 def test_tpu_vit_presets_carry_the_measured_flip():
-    """The 2026-08-01 attention verdict lives in the presets: ViT-family TPU
-    presets ship with use_fused_attention=True (seq-gated in the dispatch)."""
+    """The attention verdict lives in the presets: ViT-family TPU presets
+    ship with use_fused_attention=True (train-step tie, long-seq forward win
+    under the device-dominated protocol; seq-gated in the dispatch)."""
     from tensorflowdistributedlearning_tpu.configs import PRESETS
 
     for name in ("vit_s16_imagenet", "vit_s16_moe_imagenet"):

@@ -144,16 +144,19 @@ def test_validation():
 
 
 def test_rate_gate_dispatch(monkeypatch):
-    """The layer engages the Pallas kernel only at measured-winning rates
-    (>= PALLAS_DEPTHWISE_MIN_RATE, per the v5e microbenches) even when
-    use_pallas=True; below the threshold it stays on XLA's grouped conv.
-    The platform gate is patched open so the dispatch logic runs on the CPU
-    test mesh (on real hardware it is True on TPU, False elsewhere)."""
+    """The layer engages the Pallas kernel only at rates
+    >= PALLAS_DEPTHWISE_MIN_RATE even when use_pallas=True. The threshold is
+    1 as of the 2026-08-01 device-dominated microbench (Pallas wins every
+    rate), so the gate is exercised here by PATCHING it back to 4 — the
+    machinery must keep restricting correctly if a future re-measure
+    re-raises it. The platform gate is patched open so the dispatch logic
+    runs on the CPU test mesh."""
     import tensorflowdistributedlearning_tpu.models.layers as layers_mod
     import tensorflowdistributedlearning_tpu.ops.pallas_kernels as pk
     from tensorflowdistributedlearning_tpu.models.layers import DepthwiseConv2D
 
     monkeypatch.setattr(layers_mod, "_pallas_platform_ok", lambda: True)
+    monkeypatch.setattr(pk, "PALLAS_DEPTHWISE_MIN_RATE", 4)
     taken = []
     real = pk.depthwise_conv2d
     monkeypatch.setattr(
