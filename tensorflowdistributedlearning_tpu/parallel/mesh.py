@@ -118,6 +118,11 @@ def shard_batch_stacked(tree: Any, mesh: Mesh) -> Any:
 
     def _put(x):
         x = np.asarray(x)
+        if x.ndim < 2:
+            raise ValueError(
+                "shard_batch_stacked needs [K, B, ...] leaves (a scan axis "
+                f"plus the example axis); got shape {x.shape}"
+            )
         spec = P(None, BATCH_AXIS, *([None] * (x.ndim - 2)))
         return jax.device_put(x, NamedSharding(mesh, spec))
 
