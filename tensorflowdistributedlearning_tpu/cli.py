@@ -37,6 +37,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--sequence-parallel", type=int, default=1,
                    help="H-shard the backbone over this many devices per "
                    "data-parallel replica (halo-exchange spatial parallelism)")
+    p.add_argument("--sync-bn", action="store_true",
+                   help="synchronized cross-shard BatchNorm: statistics over "
+                   "the GLOBAL batch instead of per shard (cross-replica BN; "
+                   "+7.8 points at digits scale, DIGITS_RUN.json)")
     p.add_argument("--model-parallel", type=int, default=1,
                    help="channel-shard params/optimizer over this many devices "
                    "per replica (tensor parallelism; the K-fold trainer runs "
@@ -92,6 +96,9 @@ def build_parser() -> argparse.ArgumentParser:
                        help="global batch (default: the preset's)")
     p_fit.add_argument("--eval-every", type=int, default=None)
     p_fit.add_argument("--sequence-parallel", type=int, default=1)
+    p_fit.add_argument("--sync-bn", action="store_true",
+                       help="synchronized cross-shard BatchNorm (global-batch "
+                       "statistics)")
     p_fit.add_argument("--model-parallel", type=int, default=1,
                        help="GSPMD tensor parallelism: shard params/optimizer "
                        "over this many devices per replica")
@@ -169,6 +176,7 @@ def _trainer(args):
         eval_throttle_secs=getattr(args, "eval_throttle_secs", 300),
         sequence_parallel=getattr(args, "sequence_parallel", 1),
         model_parallel=getattr(args, "model_parallel", 1),
+        sync_batch_norm=getattr(args, "sync_bn", False),
     )
     return Trainer(
         args.model_dir,
@@ -278,6 +286,7 @@ def cmd_fit(args) -> int:
         batch_size=args.batch_size,
         eval_every_steps=args.eval_every,
         sequence_parallel=args.sequence_parallel,
+        sync_batch_norm=getattr(args, "sync_bn", False),
         model_parallel=args.model_parallel,
         pipeline_parallel=args.pipeline_parallel,
         pipeline_microbatches=args.pipeline_microbatches,

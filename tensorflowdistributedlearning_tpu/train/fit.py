@@ -147,15 +147,24 @@ class ClassifierTrainer:
         validate_spatial_config(model_config, tcfg.sequence_parallel)
         self._spatial = tcfg.sequence_parallel > 1
         axis = mesh_lib.SEQUENCE_AXIS if self._spatial else None
+        # sync_batch_norm: BN statistics span the batch mesh axis too (and
+        # the sequence axis when spatial) — cross-replica BN, the pod
+        # standard for small per-shard batches (semantics and evidence:
+        # config.py's field comment)
+        bn_axis = axis
+        if tcfg.sync_batch_norm:
+            bn_axis = (
+                (mesh_lib.BATCH_AXIS, axis) if axis else mesh_lib.BATCH_AXIS
+            )
         self.model = build_model(
             model_config,
-            bn_axis_name=axis,
+            bn_axis_name=bn_axis,
             spatial_axis_name=axis,
             expert_axis_name=mesh_lib.MODEL_AXIS if self._ep else None,
         )
         self._plain_model = (
             build_model(model_config)
-            if (self._spatial or self._ep)
+            if (self._spatial or self._ep or tcfg.sync_batch_norm)
             else self.model
         )
         self._n_params: Optional[int] = None
@@ -449,7 +458,10 @@ class ClassifierTrainer:
         # expert-parallel); spatial/expert collectives cannot run outside
         # shard_map
         state = self._host_template()
-        if self._spatial or self._ep:
+        if self._spatial or self._ep or self.train_config.sync_batch_norm:
+            # the train step calls state.apply_fn — it must be the AXIS-NAMED
+            # model (spatial collectives, expert dispatch, or sync-BN pmean),
+            # not the plain init twin
             state = state.replace(apply_fn=self.model.apply)
         self._n_params = count_params(state.params)
         if self._tp:
@@ -679,6 +691,7 @@ def fit_preset(
     batch_size: Optional[int] = None,
     eval_every_steps: Optional[int] = None,
     sequence_parallel: int = 1,
+    sync_batch_norm: bool = False,
     model_parallel: int = 1,
     pipeline_parallel: int = 1,
     pipeline_microbatches: Optional[int] = None,
@@ -712,6 +725,7 @@ def fit_preset(
         )
     if (
         sequence_parallel != 1
+        or sync_batch_norm
         or model_parallel != 1
         or pipeline_parallel != 1
         or pipeline_microbatches is not None
@@ -727,6 +741,7 @@ def fit_preset(
         train_cfg = dataclasses.replace(
             train_cfg,
             sequence_parallel=sequence_parallel,
+            sync_batch_norm=sync_batch_norm or train_cfg.sync_batch_norm,
             model_parallel=model_parallel,
             pipeline_parallel=pipeline_parallel,
             pipeline_microbatches=(

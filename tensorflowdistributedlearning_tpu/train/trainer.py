@@ -166,8 +166,17 @@ class Trainer:
         validate_spatial_config(self.model_config, tcfg.sequence_parallel)
         self._spatial = tcfg.sequence_parallel > 1
         axis = mesh_lib.SEQUENCE_AXIS if self._spatial else None
+        # sync_batch_norm: BN statistics span the batch mesh axis too —
+        # cross-replica BN (semantics and evidence: config.py's field
+        # comment)
+        bn_axis = axis
+        if tcfg.sync_batch_norm:
+            bn_axis = (
+                (mesh_lib.BATCH_AXIS, axis) if axis else mesh_lib.BATCH_AXIS
+            )
+        self._sync_bn = tcfg.sync_batch_norm
         self.model = build_model(
-            self.model_config, bn_axis_name=axis, spatial_axis_name=axis
+            self.model_config, bn_axis_name=bn_axis, spatial_axis_name=axis
         )
         self._n_params: Optional[int] = None
         os.makedirs(model_dir, exist_ok=True)
@@ -196,7 +205,9 @@ class Trainer:
         which cannot run the spatial collectives outside shard_map."""
         if not hasattr(self, "_plain_model_cache"):
             self._plain_model_cache = (
-                build_model(self.model_config) if self._spatial else self.model
+                build_model(self.model_config)
+                if (self._spatial or self._sync_bn)
+                else self.model
             )
         return self._plain_model_cache
 
@@ -208,7 +219,9 @@ class Trainer:
         state = create_train_state(
             self._plain_model, tx, jax.random.PRNGKey(tcfg.seed), sample
         )
-        if self._spatial:
+        if self._spatial or self._sync_bn:
+            # state.apply_fn must be the axis-named model (halo-exchange
+            # convs / sync-BN pmean), not the plain init twin
             state = state.replace(apply_fn=self.model.apply)
         self._n_params = count_params(state.params)
         if self._tp:

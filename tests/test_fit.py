@@ -504,3 +504,34 @@ def test_resume_stream_order_differs(tmp_path):
     assert not np.array_equal(fresh["images"], resumed["images"])
     # same start point stays deterministic
     np.testing.assert_array_equal(fresh["images"], fresh_again["images"])
+
+
+def test_sync_batch_norm_rebinds_apply_fn(tmp_path):
+    """TrainConfig.sync_batch_norm must reach the executed model: the train
+    state's apply_fn is the axis-named (BN-pmean) model, not the plain init
+    twin — the exact wiring gap that once made the flag a silent no-op (the
+    guard skipped the rebind unless spatial/expert parallelism was also on),
+    invalidating a committed A/B."""
+    import dataclasses as _dc
+
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    cfg = ModelConfig(
+        num_classes=4,
+        input_shape=(32, 32),
+        input_channels=3,
+        n_blocks=(1, 1, 1),
+        base_depth=8,
+        width_multiplier=0.0625,
+        output_stride=None,
+    )
+    tr = ClassifierTrainer(
+        str(tmp_path / "run"),
+        None,
+        cfg,
+        TrainConfig(sync_batch_norm=True),
+    )
+    state = tr._init_state()
+    assert state.apply_fn == tr.model.apply
+    assert state.apply_fn != tr._plain_model.apply
