@@ -98,7 +98,7 @@ def run_benchmark(platform: str | None = None) -> dict:
         make_optimizer,
         make_train_step,
     )
-    from tensorflowdistributedlearning_tpu.utils.profiling import sync
+    from tensorflowdistributedlearning_tpu.utils.profiling import StepTimer, sync
 
     devices = jax.devices()
     on_tpu = devices[0].platform == "tpu"
@@ -171,11 +171,15 @@ def run_benchmark(platform: str | None = None) -> dict:
         for _ in range(warmup):
             s, metrics = comp(s, batch)
         sync(metrics)
-        t0 = time.perf_counter()
+        # one StepTimer window over all timed steps, synced on the final
+        # metrics — the same whole-window/single-sync protocol as before
+        # (per-step stops would insert a sync per step and measure the
+        # tunnel), now on the shared timing implementation
+        timer = StepTimer()
+        timer.start()
         for _ in range(timed_steps):
             s, metrics = comp(s, batch)
-        sync(metrics)
-        return global_b, time.perf_counter() - t0, comp
+        return global_b, timer.stop(metrics), comp
 
     # halve the batch on HBM exhaustion instead of failing the whole attempt.
     # Only the failure MESSAGE is retained — keeping the exception object would
@@ -460,7 +464,7 @@ def _vit_throughput(mesh, n: int, per_chip_batch: int = 256) -> dict:
         make_optimizer,
         make_train_step,
     )
-    from tensorflowdistributedlearning_tpu.utils.profiling import sync
+    from tensorflowdistributedlearning_tpu.utils.profiling import StepTimer, sync
 
     preset = PRESETS["vit_s16_imagenet"]
     model = build_model(preset.model)
@@ -489,11 +493,11 @@ def _vit_throughput(mesh, n: int, per_chip_batch: int = 256) -> dict:
         s, m = comp(s, batch)
     sync(m)
     steps = 80  # long window per sync — see the timed_steps note above
-    t0 = time.perf_counter()
+    timer = StepTimer()
+    timer.start()
     for _ in range(steps):
         s, m = comp(s, batch)
-    sync(m)
-    dt = (time.perf_counter() - t0) / steps
+    dt = timer.stop(m) / steps
     out = {
         "images_per_sec_per_chip": round(per_chip_batch / dt, 1),
         "global_batch": gb,

@@ -136,6 +136,26 @@ def main() -> int:
         eval_every_steps=args.eval_every,
     )
     wall = time.perf_counter() - t0
+    # the run ledger fit() wrote alongside the checkpoints: surface the
+    # goodput numbers in the committed record (full detail:
+    # `python -m tensorflowdistributedlearning_tpu.cli telemetry-report <dir>`)
+    telemetry_summary = None
+    try:
+        from tensorflowdistributedlearning_tpu.obs.report import build_report
+
+        rep = build_report(args.model_dir)
+        telemetry_summary = {
+            "ledger": "telemetry.jsonl",
+            "time_split": rep["time_split"],
+            "recompiles_post_warmup": rep["recompiles"]["post_warmup_count"],
+            "throughput": {
+                k: v
+                for k, v in rep.get("throughput", {}).items()
+                if k != "trend"
+            },
+        }
+    except Exception as e:  # noqa: BLE001 — the record stands without it
+        telemetry_summary = {"error": str(e)[:200]}
     record = {
         "dataset": "sklearn load_digits (1797 real 8x8 scans, 80/20 split)",
         "val_metrics": result.final_metrics,
@@ -149,6 +169,7 @@ def main() -> int:
         "pipeline_parallel": args.pipeline_parallel,
         "sync_batch_norm": bool(args.sync_bn),
         "wall_time_s": round(wall, 1),
+        "telemetry": telemetry_summary,
         "model_config": {"backbone": model_cfg.backbone,
                          # n_blocks only shapes the resnet family; Xception-41
                          # is a fixed architecture scaled by width_multiplier

@@ -144,6 +144,21 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("presets", help="list the named BASELINE config presets")
 
+    p_rep = sub.add_parser(
+        "telemetry-report",
+        help="render the goodput report from a workdir's telemetry.jsonl "
+        "run ledger (+ xplane trace when one exists under it)",
+    )
+    p_rep.add_argument("workdir",
+                       help="training workdir (model-dir) holding telemetry.jsonl")
+    p_rep.add_argument("--trace-dir", default=None,
+                       help="xplane trace dir to merge (default: search the "
+                       "workdir for *.xplane.pb)")
+    p_rep.add_argument("--top", type=int, default=10,
+                       help="device ops to list from the trace")
+    p_rep.add_argument("--json", action="store_true",
+                       help="machine-readable output")
+
     p_doc = sub.add_parser(
         "doctor",
         help="diagnose the environment and (optionally) a dataset layout",
@@ -305,6 +320,27 @@ def cmd_fit(args) -> int:
         "n_params": result.n_params,
         "final_metrics": result.final_metrics,
     }))
+    return 0
+
+
+def cmd_telemetry_report(args) -> int:
+    """Goodput report from the run ledger — throughput trend, step-time
+    percentiles, data-wait/compile/eval time split, recompiles, top device
+    ops when a trace exists (obs/report.py)."""
+    from tensorflowdistributedlearning_tpu.obs.report import report_workdir
+
+    try:
+        print(
+            report_workdir(
+                args.workdir,
+                trace_dir=args.trace_dir,
+                top=args.top,
+                as_json=args.json,
+            )
+        )
+    except (FileNotFoundError, ValueError) as e:
+        print(f"telemetry-report: {e}", file=sys.stderr)
+        return 1
     return 0
 
 
@@ -510,6 +546,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "smoke": cmd_smoke,
         "fit": cmd_fit,
         "presets": cmd_presets,
+        "telemetry-report": cmd_telemetry_report,
         "doctor": cmd_doctor,
     }[args.command](args)
 

@@ -298,6 +298,17 @@ class TrainConfig:
     eval_every_steps: Optional[int] = None
     # train summaries every N steps / eval summaries every step (reference: model.py:470-481)
     train_log_every_steps: int = 20
+    # write the JSONL run ledger ({workdir}/telemetry.jsonl, obs/ledger.py):
+    # run header, per-window step events with the data-wait/compute split,
+    # eval/checkpoint/memory snapshots, and post-warmup recompile flags —
+    # the machine-readable record `telemetry-report` renders. Ledger writes
+    # degrade to a warning on an unwritable workdir; disabling also skips the
+    # span bookkeeping and the jax.monitoring compile listener.
+    telemetry: bool = True
+    # memory snapshot cadence, counted in LOG WINDOWS (every N-th window event
+    # also records per-device HBM + host RSS); the trainers additionally
+    # snapshot once after state init
+    telemetry_memory_every_windows: int = 5
     # overlap periodic Orbax saves with subsequent train steps (background
     # serialization); best exports and resume points still synchronize
     async_checkpointing: bool = False
@@ -407,6 +418,33 @@ class TrainConfig:
                 "grad_accum_steps > 1 runs inside the shard_map "
                 "data/spatial-parallel step; the GSPMD tensor-parallel and "
                 "pipeline strategies define their own batch math"
+            )
+        # cadence knobs are modulus divisors in the train loops
+        # (`step_no % knob`): a zero would surface as a ZeroDivisionError
+        # mid-run, hours in — reject it at construction instead
+        if self.train_log_every_steps < 1:
+            raise ValueError(
+                "train_log_every_steps must be >= 1, got "
+                f"{self.train_log_every_steps}"
+            )
+        if self.checkpoint_every_steps < 1:
+            raise ValueError(
+                "checkpoint_every_steps must be >= 1, got "
+                f"{self.checkpoint_every_steps}"
+            )
+        if self.eval_every_steps is not None and self.eval_every_steps < 1:
+            raise ValueError(
+                "eval_every_steps must be >= 1 (or None for the "
+                f"checkpoint-coupled default), got {self.eval_every_steps}"
+            )
+        if self.eval_throttle_secs < 0:
+            raise ValueError(
+                f"eval_throttle_secs must be >= 0, got {self.eval_throttle_secs}"
+            )
+        if self.telemetry_memory_every_windows < 1:
+            raise ValueError(
+                "telemetry_memory_every_windows must be >= 1, got "
+                f"{self.telemetry_memory_every_windows}"
             )
         if not 0.0 <= self.eval_holdout_fraction < 1.0:
             raise ValueError(

@@ -1,0 +1,63 @@
+"""Unified telemetry: metrics registry, JSONL run ledger, trainer spans,
+recompile detection, and the goodput report.
+
+The reference harness had no profiler story at all (SURVEY §5.1) and this
+repo's observability used to be three disconnected islands (``utils/profiling``
+step timing, ``utils/xplane`` op breakdowns, ``utils/summary`` TB scalars) with
+no durable machine-readable record of what a run did. This package is the
+layer that ties them together, the way production TPU training is actually
+operated (pjit/TPUv4-scale jobs run off step-time/throughput telemetry and
+recompile tracking — Yoo et al., arXiv:2204.06514; TensorFlow shipped
+metrics+tracing as a core subsystem, Abadi et al., arXiv:1605.08695):
+
+- ``obs.metrics``   — counters, gauges, time-histograms (p50/p90/p99); the ONE
+  step-timing implementation (``utils.profiling.StepTimer`` delegates here);
+- ``obs.ledger``    — append-only ``telemetry.jsonl`` run ledger in the workdir
+  (degrades to a warning when the workdir is unwritable — never crashes
+  training);
+- ``obs.recompile`` — ``jax.monitoring``-based compile listener that counts and
+  timestamps post-warmup recompilations, the silent goodput killer on TPU;
+- ``obs.telemetry`` — the ``Telemetry`` façade + span API the trainers wire in
+  (data-wait vs step-compute split per log window, eval/checkpoint/memory
+  events);
+- ``obs.report``    — merges the ledger with ``utils.xplane.op_breakdown`` into
+  one goodput report (CLI: ``telemetry-report <workdir>``).
+"""
+
+from tensorflowdistributedlearning_tpu.obs.ledger import (
+    LEDGER_FILENAME,
+    RunLedger,
+    read_ledger,
+)
+from tensorflowdistributedlearning_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    MetricsRegistry,
+    TimeHistogram,
+    time_summary,
+)
+from tensorflowdistributedlearning_tpu.obs.recompile import RecompileDetector
+from tensorflowdistributedlearning_tpu.obs.telemetry import (
+    NULL_TELEMETRY,
+    SPAN_DATA_WAIT,
+    SPAN_EVAL,
+    SPAN_STEP,
+    Telemetry,
+)
+
+__all__ = [
+    "SPAN_DATA_WAIT",
+    "SPAN_EVAL",
+    "SPAN_STEP",
+    "Counter",
+    "Gauge",
+    "LEDGER_FILENAME",
+    "MetricsRegistry",
+    "NULL_TELEMETRY",
+    "RecompileDetector",
+    "RunLedger",
+    "Telemetry",
+    "TimeHistogram",
+    "read_ledger",
+    "time_summary",
+]

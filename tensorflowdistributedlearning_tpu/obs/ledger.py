@@ -1,0 +1,118 @@
+"""JSONL run ledger: the durable, machine-readable record of a training run.
+
+``{workdir}/telemetry.jsonl`` is append-only, one JSON object per line, each
+carrying ``event`` (the kind) and ``t`` (``time.time()``). A run writes a
+``run_header`` first (mesh/config/device fingerprint), then ``step_window`` /
+``eval`` / ``checkpoint`` / ``memory`` / ``compile`` events, and a ``run_end``.
+Appending means a workdir accumulates every run that touched it (resumes
+included) — readers anchor on the LAST ``run_header`` (``obs.report``).
+
+Failure stance: telemetry must never take training down. An unwritable
+workdir (read-only volume, deleted dir, quota) degrades to one logged warning
+and every subsequent ``event()`` becomes a no-op.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import os
+import time
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+LEDGER_FILENAME = "telemetry.jsonl"
+SCHEMA_VERSION = 1
+
+
+class RunLedger:
+    """Append-only JSONL event writer rooted at a workdir."""
+
+    def __init__(self, workdir: str, *, filename: str = LEDGER_FILENAME):
+        self.path = os.path.join(workdir, filename)
+        self._f: Optional[io.TextIOBase] = None
+        try:
+            os.makedirs(workdir, exist_ok=True)
+            self._f = open(self.path, "a", encoding="utf-8")
+        except OSError as e:
+            logger.warning(
+                "telemetry ledger disabled: cannot open %s (%s) — training "
+                "continues without a run ledger",
+                self.path,
+                e,
+            )
+
+    @property
+    def enabled(self) -> bool:
+        return self._f is not None
+
+    def event(self, kind: str, **fields) -> None:
+        """Append one event; a write failure disables the ledger with one
+        warning (never raises into the training loop)."""
+        if self._f is None:
+            return
+        record = {"event": kind, "t": time.time(), **fields}
+        try:
+            self._f.write(json.dumps(record, default=_jsonable) + "\n")
+            self._f.flush()
+        except (OSError, ValueError) as e:  # ValueError: write to closed file
+            logger.warning(
+                "telemetry ledger disabled mid-run: write to %s failed (%s)",
+                self.path,
+                e,
+            )
+            self._f = None
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+
+
+def _jsonable(obj):
+    """Best-effort JSON coercion for numpy scalars/arrays and other strays —
+    a weird metric value must not kill the ledger line."""
+    for attr in ("item", "tolist"):
+        fn = getattr(obj, attr, None)
+        if fn is not None:
+            try:
+                return fn()
+            except Exception:  # noqa: BLE001
+                pass
+    return str(obj)
+
+
+def read_ledger(path: str) -> List[Dict]:
+    """Parse a ledger back into a list of event dicts.
+
+    ``path`` may be the jsonl file or the workdir containing it. Tolerant of a
+    truncated final line (a killed run mid-write) — that line is dropped, not
+    raised."""
+    if os.path.isdir(path):
+        path = os.path.join(path, LEDGER_FILENAME)
+    events: List[Dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # truncated tail from an interrupted writer
+    return events
+
+
+def last_run_events(events: List[Dict]) -> List[Dict]:
+    """The events of the LAST run in an (append-accumulated) ledger: the final
+    ``run_header`` and everything after it. A ledger with no header (legacy or
+    foreign producer) is returned whole."""
+    for i in range(len(events) - 1, -1, -1):
+        if events[i].get("event") == "run_header":
+            return events[i:]
+    return events

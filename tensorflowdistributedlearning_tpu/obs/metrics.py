@@ -1,0 +1,149 @@
+"""Metrics registry: counters, gauges, and wall-time histograms.
+
+The registry is host-side and dependency-free (numpy only) — it instruments
+the Python training loop, not the jitted step (device-side time lives in the
+XPlane trace, ``utils/xplane.py``). ``TimeHistogram`` is the single
+step-timing/percentile implementation in the repo: ``utils.profiling.StepTimer``
+and the telemetry spans both record into it, so p50/p90/p99 mean the same
+thing everywhere they are reported.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def time_summary(
+    times: Sequence[float], skip_first: int = 0
+) -> Dict[str, float]:
+    """Summary statistics over a sequence of durations (seconds).
+
+    ``skip_first`` drops leading samples (the compile step) — when that would
+    drop everything, the full sequence is summarized instead so a 1-sample
+    timer still reports. Raises on an empty sequence: a vacuous summary would
+    read as a measured zero."""
+    if not times:
+        raise ValueError("time_summary: no samples recorded")
+    ts = np.asarray(list(times[skip_first:]) or list(times), np.float64)
+    return {
+        "count": float(len(ts)),
+        "mean_s": float(ts.mean()),
+        "p50_s": float(np.percentile(ts, 50)),
+        "p90_s": float(np.percentile(ts, 90)),
+        "p99_s": float(np.percentile(ts, 99)),
+        "max_s": float(ts.max()),
+        "total_s": float(ts.sum()),
+    }
+
+
+class Counter:
+    """Monotonic event counter."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """Last-value-wins instantaneous measurement."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: Optional[float] = None
+
+    def set(self, value: float) -> None:
+        self._value = float(value)
+
+    @property
+    def value(self) -> Optional[float]:
+        return self._value
+
+
+class TimeHistogram:
+    """Accumulates durations (seconds); reports count/mean/p50/p90/p99/total.
+
+    Samples are kept raw so consumers can slice deltas
+    (``samples_since(mark)``) or hand ownership over entirely (``drain()`` —
+    what the telemetry window loop uses, so per-step span histograms stay
+    bounded by one window's samples instead of growing for the whole run)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._samples: List[float] = []
+
+    def record(self, seconds: float) -> None:
+        self._samples.append(float(seconds))
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    @property
+    def total_s(self) -> float:
+        return float(sum(self._samples))
+
+    @property
+    def samples(self) -> List[float]:
+        return list(self._samples)
+
+    def samples_since(self, mark: int) -> List[float]:
+        return self._samples[mark:]
+
+    def drain(self) -> List[float]:
+        """Take (and clear) every recorded sample — the bounded-memory way to
+        consume a histogram windowed."""
+        out, self._samples = self._samples, []
+        return out
+
+    def summary(self, skip_first: int = 0) -> Dict[str, float]:
+        return time_summary(self._samples, skip_first=skip_first)
+
+
+class MetricsRegistry:
+    """Named instrument registry (get-or-create). Thread-safe creation — the
+    device-prefetch producer thread and the train loop may both touch it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, TimeHistogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter(name))
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            return self._gauges.setdefault(name, Gauge(name))
+
+    def histogram(self, name: str) -> TimeHistogram:
+        with self._lock:
+            return self._histograms.setdefault(name, TimeHistogram(name))
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """One JSON-serializable view of every instrument (histograms as
+        summaries, empty ones omitted)."""
+        with self._lock:
+            return {
+                "counters": {n: c.value for n, c in self._counters.items()},
+                "gauges": {
+                    n: g.value
+                    for n, g in self._gauges.items()
+                    if g.value is not None
+                },
+                "histograms": {
+                    n: h.summary()
+                    for n, h in self._histograms.items()
+                    if len(h)
+                },
+            }

@@ -1,0 +1,186 @@
+"""Recompile detection via ``jax.monitoring`` compile-event listeners.
+
+On TPU, a silent recompilation mid-training (a batch whose shape drifted, a
+Python-level cache miss, a donation mismatch) stalls every chip for the full
+compile — seconds to minutes — while throughput telemetry just shows a
+mysterious slow window. pjit-era production harnesses track compilations as a
+first-class signal (Yoo et al., arXiv:2204.06514 §5). This module listens on
+JAX's own monitoring stream: every backend compile fires
+``/jax/core/compile/backend_compile_duration`` (persistent-cache hits
+included — a cached recompile still stalls the step), which we timestamp,
+attribute to the telemetry span that was active when it happened, and — once
+the detector is marked *warm* (steady state reached) — flag as a post-warmup
+recompile.
+
+Fallback: if this jax build has no usable ``jax.monitoring`` (the API is
+public but young), ``RecompileDetector.available()`` is False and detectors
+degrade to inert counters — training never depends on the listener existing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, List, Optional
+
+logger = logging.getLogger(__name__)
+
+try:  # the public constant lives in a private module; keep a literal fallback
+    from jax._src.dispatch import BACKEND_COMPILE_EVENT as _COMPILE_EVENT
+except Exception:  # noqa: BLE001
+    _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+try:
+    from jax import monitoring as _monitoring
+except Exception:  # noqa: BLE001 — jax without the monitoring API
+    _monitoring = None
+
+# One process-wide listener fans out to attached detectors: jax.monitoring has
+# no unregister in its public API, so registering per-detector would leak a
+# callback per trainer construction for the process lifetime.
+_lock = threading.Lock()
+_detectors: List["RecompileDetector"] = []
+_listener_registered = False
+
+
+def _dispatch(event: str, duration_secs: float, **kwargs) -> None:
+    if event != _COMPILE_EVENT:
+        return
+    with _lock:
+        targets = list(_detectors)
+    for det in targets:
+        det._on_compile(duration_secs)
+
+
+def _ensure_listener() -> bool:
+    global _listener_registered
+    if _monitoring is None:
+        return False
+    with _lock:
+        if not _listener_registered:
+            try:
+                _monitoring.register_event_duration_secs_listener(_dispatch)
+            except Exception as e:  # noqa: BLE001 — degrade, never crash
+                logger.warning("recompile detector unavailable: %s", e)
+                return False
+            _listener_registered = True
+    return True
+
+
+@dataclasses.dataclass
+class CompileEvent:
+    t: float
+    duration_s: float
+    phase: str  # telemetry span active at compile time ("" when unattributed)
+    post_warmup: bool
+
+
+class RecompileDetector:
+    """Counts and timestamps backend compilations; flags the post-warmup ones.
+
+    Usage::
+
+        det = RecompileDetector(phase_fn=lambda: tel.current_span)
+        det.attach()
+        ... first steps (expected compiles) ...
+        det.mark_warm()          # from here on, any compile is a recompile
+        ...
+        det.detach()
+
+    Warm-up is tracked PER PHASE: a training loop's first eval legitimately
+    compiles the eval step long after the train step went warm, so the
+    trainers mark ``"step"`` warm after the first log window and ``"eval"``
+    warm after the first eval pass. ``mark_warm()`` with no arguments marks
+    every phase (the standalone usage above).
+
+    ``phase_fn`` supplies the attribution label (the telemetry span active on
+    the compiling thread); ``on_event`` is invoked for every compile with the
+    ``CompileEvent`` — the Telemetry façade uses it to write ledger lines and
+    log post-warmup warnings."""
+
+    def __init__(
+        self,
+        *,
+        phase_fn: Optional[Callable[[], str]] = None,
+        on_event: Optional[Callable[[CompileEvent], None]] = None,
+    ):
+        self._phase_fn = phase_fn
+        self._on_event = on_event
+        self._warm_phases: set = set()
+        self._attached = False
+        self.events: List[CompileEvent] = []
+
+    @staticmethod
+    def available() -> bool:
+        return _monitoring is not None
+
+    def attach(self) -> "RecompileDetector":
+        if self._attached or not _ensure_listener():
+            return self
+        with _lock:
+            _detectors.append(self)
+        self._attached = True
+        return self
+
+    def detach(self) -> None:
+        if not self._attached:
+            return
+        with _lock:
+            if self in _detectors:
+                _detectors.remove(self)
+        self._attached = False
+
+    def mark_warm(self, *phases: str) -> None:
+        """Declare steady state for ``phases`` (no arguments = every phase):
+        compiles attributed to a warm phase are recompiles."""
+        if not phases:
+            self._warm_phases.add("*")
+        else:
+            self._warm_phases.update(phases)
+
+    def is_warm(self, phase: str = "") -> bool:
+        return "*" in self._warm_phases or phase in self._warm_phases
+
+    @property
+    def warm(self) -> bool:
+        return bool(self._warm_phases)
+
+    @property
+    def compile_count(self) -> int:
+        return len(self.events)
+
+    @property
+    def compile_total_s(self) -> float:
+        return float(sum(e.duration_s for e in self.events))
+
+    @property
+    def post_warmup_events(self) -> List[CompileEvent]:
+        return [e for e in self.events if e.post_warmup]
+
+    @property
+    def post_warmup_count(self) -> int:
+        return len(self.post_warmup_events)
+
+    # -- listener side ----------------------------------------------------
+
+    def _on_compile(self, duration_s: float) -> None:
+        phase = ""
+        if self._phase_fn is not None:
+            try:
+                phase = self._phase_fn() or ""
+            except Exception:  # noqa: BLE001 — attribution is best-effort
+                phase = ""
+        event = CompileEvent(
+            t=time.time(),
+            duration_s=float(duration_s),
+            phase=phase,
+            post_warmup=self.is_warm(phase),
+        )
+        self.events.append(event)
+        if self._on_event is not None:
+            try:
+                self._on_event(event)
+            except Exception:  # noqa: BLE001 — telemetry must not kill dispatch
+                logger.exception("recompile on_event callback failed")

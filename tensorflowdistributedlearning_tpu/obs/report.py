@@ -1,0 +1,317 @@
+"""Goodput report: one view over the run ledger + (optionally) an xplane trace.
+
+``build_report(workdir)`` reads ``telemetry.jsonl`` (last run in the file) and
+answers the questions a TPU run is operated by: where did the wall time go
+(data-wait vs step-compute vs eval vs compile), what was the throughput trend,
+what were the step-time percentiles, did anything recompile after warmup, and
+— when a ``jax.profiler`` trace exists under the workdir — which device ops
+dominate (``utils.xplane.op_breakdown``, TensorBoard-free).
+
+Attribution note: ``data_wait``/``compute``/``eval`` are disjoint host spans;
+``compile`` time OVERLAPS whichever span it happened inside (a compile stalls
+the step that triggered it), so it is reported as its own row, not added into
+the split sum.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional
+
+from tensorflowdistributedlearning_tpu.obs.ledger import (
+    last_run_events,
+    read_ledger,
+)
+
+
+def _weighted(values: List[float], weights: List[float]) -> Optional[float]:
+    total = sum(weights)
+    if not total:
+        return None
+    return sum(v * w for v, w in zip(values, weights)) / total
+
+
+def _trace_section(trace_dir: str, top: int) -> Optional[Dict]:
+    """Top-k device ops + coarse buckets from an xplane capture; None when no
+    trace exists (the common case — traces are opt-in captures)."""
+    from tensorflowdistributedlearning_tpu.utils import xplane
+
+    if not xplane.find_xplane_files(trace_dir):
+        return None
+    # device planes first (TPU, then any /device:); CPU-backend captures have
+    # ONLY host-thread planes — still aggregated, with a note, so the report
+    # names the hot host frames rather than showing nothing
+    note = None
+    for plane_filter in ("TPU", "/device:", ""):
+        rows = xplane.op_breakdown(trace_dir, plane_filter=plane_filter)
+        if rows:
+            if plane_filter == "":
+                note = (
+                    "no device plane in this capture — host-thread timelines "
+                    "aggregated instead"
+                )
+            break
+    section = {
+        "dir": trace_dir,
+        "buckets_ms": xplane.grouped_breakdown(rows),
+        "top_ops": [dataclasses.asdict(r) for r in rows[:top]],
+    }
+    if note:
+        section["note"] = note
+    return section
+
+
+def build_report(
+    workdir: str, *, trace_dir: Optional[str] = None, top: int = 10
+) -> Dict:
+    """Assemble the goodput report dict for a workdir's last run."""
+    events = last_run_events(read_ledger(workdir))
+    if not events:
+        raise ValueError(f"empty telemetry ledger under {workdir}")
+    header = events[0] if events[0].get("event") == "run_header" else None
+    windows = [e for e in events if e.get("event") == "step_window"]
+    clean = [e for e in windows if not e.get("dirty")]
+    evals = [e for e in events if e.get("event") == "eval"]
+    checkpoints = [e for e in events if e.get("event") == "checkpoint"]
+    compiles = [e for e in events if e.get("event") == "compile"]
+    recompiles = [e for e in compiles if e.get("post_warmup")]
+    memories = [e for e in events if e.get("event") == "memory"]
+    run_end = next(
+        (e for e in reversed(events) if e.get("event") == "run_end"), None
+    )
+
+    wall_s = events[-1]["t"] - events[0]["t"] if len(events) > 1 else 0.0
+    data_wait_s = sum(e.get("data_wait_s", 0.0) for e in windows)
+    compute_s = sum(e.get("compute_s", 0.0) for e in windows)
+    eval_s = sum(e.get("duration_s", 0.0) for e in evals)
+    # run_end carries the exact total from the detector (ledger compile lines
+    # are thresholded to the non-trivial ones); fall back to summing those
+    compile_s = (run_end or {}).get(
+        "compile_total_s", sum(e.get("duration_s", 0.0) for e in compiles)
+    )
+    recompile_s = sum(e.get("duration_s", 0.0) for e in recompiles)
+
+    def frac(x: float) -> Optional[float]:
+        return round(x / wall_s, 4) if wall_s > 0 else None
+
+    report: Dict = {
+        "workdir": workdir,
+        "header": {
+            k: v for k, v in (header or {}).items() if k not in ("event", "t")
+        },
+        "run": {
+            "wall_s": round(wall_s, 3),
+            "last_step": windows[-1]["step"] if windows else None,
+            "windows": len(windows),
+            "clean_windows": len(clean),
+            # the trainers' finally blocks record exception exits with
+            # interrupted=True, so a bare run_end means a clean finish
+            "completed": run_end is not None and not run_end.get("interrupted"),
+            "final": {
+                k: v
+                for k, v in (run_end or {}).items()
+                if k not in ("event", "t")
+            },
+        },
+        "time_split": {
+            "data_wait_s": round(data_wait_s, 3),
+            "compute_s": round(compute_s, 3),
+            "eval_s": round(eval_s, 3),
+            "compile_s": round(compile_s, 3),
+            "data_wait_frac": frac(data_wait_s),
+            "compute_frac": frac(compute_s),
+            "eval_frac": frac(eval_s),
+            "compile_frac": frac(compile_s),
+        },
+        "recompiles": {
+            "post_warmup_count": len(recompiles),
+            "post_warmup_s": round(recompile_s, 3),
+            "events": [
+                {
+                    "t": e["t"],
+                    "duration_s": e.get("duration_s"),
+                    "phase": e.get("phase", ""),
+                }
+                for e in recompiles
+            ],
+        },
+        "evals": {
+            "count": len(evals),
+            "last_metrics": evals[-1].get("metrics") if evals else None,
+        },
+        "checkpoints": len(checkpoints),
+    }
+
+    ips = [
+        (e["step"], e["images_per_sec"])
+        for e in clean
+        if e.get("images_per_sec") is not None
+    ]
+    if ips:
+        vals = [v for _, v in ips]
+        report["throughput"] = {
+            "unit": "images/sec",
+            "first": vals[0],
+            "last": vals[-1],
+            "best": max(vals),
+            "mean": round(sum(vals) / len(vals), 2),
+            "trend": ips,
+        }
+    stw = [e for e in windows if "step_time_ms" in e]
+    if stw:
+        weights = [float(e.get("steps", 1)) for e in stw]
+        report["step_time_ms"] = {
+            "mean": round(
+                _weighted([e["step_time_ms"]["mean_ms"] for e in stw], weights), 3
+            ),
+            # per-window percentiles are merged approximately: weighted p50/p90,
+            # worst-window p99 (raw samples are not persisted to the ledger)
+            "p50": round(
+                _weighted([e["step_time_ms"]["p50_ms"] for e in stw], weights), 3
+            ),
+            "p90": round(
+                _weighted([e["step_time_ms"]["p90_ms"] for e in stw], weights), 3
+            ),
+            "p99_worst_window": round(
+                max(e["step_time_ms"]["p99_ms"] for e in stw), 3
+            ),
+        }
+    if memories:
+        device_peak = 0
+        for e in memories:
+            for stats in (e.get("devices") or {}).values():
+                device_peak = max(
+                    device_peak,
+                    stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0)),
+                )
+        mem: Dict = {"snapshots": len(memories)}
+        if device_peak:
+            mem["device_peak_bytes"] = device_peak
+        rss = [
+            e["host_rss_bytes"] for e in memories if "host_rss_bytes" in e
+        ]
+        if rss:
+            mem["host_rss_peak_bytes"] = max(rss)
+        report["memory"] = mem
+
+    try:
+        report["trace"] = _trace_section(trace_dir or workdir, top)
+    except (FileNotFoundError, ValueError, OSError):
+        report["trace"] = None
+    return report
+
+
+def _fmt_frac(x: Optional[float]) -> str:
+    return f"{x:6.1%}" if x is not None else "   n/a"
+
+
+def render_report(report: Dict) -> str:
+    """Human-readable rendering of ``build_report``'s dict."""
+    lines: List[str] = []
+    fp = (report.get("header") or {}).get("fingerprint") or {}
+    run = report["run"]
+    lines.append(f"== goodput report: {report['workdir']}")
+    if fp and "error" not in fp:
+        lines.append(
+            f"   {fp.get('n_devices', '?')}x {fp.get('device_kind', '?')} "
+            f"({fp.get('platform', '?')}), "
+            f"{fp.get('process_count', 1)} process(es), "
+            f"jax {fp.get('jax_version', '?')}"
+        )
+    lines.append(
+        f"   wall {run['wall_s']:.1f}s, last step {run['last_step']}, "
+        f"{run['windows']} windows ({run['clean_windows']} clean), "
+        f"run {'completed' if run['completed'] else 'IN PROGRESS / interrupted'}"
+    )
+    tp = report.get("throughput")
+    if tp:
+        lines.append(
+            f"\nthroughput ({tp['unit']}): first {tp['first']:.1f} -> "
+            f"last {tp['last']:.1f} (best {tp['best']:.1f}, mean {tp['mean']:.1f})"
+        )
+    st = report.get("step_time_ms")
+    if st:
+        lines.append(
+            f"step time (ms): mean {st['mean']:.2f}  p50 {st['p50']:.2f}  "
+            f"p90 {st['p90']:.2f}  p99(worst window) {st['p99_worst_window']:.2f}"
+        )
+    ts = report["time_split"]
+    lines.append("\nwhere the wall time went:")
+    lines.append(
+        f"  data-wait    {_fmt_frac(ts['data_wait_frac'])}  {ts['data_wait_s']:9.2f}s"
+    )
+    lines.append(
+        f"  step-compute {_fmt_frac(ts['compute_frac'])}  {ts['compute_s']:9.2f}s"
+    )
+    lines.append(
+        f"  eval         {_fmt_frac(ts['eval_frac'])}  {ts['eval_s']:9.2f}s"
+    )
+    lines.append(
+        f"  compile      {_fmt_frac(ts['compile_frac'])}  {ts['compile_s']:9.2f}s"
+        "  (overlaps the span it interrupted)"
+    )
+    rc = report["recompiles"]
+    if rc["post_warmup_count"]:
+        lines.append(
+            f"\n!! {rc['post_warmup_count']} POST-WARMUP RECOMPILE(S) "
+            f"({rc['post_warmup_s']:.2f}s lost):"
+        )
+        for e in rc["events"]:
+            lines.append(
+                f"   - {e['duration_s']:.2f}s during {e['phase'] or 'unattributed'!r}"
+            )
+    else:
+        lines.append("\nrecompiles after warmup: none")
+    ev = report["evals"]
+    lines.append(
+        f"evals: {ev['count']}"
+        + (f", last: {ev['last_metrics']}" if ev["last_metrics"] else "")
+    )
+    lines.append(f"checkpoints: {report['checkpoints']}")
+    mem = report.get("memory")
+    if mem:
+        parts = [f"{mem['snapshots']} snapshot(s)"]
+        if "device_peak_bytes" in mem:
+            parts.append(f"device peak {mem['device_peak_bytes'] / 2**20:.1f} MiB")
+        if "host_rss_peak_bytes" in mem:
+            parts.append(f"host RSS peak {mem['host_rss_peak_bytes'] / 2**20:.1f} MiB")
+        lines.append("memory: " + ", ".join(parts))
+    tr = report.get("trace")
+    if tr:
+        lines.append(f"\ndevice op breakdown ({tr['dir']}):")
+        if tr.get("note"):
+            lines.append(f"  ({tr['note']})")
+        for bucket, ms in tr["buckets_ms"].items():
+            lines.append(f"  {bucket:<24} {ms:>10.3f} ms")
+        lines.append(f"  top {len(tr['top_ops'])} ops:")
+        for op in tr["top_ops"]:
+            lines.append(
+                f"    {op['total_ms']:>10.3f} ms  x{op['occurrences']:<6} "
+                f"{op['fraction']:>6.1%}  {op['name']}"
+            )
+    else:
+        lines.append(
+            "\nno xplane trace under the workdir (capture one with "
+            "utils.profiling.trace / tools/profile_step.py to get the "
+            "per-op device breakdown)"
+        )
+    return "\n".join(lines)
+
+
+def report_workdir(
+    workdir: str,
+    *,
+    trace_dir: Optional[str] = None,
+    top: int = 10,
+    as_json: bool = False,
+) -> str:
+    """The ``telemetry-report`` CLI body: build + render (or JSON-dump)."""
+    import json
+
+    if not os.path.exists(workdir):
+        raise FileNotFoundError(f"workdir {workdir} does not exist")
+    report = build_report(workdir, trace_dir=trace_dir, top=top)
+    if as_json:
+        return json.dumps(report)
+    return render_report(report)

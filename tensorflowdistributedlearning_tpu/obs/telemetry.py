@@ -1,0 +1,297 @@
+"""The ``Telemetry`` façade the trainers wire in: spans + ledger + detector.
+
+One object per training run, constructed against the run's workdir. It owns:
+
+- a ``MetricsRegistry`` the span API records into (``span("data_wait")`` /
+  ``span("step")`` / ``span("eval")`` — each span is host wall time, also
+  annotated into any active ``jax.profiler`` trace so ledger windows and
+  xplane timelines line up);
+- a ``RunLedger`` (``telemetry.jsonl``; only process 0 writes under
+  multi-host — spans still accumulate everywhere, they are process-local);
+- a ``RecompileDetector`` attributing compiles to the active span and writing
+  them to the ledger; post-warmup recompiles are additionally WARNED, because
+  they are the silent goodput killer the whole subsystem exists to catch.
+
+Span accounting semantics (honest about async dispatch): ``data_wait`` is the
+host blocked on the input iterator — loader-bound time. ``step`` is the rest
+of the loop body; with async dispatch the device sync lands on the log
+window's ``device_get``, which the trainers also run inside a ``step`` span,
+so per-WINDOW totals are real wall time even though individual step samples
+measure dispatch+backpressure. The window event carries both the split and
+the per-step percentiles.
+
+``NULL_TELEMETRY`` is the disabled instance (no workdir, no ledger, no
+detector, spans are near-free) so trainer code never branches on None.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Dict, List, Optional
+
+from tensorflowdistributedlearning_tpu.obs.ledger import RunLedger
+from tensorflowdistributedlearning_tpu.obs.metrics import (
+    MetricsRegistry,
+    time_summary,
+)
+from tensorflowdistributedlearning_tpu.obs.recompile import (
+    CompileEvent,
+    RecompileDetector,
+)
+
+logger = logging.getLogger(__name__)
+
+# span names the trainers use; anything else is allowed, these are the schema
+SPAN_DATA_WAIT = "data_wait"
+SPAN_STEP = "step"
+SPAN_EVAL = "eval"
+
+
+def run_fingerprint() -> Dict:
+    """Device/process fingerprint for the run header — enough to answer
+    "what hardware produced this ledger" from the file alone."""
+    import jax
+
+    devices = jax.devices()
+    return {
+        "platform": devices[0].platform,
+        "device_kind": getattr(devices[0], "device_kind", "unknown"),
+        "n_devices": len(devices),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "jax_version": jax.__version__,
+    }
+
+
+class Telemetry:
+    """Per-run telemetry: span timing, JSONL ledger, recompile detection."""
+
+    def __init__(
+        self,
+        workdir: Optional[str],
+        *,
+        run_info: Optional[Dict] = None,
+        enabled: bool = True,
+        memory_every_windows: int = 5,
+        is_main: Optional[bool] = None,
+    ):
+        self.enabled = enabled and workdir is not None
+        self.registry = MetricsRegistry()
+        self._span_stack: List[str] = []
+        self._windows = 0
+        self._memory_every_windows = max(1, memory_every_windows)
+        self._closed = False
+        self.ledger: Optional[RunLedger] = None
+        self.detector: Optional[RecompileDetector] = None
+        if not self.enabled:
+            return
+        if is_main is None:
+            import jax
+
+            is_main = jax.process_index() == 0
+        if is_main:
+            self.ledger = RunLedger(workdir)
+            header = {"schema_version": 1}
+            try:
+                header["fingerprint"] = run_fingerprint()
+            except Exception as e:  # noqa: BLE001 — backend probe is best-effort
+                header["fingerprint"] = {"error": str(e)[:200]}
+            if run_info:
+                header.update(run_info)
+            self.ledger.event("run_header", **header)
+        self.detector = RecompileDetector(
+            phase_fn=lambda: self.current_span,
+            on_event=self._on_compile,
+        ).attach()
+
+    # -- spans -------------------------------------------------------------
+
+    @property
+    def current_span(self) -> str:
+        return self._span_stack[-1] if self._span_stack else ""
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        """Time a named host-side phase; nested spans attribute to the
+        innermost name. Also opens a profiler TraceAnnotation so captured
+        xplane traces carry the same phase names the ledger uses."""
+        if not self.enabled:
+            yield
+            return
+        self._span_stack.append(name)
+        t0 = time.perf_counter()
+        try:
+            import jax
+
+            with jax.profiler.TraceAnnotation(f"obs/{name}"):
+                yield
+        finally:
+            self.registry.histogram(f"span/{name}").record(
+                time.perf_counter() - t0
+            )
+            self._span_stack.pop()
+
+    def _span_delta(self, name: str) -> List[float]:
+        """Span samples recorded since the last window boundary. Draining
+        (not marking) keeps per-step span histograms bounded by one window —
+        a 500k-step run would otherwise retain ~1M floats nothing reads."""
+        return self.registry.histogram(f"span/{name}").drain()
+
+    # -- events ------------------------------------------------------------
+
+    def _event(self, kind: str, **fields) -> None:
+        if self.ledger is not None:
+            self.ledger.event(kind, **fields)
+
+    def window_event(
+        self,
+        step: int,
+        *,
+        steps: int,
+        images_per_sec: Optional[float] = None,
+        scalars: Optional[Dict[str, float]] = None,
+        dirty: bool = False,
+        **extra,
+    ) -> None:
+        """One per-log-window record: throughput, data-wait vs step-compute
+        split, per-step time percentiles, recompiles seen this window.
+        ``dirty`` marks windows containing compile/eval/checkpoint time (their
+        throughput point is not steady-state)."""
+        if not self.enabled:
+            return
+        wait = self._span_delta(SPAN_DATA_WAIT)
+        compute = self._span_delta(SPAN_STEP)
+        wait_s, compute_s = sum(wait), sum(compute)
+        busy = wait_s + compute_s
+        fields: Dict = {
+            "step": step,
+            "steps": steps,
+            "data_wait_s": round(wait_s, 6),
+            "compute_s": round(compute_s, 6),
+            "data_wait_frac": round(wait_s / busy, 4) if busy else 0.0,
+            "dirty": dirty,
+            **extra,
+        }
+        if compute:
+            s = time_summary(compute)
+            fields["step_time_ms"] = {
+                k[:-2] + "_ms": round(v * 1000, 3)
+                for k, v in s.items()
+                if k.endswith("_s") and k != "total_s"
+            }
+        if images_per_sec is not None:
+            fields["images_per_sec"] = round(float(images_per_sec), 2)
+        if scalars:
+            fields["scalars"] = {k: float(v) for k, v in scalars.items()}
+        if self.detector is not None:
+            fields["recompiles_post_warmup"] = self.detector.post_warmup_count
+        self._event("step_window", **fields)
+        self._windows += 1
+        if self._windows % self._memory_every_windows == 0:
+            self.memory_event(step=step)
+
+    def eval_event(
+        self, step: int, metrics: Dict[str, float], duration_s: float, **extra
+    ) -> None:
+        self._event(
+            "eval",
+            step=step,
+            duration_s=round(duration_s, 6),
+            metrics={k: float(v) for k, v in metrics.items()},
+            **extra,
+        )
+
+    def checkpoint_event(self, step: int, **extra) -> None:
+        self._event("checkpoint", step=step, **extra)
+
+    def memory_event(self, step: Optional[int] = None) -> None:
+        """Per-device HBM snapshot (``profiling.memory_stats``) plus host RSS —
+        on backends without the device query (CPU builds) the host side still
+        makes the snapshot meaningful."""
+        if not self.enabled:
+            return
+        from tensorflowdistributedlearning_tpu.utils.profiling import (
+            memory_stats,
+        )
+
+        try:
+            devices = memory_stats()
+        except Exception:  # noqa: BLE001 — a failed probe must not crash
+            devices = {}
+        fields: Dict = {"devices": devices}
+        rss = _host_rss_bytes()
+        if rss is not None:
+            fields["host_rss_bytes"] = rss
+        if step is not None:
+            fields["step"] = step
+        self._event("memory", **fields)
+
+    def mark_warm(self, *phases: str) -> None:
+        """Steady state reached for ``phases`` (none = all): compiles
+        attributed to a warm phase from now on are recompiles. The trainers
+        mark the train spans warm after the first log window and ``eval``
+        warm after the first eval pass."""
+        if self.detector is not None:
+            self.detector.mark_warm(*phases)
+
+    # a run dispatches hundreds of trivial sub-ms executables (placement,
+    # schedule evals); ledger lines are reserved for compiles that cost real
+    # time — post-warmup recompiles are ALWAYS written, they are the signal
+    _COMPILE_LEDGER_MIN_S = 0.01
+
+    def _on_compile(self, event: CompileEvent) -> None:
+        if event.post_warmup or event.duration_s >= self._COMPILE_LEDGER_MIN_S:
+            self._event(
+                "compile",
+                duration_s=round(event.duration_s, 6),
+                phase=event.phase,
+                post_warmup=event.post_warmup,
+            )
+        if event.post_warmup:
+            logger.warning(
+                "post-warmup recompilation #%d detected (%.2fs, during %r) — "
+                "on TPU this stalls every chip; check for shape drift in the "
+                "input pipeline or Python-level jit cache misses",
+                self.detector.post_warmup_count if self.detector else 0,
+                event.duration_s,
+                event.phase or "unattributed",
+            )
+
+    def close(self, **final_fields) -> None:
+        """End-of-run: one ``run_end`` event (pass final metrics/step), then
+        detach the compile listener and close the ledger. Idempotent — the
+        trainers close with final metrics on success and ``interrupted=True``
+        from their finally blocks, so an exception exit is recorded as
+        interrupted rather than silently looking completed."""
+        if self._closed:
+            return
+        self._closed = True
+        if not self.enabled:
+            return
+        if self.detector is not None:
+            final_fields.setdefault(
+                "recompiles_post_warmup", self.detector.post_warmup_count
+            )
+            final_fields.setdefault("compiles", self.detector.compile_count)
+            final_fields.setdefault(
+                "compile_total_s", round(self.detector.compile_total_s, 3)
+            )
+            self.detector.detach()
+        self._event("run_end", **final_fields)
+        if self.ledger is not None:
+            self.ledger.close()
+
+
+def _host_rss_bytes() -> Optional[int]:
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * 4096
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+# The disabled instance trainer code holds when telemetry is off — every
+# method is a cheap no-op, so call sites never branch on None.
+NULL_TELEMETRY = Telemetry(None, enabled=False)

@@ -26,6 +26,7 @@ from typing import Dict, Iterator, Optional
 import jax
 import numpy as np
 
+from tensorflowdistributedlearning_tpu import obs as obs_lib
 from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
 from tensorflowdistributedlearning_tpu.data import imagefolder
 from tensorflowdistributedlearning_tpu.data import pipeline as pipeline_lib
@@ -168,6 +169,9 @@ class ClassifierTrainer:
             else self.model
         )
         self._n_params: Optional[int] = None
+        # fit() swaps in a live Telemetry; the null instance keeps every other
+        # entry point (serving restore, direct _evaluate) span-safe
+        self._telemetry = obs_lib.NULL_TELEMETRY
         os.makedirs(model_dir, exist_ok=True)
 
     @property
@@ -344,7 +348,42 @@ class ClassifierTrainer:
         # otherwise only surface at the first eval, potentially hours in)
         self._open_records("val")
 
+        self._telemetry = obs_lib.Telemetry(
+            self.model_dir,
+            enabled=tcfg.telemetry,
+            memory_every_windows=tcfg.telemetry_memory_every_windows,
+            run_info={
+                "task": "classification",
+                "steps": steps,
+                "global_batch": batch_size,
+                "mesh": {
+                    name: int(size)
+                    for name, size in zip(
+                        self.mesh.axis_names, self.mesh.devices.shape
+                    )
+                },
+                "model_config": dataclasses.asdict(self.model_config),
+                "train_config": dataclasses.asdict(tcfg),
+            },
+        )
+        try:
+            return self._fit_instrumented(batch_size, steps, eval_every)
+        finally:
+            # idempotent: the success path already closed with final metrics;
+            # an exceptional exit reaches this close first and is recorded as
+            # interrupted (and the compile listener never leaks either way)
+            self._telemetry.close(interrupted=True)
+            self._telemetry = obs_lib.NULL_TELEMETRY
+
+    def _fit_instrumented(
+        self, batch_size: int, steps: int, eval_every: int
+    ) -> FitResult:
+        """The training loop proper, running under ``self._telemetry``
+        (constructed and torn down by ``fit``)."""
+        tcfg = self.train_config
+        tel = self._telemetry
         state = self._init_state()
+        tel.memory_event()  # post-init: the params/optimizer footprint
         ckpt = self._checkpointer()
         state = ckpt.restore_latest(state)
         start_step = int(jax.device_get(state.step))
@@ -352,6 +391,7 @@ class ClassifierTrainer:
             logger.info("already trained to step %d", start_step)
             metrics = self._evaluate(state, batch_size)
             ckpt.close()
+            tel.close(steps=start_step, already_trained=True)
             return FitResult(metrics, self.params, start_step)
 
         if self._tp:
@@ -392,25 +432,50 @@ class ClassifierTrainer:
         # time either — dirty windows skip their throughput point
         window_dirty = True
         lr_sched = step_lib.make_lr_schedule(tcfg)
-        for raw in batches:
-            batch = prepare(jax.numpy.asarray(step_no), raw)
-            state, metrics = train_step(state, batch)
+        batches_it = iter(batches)
+        _end = object()
+        while True:
+            # host blocked on the loader (prefetch underrun) vs dispatching
+            # compute: the split the ledger's step windows record
+            with tel.span(obs_lib.SPAN_DATA_WAIT):
+                raw = next(batches_it, _end)
+            if raw is _end:
+                break
+            with tel.span(obs_lib.SPAN_STEP):
+                batch = prepare(jax.numpy.asarray(step_no), raw)
+                state, metrics = train_step(state, batch)
             step_no += 1
             if tb_train is not None and step_no % tcfg.train_log_every_steps == 0:
-                scalars = step_lib.compute_metrics(jax.device_get(metrics))
+                # the device_get synchronizes on this step, so the window's
+                # span totals are real wall time — it counts as step time
+                with tel.span(obs_lib.SPAN_STEP):
+                    scalars = step_lib.compute_metrics(jax.device_get(metrics))
                 now = time.perf_counter()
+                images_per_sec = None
                 if not window_dirty and step_no > window_start:
-                    scalars["throughput/images_per_sec"] = (
+                    images_per_sec = (
                         (step_no - window_start) * batch_size / (now - window_t0)
                     )
-                window_t0, window_start, window_dirty = now, step_no, False
+                    scalars["throughput/images_per_sec"] = images_per_sec
                 # the lr the NEXT update will use — exact, the schedule is
                 # step-driven (observability the reference's TB summaries
                 # never had)
                 scalars["lr"] = float(lr_sched(step_no))
                 tb_train.scalars(scalars, step_no)
+                tel.window_event(
+                    step_no,
+                    steps=step_no - window_start,
+                    images_per_sec=images_per_sec,
+                    scalars=scalars,
+                    dirty=window_dirty,
+                )
+                window_t0, window_start, window_dirty = now, step_no, False
+                # train-side executables exist now: further train compiles
+                # are recompiles (the first eval marks its own phase warm)
+                tel.mark_warm(obs_lib.SPAN_STEP, obs_lib.SPAN_DATA_WAIT)
             if ckpt.maybe_save(state, step=step_no):
                 window_dirty = True
+                tel.checkpoint_event(step_no)
             if step_no % eval_every == 0:
                 last_eval_step = step_no
                 final_metrics = self._evaluate(state, batch_size)
@@ -423,6 +488,7 @@ class ClassifierTrainer:
                 )
                 window_dirty = True
         ckpt.save(state, force=True)
+        tel.checkpoint_event(step_no, final=True)
         if last_eval_step != step_no:
             final_metrics = self._evaluate(state, batch_size)
             if tb_eval is not None:
@@ -434,6 +500,11 @@ class ClassifierTrainer:
         if tb_eval is not None:
             tb_eval.close()
         ckpt.close()
+        tel.memory_event(step=step_no)
+        tel.close(
+            steps=step_no,
+            final_metrics={k: float(v) for k, v in final_metrics.items()},
+        )
         return FitResult(final_metrics, self.params, step_no)
 
     def _make_prepare_train(self):
@@ -496,8 +567,6 @@ class ClassifierTrainer:
             eval_split = self._open_split("train")
             if eval_split is not None:
                 self._warn_eval_on_train("the train ImageFolder split")
-        eval_step = self._eval_step
-        acc = None
         if eval_split is None:
             cfg = self.model_config
             # uniform batch structure with the on-disk path (all rows valid)
@@ -518,11 +587,30 @@ class ClassifierTrainer:
             batches = imagefolder.eval_batches(
                 eval_split.host_shard(), local_bs, num_batches=num
             )
-        for raw in batches:
-            metrics = eval_step(state, self._place_batch(raw))
-            acc = step_lib.merge_metrics(acc, jax.device_get(metrics))
-        result = step_lib.compute_metrics(acc)
-        logger.info("eval @ %d: %s", int(jax.device_get(state.step)), result)
+        return self._eval_pass(state, batches)
+
+    def _eval_pass(
+        self, state: TrainState, batches: Iterator[Dict[str, np.ndarray]]
+    ) -> Dict[str, float]:
+        """The ONE streaming accumulate/compute/log eval loop (both the
+        ImageFolder/synthetic and record-shard paths feed it), wrapped once in
+        the telemetry eval span — eval wall time is not training time, and the
+        ledger records each pass as an ``eval`` event."""
+        tel = self._telemetry
+        t0 = time.perf_counter()
+        with tel.span(obs_lib.SPAN_EVAL):
+            eval_step = self._eval_step
+            acc = None
+            for raw in batches:
+                metrics = eval_step(state, self._place_batch(raw))
+                acc = step_lib.merge_metrics(acc, jax.device_get(metrics))
+            result = step_lib.compute_metrics(acc)
+        step_no = int(jax.device_get(state.step))
+        logger.info("eval @ %d: %s", step_no, result)
+        tel.eval_event(step_no, result, time.perf_counter() - t0)
+        # this pass compiled whatever eval needed; later eval compiles are
+        # recompiles
+        tel.mark_warm(obs_lib.SPAN_EVAL)
         return result
 
     def _warn_eval_on_train(self, source: str) -> None:
@@ -550,7 +638,6 @@ class ClassifierTrainer:
         wrapped rows and the final batch's padding from the metrics."""
         from tensorflowdistributedlearning_tpu.data import records as records_lib
 
-        eval_step = self._eval_step
         my_n = records_lib.count_records(ds.paths)
         if jax.process_count() > 1:
             from tensorflowdistributedlearning_tpu.parallel import multihost as mh
@@ -558,14 +645,9 @@ class ClassifierTrainer:
             num = mh.all_processes_max_batches(my_n, local_bs)
         else:
             num = -(-my_n // local_bs) if my_n else 1
-        acc = None
-        batches = ds.batches(local_bs, repeat=False, pad_to_batches=num)
-        for raw in batches:
-            metrics = eval_step(state, self._place_batch(raw))
-            acc = step_lib.merge_metrics(acc, jax.device_get(metrics))
-        result = step_lib.compute_metrics(acc)
-        logger.info("eval @ %d: %s", int(jax.device_get(state.step)), result)
-        return result
+        return self._eval_pass(
+            state, ds.batches(local_bs, repeat=False, pad_to_batches=num)
+        )
 
     # -- serving ----------------------------------------------------------
 

@@ -114,7 +114,11 @@ def _pipelined_forward(
     """Full ViT forward with the block stack routed through the GPipe runner.
     Runs inside shard_map; ``images`` is the local batch shard."""
     k = lax.axis_size(MODEL_AXIS)
-    tokens = vit_lib.embed_tokens(config, params, images)
+    # named scopes thread the obs span taxonomy into the lowered HLO, so an
+    # xplane capture attributes device time to embed / fill-drain / head the
+    # same way the host-side ledger names its phases (obs/telemetry.py)
+    with jax.named_scope("obs/pipeline_embed"):
+        tokens = vit_lib.embed_tokens(config, params, images)
     b, t, d = tokens.shape
     if b % microbatches:
         raise ValueError(
@@ -128,8 +132,10 @@ def _pipelined_forward(
         ),
         stacked,
     )
-    out = pipeline_apply(stage_fn, my_stage, x)
-    return vit_lib.head_logits(config, params, out.reshape(b, t, d))
+    with jax.named_scope("obs/pipeline_fill_drain"):
+        out = pipeline_apply(stage_fn, my_stage, x)
+    with jax.named_scope("obs/pipeline_head"):
+        return vit_lib.head_logits(config, params, out.reshape(b, t, d))
 
 
 def _reduce_metrics(metrics: Metrics) -> Metrics:
@@ -268,17 +274,20 @@ def _make_train_step_pipeline_xception_cached(
             backbone_p = params["backbone"]
             stats = state.batch_stats
             backbone_s = stats["backbone"]
-            feats, entry_mut = entry.apply(
-                {
-                    "params": {key: backbone_p[key] for key in _XC_ENTRY_KEYS},
-                    "batch_stats": {
-                        key: backbone_s[key] for key in _XC_ENTRY_KEYS
+            with jax.named_scope("obs/pipeline_entry"):
+                feats, entry_mut = entry.apply(
+                    {
+                        "params": {
+                            key: backbone_p[key] for key in _XC_ENTRY_KEYS
+                        },
+                        "batch_stats": {
+                            key: backbone_s[key] for key in _XC_ENTRY_KEYS
+                        },
                     },
-                },
-                batch["images"],
-                True,
-                mutable=["batch_stats"],
-            )
+                    batch["images"],
+                    True,
+                    mutable=["batch_stats"],
+                )
             b = feats.shape[0]
             if b % microbatches:
                 raise ValueError(
@@ -289,7 +298,10 @@ def _make_train_step_pipeline_xception_cached(
                 (microbatches, b // microbatches) + feats.shape[1:]
             )
             my_p, my_s = _xception_stage_bundle(params, stats, k)
-            out, my_new_stats = pipeline_apply_aux(stage_fn, (my_p, my_s), x)
+            with jax.named_scope("obs/pipeline_fill_drain"):
+                out, my_new_stats = pipeline_apply_aux(
+                    stage_fn, (my_p, my_s), x
+                )
             logits, exit_mut = exit_head.apply(
                 {
                     "params": {

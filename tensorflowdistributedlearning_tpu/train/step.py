@@ -372,11 +372,15 @@ def _mean_grads(grads: Any) -> Any:
     non-spatial meshes) makes it a no-op.
     """
     from tensorflowdistributedlearning_tpu.parallel.collectives import vma_of
+    from tensorflowdistributedlearning_tpu.utils import jaxcompat
 
     def mean_leaf(g):
         vma = vma_of(g)
         for axis in (BATCH_AXIS, SEQUENCE_AXIS):
-            if axis in vma:
+            # legacy bridge (no vma tracking): nothing auto-psums, so every
+            # inside-body gradient is per-shard varying — the divide branch
+            # would halve/flip updates (proven by the cross-degree oracle)
+            if axis in vma or jaxcompat.LEGACY_BRIDGE:
                 g = jax.lax.pmean(g, axis)
             else:
                 g = g / jax.lax.axis_size(axis)

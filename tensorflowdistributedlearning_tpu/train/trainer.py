@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tensorflowdistributedlearning_tpu import config as config_lib
+from tensorflowdistributedlearning_tpu import obs as obs_lib
 from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
 from tensorflowdistributedlearning_tpu.data import augment as augment_lib
 from tensorflowdistributedlearning_tpu.data import folds as folds_lib
@@ -179,6 +180,9 @@ class Trainer:
             self.model_config, bn_axis_name=bn_axis, spatial_axis_name=axis
         )
         self._n_params: Optional[int] = None
+        # train() swaps in a live Telemetry; the null instance keeps predict/
+        # serving (which reuse _evaluate-adjacent paths) span-safe
+        self._telemetry = obs_lib.NULL_TELEMETRY
         os.makedirs(model_dir, exist_ok=True)
 
     # -- state ------------------------------------------------------------
@@ -270,14 +274,46 @@ class Trainer:
         manifests = folds_lib.write_fold_manifests(
             self.model_dir, list(X), list(np.asarray(y)), tcfg.n_folds, tcfg.seed
         )
-        results = []
-        for fold, manifest in enumerate(manifests):
-            logger.info("Processing fold %d", fold)  # reference: model.py:162
-            results.append(
-                self._train_fold(fold, dataset, manifest, batch_size, steps)
+        # one ledger for the whole K-fold run; events carry their fold
+        self._telemetry = obs_lib.Telemetry(
+            self.model_dir,
+            enabled=tcfg.telemetry,
+            memory_every_windows=tcfg.telemetry_memory_every_windows,
+            run_info={
+                "task": "segmentation",
+                "steps": steps,
+                "global_batch": batch_size,
+                "n_folds": tcfg.n_folds,
+                "mesh": {
+                    name: int(size)
+                    for name, size in zip(
+                        self.mesh.axis_names, self.mesh.devices.shape
+                    )
+                },
+                "model_config": dataclasses.asdict(self.model_config),
+                "train_config": dataclasses.asdict(tcfg),
+            },
+        )
+        try:
+            results = []
+            for fold, manifest in enumerate(manifests):
+                logger.info("Processing fold %d", fold)  # reference: model.py:162
+                results.append(
+                    self._train_fold(fold, dataset, manifest, batch_size, steps)
+                )
+                logger.info("Finished training fold %d", fold)  # reference: model.py:225
+            self._telemetry.close(
+                folds=len(results),
+                final_metrics={
+                    k: float(v) for k, v in (results[-1] if results else {}).items()
+                },
             )
-            logger.info("Finished training fold %d", fold)  # reference: model.py:225
-        return results
+            return results
+        finally:
+            # idempotent; an exceptional exit reaches this close first and is
+            # recorded as interrupted
+            self._telemetry.close(interrupted=True)
+            self._telemetry = obs_lib.NULL_TELEMETRY
 
     def _train_fold(
         self,
@@ -299,6 +335,7 @@ class Trainer:
 
         ckpt = self._checkpointer(fold)
         state = ckpt.restore_latest(self._init_state())
+        self._telemetry.memory_event()  # post-init params/optimizer footprint
         start_step = int(jax.device_get(state.step))
         if start_step >= steps:
             logger.info("fold %d already trained to step %d", fold, start_step)
@@ -349,23 +386,47 @@ class Trainer:
         # training time — mark them dirty and skip their throughput point
         window_dirty = True
         lr_sched = step_lib.make_lr_schedule(tcfg)
-        for raw in batches:
-            batch = prepare(jnp.asarray(step_no), raw)
-            state, metrics = train_step(state, batch)
+        tel = self._telemetry
+        batches_it = iter(batches)
+        _end = object()
+        while True:
+            # host blocked on the loader vs dispatching compute: the split
+            # the ledger's step windows record
+            with tel.span(obs_lib.SPAN_DATA_WAIT):
+                raw = next(batches_it, _end)
+            if raw is _end:
+                break
+            with tel.span(obs_lib.SPAN_STEP):
+                batch = prepare(jnp.asarray(step_no), raw)
+                state, metrics = train_step(state, batch)
             step_no += 1
             if tb_train is not None and step_no % tcfg.train_log_every_steps == 0:
-                scalars = step_lib.compute_metrics(jax.device_get(metrics))
+                # the device_get synchronizes on this step, so the window's
+                # span totals are real wall time — it counts as step time
+                with tel.span(obs_lib.SPAN_STEP):
+                    scalars = step_lib.compute_metrics(jax.device_get(metrics))
                 # wall-clock throughput over the log window (the device_get
                 # above synchronized on this step, so the window is real time)
                 now = time.perf_counter()
+                images_per_sec = None
                 if not window_dirty and step_no > window_start:
-                    scalars["throughput/images_per_sec"] = (
+                    images_per_sec = (
                         (step_no - window_start) * batch_size / (now - window_t0)
                     )
-                window_t0, window_start, window_dirty = now, step_no, False
+                    scalars["throughput/images_per_sec"] = images_per_sec
                 # exact lr of the next update (host-side schedule eval)
                 scalars["lr"] = float(lr_sched(step_no))
                 tb_train.scalars(scalars, step_no)
+                tel.window_event(
+                    step_no,
+                    steps=step_no - window_start,
+                    images_per_sec=images_per_sec,
+                    scalars=scalars,
+                    dirty=window_dirty,
+                    fold=fold,
+                )
+                window_t0, window_start, window_dirty = now, step_no, False
+                tel.mark_warm(obs_lib.SPAN_STEP, obs_lib.SPAN_DATA_WAIT)
                 # train-phase image grids every train_log_every_steps — the
                 # reference's SummarySaverHook wrote input/label/probability/
                 # prediction to fold{i}/train every 20 steps (model.py:470-481);
@@ -375,6 +436,7 @@ class Trainer:
             saved = ckpt.maybe_save(state, step=step_no)
             if saved:
                 window_dirty = True
+                tel.checkpoint_event(step_no, fold=fold)
             # eval cadence: an explicit eval_every_steps knob decouples eval from
             # checkpointing AND bypasses the time throttle (explicit user intent,
             # same semantics as fit()); the default preserves the reference's
@@ -400,6 +462,7 @@ class Trainer:
         # final-eval contract) — skipped when the last loop iteration already
         # checkpointed and evaluated at this exact step
         ckpt.save(state, force=True)
+        tel.checkpoint_event(step_no, fold=fold, final=True)
         if last_eval_step != step_no:
             final_metrics = self._evaluate(
                 state, eval_ds, batch_size, fold, writer=tb_eval,
@@ -451,21 +514,28 @@ class Trainer:
         num = multihost.eval_num_batches(
             global_n if global_n is not None else len(eval_ds), local_bs
         )
-        eval_step = self._eval_step
-        prepare = self._prepare_eval
-        acc = None
-        first_batch = None
-        for raw in pipeline_lib.eval_batches(eval_ds, local_bs, num_batches=num):
-            sharded = multihost.global_shard_batch(
-                raw, self.mesh, spatial=self._spatial
-            )
-            batch = prepare(sharded)
-            metrics = eval_step(state, batch)
-            acc = step_lib.merge_metrics(acc, jax.device_get(metrics))
-            if first_batch is None:
-                first_batch = batch
-        result = step_lib.compute_metrics(acc)
+        tel = self._telemetry
+        t0 = time.perf_counter()
+        with tel.span(obs_lib.SPAN_EVAL):
+            eval_step = self._eval_step
+            prepare = self._prepare_eval
+            acc = None
+            first_batch = None
+            for raw in pipeline_lib.eval_batches(eval_ds, local_bs, num_batches=num):
+                sharded = multihost.global_shard_batch(
+                    raw, self.mesh, spatial=self._spatial
+                )
+                batch = prepare(sharded)
+                metrics = eval_step(state, batch)
+                acc = step_lib.merge_metrics(acc, jax.device_get(metrics))
+                if first_batch is None:
+                    first_batch = batch
+            result = step_lib.compute_metrics(acc)
         step_no = int(jax.device_get(state.step))
+        tel.eval_event(step_no, result, time.perf_counter() - t0, fold=fold)
+        # this pass compiled whatever eval needed; later eval compiles are
+        # recompiles
+        tel.mark_warm(obs_lib.SPAN_EVAL)
         logger.info("fold %d eval @ %d: %s", fold, step_no, result)
         if writer is not None:
             writer.scalars(result, step_no)

@@ -51,13 +51,20 @@ def sync(tree: Any) -> None:
 
 
 class StepTimer:
-    """Accumulates per-step wall times; ``summary()`` reports mean/p50/p90 and
-    optional items/sec. Synchronization is the caller's choice: pass the step
-    output to ``stop`` and it is ``sync``'d before the clock stops."""
+    """Accumulates per-step wall times; ``summary()`` reports
+    mean/p50/p90/p99 and optional items/sec. Synchronization is the caller's
+    choice: pass the step output to ``stop`` and it is ``sync``'d before the
+    clock stops.
+
+    The samples live in an ``obs.metrics.TimeHistogram`` and the percentile
+    math is ``obs.metrics.time_summary`` — the ONE step-timing implementation
+    the telemetry spans, the benchmarks (bench.py), and this timer share."""
 
     def __init__(self, items_per_step: Optional[int] = None):
+        from tensorflowdistributedlearning_tpu.obs.metrics import TimeHistogram
+
         self.items_per_step = items_per_step
-        self._times: List[float] = []
+        self._hist = TimeHistogram("step")
         self._t0: Optional[float] = None
 
     def start(self) -> None:
@@ -69,7 +76,7 @@ class StepTimer:
         if outputs is not None:
             sync(outputs)
         dt = time.perf_counter() - self._t0
-        self._times.append(dt)
+        self._hist.record(dt)
         self._t0 = None
         return dt
 
@@ -84,20 +91,14 @@ class StepTimer:
 
     @property
     def times(self) -> List[float]:
-        return list(self._times)
+        return self._hist.samples
 
     def summary(self, skip_first: int = 1) -> Dict[str, float]:
         """Timing stats, excluding the first ``skip_first`` (compile) steps."""
-        if not self._times:
+        if not len(self._hist):
             raise RuntimeError("StepTimer.summary(): no steps recorded")
-        ts = np.asarray(self._times[skip_first:] or self._times, np.float64)
-        out = {
-            "steps": float(len(ts)),
-            "mean_s": float(ts.mean()),
-            "p50_s": float(np.percentile(ts, 50)),
-            "p90_s": float(np.percentile(ts, 90)),
-            "total_s": float(ts.sum()),
-        }
+        out = self._hist.summary(skip_first=skip_first)
+        out["steps"] = out.pop("count")
         if self.items_per_step:
             out["items_per_sec"] = self.items_per_step / out["mean_s"]
         return out

@@ -27,6 +27,10 @@ from tensorflowdistributedlearning_tpu.train.trainer import Trainer
 STEPS = 40
 SIZE = 64
 
+# slow tier: a real K-fold training run (~3 min on the 1-core CI box) — run
+# via tools/run_suite.py's group budgets, outside the 870s tier-1 window
+pytestmark = pytest.mark.slow
+
 
 def test_digit_segmentation_learns_real_pixels(tmp_path):
     data_dir = str(tmp_path / "data")

@@ -49,6 +49,7 @@ def _fit_digits(tmp_path, model_cfg, train_cfg, *, steps, upscale=2):
     return trainer.fit(batch_size=64, steps=steps, eval_every_steps=steps)
 
 
+@pytest.mark.slow  # real training run (minutes on the 1-core box); run_suite covers it
 def test_digits_trains_to_real_accuracy(tmp_path):
     """A tiny trunk on 16x16 upscaled digits reaches >=85% held-out top-1 in a
     short budget (a linear model scores ~95% on this corpus; the loose bar
@@ -96,6 +97,7 @@ def test_large_batch_recipe_config_contract():
     assert large_batch_recipe_train_config(150, 256, lr=0.5).lr == 0.5
 
 
+@pytest.mark.slow  # real training run (minutes on the 1-core box); run_suite covers it
 def test_digits_production_recipe_trains_to_real_accuracy(tmp_path):
     """The ImageNet PRODUCTION recipe (SGD Nesterov + linear-scaled lr +
     warmup-cosine + kernels-only wd + label smoothing — the knobs behind the
@@ -137,6 +139,7 @@ def _xception_cfg():
     )
 
 
+@pytest.mark.slow  # real training run (minutes on the 1-core box); run_suite covers it
 def test_digits_xception_trains_end_to_end(tmp_path):
     """The Xception-41 classifier — the family whose train path the
     dropout-PRNG fix unblocked — learns real structure from real data through
@@ -174,6 +177,7 @@ def test_train_digits_driver_help():
     assert "--model-dir" in proc.stdout
 
 
+@pytest.mark.slow  # real training run (minutes on the 1-core box); run_suite covers it
 def test_digits_xception_pipelined_learns(tmp_path):
     """GPipe-BN learns for the conv family (VERDICT r4 #4): the SAME
     Xception config as the plain test above, split into 2 pipeline stages

@@ -1,0 +1,309 @@
+"""Unit tests for the obs telemetry subsystem: metrics registry, JSONL run
+ledger (including the degrade-don't-crash failure paths), span API, and the
+jax.monitoring recompile detector (including a forced reshape-induced
+recompile)."""
+
+import json
+import os
+
+import pytest
+
+from tensorflowdistributedlearning_tpu import obs
+from tensorflowdistributedlearning_tpu.obs.ledger import last_run_events
+
+
+# -- metrics ----------------------------------------------------------------
+
+
+def test_time_histogram_percentiles():
+    h = obs.TimeHistogram("t")
+    for v in [0.01 * i for i in range(1, 101)]:  # 0.01..1.00
+        h.record(v)
+    s = h.summary()
+    assert s["count"] == 100
+    assert s["p50_s"] == pytest.approx(0.505, abs=0.02)
+    assert s["p90_s"] == pytest.approx(0.901, abs=0.02)
+    assert s["p99_s"] == pytest.approx(0.99, abs=0.02)
+    assert s["max_s"] == pytest.approx(1.0)
+    assert s["total_s"] == pytest.approx(50.5)
+
+
+def test_time_summary_skip_first_and_empty():
+    assert obs.time_summary([5.0, 1.0, 1.0], skip_first=1)["mean_s"] == 1.0
+    # skipping everything falls back to the full sequence, not a crash
+    assert obs.time_summary([5.0], skip_first=1)["mean_s"] == 5.0
+    with pytest.raises(ValueError, match="no samples"):
+        obs.time_summary([])
+
+
+def test_histogram_window_deltas():
+    h = obs.TimeHistogram("t")
+    h.record(1.0)
+    mark = len(h)
+    h.record(2.0)
+    h.record(3.0)
+    assert h.samples_since(mark) == [2.0, 3.0]
+
+
+def test_registry_get_or_create_and_snapshot():
+    reg = obs.MetricsRegistry()
+    reg.counter("compiles").inc()
+    reg.counter("compiles").inc(2)
+    reg.gauge("lr").set(0.1)
+    reg.histogram("step").record(0.5)
+    snap = reg.snapshot()
+    assert snap["counters"]["compiles"] == 3
+    assert snap["gauges"]["lr"] == 0.1
+    assert snap["histograms"]["step"]["count"] == 1
+    # empty instruments stay out of the snapshot
+    reg.histogram("never_recorded")
+    assert "never_recorded" not in reg.snapshot()["histograms"]
+
+
+def test_step_timer_shares_the_histogram_implementation():
+    from tensorflowdistributedlearning_tpu.utils.profiling import StepTimer
+
+    t = StepTimer(items_per_step=4)
+    for _ in range(3):
+        t.start()
+        t.stop()
+    s = t.summary(skip_first=1)
+    assert s["steps"] == 2
+    assert {"p50_s", "p90_s", "p99_s", "items_per_sec"} <= set(s)
+    assert len(t.times) == 3
+
+
+# -- ledger -----------------------------------------------------------------
+
+
+def test_ledger_roundtrip(tmp_path):
+    led = obs.RunLedger(str(tmp_path))
+    assert led.enabled
+    led.event("run_header", schema_version=1)
+    led.event("step_window", step=10, data_wait_s=0.1)
+    led.close()
+    events = obs.read_ledger(str(tmp_path))
+    assert [e["event"] for e in events] == ["run_header", "step_window"]
+    assert all("t" in e for e in events)
+    assert events[1]["step"] == 10
+
+
+def test_ledger_appends_and_last_run_selects_final_header(tmp_path):
+    for run in range(2):
+        led = obs.RunLedger(str(tmp_path))
+        led.event("run_header", run=run)
+        led.event("step_window", step=run * 100)
+        led.close()
+    events = obs.read_ledger(str(tmp_path))
+    assert len(events) == 4
+    last = last_run_events(events)
+    assert len(last) == 2 and last[0]["run"] == 1
+
+
+def test_ledger_unwritable_workdir_degrades_to_warning(tmp_path, caplog):
+    target = tmp_path / "not_a_dir"
+    target.write_text("occupied")
+    led = obs.RunLedger(str(target))  # workdir is a file: cannot create/open
+    assert not led.enabled
+    led.event("step_window", step=1)  # must be a silent no-op, never a crash
+    led.close()
+    assert any("ledger disabled" in r.message for r in caplog.records)
+
+
+def test_ledger_mid_run_write_failure_disables(tmp_path, caplog):
+    led = obs.RunLedger(str(tmp_path))
+    led.event("run_header")
+    led._f.close()  # simulate the fd dying under the writer (volume gone)
+    led.event("step_window", step=1)
+    assert not led.enabled
+    led.event("step_window", step=2)  # still a no-op
+    assert any("disabled mid-run" in r.message for r in caplog.records)
+
+
+def test_ledger_numpy_values_serialize(tmp_path):
+    import numpy as np
+
+    led = obs.RunLedger(str(tmp_path))
+    led.event("eval", loss=np.float32(1.5), steps=np.int64(3))
+    led.close()
+    e = obs.read_ledger(str(tmp_path))[0]
+    assert e["loss"] == 1.5 and e["steps"] == 3
+
+
+def test_read_ledger_tolerates_truncated_tail(tmp_path):
+    path = os.path.join(str(tmp_path), obs.LEDGER_FILENAME)
+    with open(path, "w") as f:
+        f.write(json.dumps({"event": "run_header", "t": 1.0}) + "\n")
+        f.write('{"event": "step_window", "t": 2.0, "ste')  # killed mid-write
+    events = obs.read_ledger(str(tmp_path))
+    assert len(events) == 1 and events[0]["event"] == "run_header"
+
+
+# -- telemetry façade --------------------------------------------------------
+
+
+def test_null_telemetry_is_inert(tmp_path):
+    tel = obs.NULL_TELEMETRY
+    with tel.span("step"):
+        pass
+    tel.window_event(1, steps=1)
+    tel.eval_event(1, {"loss": 1.0}, 0.1)
+    tel.memory_event()
+    tel.close()
+    assert tel.ledger is None and tel.detector is None
+
+
+def test_telemetry_spans_feed_window_events(tmp_path):
+    tel = obs.Telemetry(str(tmp_path), is_main=True, run_info={"task": "test"})
+    for _ in range(3):
+        with tel.span(obs.SPAN_DATA_WAIT):
+            pass
+        with tel.span(obs.SPAN_STEP):
+            pass
+    tel.window_event(3, steps=3, images_per_sec=100.0, scalars={"loss": 0.5})
+    tel.close(steps=3)
+    events = obs.read_ledger(str(tmp_path))
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "run_header" and kinds[-1] == "run_end"
+    window = next(e for e in events if e["event"] == "step_window")
+    assert window["data_wait_s"] >= 0 and window["compute_s"] > 0
+    assert window["step_time_ms"]["p50_ms"] >= 0
+    assert window["scalars"]["loss"] == 0.5
+    assert window["images_per_sec"] == 100.0
+    # window marks advanced: a second window only sees new samples
+    with tel.span(obs.SPAN_STEP):
+        pass
+    assert len(tel._span_delta(obs.SPAN_STEP)) == 1
+
+
+def test_interrupted_close_reports_run_incomplete(tmp_path):
+    """The trainers' finally blocks close with interrupted=True on exception
+    exits; the report must not render a crashed run as completed."""
+    from tensorflowdistributedlearning_tpu.obs.report import (
+        build_report,
+        render_report,
+    )
+
+    tel = obs.Telemetry(str(tmp_path), is_main=True, run_info={"task": "t"})
+    tel.close(interrupted=True)
+    report = build_report(str(tmp_path))
+    assert not report["run"]["completed"]
+    assert "interrupted" in render_report(report)
+    # close() on success records a clean run_end — second close is a no-op
+    tel2 = obs.Telemetry(str(tmp_path / "ok"), is_main=True)
+    tel2.close(steps=5)
+    tel2.close(interrupted=True)  # the finally-block close after success
+    assert build_report(str(tmp_path / "ok"))["run"]["completed"]
+
+
+def test_telemetry_readonly_workdir_never_crashes(tmp_path, caplog):
+    target = tmp_path / "file_in_the_way"
+    target.write_text("occupied")
+    tel = obs.Telemetry(str(target), is_main=True)
+    with tel.span("step"):
+        pass
+    tel.window_event(1, steps=1)
+    tel.memory_event()
+    tel.close()
+    assert any("ledger disabled" in r.message for r in caplog.records)
+
+
+def test_telemetry_memory_event_has_host_rss(tmp_path):
+    tel = obs.Telemetry(str(tmp_path), is_main=True)
+    tel.memory_event(step=0)
+    tel.close()
+    mem = next(
+        e for e in obs.read_ledger(str(tmp_path)) if e["event"] == "memory"
+    )
+    assert "devices" in mem
+    # CPU backends report no per-device stats; host RSS keeps the snapshot
+    # meaningful (Linux: always present)
+    assert mem.get("host_rss_bytes", 0) > 0
+
+
+# -- recompile detector ------------------------------------------------------
+
+
+def test_recompile_detector_counts_forced_reshape_recompile():
+    import jax
+    import jax.numpy as jnp
+
+    assert obs.RecompileDetector.available()
+    det = obs.RecompileDetector().attach()
+    try:
+
+        @jax.jit
+        def f(x):
+            return x * 2 + 1
+
+        f(jnp.ones((3,)))  # warmup compile: counted, not flagged
+        warm_count = det.compile_count
+        assert warm_count >= 1
+        assert det.post_warmup_count == 0
+        det.mark_warm()
+        f(jnp.ones((3,)))  # cache hit: no compile event
+        assert det.compile_count == warm_count
+        f(jnp.ones((5,)))  # reshape => retrace + recompile
+        assert det.post_warmup_count >= 1
+        event = det.post_warmup_events[0]
+        assert event.duration_s > 0 and event.post_warmup
+    finally:
+        det.detach()
+
+
+def test_recompile_phase_warmup_is_independent():
+    import jax
+    import jax.numpy as jnp
+
+    phases = ["step"]
+    det = obs.RecompileDetector(phase_fn=lambda: phases[0]).attach()
+    try:
+        det.mark_warm("eval")  # only eval is warm
+
+        @jax.jit
+        def g(x):
+            return x - 1
+
+        g(jnp.ones((7,)))  # compiles in phase "step": not flagged
+        assert det.post_warmup_count == 0
+        det.mark_warm("step")
+        g(jnp.ones((9,)))  # now flagged
+        assert det.post_warmup_count >= 1
+        assert det.post_warmup_events[0].phase == "step"
+    finally:
+        det.detach()
+
+
+# -- config validation (the ZeroDivisionError-mid-run guards) ----------------
+
+
+@pytest.mark.parametrize(
+    "field",
+    [
+        "train_log_every_steps",
+        "checkpoint_every_steps",
+        "eval_every_steps",
+        "telemetry_memory_every_windows",
+    ],
+)
+def test_cadence_knobs_reject_zero(field):
+    from tensorflowdistributedlearning_tpu.config import TrainConfig
+
+    with pytest.raises(ValueError, match=field):
+        TrainConfig(**{field: 0})
+
+
+def test_negative_eval_throttle_rejected():
+    from tensorflowdistributedlearning_tpu.config import TrainConfig
+
+    with pytest.raises(ValueError, match="eval_throttle_secs"):
+        TrainConfig(eval_throttle_secs=-1)
+
+
+def test_valid_cadence_accepted():
+    from tensorflowdistributedlearning_tpu.config import TrainConfig
+
+    cfg = TrainConfig(
+        train_log_every_steps=1, checkpoint_every_steps=1, eval_every_steps=1
+    )
+    assert cfg.telemetry and cfg.telemetry_memory_every_windows >= 1

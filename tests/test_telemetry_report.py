@@ -1,0 +1,184 @@
+"""End-to-end telemetry: a real fit() run writes a complete telemetry.jsonl,
+the `telemetry-report` CLI renders it, and a deliberately-triggered
+post-warmup recompile is counted and surfaced in both the ledger and the
+report (the acceptance pin for the obs subsystem)."""
+
+import json
+
+import pytest
+
+from tensorflowdistributedlearning_tpu import obs
+from tensorflowdistributedlearning_tpu.obs.report import (
+    build_report,
+    render_report,
+)
+
+TINY = dict(
+    num_classes=4,
+    input_shape=(16, 16),
+    input_channels=3,
+    n_blocks=(1, 1, 1),
+    width_multiplier=0.125,
+    output_stride=None,
+)
+
+
+@pytest.fixture(scope="module")
+def fit_workdir(tmp_path_factory):
+    """One short synthetic fit() run shared by the ledger/report assertions."""
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    workdir = str(tmp_path_factory.mktemp("telemetry_run"))
+    trainer = ClassifierTrainer(
+        workdir,
+        None,  # synthetic data
+        ModelConfig(**TINY),
+        TrainConfig(
+            train_log_every_steps=2,
+            checkpoint_every_steps=4,
+            eval_every_steps=4,
+            telemetry_memory_every_windows=2,
+        ),
+    )
+    result = trainer.fit(batch_size=8, steps=8, eval_every_steps=4)
+    return workdir, result
+
+
+def test_fit_writes_complete_ledger(fit_workdir):
+    workdir, result = fit_workdir
+    events = obs.read_ledger(workdir)
+    kinds = {e["event"] for e in events}
+    assert {
+        "run_header",
+        "step_window",
+        "eval",
+        "checkpoint",
+        "memory",
+        "run_end",
+    } <= kinds
+
+    header = events[0]
+    assert header["event"] == "run_header"
+    assert header["fingerprint"]["n_devices"] >= 1
+    assert header["mesh"]["batch"] >= 1
+    assert header["train_config"]["train_log_every_steps"] == 2
+
+    windows = [e for e in events if e["event"] == "step_window"]
+    assert windows, "no step windows recorded"
+    for w in windows:
+        assert w["data_wait_s"] >= 0 and w["compute_s"] > 0
+        assert 0.0 <= w["data_wait_frac"] <= 1.0
+        assert w["step_time_ms"]["p50_ms"] > 0
+    # the first window carries the compile: dirty, no throughput point
+    assert windows[0]["dirty"]
+
+    evals = [e for e in events if e["event"] == "eval"]
+    assert evals and evals[-1]["metrics"]["metrics/top1"] >= 0
+    assert all(e["duration_s"] > 0 for e in evals)
+
+    assert any(e["event"] == "memory" for e in events)
+
+    end = events[-1]
+    assert end["event"] == "run_end"
+    assert end["steps"] == result.steps == 8
+
+
+def test_report_builds_and_renders(fit_workdir):
+    workdir, _ = fit_workdir
+    report = build_report(workdir)
+    assert report["run"]["completed"]
+    assert report["run"]["last_step"] == 8
+    ts = report["time_split"]
+    assert ts["compute_s"] > 0
+    assert ts["eval_s"] > 0
+    assert report["evals"]["count"] >= 2
+    assert report["checkpoints"] >= 1
+    assert report["memory"]["snapshots"] >= 1
+    assert report["trace"] is None  # no xplane capture in this run
+    text = render_report(report)
+    assert "goodput report" in text
+    assert "data-wait" in text and "step-compute" in text
+
+
+def test_report_cli_renders_and_json(fit_workdir, capsys):
+    from tensorflowdistributedlearning_tpu.cli import main
+
+    workdir, _ = fit_workdir
+    assert main(["telemetry-report", workdir]) == 0
+    out = capsys.readouterr().out
+    assert "goodput report" in out and "where the wall time went" in out
+
+    assert main(["telemetry-report", workdir, "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["run"]["last_step"] == 8
+
+
+def test_report_cli_missing_workdir_fails_cleanly(tmp_path, capsys):
+    from tensorflowdistributedlearning_tpu.cli import main
+
+    assert main(["telemetry-report", str(tmp_path / "nope")]) == 1
+    assert "telemetry-report" in capsys.readouterr().err
+
+
+def test_report_empty_ledger_raises(tmp_path):
+    (tmp_path / obs.LEDGER_FILENAME).write_text("")
+    with pytest.raises(ValueError, match="empty telemetry ledger"):
+        build_report(str(tmp_path))
+
+
+def test_op_breakdown_failure_paths(tmp_path):
+    """xplane.op_breakdown on a missing and on an empty logdir raises the
+    clean FileNotFoundError the report layer turns into trace=None."""
+    from tensorflowdistributedlearning_tpu.utils import xplane
+
+    with pytest.raises(FileNotFoundError):
+        xplane.op_breakdown(str(tmp_path / "missing"))
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(FileNotFoundError):
+        xplane.op_breakdown(str(empty))
+
+
+def test_forced_recompile_surfaces_in_ledger_and_report(tmp_path, caplog):
+    """The acceptance pin: a deliberately-triggered post-warmup recompile
+    (reshape-induced retrace) is counted and surfaced in BOTH the ledger and
+    the rendered report."""
+    import jax
+    import jax.numpy as jnp
+
+    workdir = str(tmp_path)
+    tel = obs.Telemetry(workdir, is_main=True, run_info={"task": "test"})
+    try:
+
+        @jax.jit
+        def step(x):
+            return (x * 3 + 1).sum()
+
+        with tel.span(obs.SPAN_STEP):
+            step(jnp.ones((4,)))  # expected warmup compile
+        tel.window_event(1, steps=1, dirty=True)
+        tel.mark_warm(obs.SPAN_STEP, obs.SPAN_DATA_WAIT)
+        with tel.span(obs.SPAN_STEP):
+            step(jnp.ones((6,)))  # shape drift => the silent goodput killer
+        tel.window_event(2, steps=1)
+    finally:
+        tel.close(steps=2)
+
+    events = obs.read_ledger(workdir)
+    flagged = [
+        e for e in events if e["event"] == "compile" and e["post_warmup"]
+    ]
+    assert flagged, "post-warmup recompile missing from the ledger"
+    assert flagged[0]["phase"] == obs.SPAN_STEP
+    # the window and run_end carry the running count
+    last_window = [e for e in events if e["event"] == "step_window"][-1]
+    assert last_window["recompiles_post_warmup"] >= 1
+    assert events[-1]["recompiles_post_warmup"] >= 1
+    # ... and the detector warned loudly
+    assert any("recompilation" in r.message.lower() for r in caplog.records)
+
+    report = build_report(workdir)
+    assert report["recompiles"]["post_warmup_count"] >= 1
+    assert report["recompiles"]["events"][0]["phase"] == obs.SPAN_STEP
+    assert "POST-WARMUP RECOMPILE" in render_report(report)

@@ -55,10 +55,30 @@ def partition(files: list[str], n_groups: int) -> list[list[str]]:
     return [g for g in groups if g]
 
 
+def _open_ledger(ledger_dir: str):
+    """Suite runs write the same JSONL ledger schema training runs do
+    (obs/ledger.py): a run_header, one ``suite_group`` event per pytest
+    child, and a run_end with the TimeHistogram summary of group wall times
+    — so suite history is greppable/mergeable with the same tooling as
+    ``telemetry-report``'s inputs. Best-effort: a broken import or an
+    unwritable dir must not take the suite runner down."""
+    try:
+        sys.path.insert(0, REPO)
+        from tensorflowdistributedlearning_tpu.obs import RunLedger
+
+        return RunLedger(ledger_dir)
+    except Exception as e:  # noqa: BLE001
+        print(f"suite ledger disabled: {e}", file=sys.stderr)
+        return None
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--groups", type=int, default=4)
     parser.add_argument("--json-out", default=None)
+    parser.add_argument("--ledger-dir", default=None,
+                        help="append suite events to {dir}/telemetry.jsonl "
+                        "(the obs run-ledger schema); omitted = no ledger")
     parser.add_argument("--pytest-args", default="-q",
                         help="extra args passed to each pytest child; values "
                         "starting with '-' need the = form "
@@ -81,6 +101,17 @@ def main() -> int:
     # re-inserts the repo root itself
     env["PYTHONPATH"] = ""
     env["JAX_PLATFORMS"] = "cpu"
+
+    ledger = _open_ledger(args.ledger_dir) if args.ledger_dir else None
+    group_times = None
+    if ledger is not None:
+        from tensorflowdistributedlearning_tpu.obs import TimeHistogram
+
+        group_times = TimeHistogram("suite_group")
+        ledger.event(
+            "run_header", kind="test_suite", groups=args.groups,
+            files=len(files),
+        )
 
     record: dict = {"groups": [], "ok": True}
     t_all = time.time()
@@ -120,6 +151,12 @@ def main() -> int:
             record["groups"].append(
                 {"files": names, "timeout": args.group_timeout, "secs": secs}
             )
+            if ledger is not None:
+                group_times.record(secs)
+                ledger.event(
+                    "suite_group", group=i + 1, files=names, secs=secs,
+                    timed_out=True,
+                )
             continue
 
         secs = round(time.time() - t0, 1)
@@ -139,7 +176,20 @@ def main() -> int:
                 "summary": summary.group(1) if summary else tail,
             }
         )
+        if ledger is not None:
+            group_times.record(secs)
+            ledger.event(
+                "suite_group", group=i + 1, files=names, secs=secs,
+                rc=child.returncode,
+                summary=summary.group(1) if summary else tail,
+            )
     record["total_secs"] = round(time.time() - t_all, 1)
+    if ledger is not None:
+        ledger.event(
+            "run_end", ok=record["ok"], total_secs=record["total_secs"],
+            group_secs=group_times.summary() if len(group_times) else None,
+        )
+        ledger.close()
     print(json.dumps({"ok": record["ok"], "total_secs": record["total_secs"]}))
     if args.json_out:
         with open(args.json_out, "w") as f:
