@@ -63,10 +63,19 @@ def build_parser() -> argparse.ArgumentParser:
     p_train.add_argument("--save-best", type=int, default=5)
     p_train.add_argument("--checkpoint-every", type=int, default=500)
     p_train.add_argument("--eval-throttle-secs", type=int, default=300)
+    p_train.add_argument("--export-serving", action="store_true",
+                         help="after training, export the best fold's "
+                         "standalone StableHLO serving artifact next to its "
+                         "checkpoint ({fold_dir}/export/serving)")
 
     p_pred = sub.add_parser("predict", help="fold x TTA ensemble prediction")
     _add_common(p_pred)
     p_pred.add_argument("--test-dir", required=True)
+    p_pred.add_argument("--artifact-dir", default=None,
+                        help="run inference from an exported StableHLO "
+                        "serving artifact (through the bucketed serve "
+                        "engine) instead of restoring checkpoints; "
+                        "--model-dir is ignored")
     p_pred.add_argument("--no-tta", action="store_true",
                         help="disable test-time augmentation (single forward pass)")
     p_pred.add_argument("--output", default=None,
@@ -142,6 +151,42 @@ def build_parser() -> argparse.ArgumentParser:
                        "batches untouched; mixup/cutmix add image/label "
                        "mixing on top of flip_crop)")
 
+    p_serve = sub.add_parser(
+        "serve",
+        help="dynamic-batching HTTP inference server over an exported "
+        "StableHLO artifact (bucketed compilation, bounded-queue "
+        "backpressure, /v1/predict + /healthz + /metrics)",
+    )
+    p_serve.add_argument("--artifact-dir", required=True,
+                         help="artifact directory from export_serving "
+                         "(serving.stablehlo + manifest.json)")
+    p_serve.add_argument("--host", default="127.0.0.1")
+    p_serve.add_argument("--port", type=int, default=8000,
+                         help="0 = any free port (printed on startup)")
+    p_serve.add_argument("--buckets", type=int, nargs="+",
+                         default=(1, 4, 16, 64),
+                         help="batch-bucket ladder; each bucket is compiled "
+                         "once at warmup, requests pad up to the smallest "
+                         "fit — steady state never recompiles")
+    p_serve.add_argument("--max-wait-ms", type=float, default=5.0,
+                         help="micro-batcher coalescing window after the "
+                         "first queued request")
+    p_serve.add_argument("--queue-size", type=int, default=256,
+                         help="bounded request queue; a full queue rejects "
+                         "immediately with HTTP 429 (backpressure, not "
+                         "unbounded memory)")
+    p_serve.add_argument("--default-deadline-ms", type=float, default=None,
+                         help="deadline applied to requests that carry none; "
+                         "expired requests answer 504 without burning a "
+                         "bucket slot")
+    p_serve.add_argument("--workdir", default=None,
+                         help="telemetry ledger dir (serve_window events in "
+                         "{workdir}/telemetry.jsonl; default: the artifact "
+                         "dir)")
+    p_serve.add_argument("--window-secs", type=float, default=30.0,
+                         help="ledger window cadence; 0 disables periodic "
+                         "windows (final window still written on shutdown)")
+
     sub.add_parser("presets", help="list the named BASELINE config presets")
 
     p_rep = sub.add_parser(
@@ -206,6 +251,20 @@ def _trainer(args):
     )
 
 
+def _best_fold(results: List[dict]) -> int:
+    """Index of the fold a deployment would serve: highest mean IOU, falling
+    back to lowest loss for task metrics without one."""
+    if any("metrics/mean_iou" in r for r in results):
+        return max(
+            range(len(results)),
+            key=lambda i: results[i].get("metrics/mean_iou", float("-inf")),
+        )
+    return min(
+        range(len(results)),
+        key=lambda i: results[i].get("loss", float("inf")),
+    )
+
+
 def cmd_train(args) -> int:
     from tensorflowdistributedlearning_tpu.data import pipeline as pipeline_lib
 
@@ -215,11 +274,70 @@ def cmd_train(args) -> int:
         print(f"No images found under {args.data_dir}/images", file=sys.stderr)
         return 1
     results = trainer.train(ids, batch_size=args.batch_size, steps=args.steps)
-    print(json.dumps({"folds": results, "n_params": trainer.params}))
+    out = {"folds": results, "n_params": trainer.params}
+    if getattr(args, "export_serving", False) and results:
+        fold = _best_fold(results)
+        out["serving_fold"] = fold
+        out["serving_artifact"] = trainer.export_serving(fold)
+    print(json.dumps(out))
+    return 0
+
+
+def _predict_from_artifact(args) -> int:
+    """``predict --artifact-dir``: inference through the bucketed serve engine
+    from a standalone exported artifact — no checkpoint plumbing, no model
+    code, just the data path's preprocessing contract (normalize + Laplacian
+    channel) replayed from the manifest."""
+    import jax.numpy as jnp
+
+    from tensorflowdistributedlearning_tpu.data import augment as augment_lib
+    from tensorflowdistributedlearning_tpu.data import pipeline as pipeline_lib
+    from tensorflowdistributedlearning_tpu.serve import InferenceEngine
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    engine = InferenceEngine.from_artifact(args.artifact_dir)
+    manifest = serving_lib.read_manifest(args.artifact_dir)
+    nchw = manifest.get("data_format") == "NCHW"
+    channels = manifest["input_shape"][1 if nchw else -1]
+
+    test_ds = pipeline_lib.InMemoryDataset.from_directory(
+        args.test_dir, with_masks=False
+    )
+    images = test_ds.images  # [N, H, W, 1] normalized
+    if channels == 2:  # the segmentation contract: image + Laplacian channel
+        images = np.asarray(augment_lib.add_laplace_channel(jnp.asarray(images)))
+    if nchw:
+        images = np.transpose(images, (0, 3, 1, 2))
+
+    step = engine.max_batch_size
+    chunks = [
+        engine.infer(images[i : i + step]) for i in range(0, len(images), step)
+    ]
+    outputs = {
+        k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]
+    }
+    if args.submission and "mask" in outputs:
+        from tensorflowdistributedlearning_tpu.data.kaggle import write_submission
+
+        write_submission(args.submission, test_ds.ids, outputs["mask"])
+    if args.output:
+        np.savez(args.output, ids=np.asarray(test_ds.ids), **outputs)
+        print(json.dumps({"written": args.output, "n": len(test_ds.ids)}))
+    else:
+        summary = {
+            "n": len(test_ds.ids),
+            "outputs": {k: list(v.shape) for k, v in outputs.items()},
+            "bucket_hits": {str(b): n for b, n in engine.bucket_hits.items()},
+        }
+        if "mask" in outputs:
+            summary["mean_mask_coverage"] = float(outputs["mask"].mean())
+        print(json.dumps(summary))
     return 0
 
 
 def cmd_predict(args) -> int:
+    if getattr(args, "artifact_dir", None):
+        return _predict_from_artifact(args)
     trainer = _trainer(args)
     pred = trainer.predict(
         args.test_dir, batch_size=args.batch_size, tta=not args.no_tta
@@ -341,6 +459,70 @@ def cmd_telemetry_report(args) -> int:
     except (FileNotFoundError, ValueError) as e:
         print(f"telemetry-report: {e}", file=sys.stderr)
         return 1
+    return 0
+
+
+def cmd_serve(args) -> int:
+    """Serve an exported artifact over HTTP: warm every bucket, run the
+    micro-batcher behind /v1/predict, drain gracefully on SIGINT/SIGTERM.
+    Request-path telemetry lands in {workdir}/telemetry.jsonl; render it with
+    ``telemetry-report``."""
+    import signal
+
+    from tensorflowdistributedlearning_tpu.obs import Telemetry
+    from tensorflowdistributedlearning_tpu.serve import (
+        InferenceEngine,
+        MicroBatcher,
+        ServingServer,
+    )
+
+    workdir = args.workdir or args.artifact_dir
+    telemetry = Telemetry(
+        workdir,
+        run_info={
+            "kind": "serve",
+            "artifact_dir": args.artifact_dir,
+            "buckets": list(args.buckets),
+            "max_wait_ms": args.max_wait_ms,
+            "queue_size": args.queue_size,
+        },
+    )
+    engine = InferenceEngine.from_artifact(
+        args.artifact_dir, buckets=args.buckets, registry=telemetry.registry
+    )
+    warmup_s = engine.warmup(telemetry=telemetry)
+    batcher = MicroBatcher(
+        engine,
+        max_wait_ms=args.max_wait_ms,
+        max_queue=args.queue_size,
+        default_deadline_ms=args.default_deadline_ms,
+    )
+    server = ServingServer(
+        engine,
+        batcher,
+        host=args.host,
+        port=args.port,
+        telemetry=telemetry,
+        window_secs=args.window_secs,
+    )
+    server.start()
+    print(
+        json.dumps(
+            {
+                "serving": server.url,
+                "buckets": list(engine.buckets),
+                "warmup_s": {str(b): s for b, s in warmup_s.items()},
+                "ledger": workdir,
+            }
+        ),
+        flush=True,
+    )
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: server.shutdown())
+    try:
+        server.wait()
+    finally:
+        server.shutdown()
     return 0
 
 
@@ -545,6 +727,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "predict": cmd_predict,
         "smoke": cmd_smoke,
         "fit": cmd_fit,
+        "serve": cmd_serve,
         "presets": cmd_presets,
         "telemetry-report": cmd_telemetry_report,
         "doctor": cmd_doctor,

@@ -92,9 +92,32 @@ def fixed_padding(
     pad_total = effective - 1
     pad_beg = pad_total // 2
     pad_end = pad_total - pad_beg
-    return jnp.pad(
-        x, [(0, 0), (pad_beg, pad_end), (pad_beg, pad_end), (0, 0)], mode=mode
-    )
+    if mode == "constant":
+        return jnp.pad(
+            x, [(0, 0), (pad_beg, pad_end), (pad_beg, pad_end), (0, 0)]
+        )
+    # symmetric/reflect spelled as slice+flip+concat on the SPATIAL axes only:
+    # jnp.pad with these modes refuses a polymorphic batch dim even though its
+    # padding is zero (jax <= 0.4.x shape-poly check), which broke jax.export
+    # of any model containing upsample() — the whole segmentation family
+    off = 0 if mode == "symmetric" else 1  # reflect skips the edge pixel
+    for axis in (1, 2):
+        size = x.shape[axis]
+        parts = []
+        if pad_beg:
+            parts.append(
+                jnp.flip(jax.lax.slice_in_dim(x, off, off + pad_beg, axis=axis), axis)
+            )
+        parts.append(x)
+        if pad_end:
+            parts.append(
+                jnp.flip(
+                    jax.lax.slice_in_dim(x, size - pad_end - off, size - off, axis=axis),
+                    axis,
+                )
+            )
+        x = jnp.concatenate(parts, axis=axis)
+    return x
 
 
 def subsample(x: jax.Array, stride: int) -> jax.Array:

@@ -48,9 +48,11 @@ class RunLedger:
     def enabled(self) -> bool:
         return self._f is not None
 
-    def event(self, kind: str, **fields) -> None:
+    def event(self, kind: str, /, **fields) -> None:
         """Append one event; a write failure disables the ledger with one
-        warning (never raises into the training loop)."""
+        warning (never raises into the training loop). ``kind`` is
+        positional-only so producers may carry their own ``kind`` field (the
+        suite runner's and serving stack's headers do)."""
         if self._f is None:
             return
         record = {"event": kind, "t": time.time(), **fields}

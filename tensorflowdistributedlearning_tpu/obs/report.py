@@ -62,6 +62,65 @@ def _trace_section(trace_dir: str, top: int) -> Optional[Dict]:
     return section
 
 
+def _serve_section(windows: List[Dict]) -> Dict:
+    """Aggregate ``serve_window`` events (serve/server.py) for the report.
+
+    Counters in a window are cumulative since server start, so totals come
+    from the last window; latency summaries are per-window (the server drains
+    its histograms at each boundary), merged the same approximate way as
+    ``step_time_ms``: count-weighted mean/p50/p90, worst-window p99."""
+    last = windows[-1]
+    totals = {
+        k: last.get(k, 0)
+        for k in (
+            "requests",
+            "completed",
+            "rejected_queue_full",
+            "deadline_exceeded",
+            "errors",
+            "batches",
+            "batched_examples",
+        )
+    }
+    section: Dict = {
+        "windows": len(windows),
+        **totals,
+        "bucket_hits": last.get("bucket_hits", {}),
+        "recompiles_post_warmup": last.get("recompiles_post_warmup"),
+    }
+    if totals["batches"]:
+        section["mean_batch_fill"] = round(
+            totals["batched_examples"] / totals["batches"], 2
+        )
+    latency: Dict = {}
+    for name in ("queue_wait", "pad", "compute"):
+        per_window = [
+            e["latency_ms"][name]
+            for e in windows
+            if name in e.get("latency_ms", {})
+        ]
+        if not per_window:
+            continue
+        weights = [s.get("count", 1.0) for s in per_window]
+        latency[name] = {
+            "mean": round(
+                _weighted([s["mean_ms"] for s in per_window], weights) or 0, 3
+            ),
+            "p50": round(
+                _weighted([s["p50_ms"] for s in per_window], weights) or 0, 3
+            ),
+            "p90": round(
+                _weighted([s["p90_ms"] for s in per_window], weights) or 0, 3
+            ),
+            "p99_worst_window": round(
+                max(s["p99_ms"] for s in per_window), 3
+            ),
+        }
+    if latency:
+        section["latency_ms"] = latency
+    return section
+
+
 def build_report(
     workdir: str, *, trace_dir: Optional[str] = None, top: int = 10
 ) -> Dict:
@@ -142,6 +201,10 @@ def build_report(
         },
         "checkpoints": len(checkpoints),
     }
+
+    serve_windows = [e for e in events if e.get("event") == "serve_window"]
+    if serve_windows:
+        report["serve"] = _serve_section(serve_windows)
 
     ips = [
         (e["step"], e["images_per_sec"])
@@ -277,6 +340,41 @@ def render_report(report: Dict) -> str:
         if "host_rss_peak_bytes" in mem:
             parts.append(f"host RSS peak {mem['host_rss_peak_bytes'] / 2**20:.1f} MiB")
         lines.append("memory: " + ", ".join(parts))
+    sv = report.get("serve")
+    if sv:
+        lines.append(
+            f"\nserving ({sv['windows']} window(s)): "
+            f"{sv['requests']} requests, {sv['completed']} completed, "
+            f"{sv['rejected_queue_full']} rejected (queue full), "
+            f"{sv['deadline_exceeded']} deadline-exceeded, "
+            f"{sv['errors']} errors"
+        )
+        if sv.get("batches"):
+            lines.append(
+                f"  batches: {sv['batches']} "
+                f"(mean fill {sv.get('mean_batch_fill', 0):.1f} examples)"
+            )
+        if sv.get("bucket_hits"):
+            hits = "  ".join(
+                f"{b}:{n}" for b, n in sorted(
+                    sv["bucket_hits"].items(), key=lambda kv: int(kv[0])
+                )
+            )
+            lines.append(f"  bucket hits: {hits}")
+        for name, s in (sv.get("latency_ms") or {}).items():
+            lines.append(
+                f"  {name.replace('_', '-'):<12} (ms): mean {s['mean']:.2f}  "
+                f"p50 {s['p50']:.2f}  p90 {s['p90']:.2f}  "
+                f"p99(worst window) {s['p99_worst_window']:.2f}"
+            )
+        rc_s = sv.get("recompiles_post_warmup")
+        if rc_s:
+            lines.append(
+                f"  !! {rc_s} POST-WARMUP RECOMPILE(S) on the request path — "
+                "a shape escaped the bucket ladder"
+            )
+        elif rc_s == 0:
+            lines.append("  post-warmup recompiles on the request path: none")
     tr = report.get("trace")
     if tr:
         lines.append(f"\ndevice op breakdown ({tr['dir']}):")

@@ -141,9 +141,16 @@ class Telemetry:
 
     # -- events ------------------------------------------------------------
 
-    def _event(self, kind: str, **fields) -> None:
+    def _event(self, kind: str, /, **fields) -> None:
         if self.ledger is not None:
             self.ledger.event(kind, **fields)
+
+    def event(self, kind: str, /, **fields) -> None:
+        """Append an arbitrary ledger event under this run's header — the
+        extension point non-trainer producers (the serving stack's
+        ``serve_window`` events, suite stages) write through, so every
+        producer shares one schema, one writer, one failure stance."""
+        self._event(kind, **fields)
 
     def window_event(
         self,
@@ -286,8 +293,11 @@ class Telemetry:
 
 def _host_rss_bytes() -> Optional[int]:
     try:
+        import os
+
+        page = os.sysconf("SC_PAGE_SIZE")  # 64KiB-page kernels exist
         with open("/proc/self/statm") as f:
-            return int(f.read().split()[1]) * 4096
+            return int(f.read().split()[1]) * page
     except (OSError, ValueError, IndexError):
         return None
 

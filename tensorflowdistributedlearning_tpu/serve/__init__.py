@@ -1,0 +1,49 @@
+"""Dynamic-batching inference engine: the serving layer of the stack.
+
+``train/serving.py`` ends at a self-contained StableHLO artifact; this package
+is the runtime that turns concurrent client requests into efficient TPU
+batches against it, built around the two facts production TPU serving is
+designed by (Gemma-on-TPU, arXiv:2605.25645; pjit/TPUv4, arXiv:2204.06514):
+batching is where the throughput is, and post-warmup recompiles are where the
+goodput goes.
+
+- ``serve.engine``  — :class:`InferenceEngine`: pads requests into a fixed
+  ladder of batch buckets (default 1/4/16/64), pre-warms every bucket so
+  steady state never compiles, counts per-bucket hits;
+- ``serve.batcher`` — :class:`MicroBatcher`: bounded-queue micro-batching
+  (``max_batch_size`` / ``max_wait_ms`` coalescing), per-request deadlines,
+  explicit backpressure (full queue ⇒ immediate :class:`QueueFullError`);
+- ``serve.server``  — :class:`ServingServer`: stdlib ``ThreadingHTTPServer``
+  exposing ``/v1/predict`` / ``/healthz`` / ``/metrics``, graceful
+  drain-on-shutdown, and ``serve_window`` events in the workdir's
+  ``telemetry.jsonl`` (rendered by ``obs.report`` / ``telemetry-report``).
+
+CLI: ``python -m tensorflowdistributedlearning_tpu serve --artifact-dir D``;
+load generator + batched-vs-per-request benchmark: ``tools/bench_serve.py``.
+"""
+
+from tensorflowdistributedlearning_tpu.serve.batcher import (
+    DeadlineExceededError,
+    MicroBatcher,
+    QueueFullError,
+    Request,
+    ServerClosedError,
+)
+from tensorflowdistributedlearning_tpu.serve.engine import (
+    DEFAULT_BUCKETS,
+    InferenceEngine,
+    RequestTooLargeError,
+)
+from tensorflowdistributedlearning_tpu.serve.server import ServingServer
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "DeadlineExceededError",
+    "InferenceEngine",
+    "MicroBatcher",
+    "QueueFullError",
+    "Request",
+    "RequestTooLargeError",
+    "ServerClosedError",
+    "ServingServer",
+]

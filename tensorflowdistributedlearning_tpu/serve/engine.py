@@ -1,0 +1,208 @@
+"""Bucketed-compilation inference engine: the shape-discipline layer of serving.
+
+A TPU serving path lives or dies on two things the training stack already
+learned the hard way (obs/recompile.py): every distinct input shape is its own
+XLA executable, and a post-warmup compile stalls every chip for seconds. A
+naive server that forwards whatever batch size arrives compiles once per
+observed size — and production traffic observes *every* size. The standard
+discipline (Gemma-on-TPU serving, arXiv:2605.25645 §4; TF-Serving's batching
+contract) is a fixed ladder of batch **buckets**: requests are zero-padded up
+to the smallest bucket that fits, so steady state touches only
+``len(buckets)`` executables, all of them compiled during warmup.
+
+``InferenceEngine`` wraps either a loaded ``jax.export`` artifact
+(:meth:`from_artifact`) or any params-baked ``x -> pytree`` closure, owns the
+pad → compute → slice round-trip, pre-warms every bucket, and records the
+pad/compute latency split plus per-bucket hit counts into an
+``obs.metrics.MetricsRegistry`` so ``/metrics`` and the serve ledger windows
+report from the same instruments the trainers use.
+"""
+
+from __future__ import annotations
+
+import bisect
+import logging
+import time
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tensorflowdistributedlearning_tpu.obs.metrics import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+# the ladder production TPU servers converge on: fine steps at the small end
+# (latency-sensitive singletons), coarse at the top (throughput batches)
+DEFAULT_BUCKETS: Tuple[int, ...] = (1, 4, 16, 64)
+
+
+class RequestTooLargeError(ValueError):
+    """A request carries more examples than the largest compiled bucket —
+    the caller must chunk it; silently splitting here would reorder the
+    batcher's fairness guarantees."""
+
+
+def _tree_map(fn, tree):
+    """Apply ``fn`` to every output leaf. Dict outputs (what both tasks'
+    ``predictions`` return) take a direct path — ``jax.tree_util.tree_map``
+    costs ~10µs per call, which at one call per request per batch is real
+    money on the request path."""
+    if isinstance(tree, dict):
+        return {k: fn(v) for k, v in tree.items()}
+    import jax
+
+    return jax.tree_util.tree_map(fn, tree)
+
+
+class InferenceEngine:
+    """Pads request batches into a fixed bucket ladder and runs ``serve_fn``.
+
+    ``serve_fn`` maps ``x [B, *example_shape] -> pytree of arrays [B, ...]``
+    with parameters baked in (exactly what ``train/serving.py`` artifacts and
+    the trainers' ``serving_fn()`` closures provide). ``infer`` is thread-safe:
+    it owns no mutable state beyond registry instruments, whose updates are
+    GIL-atomic appends/increments.
+    """
+
+    def __init__(
+        self,
+        serve_fn: Callable,
+        example_shape: Sequence[int],
+        *,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        input_dtype="float32",
+        registry: Optional[MetricsRegistry] = None,
+    ):
+        self.serve_fn = serve_fn
+        self.example_shape = tuple(int(d) for d in example_shape)
+        self.buckets = tuple(sorted({int(b) for b in buckets}))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got {buckets!r}")
+        self.input_dtype = np.dtype(input_dtype)
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._pad_h = self.registry.histogram("serve/pad")
+        self._compute_h = self.registry.histogram("serve/compute")
+        # pre-create so /metrics shows the whole ladder even before traffic
+        self._hit_counters = {
+            b: self.registry.counter(f"serve/bucket_hits/{b}")
+            for b in self.buckets
+        }
+        # per-bucket zero pad template, filled lazily: the request path slices
+        # a view instead of allocating fresh zeros every call
+        self._pad_zeros: Dict[int, np.ndarray] = {}
+        self.warmed = False
+
+    @classmethod
+    def from_artifact(
+        cls,
+        directory: str,
+        *,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        registry: Optional[MetricsRegistry] = None,
+    ) -> "InferenceEngine":
+        """Engine over an exported StableHLO artifact (``train/serving.py``).
+
+        The manifest supplies the example shape and input dtype. An artifact
+        exported with a FIXED batch dimension (``batch_polymorphic=False``)
+        supports exactly one shape, so the ladder collapses to that single
+        bucket regardless of ``buckets``.
+        """
+        from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+        serve = serving_lib.load_serving_artifact(directory)
+        manifest = serving_lib.read_manifest(directory)
+        shape = manifest["input_shape"]
+        if any(d is None for d in shape[1:]):
+            raise ValueError(
+                f"artifact input shape {shape} has a symbolic non-batch dim — "
+                "the engine needs static example shapes to pad against"
+            )
+        if shape[0] is not None:
+            logger.info(
+                "artifact %s was exported with fixed batch %d — bucket ladder "
+                "collapses to that single bucket", directory, shape[0],
+            )
+            buckets = (int(shape[0]),)
+        return cls(
+            serve,
+            tuple(shape[1:]),
+            buckets=buckets,
+            input_dtype=manifest.get("input_dtype", "float32"),
+            registry=registry,
+        )
+
+    @property
+    def max_batch_size(self) -> int:
+        return self.buckets[-1]
+
+    @property
+    def bucket_hits(self) -> Dict[int, int]:
+        return {
+            b: self.registry.counter(f"serve/bucket_hits/{b}").value
+            for b in self.buckets
+        }
+
+    def select_bucket(self, n: int) -> int:
+        """Smallest bucket that fits ``n`` examples."""
+        if n < 1:
+            raise ValueError(f"cannot serve an empty batch (n={n})")
+        i = bisect.bisect_left(self.buckets, n)
+        if i == len(self.buckets):
+            raise RequestTooLargeError(
+                f"{n} examples exceeds the largest bucket "
+                f"({self.max_batch_size}); chunk the request"
+            )
+        return self.buckets[i]
+
+    def warmup(self, telemetry=None) -> Dict[int, float]:
+        """Compile every bucket up front (zeros input), returning per-bucket
+        wall seconds. After this, steady-state serving touches only warmed
+        shapes — when ``telemetry`` is passed, its recompile detector is
+        marked warm so any later compile is flagged (and ledgered) as the
+        goodput bug it is."""
+        import jax
+
+        timings: Dict[int, float] = {}
+        for b in self.buckets:
+            x = np.zeros((b, *self.example_shape), self.input_dtype)
+            t0 = time.perf_counter()
+            jax.block_until_ready(self.serve_fn(x))
+            timings[b] = round(time.perf_counter() - t0, 6)
+        self.warmed = True
+        if telemetry is not None:
+            telemetry.event(
+                "serve_warmup",
+                buckets={str(b): s for b, s in timings.items()},
+                example_shape=list(self.example_shape),
+                input_dtype=str(self.input_dtype),
+            )
+            telemetry.mark_warm()
+        return timings
+
+    def infer(self, x) -> Dict:
+        """Forward ``x [n, *example_shape]`` through the bucket ladder: pad to
+        the selected bucket, run, slice every output back to ``n`` rows."""
+        import jax
+
+        x = np.asarray(x, self.input_dtype)
+        if x.shape[1:] != self.example_shape:
+            raise ValueError(
+                f"expected examples of shape {self.example_shape}, "
+                f"got batch {x.shape}"
+            )
+        n = x.shape[0]
+        bucket = self.select_bucket(n)
+        t0 = time.perf_counter()
+        if n != bucket:
+            zeros = self._pad_zeros.get(bucket)
+            if zeros is None:
+                zeros = self._pad_zeros[bucket] = np.zeros(
+                    (bucket, *self.example_shape), self.input_dtype
+                )
+            x = np.concatenate([x, zeros[: bucket - n]])
+        self._pad_h.record(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(self.serve_fn(x))
+        self._compute_h.record(time.perf_counter() - t0)
+        self._hit_counters[bucket].inc()
+        return _tree_map(lambda a: np.asarray(a)[:n], out)

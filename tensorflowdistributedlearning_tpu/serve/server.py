@@ -1,0 +1,316 @@
+"""Stdlib HTTP front-end: ``/v1/predict``, ``/healthz``, ``/metrics``.
+
+A ``ThreadingHTTPServer`` (one thread per connection — the handler threads
+block in ``Request.result()``, the single batcher worker does the compute, so
+concurrency costs threads-waiting-on-events, not parallel TPU dispatch) in
+front of the engine/batcher pair. Wire protocol, TF-Serving-shaped:
+
+    POST /v1/predict   {"instances": [[...], ...], "deadline_ms": 250}
+                    -> {"predictions": {...}, "n": k}
+    GET  /healthz      {"ok": true, "draining": false, ...}
+    GET  /metrics      live registry snapshot + bucket hits + queue depth
+
+Errors are structured, never silent: 400 malformed input, 413 over the
+largest bucket, 429 queue full (backpressure), 503 draining, 504 deadline —
+each body carries ``{"error": {"code", "message"}}`` and bumps the matching
+registry counter.
+
+Request-path telemetry: alongside the live ``/metrics`` view, the server
+appends ``serve_window`` events to the workdir's ``telemetry.jsonl`` every
+``window_secs`` (cumulative counters + that window's queue-wait/pad/compute
+latency percentiles + post-warmup recompile count), and ``shutdown()`` drains
+gracefully — intake stops, accepted requests finish, a final window and
+``run_end`` land in the ledger. ``obs.report`` renders these as the ``serving``
+section of the goodput report.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+import numpy as np
+
+from tensorflowdistributedlearning_tpu.obs.metrics import time_summary
+from tensorflowdistributedlearning_tpu.obs.telemetry import NULL_TELEMETRY
+from tensorflowdistributedlearning_tpu.serve.batcher import (
+    DeadlineExceededError,
+    MicroBatcher,
+    QueueFullError,
+    RequestTooLargeError,
+    ServerClosedError,
+)
+from tensorflowdistributedlearning_tpu.serve.engine import InferenceEngine
+
+logger = logging.getLogger(__name__)
+
+# counters a serve_window snapshot carries (cumulative since server start)
+_WINDOW_COUNTERS = (
+    "requests",
+    "completed",
+    "rejected_queue_full",
+    "deadline_exceeded",
+    "errors",
+    "batches",
+    "batched_examples",
+)
+# per-window latency histograms, drained each window so a long-lived server
+# holds at most one window's samples (same boundedness stance as the
+# trainers' span histograms, obs/telemetry.py)
+_WINDOW_HISTOGRAMS = ("queue_wait", "pad", "compute")
+
+
+class ServingServer:
+    """Engine + batcher behind a ThreadingHTTPServer, with ledger windows."""
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        batcher: MicroBatcher,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        telemetry=None,
+        window_secs: float = 30.0,
+        result_timeout_s: float = 60.0,
+    ):
+        self.engine = engine
+        self.batcher = batcher
+        self.telemetry = telemetry if telemetry is not None else NULL_TELEMETRY
+        self.window_secs = float(window_secs)
+        self.result_timeout_s = float(result_timeout_s)
+        self.draining = False
+        self._started_t = time.time()
+        self._stop = threading.Event()
+        self._shutdown_lock = threading.Lock()
+        self._shut_down = False
+        handler = type("Handler", (_Handler,), {"ctx": self})
+        self._httpd = ThreadingHTTPServer((host, port), handler, bind_and_activate=False)
+        # stdlib default listen backlog is 5: a burst of concurrent connects
+        # overflows it and the overflow retransmits SYNs for seconds — size it
+        # like the request queue, and let quick restarts rebind the port
+        self._httpd.request_queue_size = max(128, batcher.max_queue)
+        self._httpd.allow_reuse_address = True
+        self._httpd.server_bind()
+        self._httpd.server_activate()
+        self._httpd.daemon_threads = True
+        self._serve_thread: Optional[threading.Thread] = None
+        self._ticker: Optional[threading.Thread] = None
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ServingServer":
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="serve-http",
+            daemon=True,
+        )
+        self._serve_thread.start()
+        self.telemetry.event(
+            "serve_start",
+            endpoint=self.url,
+            buckets=list(self.engine.buckets),
+            max_batch_size=self.batcher.max_batch_size,
+            max_wait_ms=self.batcher.max_wait_s * 1000,
+            max_queue=self.batcher.max_queue,
+        )
+        if self.window_secs > 0:
+            self._ticker = threading.Thread(
+                target=self._tick, name="serve-window-ticker", daemon=True
+            )
+            self._ticker.start()
+        logger.info("serving on %s (buckets %s)", self.url, self.engine.buckets)
+        return self
+
+    def wait(self) -> None:
+        """Block the calling thread until ``shutdown()`` (the CLI foreground)."""
+        self._stop.wait()
+
+    def metrics_snapshot(self) -> Dict:
+        """The ``/metrics`` body: live registry view + serving identity."""
+        reg = self.engine.registry
+        return {
+            "uptime_s": round(time.time() - self._started_t, 3),
+            "draining": self.draining,
+            "buckets": {str(b): n for b, n in self.engine.bucket_hits.items()},
+            "queue_depth": reg.gauge("serve/queue_depth").value or 0,
+            # histograms here are "since the last ledger window" — the window
+            # drain keeps a long-lived server's sample memory bounded
+            "registry": reg.snapshot(),
+        }
+
+    def emit_window(self, final: bool = False) -> Dict:
+        """One ``serve_window`` ledger event: cumulative counters, this
+        window's latency split (ms percentiles), post-warmup recompiles."""
+        reg = self.engine.registry
+        fields: Dict = {
+            k: reg.counter(f"serve/{k}").value for k in _WINDOW_COUNTERS
+        }
+        fields["queue_depth"] = reg.gauge("serve/queue_depth").value or 0
+        fields["bucket_hits"] = {
+            str(b): n for b, n in self.engine.bucket_hits.items()
+        }
+        latency: Dict = {}
+        for name in _WINDOW_HISTOGRAMS:
+            samples = reg.histogram(f"serve/{name}").drain()
+            if samples:
+                summary = time_summary(samples)
+                latency[name] = {
+                    k[:-2] + "_ms": round(v * 1000, 3)
+                    for k, v in summary.items()
+                    if k.endswith("_s") and k != "total_s"
+                }
+                latency[name]["count"] = summary["count"]
+        if latency:
+            fields["latency_ms"] = latency
+        detector = self.telemetry.detector
+        if detector is not None:
+            fields["recompiles_post_warmup"] = detector.post_warmup_count
+        if final:
+            fields["final"] = True
+        self.telemetry.event("serve_window", **fields)
+        return fields
+
+    def _tick(self) -> None:
+        while not self._stop.wait(self.window_secs):
+            try:
+                self.emit_window()
+            except Exception:  # noqa: BLE001 — telemetry never kills serving
+                logger.exception("serve window emission failed")
+
+    def shutdown(self) -> None:
+        """Graceful drain: refuse new work, finish accepted requests, write
+        the final ledger window, stop the listener. Idempotent."""
+        with self._shutdown_lock:
+            if self._shut_down:
+                return
+            self._shut_down = True
+        self.draining = True
+        self._stop.set()
+        if self._ticker is not None:
+            self._ticker.join(timeout=5)
+        self.batcher.close(drain=True)
+        try:
+            final = self.emit_window(final=True)
+        except Exception:  # noqa: BLE001
+            logger.exception("final serve window emission failed")
+            final = {}
+        self.telemetry.close(
+            kind="serve",
+            requests=final.get("requests"),
+            completed=final.get("completed"),
+            rejected_queue_full=final.get("rejected_queue_full"),
+            deadline_exceeded=final.get("deadline_exceeded"),
+        )
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=5)
+        logger.info("serving stopped (drained)")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    ctx: ServingServer  # bound by ServingServer via a subclass attribute
+    # HTTP/1.1 keep-alive: every response sets Content-Length below
+    protocol_version = "HTTP/1.1"
+    # small request/response bodies in separate writes + Nagle + delayed ACK
+    # = ~200ms per round trip on loopback; inference RPCs always disable it
+    disable_nagle_algorithm = True
+
+    def log_message(self, fmt, *args):  # route access logs to logging, quiet
+        logger.debug("%s - %s", self.address_string(), fmt % args)
+
+    def _json(self, status: int, payload: Dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, status: int, code: str, message: str) -> None:
+        self._json(status, {"error": {"code": code, "message": message}})
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        if self.path == "/healthz":
+            status = 503 if self.ctx.draining else 200
+            self._json(
+                status,
+                {
+                    "ok": not self.ctx.draining,
+                    "draining": self.ctx.draining,
+                    "uptime_s": round(time.time() - self.ctx._started_t, 3),
+                    "buckets": list(self.ctx.engine.buckets),
+                },
+            )
+        elif self.path == "/metrics":
+            self._json(200, self.ctx.metrics_snapshot())
+        else:
+            self._error(404, "not_found", f"no route for GET {self.path}")
+
+    def do_POST(self):  # noqa: N802
+        if self.path != "/v1/predict":
+            self._error(404, "not_found", f"no route for POST {self.path}")
+            return
+        if self.ctx.draining:
+            self._error(503, "draining", "server is draining; retry elsewhere")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            instances = payload["instances"]
+        except (ValueError, KeyError) as e:
+            self._error(400, "bad_request", f"expected JSON {{'instances': [...]}}: {e}")
+            return
+        try:
+            x = np.asarray(instances, self.ctx.engine.input_dtype)
+        except (ValueError, TypeError) as e:
+            self._error(400, "bad_request", f"instances not array-like: {e}")
+            return
+        deadline_ms = payload.get("deadline_ms")
+        try:
+            request = self.ctx.batcher.submit(x, deadline_ms=deadline_ms)
+            out = request.result(timeout=self.ctx.result_timeout_s)
+        except QueueFullError as e:
+            self._error(429, "queue_full", str(e))
+            return
+        except RequestTooLargeError as e:
+            self._error(413, "request_too_large", str(e))
+            return
+        except ServerClosedError as e:
+            self._error(503, "draining", str(e))
+            return
+        except DeadlineExceededError as e:
+            self._error(504, "deadline_exceeded", str(e))
+            return
+        except TimeoutError as e:
+            self._error(504, "result_timeout", str(e))
+            return
+        except ValueError as e:  # wrong example shape
+            self._error(400, "bad_request", str(e))
+            return
+        except Exception as e:  # noqa: BLE001 — engine failures surfaced by
+            # the batcher must still answer structurally, never drop the socket
+            logger.exception("inference failed")
+            self._error(500, "internal", f"{type(e).__name__}: {e}")
+            return
+        import jax
+
+        predictions = jax.tree_util.tree_map(
+            lambda a: np.asarray(a).tolist(), out
+        )
+        self._json(200, {"predictions": predictions, "n": request.n})

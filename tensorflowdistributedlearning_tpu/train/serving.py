@@ -26,12 +26,44 @@ ARTIFACT_NAME = "serving.stablehlo"
 MANIFEST_NAME = "manifest.json"
 
 
+def _manifest_dims(shape) -> list:
+    """Manifest encoding of a shape: ints stay, symbolic dims (the polymorphic
+    batch) become None — the same placeholder convention as the input spec."""
+    return [int(d) if isinstance(d, int) else None for d in shape]
+
+
+def _output_signature(out_tree) -> Dict[str, Dict]:
+    """Flatten an output pytree of avals into ``{name: {shape, dtype}}``
+    manifest entries, so clients can validate responses without calling the
+    artifact. Dict outputs (both tasks' ``predictions``) name entries by key;
+    other containers fall back to the jax key-path string."""
+    import jax
+
+    sig: Dict[str, Dict] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(out_tree)[0]:
+        parts = []
+        for p in path:
+            for attr in ("key", "idx", "name"):
+                if hasattr(p, attr):
+                    parts.append(str(getattr(p, attr)))
+                    break
+            else:
+                parts.append(str(p))
+        name = "/".join(parts) if parts else "output"
+        sig[name] = {
+            "shape": _manifest_dims(leaf.shape),
+            "dtype": str(leaf.dtype),
+        }
+    return sig
+
+
 def export_serving_artifact(
     serve_fn: Callable,
     input_shape: Tuple[int, ...],
     directory: str,
     *,
     batch_polymorphic: bool = True,
+    input_dtype: str = "float32",
     metadata: Dict | None = None,
 ) -> str:
     """Serialize ``serve_fn`` (a jittable ``images -> {...}`` closure with params
@@ -49,7 +81,7 @@ def export_serving_artifact(
         spec_shape: Tuple = (b, *input_shape[1:])
     else:
         spec_shape = tuple(input_shape)
-    spec = jax.ShapeDtypeStruct(spec_shape, jnp.float32)
+    spec = jax.ShapeDtypeStruct(spec_shape, jnp.dtype(input_dtype))
     exported = jax_export.export(jax.jit(serve_fn))(spec)
     payload = exported.serialize()
 
@@ -60,7 +92,16 @@ def export_serving_artifact(
     manifest = {
         "input_shape": [None if batch_polymorphic else input_shape[0]]
         + list(input_shape[1:]),
-        "input_dtype": "float32",
+        "input_dtype": str(jnp.dtype(input_dtype)),
+        # the OUTPUT signature too: without it clients can't validate
+        # responses (or pre-allocate) from the manifest alone. Read from what
+        # export already traced (re-tracing via eval_shape trips shape-poly
+        # restrictions the export lowering itself handles).
+        "outputs": _output_signature(
+            jax.tree_util.tree_unflatten(
+                exported.out_tree, list(exported.out_avals)
+            )
+        ),
         "format": "jax.export serialized StableHLO",
         "platforms": list(getattr(exported, "platforms", ())),
         **(metadata or {}),
@@ -72,15 +113,22 @@ def export_serving_artifact(
 
 def load_serving_artifact(directory: str) -> Callable:
     """Deserialize an exported artifact; returns ``serve(images) -> outputs``.
-    Needs only jax — none of this framework's modules or checkpoints."""
+    Needs only jax — none of this framework's modules or checkpoints. The
+    input dtype comes from the manifest (an artifact exported for bfloat16
+    inputs used to be silently fed float32); a missing/legacy manifest falls
+    back to float32, the historical contract."""
     from jax import export as jax_export
 
     with open(os.path.join(directory, ARTIFACT_NAME), "rb") as f:
         payload = f.read()
     exported = jax_export.deserialize(bytearray(payload))
+    try:
+        dtype = jnp.dtype(read_manifest(directory).get("input_dtype", "float32"))
+    except (OSError, ValueError, TypeError):
+        dtype = jnp.dtype("float32")
 
     def serve(images) -> Dict:
-        return exported.call(jnp.asarray(images, jnp.float32))
+        return exported.call(jnp.asarray(images, dtype))
 
     return serve
 

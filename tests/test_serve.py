@@ -1,0 +1,421 @@
+"""serve/ subsystem: bucketed engine, micro-batcher, HTTP server, telemetry.
+
+The contracts under test are the ones production serving is operated by:
+padding round-trips exactly (a padded batch answers identically to the
+unbatched forward), the bucket ladder keeps steady state recompile-free
+(asserted through obs.recompile's detector, not by faith), the bounded queue
+rejects structurally instead of growing, deadlines expire without burning
+bucket slots, and the localhost HTTP stack serves /v1/predict + /healthz +
+/metrics and drains gracefully into the telemetry ledger.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.obs import Telemetry
+from tensorflowdistributedlearning_tpu.serve import (
+    DeadlineExceededError,
+    InferenceEngine,
+    MicroBatcher,
+    QueueFullError,
+    RequestTooLargeError,
+    ServerClosedError,
+    ServingServer,
+)
+
+FEATURES = 6
+CLASSES = 3
+
+
+@pytest.fixture(scope="module")
+def serve_fn():
+    """Tiny params-baked jitted closure, shaped like the trainers' serving_fn."""
+    import jax
+    import jax.numpy as jnp
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (FEATURES, CLASSES)) * 0.3
+
+    @jax.jit
+    def fn(x):
+        logits = x @ w
+        return {
+            "probabilities": jax.nn.softmax(logits, axis=-1),
+            "class": jnp.argmax(logits, axis=-1),
+        }
+
+    return fn
+
+
+@pytest.fixture
+def engine(serve_fn):
+    return InferenceEngine(serve_fn, (FEATURES,), buckets=(1, 4, 8))
+
+
+def _reference(serve_fn, x):
+    return {k: np.asarray(v) for k, v in serve_fn(x).items()}
+
+
+# -- engine: bucket selection + padding round-trip --------------------------
+
+
+def test_bucket_selection():
+    eng = InferenceEngine(lambda x: {"y": x}, (2,), buckets=(4, 1, 16, 4))
+    assert eng.buckets == (1, 4, 16)  # sorted, deduped
+    assert eng.select_bucket(1) == 1
+    assert eng.select_bucket(2) == 4
+    assert eng.select_bucket(4) == 4
+    assert eng.select_bucket(5) == 16
+    assert eng.max_batch_size == 16
+    with pytest.raises(RequestTooLargeError):
+        eng.select_bucket(17)
+    with pytest.raises(ValueError):
+        eng.select_bucket(0)
+
+
+def test_padding_roundtrip_identical_to_unbatched(engine, serve_fn, rng):
+    """The whole point of padding: results for n examples through any bucket
+    are bit-comparable to the plain forward on those n examples."""
+    for n in (1, 2, 3, 4, 5, 8):
+        x = rng.normal(0, 1, (n, FEATURES)).astype(np.float32)
+        got = engine.infer(x)
+        ref = _reference(serve_fn, x)
+        assert got["probabilities"].shape == (n, CLASSES)
+        assert got["class"].shape == (n,)
+        np.testing.assert_allclose(
+            got["probabilities"], ref["probabilities"], rtol=1e-6
+        )
+        np.testing.assert_array_equal(got["class"], ref["class"])
+
+
+def test_bucket_hit_accounting(engine, rng):
+    for n, expected_bucket in ((1, 1), (3, 4), (4, 4), (7, 8)):
+        engine.infer(rng.normal(0, 1, (n, FEATURES)).astype(np.float32))
+    assert engine.bucket_hits == {1: 1, 4: 2, 8: 1}
+
+
+def test_engine_rejects_wrong_example_shape(engine):
+    with pytest.raises(ValueError, match="expected examples"):
+        engine.infer(np.zeros((2, FEATURES + 1), np.float32))
+
+
+# -- engine: artifact loading + manifest signature --------------------------
+
+
+def test_manifest_records_output_signature(serve_fn, tmp_path):
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    directory = str(tmp_path / "artifact")
+    serving_lib.export_serving_artifact(serve_fn, (1, FEATURES), directory)
+    manifest = serving_lib.read_manifest(directory)
+    assert manifest["input_shape"] == [None, FEATURES]
+    assert manifest["input_dtype"] == "float32"
+    # the output side too: clients validate responses from the manifest alone
+    assert manifest["outputs"]["probabilities"] == {
+        "shape": [None, CLASSES],
+        "dtype": "float32",
+    }
+    assert manifest["outputs"]["class"]["shape"] == [None]
+
+
+def test_engine_from_artifact_roundtrip(serve_fn, tmp_path, rng):
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    directory = str(tmp_path / "artifact")
+    serving_lib.export_serving_artifact(serve_fn, (1, FEATURES), directory)
+    eng = InferenceEngine.from_artifact(directory, buckets=(1, 4))
+    x = rng.normal(0, 1, (3, FEATURES)).astype(np.float32)
+    np.testing.assert_allclose(
+        eng.infer(x)["probabilities"],
+        _reference(serve_fn, x)["probabilities"],
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+def test_load_takes_input_dtype_from_manifest(serve_fn, tmp_path):
+    """An artifact exported for a non-float32 input signature must be fed
+    that dtype on reload — previously load hardcoded float32."""
+    import jax
+
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    directory = str(tmp_path / "artifact")
+    serving_lib.export_serving_artifact(
+        serve_fn, (1, FEATURES), directory, input_dtype="bfloat16"
+    )
+    manifest = serving_lib.read_manifest(directory)
+    assert manifest["input_dtype"] == "bfloat16"
+    loaded = serving_lib.load_serving_artifact(directory)
+    out = loaded(np.zeros((2, FEATURES), np.float32))  # cast happens inside
+    assert jax.block_until_ready(out)["probabilities"].shape == (2, CLASSES)
+
+
+# -- recompile discipline ----------------------------------------------------
+
+
+def test_zero_post_warmup_recompiles(tmp_path, rng):
+    """After warmup compiles every bucket, NO request batch size may trigger
+    a compile — asserted via the obs.recompile detector, which must also have
+    actually seen the warmup compiles (guards against a dead listener)."""
+    import jax
+
+    # a FRESH jit closure: the shared fixture's buckets are already compiled
+    # by earlier tests, which would leave the detector nothing to see
+    w = jax.random.normal(jax.random.PRNGKey(1), (FEATURES, CLASSES))
+    fn = jax.jit(lambda x: {"probabilities": jax.nn.softmax(x @ w, axis=-1)})
+    tel = Telemetry(str(tmp_path), run_info={"kind": "serve"})
+    try:
+        eng = InferenceEngine(
+            fn, (FEATURES,), buckets=(1, 4, 8), registry=tel.registry
+        )
+        eng.warmup(telemetry=tel)
+        assert eng.warmed
+        assert tel.detector.compile_count >= 1, "detector saw no compiles at all"
+        assert tel.detector.post_warmup_count == 0
+        for n in range(1, 9):
+            eng.infer(rng.normal(0, 1, (n, FEATURES)).astype(np.float32))
+        assert tel.detector.post_warmup_count == 0
+    finally:
+        tel.close()
+
+
+# -- batcher -----------------------------------------------------------------
+
+
+def test_batcher_coalesces_and_preserves_results(engine, serve_fn, rng):
+    batcher = MicroBatcher(engine, max_wait_ms=25, max_queue=64)
+    xs = [rng.normal(0, 1, (2, FEATURES)).astype(np.float32) for _ in range(4)]
+    reqs = [batcher.submit(x) for x in xs]
+    for x, req in zip(xs, reqs):
+        out = req.result(timeout=10)
+        np.testing.assert_allclose(
+            out["probabilities"],
+            _reference(serve_fn, x)["probabilities"],
+            rtol=1e-6,
+        )
+    # 4 requests x 2 examples coalesced into fewer forwards than requests
+    assert engine.registry.counter("serve/batches").value < 4
+    assert engine.registry.counter("serve/completed").value == 4
+    batcher.close()
+
+
+def test_batcher_bare_example_promoted_to_batch(engine):
+    batcher = MicroBatcher(engine, max_wait_ms=1)
+    out = batcher.submit(np.zeros(FEATURES, np.float32)).result(timeout=10)
+    assert out["probabilities"].shape == (1, CLASSES)
+    batcher.close()
+
+
+def _stalled_batcher(max_queue, release):
+    """Batcher whose engine blocks until ``release`` is set — the queue fills
+    deterministically behind the stalled worker."""
+
+    def stalled(x):
+        release.wait(10)
+        return {"y": np.asarray(x)}
+
+    eng = InferenceEngine(stalled, (FEATURES,), buckets=(1,))
+    return MicroBatcher(eng, max_queue=max_queue, max_wait_ms=0.0), eng
+
+
+def test_batcher_full_queue_rejects_structurally():
+    release = threading.Event()
+    batcher, eng = _stalled_batcher(3, release)
+    x = np.zeros((1, FEATURES), np.float32)
+    accepted = []
+    with pytest.raises(QueueFullError):
+        # queue(3) + at most 1 in flight: the 5th submit MUST reject
+        for _ in range(5):
+            accepted.append(batcher.submit(x))
+    assert eng.registry.counter("serve/rejected_queue_full").value == 1
+    release.set()
+    for req in accepted:  # everything accepted still completes — no loss
+        assert req.result(timeout=10)["y"].shape == (1, FEATURES)
+    batcher.close()
+
+
+def test_batcher_deadline_expires_in_queue():
+    release = threading.Event()
+    batcher, eng = _stalled_batcher(8, release)
+    x = np.zeros((1, FEATURES), np.float32)
+    blocker = batcher.submit(x)  # occupies the worker
+    time.sleep(0.05)  # let the worker take it
+    doomed = batcher.submit(x, deadline_ms=1)
+    ok = batcher.submit(x)  # no deadline — must still be served
+    time.sleep(0.05)  # deadline passes while the worker is stalled
+    release.set()
+    with pytest.raises(DeadlineExceededError):
+        doomed.result(timeout=10)
+    assert ok.result(timeout=10)["y"].shape == (1, FEATURES)
+    assert blocker.result(timeout=10)["y"].shape == (1, FEATURES)
+    assert eng.registry.counter("serve/deadline_exceeded").value == 1
+    batcher.close()
+
+
+def test_batcher_too_large_and_closed_rejections(engine):
+    batcher = MicroBatcher(engine, max_wait_ms=1)
+    with pytest.raises(RequestTooLargeError):
+        batcher.submit(np.zeros((engine.max_batch_size + 1, FEATURES), np.float32))
+    batcher.close()
+    with pytest.raises(ServerClosedError):
+        batcher.submit(np.zeros((1, FEATURES), np.float32))
+
+
+def test_batcher_engine_error_fails_requests_not_worker(engine):
+    batcher = MicroBatcher(engine, max_wait_ms=1)
+    bad = batcher.submit(np.zeros((2, FEATURES), np.float32))
+    bad.x = np.zeros((2, FEATURES + 3), np.float32)  # corrupt post-validation
+    with pytest.raises(ValueError):
+        bad.result(timeout=10)
+    # the worker survived: subsequent traffic still flows
+    ok = batcher.submit(np.zeros((1, FEATURES), np.float32))
+    assert ok.result(timeout=10)["probabilities"].shape == (1, CLASSES)
+    batcher.close()
+
+
+# -- HTTP end-to-end ---------------------------------------------------------
+
+
+def _post(url, payload, timeout=10):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def test_http_server_end_to_end(serve_fn, tmp_path, rng):
+    """Localhost smoke over the full stack: predict round-trip, health,
+    metrics, structured 4xx errors, graceful drain, ledger + report."""
+    from tensorflowdistributedlearning_tpu.obs.report import report_workdir
+
+    workdir = str(tmp_path / "serve_run")
+    tel = Telemetry(workdir, run_info={"kind": "serve"})
+    engine = InferenceEngine(
+        serve_fn, (FEATURES,), buckets=(1, 4), registry=tel.registry
+    )
+    engine.warmup(telemetry=tel)
+    batcher = MicroBatcher(engine, max_wait_ms=2, max_queue=16)
+    server = ServingServer(
+        engine, batcher, port=0, telemetry=tel, window_secs=0
+    ).start()
+    try:
+        x = rng.normal(0, 1, (3, FEATURES)).astype(np.float32)
+        status, body = _post(server.url + "/v1/predict", {"instances": x.tolist()})
+        assert status == 200 and body["n"] == 3
+        np.testing.assert_allclose(
+            np.asarray(body["predictions"]["probabilities"], np.float32),
+            _reference(serve_fn, x)["probabilities"],
+            rtol=1e-4,
+            atol=1e-6,
+        )
+
+        health = _get(server.url + "/healthz")
+        assert health["ok"] and not health["draining"]
+        metrics = _get(server.url + "/metrics")
+        assert metrics["buckets"] == {"1": 0, "4": 1}
+        assert metrics["registry"]["counters"]["serve/completed"] == 1
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(server.url + "/v1/predict", {"wrong_key": []})
+        assert err.value.code == 400
+        assert json.loads(err.value.read())["error"]["code"] == "bad_request"
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(
+                server.url + "/v1/predict",
+                {"instances": np.zeros((5, FEATURES)).tolist()},  # > bucket 4
+            )
+        assert err.value.code == 413
+    finally:
+        server.shutdown()
+
+    # drained shutdown wrote the final window + run_end into the ledger,
+    # and the goodput report renders a serving section from it
+    from tensorflowdistributedlearning_tpu.obs import read_ledger
+
+    events = read_ledger(workdir)
+    kinds = [e["event"] for e in events]
+    assert "serve_window" in kinds and "run_end" in kinds
+    window = [e for e in events if e["event"] == "serve_window"][-1]
+    assert window["completed"] == 1
+    assert window["recompiles_post_warmup"] == 0
+    rendered = report_workdir(workdir)
+    assert "serving" in rendered
+    assert "post-warmup recompiles on the request path: none" in rendered
+
+
+def test_http_rejects_while_draining(serve_fn):
+    engine = InferenceEngine(serve_fn, (FEATURES,), buckets=(1,))
+    batcher = MicroBatcher(engine, max_wait_ms=1)
+    server = ServingServer(engine, batcher, port=0, window_secs=0).start()
+    url = server.url
+    server.shutdown()
+    # listener is closed after drain: connection refused, not a hang
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        _post(url + "/v1/predict", {"instances": [[0.0] * FEATURES]}, timeout=3)
+
+
+# -- CLI surface -------------------------------------------------------------
+
+
+def test_cli_serve_parser_defaults():
+    from tensorflowdistributedlearning_tpu.cli import build_parser
+
+    args = build_parser().parse_args(["serve", "--artifact-dir", "d"])
+    assert args.port == 8000
+    assert tuple(args.buckets) == (1, 4, 16, 64)
+    assert args.queue_size == 256
+    args = build_parser().parse_args(
+        ["predict", "--test-dir", "t", "--model-dir", "m", "--artifact-dir", "a"]
+    )
+    assert args.artifact_dir == "a"
+
+
+def test_cli_predict_from_artifact(serve_fn, tmp_path, capsys):
+    """predict --artifact-dir: checkpoint-free inference through the engine
+    (segmentation-shaped artifact so the Laplacian-channel contract runs)."""
+    from tensorflowdistributedlearning_tpu.cli import main
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+    from tests.conftest import make_salt_dataset
+
+    _, test_dir, _ = make_salt_dataset(tmp_path, n_images=1, n_test=3, shape=(8, 8))
+
+    def seg_fn(images):  # [B, 8, 8, 2] -> probabilities/mask, serving_fn-shaped
+        import jax
+        import jax.numpy as jnp
+
+        probs = jax.nn.sigmoid(images.mean(axis=-1, keepdims=True))
+        return {"probabilities": probs, "mask": (probs > 0.5).astype(jnp.float32)}
+
+    artifact_dir = str(tmp_path / "artifact")
+    serving_lib.export_serving_artifact(seg_fn, (1, 8, 8, 2), artifact_dir)
+    out_npz = str(tmp_path / "pred.npz")
+    rc = main(
+        [
+            "predict",
+            "--test-dir", test_dir,
+            "--model-dir", "unused",
+            "--artifact-dir", artifact_dir,
+            "--output", out_npz,
+        ]
+    )
+    assert rc == 0
+    written = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert written["n"] == 3
+    loaded = np.load(out_npz, allow_pickle=True)
+    assert loaded["probabilities"].shape == (3, 8, 8, 1)
+    assert loaded["mask"].shape == (3, 8, 8, 1)

@@ -1,0 +1,363 @@
+"""Closed-loop load generator for the serve/ stack: batched vs per-request.
+
+Builds a small synthetic params-baked model (pure jax, no checkpoint), then
+drives it with N closed-loop clients (each thread issues its next request the
+moment the previous one answers — the standard closed-loop load model) in up
+to three configurations:
+
+- ``per_request``: the same serving pipeline (bounded queue, single dispatch
+  worker, futures) with coalescing OFF — every request is its own batch-1
+  forward, serialized at the device exactly like a no-batching server in
+  front of one accelerator;
+- ``batched``:     identical pipeline with the bucket-ladder coalescing ON —
+  the only variable is server-side batching;
+- ``http``:        the full stack — ThreadingHTTPServer, JSON wire format,
+  batcher, engine (enabled with ``--http``).
+
+Also probes the backpressure contract (a full bounded queue must answer with
+a structured QueueFullError, not queue unboundedly) and — when ``--ledger-dir``
+is given — runs under a Telemetry recompile detector marked warm after bucket
+warmup, so the record carries the post-warmup recompile count (must be 0: the
+bucket ladder exists so steady-state serving never recompiles).
+
+Writes a JSON record (default BENCH_SERVE.json). ``--check`` exits non-zero
+unless batched/per_request speedup >= --min-speedup, recompiles == 0, and the
+backpressure probe rejected structurally — the CI serve-smoke gate
+(tools/run_suite.py --serve-smoke).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+FEATURES = 128
+HIDDEN = 256
+CLASSES = 16
+
+
+def make_synthetic_model():
+    """Params-baked jitted ``x [B, FEATURES] -> {probabilities, class}`` —
+    shaped like the trainers' serving_fn closures, sized so one forward is
+    dispatch-overhead-dominated at batch 1 (the regime batching exists for)."""
+    import jax
+    import jax.numpy as jnp
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    w1 = jax.random.normal(k1, (FEATURES, HIDDEN), jnp.float32) * 0.05
+    w2 = jax.random.normal(k2, (HIDDEN, CLASSES), jnp.float32) * 0.05
+
+    @jax.jit
+    def serve(x):
+        h = jnp.maximum(x @ w1, 0.0)
+        logits = h @ w2
+        return {
+            "probabilities": jax.nn.softmax(logits, axis=-1),
+            "class": jnp.argmax(logits, axis=-1),
+        }
+
+    return serve
+
+
+def closed_loop(issue, concurrency: int, duration_s: float) -> dict:
+    """Run ``concurrency`` closed-loop clients for ``duration_s``; returns
+    completed-request throughput and client-observed latency percentiles."""
+    stop = time.monotonic() + duration_s
+    counts = [0] * concurrency
+    latencies: list = [[] for _ in range(concurrency)]
+    errors = [0] * concurrency
+    barrier = threading.Barrier(concurrency + 1)
+    rng = np.random.default_rng(7)
+    # one example per client, pre-generated off the clock
+    examples = rng.normal(0, 1, (concurrency, FEATURES)).astype(np.float32)
+
+    def client(i: int):
+        x = examples[i : i + 1]
+        barrier.wait()
+        while time.monotonic() < stop:
+            t0 = time.perf_counter()
+            try:
+                issue(x)
+            except Exception:  # noqa: BLE001 — count, keep looping
+                errors[i] += 1
+                continue
+            latencies[i].append(time.perf_counter() - t0)
+            counts[i] += 1
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(concurrency)
+    ]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t_start = time.monotonic()
+    for t in threads:
+        t.join(duration_s + 30)
+    elapsed = time.monotonic() - t_start
+    lat = np.asarray([s for per in latencies for s in per], np.float64)
+    total = int(sum(counts))
+    out = {
+        "requests": total,
+        "errors": int(sum(errors)),
+        "elapsed_s": round(elapsed, 3),
+        "requests_per_sec": round(total / elapsed, 1) if elapsed else 0.0,
+    }
+    if len(lat):
+        out["latency_ms"] = {
+            "mean": round(float(lat.mean()) * 1000, 3),
+            "p50": round(float(np.percentile(lat, 50)) * 1000, 3),
+            "p99": round(float(np.percentile(lat, 99)) * 1000, 3),
+        }
+    return out
+
+
+def best_of(issue, concurrency: int, duration_s: float, trials: int) -> dict:
+    """Best-of-N closed-loop runs per mode: this box shows multi-second
+    noisy-neighbor windows that halve throughput for every mode at once; the
+    max is the standard capability estimator under that noise. All trial
+    rates are kept in the record so the spread is visible."""
+    runs = [closed_loop(issue, concurrency, duration_s) for _ in range(trials)]
+    best = max(runs, key=lambda r: r["requests_per_sec"])
+    best["trial_rps"] = [r["requests_per_sec"] for r in runs]
+    return best
+
+
+def probe_backpressure() -> dict:
+    """A full bounded queue must reject at submit time with QueueFullError —
+    the structured signal — while everything already accepted completes."""
+    from tensorflowdistributedlearning_tpu.serve import (
+        InferenceEngine,
+        MicroBatcher,
+        QueueFullError,
+    )
+
+    release = threading.Event()
+
+    def stalled_fn(x):  # holds the worker so the queue genuinely fills
+        release.wait(10)
+        return {"y": np.asarray(x)}
+
+    engine = InferenceEngine(stalled_fn, (4,), buckets=(1,))
+    batcher = MicroBatcher(engine, max_queue=4, max_wait_ms=0.0)
+    accepted = []
+    rejected = False
+    x = np.zeros((1, 4), np.float32)
+    try:
+        # max_queue + worker-in-flight + 1 guarantees one submit sees a full
+        # queue regardless of how fast the worker drains the first request
+        for _ in range(batcher.max_queue + 2):
+            accepted.append(batcher.submit(x))
+    except QueueFullError:
+        rejected = True
+    release.set()
+    completed = sum(1 for r in accepted if r.result(10) is not None)
+    batcher.close()
+    return {
+        "queue_size": batcher.max_queue,
+        "accepted": len(accepted),
+        "completed": completed,
+        "structured_reject": rejected,
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--concurrency", type=int, default=32)
+    parser.add_argument("--duration", type=float, default=2.0,
+                        help="seconds per trial")
+    parser.add_argument("--trials", type=int, default=2,
+                        help="closed-loop trials per mode; the best is "
+                        "reported (shared-host noise resilience)")
+    parser.add_argument("--buckets", type=int, nargs="+",
+                        default=(1, 4, 16, 64))
+    parser.add_argument("--max-wait-ms", type=float, default=1.0)
+    parser.add_argument("--http", action="store_true",
+                        help="also measure the full HTTP stack (localhost)")
+    parser.add_argument("--json-out", default=os.path.join(REPO, "BENCH_SERVE.json"))
+    parser.add_argument("--ledger-dir", default=None,
+                        help="write a telemetry ledger (enables the "
+                        "recompile-detector assertion)")
+    parser.add_argument("--check", action="store_true",
+                        help="exit non-zero unless speedup >= --min-speedup, "
+                        "zero post-warmup recompiles, and backpressure "
+                        "rejected structurally")
+    parser.add_argument("--min-speedup", type=float, default=3.0)
+    args = parser.parse_args()
+
+    from tensorflowdistributedlearning_tpu.obs import Telemetry
+    from tensorflowdistributedlearning_tpu.serve import (
+        InferenceEngine,
+        MicroBatcher,
+        ServingServer,
+    )
+
+    telemetry = Telemetry(
+        args.ledger_dir,
+        enabled=args.ledger_dir is not None,
+        run_info={
+            "kind": "bench_serve",
+            "concurrency": args.concurrency,
+            "duration_s": args.duration,
+            "buckets": list(args.buckets),
+        },
+    )
+    # the zero-recompile gate must hold with or without a ledger: fall back
+    # to a standalone detector when telemetry is disabled
+    standalone_detector = None
+    if telemetry.detector is None:
+        from tensorflowdistributedlearning_tpu.obs import RecompileDetector
+
+        standalone_detector = RecompileDetector().attach()
+    detector = telemetry.detector or standalone_detector
+
+    serve_fn = make_synthetic_model()
+    record: dict = {
+        "model": {"features": FEATURES, "hidden": HIDDEN, "classes": CLASSES},
+        "concurrency": args.concurrency,
+        "duration_s": args.duration,
+        "buckets": list(args.buckets),
+        "max_wait_ms": args.max_wait_ms,
+    }
+
+    # one engine (with its OWN registry) per mode so counters and per-bucket
+    # hits stay attributable to a mode — the ledger is the only shared sink;
+    # all warm BEFORE the detector goes warm, after that any compile is a bug
+    engine_pr = InferenceEngine(serve_fn, (FEATURES,), buckets=(1,))
+    engine_b = InferenceEngine(serve_fn, (FEATURES,), buckets=args.buckets)
+    engine_pr.warmup()
+    warmup_s = engine_b.warmup(telemetry=telemetry)
+    record["warmup_s"] = {str(b): s for b, s in warmup_s.items()}
+    if standalone_detector is not None:
+        standalone_detector.mark_warm()
+
+    print(f"per-request baseline: {args.concurrency} clients, "
+          f"{args.duration}s ...", flush=True)
+    batcher_pr = MicroBatcher(engine_pr, max_wait_ms=0.0,
+                              max_queue=max(256, 4 * args.concurrency))
+    record["per_request"] = best_of(
+        lambda x: batcher_pr.submit(x).result(30),
+        args.concurrency, args.duration, args.trials,
+    )
+    batcher_pr.close()
+    telemetry.event("bench_mode", mode="per_request", **record["per_request"])
+
+    print("batched (in-process micro-batcher) ...", flush=True)
+    batcher = MicroBatcher(engine_b, max_wait_ms=args.max_wait_ms,
+                           max_queue=max(256, 4 * args.concurrency))
+    record["batched"] = best_of(
+        lambda x: batcher.submit(x).result(30),
+        args.concurrency, args.duration, args.trials,
+    )
+    record["batched"]["bucket_hits"] = {
+        str(b): n for b, n in engine_b.bucket_hits.items()
+    }
+    telemetry.event("bench_mode", mode="batched", **record["batched"])
+
+    if args.http:
+        print("http (full stack, localhost) ...", flush=True)
+        import http.client
+        import socket
+
+        engine_h = InferenceEngine(serve_fn, (FEATURES,), buckets=args.buckets)
+        engine_h.warmup()
+        batcher_h = MicroBatcher(engine_h, max_wait_ms=args.max_wait_ms,
+                                 max_queue=max(256, 4 * args.concurrency))
+        server = ServingServer(engine_h, batcher_h, port=0,
+                               telemetry=telemetry, window_secs=0).start()
+        local = threading.local()  # one keep-alive connection per client
+
+        def issue_http(x):
+            conn = getattr(local, "conn", None)
+            if conn is None:
+                conn = local.conn = http.client.HTTPConnection(
+                    server.host, server.port, timeout=30
+                )
+                conn.connect()
+                # headers and body go out as separate writes; without
+                # NODELAY the body waits out a delayed ACK (~40-200ms)
+                conn.sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+            body = json.dumps({"instances": x.tolist()})
+            try:
+                conn.request("POST", "/v1/predict", body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                payload = json.loads(resp.read())
+            except (http.client.HTTPException, OSError):
+                local.conn = None  # reconnect next iteration
+                raise
+            if resp.status != 200:
+                raise RuntimeError(f"HTTP {resp.status}: {payload}")
+
+        record["http"] = best_of(
+            issue_http, args.concurrency, args.duration, args.trials
+        )
+        telemetry.event("bench_mode", mode="http", **record["http"])
+        server.shutdown()
+
+    record["backpressure"] = probe_backpressure()
+
+    pr_rps = record["per_request"]["requests_per_sec"]
+    b_rps = record["batched"]["requests_per_sec"]
+    record["speedup_batched_vs_per_request"] = (
+        round(b_rps / pr_rps, 2) if pr_rps else None
+    )
+    record["post_warmup_recompiles"] = detector.post_warmup_count
+    if standalone_detector is not None:
+        standalone_detector.detach()
+    telemetry.event("bench_serve", **{
+        k: v for k, v in record.items() if k != "model"
+    })
+    telemetry.close(
+        speedup=record["speedup_batched_vs_per_request"],
+        recompiles_post_warmup=record.get("post_warmup_recompiles"),
+    )
+
+    with open(args.json_out, "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps({
+        "per_request_rps": pr_rps,
+        "batched_rps": b_rps,
+        "http_rps": record.get("http", {}).get("requests_per_sec"),
+        "speedup": record["speedup_batched_vs_per_request"],
+        "post_warmup_recompiles": record.get("post_warmup_recompiles"),
+        "backpressure_structured_reject":
+            record["backpressure"]["structured_reject"],
+        "written": args.json_out,
+    }))
+
+    if args.check:
+        problems = []
+        speedup = record["speedup_batched_vs_per_request"] or 0
+        if speedup < args.min_speedup:
+            problems.append(
+                f"speedup {speedup} < required {args.min_speedup}"
+            )
+        if record.get("post_warmup_recompiles"):
+            problems.append(
+                f"{record['post_warmup_recompiles']} post-warmup recompile(s)"
+            )
+        if not record["backpressure"]["structured_reject"]:
+            problems.append("full queue did not reject structurally")
+        if record["backpressure"]["completed"] != record["backpressure"]["accepted"]:
+            problems.append("accepted requests lost during backpressure probe")
+        if problems:
+            print("CHECK FAILED: " + "; ".join(problems), file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

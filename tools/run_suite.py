@@ -88,6 +88,12 @@ def main() -> int:
                         help="seconds per pytest child before it is killed "
                         "and recorded as a timeout (a hung group must not "
                         "wedge the runner)")
+    parser.add_argument("--serve-smoke", action="store_true",
+                        help="after the test groups, run the closed-loop "
+                        "load generator (tools/bench_serve.py --http) "
+                        "against a synthetic-model server: checks the "
+                        "batched-vs-per-request speedup, zero post-warmup "
+                        "recompiles, and structured queue-full rejection")
     args = parser.parse_args()
 
     files = sorted(glob.glob(os.path.join(REPO, "tests", "test_*.py")))
@@ -183,6 +189,37 @@ def main() -> int:
                 rc=child.returncode,
                 summary=summary.group(1) if summary else tail,
             )
+    if args.serve_smoke:
+        print("=== serve smoke: load generator vs synthetic-model server",
+              flush=True)
+        t0 = time.time()
+        smoke_cmd = [
+            sys.executable, os.path.join(REPO, "tools", "bench_serve.py"),
+            "--http", "--concurrency", "16", "--duration", "1.5",
+            "--check", "--min-speedup", "1.5",
+            "--json-out", os.path.join(REPO, "SERVE_SMOKE.json"),
+        ]
+        if args.ledger_dir:
+            smoke_cmd += ["--ledger-dir", args.ledger_dir]
+        try:
+            smoke = subprocess.run(
+                smoke_cmd, cwd=REPO, env=env, capture_output=True, text=True,
+                timeout=300,
+            )
+            rc, tail = smoke.returncode, (smoke.stdout or "").strip().splitlines()
+            summary = tail[-1] if tail else ""
+            if rc != 0:
+                print((smoke.stdout or "")[-2000:], flush=True)
+                print((smoke.stderr or "")[-1000:], file=sys.stderr, flush=True)
+        except subprocess.TimeoutExpired:
+            rc, summary = -1, "serve smoke timed out"
+        secs = round(time.time() - t0, 1)
+        print(f"    rc={rc} {secs}s {summary}", flush=True)
+        record["serve_smoke"] = {"rc": rc, "secs": secs, "summary": summary}
+        record["ok"] = record["ok"] and rc == 0
+        if ledger is not None:
+            ledger.event("serve_smoke", rc=rc, secs=secs, summary=summary)
+
     record["total_secs"] = round(time.time() - t_all, 1)
     if ledger is not None:
         ledger.event(
