@@ -47,6 +47,32 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    "it in shard_map's hybrid auto-model mode)")
 
 
+def _add_resilience(p: argparse.ArgumentParser) -> None:
+    """Flags shared by the training commands (train/fit) — resilience/."""
+    from tensorflowdistributedlearning_tpu.resilience.preempt import (
+        EXIT_PREEMPTED,
+    )
+
+    p.add_argument("--inject-fault", default=None, metavar="SPEC",
+                   help="deterministic fault injection for drills and tests: "
+                   "KIND@AT[xCOUNT] with KIND in raise|sigterm|io-data|"
+                   "io-read|io-ckpt (e.g. 'sigterm@12' preempts after step "
+                   "12; 'raise@5-20' crashes at a seeded-random step; "
+                   "'io-ckpt@1' makes the first checkpoint write fail "
+                   "transiently)")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="run under the restart supervisor: relaunch this "
+                   "command after crashes/preemptions (exponential backoff + "
+                   "jitter) up to this many times, aborting early when no "
+                   "step progress is made between restarts; 0 = unsupervised")
+    p.add_argument("--preempt-notice-file", default=None, metavar="PATH",
+                   help="also treat the appearance of this file as a "
+                   "preemption notice (for environments that cannot deliver "
+                   "SIGTERM to the training process); same semantics as the "
+                   "signal: final checkpoint at the next step boundary, "
+                   f"exit code {EXIT_PREEMPTED}")
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="tensorflowdistributedlearning_tpu",
@@ -67,6 +93,7 @@ def build_parser() -> argparse.ArgumentParser:
                          help="after training, export the best fold's "
                          "standalone StableHLO serving artifact next to its "
                          "checkpoint ({fold_dir}/export/serving)")
+    _add_resilience(p_train)
 
     p_pred = sub.add_parser("predict", help="fold x TTA ensemble prediction")
     _add_common(p_pred)
@@ -150,6 +177,7 @@ def build_parser() -> argparse.ArgumentParser:
                        "(crop drops the mirror — digits/text; none streams "
                        "batches untouched; mixup/cutmix add image/label "
                        "mixing on top of flip_crop)")
+    _add_resilience(p_fit)
 
     p_serve = sub.add_parser(
         "serve",
@@ -517,8 +545,8 @@ def cmd_serve(args) -> int:
         ),
         flush=True,
     )
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        signal.signal(sig, lambda *_: server.shutdown())
+    # resilience contract for the serving tier: SIGTERM = graceful drain
+    server.install_signal_handlers((signal.SIGINT, signal.SIGTERM))
     try:
         server.wait()
     finally:
@@ -716,12 +744,100 @@ def cmd_doctor(args) -> int:
     return 0 if report["ok"] else 1
 
 
+def _strip_supervisor_flags(argv: List[str]) -> List[str]:
+    """The child command the supervisor relaunches: this invocation minus
+    ``--max-restarts`` (both ``--flag N`` and ``--flag=N`` forms) — every
+    other flag, fault injection included, replays verbatim."""
+    out: List[str] = []
+    skip = False
+    for token in argv:
+        if skip:
+            skip = False
+            continue
+        if token == "--max-restarts":
+            skip = True
+            continue
+        if token.startswith("--max-restarts="):
+            continue
+        out.append(token)
+    return out
+
+
+def _run_supervised(args, argv: List[str]) -> int:
+    """``train/fit --max-restarts N``: re-exec this command under the restart
+    supervisor (resilience/supervisor.py), rooted at the model dir's run
+    ledger for progress tracking and restart accounting."""
+    import os
+
+    from tensorflowdistributedlearning_tpu.resilience.supervisor import Supervisor
+
+    # the env marker (checked in main()) makes supervisor recursion
+    # structurally impossible even if a --max-restarts spelling survives the
+    # argv strip (argparse accepts prefix abbreviations like --max-rest)
+    child_env = dict(os.environ, TFDL_SUPERVISED_CHILD="1")
+    result = Supervisor(
+        [sys.executable, "-m", "tensorflowdistributedlearning_tpu",
+         *_strip_supervisor_flags(argv)],
+        workdir=args.model_dir,
+        max_restarts=args.max_restarts,
+        seed=getattr(args, "seed", 0),
+        env=child_env,
+    ).run()
+    print(
+        json.dumps(
+            {
+                "supervised": True,
+                "ok": result.ok,
+                "restarts": result.restarts,
+                "aborted": result.aborted,
+                "final_step": result.final_step,
+                "downtime_s": result.downtime_s,
+            }
+        ),
+        file=sys.stderr,
+        flush=True,
+    )
+    if result.ok:
+        return 0
+    rc = result.exit_code
+    # a child killed by signal N reports rc=-N; surface the conventional
+    # 128+N instead of a negative value the shell would fold mod 256
+    return 128 - rc if rc < 0 else (rc or 1)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     logging.basicConfig(level=logging.INFO)
     from tensorflowdistributedlearning_tpu.utils.devices import apply_platform_env
 
     apply_platform_env()
-    args = build_parser().parse_args(argv)
+    raw_argv = list(argv) if argv is not None else sys.argv[1:]
+    args = build_parser().parse_args(raw_argv)
+    if args.command in ("train", "fit"):
+        import os
+
+        if getattr(args, "max_restarts", 0) > 0 and not os.environ.get(
+            "TFDL_SUPERVISED_CHILD"
+        ):
+            return _run_supervised(args, raw_argv)
+        from tensorflowdistributedlearning_tpu.resilience import faults, preempt
+
+        if getattr(args, "inject_fault", None):
+            faults.install(args.inject_fault, seed=getattr(args, "seed", 0))
+        # first SIGTERM/SIGINT: checkpoint at the next step boundary and exit
+        # EXIT_PREEMPTED; a second signal kills immediately
+        preempt.install(notice_file=getattr(args, "preempt_notice_file", None))
+        try:
+            return {"train": cmd_train, "fit": cmd_fit}[args.command](args)
+        except preempt.PreemptedError as e:
+            print(
+                json.dumps({"preempted": True, "step": e.step}), flush=True
+            )
+            return preempt.EXIT_PREEMPTED
+        finally:
+            # embedding callers (tests, notebooks) must not inherit the
+            # process-global handler/injector past the command
+            preempt.uninstall()
+            faults.uninstall()
     return {
         "train": cmd_train,
         "predict": cmd_predict,

@@ -21,9 +21,19 @@ from tensorflowdistributedlearning_tpu.data.pipeline import discover_ids, mask_c
 
 def read_two_column_csv(path: str) -> Dict[str, str]:
     """{first_column: second_column} for a headered CSV (train.csv id,rle_mask /
-    depths.csv id,z)."""
+    depths.csv id,z). The open retries transient I/O failures
+    (resilience/retry.py; injectable ``io-read`` fault site)."""
+    from tensorflowdistributedlearning_tpu.resilience import faults
+    import tensorflowdistributedlearning_tpu.resilience.retry as retry_lib
+
+    def attempt():
+        faults.fire(faults.SITE_IO)
+        return open(path, newline="")
+
     out: Dict[str, str] = {}
-    with open(path, newline="") as f:
+    with retry_lib.call_with_retry(
+        attempt, name="csv_open", exceptions=(OSError,)
+    ) as f:
         reader = csv.reader(f)
         next(reader, None)  # header
         for row in reader:

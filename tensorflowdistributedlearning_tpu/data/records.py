@@ -32,6 +32,22 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from tensorflowdistributedlearning_tpu.native import loader as native_loader
+from tensorflowdistributedlearning_tpu.resilience import faults
+import tensorflowdistributedlearning_tpu.resilience.retry as retry_lib
+
+
+def _open_shard(path: str, mode: str = "rb"):
+    """Shard-file open with transient-I/O retry (resilience/retry.py) — the
+    failure mode network filesystems actually exhibit mid-epoch; the
+    injectable ``io-read`` fault site lives inside the attempt."""
+
+    def attempt():
+        faults.fire(faults.SITE_IO)
+        return open(path, mode)
+
+    return retry_lib.call_with_retry(
+        attempt, name="record_open", exceptions=(OSError,)
+    )
 
 # -- crc32c (Castagnoli), table-driven — mirrors native/records.cc ------------
 
@@ -82,7 +98,7 @@ def write_records(path: str, records: Sequence[bytes]) -> None:
 
 def read_records(path: str, verify: bool = True) -> Iterator[bytes]:
     """Pure-Python shard reader (fallback + oracle for the native one)."""
-    with open(path, "rb") as f:
+    with _open_shard(path) as f:
         while True:
             header = f.read(12)
             if not header:
@@ -271,7 +287,7 @@ def count_records(paths: Sequence[str]) -> int:
     total = 0
     for path in paths:
         size = os.path.getsize(path)
-        with open(path, "rb") as f:
+        with _open_shard(path) as f:
             while True:
                 header = f.read(12)
                 if not header:
@@ -343,7 +359,17 @@ class ClassificationRecords:
         h, w = self.image_shape
         arr_labels = np.asarray(labels, np.int32)
         self._check_labels(arr_labels[:valid_rows])
-        images = native_loader.decode_image_blobs(blobs, (h, w), self.channels)
+
+        def attempt():
+            # decode is re-runnable from the buffered blobs, so a transient
+            # decode-side I/O failure on the Nth batch (the injectable
+            # ``io-data`` site) retries instead of killing the stream
+            faults.fire(faults.SITE_DATA)
+            return native_loader.decode_image_blobs(blobs, (h, w), self.channels)
+
+        images = retry_lib.call_with_retry(
+            attempt, name="record_batch", exceptions=(OSError,)
+        )
         valid = np.zeros(len(blobs), np.float32)
         valid[:valid_rows] = 1.0
         return {

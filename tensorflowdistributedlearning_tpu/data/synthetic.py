@@ -55,17 +55,35 @@ def synthetic_batches(
     batch_size: int,
     seed: int = 0,
     steps: Optional[int] = None,
+    start_index: int = 0,
+    index_keyed: bool = False,
     **kwargs,
 ) -> Iterator[Dict[str, np.ndarray]]:
-    """Infinite (or ``steps``-bounded) stream of synthetic batches."""
+    """Infinite (or ``steps``-bounded) stream of synthetic batches.
+
+    ``index_keyed=True`` makes batch ``i`` a pure function of ``(seed, i)``
+    (fresh ``default_rng((seed, i))`` per batch) and starts at
+    ``start_index`` — the restart-invariant form the resilience contract
+    needs: a run resumed at step k sees bit-for-bit the batches the
+    uninterrupted run saw from step k. The default streaming form (one rng
+    across the stream) is byte-stable with what it always produced, which the
+    determinism goldens pin."""
     if kind not in ("segmentation", "classification"):
         raise ValueError(f"Unknown synthetic data kind {kind!r}")
-    rng = np.random.default_rng(seed)
     make = (
         synthetic_segmentation_batch
         if kind == "segmentation"
         else synthetic_classification_batch
     )
+    if index_keyed:
+        i = start_index
+        while steps is None or i < start_index + steps:
+            yield make(np.random.default_rng((seed, i)), batch_size, **kwargs)
+            i += 1
+        return
+    if start_index:
+        raise ValueError("start_index requires index_keyed=True")
+    rng = np.random.default_rng(seed)
     i = 0
     while steps is None or i < steps:
         yield make(rng, batch_size, **kwargs)

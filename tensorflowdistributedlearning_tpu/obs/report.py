@@ -121,11 +121,83 @@ def _serve_section(windows: List[Dict]) -> Dict:
     return section
 
 
+def _resilience_scope(all_events: List[Dict]) -> List[Dict]:
+    """The event window the resilience section describes: the last SUPERVISED
+    SESSION (from ``supervisor_start``; every relaunch in it writes its own
+    ``supervised``-stamped run header, so restarts by construction straddle
+    run boundaries and a plain last-run scope would lose them) — unless a
+    later STANDALONE run (a run header without the ``supervised`` stamp)
+    started after the session, in which case that run is the story and stale
+    restarts/aborts must not haunt it. Keying the takeover on the header
+    stamp rather than ``supervisor_end`` means even a hard-killed supervisor
+    (no end event ever written) cannot haunt later clean runs."""
+    last_start = None
+    last_header = None
+    for i, e in enumerate(all_events):
+        kind = e.get("event")
+        if kind == "supervisor_start":
+            last_start = i
+        elif kind == "run_header":
+            last_header = i
+    if last_start is None:
+        return all_events[last_header:] if last_header is not None else all_events
+    standalone = [
+        i
+        for i, e in enumerate(all_events[last_start:], last_start)
+        if e.get("event") == "run_header" and not e.get("supervised")
+    ]
+    if standalone:
+        return all_events[standalone[-1]:]
+    return all_events[last_start:]
+
+
+def _resilience_section(all_events: List[Dict]) -> Optional[Dict]:
+    """Aggregate resilience events (resilience/) over ``_resilience_scope``.
+    None when that window shows a clean, never-preempted history."""
+    scope = _resilience_scope(all_events)
+    restarts = [e for e in scope if e.get("event") == "restart"]
+    preempted = [e for e in scope if e.get("event") == "preempted"]
+    resumed = [e for e in scope if e.get("event") == "resumed"]
+    # only per-step events: the fresh-init SUMMARY event shares the kind but
+    # has no step, and counting it would inflate skipped-checkpoint totals
+    corrupt = [
+        e
+        for e in scope
+        if e.get("event") == "checkpoint_corrupt" and "step" in e
+    ]
+    retries = [e for e in scope if e.get("event") == "checkpoint_retry"]
+    aborts = [e for e in scope if e.get("event") == "supervisor_abort"]
+    if not (restarts or preempted or resumed or corrupt or retries or aborts):
+        return None
+    section: Dict = {
+        "restarts": len(restarts),
+        # goodput lost to restarts: child-death -> relaunch wall time
+        # (backoff included), as measured by the supervisor
+        "restart_downtime_s": round(
+            sum(e.get("downtime_s", 0.0) for e in restarts), 3
+        ),
+        "preemptions": len(preempted),
+        "resumes": len(resumed),
+        "corrupt_checkpoints_skipped": len(corrupt),
+        "checkpoint_retries": len(retries),
+    }
+    if restarts:
+        section["last_restart"] = {
+            k: restarts[-1].get(k) for k in ("attempt", "rc", "reason", "step")
+        }
+    if resumed:
+        section["last_resume_step"] = resumed[-1].get("step")
+    if aborts:
+        section["aborted"] = aborts[-1].get("reason")
+    return section
+
+
 def build_report(
     workdir: str, *, trace_dir: Optional[str] = None, top: int = 10
 ) -> Dict:
     """Assemble the goodput report dict for a workdir's last run."""
-    events = last_run_events(read_ledger(workdir))
+    all_events = read_ledger(workdir)
+    events = last_run_events(all_events)
     if not events:
         raise ValueError(f"empty telemetry ledger under {workdir}")
     header = events[0] if events[0].get("event") == "run_header" else None
@@ -201,6 +273,10 @@ def build_report(
         },
         "checkpoints": len(checkpoints),
     }
+
+    resilience = _resilience_section(all_events)
+    if resilience:
+        report["resilience"] = resilience
 
     serve_windows = [e for e in events if e.get("event") == "serve_window"]
     if serve_windows:
@@ -332,6 +408,31 @@ def render_report(report: Dict) -> str:
         + (f", last: {ev['last_metrics']}" if ev["last_metrics"] else "")
     )
     lines.append(f"checkpoints: {report['checkpoints']}")
+    res = report.get("resilience")
+    if res:
+        lines.append(
+            f"\nresilience: {res['restarts']} restart(s), "
+            f"{res['restart_downtime_s']:.2f}s goodput lost to restarts; "
+            f"{res['preemptions']} preemption(s), {res['resumes']} resume(s), "
+            f"{res['corrupt_checkpoints_skipped']} corrupt checkpoint(s) "
+            f"skipped, {res['checkpoint_retries']} checkpoint retry(ies)"
+        )
+        lr = res.get("last_restart")
+        if lr:
+            lines.append(
+                f"  last restart: attempt {lr['attempt']}, rc={lr['rc']} "
+                f"({lr['reason']}) at step {lr['step']}"
+            )
+        if res.get("aborted"):
+            explanation = {
+                "crash-loop": "no step progress between restarts",
+                "restart-budget": "the restart budget was exhausted",
+                "signaled": "the supervisor itself was signaled to stop",
+            }.get(res["aborted"], "see the supervisor_abort ledger event")
+            lines.append(
+                f"  !! supervisor gave this run up: {res['aborted']} — "
+                f"{explanation}"
+            )
     mem = report.get("memory")
     if mem:
         parts = [f"{mem['snapshots']} snapshot(s)"]

@@ -92,8 +92,15 @@ class Telemetry:
 
             is_main = jax.process_index() == 0
         if is_main:
+            import os
+
             self.ledger = RunLedger(workdir)
             header = {"schema_version": 1}
+            if os.environ.get("TFDL_SUPERVISED_CHILD"):
+                # stamped by resilience/supervisor.py on its children: lets
+                # obs/report tell a supervised session's relaunches apart
+                # from later standalone runs in the same workdir
+                header["supervised"] = True
             try:
                 header["fingerprint"] = run_fingerprint()
             except Exception as e:  # noqa: BLE001 — backend probe is best-effort

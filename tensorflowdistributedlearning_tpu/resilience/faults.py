@@ -1,0 +1,179 @@
+"""Deterministic fault injection: the testable half of failure handling.
+
+Production TPU jobs treat preemptions and transient faults as routine
+(arXiv:2204.06514 measures goodput by how fast a run recovers from one), but a
+recovery path that only ever runs during real outages is untested code. This
+module makes faults a *scheduled, seeded input*: a single spec string names
+what fails, where, and when — and the trainers, the data path, and the
+checkpoint layer carry cheap ``fire()`` hooks at the failure-prone sites.
+
+Spec grammar (``--inject-fault``)::
+
+    KIND@AT[xCOUNT]
+
+    raise@12        raise InjectedFault after train step 12
+    sigterm@12      SIGTERM this process after train step 12 (the preemption
+                    drill: resilience/preempt.py turns it into a final
+                    checkpoint + EXIT_PREEMPTED)
+    sigterm@5-20    seeded schedule: the step is drawn uniformly from [5, 20]
+                    by ``install(seed=...)`` — deterministic per seed, the
+                    "kill at a random step" e2e
+    io-data@3       transient IOError on the 3rd emitted record batch
+    io-data@3x2     ... failing the 3rd AND 4th attempt (retry-exhaustion
+                    shapes need consecutive failures)
+    io-read@2       transient IOError on the 2nd tracked file open
+                    (record shards, kaggle CSVs)
+    io-ckpt@1       transient IOError on the 1st checkpoint save attempt
+
+Transient faults raise ``TransientInjectedIOError`` (an ``OSError``), exactly
+what ``resilience.retry`` retries — the clean path through the same code
+observes zero fires and zero retries. Step faults fire at most ``COUNT``
+times per process (default 1), so a supervised restart that resumes *past*
+the step recovers, while one that resumes *before* it re-dies deterministically
+(the crash-loop the supervisor must detect).
+
+Process-global by design: one injector per process, installed by the CLI flag
+or by tests, consulted via module-level ``fire(site, index)`` that is a no-op
+when nothing is installed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import re
+import signal
+import threading
+from typing import Optional
+
+# injection sites the codebase carries hooks at
+SITE_STEP = "step"  # trainers, after each completed train step (index = step)
+SITE_DATA = "data"  # data/records.py, per emitted record batch
+SITE_IO = "io"  # tracked file opens (record shards, kaggle CSVs)
+SITE_CHECKPOINT = "checkpoint"  # CheckpointManager, per save attempt
+
+_KIND_SITE = {
+    "raise": SITE_STEP,
+    "sigterm": SITE_STEP,
+    "io-data": SITE_DATA,
+    "io-read": SITE_IO,
+    "io-ckpt": SITE_CHECKPOINT,
+}
+
+_SPEC_RE = re.compile(
+    r"^(?P<kind>raise|sigterm|io-data|io-read|io-ckpt)"
+    r"@(?P<lo>\d+)(?:-(?P<hi>\d+))?"
+    r"(?:x(?P<count>\d+))?$"
+)
+
+
+class InjectedFault(RuntimeError):
+    """The non-transient injected failure (``raise@STEP``) — nothing retries
+    it; it models a crash the supervisor must restart through."""
+
+
+class TransientInjectedIOError(OSError):
+    """Injected transient I/O failure — the retry decorator's exception set
+    covers it, so the recovery path is the production one."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One resolved fault: ``kind`` (grammar above), ``at`` (step for step
+    kinds; 1-based occurrence for io kinds), ``count`` fires."""
+
+    kind: str
+    at: int
+    count: int = 1
+
+    @property
+    def site(self) -> str:
+        return _KIND_SITE[self.kind]
+
+
+def parse_fault_spec(spec: str, seed: int = 0) -> FaultSpec:
+    """Parse ``KIND@AT[xCOUNT]``; an ``AT`` range ``LO-HI`` resolves to one
+    seeded-uniform draw (inclusive), so "kill at a random step" is
+    reproducible from the seed alone."""
+    m = _SPEC_RE.match(spec.strip())
+    if not m:
+        raise ValueError(
+            f"bad fault spec {spec!r}; expected KIND@AT[xCOUNT] with KIND in "
+            f"{sorted(_KIND_SITE)} (e.g. 'sigterm@12', 'io-data@3x2', "
+            "'raise@5-20' for a seeded random step)"
+        )
+    lo = int(m.group("lo"))
+    hi = int(m.group("hi")) if m.group("hi") else lo
+    if hi < lo:
+        raise ValueError(f"bad fault spec {spec!r}: range {lo}-{hi} is empty")
+    at = lo if hi == lo else random.Random(seed).randint(lo, hi)
+    count = int(m.group("count")) if m.group("count") else 1
+    if count < 1:
+        raise ValueError(f"bad fault spec {spec!r}: count must be >= 1")
+    return FaultSpec(kind=m.group("kind"), at=at, count=count)
+
+
+class FaultInjector:
+    """Executes one ``FaultSpec`` against the ``fire()`` hook stream.
+
+    Occurrence counters are per-site and per-process; a supervised restart
+    starts a fresh process with fresh counters (which is the point: whether
+    the fault re-fires after resume is decided by the *spec*, not by state
+    smuggled across the restart)."""
+
+    def __init__(self, spec: FaultSpec):
+        self.spec = spec
+        self._lock = threading.Lock()
+        self._occurrences = 0
+        self.fired = 0
+
+    def fire(self, site: str, index: Optional[int] = None) -> None:
+        spec = self.spec
+        if site != spec.site:
+            return
+        with self._lock:
+            if site == SITE_STEP:
+                if index != spec.at or self.fired >= spec.count:
+                    return
+            else:
+                # io sites: 1-based occurrence window [at, at + count)
+                self._occurrences += 1
+                if not spec.at <= self._occurrences < spec.at + spec.count:
+                    return
+            self.fired += 1
+        if spec.kind == "raise":
+            raise InjectedFault(f"injected fault: raise at step {spec.at}")
+        if spec.kind == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+            return
+        raise TransientInjectedIOError(
+            f"injected transient I/O error ({spec.kind} occurrence "
+            f"{self._occurrences})"
+        )
+
+
+_INJECTOR: Optional[FaultInjector] = None
+
+
+def install(spec: Optional[str], seed: int = 0) -> Optional[FaultInjector]:
+    """Install the process-global injector from a spec string (``None``/empty
+    uninstalls). Returns the injector."""
+    global _INJECTOR
+    _INJECTOR = FaultInjector(parse_fault_spec(spec, seed)) if spec else None
+    return _INJECTOR
+
+
+def uninstall() -> None:
+    global _INJECTOR
+    _INJECTOR = None
+
+
+def installed() -> Optional[FaultInjector]:
+    return _INJECTOR
+
+
+def fire(site: str, index: Optional[int] = None) -> None:
+    """The hook the instrumented sites call; free when nothing is installed."""
+    if _INJECTOR is not None:
+        _INJECTOR.fire(site, index)

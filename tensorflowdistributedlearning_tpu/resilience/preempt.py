@@ -1,0 +1,169 @@
+"""Preemption handling: turn SIGTERM into a checkpoint, not a lost run.
+
+TPU preemptions arrive as SIGTERM with a short grace window; the Estimator
+stack survived them only through its implicit resume-from-latest (reference:
+model.py:117-121, 164-167) — anything since the last periodic checkpoint was
+retrained. This module closes that gap: a signal handler (plus a file-based
+"preemption notice" for environments that cannot deliver signals into the
+training process) raises a flag the trainers poll at step boundaries; they
+write a final checkpoint at the *current* step, ledger a ``preempted`` event,
+and exit with ``EXIT_PREEMPTED`` so the supervisor (and any job scheduler)
+can tell a routine preemption from a crash.
+
+Semantics:
+
+- first SIGTERM/SIGINT: graceful — finish the in-flight step, checkpoint,
+  flush the ledger, exit ``EXIT_PREEMPTED`` (75, ``EX_TEMPFAIL``: "transient,
+  retry me");
+- second signal while already draining: escalate — the previous disposition
+  is restored and the signal re-raised (a wedged run stays killable);
+- notice file: ``requested()`` also answers True once ``notice_file`` exists
+  (stat throttled to ``NOTICE_CHECK_INTERVAL_S`` so per-step polling is free).
+
+Process-global like the fault injector: the CLI installs it for ``train`` and
+``fit``; library code only ever calls ``requested()``, which is False when
+nothing is installed.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+# EX_TEMPFAIL — distinct from crash exits so supervisors/schedulers can treat
+# preemption as the routine, retryable outcome it is
+EXIT_PREEMPTED = 75
+
+NOTICE_CHECK_INTERVAL_S = 0.2
+
+
+class PreemptedError(RuntimeError):
+    """Raised by the trainers after the preemption checkpoint landed; the CLI
+    converts it to ``EXIT_PREEMPTED``. Carries the step the run stopped at."""
+
+    def __init__(self, step: int):
+        super().__init__(f"preempted at step {step} (checkpoint written)")
+        self.step = step
+
+
+class PreemptionHandler:
+    """One process's preemption state: signal flag + optional notice file."""
+
+    def __init__(self, notice_file: Optional[str] = None):
+        self.notice_file = notice_file
+        self._flag = threading.Event()
+        self._reason: Optional[str] = None
+        self._prev: Dict[int, object] = {}
+        self._last_notice_check = 0.0
+
+    # -- signals -----------------------------------------------------------
+
+    def install_signals(
+        self, signals: Tuple[int, ...] = (signal.SIGTERM, signal.SIGINT)
+    ) -> "PreemptionHandler":
+        for sig in signals:
+            try:
+                self._prev[sig] = signal.signal(sig, self._on_signal)
+            except ValueError:
+                # off the main thread (embedding callers): CPython refuses
+                # signal registration — degrade to notice-file/request()
+                # preemption instead of refusing to train at all
+                logger.warning(
+                    "cannot install a %s handler off the main thread — "
+                    "signal-based preemption disabled (the notice file and "
+                    "request() still work)",
+                    signal.Signals(sig).name,
+                )
+        return self
+
+    def uninstall_signals(self) -> None:
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError):  # non-main thread / exotic prev
+                pass
+        self._prev = {}
+
+    def _on_signal(self, signum, frame) -> None:
+        if self._flag.is_set():
+            # second signal: the graceful path is apparently stuck — restore
+            # the previous disposition and let the signal do its normal thing
+            prev = self._prev.pop(signum, signal.SIG_DFL)
+            signal.signal(signum, prev)
+            os.kill(os.getpid(), signum)
+            return
+        self._reason = f"signal:{signal.Signals(signum).name}"
+        self._flag.set()
+        logger.warning(
+            "%s received — requesting a final checkpoint at the next step "
+            "boundary (second signal kills immediately)",
+            self._reason,
+        )
+
+    # -- state -------------------------------------------------------------
+
+    def request(self, reason: str = "manual") -> None:
+        """Programmatic preemption request (tests, embedding frameworks)."""
+        self._reason = reason
+        self._flag.set()
+
+    def requested(self) -> bool:
+        if self._flag.is_set():
+            return True
+        if self.notice_file:
+            now = time.monotonic()
+            if now - self._last_notice_check >= NOTICE_CHECK_INTERVAL_S:
+                self._last_notice_check = now
+                if os.path.exists(self.notice_file):
+                    self._reason = f"notice-file:{self.notice_file}"
+                    self._flag.set()
+                    return True
+        return False
+
+    def reason(self) -> str:
+        return self._reason or "unknown"
+
+
+_HANDLER: Optional[PreemptionHandler] = None
+
+
+def install(
+    notice_file: Optional[str] = None,
+    signals: Optional[Tuple[int, ...]] = (signal.SIGTERM, signal.SIGINT),
+) -> PreemptionHandler:
+    """Install the process-global handler (replacing any previous one, whose
+    signal dispositions are restored first). ``signals=None`` skips signal
+    registration (notice-file-only mode, usable off the main thread)."""
+    global _HANDLER
+    if _HANDLER is not None:
+        _HANDLER.uninstall_signals()
+    _HANDLER = PreemptionHandler(notice_file=notice_file)
+    if signals:
+        _HANDLER.install_signals(signals)
+    return _HANDLER
+
+
+def uninstall() -> None:
+    global _HANDLER
+    if _HANDLER is not None:
+        _HANDLER.uninstall_signals()
+    _HANDLER = None
+
+
+def handler() -> Optional[PreemptionHandler]:
+    return _HANDLER
+
+
+def requested() -> bool:
+    """The per-step poll the trainers run; False when nothing is installed."""
+    return _HANDLER is not None and _HANDLER.requested()
+
+
+def reason() -> str:
+    return _HANDLER.reason() if _HANDLER is not None else "unknown"

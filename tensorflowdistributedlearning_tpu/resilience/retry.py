@@ -1,0 +1,146 @@
+"""Retry with exponential backoff + jitter for transient-failure-prone paths.
+
+Applied where production runs actually see transient faults: checkpoint
+save/restore (train/checkpoint.py) and record/file reads (data/records.py,
+data/kaggle.py). Every retry is counted in an ``obs.metrics`` registry under
+``retry/{name}``, so the clean path is *observably* clean (zero retries) and a
+flaky filesystem shows up in telemetry instead of only in latency.
+
+Exhaustion raises ``RetryExhaustedError`` — deliberately NOT an ``OSError``
+(an outer retry must not re-retry an inner exhaustion) and NOT a
+``RuntimeError`` (the checkpoint layer reserves that family for structure
+mismatches it must re-raise) — with ``name``/``attempts``/``last`` attached
+and ``__cause__`` chained to the final failure.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from tensorflowdistributedlearning_tpu.obs.metrics import MetricsRegistry
+
+# the default sink for retry counters; tests and /metrics-style snapshots read
+# it via ``retries()`` — per-call ``registry=`` overrides for scoped counting
+RETRY_REGISTRY = MetricsRegistry()
+
+# OSError subclasses that are deterministic, not transient: backing off on a
+# missing file or a permission wall wastes the whole backoff schedule and then
+# re-types the error — callers keep seeing the original FileNotFoundError etc.
+NON_TRANSIENT = (
+    FileNotFoundError,
+    PermissionError,
+    IsADirectoryError,
+    NotADirectoryError,
+)
+
+
+class RetryExhaustedError(Exception):
+    """All attempts failed; ``__cause__`` is the last underlying exception."""
+
+    def __init__(self, name: str, attempts: int, last: BaseException):
+        super().__init__(
+            f"{name}: failed after {attempts} attempt(s); last error: "
+            f"{type(last).__name__}: {last}"
+        )
+        self.name = name
+        self.attempts = attempts
+        self.last = last
+
+
+def retries(name: Optional[str] = None) -> int:
+    """Total retries recorded in the default registry (optionally for one
+    ``retry/{name}`` counter)."""
+    snapshot = RETRY_REGISTRY.snapshot()["counters"]
+    if name is not None:
+        return snapshot.get(f"retry/{name}", 0)
+    return sum(v for k, v in snapshot.items() if k.startswith("retry/"))
+
+
+def reset_registry() -> None:
+    """Fresh default registry (test isolation)."""
+    global RETRY_REGISTRY
+    RETRY_REGISTRY = MetricsRegistry()
+
+
+def backoff_delay(
+    attempt: int,
+    *,
+    base_delay_s: float,
+    max_delay_s: float,
+    jitter_frac: float,
+    rng: random.Random,
+) -> float:
+    """The one exponential-backoff-with-symmetric-jitter formula (shared by
+    the retry loop and the restart supervisor): doubles from ``base_delay_s``,
+    caps at ``max_delay_s``, jitters +-``jitter_frac``."""
+    delay = min(base_delay_s * 2 ** (attempt - 1), max_delay_s)
+    return max(0.0, delay * (1.0 + jitter_frac * (2.0 * rng.random() - 1.0)))
+
+
+def call_with_retry(
+    fn: Callable,
+    *,
+    name: str,
+    exceptions: Tuple[Type[BaseException], ...] = (OSError,),
+    attempts: int = 3,
+    base_delay_s: float = 0.05,
+    max_delay_s: float = 2.0,
+    jitter_frac: float = 0.25,
+    seed: int = 0,
+    registry: Optional[MetricsRegistry] = None,
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    give_up: Tuple[Type[BaseException], ...] = NON_TRANSIENT,
+):
+    """Call ``fn()`` retrying ``exceptions`` up to ``attempts`` total tries.
+
+    Backoff doubles from ``base_delay_s`` (capped at ``max_delay_s``) with
+    seeded symmetric jitter (+-``jitter_frac``) — deterministic for a given
+    seed, so tests can pin schedules. ``on_retry(attempt, error)`` runs before
+    each sleep (the checkpoint layer ledgers through it). ``give_up``
+    exceptions re-raise immediately and unwrapped even when ``exceptions``
+    covers them — deterministic failures (missing file, permissions) must
+    keep their type and cost no backoff."""
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    reg = registry if registry is not None else RETRY_REGISTRY
+    rng = random.Random(seed)
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except exceptions as e:  # noqa: PERF203 — retry loop
+            if isinstance(e, give_up):
+                raise
+            if attempt == attempts:
+                raise RetryExhaustedError(name, attempts, e) from e
+            reg.counter(f"retry/{name}").inc()
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(
+                backoff_delay(
+                    attempt,
+                    base_delay_s=base_delay_s,
+                    max_delay_s=max_delay_s,
+                    jitter_frac=jitter_frac,
+                    rng=rng,
+                )
+            )
+
+
+def retry(**opts):
+    """Decorator form of ``call_with_retry`` (same kwargs; ``name`` defaults
+    to the wrapped function's name)."""
+    import functools
+
+    def deco(fn):
+        opts.setdefault("name", fn.__name__)
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return call_with_retry(lambda: fn(*args, **kwargs), **opts)
+
+        return wrapped
+
+    return deco

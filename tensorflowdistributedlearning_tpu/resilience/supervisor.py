@@ -1,0 +1,341 @@
+"""Restart supervisor: relaunch a training command until it finishes or is
+provably stuck.
+
+The reference stack's recovery story ended at Estimator resume-from-latest —
+*something else* had to notice the dead process and relaunch it. This is that
+something: a small, dependency-free loop that re-runs a fold's command with
+exponential backoff + seeded jitter, a max-restart budget, and crash-loop
+detection (no step progress between consecutive restarts ⇒ abort — a run that
+re-dies at the same step forever must page a human, not burn the budget).
+
+Every restart writes a ``restart`` event into the workdir's run ledger
+(``telemetry.jsonl``) with the observed exit code, the step progress, and the
+downtime — so ``telemetry-report`` can render a goodput-lost-to-restarts line
+next to the usual time split. A final ``supervisor_abort`` event records why a
+run was given up on.
+
+Exit-code contract: ``0`` done; ``preempt.EXIT_PREEMPTED`` (75) is a routine
+preemption (restart after backoff); anything else is a crash (also restarted,
+but the crash-loop detector watches it). Progress is read from the ledger by
+default (the last event carrying a ``step``), so the supervisor needs no
+protocol with its child beyond the workdir.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import random
+import signal as signal_lib
+import subprocess
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from tensorflowdistributedlearning_tpu.resilience.preempt import EXIT_PREEMPTED
+
+logger = logging.getLogger(__name__)
+
+ABORT_CRASH_LOOP = "crash-loop"
+ABORT_RESTART_BUDGET = "restart-budget"
+ABORT_SIGNALED = "signaled"
+
+
+def ledger_progress(workdir: str) -> Optional[int]:
+    """Step progress of the run under ``workdir``: the last ledger event that
+    carries a ``step`` (checkpoints, step windows, preemption). ``None`` when
+    there is no ledger or no stepped event yet — i.e. no observable progress."""
+    import os
+
+    from tensorflowdistributedlearning_tpu.obs.ledger import read_ledger
+
+    try:
+        events = read_ledger(workdir)
+    except (OSError, ValueError):
+        return None
+    for event in reversed(events):
+        step = event.get("step")
+        if isinstance(step, (int, float)):
+            return int(step)
+    return None
+
+
+@dataclasses.dataclass
+class SupervisorResult:
+    ok: bool
+    exit_code: int
+    restarts: int
+    aborted: Optional[str] = None  # ABORT_* or None
+    final_step: Optional[int] = None
+    downtime_s: float = 0.0
+
+
+class Supervisor:
+    """Run ``argv`` under restart supervision rooted at ``workdir``.
+
+    ``launch`` is injectable for tests (a callable returning an exit code);
+    the default runs ``argv`` as a subprocess inheriting stdio. ``sleep`` is
+    injectable so backoff schedules are testable without wall time."""
+
+    def __init__(
+        self,
+        argv: Sequence[str],
+        *,
+        workdir: Optional[str] = None,
+        max_restarts: int = 3,
+        backoff_base_s: float = 1.0,
+        backoff_max_s: float = 30.0,
+        jitter_frac: float = 0.25,
+        seed: int = 0,
+        crash_loop_tolerance: int = 2,
+        progress_fn: Optional[Callable[[], Optional[int]]] = None,
+        env: Optional[Dict[str, str]] = None,
+        launch: Optional[Callable[[], int]] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+        if crash_loop_tolerance < 1:
+            raise ValueError(
+                f"crash_loop_tolerance must be >= 1, got {crash_loop_tolerance}"
+            )
+        self.argv = list(argv)
+        self.workdir = workdir
+        self.max_restarts = max_restarts
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.jitter_frac = jitter_frac
+        self.crash_loop_tolerance = crash_loop_tolerance
+        self._rng = random.Random(seed)
+        self._progress = progress_fn or (
+            (lambda: ledger_progress(self.workdir)) if workdir else (lambda: None)
+        )
+        self._env = env
+        self._launch = launch or self._launch_subprocess
+        self._sleep = sleep
+        self._child: Optional[subprocess.Popen] = None
+        self._stop_signal: Optional[int] = None
+        self.restart_events: List[Dict] = []
+
+    def _launch_subprocess(self) -> int:
+        env = dict(self._env if self._env is not None else os.environ)
+        # children know they are supervised (the CLI uses this to make
+        # supervisor recursion impossible; the run-header stamp lets
+        # obs/report tell a session's children from later standalone runs)
+        env["TFDL_SUPERVISED_CHILD"] = "1"
+        self._child = subprocess.Popen(self.argv, env=env)
+        try:
+            if self._stop_signal is not None:
+                # the signal landed while Popen was setting up (self._child
+                # still None in the handler): forward it now so the fresh
+                # child drains instead of running the whole job unsignaled
+                try:
+                    self._child.send_signal(self._stop_signal)
+                except (ProcessLookupError, OSError):
+                    pass
+            return self._child.wait()
+        finally:
+            self._child = None
+
+    # -- signal passthrough ------------------------------------------------
+    # The supervisor is the pid a scheduler signals; the preemption contract
+    # (first SIGTERM = checkpoint + exit 75) lives in the CHILD. Forward the
+    # signal and stop relaunching — a preempted job must drain, not restart.
+
+    def _on_signal(self, signum, frame) -> None:
+        self._stop_signal = signum
+        child = self._child
+        if child is not None and child.poll() is None:
+            logger.warning(
+                "supervisor got %s — forwarding to child pid %d and stopping "
+                "the restart loop",
+                signal_lib.Signals(signum).name, child.pid,
+            )
+            try:
+                child.send_signal(signum)
+            except (ProcessLookupError, OSError):
+                pass
+
+    def _install_signals(self) -> Dict[int, object]:
+        prev: Dict[int, object] = {}
+        for sig in (signal_lib.SIGTERM, signal_lib.SIGINT):
+            try:
+                prev[sig] = signal_lib.signal(sig, self._on_signal)
+            except ValueError:  # non-main thread: no passthrough, still works
+                pass
+        return prev
+
+    @staticmethod
+    def _restore_signals(prev: Dict[int, object]) -> None:
+        for sig, disposition in prev.items():
+            try:
+                signal_lib.signal(sig, disposition)
+            except (ValueError, TypeError):
+                pass
+
+    def _ledger(self):
+        if self.workdir is None:
+            return None
+        from tensorflowdistributedlearning_tpu.obs.ledger import RunLedger
+
+        # a second appender on the same telemetry.jsonl: the supervisor only
+        # writes between child lifetimes, and readers key on the event kind
+        return RunLedger(self.workdir)
+
+    def _backoff(self, attempt: int) -> float:
+        from tensorflowdistributedlearning_tpu.resilience.retry import (
+            backoff_delay,
+        )
+
+        return backoff_delay(
+            attempt,
+            base_delay_s=self.backoff_base_s,
+            max_delay_s=self.backoff_max_s,
+            jitter_frac=self.jitter_frac,
+            rng=self._rng,
+        )
+
+    def _stop_result(
+        self, ledger, rc: int, restarts: int, step, downtime_s: float
+    ) -> SupervisorResult:
+        """The supervisor itself was told to stop: the child's exit (75 after
+        its preemption checkpoint, ideally) is final — relaunching a job the
+        scheduler is tearing down would fight the preemption. A child that
+        finished CLEANLY (rc 0) under the incoming signal is a completed run,
+        not an aborted one — no abort event for it."""
+        if ledger is not None and rc != 0:
+            ledger.event(
+                "supervisor_abort",
+                reason=ABORT_SIGNALED,
+                signal=int(self._stop_signal),
+                rc=rc,
+                restarts=restarts,
+                step=step,
+            )
+        return SupervisorResult(
+            ok=rc == 0,
+            exit_code=rc,
+            restarts=restarts,
+            aborted=None if rc == 0 else ABORT_SIGNALED,
+            final_step=step,
+            downtime_s=round(downtime_s, 3),
+        )
+
+    def run(self) -> SupervisorResult:
+        ledger = self._ledger()
+        prev_handlers = self._install_signals()
+        restarts = 0
+        no_progress = 0
+        downtime_s = 0.0
+        prev_step = self._progress()
+        result: Optional[SupervisorResult] = None
+        if ledger is not None:
+            # session marker: obs/report scopes its resilience section to the
+            # last supervised session (supervisor_start .. supervisor_end), so
+            # stale restarts/aborts do not haunt later clean runs
+            ledger.event(
+                "supervisor_start",
+                max_restarts=self.max_restarts,
+                step=prev_step,
+            )
+        try:
+            while True:
+                rc = self._launch()
+                died_t = time.time()
+                step = self._progress()
+                if self._stop_signal is not None:
+                    result = self._stop_result(
+                        ledger, rc, restarts, step, downtime_s
+                    )
+                    return result
+                if rc == 0:
+                    result = SupervisorResult(
+                        ok=True,
+                        exit_code=0,
+                        restarts=restarts,
+                        final_step=step,
+                        downtime_s=round(downtime_s, 3),
+                    )
+                    return result
+                reason = "preempted" if rc == EXIT_PREEMPTED else "crash"
+                progressed = step is not None and (
+                    prev_step is None or step > prev_step
+                )
+                no_progress = 0 if progressed else no_progress + 1
+                abort = None
+                if no_progress >= self.crash_loop_tolerance:
+                    abort = ABORT_CRASH_LOOP
+                elif restarts >= self.max_restarts:
+                    abort = ABORT_RESTART_BUDGET
+                if abort:
+                    logger.error(
+                        "supervisor giving up (%s) after %d restart(s): rc=%d, "
+                        "step=%s",
+                        abort, restarts, rc, step,
+                    )
+                    if ledger is not None:
+                        ledger.event(
+                            "supervisor_abort",
+                            reason=abort,
+                            rc=rc,
+                            restarts=restarts,
+                            step=step,
+                        )
+                    result = SupervisorResult(
+                        ok=False,
+                        exit_code=rc,
+                        restarts=restarts,
+                        aborted=abort,
+                        final_step=step,
+                        downtime_s=round(downtime_s, 3),
+                    )
+                    return result
+                restarts += 1
+                backoff = self._backoff(restarts)
+                logger.warning(
+                    "child exited rc=%d (%s) at step %s — restart %d/%d in "
+                    "%.2fs",
+                    rc, reason, step, restarts, self.max_restarts, backoff,
+                )
+                self._sleep(backoff)
+                if self._stop_signal is not None:
+                    # a signal landing between child lifetimes (typically mid
+                    # backoff sleep) must not launch a fresh child the
+                    # scheduler would have to kill again
+                    result = self._stop_result(
+                        ledger, rc, restarts - 1, step, downtime_s
+                    )
+                    return result
+                restart_downtime = time.time() - died_t
+                downtime_s += restart_downtime
+                event = {
+                    "attempt": restarts,
+                    "rc": rc,
+                    "reason": reason,
+                    "step": step,
+                    "prev_step": prev_step,
+                    "backoff_s": round(backoff, 3),
+                    "downtime_s": round(restart_downtime, 3),
+                }
+                self.restart_events.append(event)
+                if ledger is not None:
+                    ledger.event("restart", **event)
+                prev_step = step
+        finally:
+            self._restore_signals(prev_handlers)
+            if ledger is not None:
+                if result is not None:
+                    ledger.event(
+                        "supervisor_end",
+                        ok=result.ok,
+                        restarts=result.restarts,
+                        aborted=result.aborted,
+                        step=result.final_step,
+                        downtime_s=result.downtime_s,
+                    )
+                ledger.close()
+
+
+def run_supervised(argv: Sequence[str], **kwargs) -> SupervisorResult:
+    """One-shot convenience: ``Supervisor(argv, **kwargs).run()``."""
+    return Supervisor(argv, **kwargs).run()

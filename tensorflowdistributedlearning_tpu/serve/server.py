@@ -140,6 +140,16 @@ class ServingServer:
         """Block the calling thread until ``shutdown()`` (the CLI foreground)."""
         self._stop.wait()
 
+    def install_signal_handlers(self, signals=None) -> None:
+        """SIGTERM/SIGINT trigger the graceful drain — the serving tier's
+        preemption contract (resilience/): intake stops, accepted requests
+        finish, the final ledger window and ``run_end`` land. Main thread
+        only (the CPython signal rule)."""
+        import signal as signal_lib
+
+        for sig in signals or (signal_lib.SIGINT, signal_lib.SIGTERM):
+            signal_lib.signal(sig, lambda *_: self.shutdown())
+
     def metrics_snapshot(self) -> Dict:
         """The ``/metrics`` body: live registry view + serving identity."""
         reg = self.engine.registry

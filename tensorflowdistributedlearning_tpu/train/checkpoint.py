@@ -15,17 +15,38 @@ model.py:189-204). All three map onto one Orbax ``CheckpointManager`` here:
 
 Only the pytree part of ``TrainState`` (step/params/batch_stats/opt_state) is stored;
 ``apply_fn``/``tx`` are static and re-supplied from the template state on restore.
+
+Resilience (resilience/): saves and restores retry transient I/O with backoff
+(counted in obs metrics, ledgered as ``checkpoint_retry`` when a telemetry is
+wired in); ``restore_latest`` skips a partially-written/corrupt latest
+checkpoint and falls back to the previous step (``checkpoint_corrupt`` event)
+— only a *structure mismatch* (``CheckpointStructureError``: the config
+changed since the write) still raises; and every manager registers an
+``atexit`` close so an uncaught exception mid-fold cannot leave orbax with
+unflushed async state.
 """
 
 from __future__ import annotations
 
+import atexit
+import logging
 import os
 from typing import Dict, Optional
 
 import jax
 import orbax.checkpoint as ocp
 
+from tensorflowdistributedlearning_tpu.resilience import faults
+import tensorflowdistributedlearning_tpu.resilience.retry as retry_lib
 from tensorflowdistributedlearning_tpu.train.state import TrainState
+
+logger = logging.getLogger(__name__)
+
+
+class CheckpointStructureError(RuntimeError):
+    """The checkpoint's pytree does not match the current training state —
+    a configuration change, not corruption; the corrupt-checkpoint fallback
+    must NOT swallow it (resuming an adam run as sgd deserves a crash)."""
 
 
 def _state_pytree(state: TrainState) -> Dict:
@@ -71,10 +92,19 @@ class CheckpointManager:
         best_metric: str = "metrics/mean_iou",
         greater_is_better: bool = True,
         async_checkpointing: bool = False,
+        telemetry=None,
     ):
         self.directory = os.path.abspath(directory)
         self.save_every_steps = save_every_steps
         self.best_metric = best_metric
+        # ledger sink for checkpoint_retry/checkpoint_corrupt events; the
+        # trainers pass their live Telemetry, everything else stays silent
+        if telemetry is None:
+            from tensorflowdistributedlearning_tpu.obs import NULL_TELEMETRY
+
+            telemetry = NULL_TELEMETRY
+        self._telemetry = telemetry
+        self._closed = False
         # async: periodic saves overlap the next train steps (device->host copy
         # happens synchronously, serialization in a background thread — the knob
         # the large-batch pod configs want); best exports stay synchronous since
@@ -97,19 +127,39 @@ class CheckpointManager:
                 enable_async_checkpointing=False,
             ),
         )
+        # an uncaught exception mid-fold must not leave orbax's async machinery
+        # with unflushed state; close() unregisters, so a normal close is not
+        # re-run at interpreter exit
+        atexit.register(self.close)
 
     # -- periodic ---------------------------------------------------------
 
     def save(self, state: TrainState, *, force: bool = False) -> bool:
         """Save now (used at step boundaries and end-of-training); idempotent per
-        step — re-offering an already-saved step is a no-op."""
+        step — re-offering an already-saved step is a no-op. Transient I/O
+        failures retry with backoff (resilience/retry.py; the injectable
+        ``io-ckpt`` fault site lives inside the attempt)."""
         step = int(jax.device_get(state.step))
         if step in self._ckpt.all_steps():
             return False
-        saved = self._ckpt.save(
-            step,
-            args=ocp.args.StandardSave(_save_pytree(state, to_host=not self._async)),
-            force=force,
+
+        def attempt() -> bool:
+            faults.fire(faults.SITE_CHECKPOINT)
+            return self._ckpt.save(
+                step,
+                args=ocp.args.StandardSave(
+                    _save_pytree(state, to_host=not self._async)
+                ),
+                force=force,
+            )
+
+        saved = retry_lib.call_with_retry(
+            attempt,
+            name="checkpoint_save",
+            exceptions=(OSError,),
+            on_retry=lambda a, e: self._telemetry.event(
+                "checkpoint_retry", step=step, attempt=a, error=str(e)[:200]
+            ),
         )
         if not self._async:
             self._ckpt.wait_until_finished()
@@ -134,12 +184,81 @@ class CheckpointManager:
     def restore_latest(self, template: TrainState) -> TrainState:
         """Estimator-style auto-resume: if a checkpoint exists, restore it into the
         template's shardings; else return the template unchanged (reference: implicit
-        in per-fold Estimator construction, model.py:164-167)."""
+        in per-fold Estimator construction, model.py:164-167).
+
+        A partially-written/corrupt latest checkpoint (the signature of a run
+        killed mid-write) is skipped — deleted, so later saves can re-write
+        its step — with a ``checkpoint_corrupt`` ledger event, and the
+        previous step restored instead; if every retained step is genuinely
+        corrupt the template (fresh init) is returned — for a supervised run,
+        retraining beats a permanent crash loop. Two failure classes still
+        raise: structure mismatches (``CheckpointStructureError``: the
+        *configuration* changed, and silently restarting from scratch would
+        hide it) and TRANSIENT exhaustion on every step (a filesystem blip —
+        the kept checkpoints will likely restore after the supervisor's
+        backoff, and fresh-initing next to retained old-lineage steps would
+        build a mixed history)."""
         self._ckpt.wait_until_finished()  # async saves must land before reading
-        step = self._ckpt.latest_step()
-        if step is None:
-            return template
-        return self._restore(self._ckpt, step, template)
+        steps = sorted(self._ckpt.all_steps(), reverse=True)
+        last_error: Optional[BaseException] = None
+        any_transient = False
+        for step in steps:
+            try:
+                return self._restore(self._ckpt, step, template)
+            except CheckpointStructureError:
+                raise
+            except Exception as e:  # noqa: BLE001 — corrupt/truncated step dir
+                last_error = e
+                # a transiently-failing filesystem (RetryExhaustedError: the
+                # short backoff window expired) is NOT corruption — fall back
+                # for this resume but KEEP the step; it may restore fine once
+                # the blip passes, and deleting good checkpoints on a blip
+                # could walk the whole history into a fresh init
+                transient = isinstance(e, retry_lib.RetryExhaustedError)
+                logger.warning(
+                    "checkpoint at step %d under %s is unrestorable (%s: %s) "
+                    "— falling back to the previous step",
+                    step, self.directory, type(e).__name__, str(e)[:200],
+                )
+                self._telemetry.event(
+                    "checkpoint_corrupt",
+                    step=step,
+                    transient=transient,
+                    error=f"{type(e).__name__}: {str(e)[:200]}",
+                )
+                if transient:
+                    any_transient = True
+                    continue
+                # drop the genuinely-corrupt step: otherwise every restart
+                # re-walks it, and save()'s per-step idempotence guard
+                # (`step in all_steps()`) would refuse to ever RE-write this
+                # step after the run retrains through it — capping
+                # recoverable progress at the corruption point forever
+                try:
+                    self._ckpt.delete(step)
+                except Exception as delete_error:  # noqa: BLE001
+                    logger.warning(
+                        "could not delete corrupt checkpoint step %d: %s",
+                        step, delete_error,
+                    )
+        if last_error is not None:
+            if any_transient:
+                # at least one step failed only TRANSIENTLY and was kept: a
+                # fresh init here would retrain a new lineage next to retained
+                # old-lineage step dirs (whose steps save() would then refuse
+                # to re-write — a mixed history later resumes could pick up).
+                # Raise instead; the supervisor's backoff retries the whole
+                # launch after the blip.
+                raise last_error
+            logger.error(
+                "no restorable checkpoint under %s (%d candidate(s), all "
+                "corrupt and removed) — starting from a fresh init",
+                self.directory, len(steps),
+            )
+            self._telemetry.event(
+                "checkpoint_corrupt", fallback="fresh_init", candidates=len(steps)
+            )
+        return template
 
     # -- best export ------------------------------------------------------
 
@@ -185,14 +304,28 @@ class CheckpointManager:
     def _restore(self, manager: ocp.CheckpointManager, step: int, template: TrainState) -> TrainState:
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, _state_pytree(template))
         try:
-            restored = manager.restore(step, args=ocp.args.StandardRestore(abstract))
+            # transient filesystem faults retry; persistent corruption
+            # surfaces as RetryExhaustedError for restore_latest's fallback
+            restored = retry_lib.call_with_retry(
+                lambda: manager.restore(
+                    step, args=ocp.args.StandardRestore(abstract)
+                ),
+                name="checkpoint_restore",
+                exceptions=(OSError,),
+            )
         except Exception as e:  # noqa: BLE001 — surface structure mismatches clearly
             msg = str(e)
-            mismatch = isinstance(e, KeyError) or (
-                "pytree" in msg.lower() or "tree structure" in msg.lower()
+            # orbax raises KeyError both for a tree-key mismatch (config
+            # changed) and for a MISSING SAVE UNIT ('Item "default" was not
+            # found...') — the latter is the signature of a step dir a killed
+            # run left partially written, i.e. corruption, not a mismatch
+            missing_item = "was not found in the checkpoint" in msg
+            mismatch = (isinstance(e, KeyError) and not missing_item) or any(
+                marker in msg.lower()
+                for marker in ("pytree", "tree structure", "key mismatch")
             )
             if mismatch:
-                raise RuntimeError(
+                raise CheckpointStructureError(
                     f"checkpoint at step {step} under {self.directory} does not "
                     "match the current training state structure — most often "
                     "the optimizer or model configuration changed since the "
@@ -209,5 +342,11 @@ class CheckpointManager:
         )
 
     def close(self) -> None:
+        """Idempotent: also runs via ``atexit`` when a fold dies with this
+        manager open, so async orbax state is always flushed."""
+        if self._closed:
+            return
+        self._closed = True
+        atexit.unregister(self.close)
         self._ckpt.close()
         self._best.close()

@@ -34,6 +34,8 @@ from tensorflowdistributedlearning_tpu.data import synthetic as synthetic_lib
 from tensorflowdistributedlearning_tpu.models import build_model
 from tensorflowdistributedlearning_tpu.parallel import mesh as mesh_lib
 from tensorflowdistributedlearning_tpu.parallel import multihost
+from tensorflowdistributedlearning_tpu.resilience import faults as faults_lib
+from tensorflowdistributedlearning_tpu.resilience import preempt as preempt_lib
 from tensorflowdistributedlearning_tpu.train import step as step_lib
 from tensorflowdistributedlearning_tpu.train.checkpoint import CheckpointManager
 from tensorflowdistributedlearning_tpu.train.state import TrainState, create_train_state
@@ -295,11 +297,17 @@ class ClassifierTrainer:
         train_split = self._open_split("train")
         if train_split is None:
             cfg = self.model_config
+            # index-keyed: batch i is a pure function of (seed, i), so a
+            # resumed run replays the exact stream the uninterrupted run saw
+            # from start_step on — the data-side half of the resilience
+            # contract (resumed params must match bit-for-bit)
             return synthetic_lib.synthetic_batches(
                 "classification",
                 local_bs,
-                seed=seed,
+                seed=tcfg.seed + jax.process_index(),
                 steps=steps,
+                start_index=start_step,
+                index_keyed=True,
                 input_shape=cfg.input_shape,
                 channels=cfg.input_channels,
                 num_classes=cfg.num_classes,
@@ -393,6 +401,13 @@ class ClassifierTrainer:
             ckpt.close()
             tel.close(steps=start_step, already_trained=True)
             return FitResult(metrics, self.params, start_step)
+        if start_step > 0:
+            # resume verification: training actually CONTINUES from a prior
+            # checkpoint (an already-trained rerun above is not a resume, and
+            # must not fabricate a resilience story in the report); the ledger
+            # records the resume point so telemetry-report can line restarts
+            # up with recovered progress
+            tel.event("resumed", step=start_step)
 
         if self._tp:
             from tensorflowdistributedlearning_tpu.parallel import tensor as tp_lib
@@ -445,6 +460,17 @@ class ClassifierTrainer:
                 batch = prepare(jax.numpy.asarray(step_no), raw)
                 state, metrics = train_step(state, batch)
             step_no += 1
+            # resilience boundary: injected faults fire here (a SIGTERM lands
+            # in the preemption handler below within the same boundary), and a
+            # pending preemption turns into a final checkpoint + distinct exit
+            faults_lib.fire(faults_lib.SITE_STEP, step_no)
+            if preempt_lib.requested():
+                ckpt.save(state, force=True)
+                tel.checkpoint_event(step_no, preempted=True)
+                tel.event(
+                    "preempted", step=step_no, reason=preempt_lib.reason()
+                )
+                raise preempt_lib.PreemptedError(step_no)
             if tb_train is not None and step_no % tcfg.train_log_every_steps == 0:
                 # the device_get synchronizes on this step, so the window's
                 # span totals are real wall time — it counts as step time
@@ -662,6 +688,9 @@ class ClassifierTrainer:
             save_best=tcfg.save_best,
             best_metric="metrics/top1",
             async_checkpointing=tcfg.async_checkpointing,
+            # live during fit(), the null instance on serving restores —
+            # checkpoint_retry/checkpoint_corrupt events reach the run ledger
+            telemetry=self._telemetry,
         )
 
     def _host_template(self) -> TrainState:

@@ -44,6 +44,8 @@ from tensorflowdistributedlearning_tpu.data import pipeline as pipeline_lib
 from tensorflowdistributedlearning_tpu.models import build_model
 from tensorflowdistributedlearning_tpu.parallel import mesh as mesh_lib
 from tensorflowdistributedlearning_tpu.parallel import multihost
+from tensorflowdistributedlearning_tpu.resilience import faults as faults_lib
+from tensorflowdistributedlearning_tpu.resilience import preempt as preempt_lib
 from tensorflowdistributedlearning_tpu.train import step as step_lib
 from tensorflowdistributedlearning_tpu.train.checkpoint import CheckpointManager
 from tensorflowdistributedlearning_tpu.train.state import TrainState, create_train_state
@@ -241,6 +243,9 @@ class Trainer:
             save_every_steps=tcfg.checkpoint_every_steps,
             save_best=tcfg.save_best,
             async_checkpointing=tcfg.async_checkpointing,
+            # live during train(), the null instance on predict/serving —
+            # checkpoint_retry/checkpoint_corrupt events reach the run ledger
+            telemetry=self._telemetry,
         )
 
     # -- training ---------------------------------------------------------
@@ -344,6 +349,11 @@ class Trainer:
                 state, eval_ds, batch_size, fold, writer=None,
                 global_n=eval_global_n,
             )
+        if start_step > 0:
+            # resume verification: training actually CONTINUES from a prior
+            # checkpoint (an already-trained fold rerun above is not a resume);
+            # telemetry-report lines restarts up with the recovered progress
+            self._telemetry.event("resumed", step=start_step, fold=fold)
 
         train_step = step_lib.make_train_step(
             self.mesh,
@@ -400,6 +410,20 @@ class Trainer:
                 batch = prepare(jnp.asarray(step_no), raw)
                 state, metrics = train_step(state, batch)
             step_no += 1
+            # resilience boundary: injected faults fire here (a SIGTERM lands
+            # in the preemption handler below within the same boundary), and a
+            # pending preemption turns into a final checkpoint + distinct exit
+            faults_lib.fire(faults_lib.SITE_STEP, step_no)
+            if preempt_lib.requested():
+                ckpt.save(state, force=True)
+                tel.checkpoint_event(step_no, fold=fold, preempted=True)
+                tel.event(
+                    "preempted",
+                    step=step_no,
+                    fold=fold,
+                    reason=preempt_lib.reason(),
+                )
+                raise preempt_lib.PreemptedError(step_no)
             if tb_train is not None and step_no % tcfg.train_log_every_steps == 0:
                 # the device_get synchronizes on this step, so the window's
                 # span totals are real wall time — it counts as step time

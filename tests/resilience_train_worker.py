@@ -1,0 +1,223 @@
+"""Worker for tests/test_resilience.py and ``tools/run_suite.py
+--resilience-smoke``: one real (tiny, synthetic-data, single-CPU-device)
+``ClassifierTrainer.fit`` run with the resilience stack installed.
+
+Two modes:
+
+``run``    — one training run. Installs the preemption handler and (optionally)
+             a fault injector, trains ``--steps`` steps, dumps the final
+             checkpoint's params to ``--params-out`` (.npz), prints a RESULT
+             json line. Exits ``EXIT_PREEMPTED`` (75) after a preemption
+             checkpoint — exactly what the CLI's train/fit commands do.
+
+``smoke``  — the whole resilience drill: an uninterrupted golden run, then a
+             supervised run injected with SIGTERM at a seeded-random step
+             (restarted by resilience.supervisor), then a bit-for-bit compare
+             of the final params. Prints ``{"ok": true, ...}``; exit 0 iff
+             recovery produced identical params and the ledger shows the
+             restart. This is the zero-hardware proof that kill -> resume ->
+             identical result actually holds end to end.
+
+The training setup is deliberately the smallest thing that exercises the real
+fit loop: synthetic classification batches are index-keyed (pure function of
+(seed, step)), so a resumed run replays the exact uninterrupted stream.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _setup_jax_env() -> None:
+    """Single CPU device, BEFORE jax initializes (subprocesses do not load the
+    root conftest). The persistent compile cache is deliberately NOT enabled:
+    resumed children deterministically SIGSEGV'd inside XLA:CPU executable
+    serialization with it on this box (the same cache flakiness the root
+    conftest documents) — a resilience drill must not depend on it."""
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _tiny_trainer(model_dir: str):
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    return ClassifierTrainer(
+        model_dir,
+        None,  # synthetic data — index-keyed, restart-invariant
+        ModelConfig(
+            num_classes=4,
+            input_shape=(16, 16),
+            input_channels=3,
+            n_blocks=(1, 1, 1),
+            base_depth=8,
+            width_multiplier=0.0625,
+            output_stride=None,
+        ),
+        TrainConfig(
+            seed=0,
+            checkpoint_every_steps=2,
+            train_log_every_steps=1,
+            augmentation="none",
+        ),
+    )
+
+
+def _dump_final_params(trainer, path: str) -> None:
+    """Final checkpoint -> flat .npz (deterministic leaf order) for the
+    bit-for-bit compare."""
+    import jax
+    import numpy as np
+
+    state = trainer._checkpointer().restore_latest(trainer._host_template())
+    arrays = {"step": np.asarray(jax.device_get(state.step))}
+    for tree, prefix in ((state.params, "p"), (state.batch_stats, "bs")):
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+            arrays[f"{prefix}{i}"] = np.asarray(jax.device_get(leaf))
+    np.savez(path, **arrays)
+
+
+def cmd_run(args) -> int:
+    _setup_jax_env()
+    sys.path.insert(0, REPO)
+    from tensorflowdistributedlearning_tpu.resilience import faults, preempt
+
+    preempt.install(notice_file=args.notice_file)
+    if args.inject_fault:
+        faults.install(args.inject_fault, seed=args.seed)
+    trainer = _tiny_trainer(args.model_dir)
+    try:
+        result = trainer.fit(
+            batch_size=4, steps=args.steps, eval_every_steps=args.steps
+        )
+    except preempt.PreemptedError as e:
+        print(json.dumps({"preempted": True, "step": e.step}), flush=True)
+        return preempt.EXIT_PREEMPTED
+    if args.params_out:
+        _dump_final_params(trainer, args.params_out)
+    import tensorflowdistributedlearning_tpu.resilience.retry as retry_lib
+
+    print(
+        json.dumps(
+            {
+                "steps": result.steps,
+                "final_metrics": result.final_metrics,
+                "retries": retry_lib.retries(),
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+def _run_child(argv, timeout=420) -> int:
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), *argv],
+        timeout=timeout,
+        check=False,
+    ).returncode
+
+
+def cmd_smoke(args) -> int:
+    sys.path.insert(0, REPO)
+    import numpy as np
+
+    from tensorflowdistributedlearning_tpu.obs.ledger import read_ledger
+    from tensorflowdistributedlearning_tpu.resilience import parse_fault_spec
+    from tensorflowdistributedlearning_tpu.resilience.supervisor import Supervisor
+
+    golden_dir = os.path.join(args.workdir, "golden")
+    sup_dir = os.path.join(args.workdir, "supervised")
+    golden_npz = os.path.join(args.workdir, "golden.npz")
+    sup_npz = os.path.join(args.workdir, "supervised.npz")
+
+    rc = _run_child(
+        ["run", "--model-dir", golden_dir, "--steps", str(args.steps),
+         "--params-out", golden_npz]
+    )
+    if rc != 0:
+        print(json.dumps({"ok": False, "stage": "golden", "rc": rc}))
+        return 1
+
+    # kill at a seeded-random mid-run step (never the final step: preemption
+    # AT the end would leave nothing to resume)
+    fault = f"sigterm@2-{args.steps - 1}"
+    kill_step = parse_fault_spec(fault, seed=args.seed).at
+    result = Supervisor(
+        [sys.executable, os.path.abspath(__file__), "run",
+         "--model-dir", sup_dir, "--steps", str(args.steps),
+         "--params-out", sup_npz, "--inject-fault", fault,
+         "--seed", str(args.seed)],
+        workdir=sup_dir,
+        max_restarts=3,
+        backoff_base_s=0.1,
+        backoff_max_s=1.0,
+        seed=args.seed,
+    ).run()
+
+    events = read_ledger(sup_dir) if os.path.exists(
+        os.path.join(sup_dir, "telemetry.jsonl")
+    ) else []
+    kinds = [e.get("event") for e in events]
+    identical = False
+    if result.ok and os.path.exists(sup_npz):
+        a, b = np.load(golden_npz), np.load(sup_npz)
+        identical = sorted(a.files) == sorted(b.files) and all(
+            np.array_equal(a[k], b[k]) for k in a.files
+        )
+    ok = (
+        result.ok
+        and result.restarts >= 1
+        and identical
+        and "preempted" in kinds
+        and "restart" in kinds
+        and "resumed" in kinds
+    )
+    print(
+        json.dumps(
+            {
+                "ok": ok,
+                "kill_step": kill_step,
+                "restarts": result.restarts,
+                "identical": identical,
+                "downtime_s": result.downtime_s,
+                "ledger_events": sorted(
+                    {k for k in kinds if k in (
+                        "preempted", "restart", "resumed", "run_header",
+                        "run_end",
+                    )}
+                ),
+            }
+        ),
+        flush=True,
+    )
+    return 0 if ok else 1
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    sub = parser.add_subparsers(dest="mode", required=True)
+    p_run = sub.add_parser("run")
+    p_run.add_argument("--model-dir", required=True)
+    p_run.add_argument("--steps", type=int, default=8)
+    p_run.add_argument("--inject-fault", default=None)
+    p_run.add_argument("--notice-file", default=None)
+    p_run.add_argument("--params-out", default=None)
+    p_run.add_argument("--seed", type=int, default=0)
+    p_smoke = sub.add_parser("smoke")
+    p_smoke.add_argument("--workdir", required=True)
+    p_smoke.add_argument("--steps", type=int, default=8)
+    p_smoke.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+    return {"run": cmd_run, "smoke": cmd_smoke}[args.mode](args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

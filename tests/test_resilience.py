@@ -1,0 +1,728 @@
+"""Resilience subsystem tests: fault injection, retry, preemption, corrupt-
+checkpoint fallback, the restart supervisor, and the headline kill-and-resume
+e2e (SIGTERM a real training subprocess mid-run, supervise its restart, and
+require the final params to match an uninterrupted run bit-for-bit).
+
+Everything here stays OUT of the ``slow`` marker on purpose (ISSUE 3): the
+recovery path must be exercised by every tier-1 sweep, not only by the full
+suite runner."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import tensorflowdistributedlearning_tpu.resilience.retry as retry_lib
+from tensorflowdistributedlearning_tpu.obs.ledger import RunLedger, read_ledger
+from tensorflowdistributedlearning_tpu.resilience import (
+    ABORT_CRASH_LOOP,
+    ABORT_RESTART_BUDGET,
+    EXIT_PREEMPTED,
+    InjectedFault,
+    RetryExhaustedError,
+    Supervisor,
+    TransientInjectedIOError,
+    faults,
+    parse_fault_spec,
+    preempt,
+)
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "resilience_train_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    """Process-global injector/handler/retry counters must not leak between
+    tests (or into the rest of the suite)."""
+    yield
+    faults.uninstall()
+    preempt.uninstall()
+    retry_lib.reset_registry()
+
+
+# -- fault specs ---------------------------------------------------------------
+
+
+def test_parse_fault_spec_forms():
+    assert parse_fault_spec("raise@12") == faults.FaultSpec("raise", 12, 1)
+    assert parse_fault_spec("sigterm@3") == faults.FaultSpec("sigterm", 3, 1)
+    assert parse_fault_spec("io-data@3x2") == faults.FaultSpec("io-data", 3, 2)
+    assert parse_fault_spec("io-ckpt@1").site == faults.SITE_CHECKPOINT
+    assert parse_fault_spec("io-read@2").site == faults.SITE_IO
+
+
+def test_parse_fault_spec_seeded_range_is_deterministic():
+    a = parse_fault_spec("sigterm@5-20", seed=7)
+    b = parse_fault_spec("sigterm@5-20", seed=7)
+    c = parse_fault_spec("sigterm@5-20", seed=8)
+    assert a == b
+    assert 5 <= a.at <= 20 and 5 <= c.at <= 20
+    # different seeds should usually differ; at minimum both stay in range
+    assert parse_fault_spec("sigterm@9-9", seed=3).at == 9
+
+
+@pytest.mark.parametrize(
+    "bad", ["", "boom@3", "raise@", "raise@5-2", "io-data@3x0", "raise3"]
+)
+def test_parse_fault_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_fault_spec(bad)
+
+
+def test_injector_step_fault_fires_once_at_exact_step():
+    faults.install("raise@3")
+    faults.fire(faults.SITE_STEP, 1)
+    faults.fire(faults.SITE_STEP, 2)
+    with pytest.raises(InjectedFault):
+        faults.fire(faults.SITE_STEP, 3)
+    # count=1: the same step offered again does not re-fire
+    faults.fire(faults.SITE_STEP, 3)
+    faults.fire(faults.SITE_STEP, 4)
+
+
+def test_injector_io_occurrence_window():
+    faults.install("io-read@2x2")
+    faults.fire(faults.SITE_IO)  # occurrence 1: clean
+    for _ in range(2):  # occurrences 2 and 3: fail
+        with pytest.raises(TransientInjectedIOError):
+            faults.fire(faults.SITE_IO)
+    faults.fire(faults.SITE_IO)  # occurrence 4: clean again
+    # other sites never see it
+    faults.fire(faults.SITE_DATA)
+    faults.fire(faults.SITE_CHECKPOINT)
+
+
+def test_fire_is_noop_when_nothing_installed():
+    faults.uninstall()
+    faults.fire(faults.SITE_STEP, 1)
+    faults.fire(faults.SITE_IO)
+
+
+# -- retry ---------------------------------------------------------------------
+
+
+def test_retry_recovers_and_counts():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    out = retry_lib.call_with_retry(
+        flaky, name="unit", sleep=lambda _s: None
+    )
+    assert out == "ok"
+    assert len(calls) == 3
+    assert retry_lib.retries("unit") == 2
+    assert retry_lib.retries() == 2
+
+
+def test_retry_exhaustion_error_shape():
+    def always():
+        raise OSError("disk on fire")
+
+    with pytest.raises(RetryExhaustedError) as exc:
+        retry_lib.call_with_retry(
+            always, name="unit", attempts=3, sleep=lambda _s: None
+        )
+    err = exc.value
+    assert err.name == "unit"
+    assert err.attempts == 3
+    assert isinstance(err.last, OSError)
+    assert isinstance(err.__cause__, OSError)
+    assert "disk on fire" in str(err)
+    # exhaustion is NOT itself OSError: outer retries must not re-retry it
+    assert not isinstance(err, OSError)
+    assert retry_lib.retries("unit") == 2  # attempts - 1 sleeps/counts
+
+
+def test_retry_clean_path_counts_nothing():
+    assert retry_lib.call_with_retry(lambda: 7, name="unit") == 7
+    assert retry_lib.retries() == 0
+
+
+def test_retry_gives_up_immediately_on_non_transient_oserrors():
+    """Missing files / permission walls are deterministic: no backoff, and the
+    caller keeps the original exception type (not RetryExhaustedError)."""
+    calls = []
+
+    def missing():
+        calls.append(1)
+        raise FileNotFoundError("/no/such/shard")
+
+    with pytest.raises(FileNotFoundError):
+        retry_lib.call_with_retry(missing, name="unit", sleep=lambda _s: None)
+    assert len(calls) == 1
+    assert retry_lib.retries() == 0
+
+
+def test_retry_does_not_swallow_unlisted_exceptions():
+    with pytest.raises(ValueError):
+        retry_lib.call_with_retry(
+            lambda: (_ for _ in ()).throw(ValueError("no")),
+            name="unit",
+            sleep=lambda _s: None,
+        )
+
+
+# -- preemption ----------------------------------------------------------------
+
+
+def test_preempt_signal_sets_flag_and_reason():
+    preempt.install(signals=(signal.SIGUSR1,))
+    assert not preempt.requested()
+    os.kill(os.getpid(), signal.SIGUSR1)
+    assert preempt.requested()
+    assert preempt.reason() == "signal:SIGUSR1"
+
+
+def test_preempt_notice_file(tmp_path):
+    notice = tmp_path / "PREEMPT"
+    preempt.install(notice_file=str(notice), signals=None)
+    assert not preempt.requested()
+    notice.write_text("drain please")
+    # the throttle caches the first (pre-notice) stat briefly; force recheck
+    preempt.handler()._last_notice_check = 0.0
+    assert preempt.requested()
+    assert preempt.reason().startswith("notice-file:")
+
+
+def test_preempt_uninstalled_is_false():
+    preempt.uninstall()
+    assert not preempt.requested()
+    assert preempt.reason() == "unknown"
+
+
+def test_preempted_error_carries_step_and_exit_code_is_distinct():
+    err = preempt.PreemptedError(41)
+    assert err.step == 41
+    assert EXIT_PREEMPTED == 75
+    assert EXIT_PREEMPTED not in (0, 1, 2, 130, 137, 139, 143)
+
+
+# -- supervisor (fake launches: no subprocesses, no sleeping) ------------------
+
+
+def _supervisor(tmp_path, rcs, progress, **kw):
+    """Supervisor over a scripted child: ``rcs`` consumed per launch,
+    ``progress`` consumed per progress query."""
+    rcs, progress = list(rcs), list(progress)
+    kw.setdefault("sleep", lambda _s: None)
+    kw.setdefault("backoff_base_s", 0.0)
+    return Supervisor(
+        ["true"],
+        workdir=str(tmp_path),
+        launch=lambda: rcs.pop(0),
+        progress_fn=lambda: progress.pop(0),
+        **kw,
+    )
+
+
+def test_supervisor_restarts_through_failures_to_success(tmp_path):
+    # initial probe, then one query after each of 3 launches
+    sup = _supervisor(
+        tmp_path, rcs=[1, EXIT_PREEMPTED, 0], progress=[None, 2, 5, 8],
+        max_restarts=3,
+    )
+    result = sup.run()
+    assert result.ok
+    assert result.restarts == 2
+    assert result.final_step == 8
+    events = read_ledger(str(tmp_path))
+    restarts = [e for e in events if e["event"] == "restart"]
+    assert [e["rc"] for e in restarts] == [1, EXIT_PREEMPTED]
+    assert restarts[0]["reason"] == "crash"
+    assert restarts[1]["reason"] == "preempted"
+    assert all(e["downtime_s"] >= 0 for e in restarts)
+
+
+def test_supervisor_crash_loop_aborts(tmp_path):
+    # step never advances past 3: two consecutive no-progress failures abort
+    sup = _supervisor(
+        tmp_path, rcs=[1, 1, 1, 1], progress=[3, 3, 3, 3, 3], max_restarts=10,
+    )
+    result = sup.run()
+    assert not result.ok
+    assert result.aborted == ABORT_CRASH_LOOP
+    assert result.restarts == 1  # first no-progress restart, then abort
+    aborts = [
+        e for e in read_ledger(str(tmp_path)) if e["event"] == "supervisor_abort"
+    ]
+    assert aborts and aborts[-1]["reason"] == ABORT_CRASH_LOOP
+
+
+def test_supervisor_restart_budget_aborts(tmp_path):
+    # progress every time (no crash loop) but the child never succeeds
+    sup = _supervisor(
+        tmp_path, rcs=[1, 1, 1], progress=[0, 1, 2, 3], max_restarts=2,
+    )
+    result = sup.run()
+    assert not result.ok
+    assert result.aborted == ABORT_RESTART_BUDGET
+    assert result.restarts == 2
+
+
+def test_supervisor_clean_run_writes_nothing(tmp_path):
+    result = _supervisor(tmp_path, rcs=[0], progress=[None, 7]).run()
+    assert result.ok and result.restarts == 0 and result.downtime_s == 0.0
+    assert not os.path.exists(os.path.join(str(tmp_path), "telemetry.jsonl")) or not [
+        e
+        for e in read_ledger(str(tmp_path))
+        if e["event"] in ("restart", "supervisor_abort")
+    ]
+
+
+def test_supervisor_signal_stops_restart_loop(tmp_path):
+    """A signal delivered to the SUPERVISOR must not trigger a relaunch: the
+    child's (preempted) exit is final when the whole job is being torn down."""
+    from tensorflowdistributedlearning_tpu.resilience import ABORT_SIGNALED
+
+    def launch():
+        os.kill(os.getpid(), signal.SIGTERM)  # handled by the supervisor
+        return EXIT_PREEMPTED
+
+    sup = Supervisor(
+        ["true"],
+        workdir=str(tmp_path),
+        launch=launch,
+        progress_fn=lambda: 5,
+        sleep=lambda _s: None,
+    )
+    result = sup.run()
+    assert result.restarts == 0
+    assert not result.ok
+    assert result.exit_code == EXIT_PREEMPTED
+    assert result.aborted == ABORT_SIGNALED
+    aborts = [
+        e for e in read_ledger(str(tmp_path)) if e["event"] == "supervisor_abort"
+    ]
+    assert aborts and aborts[-1]["reason"] == ABORT_SIGNALED
+    # the supervisor restored the previous SIGTERM disposition on exit
+    assert signal.getsignal(signal.SIGTERM) != sup._on_signal
+
+
+def test_supervisor_signal_during_backoff_prevents_relaunch(tmp_path):
+    """A signal landing between child lifetimes (mid backoff sleep) must stop
+    the loop — launching a fresh child the scheduler would have to kill again
+    fights the teardown."""
+    launches = []
+
+    def launch():
+        launches.append(1)
+        return 1
+
+    sup = Supervisor(
+        ["true"],
+        workdir=str(tmp_path),
+        launch=launch,
+        progress_fn=lambda: len(launches),  # always progresses: no crash loop
+        max_restarts=5,
+    )
+    # deliver the signal "during" the backoff sleep
+    sup._sleep = lambda _s: sup._on_signal(signal.SIGTERM, None)
+    result = sup.run()
+    assert launches == [1]
+    assert not result.ok and result.exit_code == 1
+    assert result.restarts == 0  # the aborted relaunch does not count
+
+
+def test_transient_restore_exhaustion_keeps_checkpoints_and_raises(
+    tmp_path, tiny_state, monkeypatch
+):
+    """A filesystem blip (RetryExhaustedError out of the restore retry) must
+    NOT delete the step and must NOT fresh-init next to it (mixed lineage):
+    it raises, the supervisor backs off, and the kept checkpoint restores
+    fine once the blip passes."""
+    import jax
+
+    ck = _manager(tmp_path)
+    ck.save(tiny_state.replace(step=tiny_state.step + 1), force=True)
+    original = ck._ckpt.restore
+
+    def flaky_restore(*args, **kwargs):
+        raise OSError("NFS blip")
+
+    monkeypatch.setattr(ck._ckpt, "restore", flaky_restore)
+    with pytest.raises(RetryExhaustedError):
+        ck.restore_latest(tiny_state)
+    assert ck._ckpt.all_steps() == [1]  # the checkpoint survived the blip
+    monkeypatch.setattr(ck._ckpt, "restore", original)
+    assert int(jax.device_get(ck.restore_latest(tiny_state).step)) == 1
+    ck.close()
+
+
+def test_supervisor_signal_after_clean_child_exit_is_not_an_abort(tmp_path):
+    """SIGTERM arriving as the child finishes cleanly: the run completed —
+    no supervisor_abort event, ok result."""
+
+    def launch():
+        os.kill(os.getpid(), signal.SIGTERM)
+        return 0  # the child finished its run before the signal mattered
+
+    result = Supervisor(
+        ["true"],
+        workdir=str(tmp_path),
+        launch=launch,
+        progress_fn=lambda: 4,
+        sleep=lambda _s: None,
+    ).run()
+    assert result.ok and result.restarts == 0 and result.aborted is None
+    assert not [
+        e for e in read_ledger(str(tmp_path)) if e["event"] == "supervisor_abort"
+    ]
+
+
+def test_supervised_child_never_recurses(tmp_path, monkeypatch):
+    """The env marker makes supervisor recursion structurally impossible even
+    if a --max-restarts spelling survives the argv strip (argparse accepts
+    prefix abbreviations)."""
+    from tensorflowdistributedlearning_tpu import cli
+
+    calls = []
+    monkeypatch.setattr(
+        cli, "_run_supervised", lambda args, argv: calls.append(1) or 42
+    )
+    argv = ["fit", "--preset", "nope", "--model-dir", str(tmp_path),
+            "--max-restarts", "2"]
+    assert cli.main(argv) == 42  # parent: supervised path taken
+    monkeypatch.setenv("TFDL_SUPERVISED_CHILD", "1")
+    with pytest.raises(ValueError, match="Unknown preset"):
+        cli.main(argv)  # child: runs the command directly, no recursion
+    assert calls == [1]
+
+
+def test_ledger_progress_reads_last_stepped_event(tmp_path):
+    from tensorflowdistributedlearning_tpu.resilience import ledger_progress
+
+    assert ledger_progress(str(tmp_path)) is None
+    ledger = RunLedger(str(tmp_path))
+    ledger.event("run_header", kind="x")
+    ledger.event("checkpoint", step=4)
+    ledger.event("step_window", step=9)
+    ledger.event("run_end")
+    ledger.close()
+    assert ledger_progress(str(tmp_path)) == 9
+
+
+# -- report integration --------------------------------------------------------
+
+
+def test_report_renders_goodput_lost_to_restarts(tmp_path):
+    from tensorflowdistributedlearning_tpu.obs.report import (
+        build_report,
+        render_report,
+    )
+
+    ledger = RunLedger(str(tmp_path))
+    ledger.event("supervisor_start", max_restarts=3)
+    ledger.event("run_header", kind="train", supervised=True)
+    ledger.event("checkpoint", step=5)
+    ledger.event("preempted", step=5, reason="signal:SIGTERM")
+    ledger.event(
+        "restart", attempt=1, rc=EXIT_PREEMPTED, reason="preempted", step=5,
+        prev_step=None, backoff_s=0.5, downtime_s=0.6,
+    )
+    # the relaunch's own header (children stamp `supervised`)
+    ledger.event("run_header", kind="train", supervised=True)
+    ledger.event("resumed", step=5)
+    ledger.event("checkpoint_retry", step=6, attempt=1, error="EIO")
+    ledger.event("run_end", steps=8)
+    ledger.event("supervisor_end", ok=True, restarts=1)
+    ledger.close()
+
+    report = build_report(str(tmp_path))
+    res = report["resilience"]
+    assert res["restarts"] == 1
+    assert res["preemptions"] == 1
+    assert res["resumes"] == 1
+    assert res["checkpoint_retries"] == 1
+    assert res["restart_downtime_s"] == pytest.approx(0.6)
+    assert res["last_restart"]["reason"] == "preempted"
+    text = render_report(report)
+    assert "goodput lost to restarts" in text
+    assert "1 restart(s)" in text
+
+
+def test_report_resilience_scope_forgets_old_sessions(tmp_path):
+    """A clean standalone run AFTER a closed supervised session must not
+    inherit that session's restarts/aborts in its report."""
+    from tensorflowdistributedlearning_tpu.obs.report import (
+        build_report,
+        render_report,
+    )
+
+    ledger = RunLedger(str(tmp_path))
+    ledger.event("supervisor_start", max_restarts=1)
+    ledger.event("run_header", kind="train", supervised=True)
+    ledger.event("restart", attempt=1, rc=1, reason="crash", downtime_s=2.0)
+    ledger.event("supervisor_abort", reason="crash-loop", rc=1, restarts=1)
+    ledger.event("supervisor_end", ok=False, restarts=1, aborted="crash-loop")
+    # ... user fixes the problem and reruns unsupervised, cleanly
+    ledger.event("run_header", kind="train")
+    ledger.event("step_window", step=4, steps=4)
+    ledger.event("run_end", steps=4)
+    ledger.close()
+    report = build_report(str(tmp_path))
+    assert "resilience" not in report
+    assert "gave this run up" not in render_report(report)
+
+
+def test_report_scope_survives_a_hard_killed_supervisor(tmp_path):
+    """A supervisor that never wrote supervisor_end (SIGKILL, machine death)
+    must not haunt later clean standalone runs either — the takeover keys on
+    the run header's `supervised` stamp, not on the end marker."""
+    from tensorflowdistributedlearning_tpu.obs.report import build_report
+
+    ledger = RunLedger(str(tmp_path))
+    ledger.event("supervisor_start", max_restarts=3)
+    ledger.event("run_header", kind="train", supervised=True)
+    ledger.event("restart", attempt=1, rc=1, reason="crash", downtime_s=1.0)
+    # supervisor hard-killed here: no supervisor_end ever lands
+    ledger.event("run_header", kind="train")  # later clean standalone run
+    ledger.event("run_end", steps=4)
+    ledger.close()
+    assert "resilience" not in build_report(str(tmp_path))
+
+
+def test_report_abort_explanations_match_reason(tmp_path):
+    from tensorflowdistributedlearning_tpu.obs.report import (
+        build_report,
+        render_report,
+    )
+
+    ledger = RunLedger(str(tmp_path))
+    ledger.event("supervisor_start", max_restarts=1)
+    ledger.event("run_header", kind="train", supervised=True)
+    ledger.event("supervisor_abort", reason="signaled", rc=75, restarts=0)
+    ledger.event("supervisor_end", ok=False, restarts=0, aborted="signaled")
+    ledger.close()
+    text = render_report(build_report(str(tmp_path)))
+    assert "signaled" in text
+    assert "itself was signaled" in text
+    assert "progress between restarts" not in text
+
+
+# -- checkpoint layer ----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_state():
+    import jax
+
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.models import build_model
+    from tensorflowdistributedlearning_tpu.train import (
+        create_train_state,
+        make_optimizer,
+    )
+
+    cfg = ModelConfig(
+        n_blocks=(1, 1, 1), input_shape=(16, 16), base_depth=8,
+        width_multiplier=0.0625,
+    )
+    return create_train_state(
+        build_model(cfg),
+        make_optimizer(TrainConfig()),
+        jax.random.PRNGKey(0),
+        np.zeros((1, 16, 16, 2), np.float32),
+    )
+
+
+def _manager(directory, telemetry=None):
+    from tensorflowdistributedlearning_tpu.train.checkpoint import (
+        CheckpointManager,
+    )
+
+    return CheckpointManager(
+        str(directory), save_every_steps=1, telemetry=telemetry
+    )
+
+
+def test_corrupt_latest_checkpoint_falls_back_to_previous(tmp_path, tiny_state):
+    import shutil
+
+    import jax
+
+    from tensorflowdistributedlearning_tpu.obs import Telemetry
+
+    tel = Telemetry(str(tmp_path), run_info={"kind": "test"})
+    ck = _manager(tmp_path, telemetry=tel)
+    ck.save(tiny_state.replace(step=tiny_state.step + 1), force=True)
+    ck.save(tiny_state.replace(step=tiny_state.step + 2), force=True)
+    # the signature of a run killed mid-write: the newest step dir exists but
+    # its save unit is gone
+    shutil.rmtree(os.path.join(str(tmp_path), "checkpoints", "2", "default"))
+    restored = ck.restore_latest(tiny_state)
+    assert int(jax.device_get(restored.step)) == 1
+    # the corrupt step was dropped, so retraining through step 2 can RE-write
+    # it (save()'s per-step idempotence guard must not see the corpse) and the
+    # next restart does not re-walk it
+    assert 2 not in ck._ckpt.all_steps()
+    assert ck.save(tiny_state.replace(step=tiny_state.step + 2), force=True)
+    assert int(jax.device_get(ck.restore_latest(tiny_state).step)) == 2
+    ck.close()
+    tel.close()
+    corrupt = [
+        e for e in read_ledger(str(tmp_path))
+        if e["event"] == "checkpoint_corrupt"
+    ]
+    assert corrupt and corrupt[0]["step"] == 2
+
+
+def test_all_checkpoints_corrupt_falls_back_to_template(tmp_path, tiny_state):
+    import shutil
+
+    ck = _manager(tmp_path)
+    ck.save(tiny_state.replace(step=tiny_state.step + 1), force=True)
+    shutil.rmtree(os.path.join(str(tmp_path), "checkpoints", "1", "default"))
+    restored = ck.restore_latest(tiny_state)
+    assert restored is tiny_state  # fresh init beats a permanent crash loop
+    ck.close()
+
+
+def test_structure_mismatch_still_raises_through_fallback(tmp_path, tiny_state):
+    """A config change is NOT corruption: the corrupt-checkpoint fallback must
+    re-raise it instead of silently restarting from scratch."""
+    import jax
+
+    from tensorflowdistributedlearning_tpu.config import TrainConfig
+    from tensorflowdistributedlearning_tpu.train import make_optimizer
+    from tensorflowdistributedlearning_tpu.train.state import create_train_state
+    from tensorflowdistributedlearning_tpu.models import build_model
+    from tensorflowdistributedlearning_tpu.config import ModelConfig
+
+    ck = _manager(tmp_path)
+    ck.save(tiny_state.replace(step=tiny_state.step + 1), force=True)
+    cfg = ModelConfig(
+        n_blocks=(1, 1, 1), input_shape=(16, 16), base_depth=8,
+        width_multiplier=0.0625,
+    )
+    sgd_template = create_train_state(
+        build_model(cfg),
+        make_optimizer(TrainConfig(optimizer="sgd")),
+        jax.random.PRNGKey(0),
+        np.zeros((1, 16, 16, 2), np.float32),
+    )
+    with pytest.raises(RuntimeError, match="optimizer|structure"):
+        ck.restore_latest(sgd_template)
+    ck.close()
+
+
+def test_injected_transient_checkpoint_io_recovers(tmp_path, tiny_state):
+    """io-ckpt@1: the first save attempt fails transiently, the retry layer
+    recovers it, and the retry is counted + ledgered."""
+    from tensorflowdistributedlearning_tpu.obs import Telemetry
+
+    tel = Telemetry(str(tmp_path), run_info={"kind": "test"})
+    ck = _manager(tmp_path, telemetry=tel)
+    faults.install("io-ckpt@1")
+    assert ck.save(tiny_state.replace(step=tiny_state.step + 1), force=True)
+    assert retry_lib.retries("checkpoint_save") == 1
+    ck.close()
+    tel.close()
+    retries = [
+        e for e in read_ledger(str(tmp_path))
+        if e["event"] == "checkpoint_retry"
+    ]
+    assert retries and retries[0]["step"] == 1
+
+
+def test_checkpoint_close_is_idempotent(tmp_path, tiny_state):
+    ck = _manager(tmp_path)
+    ck.save(tiny_state.replace(step=tiny_state.step + 1), force=True)
+    ck.close()
+    ck.close()  # atexit may also call close(); must be a no-op
+
+
+# -- data-path injection -------------------------------------------------------
+
+
+def test_injected_transient_record_batch_recovers(tmp_path):
+    pytest.importorskip("PIL")
+    from tensorflowdistributedlearning_tpu.data import records as rec
+
+    rng = np.random.default_rng(0)
+    images = [rng.integers(0, 255, (8, 8, 3), dtype=np.uint8) for _ in range(8)]
+    rec.write_classification_shards(
+        str(tmp_path), images, [i % 4 for i in range(8)], shards=2
+    )
+    ds = rec.ClassificationRecords(
+        str(tmp_path), image_shape=(8, 8), channels=3, num_classes=4
+    )
+    faults.install("io-data@1")
+    batches = list(ds.batches(4, repeat=False))
+    assert len(batches) == 2
+    assert retry_lib.retries("record_batch") == 1
+
+
+def test_injected_transient_shard_open_recovers(tmp_path):
+    from tensorflowdistributedlearning_tpu.data import records as rec
+
+    path = os.path.join(str(tmp_path), "a.tfrecord")
+    rec.write_records(path, [b"x", b"y"])
+    faults.install("io-read@1")
+    assert list(rec.read_records(path)) == [b"x", b"y"]
+    assert retry_lib.retries("record_open") == 1
+
+
+# -- the headline: kill at a (seeded-)random step, supervised resume, bit-for-
+# -- bit identical result ------------------------------------------------------
+
+
+def test_kill_and_resume_e2e(tmp_path):
+    """SIGTERM a real fit() subprocess mid-run via injection, let the restart
+    supervisor bring it back, and require the final checkpoint's params to be
+    IDENTICAL to an uninterrupted golden run — plus restart/preempted/resumed
+    accounting in the ledger and a goodput-lost line in telemetry-report."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, WORKER, "smoke", "--workdir", str(tmp_path),
+         "--steps", "6"],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env=env,
+    )
+    lines = [ln for ln in (out.stdout or "").splitlines() if ln.startswith("{")]
+    assert out.returncode == 0 and lines, (
+        f"smoke failed rc={out.returncode}\nstdout:{out.stdout[-3000:]}\n"
+        f"stderr:{out.stderr[-2000:]}"
+    )
+    result = json.loads(lines[-1])
+    assert result["ok"]
+    assert result["identical"], "resumed params differ from the golden run"
+    assert result["restarts"] >= 1
+    assert 2 <= result["kill_step"] <= 5
+
+    # the supervised workdir's ledger carries the whole story
+    events = read_ledger(str(tmp_path / "supervised"))
+    kinds = [e["event"] for e in events]
+    assert "preempted" in kinds and "restart" in kinds and "resumed" in kinds
+    restart = next(e for e in events if e["event"] == "restart")
+    assert restart["rc"] == EXIT_PREEMPTED and restart["reason"] == "preempted"
+
+    # telemetry-report renders the restart with time-lost accounting
+    from tensorflowdistributedlearning_tpu.obs.report import report_workdir
+
+    text = report_workdir(str(tmp_path / "supervised"))
+    assert "goodput lost to restarts" in text
+    assert "1 restart(s)" in text
+
+    # zero restarts/preemptions/retries observed on the clean (golden) path
+    golden = read_ledger(str(tmp_path / "golden"))
+    assert not [
+        e for e in golden
+        if e["event"] in (
+            "restart", "preempted", "checkpoint_retry", "checkpoint_corrupt",
+            "resumed",
+        )
+    ]

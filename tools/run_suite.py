@@ -94,6 +94,13 @@ def main() -> int:
                         "against a synthetic-model server: checks the "
                         "batched-vs-per-request speedup, zero post-warmup "
                         "recompiles, and structured queue-full rejection")
+    parser.add_argument("--resilience-smoke", action="store_true",
+                        help="after the test groups, run the resilience "
+                        "drill (tests/resilience_train_worker.py smoke): "
+                        "SIGTERM-inject a tiny training run at a seeded-"
+                        "random step, recover it under the restart "
+                        "supervisor, and assert the final params match an "
+                        "uninterrupted run bit-for-bit")
     args = parser.parse_args()
 
     files = sorted(glob.glob(os.path.join(REPO, "tests", "test_*.py")))
@@ -219,6 +226,39 @@ def main() -> int:
         record["ok"] = record["ok"] and rc == 0
         if ledger is not None:
             ledger.event("serve_smoke", rc=rc, secs=secs, summary=summary)
+
+    if args.resilience_smoke:
+        import tempfile
+
+        print("=== resilience smoke: inject fault, assert supervised recovery",
+              flush=True)
+        t0 = time.time()
+        with tempfile.TemporaryDirectory(prefix="resilience_smoke_") as tmp:
+            cmd = [
+                sys.executable,
+                os.path.join(REPO, "tests", "resilience_train_worker.py"),
+                "smoke", "--workdir", tmp,
+            ]
+            try:
+                smoke = subprocess.run(
+                    cmd, cwd=REPO, env=env, capture_output=True, text=True,
+                    timeout=600,
+                )
+                rc = smoke.returncode
+                tail = (smoke.stdout or "").strip().splitlines()
+                summary = tail[-1] if tail else ""
+                if rc != 0:
+                    print((smoke.stdout or "")[-2000:], flush=True)
+                    print((smoke.stderr or "")[-1000:], file=sys.stderr,
+                          flush=True)
+            except subprocess.TimeoutExpired:
+                rc, summary = -1, "resilience smoke timed out"
+        secs = round(time.time() - t0, 1)
+        print(f"    rc={rc} {secs}s {summary}", flush=True)
+        record["resilience_smoke"] = {"rc": rc, "secs": secs, "summary": summary}
+        record["ok"] = record["ok"] and rc == 0
+        if ledger is not None:
+            ledger.event("resilience_smoke", rc=rc, secs=secs, summary=summary)
 
     record["total_secs"] = round(time.time() - t_all, 1)
     if ledger is not None:
