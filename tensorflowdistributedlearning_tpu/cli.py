@@ -45,6 +45,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="channel-shard params/optimizer over this many devices "
                    "per replica (tensor parallelism; the K-fold trainer runs "
                    "it in shard_map's hybrid auto-model mode)")
+    p.add_argument("--weight-update-sharding", action="store_true",
+                   help="ZeRO-1: shard optimizer state and the weight update "
+                   "across the data-parallel axis — per-chip optimizer memory "
+                   "drops ~dp-fold at neutral step time, numerics unchanged "
+                   "(arXiv:2004.13336)")
 
 
 def _add_resilience(p: argparse.ArgumentParser) -> None:
@@ -149,6 +154,13 @@ def build_parser() -> argparse.ArgumentParser:
                        help="expert parallelism for MoE presets: one expert "
                        "per shard with all-to-all dispatch (must equal the "
                        "preset's moe_experts)")
+    p_fit.add_argument("--weight-update-sharding", action="store_true",
+                       default=None,
+                       help="ZeRO-1: shard optimizer state and the weight "
+                       "update across the data-parallel axis — per-chip "
+                       "optimizer memory drops ~dp-fold at neutral step "
+                       "time, numerics unchanged (arXiv:2004.13336); "
+                       "default: the preset's setting")
     p_fit.add_argument("--eval-holdout-fraction", type=float, default=None,
                        help="with record shards and no val split: hold out "
                        "this fraction of train shards as the eval split")
@@ -265,6 +277,7 @@ def _trainer(args):
         sequence_parallel=getattr(args, "sequence_parallel", 1),
         model_parallel=getattr(args, "model_parallel", 1),
         sync_batch_norm=getattr(args, "sync_bn", False),
+        weight_update_sharding=getattr(args, "weight_update_sharding", False),
     )
     return Trainer(
         args.model_dir,
@@ -452,6 +465,7 @@ def cmd_fit(args) -> int:
         pipeline_parallel=args.pipeline_parallel,
         pipeline_microbatches=args.pipeline_microbatches,
         expert_parallel=args.expert_parallel,
+        weight_update_sharding=args.weight_update_sharding,
         optimizer=args.optimizer,
         lr=args.lr,
         eval_holdout_fraction=args.eval_holdout_fraction,

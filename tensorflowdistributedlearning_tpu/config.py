@@ -270,6 +270,21 @@ class TrainConfig:
     # 1 computes every expert locally (dense dispatch, any mesh). Mutually
     # exclusive with the other model-axis strategies.
     expert_parallel: int = 1
+    # ZeRO-1 cross-replica weight-update sharding (arXiv:2004.13336,
+    # parallel/zero.py): optimizer state (Adam moments, LARS/SGD momentum,
+    # the EMA tracker) shards over the data-parallel mesh axis — each leaf
+    # partitioned on its largest dp-divisible dimension, tiny/indivisible
+    # leaves replicated — and the weight update runs on each chip's 1/dp
+    # shard under GSPMD constraints, with the parameter all-gather placed by
+    # the partitioner. Per-chip optimizer memory drops by ~the data-parallel
+    # degree (Adam slots are ~2x params; +1x more with ema_decay) at
+    # neutral step time; numerics match the replicated update
+    # (tests/test_zero1.py pins step-for-step equivalence). Composes with
+    # grad_accum_steps, sequence_parallel, sync_batch_norm, the multi-step
+    # scan, and model_parallel (slots shard over (model, batch) jointly);
+    # mutually exclusive with pipeline_parallel, whose stage runner owns its
+    # own update placement.
+    weight_update_sharding: bool = False
     # synchronized cross-shard BatchNorm: compute BN statistics over the
     # GLOBAL batch (lax.pmean over the batch mesh axis inside flax BN)
     # instead of per shard. Default False preserves the reference's
@@ -361,6 +376,13 @@ class TrainConfig:
                 "least one microbatch per stage "
                 f"(got microbatches={self.pipeline_microbatches}, "
                 f"stages={self.pipeline_parallel})"
+            )
+        if self.weight_update_sharding and self.pipeline_parallel > 1:
+            raise ValueError(
+                "weight_update_sharding cannot combine with pipeline_parallel: "
+                "the GPipe stage runner applies its own update placement "
+                "(train/pipeline_step.py); ZeRO-1 shards the data axis the "
+                "standard and GSPMD steps own"
             )
         if self.sync_batch_norm and self.pipeline_parallel > 1:
             raise ValueError(

@@ -211,10 +211,15 @@ PRESETS: Dict[str, Preset] = {
             label_smoothing=0.1,
             weight_decay=1e-4,
             async_checkpointing=True,
+            # ZeRO-1: at dp=64 the replicated LARS momentum + master math is
+            # pure waste — shard the slots and the update across the data
+            # axis (parallel/zero.py; numerics pinned identical by
+            # tests/test_zero1.py, per-chip bytes recorded by bench.py)
+            weight_update_sharding=True,
         ),
         global_batch=8192,
         description="ResNet-50 bf16 large-batch (8k) pod config (v5e-64: 128/chip), "
-        "LARS optimizer",
+        "LARS optimizer, ZeRO-1 weight-update sharding",
     ),
 }
 

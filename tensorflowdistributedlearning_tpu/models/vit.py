@@ -46,7 +46,7 @@ from tensorflowdistributedlearning_tpu.models.layers import (  # noqa: E402
     _pallas_platform_ok as _fused_platform_ok,
 )
 
-# Sequence-length ceiling for the fused kernel. Under the 2026-08-01
+# PATCH-token ceiling for the fused kernel. Under the 2026-08-01
 # DEVICE-DOMINATED protocol (bench_kernels._chained — single-call windows
 # over the tunnel were 97%+ dispatch latency, producing the earlier
 # contradictory 0.74x-1.15x train columns) the verdict at [32,T,6,64] is:
@@ -54,6 +54,11 @@ from tensorflowdistributedlearning_tpu.models.layers import (  # noqa: E402
 # 196 and 1.14x at 1024. The gate sits at the measured ceiling — above it
 # the kernel is unmeasured, and ops/flash_attention.py's own VMEM-budget
 # fallback (_VMEM_KV_LIMIT_BYTES) already degrades oversized shapes to XLA.
+# The ceiling counts PATCH tokens: this repo's ViT pools (no cls token), so
+# its sequence length IS the patch count, and a variant that prepends
+# auxiliary tokens (cls, registers) declares them via
+# MultiHeadSelfAttention.num_prefix_tokens so a 1024-patch image does not
+# fall back to XLA one token early (ADVICE round 5).
 _FUSED_MAX_SEQ = 1024
 
 
@@ -70,6 +75,10 @@ class MultiHeadSelfAttention(nn.Module):
     spatial_axis_name: Optional[str] = None
     dtype: Optional[jnp.dtype] = None
     use_fused: bool = False
+    # auxiliary tokens prepended to the patch sequence (cls token, register
+    # tokens); excluded from the _FUSED_MAX_SEQ gate, whose ceiling was
+    # measured in patch tokens. 0 for this repo's ViT (mean-pool head).
+    num_prefix_tokens: int = 0
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -88,7 +97,11 @@ class MultiHeadSelfAttention(nn.Module):
                     stacklevel=2,
                 )
             out = ring_attention(q, k, v, axis_name=self.spatial_axis_name)
-        elif self.use_fused and t <= _FUSED_MAX_SEQ and _fused_platform_ok():
+        elif (
+            self.use_fused
+            and t - self.num_prefix_tokens <= _FUSED_MAX_SEQ
+            and _fused_platform_ok()
+        ):
             from tensorflowdistributedlearning_tpu.ops.flash_attention import (
                 flash_attention,
             )

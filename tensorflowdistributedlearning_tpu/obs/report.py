@@ -332,6 +332,20 @@ def build_report(
         ]
         if rss:
             mem["host_rss_peak_bytes"] = max(rss)
+        # exact per-device state accounting the trainers attach post-init:
+        # under weight_update_sharding the opt-state number is ~1/dp of the
+        # replicated run's — the saving the mode exists for, made visible
+        for key in ("opt_state_bytes_per_device", "params_bytes_per_device"):
+            vals = [e[key] for e in memories if key in e]
+            if vals:
+                mem[key] = vals[-1]
+        wus = [
+            e["weight_update_sharding"]
+            for e in memories
+            if "weight_update_sharding" in e
+        ]
+        if wus:
+            mem["weight_update_sharding"] = wus[-1]
         report["memory"] = mem
 
     try:
@@ -440,6 +454,12 @@ def render_report(report: Dict) -> str:
             parts.append(f"device peak {mem['device_peak_bytes'] / 2**20:.1f} MiB")
         if "host_rss_peak_bytes" in mem:
             parts.append(f"host RSS peak {mem['host_rss_peak_bytes'] / 2**20:.1f} MiB")
+        if "opt_state_bytes_per_device" in mem:
+            tag = " (ZeRO-1 sharded)" if mem.get("weight_update_sharding") else ""
+            parts.append(
+                f"opt state {mem['opt_state_bytes_per_device'] / 2**20:.1f} "
+                f"MiB/device{tag}"
+            )
         lines.append("memory: " + ", ".join(parts))
     sv = report.get("serve")
     if sv:

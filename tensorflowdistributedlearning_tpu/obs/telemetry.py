@@ -220,10 +220,15 @@ class Telemetry:
     def checkpoint_event(self, step: int, **extra) -> None:
         self._event("checkpoint", step=step, **extra)
 
-    def memory_event(self, step: Optional[int] = None) -> None:
+    def memory_event(self, step: Optional[int] = None, **extra) -> None:
         """Per-device HBM snapshot (``profiling.memory_stats``) plus host RSS —
         on backends without the device query (CPU builds) the host side still
-        makes the snapshot meaningful."""
+        makes the snapshot meaningful. ``extra`` fields ride along verbatim:
+        the trainers attach exact per-device state accounting
+        (``opt_state_bytes_per_device``/``params_bytes_per_device``, from
+        ``train.state.tree_bytes_per_device``) so the weight-update-sharding
+        saving is visible in the ledger even where the allocator query is
+        unavailable."""
         if not self.enabled:
             return
         from tensorflowdistributedlearning_tpu.utils.profiling import (
@@ -234,7 +239,7 @@ class Telemetry:
             devices = memory_stats()
         except Exception:  # noqa: BLE001 — a failed probe must not crash
             devices = {}
-        fields: Dict = {"devices": devices}
+        fields: Dict = {"devices": devices, **extra}
         rss = _host_rss_bytes()
         if rss is not None:
             fields["host_rss_bytes"] = rss

@@ -45,6 +45,11 @@ from tensorflowdistributedlearning_tpu.parallel.tensor import (
     shard_state_weight_update,
     tensor_parallel_specs,
 )
+from tensorflowdistributedlearning_tpu.parallel.zero import (
+    apply_gradients_sharded,
+    weight_update_spec,
+    weight_update_specs,
+)
 from tensorflowdistributedlearning_tpu.parallel.multihost import (
     global_shard_batch,
     initialize as initialize_multihost,
@@ -69,6 +74,9 @@ __all__ = [
     "shard_state_tensor_parallel",
     "shard_state_weight_update",
     "tensor_parallel_specs",
+    "apply_gradients_sharded",
+    "weight_update_spec",
+    "weight_update_specs",
     "initialize_multihost",
     "process_info",
     "vma_of",

@@ -172,6 +172,26 @@ def data_parallel_degree(mesh: Mesh) -> int:
     return mesh.shape[BATCH_AXIS]
 
 
+def largest_divisible_dim(
+    shape: Sequence[int], degree: int, *, taken: Optional[set] = None
+) -> Optional[int]:
+    """Index of the largest dimension of ``shape`` divisible by ``degree``,
+    skipping indices in ``taken`` (dimensions another mesh axis already
+    shards); None when nothing divides — the shared eligibility rule of the
+    ZeRO-1 weight-update specs (parallel/zero.py). Picking the LARGEST
+    divisible dimension (not a fixed one) keeps the replicated tail small:
+    a conv kernel [3, 3, C_in, C_out] shards its widest channel dim, a bias
+    [C] shards outright, and only scalars/tiny vectors stay whole."""
+    taken = taken or set()
+    best: Optional[int] = None
+    for i, d in enumerate(shape):
+        if i in taken or d % degree != 0:
+            continue
+        if best is None or d > shape[best]:
+            best = i
+    return best
+
+
 def check_accum_divisibility(
     global_batch: int, mesh: Mesh, grad_accum_steps: int
 ) -> int:

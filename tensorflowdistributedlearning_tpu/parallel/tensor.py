@@ -115,33 +115,21 @@ def shard_state_tensor_parallel(state, mesh: Mesh):
 
 
 def shard_state_weight_update(state, mesh: Mesh):
-    """Cross-replica weight-update (ZeRO-style optimizer-state) sharding: the
-    Adam moments additionally shard their channel dimension over the ``batch``
-    axis, so each data-parallel replica stores and updates only 1/dp of the
-    optimizer state — GSPMD inserts the reduce-scatter before and all-gather
-    after the update (the technique of "Automatic Cross-Replica Sharding of
-    Weight Update in Data-Parallel Training", arXiv:2004.13336, which XLA
-    implements natively on TPU). Composes with tensor parallelism: params and
-    batch stats keep their model-axis sharding, and moments shard over
-    (model, batch) together where the width divides; numerics are identical to
-    the replicated update."""
-    tp_axes = ((MODEL_AXIS, mesh.shape[MODEL_AXIS]),)
-    zero_axes = tp_axes + ((BATCH_AXIS, mesh.shape[BATCH_AXIS]),)
+    """Cross-replica weight-update (ZeRO-1 optimizer-state) sharding for the
+    GSPMD path: the optimizer state additionally shards over the ``batch``
+    axis — each data-parallel replica stores and updates only 1/dp of it —
+    the technique of "Automatic Cross-Replica Sharding of Weight Update in
+    Data-Parallel Training" (arXiv:2004.13336), which XLA implements natively
+    on TPU. Delegates to ``parallel/zero.py`` (the canonical spec machinery
+    shared with the shard_map trainers): params and batch stats keep their
+    model-axis sharding, optimizer leaves shard along the batch axis on their
+    largest divisible free dimension; numerics are identical to the
+    replicated update. Pair with
+    ``make_train_step_gspmd(weight_update_sharding=True)`` so the update
+    itself runs under the matching constraints."""
+    from tensorflowdistributedlearning_tpu.parallel import zero as zero_lib
 
-    def place(tree, axes):
-        return jax.tree.map(
-            lambda x: _place_full_value(
-                x, NamedSharding(mesh, _spec_for_leaf(x, axes))
-            ),
-            tree,
-        )
-
-    return state.replace(
-        step=_place_full_value(state.step, NamedSharding(mesh, P())),
-        params=place(state.params, tp_axes),
-        batch_stats=place(state.batch_stats, tp_axes),
-        opt_state=place(state.opt_state, zero_axes),
-    )
+    return zero_lib.shard_state_weight_update(state, mesh, tensor_parallel=True)
 
 
 def make_train_step_gspmd(
@@ -149,6 +137,7 @@ def make_train_step_gspmd(
     task,
     *,
     donate: bool = True,
+    weight_update_sharding: bool = False,
 ) -> Callable:
     """jit (auto-SPMD) train step for meshes with a ``model`` axis degree > 1.
 
@@ -165,12 +154,19 @@ def make_train_step_gspmd(
       tensors), not per data-parallel shard — mathematically the synced-BN
       variant; use the shard_map step when exact per-tower BN parity with the
       reference is required.
+
+    ``weight_update_sharding=True`` runs the optimizer update under ZeRO-1
+    sharding constraints (``parallel/zero.py``): pass state placed with
+    ``shard_state_weight_update`` so the optimizer leaves arrive (and leave,
+    and are checkpointed) sharded over the data axis.
     """
-    return _make_train_step_gspmd_cached(mesh, task, donate)
+    return _make_train_step_gspmd_cached(mesh, task, donate, weight_update_sharding)
 
 
 @functools.lru_cache(maxsize=None)
-def _make_train_step_gspmd_cached(mesh: Mesh, task, donate: bool) -> Callable:
+def _make_train_step_gspmd_cached(
+    mesh: Mesh, task, donate: bool, weight_update_sharding: bool = False
+) -> Callable:
     def step(state, batch: Dict[str, jax.Array]):
         def loss_fn(params):
             outputs, mutated = state.apply_fn(
@@ -189,7 +185,14 @@ def _make_train_step_gspmd_cached(mesh: Mesh, task, donate: bool) -> Callable:
         (loss, (outputs, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params)
-        new_state = state.apply_gradients(grads, new_stats)
+        if weight_update_sharding:
+            from tensorflowdistributedlearning_tpu.parallel import zero as zero_lib
+
+            new_state = zero_lib.apply_gradients_sharded(
+                state, grads, new_stats, mesh, tensor_parallel=True
+            )
+        else:
+            new_state = state.apply_gradients(grads, new_stats)
 
         from tensorflowdistributedlearning_tpu.ops import metrics as metrics_lib
 

@@ -70,6 +70,10 @@ def _save_pytree(state: TrainState, *, to_host: bool) -> Dict:
     device-to-host transfer async checkpointing exists to overlap)."""
     tree = _state_pytree(state)
     if to_host and jax.process_count() == 1:
+        # device_get assembles every leaf FULLY regardless of its sharding
+        # (ZeRO-sharded opt_state leaves included), so the on-disk layout is
+        # placement-independent — what makes a replicated checkpoint
+        # restorable into weight_update_sharding mode and vice versa
         return jax.device_get(tree)
     return tree
 
@@ -302,6 +306,12 @@ class CheckpointManager:
     # -- shared -----------------------------------------------------------
 
     def _restore(self, manager: ocp.CheckpointManager, step: int, template: TrainState) -> TrainState:
+        # the abstract tree keeps each template leaf's SHARDING (not just
+        # shape/dtype), so orbax places every restored leaf straight into the
+        # template's layout — a checkpoint written replicated restores into a
+        # ZeRO-sharded template (opt_state landing 1/dp per chip) and a
+        # sharded-run checkpoint restores into a replicated template, the
+        # cross-mode resume contract tests/test_zero1.py pins both ways
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, _state_pytree(template))
         try:
             # transient filesystem faults retry; persistent corruption

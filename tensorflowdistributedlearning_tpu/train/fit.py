@@ -36,6 +36,7 @@ from tensorflowdistributedlearning_tpu.parallel import mesh as mesh_lib
 from tensorflowdistributedlearning_tpu.parallel import multihost
 from tensorflowdistributedlearning_tpu.resilience import faults as faults_lib
 from tensorflowdistributedlearning_tpu.resilience import preempt as preempt_lib
+from tensorflowdistributedlearning_tpu.train import state as state_lib
 from tensorflowdistributedlearning_tpu.train import step as step_lib
 from tensorflowdistributedlearning_tpu.train.checkpoint import CheckpointManager
 from tensorflowdistributedlearning_tpu.train.state import TrainState, create_train_state
@@ -391,7 +392,15 @@ class ClassifierTrainer:
         tcfg = self.train_config
         tel = self._telemetry
         state = self._init_state()
-        tel.memory_event()  # post-init: the params/optimizer footprint
+        # post-init: the params/optimizer footprint, with exact per-device
+        # opt-state accounting (1/dp of it under weight_update_sharding)
+        tel.memory_event(
+            params_bytes_per_device=state_lib.tree_bytes_per_device(state.params),
+            opt_state_bytes_per_device=state_lib.tree_bytes_per_device(
+                state.opt_state
+            ),
+            weight_update_sharding=tcfg.weight_update_sharding,
+        )
         ckpt = self._checkpointer()
         state = ckpt.restore_latest(state)
         start_step = int(jax.device_get(state.step))
@@ -412,7 +421,11 @@ class ClassifierTrainer:
         if self._tp:
             from tensorflowdistributedlearning_tpu.parallel import tensor as tp_lib
 
-            train_step = tp_lib.make_train_step_gspmd(self.mesh, self.task)
+            train_step = tp_lib.make_train_step_gspmd(
+                self.mesh,
+                self.task,
+                weight_update_sharding=tcfg.weight_update_sharding,
+            )
         elif self._pp:
             from tensorflowdistributedlearning_tpu.train import pipeline_step as pp_lib
 
@@ -428,6 +441,7 @@ class ClassifierTrainer:
                 spatial=self._spatial,
                 accum=self.train_config.grad_accum_steps,
                 seed=self.train_config.seed,
+                weight_update_sharding=tcfg.weight_update_sharding,
             )
         is_main = jax.process_index() == 0
         tb_train = SummaryWriter(os.path.join(self.model_dir, "train")) if is_main else None
@@ -561,6 +575,15 @@ class ClassifierTrainer:
             # not the plain init twin
             state = state.replace(apply_fn=self.model.apply)
         self._n_params = count_params(state.params)
+        if self.train_config.weight_update_sharding:
+            from tensorflowdistributedlearning_tpu.parallel import zero as zero_lib
+
+            # opt_state 1/dp over the data axis; params/batch_stats keep
+            # their canonical layout (channel-sharded under TP, where the
+            # optimizer leaves shard over (model, batch) jointly)
+            return zero_lib.shard_state_weight_update(
+                state, self.mesh, tensor_parallel=self._tp
+            )
         if self._tp:
             from tensorflowdistributedlearning_tpu.parallel import tensor as tp_lib
 
@@ -575,8 +598,11 @@ class ClassifierTrainer:
         on noise; that case evaluates one pass over the train records instead."""
         tcfg = self.train_config
         # evaluate the EMA view when one is tracked (TrainConfig.ema_decay>0) —
-        # the same params best-export stores, so selection and serving agree
-        state = step_lib.with_ema_params(state)
+        # the same params best-export stores, so selection and serving agree —
+        # then drop the optimizer state: eval reads params/batch_stats only,
+        # and under weight_update_sharding the data-axis-sharded moments would
+        # otherwise be all-gathered into the eval executable for nothing
+        state = step_lib.with_ema_params(state).replace(opt_state=None)
         local_bs = multihost.per_process_batch_size(batch_size)
         val_folder = self._open_split("val")
         eval_records = self._open_records("val")
@@ -807,6 +833,7 @@ def fit_preset(
     pipeline_parallel: int = 1,
     pipeline_microbatches: Optional[int] = None,
     expert_parallel: int = 1,
+    weight_update_sharding: Optional[bool] = None,
     optimizer: Optional[str] = None,
     lr: Optional[float] = None,
     eval_holdout_fraction: Optional[float] = None,
@@ -841,6 +868,7 @@ def fit_preset(
         or pipeline_parallel != 1
         or pipeline_microbatches is not None
         or expert_parallel != 1
+        or weight_update_sharding is not None
         or optimizer is not None
         or lr is not None
         or eval_holdout_fraction is not None
@@ -861,6 +889,11 @@ def fit_preset(
                 else train_cfg.pipeline_microbatches
             ),
             expert_parallel=expert_parallel,
+            weight_update_sharding=(
+                weight_update_sharding
+                if weight_update_sharding is not None
+                else train_cfg.weight_update_sharding
+            ),
             optimizer=optimizer or train_cfg.optimizer,
             lr=lr if lr is not None else train_cfg.lr,
             eval_holdout_fraction=(

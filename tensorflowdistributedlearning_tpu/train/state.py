@@ -12,6 +12,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax import core, struct
 
@@ -58,3 +59,22 @@ def create_train_state(
         apply_fn=model.apply,
         tx=tx,
     )
+
+
+def tree_bytes_per_device(tree: Any) -> int:
+    """Bytes ONE device holds for a placed pytree: each leaf counts its shard
+    (``sharding.shard_shape``), so a replicated leaf counts full size and a
+    ZeRO-sharded optimizer moment counts 1/dp — the number the weight-update
+    sharding mode exists to shrink, reported by the trainers' memory events
+    and bench.py so the saving is measured, not asserted. Host numpy leaves
+    (and ShapeDtypeStructs without a sharding) count full size."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            continue
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and hasattr(sharding, "shard_shape"):
+            shape = sharding.shard_shape(tuple(shape))
+        total += int(np.prod(shape)) * np.dtype(leaf.dtype).itemsize
+    return total

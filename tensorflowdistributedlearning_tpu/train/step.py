@@ -409,6 +409,28 @@ def merge_metrics(acc: Optional[Metrics], new: Metrics) -> Metrics:
     return {k: acc[k].merge(v) for k, v in new.items()}
 
 
+def _merge_stacked_metrics(stacked: Metrics) -> Metrics:
+    """Merge metric pytrees stacked on a leading axis (a scan's per-iteration
+    outputs — the accumulation microbatch loop and the multi-step loop both
+    produce one) into a single stream by summing over that axis.
+
+    Summation IS the K-way merge only because every leaf is a ``Mean`` state
+    (``Mean.merge`` is addition of total/count). A non-additive metric leaf
+    slipping into a scanned step would be silently mis-merged by a blind
+    ``jnp.sum`` — fail loudly instead, naming the offender, so whoever adds
+    such a metric also adds its merge path here (the ONE place both scan
+    paths share)."""
+    for name, leaf in stacked.items():
+        if not isinstance(leaf, metrics_lib.Mean):
+            raise TypeError(
+                f"stacked per-step metric {name!r} is a "
+                f"{type(leaf).__name__}, not a Mean state — summing over the "
+                "step axis is only a valid merge for Mean's (total, count); "
+                "teach _merge_stacked_metrics this type before scanning it"
+            )
+    return jax.tree.map(lambda x: jnp.sum(x, axis=0), stacked)
+
+
 def compute_metrics(acc: Metrics) -> Dict[str, float]:
     return {k: float(v.compute()) for k, v in acc.items()}
 
@@ -437,6 +459,7 @@ def make_train_step(
     accum: int = 1,
     seed: int = 0,
     auto_model: bool = False,
+    weight_update_sharding: bool = False,
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Metrics]]:
     """Build the jitted SPMD train step.
 
@@ -481,10 +504,23 @@ def make_train_step(
     partitioner to derive its all-reduces. Pass state through
     ``shard_state_tensor_parallel`` and GSPMD partitions the channel math
     inside each manual shard — the dp x tp x sp layout real pods run.
+
+    ``weight_update_sharding=True`` is the ZeRO-1 mode (arXiv:2004.13336,
+    parallel/zero.py): the forward/backward still runs under the manual
+    shard_map (per-tower BN, explicit collectives — semantics unchanged), but
+    the shard_map returns (grads, batch_stats, metrics) and the OPTIMIZER
+    UPDATE moves outside it, under GSPMD sharding constraints that keep every
+    optimizer-state leaf sharded along the ``batch`` mesh axis on its largest
+    divisible dimension. Each chip then stores and updates 1/dp of the
+    Adam/LARS/EMA slots; the parameter all-gather falls out of constraining
+    the updated params back to replicated. Pass state placed with
+    ``parallel.zero.shard_state_weight_update``. Composes with ``accum``,
+    ``spatial``, the multi-step scan, and ``auto_model`` tensor parallelism
+    (slots shard over (model, batch) jointly).
     """
     return _make_train_step_cached(
         mesh, task, weight_decay, apply_weight_decay, donate, spatial, accum,
-        seed, auto_model,
+        seed, auto_model, weight_update_sharding,
     )
 
 
@@ -499,8 +535,14 @@ def _make_train_step_cached(
     accum: int = 1,
     seed: int = 0,
     auto_model: bool = False,
+    weight_update_sharding: bool = False,
 ):
-    def step(state: TrainState, batch: Dict[str, jax.Array]):
+    def forward_backward(state: TrainState, batch: Dict[str, jax.Array]):
+        """Per-shard forward/backward inside the manual region: returns the
+        globally-meaned grads, the replicated new BN stats, and the psum'd
+        metric deltas — everything the optimizer update needs, with the
+        update itself left to the caller (inside the shard_map for the
+        replicated update, outside under GSPMD for ZeRO-1)."""
         # Deterministic per-(step, batch-shard) dropout stream for the models
         # that have a stochastic layer (Xception41's pre-logits dropout — the
         # reference declared keep_prob but never used it; here it is live, so
@@ -592,9 +634,8 @@ def _make_train_step_cached(
             (new_batch_stats, grads), stacked = jax.lax.scan(
                 body, init, (chunks, jnp.arange(accum))
             )
-            # stacked Mean states carry a leading [accum] dim on total/count;
-            # summing merges the streams (Mean.merge is addition)
-            metrics = jax.tree.map(lambda x: jnp.sum(x, axis=0), stacked)
+            # stacked Mean states carry a leading [accum] dim on total/count
+            metrics = _merge_stacked_metrics(stacked)
 
         # MirroredStrategy's gradient MEAN across towers. Under shard_map's
         # varying-manual-axes tracking, autodiff of replicated params already
@@ -609,22 +650,53 @@ def _make_train_step_cached(
         # required either way so the stored stats leave the shard_map unvarying)
         new_batch_stats = jax.lax.pmean(new_batch_stats, BATCH_AXIS)
         new_batch_stats = jax.lax.pmean(new_batch_stats, SEQUENCE_AXIS)
+        return grads, new_batch_stats, _psum_metrics(metrics)
 
-        new_state = state.apply_gradients(grads, new_batch_stats)
-        return new_state, _psum_metrics(metrics)
+    def step(state: TrainState, batch: Dict[str, jax.Array]):
+        grads, new_batch_stats, metrics = forward_backward(state, batch)
+        return state.apply_gradients(grads, new_batch_stats), metrics
 
     # hybrid mode: only (batch, sequence) are manual axes; the model axis is
     # left to the SPMD partitioner, so channel-sharded params (GSPMD tensor
     # parallelism) keep their sharding through the specs below, which describe
     # manual axes only
-    sharded = jax.shard_map(
-        step,
+    batch_specs = _batch_in_specs(spatial, ("images", "labels"))
+    if not weight_update_sharding:
+        sharded = jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P(), batch_specs),
+            out_specs=(P(), P()),
+            **_hybrid_kwargs(auto_model),
+        )
+        return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+    # ZeRO-1: the manual region ends at (grads, stats, metrics) — all
+    # unvarying, so they leave replicated — and the optimizer update runs in
+    # the enclosing jit under GSPMD constraints that shard every slot (and
+    # its 1/dp of the update math) along the batch axis. opt_state never
+    # enters the shard_map (the gradient computation does not read it), so
+    # its data-axis sharding is invisible to the manual region.
+    from tensorflowdistributedlearning_tpu.parallel import zero as zero_lib
+
+    sharded_grads = jax.shard_map(
+        forward_backward,
         mesh=mesh,
-        in_specs=(P(), _batch_in_specs(spatial, ("images", "labels"))),
-        out_specs=(P(), P()),
+        in_specs=(P(), batch_specs),
+        out_specs=(P(), P(), P()),
         **_hybrid_kwargs(auto_model),
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+    def zero_step(state: TrainState, batch: Dict[str, jax.Array]):
+        grads, new_batch_stats, metrics = sharded_grads(
+            state.replace(opt_state=None), batch
+        )
+        new_state = zero_lib.apply_gradients_sharded(
+            state, grads, new_batch_stats, mesh, tensor_parallel=auto_model
+        )
+        return new_state, metrics
+
+    return jax.jit(zero_step, donate_argnums=(0,) if donate else ())
 
 
 def make_multi_train_step(
@@ -638,6 +710,7 @@ def make_multi_train_step(
     accum: int = 1,
     seed: int = 0,
     auto_model: bool = False,
+    weight_update_sharding: bool = False,
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Any]:
     """Device-side training loop: ONE dispatch runs ``n_steps`` train steps
     under ``lax.scan``, the way the reference's Estimator ran many steps per
@@ -680,6 +753,9 @@ def make_multi_train_step(
         accum=accum,
         seed=seed,
         auto_model=auto_model,
+        # the zero step's sharding constraints ride inside the scan body, so
+        # the carried opt_state stays data-axis sharded across all n_steps
+        weight_update_sharding=weight_update_sharding,
     )
     return _make_multi_train_step_cached(single, n_steps)
 
@@ -689,9 +765,8 @@ def _make_multi_train_step_cached(single, n_steps: int):
     def multi(state: TrainState, batches: Dict[str, jax.Array]):
         # `single` already has scan's (carry, x) -> (carry, y) signature
         final, stacked = jax.lax.scan(single, state, batches, length=n_steps)
-        # stacked Mean states carry a leading [n_steps] dim; summing merges
-        # the per-step streams (Mean.merge is addition of total/count)
-        return final, jax.tree.map(lambda x: jnp.sum(x, axis=0), stacked)
+        # stacked Mean states carry a leading [n_steps] dim
+        return final, _merge_stacked_metrics(stacked)
 
     return jax.jit(multi, donate_argnums=(0,))
 

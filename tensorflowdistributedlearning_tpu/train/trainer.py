@@ -46,6 +46,7 @@ from tensorflowdistributedlearning_tpu.parallel import mesh as mesh_lib
 from tensorflowdistributedlearning_tpu.parallel import multihost
 from tensorflowdistributedlearning_tpu.resilience import faults as faults_lib
 from tensorflowdistributedlearning_tpu.resilience import preempt as preempt_lib
+from tensorflowdistributedlearning_tpu.train import state as state_lib
 from tensorflowdistributedlearning_tpu.train import step as step_lib
 from tensorflowdistributedlearning_tpu.train.checkpoint import CheckpointManager
 from tensorflowdistributedlearning_tpu.train.state import TrainState, create_train_state
@@ -230,6 +231,16 @@ class Trainer:
             # convs / sync-BN pmean), not the plain init twin
             state = state.replace(apply_fn=self.model.apply)
         self._n_params = count_params(state.params)
+        if tcfg.weight_update_sharding:
+            from tensorflowdistributedlearning_tpu.parallel import zero as zero_lib
+
+            # opt_state 1/dp over the data axis; params/batch_stats keep
+            # their canonical layout (channel-sharded under TP, where the
+            # optimizer leaves shard over (model, batch) jointly and the
+            # hybrid auto-model step constrains params back each step)
+            return zero_lib.shard_state_weight_update(
+                state, self.mesh, tensor_parallel=self._tp
+            )
         if self._tp:
             from tensorflowdistributedlearning_tpu.parallel import tensor as tp_lib
 
@@ -340,7 +351,15 @@ class Trainer:
 
         ckpt = self._checkpointer(fold)
         state = ckpt.restore_latest(self._init_state())
-        self._telemetry.memory_event()  # post-init params/optimizer footprint
+        # post-init params/optimizer footprint, with exact per-device
+        # opt-state accounting (1/dp of it under weight_update_sharding)
+        self._telemetry.memory_event(
+            params_bytes_per_device=state_lib.tree_bytes_per_device(state.params),
+            opt_state_bytes_per_device=state_lib.tree_bytes_per_device(
+                state.opt_state
+            ),
+            weight_update_sharding=tcfg.weight_update_sharding,
+        )
         start_step = int(jax.device_get(state.step))
         if start_step >= steps:
             logger.info("fold %d already trained to step %d", fold, start_step)
@@ -363,6 +382,7 @@ class Trainer:
             accum=self.train_config.grad_accum_steps,
             seed=self.train_config.seed,
             auto_model=self._tp,
+            weight_update_sharding=tcfg.weight_update_sharding,
         )
         prepare = self._make_prepare_train(fold)
 
@@ -532,8 +552,11 @@ class Trainer:
         size) pins the step count so every process runs the same number of
         collective-bearing steps."""
         mesh_lib.local_batch_size(batch_size, self.mesh)  # fail fast, clear message
-        # evaluate the EMA view when one is tracked (TrainConfig.ema_decay>0)
-        state = step_lib.with_ema_params(state)
+        # evaluate the EMA view when one is tracked (TrainConfig.ema_decay>0),
+        # then drop the optimizer state: eval reads params/batch_stats only,
+        # and under weight_update_sharding the data-axis-sharded moments would
+        # otherwise be all-gathered into the eval executable for nothing
+        state = step_lib.with_ema_params(state).replace(opt_state=None)
         local_bs = multihost.per_process_batch_size(batch_size)
         num = multihost.eval_num_batches(
             global_n if global_n is not None else len(eval_ds), local_bs

@@ -234,12 +234,17 @@ def op_breakdown(
         # 2026-08-01 v5e capture it summed to 7x the wall). A substring
         # match would fold both and invent a giant copy bucket, so whenever
         # the requested filter names an existing line EXACTLY — auto-selected
-        # or user-supplied — only that line contributes; and the auto-select
-        # additionally never folds Async timelines even when no exact name
-        # matches (a plane with ONLY 'Async XLA Ops' contributes nothing
-        # rather than corrupting every fraction).
+        # or user-supplied — only that line contributes; and in substring
+        # mode Async timelines are skipped outright — auto-selected OR
+        # user-supplied (a user filter like "XLA" or "Ops" must not fold the
+        # overlapping async spans in through the side door) — UNLESS the
+        # user's filter itself names Async, which is the one way to opt into
+        # aggregating those spans deliberately.
         exact_only = effective_filter is not None and any(
             line == effective_filter for line in lines
+        )
+        skip_async = auto_selected or (
+            effective_filter is not None and "Async" not in effective_filter
         )
         for line_name, line_agg in lines.items():
             if exact_only:
@@ -247,7 +252,7 @@ def op_breakdown(
                     continue
             elif effective_filter and effective_filter not in line_name:
                 continue
-            elif auto_selected and "Async" in line_name:
+            elif skip_async and "Async" in line_name:
                 continue
             for op, (ms, cnt) in line_agg.items():
                 entry = agg.setdefault(op, [0.0, 0])

@@ -157,6 +157,55 @@ def test_fused_attention_seq_gate(monkeypatch):
     model.apply(variables, x, train=False)  # must not raise
 
 
+def test_fused_seq_gate_counts_patch_tokens_not_prefix(monkeypatch):
+    """The _FUSED_MAX_SEQ ceiling was measured in PATCH tokens: a model that
+    prepends auxiliary tokens (cls/registers) declares them via
+    ``num_prefix_tokens`` so a ceiling-sized patch grid does not fall back to
+    XLA one token early (ADVICE round 5). This repo's ViT pools (no cls), so
+    its sequence length IS the patch count — pinned by the t == gate case."""
+    import tensorflowdistributedlearning_tpu.models.vit as vit_mod
+
+    monkeypatch.setattr(vit_mod, "_fused_platform_ok", lambda: True)
+    monkeypatch.setattr(vit_mod, "_FUSED_MAX_SEQ", 16)
+    calls = []
+
+    def _count(q, k, v):
+        calls.append(q.shape)
+        from tensorflowdistributedlearning_tpu.parallel.ring_attention import (
+            attention_reference,
+        )
+
+        return attention_reference(q, k, v)
+
+    import tensorflowdistributedlearning_tpu.ops.flash_attention as fa
+
+    monkeypatch.setattr(fa, "flash_attention", _count)
+
+    rng = np.random.default_rng(8)
+    x = jnp.asarray(rng.normal(0, 1, (2, 16, 32)), jnp.float32)  # t == gate
+    attn = vit_mod.MultiHeadSelfAttention(32, 4, use_fused=True)
+    variables = attn.init(jax.random.PRNGKey(0), x)
+    calls.clear()  # init traced __call__ once too
+    attn.apply(variables, x)
+    assert len(calls) == 1  # t == ceiling dispatches (inclusive gate)
+
+    # 16 patches + 1 prefix token: still within the PATCH ceiling
+    x17 = jnp.asarray(rng.normal(0, 1, (2, 17, 32)), jnp.float32)
+    attn_prefix = vit_mod.MultiHeadSelfAttention(
+        32, 4, use_fused=True, num_prefix_tokens=1
+    )
+    v17 = attn_prefix.init(jax.random.PRNGKey(0), x17)
+    calls.clear()
+    attn_prefix.apply(v17, x17)
+    assert len(calls) == 1  # the prefix token did not push it over
+
+    # but 17 PATCH tokens (no prefix) is genuinely above the ceiling
+    attn17 = vit_mod.MultiHeadSelfAttention(32, 4, use_fused=True)
+    calls.clear()
+    attn17.apply(v17, x17)
+    assert calls == []  # fell back to XLA
+
+
 def test_tpu_vit_presets_carry_the_measured_flip():
     """The attention verdict lives in the presets: ViT-family TPU presets
     ship with use_fused_attention=True (train-step tie, long-seq forward win

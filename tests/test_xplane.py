@@ -185,3 +185,31 @@ def test_host_planes_survive_unfiltered_aggregation(tmp_path):
 def test_missing_logdir_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         xplane.op_breakdown(str(tmp_path))
+
+
+def test_user_substring_filter_skips_async_lines(tmp_path):
+    """A user-supplied line_filter that substring-matches BOTH the op line and
+    the overlapping 'Async XLA Ops' line (e.g. --line Ops) must not fold the
+    async copy spans in through the side door — they overlap compute and
+    corrupt every fraction (ADVICE round 5). Naming Async explicitly is the
+    deliberate opt-in that still aggregates them."""
+    logdir = make_xspace(
+        tmp_path,
+        lines={
+            "XLA Ops": [("convolution.1", 8_000_000, 10)],
+            "Async XLA Ops": [("copy-start.5", 56_000_000, 40)],
+        },
+    )
+    # substring filter matching both lines: async skipped
+    rows = xplane.op_breakdown(logdir, line_filter="Ops")
+    assert [r.name for r in rows] == ["convolution.1"]
+    assert rows[0].fraction == pytest.approx(1.0)
+    # a filter that matches ONLY the async line: still skipped (it does not
+    # name Async, so the user has not opted into overlap-corrupted sums)
+    assert xplane.op_breakdown(logdir, line_filter="nc XLA") == []
+    # naming Async explicitly is the opt-in
+    async_rows = xplane.op_breakdown(logdir, line_filter="Async")
+    assert [r.name for r in async_rows] == ["copy-start.5"]
+    # exact-name behavior is unchanged
+    exact = xplane.op_breakdown(logdir, line_filter="XLA Ops")
+    assert [r.name for r in exact] == ["convolution.1"]
