@@ -458,6 +458,15 @@ def run_benchmark(platform: str | None = None) -> dict:
             result["weight_update_sharding"] = {"error": str(e)[:200]}
         print(json.dumps(result), flush=True)
 
+        # Sync-vs-async host loop on the same mesh: step time A/B plus the
+        # per-window blocked-on-fetch split (also standalone:
+        # `python bench.py --async-loop`, committed as BENCH_ASYNC.json).
+        try:
+            result["async_host_loop"] = bench_async_loop(mesh, n)
+        except Exception as e:  # noqa: BLE001
+            result["async_host_loop"] = {"error": str(e)[:200]}
+        print(json.dumps(result), flush=True)
+
     return result
 
 
@@ -707,6 +716,201 @@ def bench_weight_update_sharding(mesh=None, n: int | None = None) -> dict:
     return result
 
 
+def bench_async_loop(
+    mesh=None, n: int | None = None, check: bool = False,
+    max_ratio: float = 1.05,
+) -> dict:
+    """Sync-vs-async host loop A/B (``TrainConfig.dispatch_ahead_steps``).
+
+    Runs the SAME compiled train step through the real host-overlap machinery
+    (``train/async_loop.HostOverlap``) twice — ``dispatch_ahead=0`` (the
+    legacy loop: a blocking ``device_get`` per log window) vs the default
+    budget of 2 (deferred window fetch + bounded dispatch-ahead) — with
+    best-of-N timing per mode, the per-window host-blocked-on-fetch ms read
+    back from each run's own telemetry ledger, and a bitwise comparison of
+    the final params (the overlap layer must not change a single ULP).
+
+    ``check`` gates the result (CI's regression tripwire): async step time
+    must be <= ``max_ratio`` x sync (default 1.05; CI passes a looser bound
+    via ``--max-ratio`` — shared runners have wall-clock noise a best-of-N
+    cannot fully absorb, and the bound only needs to catch a serialization
+    regression, which lands far above any noise) and the params must match
+    exactly; the verdict is recorded as ``check_passed`` and ``main`` exits
+    non-zero on failure.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+    from flax.core import unfreeze
+
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.models import build_model
+    from tensorflowdistributedlearning_tpu.obs.ledger import LEDGER_FILENAME
+    from tensorflowdistributedlearning_tpu.obs.telemetry import (
+        SPAN_STEP,
+        Telemetry,
+    )
+    from tensorflowdistributedlearning_tpu.parallel.mesh import (
+        BATCH_AXIS,
+        make_mesh,
+        replicate,
+        shard_batch,
+    )
+    from tensorflowdistributedlearning_tpu.train import async_loop
+    from tensorflowdistributedlearning_tpu.train.state import create_train_state
+    from tensorflowdistributedlearning_tpu.train.step import (
+        ClassificationTask,
+        make_optimizer,
+        make_train_step,
+    )
+
+    if mesh is None:
+        mesh = make_mesh(n)
+    n = n or len(jax.devices())
+    dp = int(mesh.shape[BATCH_AXIS])
+    on_tpu = jax.devices()[0].platform == "tpu"
+
+    if on_tpu:
+        mcfg = ModelConfig(
+            backbone="vit", num_classes=1000, input_shape=(224, 224),
+            input_channels=3, patch_size=16, embed_dim=384, vit_layers=12,
+            num_heads=6, output_stride=None,
+        )
+        per_chip, steps, log_every, trials = 64, 60, 10, 3
+    else:
+        # same smoke scale as the ZeRO-1 A/B: big enough that a step is real
+        # device work the host can (or can't) hide behind, small enough for
+        # the CI box
+        mcfg = ModelConfig(
+            backbone="vit", num_classes=10, input_shape=(32, 32),
+            input_channels=3, patch_size=8, embed_dim=256, vit_layers=4,
+            num_heads=4, output_stride=None,
+        )
+        per_chip, steps, log_every, trials = 4, 30, 5, 3
+    tcfg = TrainConfig(optimizer="adam", lr=1e-3)
+    model = build_model(mcfg)
+    tx = make_optimizer(tcfg)
+    rng = jax.random.PRNGKey(0)
+    sample = np.zeros((1, *mcfg.input_shape, mcfg.input_channels), np.float32)
+    gb = per_chip * dp
+    gen = np.random.default_rng(0)
+    # a few DISTINCT pre-placed batches, cycled: input cost off the clock (the
+    # prefetcher owns that trade), but the metric stream still varies per step
+    placed = [
+        shard_batch(
+            {
+                "images": gen.normal(
+                    0, 1, (gb, *mcfg.input_shape, mcfg.input_channels)
+                ).astype(np.float32),
+                "labels": gen.integers(0, mcfg.num_classes, gb).astype(np.int32),
+            },
+            mesh,
+        )
+        for _ in range(4)
+    ]
+    state0 = create_train_state(model, tx, rng, sample)
+    state0 = replicate(state0.replace(batch_stats=unfreeze(state0.batch_stats)), mesh)
+    # donate=False: state0 is reused across trials and modes
+    step = make_train_step(mesh, ClassificationTask(), donate=False)
+    comp = step.lower(state0, placed[0]).compile()
+    s = state0
+    for i in range(3):  # warm the executable + allocator before any clock
+        s, m = comp(s, placed[i % len(placed)])
+    jax.block_until_ready(m)
+
+    def run(budget: int) -> tuple:
+        """One mode: best-of-``trials`` full loops from the same init, each
+        under its own telemetry workdir; returns (final_state, section)."""
+        dts, fetch_ms = [], []
+        final = None
+        for _ in range(trials):
+            workdir = tempfile.mkdtemp(prefix="bench_async_")
+            tel = Telemetry(
+                workdir,
+                run_info={"bench": "async_loop", "dispatch_ahead": budget},
+                memory_every_windows=10**6,  # no memory probes on the clock
+            )
+            overlap = async_loop.HostOverlap(
+                tel,
+                dispatch_ahead=budget,
+                emit=lambda rec, scalars: tel.window_event(
+                    rec.step,
+                    steps=rec.steps,
+                    scalars=scalars,
+                    dirty=rec.dirty,
+                    samples=rec.samples,
+                ),
+            )
+            st = state0
+            t0 = time.perf_counter()
+            for i in range(steps):
+                with tel.span(SPAN_STEP):
+                    st, metrics = comp(st, placed[i % len(placed)])
+                overlap.track(metrics)
+                if (i + 1) % log_every == 0:
+                    overlap.window(
+                        async_loop.PendingWindow(
+                            step=i + 1, metrics=metrics, steps=log_every,
+                            lr=float(tcfg.lr),
+                        )
+                    )
+            overlap.flush()
+            jax.block_until_ready(st.params)
+            dts.append(time.perf_counter() - t0)
+            tel.close(steps=steps)
+            waits = []
+            try:
+                with open(os.path.join(workdir, LEDGER_FILENAME)) as f:
+                    for line in f:
+                        ev = json.loads(line)
+                        if ev.get("event") == "step_window":
+                            waits.append(ev.get("fetch_wait_s", 0.0) * 1000)
+            finally:
+                shutil.rmtree(workdir, ignore_errors=True)
+            fetch_ms.append(waits)
+            final = st
+        best = min(range(trials), key=lambda t: dts[t])
+        waits = fetch_ms[best]
+        return final, {
+            "step_time_ms": round(dts[best] / steps * 1000, 3),
+            "loop_time_s": round(dts[best], 3),
+            "windows": len(waits),
+            "fetch_wait_ms_per_window": {
+                "mean": round(sum(waits) / len(waits), 3) if waits else 0.0,
+                "max": round(max(waits), 3) if waits else 0.0,
+            },
+        }
+
+    s_sync, sync = run(0)
+    s_async, rasync = run(2)
+    identical = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree.leaves(jax.device_get(s_sync.params)),
+            jax.tree.leaves(jax.device_get(s_async.params)),
+        )
+    )
+    ratio = rasync["step_time_ms"] / max(sync["step_time_ms"], 1e-9)
+    result = {
+        "data_parallel": dp,
+        "model": "vit_s16_imagenet_shape" if on_tpu else "vit_cpu_smoke",
+        "global_batch": gb,
+        "timed_steps": steps,
+        "log_every_steps": log_every,
+        "trials": trials,
+        "sync": sync,
+        "async": rasync,
+        "step_time_ratio_async_over_sync": round(ratio, 3),
+        "final_params_bit_identical": identical,
+    }
+    if check:
+        result["check"] = {"max_ratio": max_ratio}
+        result["check_passed"] = bool(identical and ratio <= max_ratio)
+    return result
+
+
 def _run_child(platform: str, timeout: int) -> dict | None:
     args = [sys.executable, os.path.abspath(__file__), "--child"]
     if platform == "cpu":
@@ -817,23 +1021,45 @@ def _load_tpu_cache() -> dict | None:
         return None
 
 
+def _force_host_devices() -> None:
+    """8-device host platform for the standalone A/B modes: a dp=1 run is a
+    vacuous A/B on CPU, and the env var is inert when a real TPU answers
+    (the flag only shapes the host platform; the backend initializes lazily
+    at the first device query)."""
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
 def main() -> None:
+    if "--async-loop" in sys.argv:
+        # Standalone sync-vs-async host loop A/B (committed as
+        # BENCH_ASYNC.json); --check turns it into a pass/fail gate.
+        _force_host_devices()
+        import jax
+
+        if "--platform=cpu" in sys.argv:
+            jax.config.update("jax_platforms", "cpu")
+        check = "--check" in sys.argv
+        max_ratio = 1.05
+        if "--max-ratio" in sys.argv:
+            max_ratio = float(sys.argv[sys.argv.index("--max-ratio") + 1])
+        out = bench_async_loop(check=check, max_ratio=max_ratio)
+        out["platform"] = jax.devices()[0].platform
+        out["device_kind"] = getattr(jax.devices()[0], "device_kind", "unknown")
+        print(json.dumps(out), flush=True)
+        if check and not out.get("check_passed"):
+            sys.exit(1)
+        return
     if "--zero1" in sys.argv:
         # Standalone ZeRO-1 section on whatever platform answers (committed
         # as BENCH_ZERO1.json; the TPU supervisor path also embeds it in the
         # full run as result["weight_update_sharding"]).
-        if "xla_force_host_platform_device_count" not in os.environ.get(
-            "XLA_FLAGS", ""
-        ):
-            # an 8-device host platform, or a CPU-backed run (requested via
-            # --platform=cpu OR a host whose default backend is already CPU)
-            # is a vacuous dp=1 A/B; the flag only shapes the host platform,
-            # so it is inert when a real TPU answers. Env var works because
-            # the backend initializes lazily at the first device query below.
-            os.environ["XLA_FLAGS"] = (
-                os.environ.get("XLA_FLAGS", "")
-                + " --xla_force_host_platform_device_count=8"
-            ).strip()
+        _force_host_devices()
         import jax
 
         if "--platform=cpu" in sys.argv:

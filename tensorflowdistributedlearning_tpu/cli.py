@@ -52,6 +52,25 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    "(arXiv:2004.13336)")
 
 
+def _add_host_loop(p: argparse.ArgumentParser) -> None:
+    """Host-loop overlap knobs shared by the training commands (train/fit).
+
+    Defaults are None so the config's own defaults (TrainConfig or the
+    preset's) stay the single source of truth — the flags only override."""
+    p.add_argument("--prefetch-depth", type=int, default=None,
+                   help="host→device input prefetch depth: the loader thread "
+                   "stays this many placed batches ahead of the train loop "
+                   "(>= 1; per-window queue-depth telemetry shows underruns "
+                   "in telemetry-report; default: the config's, 2)")
+    p.add_argument("--dispatch-ahead", type=int, default=None,
+                   help="host-device overlap budget: dispatch at most this "
+                   "many unretired train steps ahead of the device, with log "
+                   "windows deferring their metric fetch one window so the "
+                   "device queue never drains on a log line; 0 = the "
+                   "synchronous legacy loop (numerics identical either way; "
+                   "default: the config's, 2)")
+
+
 def _add_resilience(p: argparse.ArgumentParser) -> None:
     """Flags shared by the training commands (train/fit) — resilience/."""
     from tensorflowdistributedlearning_tpu.resilience.preempt import (
@@ -98,6 +117,7 @@ def build_parser() -> argparse.ArgumentParser:
                          help="after training, export the best fold's "
                          "standalone StableHLO serving artifact next to its "
                          "checkpoint ({fold_dir}/export/serving)")
+    _add_host_loop(p_train)
     _add_resilience(p_train)
 
     p_pred = sub.add_parser("predict", help="fold x TTA ensemble prediction")
@@ -189,6 +209,7 @@ def build_parser() -> argparse.ArgumentParser:
                        "(crop drops the mirror — digits/text; none streams "
                        "batches untouched; mixup/cutmix add image/label "
                        "mixing on top of flip_crop)")
+    _add_host_loop(p_fit)
     _add_resilience(p_fit)
 
     p_serve = sub.add_parser(
@@ -266,6 +287,13 @@ def _trainer(args):
     from tensorflowdistributedlearning_tpu.config import TrainConfig
     from tensorflowdistributedlearning_tpu.train.trainer import Trainer
 
+    # host-loop overlap knobs only override when given; the TrainConfig
+    # defaults are the single source of truth
+    overlap = {}
+    if getattr(args, "prefetch_depth", None) is not None:
+        overlap["prefetch_depth"] = args.prefetch_depth
+    if getattr(args, "dispatch_ahead", None) is not None:
+        overlap["dispatch_ahead_steps"] = args.dispatch_ahead
     tcfg = TrainConfig(
         lr=getattr(args, "lr", 0.001),
         n_devices=args.n_devices,
@@ -278,6 +306,7 @@ def _trainer(args):
         model_parallel=getattr(args, "model_parallel", 1),
         sync_batch_norm=getattr(args, "sync_bn", False),
         weight_update_sharding=getattr(args, "weight_update_sharding", False),
+        **overlap,
     )
     return Trainer(
         args.model_dir,
@@ -473,6 +502,8 @@ def cmd_fit(args) -> int:
         ema_decay=args.ema_decay,
         grad_accum_steps=args.grad_accum,
         grad_clip_norm=args.grad_clip,
+        prefetch_depth=args.prefetch_depth,
+        dispatch_ahead_steps=args.dispatch_ahead,
     )
     print(json.dumps({
         "preset": args.preset,

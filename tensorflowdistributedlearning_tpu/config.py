@@ -327,6 +327,23 @@ class TrainConfig:
     # overlap periodic Orbax saves with subsequent train steps (background
     # serialization); best exports and resume points still synchronize
     async_checkpointing: bool = False
+    # host→device input prefetch depth (data/pipeline.py:device_prefetch):
+    # the producer thread stays this many PLACED batches ahead of the train
+    # loop so HBM copies overlap the previous step's compute — the
+    # generalized form of the reference's prefetch(2×n_gpus)
+    # (reference: model.py:319-320). Per-window queue-depth telemetry makes
+    # underruns visible in telemetry-report; raise this when they show.
+    prefetch_depth: int = 2
+    # host–device overlap budget (train/async_loop.py): the host may run at
+    # most this many dispatched-but-unretired train steps ahead of the
+    # device, and log windows defer their metric fetch one window
+    # (copy_to_host_async at the boundary, fetched while the next window is
+    # already dispatching) — the device queue never drains on a log line.
+    # The blocked-past-budget time is ledgered as the fetch_wait span.
+    # 0 = the synchronous legacy loop (blocking device_get per log window);
+    # numerics are bit-identical either way (tests/test_async_loop.py,
+    # BENCH_ASYNC.json).
+    dispatch_ahead_steps: int = 2
     # fit() with record shards and NO val split: hold out this fraction of the
     # train record shards (at least one) as the eval split, so best-checkpoint
     # selection runs on data the model never trains on. 0.0 keeps every shard
@@ -462,6 +479,16 @@ class TrainConfig:
         if self.eval_throttle_secs < 0:
             raise ValueError(
                 f"eval_throttle_secs must be >= 0, got {self.eval_throttle_secs}"
+            )
+        if self.prefetch_depth < 1:
+            raise ValueError(
+                f"prefetch_depth must be >= 1, got {self.prefetch_depth} "
+                "(1 = single-buffered; there is no unprefetched mode)"
+            )
+        if self.dispatch_ahead_steps < 0:
+            raise ValueError(
+                "dispatch_ahead_steps must be >= 0 (0 = the synchronous "
+                f"host loop), got {self.dispatch_ahead_steps}"
             )
         if self.telemetry_memory_every_windows < 1:
             raise ValueError(

@@ -21,6 +21,7 @@ from __future__ import annotations
 import os
 import threading
 import queue as queue_lib
+import weakref
 from glob import glob
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -214,31 +215,82 @@ def eval_batches(
 
 
 def device_prefetch(
-    iterator: Iterator, place, depth: int = 2
+    iterator: Iterator, place, depth: int = 2, registry=None
 ) -> Iterator:
-    """Double-buffered host→device prefetch (the reference's ``prefetch(2×n_gpus)``,
+    """Buffered host→device prefetch (the reference's ``prefetch(2×n_gpus)``,
     model.py:319-320): a daemon thread stays ``depth`` batches ahead so HBM copies
     overlap the previous step's compute. ``place`` maps a host batch to device arrays
-    (e.g. ``lambda b: shard_batch(b, mesh)``)."""
+    (e.g. ``lambda b: shard_batch(b, mesh)``); ``depth`` is
+    ``TrainConfig.prefetch_depth`` in the trainers.
+
+    ``registry`` (an ``obs.metrics.MetricsRegistry``) records the ready-queue
+    depth observed at each consumer take into the ``prefetch/queue_depth``
+    histogram — the per-window gauge that makes prefetch underruns visible in
+    ``telemetry-report``.
+
+    Shutdown contract: producer puts are stop-aware, so a consumer that
+    abandons iteration early (a preemption raise mid-epoch, a test that reads
+    one batch) releases the thread within one poll interval instead of
+    leaving it blocked forever on a full queue — the consumer generator's
+    ``finally`` signals stop on close, and a finalizer covers a generator
+    that is dropped without ever being iterated. Depth validation and the
+    thread start are EAGER (this is a plain function returning a generator),
+    so a bad depth fails at the call site and prefetch begins before the
+    first ``next``."""
+    if depth < 1:
+        raise ValueError(f"device_prefetch depth must be >= 1, got {depth}")
     q: queue_lib.Queue = queue_lib.Queue(maxsize=depth)
+    stop = threading.Event()
     _done = object()
     _error = object()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue_lib.Full:
+                continue
+        return False
 
     def producer():
         try:
             for item in iterator:
-                q.put(place(item))
+                if not put(place(item)):
+                    return
         except BaseException as e:  # noqa: BLE001 — re-raised on the consumer side
-            q.put((_error, e))
+            put((_error, e))
             return
-        q.put(_done)
+        put(_done)
 
-    thread = threading.Thread(target=producer, daemon=True)
+    thread = threading.Thread(target=producer, daemon=True, name="device_prefetch")
     thread.start()
-    while True:
-        item = q.get()
-        if item is _done:
-            return
-        if isinstance(item, tuple) and len(item) == 2 and item[0] is _error:
-            raise item[1]
-        yield item
+    hist = None
+    if registry is not None:
+        from tensorflowdistributedlearning_tpu.obs.telemetry import (
+            PREFETCH_DEPTH_HISTOGRAM,
+        )
+
+        hist = registry.histogram(PREFETCH_DEPTH_HISTOGRAM)
+
+    def consume():
+        try:
+            while True:
+                item = q.get()
+                if item is _done:
+                    return
+                if isinstance(item, tuple) and len(item) == 2 and item[0] is _error:
+                    raise item[1]
+                if hist is not None:
+                    # batches still ready behind the one just taken: 0 means
+                    # the consumer caught the producer (an underrun)
+                    hist.record(float(q.qsize()))
+                yield item
+        finally:
+            stop.set()
+
+    gen = consume()
+    # a generator dropped before its first next() never enters the try above,
+    # so its finally cannot release the producer — the finalizer does
+    weakref.finalize(gen, stop.set)
+    return gen

@@ -39,15 +39,19 @@ from tensorflowdistributedlearning_tpu.obs.metrics import (
 from tensorflowdistributedlearning_tpu.obs.recompile import RecompileDetector
 from tensorflowdistributedlearning_tpu.obs.telemetry import (
     NULL_TELEMETRY,
+    PREFETCH_DEPTH_HISTOGRAM,
     SPAN_DATA_WAIT,
     SPAN_EVAL,
+    SPAN_FETCH_WAIT,
     SPAN_STEP,
     Telemetry,
 )
 
 __all__ = [
+    "PREFETCH_DEPTH_HISTOGRAM",
     "SPAN_DATA_WAIT",
     "SPAN_EVAL",
+    "SPAN_FETCH_WAIT",
     "SPAN_STEP",
     "Counter",
     "Gauge",

@@ -215,6 +215,7 @@ def build_report(
     wall_s = events[-1]["t"] - events[0]["t"] if len(events) > 1 else 0.0
     data_wait_s = sum(e.get("data_wait_s", 0.0) for e in windows)
     compute_s = sum(e.get("compute_s", 0.0) for e in windows)
+    fetch_wait_s = sum(e.get("fetch_wait_s", 0.0) for e in windows)
     eval_s = sum(e.get("duration_s", 0.0) for e in evals)
     # run_end carries the exact total from the detector (ledger compile lines
     # are thresholded to the non-trivial ones); fall back to summing those
@@ -248,10 +249,12 @@ def build_report(
         "time_split": {
             "data_wait_s": round(data_wait_s, 3),
             "compute_s": round(compute_s, 3),
+            "fetch_wait_s": round(fetch_wait_s, 3),
             "eval_s": round(eval_s, 3),
             "compile_s": round(compile_s, 3),
             "data_wait_frac": frac(data_wait_s),
             "compute_frac": frac(compute_s),
+            "fetch_wait_frac": frac(fetch_wait_s),
             "eval_frac": frac(eval_s),
             "compile_frac": frac(compile_s),
         },
@@ -281,6 +284,19 @@ def build_report(
     serve_windows = [e for e in events if e.get("event") == "serve_window"]
     if serve_windows:
         report["serve"] = _serve_section(serve_windows)
+
+    depths = [e["prefetch_queue_depth"] for e in windows if "prefetch_queue_depth" in e]
+    if depths:
+        report["prefetch"] = {
+            "windows": len(depths),
+            "mean_queue_depth": round(
+                sum(d["mean"] for d in depths) / len(depths), 2
+            ),
+            "min_queue_depth": min(d["min"] for d in depths),
+            # windows whose queue touched empty: the loader failed to stay
+            # ahead of the device at least once in them
+            "underrun_windows": sum(1 for d in depths if d["min"] == 0),
+        }
 
     ips = [
         (e["step"], e["images_per_sec"])
@@ -397,6 +413,12 @@ def render_report(report: Dict) -> str:
     lines.append(
         f"  step-compute {_fmt_frac(ts['compute_frac'])}  {ts['compute_s']:9.2f}s"
     )
+    if ts.get("fetch_wait_s"):
+        lines.append(
+            f"  fetch-wait   {_fmt_frac(ts.get('fetch_wait_frac'))}  "
+            f"{ts['fetch_wait_s']:9.2f}s  (host blocked on device values — "
+            "dispatch-ahead backpressure)"
+        )
     lines.append(
         f"  eval         {_fmt_frac(ts['eval_frac'])}  {ts['eval_s']:9.2f}s"
     )
@@ -416,6 +438,18 @@ def render_report(report: Dict) -> str:
             )
     else:
         lines.append("\nrecompiles after warmup: none")
+    pf = report.get("prefetch")
+    if pf:
+        line = (
+            f"input prefetch: mean queue depth {pf['mean_queue_depth']:.1f} "
+            f"(min {pf['min_queue_depth']}) over {pf['windows']} window(s)"
+        )
+        if pf["underrun_windows"]:
+            line += (
+                f" — !! {pf['underrun_windows']} window(s) underran (queue "
+                "hit empty; raise --prefetch-depth or speed the loader up)"
+            )
+        lines.append(line)
     ev = report["evals"]
     lines.append(
         f"evals: {ev['count']}"

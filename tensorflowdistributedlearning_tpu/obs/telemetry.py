@@ -47,6 +47,15 @@ logger = logging.getLogger(__name__)
 SPAN_DATA_WAIT = "data_wait"
 SPAN_STEP = "step"
 SPAN_EVAL = "eval"
+# host blocked waiting on a device value (the async loop's bounded
+# dispatch-ahead and deferred window fetch — train/async_loop.py); disjoint
+# from data_wait/step like the other window spans
+SPAN_FETCH_WAIT = "fetch_wait"
+
+# registry histogram the input prefetcher records its ready-queue depth into
+# (data/pipeline.py:device_prefetch); drained per window like the spans, so
+# prefetch underruns are visible in the ledger and telemetry-report
+PREFETCH_DEPTH_HISTOGRAM = "prefetch/queue_depth"
 
 
 def run_fingerprint() -> Dict:
@@ -146,6 +155,23 @@ class Telemetry:
         a 500k-step run would otherwise retain ~1M floats nothing reads."""
         return self.registry.histogram(f"span/{name}").drain()
 
+    def drain_window_samples(self) -> Dict[str, List[float]]:
+        """Drain the per-window samples NOW and hand them to the caller.
+
+        Deferred-emission callers (the async host loop) snapshot at the
+        window BOUNDARY and pass the result back through
+        ``window_event(samples=...)`` one window later, so a late-written
+        window event still describes its own interval instead of the next
+        one's."""
+        samples = {
+            name: self._span_delta(name)
+            for name in (SPAN_DATA_WAIT, SPAN_STEP, SPAN_FETCH_WAIT)
+        }
+        samples["prefetch_depth"] = self.registry.histogram(
+            PREFETCH_DEPTH_HISTOGRAM
+        ).drain()
+        return samples
+
     # -- events ------------------------------------------------------------
 
     def _event(self, kind: str, /, **fields) -> None:
@@ -167,27 +193,43 @@ class Telemetry:
         images_per_sec: Optional[float] = None,
         scalars: Optional[Dict[str, float]] = None,
         dirty: bool = False,
+        samples: Optional[Dict[str, List[float]]] = None,
         **extra,
     ) -> None:
         """One per-log-window record: throughput, data-wait vs step-compute
-        split, per-step time percentiles, recompiles seen this window.
-        ``dirty`` marks windows containing compile/eval/checkpoint time (their
-        throughput point is not steady-state)."""
+        vs blocked-on-fetch split, per-step time percentiles, prefetch queue
+        depth, recompiles seen this window. ``dirty`` marks windows containing
+        compile/eval/checkpoint time (their throughput point is not
+        steady-state). ``samples`` lets a deferred emitter pass the window's
+        own boundary-snapshotted samples (``drain_window_samples``); default
+        drains now."""
         if not self.enabled:
             return
-        wait = self._span_delta(SPAN_DATA_WAIT)
-        compute = self._span_delta(SPAN_STEP)
-        wait_s, compute_s = sum(wait), sum(compute)
-        busy = wait_s + compute_s
+        if samples is None:
+            samples = self.drain_window_samples()
+        wait = samples.get(SPAN_DATA_WAIT, [])
+        compute = samples.get(SPAN_STEP, [])
+        fetch = samples.get(SPAN_FETCH_WAIT, [])
+        depth = samples.get("prefetch_depth", [])
+        wait_s, compute_s, fetch_s = sum(wait), sum(compute), sum(fetch)
+        busy = wait_s + compute_s + fetch_s
         fields: Dict = {
             "step": step,
             "steps": steps,
             "data_wait_s": round(wait_s, 6),
             "compute_s": round(compute_s, 6),
+            "fetch_wait_s": round(fetch_s, 6),
             "data_wait_frac": round(wait_s / busy, 4) if busy else 0.0,
             "dirty": dirty,
             **extra,
         }
+        if depth:
+            # ready batches behind each consumer take: mean tells how full
+            # the input prefetch queue ran, min 0 marks an underrun window
+            fields["prefetch_queue_depth"] = {
+                "mean": round(sum(depth) / len(depth), 2),
+                "min": int(min(depth)),
+            }
         if compute:
             s = time_summary(compute)
             fields["step_time_ms"] = {

@@ -36,6 +36,7 @@ from tensorflowdistributedlearning_tpu.parallel import mesh as mesh_lib
 from tensorflowdistributedlearning_tpu.parallel import multihost
 from tensorflowdistributedlearning_tpu.resilience import faults as faults_lib
 from tensorflowdistributedlearning_tpu.resilience import preempt as preempt_lib
+from tensorflowdistributedlearning_tpu.train import async_loop
 from tensorflowdistributedlearning_tpu.train import state as state_lib
 from tensorflowdistributedlearning_tpu.train import step as step_lib
 from tensorflowdistributedlearning_tpu.train.checkpoint import CheckpointManager
@@ -406,7 +407,7 @@ class ClassifierTrainer:
         start_step = int(jax.device_get(state.step))
         if start_step >= steps:
             logger.info("already trained to step %d", start_step)
-            metrics = self._evaluate(state, batch_size)
+            metrics = self._evaluate(state, batch_size, step_no=start_step)
             ckpt.close()
             tel.close(steps=start_step, already_trained=True)
             return FitResult(metrics, self.params, start_step)
@@ -450,6 +451,14 @@ class ClassifierTrainer:
         batches = pipeline_lib.device_prefetch(
             self._train_stream(batch_size, steps - start_step, start_step),
             self._place_batch,
+            depth=tcfg.prefetch_depth,
+            # the gauge is drained per log window; a run that never writes
+            # windows (telemetry off, or a non-main host with no TB writer)
+            # must not record into it — the samples would accumulate for the
+            # life of the run with nothing reading them
+            registry=(
+                tel.registry if tel.enabled and tb_train is not None else None
+            ),
         )
         step_no = start_step
         last_eval_step = -1
@@ -460,7 +469,27 @@ class ClassifierTrainer:
         # first window contains the compile; eval/save windows are not training
         # time either — dirty windows skip their throughput point
         window_dirty = True
-        lr_sched = step_lib.make_lr_schedule(tcfg)
+        # host-side schedule mirror: the lr log line must not dispatch device
+        # work (the whole point of the deferred-fetch loop is a full queue)
+        lr_sched = step_lib.make_host_lr_schedule(tcfg)
+
+        def emit_window(rec: async_loop.PendingWindow, scalars) -> None:
+            if tb_train is not None:
+                tb_train.scalars(scalars, rec.step)
+            tel.window_event(
+                rec.step,
+                steps=rec.steps,
+                images_per_sec=rec.images_per_sec,
+                scalars=scalars,
+                dirty=rec.dirty,
+                samples=rec.samples,
+            )
+
+        # dispatch-ahead + deferred window fetch (train/async_loop.py);
+        # dispatch_ahead_steps=0 is the synchronous legacy loop
+        overlap = async_loop.HostOverlap(
+            tel, dispatch_ahead=tcfg.dispatch_ahead_steps, emit=emit_window
+        )
         batches_it = iter(batches)
         _end = object()
         while True:
@@ -474,11 +503,17 @@ class ClassifierTrainer:
                 batch = prepare(jax.numpy.asarray(step_no), raw)
                 state, metrics = train_step(state, batch)
             step_no += 1
+            # bounded dispatch-ahead: block (as fetch_wait) once more than
+            # dispatch_ahead_steps steps are in flight
+            overlap.track(metrics)
             # resilience boundary: injected faults fire here (a SIGTERM lands
             # in the preemption handler below within the same boundary), and a
             # pending preemption turns into a final checkpoint + distinct exit
             faults_lib.fire(faults_lib.SITE_STEP, step_no)
             if preempt_lib.requested():
+                # the deferred window reaches the ledger BEFORE the preemption
+                # checkpoint/events — resilience reporting stays complete
+                overlap.flush()
                 ckpt.save(state, force=True)
                 tel.checkpoint_event(step_no, preempted=True)
                 tel.event(
@@ -486,39 +521,39 @@ class ClassifierTrainer:
                 )
                 raise preempt_lib.PreemptedError(step_no)
             if tb_train is not None and step_no % tcfg.train_log_every_steps == 0:
-                # the device_get synchronizes on this step, so the window's
-                # span totals are real wall time — it counts as step time
-                with tel.span(obs_lib.SPAN_STEP):
-                    scalars = step_lib.compute_metrics(jax.device_get(metrics))
                 now = time.perf_counter()
                 images_per_sec = None
                 if not window_dirty and step_no > window_start:
                     images_per_sec = (
                         (step_no - window_start) * batch_size / (now - window_t0)
                     )
-                    scalars["throughput/images_per_sec"] = images_per_sec
-                # the lr the NEXT update will use — exact, the schedule is
-                # step-driven (observability the reference's TB summaries
-                # never had)
-                scalars["lr"] = float(lr_sched(step_no))
-                tb_train.scalars(scalars, step_no)
-                tel.window_event(
-                    step_no,
-                    steps=step_no - window_start,
-                    images_per_sec=images_per_sec,
-                    scalars=scalars,
-                    dirty=window_dirty,
+                # sync mode fetches+emits here; async mode emits the PREVIOUS
+                # window and defers this one while the device keeps running.
+                # rec.lr is the lr the NEXT update will use — exact, the
+                # schedule is step-driven (observability the reference's TB
+                # summaries never had)
+                overlap.window(
+                    async_loop.PendingWindow(
+                        step=step_no,
+                        metrics=metrics,
+                        steps=step_no - window_start,
+                        lr=lr_sched(step_no),
+                        images_per_sec=images_per_sec,
+                        dirty=window_dirty,
+                    )
                 )
                 window_t0, window_start, window_dirty = now, step_no, False
                 # train-side executables exist now: further train compiles
                 # are recompiles (the first eval marks its own phase warm)
                 tel.mark_warm(obs_lib.SPAN_STEP, obs_lib.SPAN_DATA_WAIT)
             if ckpt.maybe_save(state, step=step_no):
+                overlap.flush()
                 window_dirty = True
                 tel.checkpoint_event(step_no)
             if step_no % eval_every == 0:
+                overlap.flush()
                 last_eval_step = step_no
-                final_metrics = self._evaluate(state, batch_size)
+                final_metrics = self._evaluate(state, batch_size, step_no=step_no)
                 if tb_eval is not None:
                     tb_eval.scalars(final_metrics, step_no)
                     tb_eval.flush()
@@ -527,10 +562,11 @@ class ClassifierTrainer:
                     step_lib.with_ema_params(state), final_metrics
                 )
                 window_dirty = True
+        overlap.flush()
         ckpt.save(state, force=True)
         tel.checkpoint_event(step_no, final=True)
         if last_eval_step != step_no:
-            final_metrics = self._evaluate(state, batch_size)
+            final_metrics = self._evaluate(state, batch_size, step_no=step_no)
             if tb_eval is not None:
                 tb_eval.scalars(final_metrics, step_no)
                 tb_eval.flush()
@@ -590,12 +626,21 @@ class ClassifierTrainer:
             return tp_lib.shard_state_tensor_parallel(state, self.mesh)
         return mesh_lib.replicate(state, self.mesh)
 
-    def _evaluate(self, state: TrainState, batch_size: int) -> Dict[str, float]:
+    def _evaluate(
+        self,
+        state: TrainState,
+        batch_size: int,
+        step_no: Optional[int] = None,
+    ) -> Dict[str, float]:
         """One eval pass: the ``val`` split when present (ImageFolder or record
         shards), else ``train`` (read in order, no augmentation), else one
         synthetic pass — EXCEPT when training came from record shards, where a
         synthetic fallback would drive best-checkpoint selection with accuracy
-        on noise; that case evaluates one pass over the train records instead."""
+        on noise; that case evaluates one pass over the train records instead.
+
+        ``step_no``: the host-known step the pass describes (the train loop
+        always knows it); None falls back to a device fetch of ``state.step``
+        — direct callers only, the loop path stays sync-free."""
         tcfg = self.train_config
         # evaluate the EMA view when one is tracked (TrainConfig.ema_decay>0) —
         # the same params best-export stores, so selection and serving agree —
@@ -613,7 +658,7 @@ class ClassifierTrainer:
             if eval_records is not None:
                 self._warn_eval_on_train("train record shards")
         if eval_records is not None:
-            return self._evaluate_records(state, eval_records, local_bs)
+            return self._evaluate_records(state, eval_records, local_bs, step_no)
         eval_split = val_folder
         if eval_split is None:
             eval_split = self._open_split("train")
@@ -639,25 +684,39 @@ class ClassifierTrainer:
             batches = imagefolder.eval_batches(
                 eval_split.host_shard(), local_bs, num_batches=num
             )
-        return self._eval_pass(state, batches)
+        return self._eval_pass(state, batches, step_no)
 
     def _eval_pass(
-        self, state: TrainState, batches: Iterator[Dict[str, np.ndarray]]
+        self,
+        state: TrainState,
+        batches: Iterator[Dict[str, np.ndarray]],
+        step_no: Optional[int] = None,
     ) -> Dict[str, float]:
         """The ONE streaming accumulate/compute/log eval loop (both the
         ImageFolder/synthetic and record-shard paths feed it), wrapped once in
         the telemetry eval span — eval wall time is not training time, and the
-        ledger records each pass as an ``eval`` event."""
+        ledger records each pass as an ``eval`` event.
+
+        The metric accumulator stays DEVICE-RESIDENT (a tiny jitted merge per
+        batch, train/async_loop.py): one host transfer per pass regardless of
+        batch count, instead of a device-queue drain per batch."""
         tel = self._telemetry
         t0 = time.perf_counter()
         with tel.span(obs_lib.SPAN_EVAL):
             eval_step = self._eval_step
+            # in-flight bound: without it, device-resident accumulation would
+            # let the host enqueue EVERY eval batch's copy+step at once
+            budget = async_loop.eval_budget(
+                tel, self.train_config.dispatch_ahead_steps
+            )
             acc = None
             for raw in batches:
                 metrics = eval_step(state, self._place_batch(raw))
-                acc = step_lib.merge_metrics(acc, jax.device_get(metrics))
-            result = step_lib.compute_metrics(acc)
-        step_no = int(jax.device_get(state.step))
+                acc = async_loop.merge_metrics_device(acc, metrics)
+                budget.track(acc)
+            result = async_loop.fetch_metrics(acc, telemetry=tel)
+        if step_no is None:
+            step_no = int(jax.device_get(state.step))
         logger.info("eval @ %d: %s", step_no, result)
         tel.eval_event(step_no, result, time.perf_counter() - t0)
         # this pass compiled whatever eval needed; later eval compiles are
@@ -681,7 +740,8 @@ class ClassifierTrainer:
         )
 
     def _evaluate_records(
-        self, state: TrainState, ds, local_bs: int
+        self, state: TrainState, ds, local_bs: int,
+        step_no: Optional[int] = None,
     ) -> Dict[str, float]:
         """One streaming eval pass over record shards. Every process runs the
         same number of collective-bearing steps: batch counts are equalized to
@@ -698,7 +758,8 @@ class ClassifierTrainer:
         else:
             num = -(-my_n // local_bs) if my_n else 1
         return self._eval_pass(
-            state, ds.batches(local_bs, repeat=False, pad_to_batches=num)
+            state, ds.batches(local_bs, repeat=False, pad_to_batches=num),
+            step_no,
         )
 
     # -- serving ----------------------------------------------------------
@@ -841,6 +902,8 @@ def fit_preset(
     ema_decay: Optional[float] = None,
     grad_accum_steps: Optional[int] = None,
     grad_clip_norm: Optional[float] = None,
+    prefetch_depth: Optional[int] = None,
+    dispatch_ahead_steps: Optional[int] = None,
 ) -> FitResult:
     """Train a named config preset end-to-end (the CLI `fit` entry point)."""
     from tensorflowdistributedlearning_tpu.configs import get_preset
@@ -876,6 +939,8 @@ def fit_preset(
         or ema_decay is not None
         or grad_accum_steps is not None
         or grad_clip_norm is not None
+        or prefetch_depth is not None
+        or dispatch_ahead_steps is not None
     ):
         train_cfg = dataclasses.replace(
             train_cfg,
@@ -914,6 +979,16 @@ def fit_preset(
                 grad_clip_norm
                 if grad_clip_norm is not None
                 else train_cfg.grad_clip_norm
+            ),
+            prefetch_depth=(
+                prefetch_depth
+                if prefetch_depth is not None
+                else train_cfg.prefetch_depth
+            ),
+            dispatch_ahead_steps=(
+                dispatch_ahead_steps
+                if dispatch_ahead_steps is not None
+                else train_cfg.dispatch_ahead_steps
             ),
         )
     trainer = ClassifierTrainer(

@@ -66,6 +66,47 @@ def make_lr_schedule(cfg: TrainConfig) -> optax.Schedule:
     )
 
 
+def make_host_lr_schedule(cfg: TrainConfig) -> Callable[[int], float]:
+    """Pure-host (math-library) mirror of ``make_lr_schedule``.
+
+    The trainers log the next update's lr every window; evaluating the optax
+    schedule for that dispatches a tiny device computation per log line — the
+    logging path should add ZERO device work, especially under the async host
+    loop where the device queue must stay full. Parity with the optax
+    schedules is pinned by
+    tests/test_async_loop.py::test_host_lr_schedule_matches_optax."""
+    import math
+
+    lr = float(cfg.lr)
+    if cfg.lr_schedule == "cosine":
+        warmup = cfg.lr_warmup_steps
+        if warmup == 0:
+            decay_steps = max(cfg.lr_decay_steps, 1)
+
+            def sched(step: int) -> float:
+                frac = min(max(step, 0), decay_steps) / decay_steps
+                return lr * 0.5 * (1.0 + math.cos(math.pi * frac))
+
+            return sched
+        decay_steps = max(cfg.lr_decay_steps, warmup + 1)
+
+        def sched(step: int) -> float:
+            if step < warmup:
+                return lr * max(step, 0) / warmup
+            frac = min(step - warmup, decay_steps - warmup) / (
+                decay_steps - warmup
+            )
+            return lr * 0.5 * (1.0 + math.cos(math.pi * frac))
+
+        return sched
+    transition, rate = cfg.lr_decay_steps, cfg.lr_decay_rate
+
+    def sched(step: int) -> float:
+        return lr * rate ** (step / transition)
+
+    return sched
+
+
 # weight-matrix leaf names: flax conv/dense "kernel", plus the MoE FFN's
 # explicitly-declared expert matrices and router (models/vit.py:MoEMlp) —
 # the direct replacements for the dense mlp kernels they stand in for

@@ -46,6 +46,7 @@ from tensorflowdistributedlearning_tpu.parallel import mesh as mesh_lib
 from tensorflowdistributedlearning_tpu.parallel import multihost
 from tensorflowdistributedlearning_tpu.resilience import faults as faults_lib
 from tensorflowdistributedlearning_tpu.resilience import preempt as preempt_lib
+from tensorflowdistributedlearning_tpu.train import async_loop
 from tensorflowdistributedlearning_tpu.train import state as state_lib
 from tensorflowdistributedlearning_tpu.train import step as step_lib
 from tensorflowdistributedlearning_tpu.train.checkpoint import CheckpointManager
@@ -366,7 +367,7 @@ class Trainer:
             ckpt.close()
             return self._evaluate(
                 state, eval_ds, batch_size, fold, writer=None,
-                global_n=eval_global_n,
+                global_n=eval_global_n, step_no=start_step,
             )
         if start_step > 0:
             # resume verification: training actually CONTINUES from a prior
@@ -406,6 +407,16 @@ class Trainer:
             lambda b: multihost.global_shard_batch(
                 b, self.mesh, spatial=self._spatial
             ),
+            depth=tcfg.prefetch_depth,
+            # the gauge is drained per log window; a run that never writes
+            # windows (telemetry off, or a non-main host with no TB writer)
+            # must not record into it — the samples would accumulate for the
+            # life of the run with nothing reading them
+            registry=(
+                self._telemetry.registry
+                if self._telemetry.enabled and tb_train is not None
+                else None
+            ),
         )
         step_no = start_step
         last_eval_step = -1
@@ -415,8 +426,28 @@ class Trainer:
         # an eval pass or a synchronous checkpoint save are likewise not
         # training time — mark them dirty and skip their throughput point
         window_dirty = True
-        lr_sched = step_lib.make_lr_schedule(tcfg)
+        # host-side schedule mirror: the lr log line adds zero device work
+        lr_sched = step_lib.make_host_lr_schedule(tcfg)
         tel = self._telemetry
+
+        def emit_window(rec: async_loop.PendingWindow, scalars) -> None:
+            if tb_train is not None:
+                tb_train.scalars(scalars, rec.step)
+            tel.window_event(
+                rec.step,
+                steps=rec.steps,
+                images_per_sec=rec.images_per_sec,
+                scalars=scalars,
+                dirty=rec.dirty,
+                samples=rec.samples,
+                **rec.extra,
+            )
+
+        # dispatch-ahead + deferred window fetch (train/async_loop.py);
+        # dispatch_ahead_steps=0 is the synchronous legacy loop
+        overlap = async_loop.HostOverlap(
+            tel, dispatch_ahead=tcfg.dispatch_ahead_steps, emit=emit_window
+        )
         batches_it = iter(batches)
         _end = object()
         while True:
@@ -430,11 +461,17 @@ class Trainer:
                 batch = prepare(jnp.asarray(step_no), raw)
                 state, metrics = train_step(state, batch)
             step_no += 1
+            # bounded dispatch-ahead: block (as fetch_wait) once more than
+            # dispatch_ahead_steps steps are in flight
+            overlap.track(metrics)
             # resilience boundary: injected faults fire here (a SIGTERM lands
             # in the preemption handler below within the same boundary), and a
             # pending preemption turns into a final checkpoint + distinct exit
             faults_lib.fire(faults_lib.SITE_STEP, step_no)
             if preempt_lib.requested():
+                # the deferred window reaches the ledger BEFORE the preemption
+                # checkpoint/events — resilience reporting stays complete
+                overlap.flush()
                 ckpt.save(state, force=True)
                 tel.checkpoint_event(step_no, fold=fold, preempted=True)
                 tel.event(
@@ -445,29 +482,26 @@ class Trainer:
                 )
                 raise preempt_lib.PreemptedError(step_no)
             if tb_train is not None and step_no % tcfg.train_log_every_steps == 0:
-                # the device_get synchronizes on this step, so the window's
-                # span totals are real wall time — it counts as step time
-                with tel.span(obs_lib.SPAN_STEP):
-                    scalars = step_lib.compute_metrics(jax.device_get(metrics))
-                # wall-clock throughput over the log window (the device_get
-                # above synchronized on this step, so the window is real time)
                 now = time.perf_counter()
                 images_per_sec = None
                 if not window_dirty and step_no > window_start:
                     images_per_sec = (
                         (step_no - window_start) * batch_size / (now - window_t0)
                     )
-                    scalars["throughput/images_per_sec"] = images_per_sec
-                # exact lr of the next update (host-side schedule eval)
-                scalars["lr"] = float(lr_sched(step_no))
-                tb_train.scalars(scalars, step_no)
-                tel.window_event(
-                    step_no,
-                    steps=step_no - window_start,
-                    images_per_sec=images_per_sec,
-                    scalars=scalars,
-                    dirty=window_dirty,
-                    fold=fold,
+                # sync mode fetches+emits here; async mode emits the PREVIOUS
+                # window and defers this one while the device keeps running.
+                # rec.lr is the exact lr of the next update (host-side
+                # schedule eval)
+                overlap.window(
+                    async_loop.PendingWindow(
+                        step=step_no,
+                        metrics=metrics,
+                        steps=step_no - window_start,
+                        lr=lr_sched(step_no),
+                        images_per_sec=images_per_sec,
+                        dirty=window_dirty,
+                        extra={"fold": fold},
+                    )
                 )
                 window_t0, window_start, window_dirty = now, step_no, False
                 tel.mark_warm(obs_lib.SPAN_STEP, obs_lib.SPAN_DATA_WAIT)
@@ -479,6 +513,7 @@ class Trainer:
                     self._write_image_summaries(tb_train, state, batch, step_no)
             saved = ckpt.maybe_save(state, step=step_no)
             if saved:
+                overlap.flush()
                 window_dirty = True
                 tel.checkpoint_event(step_no, fold=fold)
             # eval cadence: an explicit eval_every_steps knob decouples eval from
@@ -491,11 +526,12 @@ class Trainer:
             else:
                 due = saved and time.time() - last_eval_time >= tcfg.eval_throttle_secs
             if due:
+                overlap.flush()
                 last_eval_time = time.time()
                 last_eval_step = step_no
                 final_metrics = self._evaluate(
                     state, eval_ds, batch_size, fold, writer=tb_eval,
-                    global_n=eval_global_n,
+                    global_n=eval_global_n, step_no=step_no,
                 )
                 # best-export stores the eval view: EMA params when tracked
                 ckpt.export_best(
@@ -505,12 +541,13 @@ class Trainer:
         # end of training: final checkpoint + eval + export (train_and_evaluate's
         # final-eval contract) — skipped when the last loop iteration already
         # checkpointed and evaluated at this exact step
+        overlap.flush()
         ckpt.save(state, force=True)
         tel.checkpoint_event(step_no, fold=fold, final=True)
         if last_eval_step != step_no:
             final_metrics = self._evaluate(
                 state, eval_ds, batch_size, fold, writer=tb_eval,
-                global_n=eval_global_n,
+                global_n=eval_global_n, step_no=step_no,
             )
             ckpt.export_best(step_lib.with_ema_params(state), final_metrics)
         if tb_train is not None:
@@ -542,6 +579,7 @@ class Trainer:
         fold: int,
         writer: Optional[SummaryWriter],
         global_n: Optional[int] = None,
+        step_no: Optional[int] = None,
     ) -> Dict[str, float]:
         """One full eval pass with streaming metrics (the EVAL branch + SummarySaverHook,
         reference: model.py:391-403, 475-481). Runs at the caller's ``batch_size``
@@ -550,7 +588,10 @@ class Trainer:
 
         ``eval_ds`` is this process's host shard; ``global_n`` (the fold's total eval
         size) pins the step count so every process runs the same number of
-        collective-bearing steps."""
+        collective-bearing steps. The metric accumulator stays DEVICE-RESIDENT
+        (train/async_loop.py): one host transfer per pass regardless of batch
+        count. ``step_no`` is the host-known step (None = fetch ``state.step``
+        — direct callers only)."""
         mesh_lib.local_batch_size(batch_size, self.mesh)  # fail fast, clear message
         # evaluate the EMA view when one is tracked (TrainConfig.ema_decay>0),
         # then drop the optimizer state: eval reads params/batch_stats only,
@@ -566,6 +607,11 @@ class Trainer:
         with tel.span(obs_lib.SPAN_EVAL):
             eval_step = self._eval_step
             prepare = self._prepare_eval
+            # in-flight bound: without it, device-resident accumulation would
+            # let the host enqueue EVERY eval batch's copy+step at once
+            budget = async_loop.eval_budget(
+                tel, self.train_config.dispatch_ahead_steps
+            )
             acc = None
             first_batch = None
             for raw in pipeline_lib.eval_batches(eval_ds, local_bs, num_batches=num):
@@ -574,11 +620,13 @@ class Trainer:
                 )
                 batch = prepare(sharded)
                 metrics = eval_step(state, batch)
-                acc = step_lib.merge_metrics(acc, jax.device_get(metrics))
+                acc = async_loop.merge_metrics_device(acc, metrics)
+                budget.track(acc)
                 if first_batch is None:
                     first_batch = batch
-            result = step_lib.compute_metrics(acc)
-        step_no = int(jax.device_get(state.step))
+            result = async_loop.fetch_metrics(acc, telemetry=tel)
+        if step_no is None:
+            step_no = int(jax.device_get(state.step))
         tel.eval_event(step_no, result, time.perf_counter() - t0, fold=fold)
         # this pass compiled whatever eval needed; later eval compiles are
         # recompiles
