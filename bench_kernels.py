@@ -137,6 +137,68 @@ def bench_depthwise(
     return results
 
 
+def bench_fused_bn_act(
+    batch: int = 32,
+    hw: int = 13,
+    channels: int = 1024,
+    iters: int = 30,
+    warmup: int = 5,
+    repeats: int = 64,
+) -> Dict:
+    """Fused inference BN+act(+residual) Pallas pass vs XLA's fusion at the
+    serving-relevant shape: the ASPP feature map the step profile's dominant
+    elementwise/BN bucket (PROFILE_SEG_r05.json: 53.2%) runs over. Both
+    columns are HBM-roofline candidates — the question this answers is
+    whether Mosaic's single VMEM pass beats XLA's elementwise fusion on real
+    hardware, per variant (plain BN+relu, +residual)."""
+    import jax
+    import numpy as np
+
+    from tensorflowdistributedlearning_tpu.ops.pallas_kernels import (
+        fused_bn_act,
+        fused_bn_act_reference,
+    )
+
+    rng = np.random.default_rng(2)
+    x = jax.device_put(
+        rng.normal(0, 1, (batch, hw, hw, channels)).astype(np.float32)
+    )
+    r = jax.device_put(
+        rng.normal(0, 1, (batch, hw, hw, channels)).astype(np.float32)
+    )
+    vecs = tuple(
+        jax.device_put(v.astype(np.float32))
+        for v in (
+            rng.normal(1, 0.1, channels),
+            rng.normal(0, 0.1, channels),
+            rng.normal(0, 0.1, channels),
+            rng.uniform(0.5, 1.5, channels),
+        )
+    )
+
+    results: Dict = {}
+    wins = 0
+    for name, resid in (("bn_relu", False), ("bn_relu_residual", True)):
+        pallas_us, xla_us, speedup = _paired_us(
+            lambda a, rr: fused_bn_act(
+                a, *vecs, residual=rr if resid else None
+            ),
+            lambda a, rr: fused_bn_act_reference(
+                a, *vecs, residual=rr if resid else None
+            ),
+            (x, r), max(2, iters // 10), warmup, repeats=repeats,
+        )
+        results[name] = {
+            "pallas_us": round(pallas_us, 1),
+            "xla_us": round(xla_us, 1),
+            "speedup": round(speedup, 3),
+        }
+        wins += speedup > 1.0
+    results["pallas_wins"] = bool(wins == 2)
+    results["shape"] = [batch, hw, hw, channels]
+    return results
+
+
 def bench_attention(
     batch: int = 32,
     heads: int = 6,
@@ -262,6 +324,13 @@ def main() -> None:
                               repeats=2)
     out["platform"] = jax.default_backend()
     print(json.dumps(out), flush=True)
+    if jax.default_backend() == "tpu":
+        bn = bench_fused_bn_act()
+    else:
+        bn = bench_fused_bn_act(batch=2, hw=5, channels=8, iters=2, warmup=1,
+                                repeats=2)
+    bn["platform"] = jax.default_backend()
+    print(json.dumps({"fused_bn_act": bn}), flush=True)
     if jax.default_backend() == "tpu":
         attn = bench_attention()
     else:

@@ -117,6 +117,16 @@ def build_parser() -> argparse.ArgumentParser:
                          help="after training, export the best fold's "
                          "standalone StableHLO serving artifact next to its "
                          "checkpoint ({fold_dir}/export/serving)")
+    p_train.add_argument("--serving-dtype",
+                         choices=("float32", "bfloat16", "int8"),
+                         default="float32",
+                         help="post-training precision recipe for "
+                         "--export-serving (train/quantize.py): bfloat16 "
+                         "casts params at export, int8 stores conv/dense "
+                         "kernels as int8 with per-channel symmetric scales "
+                         "(activations bf16); quantized exports land in "
+                         "export/serving-{dtype} beside the float32 "
+                         "reference and must pass quantize-check to ship")
     _add_host_loop(p_train)
     _add_resilience(p_train)
 
@@ -248,6 +258,42 @@ def build_parser() -> argparse.ArgumentParser:
                          help="ledger window cadence; 0 disables periodic "
                          "windows (final window still written on shutdown)")
 
+    p_qc = sub.add_parser(
+        "quantize-check",
+        help="accuracy gate between a float32 serving artifact and a "
+        "quantized sibling: pinned eval batch, per-precision delta "
+        "thresholds, quant_check ledger event; exit 1 on failure "
+        "(promotion-pipeline gate)",
+    )
+    p_qc.add_argument("--reference-dir", required=True,
+                      help="the float32 reference artifact directory")
+    p_qc.add_argument("--candidate-dir", required=True,
+                      help="the quantized candidate artifact directory "
+                      "(its manifest quantization.dtype selects the "
+                      "threshold set)")
+    p_qc.add_argument("--batch-size", type=int, default=16,
+                      help="pinned eval batch size (fixed-batch artifacts "
+                      "pin their own)")
+    p_qc.add_argument("--seed", type=int, default=0,
+                      help="seed of the pinned eval batch")
+    p_qc.add_argument("--max-abs-delta", type=float, default=None,
+                      help="override the precision's max |delta| budget on "
+                      "float outputs")
+    p_qc.add_argument("--mean-abs-delta", type=float, default=None,
+                      help="override the precision's mean |delta| budget")
+    p_qc.add_argument("--min-iou", type=float, default=None,
+                      help="override the precision's minimum mask IoU")
+    p_qc.add_argument("--max-disagree", type=float, default=None,
+                      help="override the precision's max class-disagreement "
+                      "fraction")
+    p_qc.add_argument("--allow-fingerprint-mismatch", action="store_true",
+                      help="compare artifacts whose manifests carry "
+                      "different source fingerprints (normally a hard fail: "
+                      "the pair derives from different checkpoints)")
+    p_qc.add_argument("--workdir", default=None,
+                      help="telemetry ledger dir for the quant_check event "
+                      "(default: the candidate dir)")
+
     sub.add_parser("presets", help="list the named BASELINE config presets")
 
     p_rep = sub.add_parser(
@@ -348,7 +394,10 @@ def cmd_train(args) -> int:
     if getattr(args, "export_serving", False) and results:
         fold = _best_fold(results)
         out["serving_fold"] = fold
-        out["serving_artifact"] = trainer.export_serving(fold)
+        out["serving_artifact"] = trainer.export_serving(
+            fold, serving_dtype=getattr(args, "serving_dtype", "float32")
+        )
+        out["serving_dtype"] = getattr(args, "serving_dtype", "float32")
     print(json.dumps(out))
     return 0
 
@@ -597,6 +646,44 @@ def cmd_serve(args) -> int:
     finally:
         server.shutdown()
     return 0
+
+
+def cmd_quantize_check(args) -> int:
+    """Run the f32-vs-quantized accuracy gate (serve/quant_check.py) and
+    ledger the verdict; exit status IS the gate."""
+    from tensorflowdistributedlearning_tpu.obs import Telemetry
+    from tensorflowdistributedlearning_tpu.serve.quant_check import (
+        run_quant_check,
+    )
+
+    workdir = args.workdir or args.candidate_dir
+    telemetry = Telemetry(
+        workdir,
+        run_info={
+            "kind": "quant_check",
+            "reference_dir": args.reference_dir,
+            "candidate_dir": args.candidate_dir,
+        },
+    )
+    try:
+        result = run_quant_check(
+            args.reference_dir,
+            args.candidate_dir,
+            batch_size=args.batch_size,
+            seed=args.seed,
+            thresholds={
+                "max_abs_delta": args.max_abs_delta,
+                "mean_abs_delta": args.mean_abs_delta,
+                "min_iou": args.min_iou,
+                "max_disagree": args.max_disagree,
+            },
+            allow_fingerprint_mismatch=args.allow_fingerprint_mismatch,
+            telemetry=telemetry,
+        )
+    finally:
+        telemetry.close()
+    print(json.dumps(result))
+    return 0 if result["passed"] else 1
 
 
 def cmd_presets(args) -> int:
@@ -889,6 +976,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "smoke": cmd_smoke,
         "fit": cmd_fit,
         "serve": cmd_serve,
+        "quantize-check": cmd_quantize_check,
         "presets": cmd_presets,
         "telemetry-report": cmd_telemetry_report,
         "doctor": cmd_doctor,

@@ -88,6 +88,12 @@ def _serve_section(windows: List[Dict]) -> Dict:
         "bucket_hits": last.get("bucket_hits", {}),
         "recompiles_post_warmup": last.get("recompiles_post_warmup"),
     }
+    if last.get("serving_dtype"):
+        section["serving_dtype"] = last["serving_dtype"]
+    if last.get("padding_waste"):
+        # cumulative like the hits: fraction of compiled batch slots filled
+        # with padding, per bucket that saw traffic
+        section["padding_waste"] = last["padding_waste"]
     if totals["batches"]:
         section["mean_batch_fill"] = round(
             totals["batched_examples"] / totals["batches"], 2
@@ -284,6 +290,23 @@ def build_report(
     serve_windows = [e for e in events if e.get("event") == "serve_window"]
     if serve_windows:
         report["serve"] = _serve_section(serve_windows)
+
+    quant_checks = [e for e in events if e.get("event") == "quant_check"]
+    if quant_checks:
+        report["quant_checks"] = [
+            {
+                k: e.get(k)
+                for k in (
+                    "dtype",
+                    "passed",
+                    "candidate",
+                    "outputs",
+                    "failures",
+                    "fingerprint_match",
+                )
+            }
+            for e in quant_checks
+        ]
 
     depths = [e["prefetch_queue_depth"] for e in windows if "prefetch_queue_depth" in e]
     if depths:
@@ -497,8 +520,11 @@ def render_report(report: Dict) -> str:
         lines.append("memory: " + ", ".join(parts))
     sv = report.get("serve")
     if sv:
+        dtype_tag = (
+            f" [{sv['serving_dtype']}]" if sv.get("serving_dtype") else ""
+        )
         lines.append(
-            f"\nserving ({sv['windows']} window(s)): "
+            f"\nserving{dtype_tag} ({sv['windows']} window(s)): "
             f"{sv['requests']} requests, {sv['completed']} completed, "
             f"{sv['rejected_queue_full']} rejected (queue full), "
             f"{sv['deadline_exceeded']} deadline-exceeded, "
@@ -516,6 +542,13 @@ def render_report(report: Dict) -> str:
                 )
             )
             lines.append(f"  bucket hits: {hits}")
+        if sv.get("padding_waste"):
+            waste = "  ".join(
+                f"{b}:{w:.1%}" for b, w in sorted(
+                    sv["padding_waste"].items(), key=lambda kv: int(kv[0])
+                )
+            )
+            lines.append(f"  padding waste (slots padded/compiled): {waste}")
         for name, s in (sv.get("latency_ms") or {}).items():
             lines.append(
                 f"  {name.replace('_', '-'):<12} (ms): mean {s['mean']:.2f}  "
@@ -530,6 +563,23 @@ def render_report(report: Dict) -> str:
             )
         elif rc_s == 0:
             lines.append("  post-warmup recompiles on the request path: none")
+    for qc in report.get("quant_checks", ()):
+        verdict = "PASSED" if qc.get("passed") else "FAILED"
+        details = []
+        for name, rec in (qc.get("outputs") or {}).items():
+            if "max_abs_delta" in rec:
+                details.append(f"{name} max|Δ| {rec['max_abs_delta']}")
+            if "iou" in rec:
+                details.append(f"{name} IoU {rec['iou']}")
+            if "disagree" in rec:
+                details.append(f"{name} disagree {rec['disagree']}")
+        line = (
+            f"\nquantize-check [{qc.get('dtype')}] {verdict}"
+            + (f": {', '.join(details)}" if details else "")
+        )
+        lines.append(line)
+        for failure in qc.get("failures") or ():
+            lines.append(f"  !! {failure}")
     tr = report.get("trace")
     if tr:
         lines.append(f"\ndevice op breakdown ({tr['dir']}):")

@@ -1,5 +1,18 @@
 """Pallas TPU kernels for the framework's hot custom ops.
 
+Second kernel: **fused inference BatchNorm + activation (+ residual add)** —
+the serving-side answer to the step profile's dominant bucket
+(PROFILE_SEG_r05.json: 53.2% of serialized device time in bandwidth-bound
+elementwise/BN fusions). At inference BN is an affine per-channel transform
+(running statistics are constants), so the whole
+``BN -> (+residual) -> activation`` chain is one read and one write of the
+activation tensor at the HBM roofline. :func:`fused_bn_act` folds the four BN
+vectors into a per-channel multiplier/offset in XLA (a [C]-sized epsilon of
+work) and runs the memory-bound part as a single VMEM-resident Pallas pass;
+:func:`fused_bn_act_reference` is the XLA oracle and the off-TPU/VMEM-overflow
+fallback. Inference-only by design — training BN needs batch statistics and a
+VJP, which the flax path already owns.
+
 First kernel: **depthwise (per-channel) 2-D convolution**, the core of the
 split-separable convolutions the ASPP head runs at atrous rates 2/4/8 and the
 decoder runs at rate 1 (reference: core/layers.py:7-49 built these from
@@ -225,3 +238,156 @@ def depthwise_conv2d(
         # kernel lowers through Mosaic, not the interpreter.
         return depthwise_conv2d_reference(x, w, rate)
     return _dw_with_grad(x, w, rate, interpret, ct)
+
+
+# -- fused inference BN + activation (+ residual) ----------------------------
+
+# the activations the models' BN chains end in; "none" covers the pre-residual
+# projection case where the add itself is the last op
+_BN_ACTIVATIONS = {
+    "none": lambda y: y,
+    "relu": lambda y: jnp.maximum(y, 0.0),
+    "relu6": lambda y: jnp.clip(y, 0.0, 6.0),
+    "sigmoid": jax.nn.sigmoid,
+    "gelu": jax.nn.gelu,
+}
+
+
+def _fold_bn(scale, bias, mean, var, eps):
+    """Inference BN as per-channel affine: ``y = x*m + b`` with
+    ``m = scale*rsqrt(var+eps)``, ``b = bias - mean*m``. Folded in float32 —
+    a [C]-sized computation, numerically the safest place to spend f32."""
+    inv = lax.rsqrt(var.astype(jnp.float32) + jnp.float32(eps))
+    m = scale.astype(jnp.float32) * inv
+    b = bias.astype(jnp.float32) - mean.astype(jnp.float32) * m
+    return m, b
+
+
+def fused_bn_act_reference(
+    x: jax.Array,
+    scale: jax.Array,
+    bias: jax.Array,
+    mean: jax.Array,
+    var: jax.Array,
+    *,
+    eps: float = 1e-3,
+    act: str = "relu",
+    residual: Optional[jax.Array] = None,
+) -> jax.Array:
+    """XLA oracle/fallback: ``act((x - mean)/sqrt(var+eps)*scale + bias
+    [+ residual])`` with f32 internal math, output in ``x``'s dtype."""
+    if act not in _BN_ACTIVATIONS:
+        raise ValueError(
+            f"act {act!r} not in {sorted(_BN_ACTIVATIONS)}"
+        )
+    m, b = _fold_bn(scale, bias, mean, var, eps)
+    y = x.astype(jnp.float32) * m + b
+    if residual is not None:
+        y = y + residual.astype(jnp.float32)
+    return _BN_ACTIVATIONS[act](y).astype(x.dtype)
+
+
+def _bn_act_kernel(x_ref, m_ref, b_ref, o_ref, *, act: str):
+    y = x_ref[0].astype(jnp.float32) * m_ref[0] + b_ref[0]
+    o_ref[0] = _BN_ACTIVATIONS[act](y).astype(o_ref.dtype)
+
+
+def _bn_act_res_kernel(x_ref, m_ref, b_ref, r_ref, o_ref, *, act: str):
+    y = x_ref[0].astype(jnp.float32) * m_ref[0] + b_ref[0]
+    y = y + r_ref[0].astype(jnp.float32)
+    o_ref[0] = _BN_ACTIVATIONS[act](y).astype(o_ref.dtype)
+
+
+def fused_bn_act(
+    x: jax.Array,
+    scale: jax.Array,
+    bias: jax.Array,
+    mean: jax.Array,
+    var: jax.Array,
+    *,
+    eps: float = 1e-3,
+    act: str = "relu",
+    residual: Optional[jax.Array] = None,
+    interpret: Optional[bool] = None,
+    vmem_limit_bytes: int = _VMEM_BLOCK_LIMIT_BYTES,
+) -> jax.Array:
+    """Fused inference BN + activation (+ residual add), Pallas where it fits.
+
+    ``x``: [B, H, W, C] activations (channels on the 128-lane dim, the
+    natural TPU layout); ``scale``/``bias``/``mean``/``var``: [C] running BN
+    parameters; ``residual``: optional [B, H, W, C] skip input added before
+    the activation. One grid step handles one image (channel-tiled like the
+    depthwise kernel when an image block overflows the VMEM budget); the BN
+    fold happens once in XLA outside the kernel, so the kernel body is
+    exactly the HBM-roofline pass: read x (+residual), multiply-add,
+    activate, write.
+
+    INFERENCE-ONLY: no custom VJP — serving graphs never differentiate it.
+    ``interpret=None`` auto-selects compiled Pallas on TPU and the
+    interpreter off-TPU (tests); falls back to the XLA reference when the
+    image block exceeds the VMEM budget or under shard_map's interpreter
+    restriction (same policy as ``depthwise_conv2d``).
+    """
+    if act not in _BN_ACTIVATIONS:
+        raise ValueError(f"act {act!r} not in {sorted(_BN_ACTIVATIONS)}")
+    if x.ndim != 4:
+        raise ValueError(f"fused_bn_act expects [B, H, W, C], got {x.shape}")
+    c = x.shape[-1]
+    for name, v in (("scale", scale), ("bias", bias), ("mean", mean), ("var", var)):
+        if v.shape != (c,):
+            raise ValueError(
+                f"{name} must be [{c}] to match x's channels, got {v.shape}"
+            )
+    if residual is not None and residual.shape != x.shape:
+        raise ValueError(
+            f"residual shape {residual.shape} != x shape {x.shape}"
+        )
+    b_, h, wdt, _ = x.shape
+    itemsize = jnp.dtype(x.dtype).itemsize
+    # the block must hold x (and the residual, when present) simultaneously
+    block_elems = h * wdt * (2 if residual is not None else 1)
+    ct = _channel_tile(c, block_elems, vmem_limit_bytes, itemsize)
+    if block_elems * ct * itemsize > vmem_limit_bytes:
+        return fused_bn_act_reference(
+            x, scale, bias, mean, var, eps=eps, act=act, residual=residual
+        )
+    if interpret is None:
+        interpret = not pallas_platform_ok()
+    if interpret and vma_of(x):
+        # same interpreter-under-shard_map restriction as the depthwise kernel
+        return fused_bn_act_reference(
+            x, scale, bias, mean, var, eps=eps, act=act, residual=residual
+        )
+    m, b = _fold_bn(scale, bias, mean, var, eps)
+    # 2-D [1, C] so the per-channel vectors land on the lane dimension
+    m2, b2 = m.reshape(1, c), b.reshape(1, c)
+    vma = vma_of(x)
+    out_shape = (
+        jax.ShapeDtypeStruct(x.shape, x.dtype, vma=vma)
+        if vma
+        else jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    x_spec = pl.BlockSpec(
+        (1, h, wdt, ct), lambda i, j: (i, 0, 0, j), memory_space=pltpu.VMEM
+    )
+    chan_spec = pl.BlockSpec(
+        (1, ct), lambda i, j: (0, j), memory_space=pltpu.VMEM
+    )
+    if residual is None:
+        kernel = functools.partial(_bn_act_kernel, act=act)
+        in_specs = [x_spec, chan_spec, chan_spec]
+        operands = (x, m2, b2)
+    else:
+        kernel = functools.partial(_bn_act_res_kernel, act=act)
+        in_specs = [x_spec, chan_spec, chan_spec, x_spec]
+        operands = (x, m2, b2, residual)
+    return pl.pallas_call(
+        kernel,
+        grid=(b_, c // ct),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, h, wdt, ct), lambda i, j: (i, 0, 0, j), memory_space=pltpu.VMEM
+        ),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*operands)

@@ -16,10 +16,14 @@ goodput goes.
 - ``serve.server``  — :class:`ServingServer`: stdlib ``ThreadingHTTPServer``
   exposing ``/v1/predict`` / ``/healthz`` / ``/metrics``, graceful
   drain-on-shutdown, and ``serve_window`` events in the workdir's
-  ``telemetry.jsonl`` (rendered by ``obs.report`` / ``telemetry-report``).
+  ``telemetry.jsonl`` (rendered by ``obs.report`` / ``telemetry-report``);
+- ``serve.quant_check`` — :func:`run_quant_check`: the accuracy gate between
+  a float32 artifact and its bf16/int8 sibling (pinned eval batch,
+  per-precision thresholds, ``quant_check`` ledger events).
 
 CLI: ``python -m tensorflowdistributedlearning_tpu serve --artifact-dir D``;
-load generator + batched-vs-per-request benchmark: ``tools/bench_serve.py``.
+accuracy gate: ``... quantize-check --reference-dir F32 --candidate-dir Q``;
+load generator + precision A/B benchmark: ``tools/bench_serve.py [--quant]``.
 """
 
 from tensorflowdistributedlearning_tpu.serve.batcher import (
@@ -34,10 +38,15 @@ from tensorflowdistributedlearning_tpu.serve.engine import (
     InferenceEngine,
     RequestTooLargeError,
 )
+from tensorflowdistributedlearning_tpu.serve.quant_check import (
+    DEFAULT_THRESHOLDS,
+    run_quant_check,
+)
 from tensorflowdistributedlearning_tpu.serve.server import ServingServer
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "DEFAULT_THRESHOLDS",
     "DeadlineExceededError",
     "InferenceEngine",
     "MicroBatcher",
@@ -46,4 +55,5 @@ __all__ = [
     "RequestTooLargeError",
     "ServerClosedError",
     "ServingServer",
+    "run_quant_check",
 ]

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import bisect
 import logging
+import threading
 import time
 from typing import Callable, Dict, Optional, Sequence, Tuple
 
@@ -60,8 +61,9 @@ class InferenceEngine:
     ``serve_fn`` maps ``x [B, *example_shape] -> pytree of arrays [B, ...]``
     with parameters baked in (exactly what ``train/serving.py`` artifacts and
     the trainers' ``serving_fn()`` closures provide). ``infer`` is thread-safe:
-    it owns no mutable state beyond registry instruments, whose updates are
-    GIL-atomic appends/increments.
+    registry instrument updates are GIL-atomic appends/increments, and the
+    pad scratch buffers are thread-local (the single batcher worker
+    materializes exactly one ladder of them).
     """
 
     def __init__(
@@ -72,6 +74,7 @@ class InferenceEngine:
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         input_dtype="float32",
         registry: Optional[MetricsRegistry] = None,
+        quantization: Optional[Dict] = None,
     ):
         self.serve_fn = serve_fn
         self.example_shape = tuple(int(d) for d in example_shape)
@@ -79,6 +82,10 @@ class InferenceEngine:
         if not self.buckets or self.buckets[0] < 1:
             raise ValueError(f"buckets must be positive ints, got {buckets!r}")
         self.input_dtype = np.dtype(input_dtype)
+        # manifest self-description of the artifact's precision recipe
+        # (train/quantize.py section); None for raw closures / legacy
+        # artifacts — informational: the graph itself carries the dtypes
+        self.quantization = quantization
         self.registry = registry if registry is not None else MetricsRegistry()
         self._pad_h = self.registry.histogram("serve/pad")
         self._compute_h = self.registry.histogram("serve/compute")
@@ -87,9 +94,19 @@ class InferenceEngine:
             b: self.registry.counter(f"serve/bucket_hits/{b}")
             for b in self.buckets
         }
-        # per-bucket zero pad template, filled lazily: the request path slices
-        # a view instead of allocating fresh zeros every call
-        self._pad_zeros: Dict[int, np.ndarray] = {}
+        # real examples per bucket, beside the hit counts: hits*bucket vs
+        # examples is the ladder's padding-waste — the utilization signal
+        # that says whether the ladder fits the traffic
+        self._example_counters = {
+            b: self.registry.counter(f"serve/bucket_examples/{b}")
+            for b in self.buckets
+        }
+        # per-bucket scratch pad the request path copies into instead of
+        # allocating (np.concatenate allocated a fresh bucket-sized array
+        # per dispatch); thread-local so concurrent infer() callers never
+        # share a buffer — one worker thread (the batcher) materializes
+        # exactly one ladder of buffers
+        self._scratch = threading.local()
         self.warmed = False
 
     @classmethod
@@ -127,8 +144,11 @@ class InferenceEngine:
             serve,
             tuple(shape[1:]),
             buckets=buckets,
-            input_dtype=manifest.get("input_dtype", "float32"),
+            # read_manifest applied the legacy float32 default (and rejected
+            # corrupt quantization metadata) — the engine just consumes
+            input_dtype=manifest["input_dtype"],
             registry=registry,
+            quantization=manifest.get("quantization"),
         )
 
     @property
@@ -141,6 +161,33 @@ class InferenceEngine:
             b: self.registry.counter(f"serve/bucket_hits/{b}").value
             for b in self.buckets
         }
+
+    @property
+    def padding_waste(self) -> Dict[int, float]:
+        """Per-bucket fraction of compiled batch slots filled with padding:
+        ``1 - examples / (hits * bucket)``. Only buckets that saw traffic
+        appear — 32-client closed-loop traffic all landing in bucket 64
+        shows up as waste 0.5 there (at most 32 live rows per compiled
+        64-slot batch), an all-singletons pattern through bucket 4 as
+        waste 0.75."""
+        waste: Dict[int, float] = {}
+        for b in self.buckets:
+            hits = self._hit_counters[b].value
+            if hits:
+                examples = self._example_counters[b].value
+                waste[b] = round(1.0 - examples / (hits * b), 4)
+        return waste
+
+    def _scratch_for(self, bucket: int) -> np.ndarray:
+        bufs = getattr(self._scratch, "bufs", None)
+        if bufs is None:
+            bufs = self._scratch.bufs = {}
+        buf = bufs.get(bucket)
+        if buf is None:
+            buf = bufs[bucket] = np.zeros(
+                (bucket, *self.example_shape), self.input_dtype
+            )
+        return buf
 
     def select_bucket(self, n: int) -> int:
         """Smallest bucket that fits ``n`` examples."""
@@ -164,17 +211,25 @@ class InferenceEngine:
 
         timings: Dict[int, float] = {}
         for b in self.buckets:
+            # transient zeros: the request-path scratch pads are thread-local
+            # and the batcher worker is a different thread than the one
+            # running warmup — filling this thread's ladder would just leave
+            # a dead duplicate alive for the engine's lifetime
             x = np.zeros((b, *self.example_shape), self.input_dtype)
             t0 = time.perf_counter()
             jax.block_until_ready(self.serve_fn(x))
             timings[b] = round(time.perf_counter() - t0, 6)
         self.warmed = True
         if telemetry is not None:
+            warm_fields = {}
+            if self.quantization is not None:
+                warm_fields["serving_dtype"] = self.quantization.get("dtype")
             telemetry.event(
                 "serve_warmup",
                 buckets={str(b): s for b, s in timings.items()},
                 example_shape=list(self.example_shape),
                 input_dtype=str(self.input_dtype),
+                **warm_fields,
             )
             telemetry.mark_warm()
         return timings
@@ -194,15 +249,19 @@ class InferenceEngine:
         bucket = self.select_bucket(n)
         t0 = time.perf_counter()
         if n != bucket:
-            zeros = self._pad_zeros.get(bucket)
-            if zeros is None:
-                zeros = self._pad_zeros[bucket] = np.zeros(
-                    (bucket, *self.example_shape), self.input_dtype
-                )
-            x = np.concatenate([x, zeros[: bucket - n]])
+            # copy into the bucket's reusable scratch pad (zeroing the tail,
+            # which may hold rows from a previous, fuller dispatch) instead
+            # of concatenating into a fresh allocation every call. infer()
+            # blocks until the device result is ready before returning, so
+            # within a thread the buffer is never overwritten mid-compute.
+            buf = self._scratch_for(bucket)
+            buf[:n] = x
+            buf[n:] = 0
+            x = buf
         self._pad_h.record(time.perf_counter() - t0)
         t0 = time.perf_counter()
         out = jax.block_until_ready(self.serve_fn(x))
         self._compute_h.record(time.perf_counter() - t0)
         self._hit_counters[bucket].inc()
+        self._example_counters[bucket].inc(n)
         return _tree_map(lambda a: np.asarray(a)[:n], out)

@@ -1,0 +1,222 @@
+"""quantize-check: the accuracy gate between an f32 artifact and its
+quantized sibling.
+
+A quantized serving artifact is a *candidate*: it ships only if its outputs
+stay within a per-precision accuracy budget of the float32 reference it was
+derived from. This module runs both artifacts over a **pinned eval batch**
+(deterministic, derived from the manifest's input signature and a seed — the
+same bytes every run, every machine) and fails when any output's delta
+exceeds the precision's threshold. That makes it promotion-pipeline-ready
+(ROADMAP item 4): the promotion controller can call ``run_quant_check`` as a
+hard gate, and every verdict lands in the run ledger as a ``quant_check``
+event that ``telemetry-report`` renders.
+
+Pairing is verified before numerics: both manifests carry a source
+fingerprint (sha256 over the float32 params, train/quantize.py), and a
+mismatch fails the check outright — comparing artifacts from different
+checkpoints produces a meaningless (and often accidentally-passing) delta.
+
+Deltas measured per output:
+
+- floating outputs: max/mean absolute delta (probabilities, logits);
+- binary-valued outputs (segmentation masks — float {0,1}): IoU between
+  the two masks;
+- integer outputs (argmax class ids): disagreement fraction.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# Per-precision accuracy budgets, in output units (probabilities/masks in
+# [0,1]). bf16 keeps ~3 significant digits — rounding alone cannot move a
+# probability by 0.05 unless the model amplifies it, which is exactly what
+# the gate exists to catch. int8 weight-quantization error is larger and
+# model-dependent; the defaults are the loosest budget a production gate
+# should bless. float32 candidates must be bit-exact up to run-to-run fusion
+# jitter. All overridable per-run (CLI flags / thresholds=).
+DEFAULT_THRESHOLDS: Dict[str, Dict[str, float]] = {
+    "float32": {
+        "max_abs_delta": 1e-5,
+        "mean_abs_delta": 1e-6,
+        "min_iou": 1.0,
+        "max_disagree": 0.0,
+    },
+    "bfloat16": {
+        "max_abs_delta": 0.05,
+        "mean_abs_delta": 0.01,
+        "min_iou": 0.98,
+        "max_disagree": 0.02,
+    },
+    "int8": {
+        "max_abs_delta": 0.15,
+        "mean_abs_delta": 0.03,
+        "min_iou": 0.95,
+        "max_disagree": 0.05,
+    },
+}
+
+
+def pinned_eval_batch(manifest: Dict, batch_size: int, seed: int = 0) -> np.ndarray:
+    """The deterministic probe batch both artifacts are compared on:
+    standard-normal values (the models' inputs are normalized images) shaped
+    from the manifest's input signature. A fixed-batch artifact pins the
+    batch dimension itself; polymorphic ones take ``batch_size``."""
+    shape = list(manifest["input_shape"])
+    if shape[0] is not None:
+        batch_size = int(shape[0])
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((batch_size, *shape[1:])).astype(np.float32)
+
+
+def _is_binary(a: np.ndarray) -> bool:
+    return a.size > 0 and bool(np.isin(np.unique(a), (0, 1)).all())
+
+
+def _output_delta(name: str, ref: np.ndarray, cand: np.ndarray) -> Dict:
+    """Delta record for one output; the applicable threshold keys depend on
+    which of the three output kinds this is."""
+    if ref.shape != cand.shape:
+        return {"error": f"shape mismatch: {ref.shape} vs {cand.shape}"}
+    if np.issubdtype(ref.dtype, np.integer) or np.issubdtype(
+        cand.dtype, np.integer
+    ):
+        return {
+            "kind": "integer",
+            "disagree": round(float(np.mean(ref != cand)), 6),
+        }
+    ref64 = ref.astype(np.float64)
+    cand64 = cand.astype(np.float64)
+    delta = np.abs(ref64 - cand64)
+    rec = {
+        "kind": "float",
+        "max_abs_delta": round(float(delta.max()), 6) if delta.size else 0.0,
+        "mean_abs_delta": round(float(delta.mean()), 6) if delta.size else 0.0,
+    }
+    if _is_binary(ref64) and _is_binary(cand64):
+        rec["kind"] = "binary"
+        inter = float(np.sum((ref64 > 0.5) & (cand64 > 0.5)))
+        union = float(np.sum((ref64 > 0.5) | (cand64 > 0.5)))
+        rec["iou"] = round(inter / union, 6) if union else 1.0
+    return rec
+
+
+def run_quant_check(
+    reference_dir: str,
+    candidate_dir: str,
+    *,
+    batch_size: int = 16,
+    seed: int = 0,
+    thresholds: Optional[Dict[str, float]] = None,
+    allow_fingerprint_mismatch: bool = False,
+    telemetry=None,
+) -> Dict:
+    """Compare two exported artifacts over the pinned eval batch.
+
+    Returns the verdict record (also ledgered as a ``quant_check`` event when
+    ``telemetry`` is passed): per-output deltas, the thresholds applied, the
+    failure list, and ``passed``. The candidate's precision — hence its
+    budget — comes from its own manifest's ``quantization.dtype`` (legacy
+    manifests gate as float32).
+    """
+    import jax
+
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    ref_manifest = serving_lib.read_manifest(reference_dir)
+    cand_manifest = serving_lib.read_manifest(candidate_dir)
+    dtype = (cand_manifest.get("quantization") or {}).get("dtype", "float32")
+    limits = dict(DEFAULT_THRESHOLDS.get(dtype, DEFAULT_THRESHOLDS["int8"]))
+    if thresholds:
+        limits.update({k: v for k, v in thresholds.items() if v is not None})
+
+    failures = []
+    ref_fp = (ref_manifest.get("quantization") or {}).get("source_fingerprint")
+    cand_fp = (cand_manifest.get("quantization") or {}).get(
+        "source_fingerprint"
+    )
+    if ref_fp and cand_fp and ref_fp != cand_fp:
+        msg = (
+            "source fingerprint mismatch — the artifacts derive from "
+            "different checkpoints, the comparison is meaningless"
+        )
+        if allow_fingerprint_mismatch:
+            logger.warning("quantize-check: %s (allowed by flag)", msg)
+        else:
+            failures.append(msg)
+
+    batch = pinned_eval_batch(cand_manifest, batch_size, seed)
+    outputs: Dict[str, Dict] = {}
+    if not failures:  # a wrong pairing makes the numerics noise; skip them
+        ref_fn = serving_lib.load_serving_artifact(reference_dir)
+        cand_fn = serving_lib.load_serving_artifact(candidate_dir)
+        ref_out = jax.device_get(ref_fn(batch))
+        cand_out = jax.device_get(cand_fn(batch))
+        if set(ref_out) != set(cand_out):
+            failures.append(
+                f"output names differ: {sorted(ref_out)} vs {sorted(cand_out)}"
+            )
+        for name in sorted(set(ref_out) & set(cand_out)):
+            rec = _output_delta(
+                name, np.asarray(ref_out[name]), np.asarray(cand_out[name])
+            )
+            outputs[name] = rec
+            if "error" in rec:
+                failures.append(f"{name}: {rec['error']}")
+                continue
+            if rec["kind"] == "integer":
+                if rec["disagree"] > limits["max_disagree"]:
+                    failures.append(
+                        f"{name}: disagreement {rec['disagree']} > "
+                        f"{limits['max_disagree']}"
+                    )
+                continue
+            if rec["kind"] == "binary":
+                # a binary mask's max|delta| is 1.0 the moment ANY pixel
+                # flips near the decision threshold, so the float budgets
+                # would fail every quantized segmentation artifact; masks
+                # gate on IoU and the disagreement fraction (which IS the
+                # mean |delta| of a {0,1} pair)
+                if rec["mean_abs_delta"] > limits["max_disagree"]:
+                    failures.append(
+                        f"{name}: mask disagreement {rec['mean_abs_delta']} "
+                        f"> {limits['max_disagree']}"
+                    )
+                if rec["iou"] < limits["min_iou"]:
+                    failures.append(
+                        f"{name}: IoU {rec['iou']} < {limits['min_iou']}"
+                    )
+                continue
+            if rec["max_abs_delta"] > limits["max_abs_delta"]:
+                failures.append(
+                    f"{name}: max|delta| {rec['max_abs_delta']} > "
+                    f"{limits['max_abs_delta']}"
+                )
+            if rec["mean_abs_delta"] > limits["mean_abs_delta"]:
+                failures.append(
+                    f"{name}: mean|delta| {rec['mean_abs_delta']} > "
+                    f"{limits['mean_abs_delta']}"
+                )
+
+    result = {
+        "reference": reference_dir,
+        "candidate": candidate_dir,
+        "dtype": dtype,
+        "batch": list(batch.shape),
+        "seed": seed,
+        "thresholds": limits,
+        "outputs": outputs,
+        "fingerprint_match": (
+            None if not (ref_fp and cand_fp) else ref_fp == cand_fp
+        ),
+        "failures": failures,
+        "passed": not failures,
+    }
+    if telemetry is not None:
+        telemetry.event("quant_check", **result)
+    return result

@@ -153,15 +153,21 @@ class ServingServer:
     def metrics_snapshot(self) -> Dict:
         """The ``/metrics`` body: live registry view + serving identity."""
         reg = self.engine.registry
-        return {
+        snapshot = {
             "uptime_s": round(time.time() - self._started_t, 3),
             "draining": self.draining,
             "buckets": {str(b): n for b, n in self.engine.bucket_hits.items()},
+            "padding_waste": {
+                str(b): w for b, w in self.engine.padding_waste.items()
+            },
             "queue_depth": reg.gauge("serve/queue_depth").value or 0,
             # histograms here are "since the last ledger window" — the window
             # drain keeps a long-lived server's sample memory bounded
             "registry": reg.snapshot(),
         }
+        if self.engine.quantization is not None:
+            snapshot["serving_dtype"] = self.engine.quantization.get("dtype")
+        return snapshot
 
     def emit_window(self, final: bool = False) -> Dict:
         """One ``serve_window`` ledger event: cumulative counters, this
@@ -174,6 +180,13 @@ class ServingServer:
         fields["bucket_hits"] = {
             str(b): n for b, n in self.engine.bucket_hits.items()
         }
+        # ladder utilization: fraction of compiled batch slots filled with
+        # padding, per bucket that saw traffic (cumulative, like the hits)
+        waste = self.engine.padding_waste
+        if waste:
+            fields["padding_waste"] = {str(b): w for b, w in waste.items()}
+        if self.engine.quantization is not None:
+            fields["serving_dtype"] = self.engine.quantization.get("dtype")
         latency: Dict = {}
         for name in _WINDOW_HISTOGRAMS:
             samples = reg.histogram(f"serve/{name}").drain()

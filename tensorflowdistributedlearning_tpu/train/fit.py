@@ -808,12 +808,16 @@ class ClassifierTrainer:
         finally:
             ckpt.close()
 
-    def serving_fn(self):
+    def serving_fn(self, serving_dtype: str = "float32"):
         """Jitted single-model inference for deployment: ``serve(images) ->
         {'probabilities', 'class'}`` on the best state — the classification twin
         of the K-fold Trainer's serving_fn (reference exported SavedModels via
         BestExporter, model.py:190-204). Honors ``data_format='NCHW'`` at the
-        boundary exactly like the segmentation path."""
+        boundary exactly like the segmentation path, and the same
+        ``serving_dtype`` precision recipes (train/quantize.py): float32 wire
+        contract either way, quantized constants inside; the closure carries
+        its manifest section as ``serve.quantization``."""
+        from tensorflowdistributedlearning_tpu.train import quantize
         from tensorflowdistributedlearning_tpu.train.trainer import _forward_cached
 
         # EMA-trained models serve the averaged weights even when restore fell
@@ -822,6 +826,10 @@ class ClassifierTrainer:
         state = step_lib.with_ema_params(self._restore_best_host()).replace(
             opt_state=None
         )
+        qparams, qstats, quant_section = quantize.quantize_state(
+            state.params, state.batch_stats, serving_dtype
+        )
+        act_dtype = quantize.compute_dtype(serving_dtype)
         task = self.task
         forward = _forward_cached(self._plain_model)
         nchw = self.train_config.data_format == "NCHW"
@@ -829,16 +837,29 @@ class ClassifierTrainer:
         def serve(images):
             if nchw:
                 images = jax.numpy.transpose(images, (0, 2, 3, 1))
-            return task.predictions(forward(state, images))
+            st = state.replace(
+                params=quantize.dequantize_pytree(qparams, act_dtype),
+                batch_stats=quantize.dequantize_pytree(qstats, act_dtype),
+            )
+            out = task.predictions(forward(st, images.astype(act_dtype)))
+            return quantize.cast_outputs_float32(out)
 
+        serve.quantization = quant_section
         return serve
 
-    def export_serving(self, directory: Optional[str] = None) -> str:
+    def export_serving(
+        self,
+        directory: Optional[str] = None,
+        serving_dtype: str = "float32",
+    ) -> str:
         """Standalone serialized-StableHLO serving artifact for the best state
-        (see train/serving.py); default location ``{model_dir}/export/serving``."""
+        (see train/serving.py); default location ``{model_dir}/export/serving``
+        (``serving-{dtype}`` for quantized exports, so the f32 reference and
+        its quantize-check candidates coexist)."""
         from tensorflowdistributedlearning_tpu.train import serving as serving_lib
 
-        directory = directory or os.path.join(self.model_dir, "export", "serving")
+        suffix = "serving" if serving_dtype == "float32" else f"serving-{serving_dtype}"
+        directory = directory or os.path.join(self.model_dir, "export", suffix)
         cfg = self.model_config
         h, w = cfg.input_shape
         shape = (
@@ -846,8 +867,9 @@ class ClassifierTrainer:
             if self.train_config.data_format == "NCHW"
             else (1, h, w, cfg.input_channels)
         )
+        serve = self.serving_fn(serving_dtype=serving_dtype)
         return serving_lib.export_serving_artifact(
-            self.serving_fn(),
+            serve,
             shape,
             directory,
             metadata={
@@ -856,6 +878,7 @@ class ClassifierTrainer:
                 "backbone": cfg.backbone,
                 "data_format": self.train_config.data_format,
             },
+            quantization=serve.quantization,
         )
 
     @property

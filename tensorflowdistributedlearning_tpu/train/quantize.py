@@ -1,0 +1,268 @@
+"""Post-training quantization for serving export: bf16 casts and int8 weights.
+
+The training stack already runs bf16 recipes; serving exported the float32
+training graph and paid full-precision HBM bandwidth on every request even
+though the step profile is dominated by bandwidth-bound elementwise/BN fusions
+(PROFILE_SEG_r05.json: 53.2%). This module is the export-side half of the
+quantized serving path: it transforms a restored state's pytrees ONCE at
+export time, so the serialized StableHLO artifact carries low-precision
+constants — the weights are genuinely small at rest and in HBM, and the
+engine (serve/engine.py) needs nothing but the manifest to execute them.
+
+Precision recipes (the standard PTQ-for-serving ladder, Gemma-on-TPU
+serving, arXiv:2605.25645):
+
+- ``bfloat16``: every floating leaf casts to bf16; compute runs bf16.
+- ``int8``: weight-only quantization — conv/dense **kernels** (floating
+  leaves named ``kernel`` with >= 2 dims) store as int8 with per-channel
+  symmetric scales over the output-channel axis (-1); everything else
+  (biases, BN scale/bias, batch_stats) casts to bf16, and activations stay
+  bf16. The serve closure dequantizes inside the traced graph, so the
+  artifact reads int8 from HBM and upcasts in registers.
+- ``float32``: identity — the pre-quantization graph, bit-for-bit. Still
+  stamped with a manifest section so every artifact is self-describing.
+
+Every artifact's manifest ``quantization`` section carries the serving dtype,
+per-tensor scale metadata, and a **source fingerprint** (sha256 over the
+float32 params) so the accuracy gate (serve/quant_check.py) can verify an
+f32/quantized pair really came from the same checkpoint before comparing
+outputs — the promotion-pipeline pairing contract (ROADMAP item 4).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections.abc import Mapping
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+SERVING_DTYPES = ("float32", "bfloat16", "int8")
+
+# the int8 recipe quantizes exactly the matmul/conv weights; the leaf name is
+# the flax convention shared by nn.Conv / nn.Dense / DepthwiseConv2D
+_KERNEL_LEAF = "kernel"
+_INT8_AXIS = -1  # output channels: the last dim of conv [kh,kw,cin,cout]
+# and dense [in,out] kernels
+
+# marker key for a quantized leaf's record dict — chosen to be impossible as
+# a flax module name, so tree traversal can tell records from submodules
+_QKEY = "__int8__"
+
+
+def check_serving_dtype(serving_dtype: str) -> str:
+    if serving_dtype not in SERVING_DTYPES:
+        raise ValueError(
+            f"serving_dtype {serving_dtype!r} not in {SERVING_DTYPES}"
+        )
+    return serving_dtype
+
+
+def compute_dtype(serving_dtype: str):
+    """The activation dtype a serving graph runs in for a given recipe."""
+    import jax.numpy as jnp
+
+    check_serving_dtype(serving_dtype)
+    return jnp.float32 if serving_dtype == "float32" else jnp.bfloat16
+
+
+def fingerprint_tree(tree) -> str:
+    """sha256 over (path, dtype, shape, bytes) of every leaf — the identity
+    of a params pytree, stable across export runs and serving dtypes (always
+    computed on the SOURCE tree, before any cast/quantize)."""
+    import jax
+
+    h = hashlib.sha256()
+    for path, leaf in sorted(
+        jax.tree_util.tree_flatten_with_path(tree)[0],
+        key=lambda kv: jax.tree_util.keystr(kv[0]),
+    ):
+        arr = np.asarray(leaf)
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return "sha256:" + h.hexdigest()
+
+
+def _is_quant_record(node) -> bool:
+    return isinstance(node, Mapping) and _QKEY in node
+
+
+def _quantize_leaf_int8(arr: np.ndarray) -> Dict[str, Any]:
+    """Per-channel symmetric int8 over the last axis: scale = max|w|/127,
+    q = round(w/scale) in [-127, 127]. All-zero channels keep scale 1.0 so
+    dequantization never divides by (or multiplies garbage with) zero."""
+    a = np.asarray(arr, np.float32)
+    max_abs = np.max(np.abs(a), axis=tuple(range(a.ndim - 1)))
+    scale = np.where(max_abs > 0, max_abs / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
+    return {_QKEY: True, "q": q, "scale": scale}
+
+
+def _walk(tree, path, fn):
+    # Mapping, not dict: flax FrozenDict params must recurse too — matching
+    # dict alone would pass a frozen tree through as one opaque "leaf" and
+    # export a full-precision artifact whose manifest claims it is quantized
+    if isinstance(tree, Mapping):
+        return {k: _walk(v, path + (k,), fn) for k, v in tree.items()}
+    return fn(path, tree)
+
+
+def quantize_pytree(tree, serving_dtype: str) -> Tuple[Any, Dict]:
+    """Transform a (nested-dict) params/batch_stats pytree for export.
+
+    Returns ``(qtree, section)`` where ``section`` is the manifest
+    ``quantization`` dict (dtype, per-tensor scale metadata, source
+    fingerprint). ``float32`` returns the tree untouched; ``bfloat16`` casts
+    floating leaves; ``int8`` replaces kernel leaves with
+    ``{__int8__, q, scale}`` records and casts the rest to bf16.
+    ``dequantize_pytree`` inverts the transform inside the traced graph.
+    """
+    import jax.numpy as jnp
+
+    check_serving_dtype(serving_dtype)
+    section: Dict[str, Any] = {
+        "dtype": serving_dtype,
+        "source_fingerprint": fingerprint_tree(tree),
+    }
+    if serving_dtype == "float32":
+        return tree, section
+
+    scales: Dict[str, Dict] = {}
+
+    def convert(path, leaf):
+        arr = np.asarray(leaf)
+        if not np.issubdtype(arr.dtype, np.floating):
+            return leaf  # int leaves (counters, ids) pass through untouched
+        if (
+            serving_dtype == "int8"
+            and path
+            and path[-1] == _KERNEL_LEAF
+            and arr.ndim >= 2
+        ):
+            rec = _quantize_leaf_int8(arr)
+            scales["/".join(path)] = {
+                "shape": list(rec["scale"].shape),
+                "axis": _INT8_AXIS,
+                "scale_min": float(rec["scale"].min()),
+                "scale_max": float(rec["scale"].max()),
+            }
+            return rec
+        return jnp.asarray(arr, jnp.bfloat16)
+
+    qtree = _walk(tree, (), convert)
+    if serving_dtype == "int8":
+        section["scheme"] = "per-channel-symmetric"
+        section["scales"] = scales
+    return qtree, section
+
+
+def dequantize_pytree(qtree, dtype=None):
+    """Rebuild a float tree from ``quantize_pytree``'s output — jit-traceable,
+    so calling it inside a serve closure bakes the low-precision constants
+    (and the cheap upcast) into the exported graph. ``dtype`` is the target
+    activation dtype for int8 records (default bf16); already-cast bf16 / f32
+    leaves pass through untouched."""
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if dtype is None else dtype
+
+    def restore(node):
+        if _is_quant_record(node):
+            # jnp.asarray FIRST: the int8 values must enter the trace as an
+            # int8 constant with a traced convert op after it — numpy's
+            # eager .astype would upcast at trace time and the artifact
+            # would serialize bf16 constants, silently doubling its weight
+            # bytes at rest (caught by the artifact-size assertion in
+            # tests/test_quant_serve.py)
+            q = jnp.asarray(node["q"])
+            return q.astype(dtype) * jnp.asarray(node["scale"], dtype)
+        if isinstance(node, Mapping):
+            return {k: restore(v) for k, v in node.items()}
+        return node
+
+    return restore(qtree)
+
+
+def quantize_state(params, batch_stats, serving_dtype: str):
+    """The trainers' one-call entry: quantize params and batch_stats with a
+    single manifest section whose fingerprint covers the PARAMS tree (the
+    identity a checkpoint is selected by)."""
+    qparams, section = quantize_pytree(params, serving_dtype)
+    if batch_stats is not None:
+        qstats, _ = quantize_pytree(batch_stats, serving_dtype)
+        # batch_stats never holds kernels: drop the redundant empty scale map
+    else:
+        qstats = None
+    return qparams, qstats, section
+
+
+def cast_outputs_float32(out: Dict):
+    """Serving boundary contract: float outputs leave as float32 regardless
+    of the internal compute dtype (clients, the accuracy gate, and the HTTP
+    JSON encoder all see one stable dtype); integer outputs (class ids,
+    binary masks already cast by the task) pass through."""
+    import jax.numpy as jnp
+
+    def cast(v):
+        if jnp.issubdtype(v.dtype, jnp.floating) and v.dtype != jnp.float32:
+            return v.astype(jnp.float32)
+        return v
+
+    return {k: cast(v) for k, v in out.items()}
+
+
+def validate_quantization(section) -> Dict:
+    """Manifest ``quantization`` section validation — the corrupt-artifact
+    gate ``read_manifest`` applies. Raises ``ValueError`` with a pointed
+    message; returns the section for chaining."""
+    if not isinstance(section, dict):
+        raise ValueError(
+            f"manifest quantization section must be a dict, got "
+            f"{type(section).__name__}"
+        )
+    dtype = section.get("dtype")
+    if dtype not in SERVING_DTYPES:
+        raise ValueError(
+            f"manifest quantization.dtype {dtype!r} not in {SERVING_DTYPES}"
+        )
+    scales = section.get("scales")
+    if dtype == "int8":
+        if not isinstance(scales, dict) or not scales:
+            raise ValueError(
+                "int8 manifest must carry non-empty quantization.scales "
+                "metadata — an int8 recipe that quantized zero tensors is a "
+                "broken export, not a precision"
+            )
+        for name, meta in scales.items():
+            if not isinstance(meta, dict):
+                raise ValueError(
+                    f"quantization.scales[{name!r}] must be a dict"
+                )
+            shape = meta.get("shape")
+            if not (
+                isinstance(shape, list)
+                and all(isinstance(d, int) and d > 0 for d in shape)
+            ):
+                raise ValueError(
+                    f"quantization.scales[{name!r}].shape corrupt: {shape!r}"
+                )
+            for key in ("scale_min", "scale_max"):
+                v = meta.get(key)
+                if not isinstance(v, (int, float)) or not np.isfinite(v) or v <= 0:
+                    raise ValueError(
+                        f"quantization.scales[{name!r}].{key} corrupt: {v!r} "
+                        "(scales are strictly positive finite floats)"
+                    )
+            if meta["scale_min"] > meta["scale_max"]:
+                raise ValueError(
+                    f"quantization.scales[{name!r}] corrupt: scale_min "
+                    f"{meta['scale_min']} > scale_max {meta['scale_max']}"
+                )
+    elif scales:
+        raise ValueError(
+            f"quantization.scales present on a {dtype} manifest — only int8 "
+            "artifacts carry scale metadata"
+        )
+    return section

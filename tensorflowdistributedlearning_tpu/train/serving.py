@@ -65,6 +65,7 @@ def export_serving_artifact(
     batch_polymorphic: bool = True,
     input_dtype: str = "float32",
     metadata: Dict | None = None,
+    quantization: Dict | None = None,
 ) -> str:
     """Serialize ``serve_fn`` (a jittable ``images -> {...}`` closure with params
     baked in) for the given input signature; returns the artifact path.
@@ -73,6 +74,11 @@ def export_serving_artifact(
     ``batch_polymorphic=True`` replaces the batch dim with a symbolic size so one
     artifact serves any batch size (the reference's ``[None, 101, 101, 2]``
     placeholder semantics, model.py:192).
+
+    ``quantization`` is the manifest section ``train/quantize.py`` produced
+    alongside the (possibly quantized) ``serve_fn`` — serving dtype, per-tensor
+    scale metadata, source fingerprint. Validated before writing, so a corrupt
+    section fails the EXPORT, not some later load.
     """
     from jax import export as jax_export
 
@@ -106,6 +112,10 @@ def export_serving_artifact(
         "platforms": list(getattr(exported, "platforms", ())),
         **(metadata or {}),
     }
+    if quantization is not None:
+        from tensorflowdistributedlearning_tpu.train import quantize
+
+        manifest["quantization"] = quantize.validate_quantization(quantization)
     with open(os.path.join(directory, MANIFEST_NAME), "w") as f:
         json.dump(manifest, f, indent=2)
     return artifact
@@ -115,17 +125,21 @@ def load_serving_artifact(directory: str) -> Callable:
     """Deserialize an exported artifact; returns ``serve(images) -> outputs``.
     Needs only jax — none of this framework's modules or checkpoints. The
     input dtype comes from the manifest (an artifact exported for bfloat16
-    inputs used to be silently fed float32); a missing/legacy manifest falls
-    back to float32, the historical contract."""
+    inputs used to be silently fed float32); a MISSING manifest falls back to
+    float32, the historical contract — a present-but-corrupt one (bad dtype
+    string, invalid quantization section) raises, because executing an
+    artifact whose self-description cannot be trusted is how silently-wrong
+    answers ship."""
     from jax import export as jax_export
 
     with open(os.path.join(directory, ARTIFACT_NAME), "rb") as f:
         payload = f.read()
     exported = jax_export.deserialize(bytearray(payload))
     try:
-        dtype = jnp.dtype(read_manifest(directory).get("input_dtype", "float32"))
-    except (OSError, ValueError, TypeError):
-        dtype = jnp.dtype("float32")
+        manifest = read_manifest(directory)
+    except OSError:
+        manifest = {"input_dtype": "float32"}
+    dtype = jnp.dtype(manifest["input_dtype"])
 
     def serve(images) -> Dict:
         return exported.call(jnp.asarray(images, dtype))
@@ -134,5 +148,16 @@ def load_serving_artifact(directory: str) -> Callable:
 
 
 def read_manifest(directory: str) -> Dict:
+    """Read + validate an artifact manifest. The ONE site that applies the
+    legacy defaults (pre-input_dtype manifests mean float32; no
+    ``quantization`` section means an unquantized float32 graph) and the one
+    gate that rejects corrupt quantization metadata — every consumer
+    (engine, loader, quantize-check, CLI) reads through here."""
+    from tensorflowdistributedlearning_tpu.train import quantize
+
     with open(os.path.join(directory, MANIFEST_NAME)) as f:
-        return json.load(f)
+        manifest = json.load(f)
+    manifest.setdefault("input_dtype", "float32")
+    if "quantization" in manifest:
+        quantize.validate_quantization(manifest["quantization"])
+    return manifest

@@ -763,7 +763,7 @@ class Trainer:
         finally:
             ckpt.close()
 
-    def serving_fn(self, fold: int):
+    def serving_fn(self, fold: int, serving_dtype: str = "float32"):
         """Jitted single-model inference function for deployment — the JAX analogue
         of the reference's exported SavedModel with serving signature
         ``image: [None, H, W, input_channels] float32`` (reference: model.py:190-194).
@@ -773,11 +773,21 @@ class Trainer:
         (normalized + Laplacian channel, exactly what the reference's serving
         placeholder received).
 
+        ``serving_dtype`` selects the post-training precision recipe
+        (train/quantize.py): ``float32`` is the training graph unchanged,
+        ``bfloat16`` casts params/batch_stats and runs bf16 activations,
+        ``int8`` stores conv/dense kernels as int8 with per-channel scales
+        (dequantized to bf16 inside the graph). Wire contract is constant
+        across recipes: float32 in, float32 out. The returned closure carries
+        its manifest ``quantization`` section as ``serve.quantization``.
+
         ``data_format="NCHW"`` is honored at this boundary: inputs arrive
         ``[B, C, H, W]`` and outputs return ``[B, 1, H, W]`` (the reference's NCHW
         mode transposed at the top of model_fn, model.py:344-351; on TPU, XLA owns
         the internal layout, so the transpose happens exactly once, here).
         """
+        from tensorflowdistributedlearning_tpu.train import quantize
+
         state = self._restore_fold_or_raise(fold, self._init_state())
         # EMA-trained models serve the averaged weights even when restore fell
         # back to a periodic (live-trajectory) checkpoint; identity otherwise
@@ -785,6 +795,10 @@ class Trainer:
         # serving reads params/batch_stats only; dropping the Adam moments
         # frees ~2x parameter memory for the closure's lifetime
         state = state.replace(opt_state=None)
+        qparams, qstats, quant_section = quantize.quantize_state(
+            state.params, state.batch_stats, serving_dtype
+        )
+        act_dtype = quantize.compute_dtype(serving_dtype)
         task = self.task
         forward = self._forward
         nchw = self.train_config.data_format == "NCHW"
@@ -792,22 +806,36 @@ class Trainer:
         def serve(images):
             if nchw:
                 images = jnp.transpose(images, (0, 2, 3, 1))
-            out = task.predictions(forward(state, images))
+            st = state.replace(
+                params=quantize.dequantize_pytree(qparams, act_dtype),
+                batch_stats=quantize.dequantize_pytree(qstats, act_dtype),
+            )
+            out = task.predictions(forward(st, images.astype(act_dtype)))
+            out = quantize.cast_outputs_float32(out)
             if nchw:
                 out = {k: jnp.transpose(v, (0, 3, 1, 2)) for k, v in out.items()}
             return out
 
+        serve.quantization = quant_section
         return serve
 
-    def export_serving(self, fold: int, directory: Optional[str] = None) -> str:
+    def export_serving(
+        self,
+        fold: int,
+        directory: Optional[str] = None,
+        serving_dtype: str = "float32",
+    ) -> str:
         """Write a standalone serialized-StableHLO serving artifact for the fold's
         best state (the reference's SavedModel export, model.py:190-204, done the
         JAX-native way — see train/serving.py). Returns the artifact path; default
-        location ``{fold_dir}/export/serving``."""
+        location ``{fold_dir}/export/serving`` (``serving-{dtype}`` for quantized
+        exports, so the f32 reference and its candidates coexist for
+        quantize-check)."""
         from tensorflowdistributedlearning_tpu.train import serving as serving_lib
 
+        suffix = "serving" if serving_dtype == "float32" else f"serving-{serving_dtype}"
         directory = directory or os.path.join(
-            self._fold_dir(fold), "export", "serving"
+            self._fold_dir(fold), "export", suffix
         )
         h, w = self.model_config.input_shape
         c = self.model_config.input_channels
@@ -816,8 +844,9 @@ class Trainer:
             if self.train_config.data_format == "NCHW"
             else (1, h, w, c)
         )
+        serve = self.serving_fn(fold, serving_dtype=serving_dtype)
         return serving_lib.export_serving_artifact(
-            self.serving_fn(fold),
+            serve,
             shape,
             directory,
             metadata={
@@ -825,6 +854,7 @@ class Trainer:
                 "data_format": self.train_config.data_format,
                 "backbone": self.model_config.backbone,
             },
+            quantization=serve.quantization,
         )
 
     def _predict_one(

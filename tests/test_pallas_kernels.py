@@ -173,3 +173,86 @@ def test_rate_gate_dispatch(monkeypatch):
         variables = layer.init(jax.random.PRNGKey(0), x)
         layer.apply(variables, x)
         assert len(taken) == expect, (rate, taken)
+
+
+# -- fused inference BN + activation (+ residual) ----------------------------
+
+
+def _bn_vectors(c, seed=7):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.normal(1, 0.1, c), jnp.float32),
+        jnp.asarray(rng.normal(0, 0.1, c), jnp.float32),
+        jnp.asarray(rng.normal(0, 0.1, c), jnp.float32),
+        jnp.asarray(rng.uniform(0.5, 1.5, c), jnp.float32),
+    )
+
+
+@pytest.mark.parametrize("act", ["none", "relu", "relu6", "sigmoid", "gelu"])
+@pytest.mark.parametrize("with_residual", [False, True])
+def test_fused_bn_act_matches_xla(act, with_residual):
+    from tensorflowdistributedlearning_tpu.ops.pallas_kernels import (
+        fused_bn_act,
+        fused_bn_act_reference,
+    )
+
+    rng = np.random.default_rng(8)
+    x = jnp.asarray(rng.normal(0, 1, (2, 9, 11, 128)), jnp.float32)
+    r = jnp.asarray(rng.normal(0, 1, x.shape), jnp.float32) if with_residual else None
+    got = fused_bn_act(x, *_bn_vectors(128), act=act, residual=r, interpret=True)
+    want = fused_bn_act_reference(x, *_bn_vectors(128), act=act, residual=r)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_fused_bn_act_bfloat16_io():
+    """bf16 activations (the quantized serving regime) compute in f32 inside
+    and return bf16 — parity against the reference at bf16 resolution."""
+    from tensorflowdistributedlearning_tpu.ops.pallas_kernels import (
+        fused_bn_act,
+        fused_bn_act_reference,
+    )
+
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.normal(0, 1, (1, 8, 8, 128)), jnp.bfloat16)
+    r = jnp.asarray(rng.normal(0, 1, x.shape), jnp.bfloat16)
+    got = fused_bn_act(x, *_bn_vectors(128), residual=r, interpret=True)
+    want = fused_bn_act_reference(x, *_bn_vectors(128), residual=r)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=1e-2, atol=1e-2,
+    )
+
+
+def test_fused_bn_act_channel_tiling_and_fallback():
+    from tensorflowdistributedlearning_tpu.ops.pallas_kernels import (
+        fused_bn_act,
+        fused_bn_act_reference,
+    )
+
+    rng = np.random.default_rng(10)
+    x = jnp.asarray(rng.normal(0, 1, (2, 12, 12, 256)), jnp.float32)
+    vecs = _bn_vectors(256)
+    want = fused_bn_act_reference(x, *vecs)
+    # budget fits one 128-lane tile but not all 256 channels: grid tiles C
+    budget = 12 * 12 * 128 * 4 + 1
+    got = fused_bn_act(x, *vecs, interpret=True, vmem_limit_bytes=budget)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+    # tiny budget: the XLA fallback must be exact too
+    got = fused_bn_act(x, *vecs, interpret=True, vmem_limit_bytes=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_fused_bn_act_validation():
+    from tensorflowdistributedlearning_tpu.ops.pallas_kernels import fused_bn_act
+
+    x = jnp.zeros((1, 4, 4, 8))
+    s = b = m = v = jnp.ones((8,))
+    with pytest.raises(ValueError, match="act"):
+        fused_bn_act(x, s, b, m, v, act="swiglu", interpret=True)
+    with pytest.raises(ValueError, match="channels"):
+        fused_bn_act(x, jnp.ones((4,)), b, m, v, interpret=True)
+    with pytest.raises(ValueError, match="residual"):
+        fused_bn_act(x, s, b, m, v, residual=jnp.zeros((1, 4, 4, 4)), interpret=True)
+    with pytest.raises(ValueError, match="B, H, W, C"):
+        fused_bn_act(jnp.zeros((4, 8)), s, b, m, v, interpret=True)

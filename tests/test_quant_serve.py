@@ -1,0 +1,603 @@
+"""Quantized serving path: export recipes, manifest contract, engine, gate.
+
+The contracts under test are the ones the promotion pipeline will be operated
+by: the manifest ``quantization`` section round-trips and rejects corruption
+at read time (never at serve time), legacy manifests pin to the float32 path,
+every precision loads from the manifest alone and serves recompile-free
+through the bucket ladder, quantized artifacts are genuinely small at rest
+(int8 constants stay int8 in the serialized graph — a trace-time eager
+upcast once silently doubled them), the engine's pad scratch buffer reuses
+allocation without leaking stale rows between dispatches, and quantize-check
+passes honest candidates, fails broken ones, and refuses mismatched pairs.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.obs import RecompileDetector, Telemetry
+from tensorflowdistributedlearning_tpu.serve import (
+    InferenceEngine,
+    run_quant_check,
+)
+from tensorflowdistributedlearning_tpu.train import quantize
+from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+FEATURES = 8
+HIDDEN = 16
+CLASSES = 4
+
+
+def make_params(seed=0, scale=0.3):
+    import jax
+    import jax.numpy as jnp
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    return {
+        "dense1": {
+            "kernel": jax.random.normal(k1, (FEATURES, HIDDEN)) * scale,
+            "bias": jnp.zeros((HIDDEN,)),
+        },
+        "dense2": {"kernel": jax.random.normal(k2, (HIDDEN, CLASSES)) * scale},
+    }
+
+
+def make_serve(params, serving_dtype):
+    """The trainers' serving-closure shape, built from a raw params tree —
+    quantize once, dequantize inside the traced graph, f32 wire contract."""
+    import jax
+    import jax.numpy as jnp
+
+    qtree, section = quantize.quantize_pytree(params, serving_dtype)
+    act = quantize.compute_dtype(serving_dtype)
+
+    def serve(x):
+        p = quantize.dequantize_pytree(qtree, act)
+        h = jnp.maximum(
+            x.astype(act) @ p["dense1"]["kernel"] + p["dense1"]["bias"], 0
+        )
+        logits = h @ p["dense2"]["kernel"]
+        out = {
+            "probabilities": jax.nn.softmax(logits, axis=-1),
+            "class": jnp.argmax(logits, axis=-1),
+        }
+        return quantize.cast_outputs_float32(out)
+
+    serve.quantization = section
+    return serve
+
+
+def export_precision(params, serving_dtype, directory):
+    serve = make_serve(params, serving_dtype)
+    serving_lib.export_serving_artifact(
+        serve, (1, FEATURES), str(directory), quantization=serve.quantization
+    )
+    return str(directory)
+
+
+@pytest.fixture(scope="module")
+def artifacts(tmp_path_factory):
+    """One f32/bf16/int8 artifact trio from the same params — the module's
+    shared fixture (export is the slow part)."""
+    root = tmp_path_factory.mktemp("quant_artifacts")
+    params = make_params()
+    return {
+        dt: export_precision(params, dt, root / dt)
+        for dt in ("float32", "bfloat16", "int8")
+    }
+
+
+# -- quantize library --------------------------------------------------------
+
+
+def test_int8_per_channel_roundtrip():
+    """Per-channel symmetric int8: dequantized kernels stay within one scale
+    step of the original, channel-wise (the per-CHANNEL part is what keeps
+    small-magnitude channels accurate next to large ones)."""
+    rng = np.random.default_rng(0)
+    # channels with wildly different magnitudes — per-tensor scaling would
+    # crush the small ones to zero
+    w = rng.normal(0, 1, (8, 6)).astype(np.float32) * np.logspace(
+        -3, 1, 6, dtype=np.float32
+    )
+    tree = {"layer": {"kernel": w}}
+    qtree, section = quantize.quantize_pytree(tree, "int8")
+    rec = qtree["layer"]["kernel"]
+    assert rec["q"].dtype == np.int8
+    assert rec["scale"].shape == (6,)
+    deq = np.asarray(
+        quantize.dequantize_pytree(qtree)["layer"]["kernel"], np.float32
+    )
+    # error bounded by half a quantization step per channel (bf16 dequant
+    # adds a relative ~0.4% on top)
+    step = np.abs(w).max(axis=0) / 127.0
+    assert np.all(np.abs(deq - w) <= step * 0.55 + np.abs(w) * 0.01)
+    assert section["scheme"] == "per-channel-symmetric"
+    assert "layer/kernel" in section["scales"]
+
+
+def test_int8_zero_channel_safe():
+    tree = {"m": {"kernel": np.zeros((4, 3), np.float32)}}
+    qtree, section = quantize.quantize_pytree(tree, "int8")
+    assert np.all(np.asarray(qtree["m"]["kernel"]["scale"]) == 1.0)
+    deq = np.asarray(quantize.dequantize_pytree(qtree)["m"]["kernel"])
+    assert np.all(deq == 0)
+    quantize.validate_quantization(section)  # scale 1.0 is valid metadata
+
+
+def test_bf16_and_float32_recipes():
+    import jax.numpy as jnp
+
+    tree = make_params()
+    b16, section = quantize.quantize_pytree(tree, "bfloat16")
+    assert b16["dense1"]["kernel"].dtype == jnp.bfloat16
+    assert section["dtype"] == "bfloat16" and "scales" not in section
+    f32, section = quantize.quantize_pytree(tree, "float32")
+    # float32 is the identity recipe: the very same leaves, zero graph drift
+    assert f32["dense1"]["kernel"] is tree["dense1"]["kernel"]
+    assert section["dtype"] == "float32"
+    with pytest.raises(ValueError, match="serving_dtype"):
+        quantize.quantize_pytree(tree, "fp8")
+
+
+def test_int8_only_quantizes_kernels():
+    """Biases/BN vectors/batch_stats cast to bf16; integer leaves pass
+    through untouched (a step counter must not become bf16)."""
+    import jax.numpy as jnp
+
+    tree = {
+        "bn": {"scale": np.ones(4, np.float32), "kernel": np.ones(3, np.float32)},
+        "count": np.asarray(7, np.int32),
+        "conv": {"kernel": np.ones((3, 3, 2, 4), np.float32)},
+    }
+    qtree, _ = quantize.quantize_pytree(tree, "int8")
+    assert qtree["bn"]["scale"].dtype == jnp.bfloat16
+    # a 1-D leaf NAMED kernel is not a matmul weight — bf16, not int8
+    assert qtree["bn"]["kernel"].dtype == jnp.bfloat16
+    assert qtree["count"].dtype == np.int32
+    assert qtree["conv"]["kernel"]["q"].dtype == np.int8
+    assert qtree["conv"]["kernel"]["scale"].shape == (4,)
+
+
+def test_frozendict_trees_quantize():
+    """flax FrozenDict params (the declared TrainState leaf container on
+    older flax / flax_return_frozendict=True) must recurse like plain dicts
+    — matching `dict` alone passed the whole frozen tree through untouched
+    while the manifest still claimed int8."""
+    from flax.core import FrozenDict
+    import jax.numpy as jnp
+
+    tree = FrozenDict(make_params())
+    qtree, section = quantize.quantize_pytree(tree, "int8")
+    assert section["scales"], "no kernels quantized — FrozenDict fell through"
+    assert qtree["dense1"]["kernel"]["q"].dtype == np.int8
+    restored = quantize.dequantize_pytree(qtree)
+    assert restored["dense1"]["kernel"].dtype == jnp.bfloat16
+
+
+def test_fingerprint_identity():
+    a, b = make_params(seed=0), make_params(seed=1)
+    fp_a, fp_a2 = quantize.fingerprint_tree(a), quantize.fingerprint_tree(
+        make_params(seed=0)
+    )
+    assert fp_a == fp_a2 and fp_a.startswith("sha256:")
+    assert fp_a != quantize.fingerprint_tree(b)
+    # the section fingerprints the SOURCE tree: identical across recipes
+    sections = [
+        quantize.quantize_pytree(a, dt)[1]["source_fingerprint"]
+        for dt in ("float32", "bfloat16", "int8")
+    ]
+    assert len(set(sections)) == 1
+
+
+# -- manifest contract -------------------------------------------------------
+
+
+def test_manifest_quantization_roundtrip(artifacts):
+    for dtype, directory in artifacts.items():
+        manifest = serving_lib.read_manifest(directory)
+        q = manifest["quantization"]
+        assert q["dtype"] == dtype
+        assert q["source_fingerprint"].startswith("sha256:")
+        if dtype == "int8":
+            assert set(q["scales"]) == {"dense1/kernel", "dense2/kernel"}
+            for meta in q["scales"].values():
+                assert meta["scale_min"] > 0
+                assert meta["scale_min"] <= meta["scale_max"]
+
+
+def test_legacy_manifest_pins_float32_path(tmp_path):
+    """A pre-quantization manifest (no input_dtype, no quantization section)
+    must load exactly as before: float32 inputs, no validation error — the
+    historical contract, applied in ONE place (read_manifest)."""
+    serve = make_serve(make_params(), "float32")
+    d = str(tmp_path / "legacy")
+    serving_lib.export_serving_artifact(serve, (1, FEATURES), d)
+    manifest_path = os.path.join(d, "manifest.json")
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    manifest.pop("input_dtype", None)
+    manifest.pop("quantization", None)
+    with open(manifest_path, "w") as f:
+        json.dump(manifest, f)
+    assert serving_lib.read_manifest(d)["input_dtype"] == "float32"
+    engine = InferenceEngine.from_artifact(d, buckets=(1, 4))
+    assert engine.input_dtype == np.dtype("float32")
+    assert engine.quantization is None
+    out = engine.infer(np.zeros((2, FEATURES), np.float32))
+    assert out["probabilities"].shape == (2, CLASSES)
+
+
+@pytest.mark.parametrize(
+    "corruption",
+    [
+        {"dtype": "int4"},
+        {"dtype": "int8", "scales": "oops"},
+        {"dtype": "int8", "scales": {}},
+        {"dtype": "int8", "scales": {"k": {"shape": [0], "scale_min": 1.0, "scale_max": 1.0}}},
+        {"dtype": "int8", "scales": {"k": {"shape": [4], "scale_min": -1.0, "scale_max": 1.0}}},
+        {"dtype": "int8", "scales": {"k": {"shape": [4], "scale_min": float("nan"), "scale_max": 1.0}}},
+        {"dtype": "int8", "scales": {"k": {"shape": [4], "scale_min": 2.0, "scale_max": 1.0}}},
+        {"dtype": "float32", "scales": {"k": {}}},
+    ],
+)
+def test_corrupt_quantization_rejected(tmp_path, corruption, artifacts):
+    """Corrupt scale metadata fails at READ time with a pointed error — an
+    artifact whose self-description lies must never reach the request path."""
+    import shutil
+
+    d = str(tmp_path / "corrupt")
+    shutil.copytree(artifacts["int8"], d)
+    manifest_path = os.path.join(d, "manifest.json")
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    manifest["quantization"] = corruption
+    with open(manifest_path, "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(ValueError, match="quantization"):
+        serving_lib.read_manifest(d)
+    with pytest.raises(ValueError, match="quantization"):
+        InferenceEngine.from_artifact(d)
+    with pytest.raises(ValueError, match="quantization"):
+        serving_lib.load_serving_artifact(d)
+
+
+def test_export_rejects_corrupt_section(tmp_path):
+    serve = make_serve(make_params(), "float32")
+    with pytest.raises(ValueError, match="quantization.dtype"):
+        serving_lib.export_serving_artifact(
+            serve, (1, FEATURES), str(tmp_path / "x"),
+            quantization={"dtype": "int3"},
+        )
+
+
+# -- per-precision execution through the engine ------------------------------
+
+
+def test_every_precision_loads_and_serves_from_manifest_alone(artifacts, rng):
+    x = rng.normal(0, 1, (5, FEATURES)).astype(np.float32)
+    ref = None
+    for dtype, directory in artifacts.items():
+        engine = InferenceEngine.from_artifact(directory, buckets=(1, 4, 8))
+        assert engine.quantization["dtype"] == dtype
+        out = engine.infer(x)
+        assert out["probabilities"].dtype == np.float32  # wire contract
+        assert out["probabilities"].shape == (5, CLASSES)
+        if ref is None:
+            ref = out
+        else:
+            np.testing.assert_allclose(
+                out["probabilities"], ref["probabilities"], atol=0.05
+            )
+
+
+def test_zero_post_warmup_recompiles_per_precision(artifacts, rng):
+    """The bucket-ladder contract holds at EVERY precision: after warmup, no
+    request batch size compiles anything."""
+    for directory in artifacts.values():
+        detector = RecompileDetector().attach()
+        try:
+            engine = InferenceEngine.from_artifact(directory, buckets=(1, 4, 8))
+            engine.warmup()
+            assert detector.compile_count >= 1, "detector saw no warmup compiles"
+            detector.mark_warm()
+            for n in range(1, 9):
+                engine.infer(rng.normal(0, 1, (n, FEATURES)).astype(np.float32))
+            assert detector.post_warmup_count == 0
+        finally:
+            detector.detach()
+
+
+def test_quantized_artifacts_small_at_rest(tmp_path):
+    """bf16 ~halves and int8 ~quarters the weight bytes in the serialized
+    graph. Regression pin for the trace-time eager upcast that once baked
+    int8 weights as bf16 constants (numpy .astype during tracing). Needs
+    weights big enough that StableHLO framing overhead stops dominating."""
+    import jax
+    import jax.numpy as jnp
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(2))
+    params = {
+        "dense1": {
+            "kernel": jax.random.normal(k1, (64, 512)) * 0.1,
+            "bias": jnp.zeros((512,)),
+        },
+        "dense2": {"kernel": jax.random.normal(k2, (512, CLASSES)) * 0.1},
+    }
+    sizes = {}
+    for dt in ("float32", "bfloat16", "int8"):
+        qtree, section = quantize.quantize_pytree(params, dt)
+        act = quantize.compute_dtype(dt)
+
+        def serve(x, qtree=qtree, act=act):
+            p = quantize.dequantize_pytree(qtree, act)
+            h = jnp.maximum(
+                x.astype(act) @ p["dense1"]["kernel"] + p["dense1"]["bias"], 0
+            )
+            return quantize.cast_outputs_float32(
+                {"y": h @ p["dense2"]["kernel"]}
+            )
+
+        d = str(tmp_path / dt)
+        serving_lib.export_serving_artifact(
+            serve, (1, 64), d, quantization=section
+        )
+        sizes[dt] = os.path.getsize(os.path.join(d, "serving.stablehlo"))
+    # ~34K weights: f32 ≈ 136KB of constants; framing is a few KB
+    assert sizes["bfloat16"] < sizes["float32"] * 0.6
+    assert sizes["int8"] < sizes["float32"] * 0.35
+
+
+# -- engine scratch pad ------------------------------------------------------
+
+
+def test_scratch_pad_reused_and_stale_rows_zeroed(rng):
+    import jax
+    import jax.numpy as jnp
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (FEATURES, CLASSES)) * 0.3
+
+    @jax.jit
+    def fn(x):
+        # "sum" couples rows across the batch: any stale (non-zero) padding
+        # row left in the scratch buffer changes every output row
+        total = jnp.broadcast_to(jnp.sum(jnp.abs(x)), (x.shape[0], 1))
+        return {"sum": total, "y": x @ w}
+
+    engine = InferenceEngine(fn, (FEATURES,), buckets=(8,))
+    big = rng.normal(0, 1, (7, FEATURES)).astype(np.float32)
+    small = rng.normal(0, 1, (2, FEATURES)).astype(np.float32)
+    engine.infer(big)
+    buf_after_big = engine._scratch.bufs[8]
+    out = engine.infer(small)
+    # same buffer object (no per-dispatch allocation) ...
+    assert engine._scratch.bufs[8] is buf_after_big
+    # ... and rows 2..6 of the previous dispatch were zeroed: the padded
+    # forward sums ONLY the two live rows
+    np.testing.assert_allclose(
+        out["sum"], np.full((2, 1), np.abs(small).sum()), rtol=1e-5
+    )
+    np.testing.assert_allclose(out["y"], small @ np.asarray(w), rtol=1e-5)
+
+
+def test_padding_waste_accounting(rng):
+    engine = InferenceEngine(lambda x: {"y": np.asarray(x)}, (FEATURES,),
+                             buckets=(4, 8))
+    for n in (2, 4, 6):
+        engine.infer(rng.normal(0, 1, (n, FEATURES)).astype(np.float32))
+    # bucket 4: hits 2 (n=2, n=4), examples 6 -> waste 1 - 6/8 = 0.25
+    # bucket 8: hits 1 (n=6),      examples 6 -> waste 1 - 6/8 = 0.25
+    assert engine.padding_waste == {4: 0.25, 8: 0.25}
+    assert engine.bucket_hits == {4: 2, 8: 1}
+
+
+def test_serve_window_carries_padding_waste_and_dtype(artifacts, tmp_path, rng):
+    from tensorflowdistributedlearning_tpu.obs import read_ledger
+    from tensorflowdistributedlearning_tpu.obs.report import report_workdir
+    from tensorflowdistributedlearning_tpu.serve import (
+        MicroBatcher,
+        ServingServer,
+    )
+
+    workdir = str(tmp_path / "serve_run")
+    tel = Telemetry(workdir, run_info={"kind": "serve"})
+    engine = InferenceEngine.from_artifact(
+        artifacts["bfloat16"], buckets=(1, 4), registry=tel.registry
+    )
+    engine.warmup(telemetry=tel)
+    batcher = MicroBatcher(engine, max_wait_ms=1, max_queue=16)
+    server = ServingServer(engine, batcher, port=0, telemetry=tel,
+                           window_secs=0).start()
+    try:
+        engine.infer(rng.normal(0, 1, (3, FEATURES)).astype(np.float32))
+    finally:
+        server.shutdown()
+    events = read_ledger(workdir)
+    warm = next(e for e in events if e["event"] == "serve_warmup")
+    assert warm["serving_dtype"] == "bfloat16"
+    window = [e for e in events if e["event"] == "serve_window"][-1]
+    assert window["serving_dtype"] == "bfloat16"
+    assert window["padding_waste"] == {"4": 0.25}
+    rendered = report_workdir(workdir)
+    assert "serving [bfloat16]" in rendered
+    assert "padding waste" in rendered
+
+
+# -- quantize-check ----------------------------------------------------------
+
+
+def test_quant_check_passes_honest_candidates(artifacts, tmp_path):
+    tel = Telemetry(str(tmp_path / "ledger"), run_info={"kind": "quant_check"})
+    try:
+        for dtype in ("bfloat16", "int8"):
+            result = run_quant_check(
+                artifacts["float32"], artifacts[dtype], telemetry=tel
+            )
+            assert result["passed"], result["failures"]
+            assert result["dtype"] == dtype
+            assert result["fingerprint_match"] is True
+            assert result["outputs"]["probabilities"]["kind"] == "float"
+            assert result["outputs"]["class"]["kind"] == "integer"
+    finally:
+        tel.close()
+    from tensorflowdistributedlearning_tpu.obs import read_ledger
+
+    events = read_ledger(str(tmp_path / "ledger"))
+    checks = [e for e in events if e["event"] == "quant_check"]
+    assert len(checks) == 2 and all(e["passed"] for e in checks)
+
+
+def test_quant_check_fails_broken_candidate(artifacts, tmp_path):
+    """A candidate quantized from DIFFERENT weights must fail twice over:
+    fingerprint mismatch up front, and (when forced past it) output deltas
+    beyond any budget."""
+    broken_dir = export_precision(
+        make_params(seed=9), "bfloat16", tmp_path / "broken"
+    )
+    result = run_quant_check(artifacts["float32"], broken_dir)
+    assert not result["passed"]
+    assert any("fingerprint" in f for f in result["failures"])
+    # numerics are skipped on a refused pairing — nothing misleading recorded
+    assert result["outputs"] == {}
+    forced = run_quant_check(
+        artifacts["float32"], broken_dir, allow_fingerprint_mismatch=True
+    )
+    assert not forced["passed"]
+    assert any("delta" in f or "disagree" in f for f in forced["failures"])
+
+
+def make_mask_serve(params, serving_dtype):
+    """The segmentation trainers' output shape: a float {0,1} mask thresholded
+    from probabilities — the output kind where a single boundary-pixel flip
+    makes max|delta| exactly 1.0."""
+    import jax
+    import jax.numpy as jnp
+
+    qtree, section = quantize.quantize_pytree(params, serving_dtype)
+    act = quantize.compute_dtype(serving_dtype)
+
+    def serve(x):
+        p = quantize.dequantize_pytree(qtree, act)
+        h = jnp.maximum(
+            x.astype(act) @ p["dense1"]["kernel"] + p["dense1"]["bias"], 0
+        )
+        prob = jax.nn.sigmoid(h @ p["dense2"]["kernel"])
+        out = {
+            "probabilities": prob,
+            "mask": (prob > 0.5).astype(act),
+        }
+        return quantize.cast_outputs_float32(out)
+
+    serve.quantization = section
+    return serve
+
+
+def export_mask_precision(params, serving_dtype, directory):
+    serve = make_mask_serve(params, serving_dtype)
+    serving_lib.export_serving_artifact(
+        serve, (1, FEATURES), str(directory), quantization=serve.quantization
+    )
+    return str(directory)
+
+
+def test_quant_check_mask_gates_on_iou_not_max_delta(tmp_path):
+    """Binary mask outputs gate on IoU/disagreement, NOT the float budgets:
+    quantization inevitably flips some near-threshold pixels, making the
+    mask's max|delta| exactly 1.0 — an honest int8 segmentation artifact with
+    near-perfect IoU must still pass (caught live on the real seg model:
+    IoU 0.9975 yet 'max|delta| 1.0 > 0.15' failed the gate)."""
+    params = make_params(seed=4, scale=1.0)  # spread probs across 0.5
+    ref = export_mask_precision(params, "float32", tmp_path / "f32")
+    cand = export_mask_precision(params, "int8", tmp_path / "int8")
+    result = run_quant_check(ref, cand, batch_size=64)
+    mask = result["outputs"]["mask"]
+    assert mask["kind"] == "binary"
+    # the premise: at least one pixel flipped, so the float budget would fail
+    assert mask["max_abs_delta"] == 1.0
+    assert mask["iou"] >= 0.95
+    assert result["passed"], result["failures"]
+    # a mask from different weights still fails, on the mask's own budgets
+    broken = export_mask_precision(
+        make_params(seed=11, scale=1.0), "int8", tmp_path / "broken"
+    )
+    forced = run_quant_check(
+        ref, broken, batch_size=64, allow_fingerprint_mismatch=True
+    )
+    assert not forced["passed"]
+    assert any("IoU" in f or "mask disagreement" in f
+               for f in forced["failures"])
+
+
+def test_quant_check_threshold_overrides(artifacts):
+    strict = run_quant_check(
+        artifacts["float32"], artifacts["int8"],
+        thresholds={"max_abs_delta": 1e-9, "mean_abs_delta": 1e-9},
+    )
+    assert not strict["passed"]
+    assert any("max|delta|" in f for f in strict["failures"])
+
+
+def test_quant_check_pinned_batch_deterministic(artifacts):
+    a = run_quant_check(artifacts["float32"], artifacts["int8"], seed=3)
+    b = run_quant_check(artifacts["float32"], artifacts["int8"], seed=3)
+    assert a["outputs"] == b["outputs"]
+
+
+def test_report_renders_quant_check(artifacts, tmp_path):
+    from tensorflowdistributedlearning_tpu.obs.report import report_workdir
+
+    workdir = str(tmp_path / "ledger")
+    tel = Telemetry(workdir, run_info={"kind": "quant_check"})
+    try:
+        run_quant_check(artifacts["float32"], artifacts["int8"], telemetry=tel)
+    finally:
+        tel.close()
+    rendered = report_workdir(workdir)
+    assert "quantize-check [int8] PASSED" in rendered
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def test_cli_quantize_check(artifacts, tmp_path, capsys):
+    from tensorflowdistributedlearning_tpu.cli import main
+
+    rc = main([
+        "quantize-check",
+        "--reference-dir", artifacts["float32"],
+        "--candidate-dir", artifacts["int8"],
+        "--workdir", str(tmp_path / "wd"),
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["passed"] and out["dtype"] == "int8"
+    # the gate IS the exit status: an impossible budget must exit 1
+    rc = main([
+        "quantize-check",
+        "--reference-dir", artifacts["float32"],
+        "--candidate-dir", artifacts["int8"],
+        "--workdir", str(tmp_path / "wd2"),
+        "--max-abs-delta", "1e-12",
+    ])
+    assert rc == 1
+
+
+def test_cli_train_serving_dtype_flag():
+    from tensorflowdistributedlearning_tpu.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["train", "--data-dir", "d", "--model-dir", "m"]
+    )
+    assert args.serving_dtype == "float32"
+    args = build_parser().parse_args(
+        ["train", "--data-dir", "d", "--model-dir", "m",
+         "--export-serving", "--serving-dtype", "int8"]
+    )
+    assert args.serving_dtype == "int8"
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(
+            ["train", "--data-dir", "d", "--model-dir", "m",
+             "--serving-dtype", "fp4"]
+        )
