@@ -71,6 +71,25 @@ def _add_host_loop(p: argparse.ArgumentParser) -> None:
                    "default: the config's, 2)")
 
 
+def _add_observability(p: argparse.ArgumentParser) -> None:
+    """Tracing/health knobs shared by the training commands (train/fit).
+
+    Defaults are None so the config's own defaults stay the single source of
+    truth — the flags only override."""
+    p.add_argument("--trace-sample-rate", type=float, default=None,
+                   help="fraction of traces (per train step / eval pass / "
+                   "checkpoint) persisted as `trace` ledger events, "
+                   "exportable via `telemetry-report --export-trace` as "
+                   "Chrome/Perfetto JSON; 0 disables tracing (the config "
+                   "default)")
+    p.add_argument("--nan-guard", choices=("warn", "abort", "off"),
+                   default=None,
+                   help="NaN/Inf loss guard action: warn (alert and keep "
+                   "training), abort (alert then stop at a recorded "
+                   "boundary), off; default: the config's (warn). Drill "
+                   "with --inject-fault nan-loss@N")
+
+
 def _add_resilience(p: argparse.ArgumentParser) -> None:
     """Flags shared by the training commands (train/fit) — resilience/."""
     from tensorflowdistributedlearning_tpu.resilience.preempt import (
@@ -80,10 +99,11 @@ def _add_resilience(p: argparse.ArgumentParser) -> None:
     p.add_argument("--inject-fault", default=None, metavar="SPEC",
                    help="deterministic fault injection for drills and tests: "
                    "KIND@AT[xCOUNT] with KIND in raise|sigterm|io-data|"
-                   "io-read|io-ckpt (e.g. 'sigterm@12' preempts after step "
-                   "12; 'raise@5-20' crashes at a seeded-random step; "
-                   "'io-ckpt@1' makes the first checkpoint write fail "
-                   "transiently)")
+                   "io-read|io-ckpt|nan-loss (e.g. 'sigterm@12' preempts "
+                   "after step 12; 'raise@5-20' crashes at a seeded-random "
+                   "step; 'io-ckpt@1' makes the first checkpoint write fail "
+                   "transiently; 'nan-loss@2' poisons the 2nd observed loss "
+                   "window with NaN — the health-monitor drill)")
     p.add_argument("--max-restarts", type=int, default=0,
                    help="run under the restart supervisor: relaunch this "
                    "command after crashes/preemptions (exponential backoff + "
@@ -128,6 +148,7 @@ def build_parser() -> argparse.ArgumentParser:
                          "export/serving-{dtype} beside the float32 "
                          "reference and must pass quantize-check to ship")
     _add_host_loop(p_train)
+    _add_observability(p_train)
     _add_resilience(p_train)
 
     p_pred = sub.add_parser("predict", help="fold x TTA ensemble prediction")
@@ -220,6 +241,7 @@ def build_parser() -> argparse.ArgumentParser:
                        "batches untouched; mixup/cutmix add image/label "
                        "mixing on top of flip_crop)")
     _add_host_loop(p_fit)
+    _add_observability(p_fit)
     _add_resilience(p_fit)
 
     p_serve = sub.add_parser(
@@ -257,6 +279,19 @@ def build_parser() -> argparse.ArgumentParser:
     p_serve.add_argument("--window-secs", type=float, default=30.0,
                          help="ledger window cadence; 0 disables periodic "
                          "windows (final window still written on shutdown)")
+    p_serve.add_argument("--trace-sample-rate", type=float, default=0.0,
+                         help="fraction of requests whose queue/pad/compute "
+                         "trace (keyed by the echoed x-request-id) persists "
+                         "as `trace` ledger events; 0 disables tracing")
+    p_serve.add_argument("--slo-p99-ms", type=float, default=None,
+                         help="serving SLO: p99 latency target in ms, "
+                         "enforced as a windowed error budget — breaches "
+                         "write health_alert ledger events and flip /healthz "
+                         "to status=degraded (the fleet-router drain signal)")
+    p_serve.add_argument("--slo-error-budget", type=float, default=0.01,
+                         help="fraction of requests per window allowed over "
+                         "the p99 target before the SLO counts as breached "
+                         "(0.01 = the p99 semantics)")
 
     p_qc = sub.add_parser(
         "quantize-check",
@@ -310,6 +345,10 @@ def build_parser() -> argparse.ArgumentParser:
                        help="device ops to list from the trace")
     p_rep.add_argument("--json", action="store_true",
                        help="machine-readable output")
+    p_rep.add_argument("--export-trace", default=None, metavar="OUT_JSON",
+                       help="instead of the report, export the last run's "
+                       "sampled trace spans as Chrome/Perfetto trace-event "
+                       "JSON (load in chrome://tracing or ui.perfetto.dev)")
 
     p_doc = sub.add_parser(
         "doctor",
@@ -340,6 +379,10 @@ def _trainer(args):
         overlap["prefetch_depth"] = args.prefetch_depth
     if getattr(args, "dispatch_ahead", None) is not None:
         overlap["dispatch_ahead_steps"] = args.dispatch_ahead
+    if getattr(args, "trace_sample_rate", None) is not None:
+        overlap["trace_sample_rate"] = args.trace_sample_rate
+    if getattr(args, "nan_guard", None) is not None:
+        overlap["nan_guard"] = args.nan_guard
     tcfg = TrainConfig(
         lr=getattr(args, "lr", 0.001),
         n_devices=args.n_devices,
@@ -553,6 +596,8 @@ def cmd_fit(args) -> int:
         grad_clip_norm=args.grad_clip,
         prefetch_depth=args.prefetch_depth,
         dispatch_ahead_steps=args.dispatch_ahead,
+        trace_sample_rate=args.trace_sample_rate,
+        nan_guard=args.nan_guard,
     )
     print(json.dumps({
         "preset": args.preset,
@@ -570,6 +615,17 @@ def cmd_telemetry_report(args) -> int:
     from tensorflowdistributedlearning_tpu.obs.report import report_workdir
 
     try:
+        if getattr(args, "export_trace", None):
+            from tensorflowdistributedlearning_tpu.obs.trace import (
+                write_chrome_trace,
+            )
+
+            n = write_chrome_trace(args.workdir, args.export_trace)
+            print(json.dumps({
+                "written": args.export_trace,
+                "span_events": n,
+            }))
+            return 0
         print(
             report_workdir(
                 args.workdir,
@@ -601,6 +657,7 @@ def cmd_serve(args) -> int:
     workdir = args.workdir or args.artifact_dir
     telemetry = Telemetry(
         workdir,
+        trace_sample_rate=args.trace_sample_rate,
         run_info={
             "kind": "serve",
             "artifact_dir": args.artifact_dir,
@@ -610,7 +667,10 @@ def cmd_serve(args) -> int:
         },
     )
     engine = InferenceEngine.from_artifact(
-        args.artifact_dir, buckets=args.buckets, registry=telemetry.registry
+        args.artifact_dir,
+        buckets=args.buckets,
+        registry=telemetry.registry,
+        tracer=telemetry.tracer,
     )
     warmup_s = engine.warmup(telemetry=telemetry)
     batcher = MicroBatcher(
@@ -626,6 +686,8 @@ def cmd_serve(args) -> int:
         port=args.port,
         telemetry=telemetry,
         window_secs=args.window_secs,
+        slo_p99_ms=args.slo_p99_ms,
+        slo_error_budget=args.slo_error_budget,
     )
     server.start()
     print(
@@ -958,6 +1020,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         # first SIGTERM/SIGINT: checkpoint at the next step boundary and exit
         # EXIT_PREEMPTED; a second signal kills immediately
         preempt.install(notice_file=getattr(args, "preempt_notice_file", None))
+        from tensorflowdistributedlearning_tpu.obs.health import (
+            HealthAbortError,
+        )
+
         try:
             return {"train": cmd_train, "fit": cmd_fit}[args.command](args)
         except preempt.PreemptedError as e:
@@ -965,6 +1031,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                 json.dumps({"preempted": True, "step": e.step}), flush=True
             )
             return preempt.EXIT_PREEMPTED
+        except HealthAbortError as e:
+            # the NaN guard's abort action: the health_alert ledger event
+            # precedes this exit; surface a structured verdict, not a
+            # traceback
+            print(
+                json.dumps({"health_abort": True, "reason": str(e)}),
+                flush=True,
+            )
+            return 1
         finally:
             # embedding callers (tests, notebooks) must not inherit the
             # process-global handler/injector past the command

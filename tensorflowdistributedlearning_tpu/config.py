@@ -324,6 +324,26 @@ class TrainConfig:
     # also records per-device HBM + host RSS); the trainers additionally
     # snapshot once after state init
     telemetry_memory_every_windows: int = 5
+    # per-unit tracing (obs/trace.py): fraction of traces (one per top-level
+    # span — each train step, eval pass, checkpoint save) persisted as
+    # `trace` ledger events, exportable via `telemetry-report --export-trace`
+    # as Chrome/Perfetto trace-event JSON. 0.0 disables tracing entirely
+    # (zero per-step cost); 1.0 keeps every span. Sampling is decided per
+    # TRACE at its root, so sampled traces are always complete. Overhead
+    # with tracing fully on is gated <= 2% step time (bench.py
+    # --trace-overhead, CI).
+    trace_sample_rate: float = 0.0
+    # online health monitors (obs/health.py) over the per-window telemetry:
+    # NaN/Inf loss guard, rolling median+MAD loss-spike detector, step-time
+    # regression vs the first clean windows. Alerts land as structured
+    # `health_alert` ledger events and render in telemetry-report's health
+    # section.
+    health_monitors: bool = True
+    # NaN/Inf loss guard action: "warn" alerts and keeps training, "abort"
+    # alerts then raises HealthAbortError (stop at a recorded boundary
+    # instead of training on garbage), "off" disables just this guard.
+    # Drill it with --inject-fault nan-loss@N (resilience/faults.py).
+    nan_guard: str = "warn"
     # overlap periodic Orbax saves with subsequent train steps (background
     # serialization); best exports and resume points still synchronize
     async_checkpointing: bool = False
@@ -494,6 +514,16 @@ class TrainConfig:
             raise ValueError(
                 "telemetry_memory_every_windows must be >= 1, got "
                 f"{self.telemetry_memory_every_windows}"
+            )
+        if not 0.0 <= self.trace_sample_rate <= 1.0:
+            raise ValueError(
+                "trace_sample_rate must be in [0, 1] (0 disables tracing), "
+                f"got {self.trace_sample_rate}"
+            )
+        if self.nan_guard not in ("warn", "abort", "off"):
+            raise ValueError(
+                "nan_guard must be one of ('warn', 'abort', 'off'), got "
+                f"{self.nan_guard!r}"
             )
         if not 0.0 <= self.eval_holdout_fraction < 1.0:
             raise ValueError(

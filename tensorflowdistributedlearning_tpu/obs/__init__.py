@@ -21,9 +21,22 @@ metrics+tracing as a core subsystem, Abadi et al., arXiv:1605.08695):
   (data-wait vs step-compute split per log window, eval/checkpoint/memory
   events);
 - ``obs.report``    — merges the ledger with ``utils.xplane.op_breakdown`` into
-  one goodput report (CLI: ``telemetry-report <workdir>``).
+  one goodput report (CLI: ``telemetry-report <workdir>``);
+- ``obs.trace``     — request/step-granular trace/span layer (trace_id/span_id/
+  parent, host clock only) persisted as sampled ``trace`` ledger events and
+  exportable as Chrome/Perfetto trace-event JSON
+  (``telemetry-report --export-trace``);
+- ``obs.health``    — online health monitors (NaN/Inf loss guard, loss-spike
+  MAD detector, step-time regression, serving SLO error budget) emitting
+  structured ``health_alert`` ledger events.
 """
 
+from tensorflowdistributedlearning_tpu.obs.health import (
+    HEALTH_ALERT_EVENT,
+    HealthAbortError,
+    HealthMonitor,
+    SloTracker,
+)
 from tensorflowdistributedlearning_tpu.obs.ledger import (
     LEDGER_FILENAME,
     RunLedger,
@@ -40,28 +53,48 @@ from tensorflowdistributedlearning_tpu.obs.recompile import RecompileDetector
 from tensorflowdistributedlearning_tpu.obs.telemetry import (
     NULL_TELEMETRY,
     PREFETCH_DEPTH_HISTOGRAM,
+    SPAN_CHECKPOINT,
     SPAN_DATA_WAIT,
     SPAN_EVAL,
     SPAN_FETCH_WAIT,
     SPAN_STEP,
     Telemetry,
 )
+from tensorflowdistributedlearning_tpu.obs.trace import (
+    NULL_TRACER,
+    TRACE_EVENT,
+    TraceContext,
+    Tracer,
+    export_chrome_trace,
+    write_chrome_trace,
+)
 
 __all__ = [
+    "HEALTH_ALERT_EVENT",
     "PREFETCH_DEPTH_HISTOGRAM",
+    "SPAN_CHECKPOINT",
     "SPAN_DATA_WAIT",
     "SPAN_EVAL",
     "SPAN_FETCH_WAIT",
     "SPAN_STEP",
+    "TRACE_EVENT",
     "Counter",
     "Gauge",
+    "HealthAbortError",
+    "HealthMonitor",
     "LEDGER_FILENAME",
     "MetricsRegistry",
     "NULL_TELEMETRY",
+    "NULL_TRACER",
     "RecompileDetector",
     "RunLedger",
+    "SloTracker",
     "Telemetry",
     "TimeHistogram",
+    "TraceContext",
+    "Tracer",
+    "export_chrome_trace",
     "read_ledger",
     "time_summary",
+    "write_chrome_trace",
 ]

@@ -1,0 +1,393 @@
+"""Online health monitors: is this run / this replica healthy RIGHT NOW?
+
+The ledger answers post-hoc questions; nothing in the repo could flag a run
+going bad *while it is going bad* — a NaN loss quietly training on garbage
+for hours, a loss spike after a bad restart, a step-time regression from a
+recompile storm, a serving replica blowing its latency SLO while /healthz
+still says ok. These monitors close that gap. Each one is a small host-side
+state machine that consumes the telemetry stream the trainers/server already
+produce and emits structured ``health_alert`` ledger events (rendered by
+``telemetry-report``'s health section); the serving SLO tracker additionally
+flips ``/healthz`` to a degraded state a fleet router can act on.
+
+Monitors:
+
+- :class:`NanGuard` — non-finite train loss; ``warn`` (alert and keep going)
+  or ``abort`` (alert, then raise :class:`HealthAbortError` so the run stops
+  at a recorded boundary instead of training on NaNs). Drillable via the
+  fault-injection hook pattern (``--inject-fault nan-loss@N``,
+  resilience/faults.py) so the recovery path is tested code;
+- :class:`LossSpikeDetector` — rolling median + MAD; robust to the heavy
+  right tail of loss curves where a mean/stddev z-score would either miss
+  spikes or fire on warmup;
+- :class:`StepTimeRegressionDetector` — median-of-first-clean-windows
+  baseline, alert on sustained regression (dirty windows — compile/eval/
+  checkpoint — are excluded exactly as they are from throughput);
+- :class:`SloTracker` — serving p99 target expressed as a windowed error
+  budget: with budget ``b``, "p99 <= target" IS "at most ``b`` of requests
+  over target" (b=0.01 by default), so one fraction drives both the alert
+  and the /healthz flip, and deadline-exceeded requests count as violations
+  even though they never produce a latency sample.
+
+All alerts share one event schema: ``health_alert`` with ``monitor``,
+``severity`` ("warn" | "critical"), ``step`` (trainer-side), and
+monitor-specific numeric context; recoveries write ``resolved: true``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import statistics
+import threading
+from typing import Deque, Dict, List, Optional
+
+HEALTH_ALERT_EVENT = "health_alert"
+
+NAN_ACTIONS = ("warn", "abort", "off")
+
+
+class HealthAbortError(RuntimeError):
+    """Raised by the NaN guard under ``action='abort'`` AFTER the alert is
+    ledgered — the run stops at a recorded boundary rather than continuing
+    to train on non-finite values."""
+
+
+class NanGuard:
+    """Non-finite loss detector. ``action``: "warn" | "abort" | "off"."""
+
+    def __init__(self, action: str = "warn"):
+        if action not in NAN_ACTIONS:
+            raise ValueError(
+                f"nan_guard action must be one of {NAN_ACTIONS}, got {action!r}"
+            )
+        self.action = action
+        self.fired = 0
+
+    def check(self, step: int, loss: float) -> Optional[Dict]:
+        if self.action == "off":
+            return None
+        if math.isfinite(loss):
+            return None
+        self.fired += 1
+        return {
+            "monitor": "nan_loss",
+            "severity": "critical" if self.action == "abort" else "warn",
+            "step": step,
+            # str(), not float(): NaN/Infinity are not valid JSON numbers
+            "loss": str(loss),
+            "action": self.action,
+        }
+
+
+class LossSpikeDetector:
+    """Rolling median + MAD spike detector over the (finite) loss stream.
+
+    A loss is a spike when it exceeds ``median + threshold * scale`` where
+    ``scale = max(MAD, rel_floor * |median|, abs_floor)`` — the floors keep a
+    near-constant loss (MAD ~ 0) from alerting on numeric jitter. History is
+    bounded (``window``) and spikes are appended too: the median is robust to
+    them, and a level SHIFT (not a spike) stops alerting once the window
+    rolls over, which is the behavior an operator wants."""
+
+    def __init__(
+        self,
+        window: int = 32,
+        min_history: int = 8,
+        threshold: float = 8.0,
+        rel_floor: float = 0.02,
+        abs_floor: float = 1e-6,
+    ):
+        self.window = int(window)
+        self.min_history = max(2, int(min_history))
+        self.threshold = float(threshold)
+        self.rel_floor = float(rel_floor)
+        self.abs_floor = float(abs_floor)
+        self._history: Deque[float] = collections.deque(maxlen=self.window)
+
+    def check(self, step: int, loss: float) -> Optional[Dict]:
+        if not math.isfinite(loss):
+            return None  # the NaN guard owns non-finite values
+        alert = None
+        if len(self._history) >= self.min_history:
+            med = statistics.median(self._history)
+            mad = statistics.median(abs(x - med) for x in self._history)
+            scale = max(mad, self.rel_floor * abs(med), self.abs_floor)
+            if loss > med + self.threshold * scale:
+                alert = {
+                    "monitor": "loss_spike",
+                    "severity": "warn",
+                    "step": step,
+                    "loss": round(float(loss), 6),
+                    "median": round(med, 6),
+                    "mad": round(mad, 6),
+                    "threshold": self.threshold,
+                }
+        self._history.append(float(loss))
+        return alert
+
+
+class StepTimeRegressionDetector:
+    """Step-time regression vs a baseline of the first clean windows.
+
+    Baseline = median mean-step-time of the first ``baseline_windows`` CLEAN
+    windows (dirty windows carry compile/eval/checkpoint time and are
+    excluded, same as the throughput trend). Alerts on the ok→degraded
+    transition when a clean window's mean exceeds ``factor`` x baseline, and
+    writes a ``resolved`` event on the way back — transitions, not every
+    window, so a sustained regression is one alert, not a flood."""
+
+    def __init__(self, baseline_windows: int = 5, factor: float = 1.5):
+        self.baseline_windows = max(1, int(baseline_windows))
+        self.factor = float(factor)
+        self._warmup: List[float] = []
+        self.baseline_ms: Optional[float] = None
+        self.degraded = False
+
+    def check(
+        self, step: int, mean_ms: float, dirty: bool = False
+    ) -> Optional[Dict]:
+        if dirty or mean_ms <= 0:
+            return None
+        if self.baseline_ms is None:
+            self._warmup.append(float(mean_ms))
+            if len(self._warmup) >= self.baseline_windows:
+                self.baseline_ms = statistics.median(self._warmup)
+            return None
+        regressed = mean_ms > self.factor * self.baseline_ms
+        if regressed and not self.degraded:
+            self.degraded = True
+            return {
+                "monitor": "step_time",
+                "severity": "warn",
+                "step": step,
+                "mean_ms": round(float(mean_ms), 3),
+                "baseline_ms": round(self.baseline_ms, 3),
+                "factor": self.factor,
+            }
+        if not regressed and self.degraded:
+            self.degraded = False
+            return {
+                "monitor": "step_time",
+                "severity": "warn",
+                "step": step,
+                "mean_ms": round(float(mean_ms), 3),
+                "baseline_ms": round(self.baseline_ms, 3),
+                "resolved": True,
+            }
+        return None
+
+
+@dataclasses.dataclass
+class SloWindow:
+    """One evaluation window's SLO accounting (returned by ``evaluate``)."""
+
+    requests: int
+    violations: int
+    p99_ms: Optional[float]
+
+
+class SloTracker:
+    """Serving SLO: p99 latency target + windowed error budget.
+
+    ``observe(latency_s)`` per answered request; ``observe_violation()`` for
+    requests that failed the latency contract without producing a sample
+    (deadline-exceeded, result timeouts). ``evaluate()`` — called at each
+    serve ledger window — drains the window and returns an alert dict on the
+    healthy→degraded transition (and a ``resolved`` dict on recovery);
+    ``healthy`` is the live state ``/healthz`` reports. Windows with fewer
+    than ``min_requests`` observations are ignored (an idle replica is not
+    degraded)."""
+
+    # retained latency samples per window (p99 estimation only — the budget
+    # math uses exact counters), so an unevaluated tracker (idle windows, a
+    # server run with windows disabled) cannot grow host memory unboundedly
+    MAX_WINDOW_SAMPLES = 4096
+
+    def __init__(
+        self,
+        p99_target_ms: float,
+        error_budget: float = 0.01,
+        min_requests: int = 20,
+    ):
+        if p99_target_ms <= 0:
+            raise ValueError(f"p99_target_ms must be > 0, got {p99_target_ms}")
+        if not 0.0 < error_budget < 1.0:
+            raise ValueError(
+                f"error_budget must be in (0, 1), got {error_budget}"
+            )
+        self.p99_target_ms = float(p99_target_ms)
+        self.error_budget = float(error_budget)
+        self.min_requests = max(1, int(min_requests))
+        self.healthy = True
+        self.last_window: Optional[SloWindow] = None
+        self._lock = threading.Lock()
+        self._latencies: collections.deque = collections.deque(
+            maxlen=self.MAX_WINDOW_SAMPLES
+        )
+        self._count = 0  # exact answered requests this window
+        self._over = 0  # exact over-target (incl. violation) count
+
+    def observe(self, latency_s: float) -> None:
+        latency_s = float(latency_s)
+        with self._lock:
+            self._latencies.append(latency_s)
+            self._count += 1
+            if latency_s > self.p99_target_ms / 1000.0:
+                self._over += 1
+
+    def observe_violation(self) -> None:
+        with self._lock:
+            self._count += 1
+            self._over += 1
+
+    def evaluate(self) -> Optional[Dict]:
+        with self._lock:
+            latencies = list(self._latencies)
+            n, over = self._count, self._over
+            self._latencies.clear()
+            self._count = 0
+            self._over = 0
+        p99_ms = None
+        if latencies:
+            s = sorted(latencies)
+            p99_ms = round(s[min(len(s) - 1, int(0.99 * len(s)))] * 1000, 3)
+        self.last_window = SloWindow(requests=n, violations=over, p99_ms=p99_ms)
+        if n < self.min_requests:
+            return None
+        breached = over / n > self.error_budget
+        fields = {
+            "monitor": "slo",
+            "severity": "critical" if breached else "warn",
+            "p99_target_ms": self.p99_target_ms,
+            "error_budget": self.error_budget,
+            "window_requests": n,
+            "window_violations": over,
+            "violation_frac": round(over / n, 4),
+        }
+        if p99_ms is not None:
+            fields["window_p99_ms"] = p99_ms
+        if breached and self.healthy:
+            self.healthy = False
+            return fields
+        if not breached and not self.healthy:
+            self.healthy = True
+            fields["severity"] = "warn"
+            fields["resolved"] = True
+            return fields
+        return None
+
+    def snapshot(self) -> Dict:
+        """The live view ``/healthz`` and the serve windows embed."""
+        out: Dict = {
+            "p99_target_ms": self.p99_target_ms,
+            "error_budget": self.error_budget,
+            "healthy": self.healthy,
+        }
+        w = self.last_window
+        if w is not None:
+            out["window_requests"] = w.requests
+            out["window_violations"] = w.violations
+            if w.p99_ms is not None:
+                out["window_p99_ms"] = w.p99_ms
+        return out
+
+
+class HealthMonitor:
+    """The trainer-side facade: NaN guard + loss spike + step-time regression
+    over the per-window telemetry stream.
+
+    Wired into ``Telemetry.window_event`` (the one place every trainer's
+    windows flow through): checks run AFTER the ``step_window`` event is
+    written, alerts append as ``health_alert`` events, and the NaN guard's
+    ``abort`` action raises :class:`HealthAbortError` last — the ledger tells
+    the whole story before the run dies. The loss value consults the
+    fault-injection hook (``nan-loss@N``) first, so the abort path is
+    drillable end to end."""
+
+    def __init__(
+        self,
+        *,
+        nan_action: str = "warn",
+        spike: Optional[LossSpikeDetector] = None,
+        step_time: Optional[StepTimeRegressionDetector] = None,
+    ):
+        self.nan_guard = NanGuard(nan_action)
+        self.spike = spike if spike is not None else LossSpikeDetector()
+        self.step_time = (
+            step_time if step_time is not None else StepTimeRegressionDetector()
+        )
+        self.alerts: List[Dict] = []
+
+    @classmethod
+    def from_train_config(cls, tcfg) -> Optional["HealthMonitor"]:
+        """The monitor a trainer runs under ``tcfg``; None when disabled."""
+        if not getattr(tcfg, "health_monitors", True):
+            return None
+        return cls(nan_action=getattr(tcfg, "nan_guard", "warn"))
+
+    @property
+    def status(self) -> str:
+        return "degraded" if self.step_time.degraded else "ok"
+
+    def reset(self) -> None:
+        """Start a fresh training phase: drop the rolling loss history and
+        the step-time baseline (the K-fold trainer calls this per fold — a
+        converged fold's low-loss median must not flag the next fold's
+        fresh untrained loss as a spike). Accumulated ``alerts`` and the
+        guard's configuration persist."""
+        self.spike = LossSpikeDetector(
+            window=self.spike.window,
+            min_history=self.spike.min_history,
+            threshold=self.spike.threshold,
+            rel_floor=self.spike.rel_floor,
+            abs_floor=self.spike.abs_floor,
+        )
+        self.step_time = StepTimeRegressionDetector(
+            baseline_windows=self.step_time.baseline_windows,
+            factor=self.step_time.factor,
+        )
+
+    def observe_window(
+        self, telemetry, step: int, scalars: Dict, fields: Dict
+    ) -> List[Dict]:
+        """Run every monitor against one emitted window; write alerts through
+        ``telemetry`` and return them. Raises :class:`HealthAbortError` after
+        a NaN alert when the guard is set to abort."""
+        alerts: List[Dict] = []
+        loss = scalars.get("loss")
+        if loss is not None:
+            loss = float(loss)
+            from tensorflowdistributedlearning_tpu.resilience import (
+                faults as faults_lib,
+            )
+
+            if faults_lib.poisoned(faults_lib.SITE_LOSS, step):
+                loss = float("nan")
+            nan_alert = self.nan_guard.check(step, loss)
+            if nan_alert:
+                alerts.append(nan_alert)
+            else:
+                spike = self.spike.check(step, loss)
+                if spike:
+                    alerts.append(spike)
+        mean_ms = (fields.get("step_time_ms") or {}).get("mean_ms")
+        if mean_ms is not None:
+            st = self.step_time.check(
+                step, float(mean_ms), dirty=bool(fields.get("dirty"))
+            )
+            if st:
+                alerts.append(st)
+        for alert in alerts:
+            self.alerts.append(alert)
+            telemetry.event(HEALTH_ALERT_EVENT, **alert)
+        if any(
+            a["monitor"] == "nan_loss" and a.get("action") == "abort"
+            for a in alerts
+        ):
+            raise HealthAbortError(
+                f"non-finite train loss at step {step} (nan_guard='abort'; "
+                "the health_alert ledger event precedes this exit)"
+            )
+        return alerts

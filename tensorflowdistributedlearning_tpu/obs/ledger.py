@@ -18,6 +18,7 @@ import io
 import json
 import logging
 import os
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -33,6 +34,11 @@ class RunLedger:
     def __init__(self, workdir: str, *, filename: str = LEDGER_FILENAME):
         self.path = os.path.join(workdir, filename)
         self._f: Optional[io.TextIOBase] = None
+        # the serving stack writes from several threads (handler threads'
+        # trace spans, the batcher worker, the window ticker) into this one
+        # TextIOWrapper, which is not thread-safe — serialize line writes so
+        # concurrent events cannot garble each other's JSONL
+        self._lock = threading.Lock()
         try:
             os.makedirs(workdir, exist_ok=True)
             self._f = open(self.path, "a", encoding="utf-8")
@@ -49,16 +55,34 @@ class RunLedger:
         return self._f is not None
 
     def event(self, kind: str, /, **fields) -> None:
-        """Append one event; a write failure disables the ledger with one
-        warning (never raises into the training loop). ``kind`` is
-        positional-only so producers may carry their own ``kind`` field (the
-        suite runner's and serving stack's headers do)."""
+        """Append one event and flush it to disk; a write failure disables the
+        ledger with one warning (never raises into the training loop).
+        ``kind`` is positional-only so producers may carry their own ``kind``
+        field (the suite runner's and serving stack's headers do)."""
+        self._write(kind, fields, flush=True)
+
+    def event_buffered(self, kind: str, /, **fields) -> None:
+        """Append one event WITHOUT forcing a flush — for high-rate producers
+        (per-span ``trace`` events can fire multiple times per train step)
+        where a syscall per line measurably steals CPU from compute. Buffered
+        lines reach disk when the stdio buffer fills, at the next flushed
+        ``event()`` (same file object), on ``flush()``, or at ``close()`` —
+        a crash can lose only the tail of *sampled traces*, never the
+        windows/alerts the flushed path carries."""
+        self._write(kind, fields, flush=False)
+
+    def _write(self, kind: str, fields: Dict, flush: bool) -> None:
         if self._f is None:
             return
         record = {"event": kind, "t": time.time(), **fields}
+        line = json.dumps(record, default=_jsonable) + "\n"  # off the lock
         try:
-            self._f.write(json.dumps(record, default=_jsonable) + "\n")
-            self._f.flush()
+            with self._lock:
+                if self._f is None:
+                    return
+                self._f.write(line)
+                if flush:
+                    self._f.flush()
         except (OSError, ValueError) as e:  # ValueError: write to closed file
             logger.warning(
                 "telemetry ledger disabled mid-run: write to %s failed (%s)",
@@ -67,13 +91,24 @@ class RunLedger:
             )
             self._f = None
 
+    def flush(self) -> None:
+        """Push any buffered events to disk (readers of a LIVE ledger — tests,
+        a tailing operator — call this through ``Telemetry.flush``)."""
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.flush()
+                except OSError:
+                    pass
+
     def close(self) -> None:
-        if self._f is not None:
-            try:
-                self._f.close()
-            except OSError:
-                pass
-            self._f = None
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
 
 
 def _jsonable(obj):

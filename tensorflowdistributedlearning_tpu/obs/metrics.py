@@ -6,12 +6,21 @@ XPlane trace, ``utils/xplane.py``). ``TimeHistogram`` is the single
 step-timing/percentile implementation in the repo: ``utils.profiling.StepTimer``
 and the telemetry spans both record into it, so p50/p90/p99 mean the same
 thing everywhere they are reported.
+
+``MetricsRegistry.render_prometheus`` exposes the same instruments in the
+Prometheus text exposition format (``text/plain; version=0.0.4``) so every
+serving replica's ``/metrics`` is scrapeable by standard collectors: counters
+as ``*_total``, gauges verbatim, time histograms as summaries
+(``{quantile=...}`` over the retained samples, lifetime-exact ``_sum`` /
+``_count``).
 """
 
 from __future__ import annotations
 
+import collections
+import re
 import threading
-from typing import Dict, List, Optional, Sequence
+from typing import Deque, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -69,43 +78,143 @@ class Gauge:
         return self._value
 
 
+class SampleWindow(list):
+    """The list ``TimeHistogram.drain()`` returns, annotated with the EXACT
+    ``count``/``total_s`` of the drained interval. When the interval recorded
+    more samples than the histogram's ring retains, the list holds the most
+    recent ``max_samples`` (percentiles degrade gracefully) while ``count``
+    and ``total_s`` stay exact — consumers that sum a window (the telemetry
+    goodput split) must read these instead of ``sum(window)``."""
+
+    def __init__(self, samples: Sequence[float], count: int, total_s: float):
+        super().__init__(samples)
+        self.count = int(count)
+        self.total_s = float(total_s)
+
+
+def window_total_s(samples) -> float:
+    """Exact wall-seconds of a drained window: ``total_s`` when the window
+    carries it (:class:`SampleWindow`), else the plain sum."""
+    if samples is None:
+        return 0.0
+    exact = getattr(samples, "total_s", None)
+    return float(exact) if exact is not None else float(sum(samples))
+
+
+def window_count(samples) -> int:
+    """Exact sample count of a drained window (see :func:`window_total_s`)."""
+    if samples is None:
+        return 0
+    exact = getattr(samples, "count", None)
+    return int(exact) if exact is not None else len(samples)
+
+
 class TimeHistogram:
     """Accumulates durations (seconds); reports count/mean/p50/p90/p99/total.
 
-    Samples are kept raw so consumers can slice deltas
-    (``samples_since(mark)``) or hand ownership over entirely (``drain()`` —
-    what the telemetry window loop uses, so per-step span histograms stay
-    bounded by one window's samples instead of growing for the whole run)."""
+    Memory is BOUNDED: raw samples live in a ring of the most recent
+    ``max_samples`` (default 8192 ≈ 64 KiB of floats), so a long-lived
+    producer that nothing drains — a multi-week serving replica, a span no
+    window consumes — cannot grow host memory without bound. Exactness is
+    kept where it matters: ``len()``, ``total_s``, and ``drain()``'s
+    ``count``/``total_s`` (:class:`SampleWindow`) count EVERY recorded
+    sample; only the percentile inputs are capped (and recency-biased once
+    the ring wraps). ``lifetime_count``/``lifetime_total_s`` survive drains —
+    the monotonic series Prometheus scrapes.
 
-    def __init__(self, name: str):
+    Consumers can slice deltas (``samples_since(mark)``) or hand ownership
+    over entirely (``drain()`` — what the telemetry window loop uses)."""
+
+    DEFAULT_MAX_SAMPLES = 8192
+
+    def __init__(self, name: str, max_samples: int = DEFAULT_MAX_SAMPLES):
+        if max_samples < 1:
+            raise ValueError(f"max_samples must be >= 1, got {max_samples}")
         self.name = name
-        self._samples: List[float] = []
+        self.max_samples = int(max_samples)
+        # recorded from handler threads while the window ticker drains:
+        # the multi-field record/drain sequences must be atomic or samples
+        # recorded mid-drain vanish from both windows and the exact
+        # counters drift
+        self._lock = threading.Lock()
+        self._samples: Deque[float] = collections.deque(maxlen=self.max_samples)
+        self._count = 0  # since the last drain, exact
+        self._total_s = 0.0  # since the last drain, exact
+        self.lifetime_count = 0
+        self.lifetime_total_s = 0.0
 
     def record(self, seconds: float) -> None:
-        self._samples.append(float(seconds))
+        s = float(seconds)
+        with self._lock:
+            self._samples.append(s)
+            self._count += 1
+            self._total_s += s
+            self.lifetime_count += 1
+            self.lifetime_total_s += s
 
     def __len__(self) -> int:
-        return len(self._samples)
+        return self._count
 
     @property
     def total_s(self) -> float:
-        return float(sum(self._samples))
+        return self._total_s
 
     @property
     def samples(self) -> List[float]:
-        return list(self._samples)
+        """The RETAINED samples (at most ``max_samples``, most recent)."""
+        with self._lock:
+            return list(self._samples)
 
     def samples_since(self, mark: int) -> List[float]:
-        return self._samples[mark:]
+        """Samples recorded after position ``mark`` (a previous ``len()``).
+        Marks that the ring has already evicted past resolve to everything
+        retained."""
+        with self._lock:
+            evicted = self._count - len(self._samples)
+            return list(self._samples)[max(0, mark - evicted):]
 
-    def drain(self) -> List[float]:
-        """Take (and clear) every recorded sample — the bounded-memory way to
-        consume a histogram windowed."""
-        out, self._samples = self._samples, []
+    def drain(self) -> SampleWindow:
+        """Take (and clear) the interval since the last drain: the retained
+        samples plus the interval's exact count/total (the bounded-memory way
+        to consume a histogram windowed)."""
+        with self._lock:
+            out = SampleWindow(self._samples, self._count, self._total_s)
+            self._samples.clear()
+            self._count = 0
+            self._total_s = 0.0
         return out
 
     def summary(self, skip_first: int = 0) -> Dict[str, float]:
-        return time_summary(self._samples, skip_first=skip_first)
+        with self._lock:
+            retained = list(self._samples)
+            count, total_s = self._count, self._total_s
+        s = time_summary(retained, skip_first=skip_first)
+        if skip_first == 0 and count > len(retained):
+            # ring wrapped: percentiles come from the retained tail, but the
+            # count/total the summary reports stay exact
+            s["count"] = float(count)
+            s["total_s"] = total_s
+            s["mean_s"] = total_s / count
+        return s
+
+
+_PROM_INVALID = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str, prefix: str) -> str:
+    base = _PROM_INVALID.sub("_", name)
+    if base and base[0].isdigit():
+        base = "_" + base
+    return f"{prefix}_{base}" if prefix else base
+
+
+def _prom_num(v: float) -> str:
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    return format(f, ".10g")
 
 
 class MetricsRegistry:
@@ -147,3 +256,45 @@ class MetricsRegistry:
                     if len(h)
                 },
             }
+
+    def render_prometheus(self, prefix: str = "tfdl") -> str:
+        """Prometheus text exposition (format version 0.0.4) of the registry.
+
+        Instrument names sanitize ``/`` (and anything else outside
+        ``[a-zA-Z0-9_:]``) to ``_`` under ``prefix``; counters gain the
+        conventional ``_total`` suffix, time histograms render as summaries in
+        SECONDS — quantiles over the retained ring (omitted while empty),
+        ``_sum``/``_count`` from the lifetime-exact monotonic totals (drains
+        do not reset them, so scrape deltas are meaningful)."""
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(
+                (n, g.value) for n, g in self._gauges.items()
+                if g.value is not None
+            )
+            hists = sorted(self._histograms.items())
+        lines: List[str] = []
+        for name, c in counters:
+            pname = _prom_name(name, prefix) + "_total"
+            lines.append(f"# TYPE {pname} counter")
+            lines.append(f"{pname} {_prom_num(c.value)}")
+        for name, value in gauges:
+            pname = _prom_name(name, prefix)
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname} {_prom_num(value)}")
+        for name, h in hists:
+            if not h.lifetime_count:
+                continue
+            pname = _prom_name(name, prefix) + "_seconds"
+            lines.append(f"# TYPE {pname} summary")
+            retained = h.samples
+            if retained:
+                arr = np.asarray(retained, np.float64)
+                for q in (0.5, 0.9, 0.99):
+                    lines.append(
+                        f'{pname}{{quantile="{q}"}} '
+                        f"{_prom_num(np.percentile(arr, q * 100))}"
+                    )
+            lines.append(f"{pname}_sum {_prom_num(h.lifetime_total_s)}")
+            lines.append(f"{pname}_count {_prom_num(h.lifetime_count)}")
+        return "\n".join(lines) + "\n"

@@ -98,8 +98,10 @@ def _serve_section(windows: List[Dict]) -> Dict:
         section["mean_batch_fill"] = round(
             totals["batched_examples"] / totals["batches"], 2
         )
+    if windows[-1].get("slo"):
+        section["slo"] = windows[-1]["slo"]
     latency: Dict = {}
-    for name in ("queue_wait", "pad", "compute"):
+    for name in ("queue_wait", "pad", "compute", "request"):
         per_window = [
             e["latency_ms"][name]
             for e in windows
@@ -125,6 +127,52 @@ def _serve_section(windows: List[Dict]) -> Dict:
     if latency:
         section["latency_ms"] = latency
     return section
+
+
+def _health_section(events: List[Dict]) -> Optional[Dict]:
+    """Aggregate ``health_alert`` events (obs/health.py) for the last run:
+    per-monitor counts, active-vs-resolved state, and the most recent alert's
+    details. None when the run never alerted."""
+    alerts = [e for e in events if e.get("event") == "health_alert"]
+    if not alerts:
+        return None
+    monitors: Dict[str, Dict] = {}
+    for e in alerts:
+        name = e.get("monitor", "unknown")
+        m = monitors.setdefault(
+            name, {"alerts": 0, "resolved": 0, "active": False}
+        )
+        if e.get("resolved"):
+            m["resolved"] += 1
+            m["active"] = False
+        else:
+            m["alerts"] += 1
+            m["active"] = True
+        m["last"] = {
+            k: v for k, v in e.items() if k not in ("event", "t")
+        }
+    return {
+        "alerts": sum(m["alerts"] for m in monitors.values()),
+        "monitors": monitors,
+        "degraded": sorted(
+            name for name, m in monitors.items() if m["active"]
+        ),
+    }
+
+
+def _trace_summary(events: List[Dict]) -> Optional[Dict]:
+    """Span counts by name for the run's sampled ``trace`` events — enough
+    for the report to say tracing was on and what `--export-trace` will
+    contain. None when the run recorded no spans."""
+    spans = [e for e in events if e.get("event") == "trace"]
+    if not spans:
+        return None
+    by_name: Dict[str, int] = {}
+    traces = set()
+    for e in spans:
+        by_name[e.get("name", "span")] = by_name.get(e.get("name", "span"), 0) + 1
+        traces.add(e.get("trace_id"))
+    return {"spans": len(spans), "traces": len(traces), "by_name": by_name}
 
 
 def _resilience_scope(all_events: List[Dict]) -> List[Dict]:
@@ -286,6 +334,13 @@ def build_report(
     resilience = _resilience_section(all_events)
     if resilience:
         report["resilience"] = resilience
+
+    health = _health_section(events)
+    if health:
+        report["health"] = health
+    traces = _trace_summary(events)
+    if traces:
+        report["traces"] = traces
 
     serve_windows = [e for e in events if e.get("event") == "serve_window"]
     if serve_windows:
@@ -504,6 +559,41 @@ def render_report(report: Dict) -> str:
                 f"  !! supervisor gave this run up: {res['aborted']} — "
                 f"{explanation}"
             )
+    hl = report.get("health")
+    if hl:
+        lines.append(
+            f"\n!! health: {hl['alerts']} alert(s)"
+            + (
+                f" — DEGRADED: {', '.join(hl['degraded'])}"
+                if hl["degraded"]
+                else " (all resolved)"
+            )
+        )
+        for name, m in sorted(hl["monitors"].items()):
+            last = m.get("last", {})
+            detail = ", ".join(
+                f"{k}={last[k]}"
+                for k in (
+                    "step", "loss", "median", "mean_ms", "baseline_ms",
+                    "window_p99_ms", "p99_target_ms", "violation_frac",
+                )
+                if k in last
+            )
+            state = "ACTIVE" if m["active"] else "resolved"
+            lines.append(
+                f"   - {name}: {m['alerts']} alert(s) [{state}]"
+                + (f" — last: {detail}" if detail else "")
+            )
+    tr_s = report.get("traces")
+    if tr_s:
+        names = ", ".join(
+            f"{n}:{c}" for n, c in sorted(tr_s["by_name"].items())
+        )
+        lines.append(
+            f"tracing: {tr_s['spans']} sampled span(s) across "
+            f"{tr_s['traces']} trace(s) ({names}) — export with "
+            "`telemetry-report --export-trace out.json`"
+        )
     mem = report.get("memory")
     if mem:
         parts = [f"{mem['snapshots']} snapshot(s)"]
@@ -555,6 +645,16 @@ def render_report(report: Dict) -> str:
                 f"p50 {s['p50']:.2f}  p90 {s['p90']:.2f}  "
                 f"p99(worst window) {s['p99_worst_window']:.2f}"
             )
+        slo = sv.get("slo")
+        if slo:
+            state = "met" if slo.get("healthy", True) else "BREACHED"
+            line = (
+                f"  SLO: p99 target {slo['p99_target_ms']:.1f}ms, error "
+                f"budget {slo['error_budget']:.1%} — {state}"
+            )
+            if slo.get("window_p99_ms") is not None:
+                line += f" (last window p99 {slo['window_p99_ms']:.1f}ms)"
+            lines.append(line)
         rc_s = sv.get("recompiles_post_warmup")
         if rc_s:
             lines.append(

@@ -31,10 +31,12 @@ import logging
 import time
 from typing import Dict, List, Optional
 
+from tensorflowdistributedlearning_tpu.obs import trace as trace_lib
 from tensorflowdistributedlearning_tpu.obs.ledger import RunLedger
 from tensorflowdistributedlearning_tpu.obs.metrics import (
     MetricsRegistry,
     time_summary,
+    window_total_s,
 )
 from tensorflowdistributedlearning_tpu.obs.recompile import (
     CompileEvent,
@@ -51,6 +53,10 @@ SPAN_EVAL = "eval"
 # dispatch-ahead and deferred window fetch — train/async_loop.py); disjoint
 # from data_wait/step like the other window spans
 SPAN_FETCH_WAIT = "fetch_wait"
+# checkpoint save wall time (the trainers wrap periodic/forced saves) — not a
+# window span (nothing drains it; the histogram ring bounds it), but a trace
+# boundary: sampled runs show checkpoint spans in the exported timeline
+SPAN_CHECKPOINT = "checkpoint"
 
 # registry histogram the input prefetcher records its ready-queue depth into
 # (data/pipeline.py:device_prefetch); drained per window like the spans, so
@@ -85,6 +91,8 @@ class Telemetry:
         enabled: bool = True,
         memory_every_windows: int = 5,
         is_main: Optional[bool] = None,
+        trace_sample_rate: float = 0.0,
+        health=None,
     ):
         self.enabled = enabled and workdir is not None
         self.registry = MetricsRegistry()
@@ -94,6 +102,20 @@ class Telemetry:
         self._closed = False
         self.ledger: Optional[RunLedger] = None
         self.detector: Optional[RecompileDetector] = None
+        # online health monitors (obs/health.py) consulted at every window
+        # event; None = no monitoring (the trainers pass
+        # HealthMonitor.from_train_config)
+        self.health = health
+        # per-unit tracing (obs/trace.py): sampled spans persist as `trace`
+        # ledger events through the same writer — BUFFERED (no flush per
+        # span: spans can fire several times per train step, and a syscall
+        # per line steals CPU from compute; buffered lines land at the next
+        # flushed event / flush() / close()). Rate 0 keeps the tracer
+        # disabled and span() single-branch cheap.
+        self.tracer = trace_lib.Tracer(
+            emit=self._trace_event if self.enabled else None,
+            sample_rate=trace_sample_rate if self.enabled else 0.0,
+        )
         if not self.enabled:
             return
         if is_main is None:
@@ -142,7 +164,13 @@ class Telemetry:
             import jax
 
             with jax.profiler.TraceAnnotation(f"obs/{name}"):
-                yield
+                if self.tracer.enabled:
+                    # per-unit tracing: a top-level span roots its own
+                    # (sampled) trace; nested spans join the enclosing one
+                    with self.tracer.span(name):
+                        yield
+                else:
+                    yield
         finally:
             self.registry.histogram(f"span/{name}").record(
                 time.perf_counter() - t0
@@ -178,6 +206,16 @@ class Telemetry:
         if self.ledger is not None:
             self.ledger.event(kind, **fields)
 
+    def _trace_event(self, fields: Dict) -> None:
+        if self.ledger is not None:
+            self.ledger.event_buffered(trace_lib.TRACE_EVENT, **fields)
+
+    def flush(self) -> None:
+        """Push buffered (trace) events to disk — for readers of a live
+        ledger; flushed events and close() do this implicitly."""
+        if self.ledger is not None:
+            self.ledger.flush()
+
     def event(self, kind: str, /, **fields) -> None:
         """Append an arbitrary ledger event under this run's header — the
         extension point non-trainer producers (the serving stack's
@@ -211,7 +249,13 @@ class Telemetry:
         compute = samples.get(SPAN_STEP, [])
         fetch = samples.get(SPAN_FETCH_WAIT, [])
         depth = samples.get("prefetch_depth", [])
-        wait_s, compute_s, fetch_s = sum(wait), sum(compute), sum(fetch)
+        # exact totals even when a histogram ring capped the raw samples
+        # (obs/metrics.py:SampleWindow)
+        wait_s, compute_s, fetch_s = (
+            window_total_s(wait),
+            window_total_s(compute),
+            window_total_s(fetch),
+        )
         busy = wait_s + compute_s + fetch_s
         fields: Dict = {
             "step": step,
@@ -247,6 +291,10 @@ class Telemetry:
         self._windows += 1
         if self._windows % self._memory_every_windows == 0:
             self.memory_event(step=step)
+        if self.health is not None:
+            # AFTER the window is persisted: alerts (and a NaN-guard abort)
+            # land in a ledger that already tells the window's story
+            self.health.observe_window(self, step, scalars or {}, fields)
 
     def eval_event(
         self, step: int, metrics: Dict[str, float], duration_s: float, **extra

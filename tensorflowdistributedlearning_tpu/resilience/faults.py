@@ -24,6 +24,12 @@ Spec grammar (``--inject-fault``)::
     io-read@2       transient IOError on the 2nd tracked file open
                     (record shards, kaggle CSVs)
     io-ckpt@1       transient IOError on the 1st checkpoint save attempt
+    nan-loss@2      poison the 2nd OBSERVED loss (log window) with NaN — the
+                    health-monitor drill (obs/health.py): the NaN guard must
+                    alert, and warn-vs-abort must behave as configured.
+                    Consumed via the non-raising ``poisoned()`` query, not
+                    ``fire()`` (the site transforms a value rather than
+                    failing)
 
 Transient faults raise ``TransientInjectedIOError`` (an ``OSError``), exactly
 what ``resilience.retry`` retries — the clean path through the same code
@@ -52,6 +58,7 @@ SITE_STEP = "step"  # trainers, after each completed train step (index = step)
 SITE_DATA = "data"  # data/records.py, per emitted record batch
 SITE_IO = "io"  # tracked file opens (record shards, kaggle CSVs)
 SITE_CHECKPOINT = "checkpoint"  # CheckpointManager, per save attempt
+SITE_LOSS = "loss"  # obs/health.py, per observed loss window (poisoned())
 
 _KIND_SITE = {
     "raise": SITE_STEP,
@@ -59,10 +66,11 @@ _KIND_SITE = {
     "io-data": SITE_DATA,
     "io-read": SITE_IO,
     "io-ckpt": SITE_CHECKPOINT,
+    "nan-loss": SITE_LOSS,
 }
 
 _SPEC_RE = re.compile(
-    r"^(?P<kind>raise|sigterm|io-data|io-read|io-ckpt)"
+    r"^(?P<kind>raise|sigterm|io-data|io-read|io-ckpt|nan-loss)"
     r"@(?P<lo>\d+)(?:-(?P<hi>\d+))?"
     r"(?:x(?P<count>\d+))?$"
 )
@@ -128,9 +136,26 @@ class FaultInjector:
         self._occurrences = 0
         self.fired = 0
 
+    def poisoned(self, site: str, index: Optional[int] = None) -> bool:
+        """Non-raising twin of ``fire`` for value-transforming sites: does an
+        installed value fault (``nan-loss``) fire at this occurrence? The
+        1-based occurrence window [at, at + count) matches the io kinds —
+        ``index`` (the step) is informational; the AT in the spec counts
+        *observations* (log windows), which stay meaningful whatever the
+        window cadence is."""
+        spec = self.spec
+        if site != spec.site or spec.kind != "nan-loss":
+            return False
+        with self._lock:
+            self._occurrences += 1
+            if not spec.at <= self._occurrences < spec.at + spec.count:
+                return False
+            self.fired += 1
+        return True
+
     def fire(self, site: str, index: Optional[int] = None) -> None:
         spec = self.spec
-        if site != spec.site:
+        if site != spec.site or spec.kind == "nan-loss":
             return
         with self._lock:
             if site == SITE_STEP:
@@ -177,3 +202,9 @@ def fire(site: str, index: Optional[int] = None) -> None:
     """The hook the instrumented sites call; free when nothing is installed."""
     if _INJECTOR is not None:
         _INJECTOR.fire(site, index)
+
+
+def poisoned(site: str, index: Optional[int] = None) -> bool:
+    """Value-fault query (``nan-loss``): should the caller corrupt the value
+    it is about to observe? Free when nothing is installed."""
+    return _INJECTOR is not None and _INJECTOR.poisoned(site, index)

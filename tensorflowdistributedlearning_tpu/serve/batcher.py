@@ -36,6 +36,7 @@ from typing import Deque, List, Optional
 
 import numpy as np
 
+from tensorflowdistributedlearning_tpu.obs import trace as trace_lib
 from tensorflowdistributedlearning_tpu.serve.engine import (
     InferenceEngine,
     RequestTooLargeError,
@@ -65,17 +66,27 @@ class ServerClosedError(RuntimeError):
 
 
 class Request:
-    """Future-like handle for one submitted request."""
+    """Future-like handle for one submitted request. ``trace`` (optional) is
+    the submitting thread's open span context (obs/trace.py) — the worker
+    emits this request's queue_wait/pad/compute spans into that trace after
+    the batch runs."""
 
     __slots__ = (
-        "x", "n", "deadline_t", "enqueued_t", "_event", "_result", "_error",
+        "x", "n", "deadline_t", "enqueued_t", "trace",
+        "_event", "_result", "_error",
     )
 
-    def __init__(self, x: np.ndarray, deadline_t: Optional[float]):
+    def __init__(
+        self,
+        x: np.ndarray,
+        deadline_t: Optional[float],
+        trace: Optional[trace_lib.TraceContext] = None,
+    ):
         self.x = x
         self.n = x.shape[0]
         self.deadline_t = deadline_t
         self.enqueued_t = time.monotonic()
+        self.trace = trace
         self._event = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
@@ -129,11 +140,19 @@ class MicroBatcher:
 
     # -- client side -------------------------------------------------------
 
-    def submit(self, x, *, deadline_ms: Optional[float] = None) -> Request:
+    def submit(
+        self,
+        x,
+        *,
+        deadline_ms: Optional[float] = None,
+        trace: Optional[trace_lib.TraceContext] = None,
+    ) -> Request:
         """Enqueue ``x`` ([n, *example_shape] or one bare example); returns a
         :class:`Request` future. Raises immediately — never queues — when the
         batcher is closed, the request exceeds the largest bucket, or the
-        queue is at capacity."""
+        queue is at capacity. ``trace`` threads the caller's span context
+        through so the worker can attribute queue/pad/compute time back to
+        this request's trace."""
         x = np.asarray(x, self.engine.input_dtype)
         if x.shape == self.engine.example_shape:
             x = x[None]
@@ -154,7 +173,7 @@ class MicroBatcher:
             if deadline_ms is not None
             else None
         )
-        req = Request(x, deadline_t)
+        req = Request(x, deadline_t, trace=trace)
         with self._cond:
             if self._closed:
                 raise ServerClosedError("batcher is draining; not accepting requests")
@@ -239,6 +258,7 @@ class MicroBatcher:
 
     def _execute(self, batch: List[Request]) -> None:
         now = time.monotonic()
+        wall_now = time.time()
         wait_h = self.registry.histogram("serve/queue_wait")
         for req in batch:
             wait_h.record(now - req.enqueued_t)
@@ -247,13 +267,45 @@ class MicroBatcher:
             if len(batch) > 1
             else batch[0].x
         )
+        # tracing: a batch span (its own trace) wraps the engine call so the
+        # engine's pad/compute spans nest under it; kept only when at least
+        # one member request's trace is sampled (partial traces are useless)
+        tracer = self.engine.tracer
+        traced = [
+            r for r in batch if tracer.enabled and r.trace is not None
+        ]
+        sampled = any(r.trace.sampled for r in traced)
+        batch_span = None
+        if traced:
+            for req in traced:
+                tracer.emit(
+                    trace_lib.SPAN_QUEUE_WAIT,
+                    trace_id=req.trace.trace_id,
+                    parent_id=req.trace.span_id,
+                    start_t=wall_now - (now - req.enqueued_t),
+                    duration_s=now - req.enqueued_t,
+                    sampled=req.trace.sampled,
+                )
         try:
-            out = self.engine.infer(x)
+            if traced:
+                with tracer.span(
+                    trace_lib.SPAN_BATCH,
+                    sampled=sampled,
+                    attrs={
+                        "requests": len(batch),
+                        "examples": sum(r.n for r in batch),
+                    },
+                ) as batch_span:
+                    out = self.engine.infer(x)
+            else:
+                out = self.engine.infer(x)
         except Exception as e:  # noqa: BLE001 — fail the requests, not the worker
             self.registry.counter("serve/errors").inc(len(batch))
             for req in batch:
                 req._finish(error=e)
             return
+        if batch_span is not None:
+            self._emit_member_spans(tracer, traced, batch_span)
         offset = 0
         for req in batch:
             lo, hi = offset, offset + req.n
@@ -262,6 +314,37 @@ class MicroBatcher:
         self.registry.counter("serve/completed").inc(len(batch))
         self.registry.counter("serve/batches").inc()
         self.registry.counter("serve/batched_examples").inc(offset)
+
+    @staticmethod
+    def _emit_member_spans(tracer, traced: List[Request], batch_span) -> None:
+        """Mirror the batch's pad/compute spans onto each member request's
+        trace: the request timeline reads queue→pad→compute end to end, and
+        the ``batch_span_id`` attr links each mirrored span to the shared
+        batch trace's compute span (one batch serves many requests, so the
+        link is an attribute, not a parent edge)."""
+        children = {c.name: c for c in batch_span.children}
+        compute = children.get(trace_lib.SPAN_COMPUTE)
+        for name in (trace_lib.SPAN_PAD, trace_lib.SPAN_COMPUTE):
+            child = children.get(name)
+            if child is None:
+                continue
+            link = {
+                "batch_trace_id": batch_span.trace_id,
+                "batch_span_id": (
+                    compute.span_id if compute is not None else batch_span.span_id
+                ),
+                **child.attrs,
+            }
+            for req in traced:
+                tracer.emit(
+                    name,
+                    trace_id=req.trace.trace_id,
+                    parent_id=req.trace.span_id,
+                    start_t=child.start_t,
+                    duration_s=child.duration_s,
+                    sampled=req.trace.sampled,
+                    attrs=link,
+                )
 
     def _run(self) -> None:
         while True:

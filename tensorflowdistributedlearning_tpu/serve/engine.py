@@ -21,6 +21,7 @@ report from the same instruments the trainers use.
 from __future__ import annotations
 
 import bisect
+import contextlib
 import logging
 import threading
 import time
@@ -28,6 +29,7 @@ from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from tensorflowdistributedlearning_tpu.obs import trace as trace_lib
 from tensorflowdistributedlearning_tpu.obs.metrics import MetricsRegistry
 
 logger = logging.getLogger(__name__)
@@ -35,6 +37,10 @@ logger = logging.getLogger(__name__)
 # the ladder production TPU servers converge on: fine steps at the small end
 # (latency-sensitive singletons), coarse at the top (throughput batches)
 DEFAULT_BUCKETS: Tuple[int, ...] = (1, 4, 16, 64)
+
+# reusable no-op context: the untraced request path must not pay even the
+# generator-contextmanager entry of a disabled tracer span
+_NULL_CTX = contextlib.nullcontext()
 
 
 class RequestTooLargeError(ValueError):
@@ -75,6 +81,7 @@ class InferenceEngine:
         input_dtype="float32",
         registry: Optional[MetricsRegistry] = None,
         quantization: Optional[Dict] = None,
+        tracer: Optional[trace_lib.Tracer] = None,
     ):
         self.serve_fn = serve_fn
         self.example_shape = tuple(int(d) for d in example_shape)
@@ -87,6 +94,10 @@ class InferenceEngine:
         # artifacts — informational: the graph itself carries the dtypes
         self.quantization = quantization
         self.registry = registry if registry is not None else MetricsRegistry()
+        # per-request tracing (obs/trace.py): infer() emits pad/compute spans
+        # that nest under the batcher's batch span; the null tracer keeps the
+        # request path branch-free when tracing is off
+        self.tracer = tracer if tracer is not None else trace_lib.NULL_TRACER
         self._pad_h = self.registry.histogram("serve/pad")
         self._compute_h = self.registry.histogram("serve/compute")
         # pre-create so /metrics shows the whole ladder even before traffic
@@ -116,6 +127,7 @@ class InferenceEngine:
         *,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         registry: Optional[MetricsRegistry] = None,
+        tracer: Optional[trace_lib.Tracer] = None,
     ) -> "InferenceEngine":
         """Engine over an exported StableHLO artifact (``train/serving.py``).
 
@@ -149,6 +161,7 @@ class InferenceEngine:
             input_dtype=manifest["input_dtype"],
             registry=registry,
             quantization=manifest.get("quantization"),
+            tracer=tracer,
         )
 
     @property
@@ -247,20 +260,36 @@ class InferenceEngine:
             )
         n = x.shape[0]
         bucket = self.select_bucket(n)
+        # trace spans nest under the caller's active span (the batcher's
+        # batch span) via the tracer's thread-local stack; disabled tracing
+        # costs one attribute read per infer
+        traced = self.tracer.enabled
+        attrs = {"bucket": bucket, "n": n} if traced else None
         t0 = time.perf_counter()
-        if n != bucket:
-            # copy into the bucket's reusable scratch pad (zeroing the tail,
-            # which may hold rows from a previous, fuller dispatch) instead
-            # of concatenating into a fresh allocation every call. infer()
-            # blocks until the device result is ready before returning, so
-            # within a thread the buffer is never overwritten mid-compute.
-            buf = self._scratch_for(bucket)
-            buf[:n] = x
-            buf[n:] = 0
-            x = buf
+        with (
+            self.tracer.span(trace_lib.SPAN_PAD, attrs=attrs)
+            if traced
+            else _NULL_CTX
+        ):
+            if n != bucket:
+                # copy into the bucket's reusable scratch pad (zeroing the
+                # tail, which may hold rows from a previous, fuller dispatch)
+                # instead of concatenating into a fresh allocation every
+                # call. infer() blocks until the device result is ready
+                # before returning, so within a thread the buffer is never
+                # overwritten mid-compute.
+                buf = self._scratch_for(bucket)
+                buf[:n] = x
+                buf[n:] = 0
+                x = buf
         self._pad_h.record(time.perf_counter() - t0)
         t0 = time.perf_counter()
-        out = jax.block_until_ready(self.serve_fn(x))
+        with (
+            self.tracer.span(trace_lib.SPAN_COMPUTE, attrs=attrs)
+            if traced
+            else _NULL_CTX
+        ):
+            out = jax.block_until_ready(self.serve_fn(x))
         self._compute_h.record(time.perf_counter() - t0)
         self._hit_counters[bucket].inc()
         self._example_counters[bucket].inc(n)

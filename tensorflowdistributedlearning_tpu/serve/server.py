@@ -7,13 +7,27 @@ front of the engine/batcher pair. Wire protocol, TF-Serving-shaped:
 
     POST /v1/predict   {"instances": [[...], ...], "deadline_ms": 250}
                     -> {"predictions": {...}, "n": k}
-    GET  /healthz      {"ok": true, "draining": false, ...}
+    GET  /healthz      {"ok": true, "status": "ok|degraded|draining",
+                        "artifact": {...}, "uptime_s": ...}
     GET  /metrics      live registry snapshot + bucket hits + queue depth
+                       (JSON by default; Prometheus text exposition under
+                       ``Accept: text/plain`` or ``?format=prometheus``)
 
-Errors are structured, never silent: 400 malformed input, 413 over the
-largest bucket, 429 queue full (backpressure), 503 draining, 504 deadline —
-each body carries ``{"error": {"code", "message"}}`` and bumps the matching
-registry counter.
+Every ``/v1/predict`` response — success and error alike, 429s and timeouts
+included — echoes the request id as ``x-request-id`` (honoring a
+client-supplied header, minting one otherwise); the id doubles as the
+request's trace id, so a shed request is correlatable with server-side
+telemetry from the client's copy of the id alone. Errors are structured,
+never silent: 400 malformed input, 413 over the largest bucket, 429 queue
+full (backpressure), 503 draining, 504 deadline — each body carries
+``{"error": {"code", "message", "request_id"}}`` (``code`` is the
+machine-readable kind) and bumps the matching registry counter.
+
+SLO: with a p99 target configured (``--slo-p99-ms``), answered-request
+latency feeds an ``obs.health.SloTracker`` (deadline expiries count as
+violations); each ledger window evaluates the error budget, breaches write
+``health_alert`` events, and ``/healthz`` reports ``status: "degraded"`` —
+the signal a fleet router drains on.
 
 Request-path telemetry: alongside the live ``/metrics`` view, the server
 appends ``serve_window`` events to the workdir's ``telemetry.jsonl`` every
@@ -30,12 +44,18 @@ import json
 import logging
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
 import numpy as np
 
-from tensorflowdistributedlearning_tpu.obs.metrics import time_summary
+from tensorflowdistributedlearning_tpu.obs import health as health_lib
+from tensorflowdistributedlearning_tpu.obs import trace as trace_lib
+from tensorflowdistributedlearning_tpu.obs.metrics import (
+    time_summary,
+    window_count,
+)
 from tensorflowdistributedlearning_tpu.obs.telemetry import NULL_TELEMETRY
 from tensorflowdistributedlearning_tpu.serve.batcher import (
     DeadlineExceededError,
@@ -60,8 +80,9 @@ _WINDOW_COUNTERS = (
 )
 # per-window latency histograms, drained each window so a long-lived server
 # holds at most one window's samples (same boundedness stance as the
-# trainers' span histograms, obs/telemetry.py)
-_WINDOW_HISTOGRAMS = ("queue_wait", "pad", "compute")
+# trainers' span histograms, obs/telemetry.py); "request" is end-to-end
+# handler latency — what the SLO tracker budgets against
+_WINDOW_HISTOGRAMS = ("queue_wait", "pad", "compute", "request")
 
 
 class ServingServer:
@@ -77,12 +98,30 @@ class ServingServer:
         telemetry=None,
         window_secs: float = 30.0,
         result_timeout_s: float = 60.0,
+        slo_p99_ms: Optional[float] = None,
+        slo_error_budget: float = 0.01,
     ):
         self.engine = engine
         self.batcher = batcher
         self.telemetry = telemetry if telemetry is not None else NULL_TELEMETRY
         self.window_secs = float(window_secs)
         self.result_timeout_s = float(result_timeout_s)
+        # serving SLO (obs/health.py): p99 target as a windowed error budget;
+        # None = no SLO tracking (healthz never degrades on latency)
+        self.slo = (
+            health_lib.SloTracker(slo_p99_ms, error_budget=slo_error_budget)
+            if slo_p99_ms is not None
+            else None
+        )
+        if self.slo is not None and self.window_secs <= 0:
+            # the budget evaluates at window boundaries; with periodic windows
+            # off only shutdown's final window (or a manual emit_window) runs
+            # it — a breach would go unalerted for the server's lifetime
+            logger.warning(
+                "SLO tracking with window_secs=0: the error budget is only "
+                "evaluated at shutdown; set a positive --window-secs for "
+                "live health_alert events and /healthz degradation"
+            )
         self.draining = False
         self._started_t = time.time()
         self._stop = threading.Event()
@@ -150,12 +189,36 @@ class ServingServer:
         for sig in signals or (signal_lib.SIGINT, signal_lib.SIGTERM):
             signal_lib.signal(sig, lambda *_: self.shutdown())
 
+    @property
+    def health_status(self) -> str:
+        """The replica's live state a fleet router routes on: "draining" >
+        "degraded" (SLO budget blown) > "ok"."""
+        if self.draining:
+            return "draining"
+        if self.slo is not None and not self.slo.healthy:
+            return "degraded"
+        return "ok"
+
+    def artifact_identity(self) -> Optional[Dict]:
+        """What this replica is actually serving — manifest dtype + source
+        fingerprint (train/quantize.py) — so a readiness probe can tell
+        replicas serving different artifacts apart. None for raw-closure /
+        legacy engines whose manifest carries no quantization section."""
+        q = self.engine.quantization
+        if q is None:
+            return None
+        return {
+            "dtype": q.get("dtype"),
+            "source_fingerprint": q.get("source_fingerprint"),
+        }
+
     def metrics_snapshot(self) -> Dict:
         """The ``/metrics`` body: live registry view + serving identity."""
         reg = self.engine.registry
         snapshot = {
             "uptime_s": round(time.time() - self._started_t, 3),
             "draining": self.draining,
+            "status": self.health_status,
             "buckets": {str(b): n for b, n in self.engine.bucket_hits.items()},
             "padding_waste": {
                 str(b): w for b, w in self.engine.padding_waste.items()
@@ -165,9 +228,27 @@ class ServingServer:
             # drain keeps a long-lived server's sample memory bounded
             "registry": reg.snapshot(),
         }
+        if self.slo is not None:
+            snapshot["slo"] = self.slo.snapshot()
         if self.engine.quantization is not None:
             snapshot["serving_dtype"] = self.engine.quantization.get("dtype")
         return snapshot
+
+    def prometheus_text(self) -> str:
+        """The ``/metrics`` Prometheus exposition body (``text/plain;
+        version=0.0.4``): the shared registry rendered by
+        ``MetricsRegistry.render_prometheus``, with server-level state
+        refreshed into gauges first so scrapers see uptime/drain/health
+        without a second endpoint."""
+        reg = self.engine.registry
+        reg.gauge("serve/uptime_s").set(time.time() - self._started_t)
+        reg.gauge("serve/draining").set(1.0 if self.draining else 0.0)
+        reg.gauge("serve/healthy").set(
+            1.0 if self.health_status == "ok" else 0.0
+        )
+        if self.slo is not None:
+            reg.gauge("serve/slo_p99_target_ms").set(self.slo.p99_target_ms)
+        return reg.render_prometheus()
 
     def emit_window(self, final: bool = False) -> Dict:
         """One ``serve_window`` ledger event: cumulative counters, this
@@ -197,12 +278,21 @@ class ServingServer:
                     for k, v in summary.items()
                     if k.endswith("_s") and k != "total_s"
                 }
-                latency[name]["count"] = summary["count"]
+                # exact even when the histogram ring capped the raw samples
+                latency[name]["count"] = float(window_count(samples))
         if latency:
             fields["latency_ms"] = latency
         detector = self.telemetry.detector
         if detector is not None:
             fields["recompiles_post_warmup"] = detector.post_warmup_count
+        if self.slo is not None:
+            # evaluate the error budget on the window boundary: breaches /
+            # recoveries become health_alert events, and the live state rides
+            # in the window for the report's health section
+            verdict = self.slo.evaluate()
+            if verdict is not None:
+                self.telemetry.event(health_lib.HEALTH_ALERT_EVENT, **verdict)
+            fields["slo"] = self.slo.snapshot()
         if final:
             fields["final"] = True
         self.telemetry.event("serve_window", **fields)
@@ -257,83 +347,166 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # route access logs to logging, quiet
         logger.debug("%s - %s", self.address_string(), fmt % args)
 
+    # set per request by do_POST; echoed on every response it produces
+    _request_id: Optional[str] = None
+
     def _json(self, status: int, payload: Dict) -> None:
         body = json.dumps(payload).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if self._request_id:
+            self.send_header("x-request-id", self._request_id)
         self.end_headers()
         self.wfile.write(body)
 
-    def _error(self, status: int, code: str, message: str) -> None:
-        self._json(status, {"error": {"code": code, "message": message}})
+    def _text(self, status: int, body: str, content_type: str) -> None:
+        raw = body.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def _error(self, status: int, code: str, message: str) -> int:
+        """Structured error: ``code`` is the machine-readable kind, and the
+        request id (when one exists — every /v1/predict error has one, 429s
+        and timeouts included) rides in the body AND the x-request-id header
+        so a shed request is correlatable with server-side telemetry.
+        Returns ``status`` so the predict path can hand it back in one
+        expression."""
+        error: Dict = {"code": code, "message": message}
+        if self._request_id:
+            error["request_id"] = self._request_id
+        self._json(status, {"error": error})
+        return status
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
-        if self.path == "/healthz":
+        # keep-alive reuses handler instances: a GET after a POST on the same
+        # connection must not echo the previous request's id
+        self._request_id = None
+        parsed = urllib.parse.urlparse(self.path)
+        if parsed.path == "/healthz":
+            server_status = self.ctx.health_status
             status = 503 if self.ctx.draining else 200
-            self._json(
-                status,
-                {
-                    "ok": not self.ctx.draining,
-                    "draining": self.ctx.draining,
-                    "uptime_s": round(time.time() - self.ctx._started_t, 3),
-                    "buckets": list(self.ctx.engine.buckets),
-                },
-            )
-        elif self.path == "/metrics":
-            self._json(200, self.ctx.metrics_snapshot())
+            body = {
+                # ok = "answers traffic within contract": draining AND
+                # SLO-degraded replicas both report false, with `status`
+                # naming which; only draining refuses traffic (503)
+                "ok": server_status == "ok",
+                "status": server_status,
+                "draining": self.ctx.draining,
+                "uptime_s": round(time.time() - self.ctx._started_t, 3),
+                "buckets": list(self.ctx.engine.buckets),
+                # artifact identity: which export this replica answers from
+                "artifact": self.ctx.artifact_identity(),
+            }
+            if self.ctx.slo is not None:
+                body["slo"] = self.ctx.slo.snapshot()
+            self._json(status, body)
+        elif parsed.path == "/metrics":
+            query = urllib.parse.parse_qs(parsed.query)
+            accept = self.headers.get("Accept", "")
+            if (
+                query.get("format", [""])[0] == "prometheus"
+                or "text/plain" in accept
+                or "openmetrics" in accept
+            ):
+                self._text(
+                    200,
+                    self.ctx.prometheus_text(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            else:
+                self._json(200, self.ctx.metrics_snapshot())
         else:
             self._error(404, "not_found", f"no route for GET {self.path}")
 
     def do_POST(self):  # noqa: N802
+        # request identity FIRST — before any routing answer, so a 404 on a
+        # reused keep-alive connection cannot echo the previous request's id:
+        # honor a client-supplied x-request-id, mint one otherwise; it
+        # doubles as the trace id, so the header clients get back IS the key
+        # into the sampled trace ledger
+        self._request_id = (
+            self.headers.get("x-request-id") or trace_lib.new_id()
+        )
         if self.path != "/v1/predict":
             self._error(404, "not_found", f"no route for POST {self.path}")
             return
+        tracer = self.ctx.telemetry.tracer
+        t0 = time.perf_counter()
+        if tracer.enabled:
+            with tracer.span(
+                trace_lib.SPAN_REQUEST, trace_id=self._request_id
+            ) as span:
+                status = self._predict(span)
+                span.attrs["status"] = status
+        else:
+            status = self._predict(None)
+        self._account_latency(status, time.perf_counter() - t0)
+
+    def _account_latency(self, status: int, dt: float) -> None:
+        """End-to-end handler latency: answered requests feed the `request`
+        histogram (and the SLO budget); deadline expiries count as SLO
+        violations even though they produce no latency sample."""
+        slo = self.ctx.slo
+        if status == 200:
+            self.ctx.engine.registry.histogram("serve/request").record(dt)
+            if slo is not None:
+                slo.observe(dt)
+        elif status == 504 and slo is not None:
+            slo.observe_violation()
+
+    def _predict(self, span) -> int:
+        """The /v1/predict body; returns the HTTP status it answered with.
+        ``span`` is the open request trace span (None when tracing is off):
+        its context rides the batcher Request so the worker can emit this
+        request's queue/pad/compute child spans."""
         if self.ctx.draining:
-            self._error(503, "draining", "server is draining; retry elsewhere")
-            return
+            return self._error(
+                503, "draining", "server is draining; retry elsewhere"
+            )
         try:
             length = int(self.headers.get("Content-Length", 0))
             payload = json.loads(self.rfile.read(length) or b"{}")
             instances = payload["instances"]
         except (ValueError, KeyError) as e:
-            self._error(400, "bad_request", f"expected JSON {{'instances': [...]}}: {e}")
-            return
+            return self._error(
+                400, "bad_request", f"expected JSON {{'instances': [...]}}: {e}"
+            )
         try:
             x = np.asarray(instances, self.ctx.engine.input_dtype)
         except (ValueError, TypeError) as e:
-            self._error(400, "bad_request", f"instances not array-like: {e}")
-            return
+            return self._error(400, "bad_request", f"instances not array-like: {e}")
         deadline_ms = payload.get("deadline_ms")
         try:
-            request = self.ctx.batcher.submit(x, deadline_ms=deadline_ms)
+            request = self.ctx.batcher.submit(
+                x,
+                deadline_ms=deadline_ms,
+                trace=span.context if span is not None else None,
+            )
             out = request.result(timeout=self.ctx.result_timeout_s)
         except QueueFullError as e:
-            self._error(429, "queue_full", str(e))
-            return
+            return self._error(429, "queue_full", str(e))
         except RequestTooLargeError as e:
-            self._error(413, "request_too_large", str(e))
-            return
+            return self._error(413, "request_too_large", str(e))
         except ServerClosedError as e:
-            self._error(503, "draining", str(e))
-            return
+            return self._error(503, "draining", str(e))
         except DeadlineExceededError as e:
-            self._error(504, "deadline_exceeded", str(e))
-            return
+            return self._error(504, "deadline_exceeded", str(e))
         except TimeoutError as e:
-            self._error(504, "result_timeout", str(e))
-            return
+            return self._error(504, "result_timeout", str(e))
         except ValueError as e:  # wrong example shape
-            self._error(400, "bad_request", str(e))
-            return
+            return self._error(400, "bad_request", str(e))
         except Exception as e:  # noqa: BLE001 — engine failures surfaced by
             # the batcher must still answer structurally, never drop the socket
             logger.exception("inference failed")
-            self._error(500, "internal", f"{type(e).__name__}: {e}")
-            return
+            return self._error(500, "internal", f"{type(e).__name__}: {e}")
         import jax
 
         predictions = jax.tree_util.tree_map(
             lambda a: np.asarray(a).tolist(), out
         )
         self._json(200, {"predictions": predictions, "n": request.n})
+        return 200

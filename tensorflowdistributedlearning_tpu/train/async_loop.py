@@ -34,6 +34,12 @@ copies to the whole host loop. This module owns the three pieces:
 blocks in place (under the ``step`` span, as before) and nothing is tracked —
 the bit-for-bit A/B the bench (``bench.py --async-loop``) and the parity tests
 compare against.
+
+All blocked-on-device time here flows through the telemetry span API, so with
+tracing enabled (``TrainConfig.trace_sample_rate``) the ``fetch_wait`` waits
+appear as sampled spans in ``telemetry-report --export-trace`` timelines
+alongside step/eval/checkpoint — the per-unit view of dispatch-ahead
+backpressure.
 """
 
 from __future__ import annotations

@@ -169,6 +169,13 @@ class CheckpointManager:
             self._ckpt.wait_until_finished()
         return saved
 
+    def is_save_step(self, step: int) -> bool:
+        """Whether ``step`` is on the periodic save cadence — THE cadence
+        rule, exposed so callers that wrap saves (the trainers' checkpoint
+        trace spans) gate on the manager's own decision instead of
+        re-deriving it from config."""
+        return step % self.save_every_steps == 0
+
     def maybe_save(self, state: TrainState, step: Optional[int] = None) -> bool:
         """Save iff ``step`` is on the periodic cadence (reference:
         ``save_checkpoints_steps=500``, model.py:118).
@@ -178,7 +185,7 @@ class CheckpointManager:
         just-dispatched train step (which would defeat async dispatch pipelining)."""
         if step is None:
             step = int(jax.device_get(state.step))
-        if step % self.save_every_steps != 0:
+        if not self.is_save_step(step):
             return False
         return self.save(state)
 

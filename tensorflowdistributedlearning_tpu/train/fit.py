@@ -362,6 +362,10 @@ class ClassifierTrainer:
             self.model_dir,
             enabled=tcfg.telemetry,
             memory_every_windows=tcfg.telemetry_memory_every_windows,
+            # sampled per-step/eval/checkpoint traces (obs/trace.py) and the
+            # online health monitors (obs/health.py) ride the window stream
+            trace_sample_rate=tcfg.trace_sample_rate,
+            health=obs_lib.HealthMonitor.from_train_config(tcfg),
             run_info={
                 "task": "classification",
                 "steps": steps,
@@ -512,9 +516,16 @@ class ClassifierTrainer:
             faults_lib.fire(faults_lib.SITE_STEP, step_no)
             if preempt_lib.requested():
                 # the deferred window reaches the ledger BEFORE the preemption
-                # checkpoint/events — resilience reporting stays complete
-                overlap.flush()
-                ckpt.save(state, force=True)
+                # checkpoint/events — resilience reporting stays complete.
+                # Preemption outranks a health abort surfacing from this
+                # flush: the alert is already ledgered, and the supervisor
+                # contract (final checkpoint + EXIT_PREEMPTED) must hold.
+                try:
+                    overlap.flush()
+                except obs_lib.HealthAbortError:
+                    pass
+                with tel.span(obs_lib.SPAN_CHECKPOINT):
+                    ckpt.save(state, force=True)
                 tel.checkpoint_event(step_no, preempted=True)
                 tel.event(
                     "preempted", step=step_no, reason=preempt_lib.reason()
@@ -546,7 +557,15 @@ class ClassifierTrainer:
                 # train-side executables exist now: further train compiles
                 # are recompiles (the first eval marks its own phase warm)
                 tel.mark_warm(obs_lib.SPAN_STEP, obs_lib.SPAN_DATA_WAIT)
-            if ckpt.maybe_save(state, step=step_no):
+            # the checkpoint span is a trace boundary (sampled runs show
+            # checkpoint spans in --export-trace timelines), not a window
+            # span; opened only on the manager's own save cadence so
+            # off-cadence steps stay span-free
+            saved = False
+            if ckpt.is_save_step(step_no):
+                with tel.span(obs_lib.SPAN_CHECKPOINT):
+                    saved = ckpt.maybe_save(state, step=step_no)
+            if saved:
                 overlap.flush()
                 window_dirty = True
                 tel.checkpoint_event(step_no)
@@ -562,9 +581,19 @@ class ClassifierTrainer:
                     step_lib.with_ema_params(state), final_metrics
                 )
                 window_dirty = True
-        overlap.flush()
-        ckpt.save(state, force=True)
+        # an abort surfacing from the end-of-run flush must not skip the
+        # final checkpoint — write it, then re-raise (abort means "stop at a
+        # recorded boundary", not "discard the run's last steps")
+        abort_err: Optional[BaseException] = None
+        try:
+            overlap.flush()
+        except obs_lib.HealthAbortError as e:
+            abort_err = e
+        with tel.span(obs_lib.SPAN_CHECKPOINT):
+            ckpt.save(state, force=True)
         tel.checkpoint_event(step_no, final=True)
+        if abort_err is not None:
+            raise abort_err
         if last_eval_step != step_no:
             final_metrics = self._evaluate(state, batch_size, step_no=step_no)
             if tb_eval is not None:
@@ -927,6 +956,8 @@ def fit_preset(
     grad_clip_norm: Optional[float] = None,
     prefetch_depth: Optional[int] = None,
     dispatch_ahead_steps: Optional[int] = None,
+    trace_sample_rate: Optional[float] = None,
+    nan_guard: Optional[str] = None,
 ) -> FitResult:
     """Train a named config preset end-to-end (the CLI `fit` entry point)."""
     from tensorflowdistributedlearning_tpu.configs import get_preset
@@ -964,6 +995,8 @@ def fit_preset(
         or grad_clip_norm is not None
         or prefetch_depth is not None
         or dispatch_ahead_steps is not None
+        or trace_sample_rate is not None
+        or nan_guard is not None
     ):
         train_cfg = dataclasses.replace(
             train_cfg,
@@ -1012,6 +1045,14 @@ def fit_preset(
                 dispatch_ahead_steps
                 if dispatch_ahead_steps is not None
                 else train_cfg.dispatch_ahead_steps
+            ),
+            trace_sample_rate=(
+                trace_sample_rate
+                if trace_sample_rate is not None
+                else train_cfg.trace_sample_rate
+            ),
+            nan_guard=(
+                nan_guard if nan_guard is not None else train_cfg.nan_guard
             ),
         )
     trainer = ClassifierTrainer(

@@ -296,6 +296,10 @@ class Trainer:
             self.model_dir,
             enabled=tcfg.telemetry,
             memory_every_windows=tcfg.telemetry_memory_every_windows,
+            # sampled per-step/eval/checkpoint traces (obs/trace.py) and the
+            # online health monitors (obs/health.py) ride the window stream
+            trace_sample_rate=tcfg.trace_sample_rate,
+            health=obs_lib.HealthMonitor.from_train_config(tcfg),
             run_info={
                 "task": "segmentation",
                 "steps": steps,
@@ -341,6 +345,12 @@ class Trainer:
         steps: int,
     ) -> Dict[str, float]:
         tcfg = self.train_config
+        # one telemetry (and one HealthMonitor) spans all K folds, but loss
+        # history and step-time baselines are per-FOLD facts: a converged
+        # fold's low-loss median would flag the next fold's fresh untrained
+        # loss as a spike
+        if self._telemetry.health is not None:
+            self._telemetry.health.reset()
         # per-process data: each host loads only its round-robin shard of the fold
         # and draws batch/P examples per step; global_shard_batch assembles them
         # into one globally-sharded batch (the per-host generalization of the
@@ -471,8 +481,15 @@ class Trainer:
             if preempt_lib.requested():
                 # the deferred window reaches the ledger BEFORE the preemption
                 # checkpoint/events — resilience reporting stays complete
-                overlap.flush()
-                ckpt.save(state, force=True)
+                # preemption outranks a health abort surfacing from this
+                # flush: the alert is already ledgered, and the supervisor
+                # contract (final checkpoint + EXIT_PREEMPTED) must hold
+                try:
+                    overlap.flush()
+                except obs_lib.HealthAbortError:
+                    pass
+                with tel.span(obs_lib.SPAN_CHECKPOINT):
+                    ckpt.save(state, force=True)
                 tel.checkpoint_event(step_no, fold=fold, preempted=True)
                 tel.event(
                     "preempted",
@@ -511,7 +528,13 @@ class Trainer:
                 # one extra inference-mode forward per log interval
                 if jax.process_count() == 1:
                     self._write_image_summaries(tb_train, state, batch, step_no)
-            saved = ckpt.maybe_save(state, step=step_no)
+            # checkpoint span = trace boundary (obs/trace.py), opened only on
+            # the manager's own save cadence so off-cadence steps stay
+            # span-free
+            saved = False
+            if ckpt.is_save_step(step_no):
+                with tel.span(obs_lib.SPAN_CHECKPOINT):
+                    saved = ckpt.maybe_save(state, step=step_no)
             if saved:
                 overlap.flush()
                 window_dirty = True
@@ -541,9 +564,18 @@ class Trainer:
         # end of training: final checkpoint + eval + export (train_and_evaluate's
         # final-eval contract) — skipped when the last loop iteration already
         # checkpointed and evaluated at this exact step
-        overlap.flush()
-        ckpt.save(state, force=True)
+        # an abort from the end-of-fold flush must not skip the final
+        # checkpoint — write it, then re-raise
+        abort_err = None
+        try:
+            overlap.flush()
+        except obs_lib.HealthAbortError as e:
+            abort_err = e
+        with tel.span(obs_lib.SPAN_CHECKPOINT):
+            ckpt.save(state, force=True)
         tel.checkpoint_event(step_no, fold=fold, final=True)
+        if abort_err is not None:
+            raise abort_err
         if last_eval_step != step_no:
             final_metrics = self._evaluate(
                 state, eval_ds, batch_size, fold, writer=tb_eval,

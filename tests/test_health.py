@@ -1,0 +1,464 @@
+"""obs/health.py: online health monitors + Prometheus exposition.
+
+The acceptance pins: an injected NaN loss (via the resilience/faults.py hook
+pattern, ``nan-loss@N``) produces a structured ``health_alert`` ledger event
+and honors warn-vs-abort; a forced p99 SLO breach alerts, renders in
+``telemetry-report``, and degrades ``/healthz``; ``/metrics`` with a
+Prometheus Accept header returns parseable exposition text."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu import obs
+from tensorflowdistributedlearning_tpu.obs import health as health_lib
+from tensorflowdistributedlearning_tpu.resilience import faults as faults_lib
+from tensorflowdistributedlearning_tpu.serve import (
+    InferenceEngine,
+    MicroBatcher,
+    ServingServer,
+)
+
+FEATURES = 4
+CLASSES = 3
+
+
+# -- monitor units -----------------------------------------------------------
+
+
+def test_nan_guard_warn_abort_off():
+    warn = health_lib.NanGuard("warn")
+    assert warn.check(1, 0.5) is None
+    alert = warn.check(2, float("nan"))
+    assert alert["monitor"] == "nan_loss" and alert["severity"] == "warn"
+    assert warn.check(3, float("inf"))["loss"] == "inf"
+    abort = health_lib.NanGuard("abort")
+    assert abort.check(1, float("nan"))["severity"] == "critical"
+    off = health_lib.NanGuard("off")
+    assert off.check(1, float("nan")) is None
+    with pytest.raises(ValueError):
+        health_lib.NanGuard("explode")
+
+
+def test_loss_spike_detector_median_mad():
+    det = health_lib.LossSpikeDetector(min_history=4, threshold=8.0)
+    for step, loss in enumerate((1.0, 1.02, 0.98, 1.01, 0.99)):
+        assert det.check(step, loss) is None
+    alert = det.check(10, 9.0)
+    assert alert["monitor"] == "loss_spike"
+    assert alert["loss"] == 9.0 and 0.9 < alert["median"] < 1.1
+    # a non-finite loss is the NaN guard's business, never a spike
+    assert det.check(11, float("nan")) is None
+    # back to normal: no alert
+    assert det.check(12, 1.0) is None
+
+
+def test_step_time_regression_transitions():
+    det = health_lib.StepTimeRegressionDetector(baseline_windows=3, factor=1.5)
+    for step, ms in enumerate((100.0, 102.0, 98.0)):
+        assert det.check(step, ms) is None
+    assert det.baseline_ms == 100.0
+    # dirty windows never alert (compile/eval noise)
+    assert det.check(10, 500.0, dirty=True) is None
+    alert = det.check(11, 200.0)
+    assert alert["monitor"] == "step_time" and not alert.get("resolved")
+    # sustained regression: ONE alert, not a flood
+    assert det.check(12, 210.0) is None
+    resolved = det.check(13, 105.0)
+    assert resolved["resolved"] is True
+    assert det.check(14, 104.0) is None
+
+
+def test_slo_tracker_breach_and_recovery():
+    slo = health_lib.SloTracker(50.0, error_budget=0.01, min_requests=10)
+    assert slo.healthy
+    # idle window: too few requests, never degrades
+    slo.observe(1.0)
+    assert slo.evaluate() is None and slo.healthy
+    # breached window: >1% of requests over 50ms
+    for _ in range(20):
+        slo.observe(0.2)
+    alert = slo.evaluate()
+    assert alert["monitor"] == "slo" and alert["severity"] == "critical"
+    assert not slo.healthy and alert["violation_frac"] == 1.0
+    # still breached: no repeat alert (state, not spam)
+    for _ in range(20):
+        slo.observe(0.2)
+    assert slo.evaluate() is None and not slo.healthy
+    # recovered window
+    for _ in range(20):
+        slo.observe(0.001)
+    resolved = slo.evaluate()
+    assert resolved["resolved"] is True and slo.healthy
+    # deadline expiries count as violations without a latency sample
+    for _ in range(20):
+        slo.observe_violation()
+    assert slo.evaluate()["window_violations"] == 20
+
+
+def test_slo_tracker_memory_is_bounded_with_exact_counts():
+    """A tracker nobody evaluates (idle windows, --window-secs 0) must not
+    grow host memory; the budget math stays exact past the sample cap."""
+    slo = health_lib.SloTracker(50.0, min_requests=10)
+    n = 3 * health_lib.SloTracker.MAX_WINDOW_SAMPLES
+    for _ in range(n):
+        slo.observe(0.2)  # all over target
+    assert len(slo._latencies) == health_lib.SloTracker.MAX_WINDOW_SAMPLES
+    alert = slo.evaluate()
+    assert alert["window_requests"] == n
+    assert alert["window_violations"] == n
+    assert alert["violation_frac"] == 1.0
+
+
+# -- trainer-side integration (Telemetry.window_event) -----------------------
+
+
+def _window(tel, step, loss, mean_ms=None):
+    scalars = {"loss": loss}
+    # feed a fake step-time via compute samples so fields carry step_time_ms
+    if mean_ms is not None:
+        tel.registry.histogram(f"span/{obs.SPAN_STEP}").record(mean_ms / 1000)
+    tel.window_event(step, steps=1, scalars=scalars)
+
+
+def test_nan_alert_written_and_warn_continues(tmp_path):
+    workdir = str(tmp_path / "run")
+    tel = obs.Telemetry(
+        workdir, run_info={}, health=health_lib.HealthMonitor(nan_action="warn")
+    )
+    _window(tel, 1, 1.0)
+    _window(tel, 2, float("nan"))
+    _window(tel, 3, 1.0)  # warn: training goes on
+    tel.close()
+    events = obs.read_ledger(workdir)
+    alerts = [e for e in events if e["event"] == "health_alert"]
+    assert len(alerts) == 1
+    assert alerts[0]["monitor"] == "nan_loss" and alerts[0]["step"] == 2
+    assert alerts[0]["loss"] == "nan"
+    # the window that carried the NaN was written BEFORE the alert
+    kinds = [e["event"] for e in events]
+    assert kinds.index("health_alert") > kinds.index("step_window")
+
+
+def test_nan_abort_raises_after_ledgering(tmp_path):
+    workdir = str(tmp_path / "run")
+    tel = obs.Telemetry(
+        workdir, run_info={},
+        health=health_lib.HealthMonitor(nan_action="abort"),
+    )
+    _window(tel, 1, 1.0)
+    with pytest.raises(health_lib.HealthAbortError):
+        _window(tel, 2, float("nan"))
+    tel.close()
+    alerts = [
+        e for e in obs.read_ledger(workdir) if e["event"] == "health_alert"
+    ]
+    assert alerts and alerts[0]["severity"] == "critical"
+    assert alerts[0]["action"] == "abort"
+
+
+def test_injected_nan_via_faults_hook(tmp_path):
+    """The drill the satellite pins: nan-loss@2 poisons the 2nd observed
+    window; the guard alerts even though the training loss stream is clean."""
+    workdir = str(tmp_path / "run")
+    tel = obs.Telemetry(
+        workdir, run_info={}, health=health_lib.HealthMonitor(nan_action="warn")
+    )
+    faults_lib.install("nan-loss@2")
+    try:
+        _window(tel, 10, 1.0)
+        _window(tel, 20, 1.0)  # poisoned
+        _window(tel, 30, 1.0)
+    finally:
+        faults_lib.uninstall()
+    tel.close()
+    alerts = [
+        e for e in obs.read_ledger(workdir) if e["event"] == "health_alert"
+    ]
+    assert len(alerts) == 1
+    assert alerts[0]["monitor"] == "nan_loss" and alerts[0]["step"] == 20
+
+
+def test_injected_nan_honors_abort(tmp_path):
+    faults_lib.install("nan-loss@1")
+    tel = obs.Telemetry(
+        str(tmp_path / "run"), run_info={},
+        health=health_lib.HealthMonitor(nan_action="abort"),
+    )
+    try:
+        with pytest.raises(health_lib.HealthAbortError):
+            _window(tel, 5, 0.7)
+    finally:
+        faults_lib.uninstall()
+        tel.close()
+
+
+def test_fit_run_with_injected_nan_alerts_and_reports(tmp_path):
+    """End to end through the real trainer: a fit() run with nan-loss
+    injected writes the alert and telemetry-report renders the health
+    section."""
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.obs.report import report_workdir
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    workdir = str(tmp_path / "fit_nan")
+    trainer = ClassifierTrainer(
+        workdir,
+        None,
+        ModelConfig(
+            num_classes=4, input_shape=(16, 16), input_channels=3,
+            n_blocks=(1, 1, 1), width_multiplier=0.125, output_stride=None,
+        ),
+        TrainConfig(
+            train_log_every_steps=2, checkpoint_every_steps=8,
+            eval_every_steps=8, nan_guard="warn",
+        ),
+    )
+    faults_lib.install("nan-loss@2")
+    try:
+        trainer.fit(batch_size=8, steps=8, eval_every_steps=8)
+    finally:
+        faults_lib.uninstall()
+    alerts = [
+        e for e in obs.read_ledger(workdir) if e["event"] == "health_alert"
+    ]
+    assert len(alerts) == 1 and alerts[0]["monitor"] == "nan_loss"
+    rendered = report_workdir(workdir)
+    assert "health" in rendered and "nan_loss" in rendered
+
+
+def test_fit_run_nan_abort_stops_with_ledgered_story(tmp_path):
+    """nan_guard=abort through the real trainer: the run stops with
+    HealthAbortError, the alert precedes the exit in the ledger, and the
+    close path records the run as interrupted."""
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    workdir = str(tmp_path / "fit_abort")
+    trainer = ClassifierTrainer(
+        workdir,
+        None,
+        ModelConfig(
+            num_classes=4, input_shape=(16, 16), input_channels=3,
+            n_blocks=(1, 1, 1), width_multiplier=0.125, output_stride=None,
+        ),
+        TrainConfig(
+            train_log_every_steps=2, checkpoint_every_steps=8,
+            eval_every_steps=8, nan_guard="abort",
+        ),
+    )
+    faults_lib.install("nan-loss@1")
+    try:
+        with pytest.raises(health_lib.HealthAbortError):
+            trainer.fit(batch_size=8, steps=8, eval_every_steps=8)
+    finally:
+        faults_lib.uninstall()
+    events = obs.read_ledger(workdir)
+    kinds = [e["event"] for e in events]
+    assert "health_alert" in kinds
+    run_end = [e for e in events if e["event"] == "run_end"][-1]
+    assert run_end.get("interrupted") is True
+
+
+def test_health_monitor_reset_clears_fold_history():
+    """The K-fold boundary contract: a converged phase's low-loss history
+    must not flag the next phase's fresh loss as a spike."""
+    monitor = health_lib.HealthMonitor(nan_action="warn")
+    for step in range(12):
+        assert monitor.spike.check(step, 0.1) is None
+    monitor.reset()
+    # fresh fold starts high: no history yet, so no spurious spike
+    assert monitor.spike.check(100, 2.5) is None
+
+
+def test_health_monitor_off_config():
+    from tensorflowdistributedlearning_tpu.config import TrainConfig
+
+    assert (
+        health_lib.HealthMonitor.from_train_config(
+            TrainConfig(health_monitors=False)
+        )
+        is None
+    )
+    monitor = health_lib.HealthMonitor.from_train_config(
+        TrainConfig(nan_guard="abort")
+    )
+    assert monitor.nan_guard.action == "abort"
+    with pytest.raises(ValueError, match="nan_guard"):
+        TrainConfig(nan_guard="bogus")
+    with pytest.raises(ValueError, match="trace_sample_rate"):
+        TrainConfig(trace_sample_rate=2.0)
+
+
+# -- serving SLO + /healthz + Prometheus -------------------------------------
+
+
+@pytest.fixture(scope="module")
+def serve_fn():
+    import jax
+    import jax.numpy as jnp
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (FEATURES, CLASSES)) * 0.3
+
+    @jax.jit
+    def fn(x):
+        logits = x @ w
+        return {
+            "probabilities": jax.nn.softmax(logits, axis=-1),
+            "class": jnp.argmax(logits, axis=-1),
+        }
+
+    return fn
+
+
+def _get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+def _post_predict(url, x):
+    req = urllib.request.Request(
+        url + "/v1/predict",
+        data=json.dumps({"instances": x.tolist()}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+QUANT = {"dtype": "bfloat16", "source_fingerprint": "cafe" * 16}
+
+
+@pytest.fixture
+def slo_server(serve_fn, tmp_path):
+    workdir = str(tmp_path / "serve_slo")
+    tel = obs.Telemetry(workdir, run_info={"kind": "serve"})
+    engine = InferenceEngine(
+        serve_fn, (FEATURES,), buckets=(1, 4),
+        registry=tel.registry, quantization=QUANT,
+    )
+    engine.warmup(telemetry=tel)
+    batcher = MicroBatcher(engine, max_wait_ms=1, max_queue=32)
+    # an impossible p99 target: every answered request violates it
+    server = ServingServer(
+        engine, batcher, port=0, telemetry=tel, window_secs=0,
+        slo_p99_ms=0.000001,
+    ).start()
+    yield server, workdir
+    server.shutdown()
+
+
+def test_slo_breach_degrades_healthz_and_ledgers(slo_server):
+    server, workdir = slo_server
+    x = np.ones((1, FEATURES), np.float32)
+
+    # healthy replica first: healthz ok, artifact identity present
+    status, _, body = _get(server.url + "/healthz")
+    health = json.loads(body)
+    assert status == 200 and health["ok"] and health["status"] == "ok"
+    assert health["artifact"] == {
+        "dtype": "bfloat16",
+        "source_fingerprint": QUANT["source_fingerprint"],
+    }
+    assert health["uptime_s"] >= 0
+
+    # force the breach: >= min_requests answered requests, all over target
+    for _ in range(25):
+        _post_predict(server.url, x)
+    window = server.emit_window()
+    assert window["slo"]["healthy"] is False
+
+    status, _, body = _get(server.url + "/healthz")
+    health = json.loads(body)
+    # alive (200 — the router reads status, draining is the 503 case) but
+    # degraded: the drain signal
+    assert status == 200
+    assert health["ok"] is False and health["status"] == "degraded"
+
+    events = obs.read_ledger(workdir)
+    alerts = [e for e in events if e["event"] == "health_alert"]
+    assert len(alerts) == 1
+    assert alerts[0]["monitor"] == "slo"
+    assert alerts[0]["severity"] == "critical"
+    assert alerts[0]["violation_frac"] == 1.0
+
+    # the serve window carries end-to-end request latency now
+    windows = [e for e in events if e["event"] == "serve_window"]
+    assert "request" in windows[-1]["latency_ms"]
+
+    from tensorflowdistributedlearning_tpu.obs.report import report_workdir
+
+    rendered = report_workdir(workdir)
+    assert "BREACHED" in rendered and "health" in rendered
+
+
+def _parse_prometheus(text):
+    """Minimal exposition-format validation: every non-comment line is
+    `name{labels} value` with a float value; returns {name: value}."""
+    import re
+
+    metrics = {}
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert re.match(r"^# (TYPE|HELP) \S+", line), line
+            continue
+        m = re.match(
+            r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})? ([0-9.eE+-]+|NaN|[+-]Inf)$',
+            line,
+        )
+        assert m, f"unparseable exposition line: {line!r}"
+        metrics[m.group(1) + (m.group(2) or "")] = float(m.group(3))
+    return metrics
+
+
+def test_metrics_prometheus_content_negotiation(slo_server):
+    server, _ = slo_server
+    x = np.ones((2, FEATURES), np.float32)
+    _post_predict(server.url, x)
+
+    # default stays JSON (no Accept preference)
+    status, headers, body = _get(server.url + "/metrics")
+    assert status == 200
+    assert headers["Content-Type"].startswith("application/json")
+    snapshot = json.loads(body)
+    assert "registry" in snapshot and "slo" in snapshot
+
+    # Prometheus via Accept header
+    status, headers, body = _get(
+        server.url + "/metrics", headers={"Accept": "text/plain"}
+    )
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/plain; version=0.0.4")
+    metrics = _parse_prometheus(body.decode())
+    assert metrics["tfdl_serve_requests_total"] >= 1
+    assert metrics["tfdl_serve_completed_total"] >= 1
+    assert "tfdl_serve_queue_depth" in metrics
+    assert metrics["tfdl_serve_draining"] == 0.0
+    # summary series for the request latency histogram
+    assert metrics["tfdl_serve_request_seconds_count"] >= 1
+    assert metrics["tfdl_serve_request_seconds_sum"] > 0
+    assert any(k.startswith('tfdl_serve_request_seconds{quantile="0.99"}')
+               or k == 'tfdl_serve_request_seconds{quantile="0.99"}'
+               for k in metrics)
+
+    # ... and via ?format= for scrape configs that can't set headers
+    status, headers, _ = _get(server.url + "/metrics?format=prometheus")
+    assert headers["Content-Type"].startswith("text/plain; version=0.0.4")
+
+
+def test_render_prometheus_counts_survive_window_drain(serve_fn):
+    """Scrape-vs-ledger-window independence: draining a histogram for the
+    serve window must not reset the exposition's monotonic _count/_sum."""
+    reg = obs.MetricsRegistry()
+    h = reg.histogram("serve/compute")
+    for _ in range(5):
+        h.record(0.01)
+    h.drain()  # the ledger window took the samples
+    h.record(0.01)
+    metrics = _parse_prometheus(reg.render_prometheus())
+    assert metrics["tfdl_serve_compute_seconds_count"] == 6.0
+    assert abs(metrics["tfdl_serve_compute_seconds_sum"] - 0.06) < 1e-9

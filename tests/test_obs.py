@@ -45,6 +45,81 @@ def test_histogram_window_deltas():
     assert h.samples_since(mark) == [2.0, 3.0]
 
 
+def test_histogram_memory_is_bounded_with_exact_totals():
+    """The unbounded-list regression pin: a histogram nothing drains retains
+    at most max_samples raw floats, while count/total stay EXACT — long runs
+    cannot grow host memory without bound."""
+    cap = 64
+    h = obs.TimeHistogram("t", max_samples=cap)
+    n = 10 * cap
+    for i in range(n):
+        h.record(0.5)
+    assert len(h.samples) == cap  # the ring bound
+    assert len(h) == n  # exact count survives eviction
+    assert h.total_s == pytest.approx(0.5 * n)
+    s = h.summary()
+    assert s["count"] == n and s["total_s"] == pytest.approx(0.5 * n)
+    assert s["p50_s"] == 0.5
+    # drain: retained samples, exact interval accounting, then empty
+    win = h.drain()
+    assert isinstance(win, list) and len(win) == cap
+    assert win.count == n and win.total_s == pytest.approx(0.5 * n)
+    assert len(h) == 0 and h.samples == []
+    # lifetime (Prometheus) series is monotonic across drains
+    h.record(1.0)
+    assert h.lifetime_count == n + 1
+    assert h.lifetime_total_s == pytest.approx(0.5 * n + 1.0)
+
+
+def test_histogram_samples_since_across_eviction():
+    h = obs.TimeHistogram("t", max_samples=4)
+    h.record(1.0)
+    h.record(2.0)
+    mark = len(h)  # 2
+    for v in (3.0, 4.0, 5.0, 6.0):  # evicts 1.0 and 2.0
+        h.record(v)
+    # everything after the mark is still retained here
+    assert h.samples_since(mark) == [3.0, 4.0, 5.0, 6.0]
+    # a mark the ring has evicted past resolves to everything retained
+    assert h.samples_since(0) == [3.0, 4.0, 5.0, 6.0]
+
+
+def test_drain_semantics_unchanged_for_unsaturated_windows():
+    """Existing callers' contract: below the cap, drain returns exactly the
+    recorded samples and the window sums match the naive sum."""
+    from tensorflowdistributedlearning_tpu.obs.metrics import (
+        window_count,
+        window_total_s,
+    )
+
+    h = obs.TimeHistogram("t")
+    for v in (0.1, 0.2, 0.3):
+        h.record(v)
+    win = h.drain()
+    assert list(win) == [0.1, 0.2, 0.3]
+    assert window_total_s(win) == pytest.approx(sum(win))
+    assert window_count(win) == 3
+    # plain lists (tests, deferred-window payloads) still work
+    assert window_total_s([1.0, 2.0]) == 3.0
+    assert window_count(None) == 0
+
+
+def test_render_prometheus_naming_and_types():
+    reg = obs.MetricsRegistry()
+    reg.counter("serve/requests").inc(7)
+    reg.gauge("serve/queue_depth").set(3)
+    reg.histogram("span/step").record(0.25)
+    text = reg.render_prometheus()
+    assert "# TYPE tfdl_serve_requests_total counter" in text
+    assert "tfdl_serve_requests_total 7" in text
+    assert "# TYPE tfdl_serve_queue_depth gauge" in text
+    assert "tfdl_serve_queue_depth 3" in text
+    assert "# TYPE tfdl_span_step_seconds summary" in text
+    assert 'tfdl_span_step_seconds{quantile="0.5"} 0.25' in text
+    assert "tfdl_span_step_seconds_count 1" in text
+    assert text.endswith("\n")
+
+
 def test_registry_get_or_create_and_snapshot():
     reg = obs.MetricsRegistry()
     reg.counter("compiles").inc()
