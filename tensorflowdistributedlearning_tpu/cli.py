@@ -292,6 +292,12 @@ def build_parser() -> argparse.ArgumentParser:
                          help="fraction of requests per window allowed over "
                          "the p99 target before the SLO counts as breached "
                          "(0.01 = the p99 semantics)")
+    p_serve.add_argument("--replica-id", type=int, default=0,
+                         help="this replica's id in a serving fleet: stamped "
+                         "on serve_window ledger events and /healthz, and "
+                         "replica i>0 writes telemetry-{i}.jsonl so N "
+                         "replicas sharing one --workdir produce per-replica "
+                         "ledgers that telemetry-report merges (obs/fleet.py)")
 
     p_qc = sub.add_parser(
         "quantize-check",
@@ -336,8 +342,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="render the goodput report from a workdir's telemetry.jsonl "
         "run ledger (+ xplane trace when one exists under it)",
     )
-    p_rep.add_argument("workdir",
-                       help="training workdir (model-dir) holding telemetry.jsonl")
+    p_rep.add_argument("workdir", nargs="?", default=None,
+                       help="training workdir (model-dir) holding "
+                       "telemetry.jsonl (+ telemetry-{i}.jsonl per extra "
+                       "process/replica, merged automatically); optional "
+                       "with --compare")
     p_rep.add_argument("--trace-dir", default=None,
                        help="xplane trace dir to merge (default: search the "
                        "workdir for *.xplane.pb)")
@@ -349,6 +358,25 @@ def build_parser() -> argparse.ArgumentParser:
                        help="instead of the report, export the last run's "
                        "sampled trace spans as Chrome/Perfetto trace-event "
                        "JSON (load in chrome://tracing or ui.perfetto.dev)")
+    p_rep.add_argument("--straggler-threshold", type=float, default=None,
+                       help="multi-host straggler alert threshold: a window "
+                       "alerts when the slowest host's mean step time "
+                       "exceeds this multiple of the fleet median "
+                       "(default 1.25)")
+    p_rep.add_argument("--registry-dir", default=None, metavar="DIR",
+                       help="cross-run registry ({DIR}/runs.jsonl): "
+                       "--register appends this workdir's summary row; "
+                       "--compare operands may be registered run ids")
+    p_rep.add_argument("--register", action="store_true",
+                       help="append the workdir's run summary (config hash, "
+                       "mesh, final metrics, goodput split, step-time "
+                       "percentiles) to the registry and print the row")
+    p_rep.add_argument("--compare", nargs=2, metavar=("RUN_A", "RUN_B"),
+                       default=None,
+                       help="instead of the report, emit structured "
+                       "noise-aware deltas between two runs (workdir paths, "
+                       "or registered run ids with --registry-dir): step "
+                       "time, data/fetch wait, eval metrics, serving p99")
 
     p_doc = sub.add_parser(
         "doctor",
@@ -609,32 +637,74 @@ def cmd_fit(args) -> int:
 
 
 def cmd_telemetry_report(args) -> int:
-    """Goodput report from the run ledger — throughput trend, step-time
-    percentiles, data-wait/compile/eval time split, recompiles, top device
-    ops when a trace exists (obs/report.py)."""
+    """Goodput report from the run ledger(s) — throughput trend, step-time
+    percentiles, data-wait/compile/eval time split, recompiles, the fleet
+    merge for multi-process workdirs, top device ops when a trace exists
+    (obs/report.py). Also the front door for the cross-run registry and
+    run-vs-run compare (obs/compare.py)."""
+    from tensorflowdistributedlearning_tpu.obs import compare as compare_lib
     from tensorflowdistributedlearning_tpu.obs.report import report_workdir
 
     try:
+        if getattr(args, "compare", None):
+            ref_a, ref_b = args.compare
+            result = compare_lib.compare_workdirs(
+                ref_a, ref_b, registry_dir=args.registry_dir
+            )
+            print(
+                json.dumps(result)
+                if args.json
+                else compare_lib.render_compare(result)
+            )
+            return 0
+        if args.workdir is None:
+            print(
+                "telemetry-report: a workdir is required unless --compare "
+                "is given",
+                file=sys.stderr,
+            )
+            return 2
         if getattr(args, "export_trace", None):
             from tensorflowdistributedlearning_tpu.obs.trace import (
                 write_chrome_trace,
             )
 
+            # fleet-aware: raises the no-ledger FileNotFoundError itself
             n = write_chrome_trace(args.workdir, args.export_trace)
             print(json.dumps({
                 "written": args.export_trace,
                 "span_events": n,
             }))
             return 0
+        if getattr(args, "register", False):
+            if not args.registry_dir:
+                print(
+                    "telemetry-report: --register requires --registry-dir",
+                    file=sys.stderr,
+                )
+                return 2
+            row = compare_lib.register_run(args.registry_dir, args.workdir)
+            print(json.dumps(row))
+            return 0
+        kwargs = {}
+        if getattr(args, "straggler_threshold", None) is not None:
+            kwargs["straggler_threshold"] = args.straggler_threshold
         print(
             report_workdir(
                 args.workdir,
                 trace_dir=args.trace_dir,
                 top=args.top,
                 as_json=args.json,
+                **kwargs,
             )
         )
-    except (FileNotFoundError, ValueError) as e:
+    except FileNotFoundError as e:
+        # a CI pipeline pointing at the wrong dir (or a run that never wrote
+        # a ledger) must FAIL here, loudly — rc 2 + a one-line hint, never a
+        # clean exit it can silently pass on
+        print(f"telemetry-report: {e}", file=sys.stderr)
+        return 2
+    except ValueError as e:
         print(f"telemetry-report: {e}", file=sys.stderr)
         return 1
     return 0
@@ -658,8 +728,13 @@ def cmd_serve(args) -> int:
     telemetry = Telemetry(
         workdir,
         trace_sample_rate=args.trace_sample_rate,
+        # fleet contract: replica i>0 writes telemetry-{i}.jsonl, so N
+        # replicas sharing one workdir leave per-replica ledgers the
+        # telemetry-report merge attributes individually (obs/fleet.py)
+        process_index=args.replica_id,
         run_info={
             "kind": "serve",
+            "replica": args.replica_id,
             "artifact_dir": args.artifact_dir,
             "buckets": list(args.buckets),
             "max_wait_ms": args.max_wait_ms,
@@ -688,12 +763,14 @@ def cmd_serve(args) -> int:
         window_secs=args.window_secs,
         slo_p99_ms=args.slo_p99_ms,
         slo_error_budget=args.slo_error_budget,
+        replica_id=args.replica_id,
     )
     server.start()
     print(
         json.dumps(
             {
                 "serving": server.url,
+                "replica": args.replica_id,
                 "buckets": list(engine.buckets),
                 "warmup_s": {str(b): s for b, s in warmup_s.items()},
                 "ledger": workdir,

@@ -31,6 +31,18 @@ metrics+tracing as a core subsystem, Abadi et al., arXiv:1605.08695):
   structured ``health_alert`` ledger events.
 """
 
+from tensorflowdistributedlearning_tpu.obs.compare import (
+    compare_workdirs,
+    load_registry,
+    register_run,
+    run_summary,
+)
+from tensorflowdistributedlearning_tpu.obs.fleet import (
+    STRAGGLER_ALERT_EVENT,
+    discover_ledgers,
+    fleet_section,
+    fleet_summary,
+)
 from tensorflowdistributedlearning_tpu.obs.health import (
     HEALTH_ALERT_EVENT,
     HealthAbortError,
@@ -40,7 +52,9 @@ from tensorflowdistributedlearning_tpu.obs.health import (
 from tensorflowdistributedlearning_tpu.obs.ledger import (
     LEDGER_FILENAME,
     RunLedger,
+    per_process_filename,
     read_ledger,
+    read_ledger_with_errors,
 )
 from tensorflowdistributedlearning_tpu.obs.metrics import (
     Counter,
@@ -53,6 +67,7 @@ from tensorflowdistributedlearning_tpu.obs.recompile import RecompileDetector
 from tensorflowdistributedlearning_tpu.obs.telemetry import (
     NULL_TELEMETRY,
     PREFETCH_DEPTH_HISTOGRAM,
+    SPAN_BARRIER,
     SPAN_CHECKPOINT,
     SPAN_DATA_WAIT,
     SPAN_EVAL,
@@ -72,11 +87,13 @@ from tensorflowdistributedlearning_tpu.obs.trace import (
 __all__ = [
     "HEALTH_ALERT_EVENT",
     "PREFETCH_DEPTH_HISTOGRAM",
+    "SPAN_BARRIER",
     "SPAN_CHECKPOINT",
     "SPAN_DATA_WAIT",
     "SPAN_EVAL",
     "SPAN_FETCH_WAIT",
     "SPAN_STEP",
+    "STRAGGLER_ALERT_EVENT",
     "TRACE_EVENT",
     "Counter",
     "Gauge",
@@ -93,8 +110,17 @@ __all__ = [
     "TimeHistogram",
     "TraceContext",
     "Tracer",
+    "compare_workdirs",
+    "discover_ledgers",
     "export_chrome_trace",
+    "fleet_section",
+    "fleet_summary",
+    "load_registry",
+    "per_process_filename",
     "read_ledger",
+    "read_ledger_with_errors",
+    "register_run",
+    "run_summary",
     "time_summary",
     "write_chrome_trace",
 ]

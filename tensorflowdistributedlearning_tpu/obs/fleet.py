@@ -1,0 +1,393 @@
+"""Fleet aggregation: merge per-process run ledgers into one cross-host view.
+
+One process = one ledger (``obs.ledger.per_process_filename``: process 0 keeps
+the canonical ``telemetry.jsonl``, process i>0 writes ``telemetry-{i}.jsonl``
+beside it). A pod-scale run therefore leaves N files in one workdir — and the
+pjit scaling methodology this repo follows (arXiv:2204.06514) operates on the
+SLICE, not a process: per-host step-time skew is the straggler signal, and
+per-host barrier wait is how "slow host" is told apart from "slow network".
+
+This module is read-side only (report time, no training-path cost):
+
+- :func:`discover_ledgers` — find + parse every per-process ledger under a
+  workdir (each scoped to its last run, parse errors counted, sorted by
+  process index);
+- :func:`straggler_section` — per-window max/median step-time skew across
+  hosts, worst-host attribution, and ``straggler_alert`` entries for windows
+  past a configurable skew threshold (the same shape as ``health_alert``
+  events, so downstream tooling treats them uniformly);
+- :func:`fleet_section` — the merged report section: per-host goodput splits
+  (data-wait / compute / fetch-wait / barrier-wait), per-host serving totals
+  (keyed by the replica id ``serve_window`` events carry), the straggler
+  analysis, and the slow-host-vs-slow-network hint;
+- :func:`fleet_summary` — standalone merge for non-report callers
+  (``tools/run_suite.py --aggregate``).
+
+``obs.report.build_report`` calls into here automatically: a workdir with one
+ledger renders exactly as before; a workdir with several gains a ``fleet``
+section.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+import re
+import statistics
+from typing import Dict, List, Optional
+
+from tensorflowdistributedlearning_tpu.obs.ledger import (
+    LEDGER_FILENAME,
+    last_run_events,
+    read_ledger_with_errors,
+)
+
+# windows needing at least this much skew before a straggler_alert fires;
+# 1.25 = the slowest host runs 25% over the fleet median, which on a
+# synchronous SPMD step is 25% of every chip's time burned waiting
+DEFAULT_SKEW_THRESHOLD = 1.25
+
+_SECONDARY_LEDGER_RE = re.compile(r"telemetry-(\d+)\.jsonl$")
+
+STRAGGLER_ALERT_EVENT = "straggler_alert"
+
+
+@dataclasses.dataclass
+class ProcessLedger:
+    """One process's parsed ledger. ``events`` is scoped to the LAST run
+    (what every fleet aggregation reads); ``all_events`` keeps the whole
+    appended history for readers with cross-run scope (the report's
+    resilience section) — same parsed objects, no second file read."""
+
+    process_index: int
+    path: str
+    events: List[Dict]
+    all_events: List[Dict]
+    parse_errors: int
+
+    @property
+    def header(self) -> Dict:
+        if self.events and self.events[0].get("event") == "run_header":
+            return self.events[0]
+        return {}
+
+
+def discover_ledgers(workdir: str) -> List[ProcessLedger]:
+    """Every per-process ledger under ``workdir``, sorted by process index.
+
+    ``telemetry.jsonl`` is process 0 (headers that carry an explicit
+    ``process_index`` win over the filename); ``telemetry-{i}.jsonl`` is
+    process i. Unreadable files are skipped (a dead NFS mount on one host
+    must not take down the whole fleet's report); an empty list means the
+    workdir holds no ledger at all."""
+    ledgers: List[ProcessLedger] = []
+    candidates = []
+    canonical = os.path.join(workdir, LEDGER_FILENAME)
+    if os.path.isfile(canonical):
+        candidates.append((0, canonical))
+    for path in sorted(glob.glob(os.path.join(workdir, "telemetry-*.jsonl"))):
+        m = _SECONDARY_LEDGER_RE.search(os.path.basename(path))
+        if m:
+            candidates.append((int(m.group(1)), path))
+    for index, path in candidates:
+        try:
+            all_events, errors = read_ledger_with_errors(path)
+        except OSError:
+            continue
+        events = last_run_events(all_events)
+        header = (
+            events[0]
+            if events and events[0].get("event") == "run_header"
+            else {}
+        )
+        ledgers.append(
+            ProcessLedger(
+                process_index=int(header.get("process_index", index)),
+                path=path,
+                events=events,
+                all_events=all_events,
+                parse_errors=errors,
+            )
+        )
+    ledgers.sort(key=lambda led: led.process_index)
+    return ledgers
+
+
+def _windows(ledger: ProcessLedger) -> List[Dict]:
+    return [e for e in ledger.events if e.get("event") == "step_window"]
+
+
+def _weighted_mean_ms(windows: List[Dict]) -> Optional[float]:
+    pairs = [
+        (e["step_time_ms"]["mean_ms"], float(e.get("steps", 1)))
+        for e in windows
+        if "step_time_ms" in e
+    ]
+    total = sum(w for _, w in pairs)
+    if not total:
+        return None
+    return sum(v * w for v, w in pairs) / total
+
+
+def straggler_section(
+    ledgers: List[ProcessLedger],
+    *,
+    skew_threshold: float = DEFAULT_SKEW_THRESHOLD,
+    max_alerts: int = 20,
+) -> Optional[Dict]:
+    """Cross-host step-time skew, window by window.
+
+    Windows are aligned by their ``step`` field (every host logs the same
+    boundaries — the loop structure is SPMD); for each step present on >= 2
+    hosts, skew = max(mean step time) / median(mean step time) over hosts.
+    Past ``skew_threshold`` the window contributes a ``straggler_alert``
+    naming the worst host. None when fewer than two hosts have comparable
+    windows."""
+    per_host: Dict[int, Dict[int, float]] = {}
+    for led in ledgers:
+        by_step = {
+            int(e["step"]): e["step_time_ms"]["mean_ms"]
+            for e in _windows(led)
+            if "step_time_ms" in e and "step" in e
+        }
+        if by_step:
+            per_host[led.process_index] = by_step
+    if len(per_host) < 2:
+        return None
+    shared_steps = sorted(
+        set.intersection(*(set(m) for m in per_host.values()))
+    )
+    if not shared_steps:
+        return None
+    alerts: List[Dict] = []
+    skews: List[float] = []
+    worst_counts: Dict[int, int] = {}
+    for step in shared_steps:
+        values = {proc: per_host[proc][step] for proc in per_host}
+        med = statistics.median(values.values())
+        if med <= 0:
+            continue
+        worst_proc = max(values, key=lambda p: values[p])
+        skew = values[worst_proc] / med
+        skews.append(skew)
+        worst_counts[worst_proc] = worst_counts.get(worst_proc, 0) + 1
+        if skew > skew_threshold:
+            alerts.append(
+                {
+                    "event": STRAGGLER_ALERT_EVENT,
+                    "severity": "warn",
+                    "step": step,
+                    "skew": round(skew, 3),
+                    "worst_process": worst_proc,
+                    "worst_ms": round(values[worst_proc], 3),
+                    "median_ms": round(med, 3),
+                }
+            )
+    if not skews:
+        return None
+    # the host named by the section: most-often-slowest among ALERTED windows
+    # when any fired (that is the straggler); most-often-slowest overall
+    # otherwise (informational — nobody crossed the threshold)
+    if alerts:
+        attributed: Dict[int, int] = {}
+        for a in alerts:
+            attributed[a["worst_process"]] = (
+                attributed.get(a["worst_process"], 0) + 1
+            )
+        worst_process = max(attributed, key=lambda p: attributed[p])
+    else:
+        worst_process = max(worst_counts, key=lambda p: worst_counts[p])
+    return {
+        "windows_compared": len(skews),
+        "skew_threshold": skew_threshold,
+        "max_skew": round(max(skews), 3),
+        "median_skew": round(statistics.median(skews), 3),
+        "worst_process": worst_process,
+        "worst_window_counts": {
+            str(p): n for p, n in sorted(worst_counts.items())
+        },
+        "alert_count": len(alerts),
+        "alerts": alerts[:max_alerts],
+    }
+
+
+def _process_row(led: ProcessLedger) -> Dict:
+    """One per-host summary row of the fleet section."""
+    windows = _windows(led)
+    header = led.header
+    serve_windows = [
+        e for e in led.events if e.get("event") == "serve_window"
+    ]
+    row: Dict = {
+        "process_index": led.process_index,
+        "ledger": os.path.basename(led.path),
+        "parse_errors": led.parse_errors,
+        "kind": header.get("kind") or header.get("task") or "unknown",
+        "windows": len(windows),
+        "last_step": windows[-1].get("step") if windows else None,
+        "data_wait_s": round(
+            sum(e.get("data_wait_s", 0.0) for e in windows), 3
+        ),
+        "compute_s": round(sum(e.get("compute_s", 0.0) for e in windows), 3),
+        "fetch_wait_s": round(
+            sum(e.get("fetch_wait_s", 0.0) for e in windows), 3
+        ),
+        "barrier_wait_s": round(
+            sum(e.get("barrier_wait_s", 0.0) for e in windows), 3
+        ),
+    }
+    mean_ms = _weighted_mean_ms(windows)
+    if mean_ms is not None:
+        row["step_time_mean_ms"] = round(mean_ms, 3)
+    fp = header.get("fingerprint") or {}
+    if fp and "error" not in fp:
+        row["device_kind"] = fp.get("device_kind")
+    if serve_windows:
+        last = serve_windows[-1]
+        serve: Dict = {
+            "windows": len(serve_windows),
+            "requests": last.get("requests", 0),
+            "completed": last.get("completed", 0),
+            "rejected_queue_full": last.get("rejected_queue_full", 0),
+        }
+        if last.get("replica") is not None:
+            serve["replica"] = last["replica"]
+        p99s = [
+            e["latency_ms"]["request"]["p99_ms"]
+            for e in serve_windows
+            if "request" in e.get("latency_ms", {})
+        ]
+        if p99s:
+            serve["request_p99_worst_window_ms"] = round(max(p99s), 3)
+        row["serve"] = serve
+    return row
+
+
+def _attribution_hint(
+    rows: List[Dict], straggler: Optional[Dict]
+) -> Optional[str]:
+    """Slow host or slow network? On a synchronous fleet the straggler
+    arrives at barriers LAST and so waits least; if the named worst host also
+    has the minimum barrier wait, the skew is that host's own step time (slow
+    host). Roughly equal barrier waits with high collective time in the
+    xplane buckets point at the interconnect instead."""
+    if not straggler or not straggler["alert_count"]:
+        return None
+    waits = {
+        r["process_index"]: r["barrier_wait_s"]
+        for r in rows
+        if r.get("windows")
+    }
+    if len(waits) < 2 or not any(waits.values()):
+        return None
+    worst = straggler["worst_process"]
+    if worst in waits and waits[worst] == min(waits.values()):
+        return (
+            f"process {worst} waits least at barriers while running the "
+            "slowest steps — a slow HOST, not a slow network"
+        )
+    return (
+        "barrier waits do not single out the slow host — check the trace "
+        "section's collectives bucket for network time"
+    )
+
+
+def fleet_section(
+    workdir: str,
+    *,
+    ledgers: Optional[List[ProcessLedger]] = None,
+    skew_threshold: float = DEFAULT_SKEW_THRESHOLD,
+) -> Optional[Dict]:
+    """The merged report's ``fleet`` section; None for single-ledger
+    workdirs (the overwhelmingly common case costs one glob)."""
+    if ledgers is None:
+        ledgers = discover_ledgers(workdir)
+    if len(ledgers) < 2:
+        return None
+    rows = [_process_row(led) for led in ledgers]
+    section: Dict = {
+        "processes": len(ledgers),
+        "ledger_parse_errors": sum(led.parse_errors for led in ledgers),
+        "per_process": rows,
+    }
+    straggler = straggler_section(ledgers, skew_threshold=skew_threshold)
+    if straggler:
+        section["straggler"] = straggler
+        hint = _attribution_hint(rows, straggler)
+        if hint:
+            section["attribution_hint"] = hint
+    return section
+
+
+def fleet_summary(workdir: str, **kwargs) -> Dict:
+    """Standalone merge (``run_suite --aggregate``, ad-hoc tooling): like
+    :func:`fleet_section` but meaningful for ANY ledger count — a dict with
+    ``processes`` 0 (nothing found), 1, or the full merged section."""
+    ledgers = discover_ledgers(workdir)
+    if not ledgers:
+        return {"processes": 0, "per_process": [], "ledger_parse_errors": 0}
+    section = fleet_section(workdir, ledgers=ledgers, **kwargs)
+    if section is None:
+        section = {
+            "processes": 1,
+            "ledger_parse_errors": ledgers[0].parse_errors,
+            "per_process": [_process_row(ledgers[0])],
+        }
+    return section
+
+
+def render_fleet_section(section: Dict) -> List[str]:
+    """Text lines for ``obs.report.render_report``."""
+    lines = [f"\nfleet: {section['processes']} process ledgers merged"]
+    if section.get("ledger_parse_errors"):
+        lines.append(
+            f"  !! {section['ledger_parse_errors']} unparseable ledger "
+            "line(s) dropped across the fleet (torn writes?)"
+        )
+    for row in section["per_process"]:
+        parts = [
+            f"  p{row['process_index']} [{row['kind']}]",
+            f"{row['windows']} window(s)",
+        ]
+        if row.get("step_time_mean_ms") is not None:
+            parts.append(f"step {row['step_time_mean_ms']:.2f}ms")
+        parts.append(
+            f"wait/compute/fetch/barrier "
+            f"{row['data_wait_s']:.2f}/{row['compute_s']:.2f}/"
+            f"{row['fetch_wait_s']:.2f}/{row['barrier_wait_s']:.2f}s"
+        )
+        if row.get("serve"):
+            sv = row["serve"]
+            replica = (
+                f" replica {sv['replica']}" if "replica" in sv else ""
+            )
+            parts.append(
+                f"serve{replica}: {sv['completed']}/{sv['requests']} ok"
+            )
+        if row.get("parse_errors"):
+            parts.append(f"!! {row['parse_errors']} parse error(s)")
+        lines.append("  ".join(parts))
+    st = section.get("straggler")
+    if st:
+        lines.append(
+            f"  straggler: max skew {st['max_skew']:.2f}x over "
+            f"{st['windows_compared']} comparable window(s) "
+            f"(threshold {st['skew_threshold']:.2f}x)"
+        )
+        if st["alert_count"]:
+            lines.append(
+                f"  !! {st['alert_count']} straggler_alert(s) — worst host: "
+                f"process {st['worst_process']}"
+            )
+            for a in st["alerts"][:3]:
+                lines.append(
+                    f"     - step {a['step']}: p{a['worst_process']} at "
+                    f"{a['worst_ms']:.1f}ms vs median {a['median_ms']:.1f}ms "
+                    f"({a['skew']:.2f}x)"
+                )
+        else:
+            lines.append("  no straggler alerts (skew within threshold)")
+    if section.get("attribution_hint"):
+        lines.append(f"  hint: {section['attribution_hint']}")
+    return lines

@@ -20,12 +20,23 @@ import logging
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
 LEDGER_FILENAME = "telemetry.jsonl"
 SCHEMA_VERSION = 1
+
+
+def per_process_filename(process_index: int) -> str:
+    """The fleet ledger naming contract (obs/fleet.py): process 0 keeps the
+    canonical ``telemetry.jsonl`` (single-process runs and their readers are
+    unchanged); every other process writes ``telemetry-{process_index}.jsonl``
+    beside it, so a pod-scale run leaves one ledger per host that
+    ``telemetry-report`` discovers and merges."""
+    if process_index == 0:
+        return LEDGER_FILENAME
+    return f"telemetry-{int(process_index)}.jsonl"
 
 
 class RunLedger:
@@ -129,20 +140,36 @@ def read_ledger(path: str) -> List[Dict]:
 
     ``path`` may be the jsonl file or the workdir containing it. Tolerant of a
     truncated final line (a killed run mid-write) — that line is dropped, not
-    raised."""
+    raised (``read_ledger_with_errors`` additionally reports how many)."""
+    return read_ledger_with_errors(path)[0]
+
+
+def read_ledger_with_errors(path: str) -> Tuple[List[Dict], int]:
+    """``read_ledger`` plus the count of undecodable lines that were skipped.
+
+    A crashed writer's torn last line (or a corrupted middle of the file) must
+    be VISIBLE, not silently absent: the report surfaces the count as
+    ``ledger_parse_errors`` in its header, and a nonzero value means the
+    events list understates what the run actually did."""
     if os.path.isdir(path):
         path = os.path.join(path, LEDGER_FILENAME)
     events: List[Dict] = []
+    errors = 0
     with open(path, encoding="utf-8") as f:
         for line in f:
             line = line.strip()
             if not line:
                 continue
             try:
-                events.append(json.loads(line))
+                record = json.loads(line)
             except json.JSONDecodeError:
-                continue  # truncated tail from an interrupted writer
-    return events
+                errors += 1  # torn tail from an interrupted writer, or worse
+                continue
+            if isinstance(record, dict):
+                events.append(record)
+            else:  # valid JSON but not an event object — still not readable
+                errors += 1
+    return events, errors
 
 
 def last_run_events(events: List[Dict]) -> List[Dict]:

@@ -19,10 +19,7 @@ import dataclasses
 import os
 from typing import Dict, List, Optional
 
-from tensorflowdistributedlearning_tpu.obs.ledger import (
-    last_run_events,
-    read_ledger,
-)
+from tensorflowdistributedlearning_tpu.obs import fleet as fleet_lib
 
 
 def _weighted(values: List[float], weights: List[float]) -> Optional[float]:
@@ -247,11 +244,31 @@ def _resilience_section(all_events: List[Dict]) -> Optional[Dict]:
 
 
 def build_report(
-    workdir: str, *, trace_dir: Optional[str] = None, top: int = 10
+    workdir: str,
+    *,
+    trace_dir: Optional[str] = None,
+    top: int = 10,
+    straggler_threshold: float = fleet_lib.DEFAULT_SKEW_THRESHOLD,
 ) -> Dict:
-    """Assemble the goodput report dict for a workdir's last run."""
-    all_events = read_ledger(workdir)
-    events = last_run_events(all_events)
+    """Assemble the goodput report dict for a workdir's last run.
+
+    Multi-host workdirs hold one ledger per process (obs/fleet.py naming
+    contract); the report is anchored on process 0's ledger and gains a
+    ``fleet`` section merging all of them (per-host goodput splits, straggler
+    analysis past ``straggler_threshold`` skew)."""
+    ledgers = fleet_lib.discover_ledgers(workdir)
+    if not ledgers:
+        raise FileNotFoundError(
+            f"no telemetry ledger (telemetry.jsonl / telemetry-N.jsonl) "
+            f"under {workdir} — pass the run's workdir (the --model-dir a "
+            "trainer wrote, or a serve --workdir)"
+        )
+    # the primary (lowest-index) ledger, parsed once by the discovery: the
+    # resilience section reads the WHOLE appended history (it scopes across
+    # run boundaries), everything else the last run
+    all_events = ledgers[0].all_events
+    parse_errors = ledgers[0].parse_errors
+    events = ledgers[0].events
     if not events:
         raise ValueError(f"empty telemetry ledger under {workdir}")
     header = events[0] if events[0].get("event") == "run_header" else None
@@ -270,6 +287,7 @@ def build_report(
     data_wait_s = sum(e.get("data_wait_s", 0.0) for e in windows)
     compute_s = sum(e.get("compute_s", 0.0) for e in windows)
     fetch_wait_s = sum(e.get("fetch_wait_s", 0.0) for e in windows)
+    barrier_wait_s = sum(e.get("barrier_wait_s", 0.0) for e in windows)
     eval_s = sum(e.get("duration_s", 0.0) for e in evals)
     # run_end carries the exact total from the detector (ledger compile lines
     # are thresholded to the non-trivial ones); fall back to summing those
@@ -284,9 +302,20 @@ def build_report(
     report: Dict = {
         "workdir": workdir,
         "header": {
-            k: v for k, v in (header or {}).items() if k not in ("event", "t")
+            **{
+                k: v
+                for k, v in (header or {}).items()
+                if k not in ("event", "t")
+            },
+            # always present, normally 0: a crashed writer's torn last line
+            # (or a corrupted middle) must be visible, not silently absent
+            "ledger_parse_errors": parse_errors,
         },
         "run": {
+            # when the run actually happened (first event's clock): registry
+            # rows key their run_id off this, so registering a week-old
+            # workdir does not stamp it with today's date
+            "started_t": round(events[0]["t"], 3) if "t" in events[0] else None,
             "wall_s": round(wall_s, 3),
             "last_step": windows[-1]["step"] if windows else None,
             "windows": len(windows),
@@ -304,11 +333,13 @@ def build_report(
             "data_wait_s": round(data_wait_s, 3),
             "compute_s": round(compute_s, 3),
             "fetch_wait_s": round(fetch_wait_s, 3),
+            "barrier_wait_s": round(barrier_wait_s, 3),
             "eval_s": round(eval_s, 3),
             "compile_s": round(compile_s, 3),
             "data_wait_frac": frac(data_wait_s),
             "compute_frac": frac(compute_s),
             "fetch_wait_frac": frac(fetch_wait_s),
+            "barrier_wait_frac": frac(barrier_wait_s),
             "eval_frac": frac(eval_s),
             "compile_frac": frac(compile_s),
         },
@@ -330,6 +361,12 @@ def build_report(
         },
         "checkpoints": len(checkpoints),
     }
+
+    fleet = fleet_lib.fleet_section(
+        workdir, ledgers=ledgers, skew_threshold=straggler_threshold
+    )
+    if fleet:
+        report["fleet"] = fleet
 
     resilience = _resilience_section(all_events)
     if resilience:
@@ -459,6 +496,13 @@ def render_report(report: Dict) -> str:
     fp = (report.get("header") or {}).get("fingerprint") or {}
     run = report["run"]
     lines.append(f"== goodput report: {report['workdir']}")
+    parse_errors = (report.get("header") or {}).get("ledger_parse_errors")
+    if parse_errors:
+        lines.append(
+            f"   !! {parse_errors} unparseable ledger line(s) dropped — a "
+            "crashed writer's torn tail, or worse; the report understates "
+            "the run"
+        )
     if fp and "error" not in fp:
         lines.append(
             f"   {fp.get('n_devices', '?')}x {fp.get('device_kind', '?')} "
@@ -497,6 +541,12 @@ def render_report(report: Dict) -> str:
             f"{ts['fetch_wait_s']:9.2f}s  (host blocked on device values — "
             "dispatch-ahead backpressure)"
         )
+    if ts.get("barrier_wait_s"):
+        lines.append(
+            f"  barrier-wait {_fmt_frac(ts.get('barrier_wait_frac'))}  "
+            f"{ts['barrier_wait_s']:9.2f}s  (blocked at cross-process sync "
+            "points — waiting on slower hosts)"
+        )
     lines.append(
         f"  eval         {_fmt_frac(ts['eval_frac'])}  {ts['eval_s']:9.2f}s"
     )
@@ -534,6 +584,9 @@ def render_report(report: Dict) -> str:
         + (f", last: {ev['last_metrics']}" if ev["last_metrics"] else "")
     )
     lines.append(f"checkpoints: {report['checkpoints']}")
+    fleet = report.get("fleet")
+    if fleet:
+        lines.extend(fleet_lib.render_fleet_section(fleet))
     res = report.get("resilience")
     if res:
         lines.append(
@@ -708,13 +761,19 @@ def report_workdir(
     trace_dir: Optional[str] = None,
     top: int = 10,
     as_json: bool = False,
+    straggler_threshold: float = fleet_lib.DEFAULT_SKEW_THRESHOLD,
 ) -> str:
     """The ``telemetry-report`` CLI body: build + render (or JSON-dump)."""
     import json
 
     if not os.path.exists(workdir):
         raise FileNotFoundError(f"workdir {workdir} does not exist")
-    report = build_report(workdir, trace_dir=trace_dir, top=top)
+    report = build_report(
+        workdir,
+        trace_dir=trace_dir,
+        top=top,
+        straggler_threshold=straggler_threshold,
+    )
     if as_json:
         return json.dumps(report)
     return render_report(report)

@@ -57,6 +57,11 @@ SPAN_FETCH_WAIT = "fetch_wait"
 # window span (nothing drains it; the histogram ring bounds it), but a trace
 # boundary: sampled runs show checkpoint spans in the exported timeline
 SPAN_CHECKPOINT = "checkpoint"
+# host blocked at a cross-process sync point (parallel/multihost.py wraps its
+# multihost_utils calls in `barrier_probe`): on a healthy fleet this is ~0 on
+# the slowest host and largest on the fastest, so per-host barrier_wait is the
+# signal that separates "slow host" from "slow network" in the fleet report
+SPAN_BARRIER = "barrier_wait"
 
 # registry histogram the input prefetcher records its ready-queue depth into
 # (data/pipeline.py:device_prefetch); drained per window like the spans, so
@@ -93,6 +98,8 @@ class Telemetry:
         is_main: Optional[bool] = None,
         trace_sample_rate: float = 0.0,
         health=None,
+        process_index: Optional[int] = None,
+        process_count: Optional[int] = None,
     ):
         self.enabled = enabled and workdir is not None
         self.registry = MetricsRegistry()
@@ -118,15 +125,53 @@ class Telemetry:
         )
         if not self.enabled:
             return
-        if is_main is None:
-            import jax
+        if process_index is None:
+            # the normal trainer path: this process's slot in the
+            # jax.distributed cluster decides the ledger it writes. Explicit
+            # process_index is for producers whose fleet identity is NOT a
+            # jax process — serve replicas sharing one workdir pass their
+            # replica id so each writes its own telemetry-{i}.jsonl.
+            process_index, process_count = 0, 1
+            if is_main is None:
+                try:
+                    from tensorflowdistributedlearning_tpu.parallel import (
+                        multihost,
+                    )
 
-            is_main = jax.process_index() == 0
-        if is_main:
+                    info = multihost.process_info()
+                    process_index = info["process_index"]
+                    process_count = info["process_count"]
+                except Exception:  # noqa: BLE001 — backend probe best-effort
+                    pass
+        process_index = int(process_index)
+        if is_main is None:
+            is_main = process_index == 0
+        # any non-zero index writes a ledger (jax secondary process OR an
+        # explicitly-identified serve replica); process 0 keeps the legacy
+        # is_main gate
+        if is_main or process_index > 0:
             import os
 
-            self.ledger = RunLedger(workdir)
-            header = {"schema_version": 1}
+            # fleet ledger contract (obs/fleet.py): under multi-host EVERY
+            # process writes its own ledger — process 0 the canonical
+            # telemetry.jsonl, process i>0 telemetry-{i}.jsonl — so the merge
+            # can attribute windows to hosts; single-process is unchanged
+            from tensorflowdistributedlearning_tpu.obs.ledger import (
+                per_process_filename,
+            )
+
+            self.ledger = RunLedger(
+                workdir, filename=per_process_filename(process_index)
+            )
+            header = {
+                "schema_version": 1,
+                "process_index": process_index,
+            }
+            # only when actually known: an explicit process_index with no
+            # count (a serve replica that cannot know the fleet size) must
+            # not persist a fabricated count
+            if process_count is not None:
+                header["process_count"] = int(process_count)
             if os.environ.get("TFDL_SUPERVISED_CHILD"):
                 # stamped by resilience/supervisor.py on its children: lets
                 # obs/report tell a supervised session's relaunches apart
@@ -193,7 +238,12 @@ class Telemetry:
         one's."""
         samples = {
             name: self._span_delta(name)
-            for name in (SPAN_DATA_WAIT, SPAN_STEP, SPAN_FETCH_WAIT)
+            for name in (
+                SPAN_DATA_WAIT,
+                SPAN_STEP,
+                SPAN_FETCH_WAIT,
+                SPAN_BARRIER,
+            )
         }
         samples["prefetch_depth"] = self.registry.histogram(
             PREFETCH_DEPTH_HISTOGRAM
@@ -248,21 +298,24 @@ class Telemetry:
         wait = samples.get(SPAN_DATA_WAIT, [])
         compute = samples.get(SPAN_STEP, [])
         fetch = samples.get(SPAN_FETCH_WAIT, [])
+        barrier = samples.get(SPAN_BARRIER, [])
         depth = samples.get("prefetch_depth", [])
         # exact totals even when a histogram ring capped the raw samples
         # (obs/metrics.py:SampleWindow)
-        wait_s, compute_s, fetch_s = (
+        wait_s, compute_s, fetch_s, barrier_s = (
             window_total_s(wait),
             window_total_s(compute),
             window_total_s(fetch),
+            window_total_s(barrier),
         )
-        busy = wait_s + compute_s + fetch_s
+        busy = wait_s + compute_s + fetch_s + barrier_s
         fields: Dict = {
             "step": step,
             "steps": steps,
             "data_wait_s": round(wait_s, 6),
             "compute_s": round(compute_s, 6),
             "fetch_wait_s": round(fetch_s, 6),
+            "barrier_wait_s": round(barrier_s, 6),
             "data_wait_frac": round(wait_s / busy, 4) if busy else 0.0,
             "dirty": dirty,
             **extra,

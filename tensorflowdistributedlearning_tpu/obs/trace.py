@@ -315,15 +315,23 @@ def export_chrome_trace(events: List[Dict]) -> Dict:
 
 
 def write_chrome_trace(workdir: str, out_path: str) -> int:
-    """Export the LAST run's sampled spans from a workdir's ledger to
+    """Export the LAST run's sampled spans from a workdir's ledger(s) to
     ``out_path`` as Chrome trace-event JSON; returns the number of span
-    events written (flow links excluded)."""
-    from tensorflowdistributedlearning_tpu.obs.ledger import (
-        last_run_events,
-        read_ledger,
-    )
+    events written (flow links excluded).
 
-    events = last_run_events(read_ledger(workdir))
+    Fleet-aware: every per-process/per-replica ledger the workdir holds
+    (obs/fleet.py naming contract) contributes its last run's spans, so a
+    multi-host export shows all hosts' timelines — and a workdir holding
+    ONLY secondary ledgers (a replica's --workdir) still exports."""
+    from tensorflowdistributedlearning_tpu.obs import fleet as fleet_lib
+
+    ledgers = fleet_lib.discover_ledgers(workdir)
+    if not ledgers:
+        raise FileNotFoundError(
+            f"no telemetry ledger (telemetry.jsonl / telemetry-N.jsonl) "
+            f"under {workdir}"
+        )
+    events = [e for led in ledgers for e in led.events]
     doc = export_chrome_trace(events)
     with open(out_path, "w", encoding="utf-8") as f:
         json.dump(doc, f)

@@ -18,6 +18,7 @@ across slices. The only host-side code multi-host adds is here:
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Dict, Optional
 
 import jax
@@ -25,6 +26,47 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tensorflowdistributedlearning_tpu.parallel.mesh import BATCH_AXIS
+
+# Telemetry instance whose `barrier_wait` span times the multihost_utils sync
+# points below (registered by the trainers for the run's lifetime). Module
+# state rather than a parameter because the sync points are called from deep
+# inside data/eval plumbing that has no telemetry handle — and there is at
+# most one live training run per process.
+_probe_telemetry = None
+
+
+def instrument(telemetry) -> None:
+    """Time every cross-process sync point in this module as ``telemetry``'s
+    ``barrier_wait`` span. Per-window barrier-wait lands in the ledger's
+    ``step_window`` events, and the fleet report (obs/fleet.py) reads the
+    per-host asymmetry as straggler attribution: the slow host arrives last
+    and waits ~0; everyone else's wait IS the skew."""
+    global _probe_telemetry
+    _probe_telemetry = telemetry
+
+
+def uninstrument(telemetry=None) -> None:
+    """Detach the barrier probe (pass the instance to only detach if it is
+    still the registered one — a later run's probe must not be clobbered by
+    an earlier run's teardown)."""
+    global _probe_telemetry
+    if telemetry is None or _probe_telemetry is telemetry:
+        _probe_telemetry = None
+
+
+@contextlib.contextmanager
+def barrier_probe():
+    """Span context around one multihost_utils sync point; no-op when no
+    telemetry is instrumented (the single-process common case never even gets
+    here — the sync points below all early-return at process_count 1)."""
+    tel = _probe_telemetry
+    if tel is None or not getattr(tel, "enabled", False):
+        yield
+        return
+    from tensorflowdistributedlearning_tpu.obs.telemetry import SPAN_BARRIER
+
+    with tel.span(SPAN_BARRIER):
+        yield
 
 
 def initialize(
@@ -109,7 +151,8 @@ def all_processes_max_batches(local_n: int, per_process_batch: int) -> int:
         return mine
     from jax.experimental import multihost_utils
 
-    counts = multihost_utils.process_allgather(np.asarray(mine, np.int32))
+    with barrier_probe():
+        counts = multihost_utils.process_allgather(np.asarray(mine, np.int32))
     return int(np.max(counts))
 
 
@@ -172,7 +215,8 @@ def fetch(x: Any) -> np.ndarray:
         return np.asarray(jax.device_get(x))
     from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    with barrier_probe():
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
 
 def global_shard_batch(local_tree: Any, mesh: Mesh, *, spatial: bool = False) -> Any:

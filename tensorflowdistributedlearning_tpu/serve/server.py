@@ -100,12 +100,18 @@ class ServingServer:
         result_timeout_s: float = 60.0,
         slo_p99_ms: Optional[float] = None,
         slo_error_budget: float = 0.01,
+        replica_id: int = 0,
     ):
         self.engine = engine
         self.batcher = batcher
         self.telemetry = telemetry if telemetry is not None else NULL_TELEMETRY
         self.window_secs = float(window_secs)
         self.result_timeout_s = float(result_timeout_s)
+        # which fleet replica this server is: stamped on every serve_window
+        # (and serve_start) ledger event so the multi-ledger merge
+        # (obs/fleet.py) can attribute request-path telemetry per replica —
+        # same role process_index plays for trainer ledgers
+        self.replica_id = int(replica_id)
         # serving SLO (obs/health.py): p99 target as a windowed error budget;
         # None = no SLO tracking (healthz never degrades on latency)
         self.slo = (
@@ -162,6 +168,7 @@ class ServingServer:
         self.telemetry.event(
             "serve_start",
             endpoint=self.url,
+            replica=self.replica_id,
             buckets=list(self.engine.buckets),
             max_batch_size=self.batcher.max_batch_size,
             max_wait_ms=self.batcher.max_wait_s * 1000,
@@ -257,6 +264,7 @@ class ServingServer:
         fields: Dict = {
             k: reg.counter(f"serve/{k}").value for k in _WINDOW_COUNTERS
         }
+        fields["replica"] = self.replica_id
         fields["queue_depth"] = reg.gauge("serve/queue_depth").value or 0
         fields["bucket_hits"] = {
             str(b): n for b, n in self.engine.bucket_hits.items()
@@ -329,7 +337,11 @@ class ServingServer:
             rejected_queue_full=final.get("rejected_queue_full"),
             deadline_exceeded=final.get("deadline_exceeded"),
         )
-        self._httpd.shutdown()
+        # only break serve_forever if it ever ran: BaseServer.shutdown()
+        # waits on an event that ONLY serve_forever sets, so calling it on a
+        # constructed-but-never-started server deadlocks forever
+        if self._serve_thread is not None:
+            self._httpd.shutdown()
         self._httpd.server_close()
         if self._serve_thread is not None:
             self._serve_thread.join(timeout=5)
@@ -395,6 +407,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # naming which; only draining refuses traffic (503)
                 "ok": server_status == "ok",
                 "status": server_status,
+                "replica": self.ctx.replica_id,
                 "draining": self.ctx.draining,
                 "uptime_s": round(time.time() - self.ctx._started_t, 3),
                 "buckets": list(self.ctx.engine.buckets),

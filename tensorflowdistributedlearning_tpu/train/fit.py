@@ -380,12 +380,16 @@ class ClassifierTrainer:
                 "train_config": dataclasses.asdict(tcfg),
             },
         )
+        # time cross-process sync points as this run's barrier_wait span —
+        # per-host barrier asymmetry is the fleet report's straggler signal
+        multihost.instrument(self._telemetry)
         try:
             return self._fit_instrumented(batch_size, steps, eval_every)
         finally:
             # idempotent: the success path already closed with final metrics;
             # an exceptional exit reaches this close first and is recorded as
             # interrupted (and the compile listener never leaks either way)
+            multihost.uninstrument(self._telemetry)
             self._telemetry.close(interrupted=True)
             self._telemetry = obs_lib.NULL_TELEMETRY
 

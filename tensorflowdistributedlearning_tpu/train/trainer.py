@@ -315,6 +315,9 @@ class Trainer:
                 "train_config": dataclasses.asdict(tcfg),
             },
         )
+        # time cross-process sync points as this run's barrier_wait span —
+        # per-host barrier asymmetry is the fleet report's straggler signal
+        multihost.instrument(self._telemetry)
         try:
             results = []
             for fold, manifest in enumerate(manifests):
@@ -333,6 +336,7 @@ class Trainer:
         finally:
             # idempotent; an exceptional exit reaches this close first and is
             # recorded as interrupted
+            multihost.uninstrument(self._telemetry)
             self._telemetry.close(interrupted=True)
             self._telemetry = obs_lib.NULL_TELEMETRY
 

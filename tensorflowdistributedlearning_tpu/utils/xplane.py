@@ -287,12 +287,28 @@ def grouped_breakdown(
     rows: List[OpTime], groups: Optional[Dict[str, Tuple[str, ...]]] = None
 ) -> Dict[str, float]:
     """Fold an op breakdown into coarse buckets by substring match (first hit
-    wins, in insertion order) — the "where does the time go" summary."""
+    wins, in insertion order) — the "where does the time go" summary.
+
+    Cross-chip/cross-host collectives get their OWN bucket, listed before the
+    generic ``reduce`` needles so all-reduce/all-gather/reduce-scatter/
+    collective-permute/all-to-all time is separated from compute: on a
+    multi-host capture a fat ``collectives`` bucket with healthy per-host
+    step times reads as a slow NETWORK, where a straggling host shows up in
+    the fleet report's per-host skew instead (obs/fleet.py)."""
     groups = groups or {
         "conv": ("convolution", "conv"),
         "matmul": ("dot", "einsum"),
         "fusion(elementwise/bn)": ("fusion",),
-        "reduce": ("reduce", "all-reduce"),
+        "collectives": (
+            "all-reduce",
+            "all-gather",
+            "reduce-scatter",
+            "collective-permute",
+            "all-to-all",
+            "collective-broadcast",
+            "ragged-all-to-all",
+        ),
+        "reduce": ("reduce",),
         "copy/transpose": ("copy", "transpose", "bitcast"),
         "infeed/outfeed": ("infeed", "outfeed"),
     }

@@ -1,0 +1,590 @@
+"""Fleet observability: per-process ledger discovery/merge, straggler
+attribution with ``straggler_alert`` emission, barrier-probe spans, the
+cross-run registry + compare, and the bench regression sentinel.
+
+The acceptance pins live here: a simulated 2-process run (one host skewed)
+must produce a merged report that NAMES the slow host and emits a
+``straggler_alert``; ``telemetry-report --compare`` on two real fit()
+workdirs must emit structured deltas; and the regression sentinel must pass
+on the committed benches but exit nonzero on an injected 2x step-time
+regression."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from tensorflowdistributedlearning_tpu import obs
+from tensorflowdistributedlearning_tpu.obs import compare as compare_lib
+from tensorflowdistributedlearning_tpu.obs import fleet as fleet_lib
+from tensorflowdistributedlearning_tpu.obs.ledger import (
+    RunLedger,
+    per_process_filename,
+)
+from tensorflowdistributedlearning_tpu.obs.report import (
+    build_report,
+    render_report,
+)
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import regression_sentinel  # noqa: E402
+
+
+# -- simulated fleet ledgers -------------------------------------------------
+
+
+def _write_process_ledger(
+    workdir,
+    idx,
+    mean_ms,
+    *,
+    process_count=2,
+    steps=(2, 4, 6),
+    barrier_s=0.0,
+    kind="classification",
+):
+    led = RunLedger(str(workdir), filename=per_process_filename(idx))
+    led.event(
+        "run_header",
+        schema_version=1,
+        process_index=idx,
+        process_count=process_count,
+        task=kind,
+        fingerprint={
+            "platform": "cpu",
+            "device_kind": "cpu",
+            "n_devices": 4,
+            "process_index": idx,
+            "process_count": process_count,
+            "jax_version": "0.0",
+        },
+    )
+    for s in steps:
+        led.event(
+            "step_window",
+            step=s,
+            steps=2,
+            data_wait_s=0.01,
+            compute_s=mean_ms * 2 / 1000,
+            fetch_wait_s=0.0,
+            barrier_wait_s=barrier_s,
+            data_wait_frac=0.0,
+            dirty=False,
+            step_time_ms={
+                "count": 2.0,
+                "mean_ms": mean_ms,
+                "p50_ms": mean_ms,
+                "p90_ms": mean_ms,
+                "p99_ms": mean_ms,
+                "max_ms": mean_ms,
+            },
+        )
+    led.event("run_end", steps=steps[-1])
+    led.close()
+
+
+def test_per_process_filename_contract():
+    assert per_process_filename(0) == "telemetry.jsonl"
+    assert per_process_filename(1) == "telemetry-1.jsonl"
+    assert per_process_filename(7) == "telemetry-7.jsonl"
+
+
+def test_discover_ledgers_orders_and_scopes(tmp_path):
+    _write_process_ledger(tmp_path, 1, 120.0)
+    _write_process_ledger(tmp_path, 0, 100.0)
+    ledgers = fleet_lib.discover_ledgers(str(tmp_path))
+    assert [led.process_index for led in ledgers] == [0, 1]
+    assert all(
+        led.events[0]["event"] == "run_header" for led in ledgers
+    )
+    # non-ledger jsonl files are not picked up
+    (tmp_path / "telemetry-notanumber.jsonl").write_text("{}\n")
+    assert len(fleet_lib.discover_ledgers(str(tmp_path))) == 2
+
+
+def test_merged_report_names_slow_host_and_emits_straggler_alert(tmp_path):
+    """THE acceptance pin: two per-process ledgers, process 1 skewed 2x —
+    the merged report's straggler section names process 1 and carries
+    straggler_alert entries; the rendering says so in prose."""
+    # the fast host waits at barriers for the slow one; the slow host barely
+    # waits — the asymmetry behind the slow-host-vs-slow-network hint
+    _write_process_ledger(tmp_path, 0, 100.0, barrier_s=0.4)
+    _write_process_ledger(tmp_path, 1, 200.0, barrier_s=0.01)
+    report = build_report(str(tmp_path))
+    fleet = report["fleet"]
+    assert fleet["processes"] == 2
+    assert {r["process_index"] for r in fleet["per_process"]} == {0, 1}
+
+    st = fleet["straggler"]
+    assert st["windows_compared"] == 3
+    assert st["worst_process"] == 1
+    assert st["alert_count"] == 3
+    alert = st["alerts"][0]
+    assert alert["event"] == obs.STRAGGLER_ALERT_EVENT == "straggler_alert"
+    assert alert["worst_process"] == 1
+    assert alert["skew"] == pytest.approx(200.0 / 150.0, abs=0.01)
+    # barrier asymmetry attributes the skew to the host, not the network
+    assert "slow HOST" in fleet["attribution_hint"]
+
+    text = render_report(report)
+    assert "straggler_alert" in text
+    assert "worst host: process 1" in text
+    assert "fleet: 2 process ledgers merged" in text
+
+
+def test_no_straggler_alert_within_threshold(tmp_path):
+    _write_process_ledger(tmp_path, 0, 100.0)
+    _write_process_ledger(tmp_path, 1, 104.0)
+    report = build_report(str(tmp_path))
+    st = report["fleet"]["straggler"]
+    assert st["alert_count"] == 0
+    assert st["max_skew"] < 1.25
+    assert "no straggler alerts" in render_report(report)
+
+
+def test_straggler_threshold_is_configurable(tmp_path):
+    _write_process_ledger(tmp_path, 0, 100.0)
+    _write_process_ledger(tmp_path, 1, 115.0)
+    assert (
+        build_report(str(tmp_path))["fleet"]["straggler"]["alert_count"] == 0
+    )
+    tight = build_report(str(tmp_path), straggler_threshold=1.05)
+    assert tight["fleet"]["straggler"]["alert_count"] == 3
+
+
+def test_single_ledger_report_has_no_fleet_section(tmp_path):
+    _write_process_ledger(tmp_path, 0, 100.0, process_count=1)
+    assert "fleet" not in build_report(str(tmp_path))
+
+
+def test_fleet_merge_covers_serving_replicas(tmp_path):
+    """serve_window events carry their replica id; the merge attributes
+    request-path telemetry per replica ledger."""
+    _write_process_ledger(tmp_path, 0, 100.0)
+    led = RunLedger(str(tmp_path), filename=per_process_filename(1))
+    led.event("run_header", kind="serve", process_index=1, process_count=2,
+              replica=1)
+    led.event(
+        "serve_window", replica=1, requests=50, completed=48,
+        rejected_queue_full=2, deadline_exceeded=0, errors=0, batches=10,
+        batched_examples=48,
+        latency_ms={"request": {
+            "count": 48.0, "mean_ms": 4.0, "p50_ms": 3.5, "p90_ms": 6.0,
+            "p99_ms": 9.5, "max_ms": 11.0,
+        }},
+    )
+    led.close()
+    fleet = build_report(str(tmp_path))["fleet"]
+    serve_row = next(
+        r for r in fleet["per_process"] if r["process_index"] == 1
+    )
+    assert serve_row["kind"] == "serve"
+    assert serve_row["serve"]["replica"] == 1
+    assert serve_row["serve"]["completed"] == 48
+    assert serve_row["serve"]["request_p99_worst_window_ms"] == 9.5
+    text = render_report(build_report(str(tmp_path)))
+    assert "serve replica 1: 48/50 ok" in text
+
+
+# -- ledger parse errors (satellite) ----------------------------------------
+
+
+def test_torn_ledger_lines_are_counted_not_silent(tmp_path):
+    _write_process_ledger(tmp_path, 0, 100.0, process_count=1)
+    with open(tmp_path / "telemetry.jsonl", "a", encoding="utf-8") as f:
+        f.write('{"event": "step_window", "step": 8, "trunc')  # torn tail
+    events, errors = obs.read_ledger_with_errors(str(tmp_path))
+    assert errors == 1
+    assert all(e["event"] != "trunc" for e in events)
+    report = build_report(str(tmp_path))
+    assert report["header"]["ledger_parse_errors"] == 1
+    assert "unparseable ledger line" in render_report(report)
+
+
+def test_clean_ledger_reports_zero_parse_errors(tmp_path):
+    _write_process_ledger(tmp_path, 0, 100.0, process_count=1)
+    assert build_report(str(tmp_path))["header"]["ledger_parse_errors"] == 0
+
+
+# -- telemetry-report CLI exit contract (satellite) --------------------------
+
+
+def test_report_cli_no_ledger_exits_nonzero_with_hint(tmp_path, capsys):
+    from tensorflowdistributedlearning_tpu.cli import main
+
+    rc = main(["telemetry-report", str(tmp_path)])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "no telemetry ledger" in err
+    assert "telemetry.jsonl" in err
+
+
+def test_report_cli_export_trace_no_ledger_exits_nonzero(tmp_path, capsys):
+    from tensorflowdistributedlearning_tpu.cli import main
+
+    rc = main([
+        "telemetry-report", str(tmp_path),
+        "--export-trace", str(tmp_path / "out.json"),
+    ])
+    assert rc == 2
+    assert "no telemetry ledger" in capsys.readouterr().err
+    assert not (tmp_path / "out.json").exists()
+
+
+# -- barrier probe -----------------------------------------------------------
+
+
+def test_barrier_probe_records_span_into_windows(tmp_path):
+    from tensorflowdistributedlearning_tpu.parallel import multihost
+
+    tel = obs.Telemetry(str(tmp_path), is_main=True, run_info={"task": "t"})
+    try:
+        multihost.instrument(tel)
+        with multihost.barrier_probe():
+            time.sleep(0.02)
+        tel.window_event(1, steps=1)
+    finally:
+        multihost.uninstrument(tel)
+        tel.close()
+    windows = [
+        e for e in obs.read_ledger(str(tmp_path))
+        if e["event"] == "step_window"
+    ]
+    assert windows[0]["barrier_wait_s"] >= 0.015
+
+
+def test_barrier_probe_noop_when_uninstrumented():
+    from tensorflowdistributedlearning_tpu.parallel import multihost
+
+    multihost.uninstrument()
+    with multihost.barrier_probe():
+        pass  # must not raise, must not need telemetry
+
+
+def test_uninstrument_only_detaches_own_telemetry(tmp_path):
+    from tensorflowdistributedlearning_tpu.parallel import multihost
+
+    tel_a = obs.Telemetry(str(tmp_path / "a"), is_main=True)
+    tel_b = obs.Telemetry(str(tmp_path / "b"), is_main=True)
+    try:
+        multihost.instrument(tel_b)
+        multihost.uninstrument(tel_a)  # a stale teardown must not clobber b
+        assert multihost._probe_telemetry is tel_b
+    finally:
+        multihost.uninstrument()
+        tel_a.close()
+        tel_b.close()
+
+
+def test_serving_server_stamps_replica_on_windows(tmp_path):
+    import jax.numpy as jnp
+
+    from tensorflowdistributedlearning_tpu.serve import (
+        InferenceEngine,
+        MicroBatcher,
+        ServingServer,
+    )
+
+    engine = InferenceEngine(lambda x: {"y": jnp.asarray(x)}, (2,), buckets=(1,))
+    batcher = MicroBatcher(engine, max_wait_ms=1.0, max_queue=4)
+    tel = obs.Telemetry(
+        str(tmp_path), process_index=2, run_info={"kind": "serve"}
+    )
+    server = ServingServer(
+        engine, batcher, port=0, telemetry=tel, window_secs=0, replica_id=2
+    ).start()
+    try:
+        fields = server.emit_window()
+        assert fields["replica"] == 2
+    finally:
+        server.shutdown()
+    # replica 2 wrote its own per-replica ledger under the fleet contract
+    path = tmp_path / "telemetry-2.jsonl"
+    assert path.exists()
+    events = obs.read_ledger(str(path))
+    assert any(
+        e["event"] == "serve_window" and e["replica"] == 2 for e in events
+    )
+
+
+# -- cross-run compare + registry -------------------------------------------
+
+TINY = dict(
+    num_classes=4,
+    input_shape=(16, 16),
+    input_channels=3,
+    n_blocks=(1, 1, 1),
+    width_multiplier=0.125,
+    output_stride=None,
+)
+
+
+@pytest.fixture(scope="module")
+def two_fit_workdirs(tmp_path_factory):
+    """Two real (tiny) fit() runs — the --compare acceptance operands."""
+    from tensorflowdistributedlearning_tpu.config import (
+        ModelConfig,
+        TrainConfig,
+    )
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    dirs = []
+    for name in ("run_a", "run_b"):
+        workdir = str(tmp_path_factory.mktemp(name))
+        ClassifierTrainer(
+            workdir,
+            None,  # synthetic data
+            ModelConfig(**TINY),
+            TrainConfig(
+                train_log_every_steps=2,
+                checkpoint_every_steps=4,
+                eval_every_steps=4,
+            ),
+        ).fit(batch_size=8, steps=6, eval_every_steps=3)
+        dirs.append(workdir)
+    return dirs
+
+
+@pytest.mark.slow  # two real fit() runs: outside the tier-1 window like the
+# other real-training e2e tests; CI runs this module unfiltered ahead of
+# tier-1, and tools/run_suite.py covers it in the full sweep
+def test_compare_two_real_fit_workdirs_emits_structured_deltas(
+    two_fit_workdirs, capsys
+):
+    from tensorflowdistributedlearning_tpu.cli import main
+
+    a, b = two_fit_workdirs
+    assert main(["telemetry-report", "--compare", a, b, "--json"]) == 0
+    result = json.loads(capsys.readouterr().out)
+    assert result["config_match"] is True  # same preset, apples-to-apples
+    metrics = {d["metric"] for d in result["deltas"]}
+    assert "step_time_mean_ms" in metrics
+    assert "wall_s" in metrics
+    assert any(m.startswith("eval:") for m in metrics)
+    for d in result["deltas"]:
+        assert d["verdict"] in ("regressed", "improved", "neutral")
+        assert {"a", "b", "delta", "direction", "threshold"} <= set(d)
+
+    # human rendering names verdicts and both runs
+    assert main(["telemetry-report", "--compare", a, b]) == 0
+    text = capsys.readouterr().out
+    assert "run compare" in text
+    assert "configs match" in text
+
+
+def test_compare_detects_injected_step_time_regression(tmp_path):
+    _write_process_ledger(tmp_path / "fast", 0, 100.0, process_count=1)
+    _write_process_ledger(tmp_path / "slow", 0, 250.0, process_count=1)
+    result = compare_lib.compare_workdirs(
+        str(tmp_path / "fast"), str(tmp_path / "slow")
+    )
+    by_metric = {d["metric"]: d for d in result["deltas"]}
+    assert by_metric["step_time_mean_ms"]["verdict"] == "regressed"
+    assert result["regressions"] >= 1
+    # ... and the same delta in the other direction is an improvement
+    back = compare_lib.compare_workdirs(
+        str(tmp_path / "slow"), str(tmp_path / "fast")
+    )
+    assert {d["metric"]: d for d in back["deltas"]}[
+        "step_time_mean_ms"
+    ]["verdict"] == "improved"
+
+
+def test_compare_small_deltas_are_neutral(tmp_path):
+    _write_process_ledger(tmp_path / "a", 0, 100.0, process_count=1)
+    _write_process_ledger(tmp_path / "b", 0, 104.0, process_count=1)  # 4% < 10%
+    result = compare_lib.compare_workdirs(
+        str(tmp_path / "a"), str(tmp_path / "b")
+    )
+    assert {d["metric"]: d for d in result["deltas"]}[
+        "step_time_mean_ms"
+    ]["verdict"] == "neutral"
+
+
+@pytest.mark.slow  # same two real fit() runs as the compare acceptance
+def test_registry_register_and_compare_by_run_id(
+    two_fit_workdirs, tmp_path, capsys
+):
+    from tensorflowdistributedlearning_tpu.cli import main
+
+    a, b = two_fit_workdirs
+    registry = str(tmp_path / "registry")
+    ids = []
+    for wd in (a, b):
+        assert main([
+            "telemetry-report", wd, "--registry-dir", registry, "--register",
+        ]) == 0
+        row = json.loads(capsys.readouterr().out)
+        assert row["config_hash"]
+        assert row["steps"] == 6
+        assert row["goodput"]["compute_s"] > 0
+        ids.append(row["run_id"])
+    rows = compare_lib.load_registry(registry)
+    assert [r["run_id"] for r in rows] == ids
+    # compare by registered run id (no workdir access needed)
+    assert main([
+        "telemetry-report", "--registry-dir", registry,
+        "--compare", ids[0], ids[1], "--json",
+    ]) == 0
+    result = json.loads(capsys.readouterr().out)
+    assert result["a"]["run_id"] == ids[0]
+    assert result["deltas"]
+
+
+def test_register_without_registry_dir_is_an_error(
+    tmp_path, capsys
+):
+    from tensorflowdistributedlearning_tpu.cli import main
+
+    _write_process_ledger(tmp_path, 0, 100.0, process_count=1)
+    assert main(["telemetry-report", str(tmp_path), "--register"]) == 2
+    assert "--registry-dir" in capsys.readouterr().err
+
+
+def test_resolve_run_unknown_ref_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match="neither a workdir"):
+        compare_lib.resolve_run("nope-123", str(tmp_path))
+
+
+def test_run_id_is_stable_across_registrations(tmp_path):
+    """run_id keys off the RUN's own start clock (report run.started_t), not
+    registration time: re-registering the same workdir reproduces the same
+    id (resolve_run's most-recent-duplicate contract relies on this)."""
+    _write_process_ledger(tmp_path / "wd", 0, 100.0, process_count=1)
+    reg = str(tmp_path / "reg")
+    row1 = compare_lib.register_run(reg, str(tmp_path / "wd"))
+    time.sleep(1.1)  # run_id has second granularity
+    row2 = compare_lib.register_run(reg, str(tmp_path / "wd"))
+    assert row1["run_id"] == row2["run_id"]
+    assert row1["t"] == row2["t"]
+
+
+def test_export_trace_covers_secondary_only_workdir(tmp_path, capsys):
+    """A workdir holding ONLY a replica's telemetry-N.jsonl (no canonical
+    telemetry.jsonl) must still export its sampled spans."""
+    from tensorflowdistributedlearning_tpu.cli import main
+
+    led = RunLedger(str(tmp_path), filename=per_process_filename(1))
+    led.event("run_header", kind="serve", process_index=1)
+    led.event(
+        "trace", trace_id="t1", span_id="s1", name="request",
+        start_t=1.0, duration_s=0.002,
+    )
+    led.close()
+    out = tmp_path / "trace.json"
+    assert main([
+        "telemetry-report", str(tmp_path), "--export-trace", str(out),
+    ]) == 0
+    assert json.loads(capsys.readouterr().out)["span_events"] == 1
+    doc = json.loads(out.read_text())
+    assert doc["traceEvents"][0]["name"] == "request"
+
+
+# -- regression sentinel -----------------------------------------------------
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_sentinel_passes_on_committed_benches():
+    """fresh == committed baseline must pass: the committed history is, by
+    definition, not a regression against itself."""
+    rc = regression_sentinel.main([
+        "--check",
+        "--fresh-async", os.path.join(REPO, "BENCH_ASYNC.json"),
+        "--fresh-serve", os.path.join(REPO, "BENCH_SERVE.json"),
+    ])
+    assert rc == 0
+
+
+def test_sentinel_fails_on_injected_2x_step_time_regression(tmp_path):
+    """THE acceptance pin: a 2x async step-time regression (and the async
+    loop now slower than sync) must exit nonzero."""
+    with open(os.path.join(REPO, "BENCH_ASYNC.json")) as f:
+        doctored = json.load(f)
+    doctored["async"]["step_time_ms"] *= 2.0
+    doctored["step_time_ratio_async_over_sync"] = round(
+        doctored["async"]["step_time_ms"] / doctored["sync"]["step_time_ms"], 3
+    )
+    fresh = tmp_path / "fresh_async.json"
+    fresh.write_text(json.dumps(doctored))
+    rc = regression_sentinel.main([
+        "--check", "--benches", "async", "--fresh-async", str(fresh),
+    ])
+    assert rc == 1
+
+
+def test_sentinel_fails_on_serve_recompile_or_throughput_collapse(tmp_path):
+    with open(os.path.join(REPO, "BENCH_SERVE.json")) as f:
+        doctored = json.load(f)
+    doctored["batched"]["requests_per_sec"] /= 3.0  # half-throughput class
+    doctored["batched"]["latency_ms"]["p99"] *= 10  # order-of-magnitude tail
+    doctored["post_warmup_recompiles"] = 2  # hard gate
+    fresh = tmp_path / "fresh_serve.json"
+    fresh.write_text(json.dumps(doctored))
+    rc = regression_sentinel.main([
+        "--check", "--benches", "serve", "--fresh-serve", str(fresh),
+        "--json-out", str(tmp_path / "verdict.json"),
+    ])
+    assert rc == 1
+    verdict = json.loads((tmp_path / "verdict.json").read_text())
+    failed = {f["metric"] for f in verdict["findings"] if not f["ok"]}
+    assert "batched.requests_per_sec" in failed
+    assert "batched.latency_ms.p99" in failed
+    assert "post_warmup_recompiles" in failed
+
+
+def test_sentinel_missing_baseline_is_an_error_not_a_pass(tmp_path):
+    rc = regression_sentinel.main([
+        "--check", "--benches", "async",
+        "--baseline-async", str(tmp_path / "missing.json"),
+        "--fresh-async", str(tmp_path / "missing.json"),
+    ])
+    assert rc == 1  # a bench that cannot run/load must fail the gate
+
+
+def test_sentinel_nothing_compared_is_not_a_pass():
+    # an empty bench selection compares nothing: rc 2, never a green gate
+    assert regression_sentinel.main(["--check", "--benches", ""]) == 2
+
+
+def test_sentinel_params_parity_is_a_hard_gate(tmp_path):
+    with open(os.path.join(REPO, "BENCH_ASYNC.json")) as f:
+        doctored = json.load(f)
+    doctored["final_params_bit_identical"] = False
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text(json.dumps(doctored))
+    rc = regression_sentinel.main([
+        "--check", "--benches", "async", "--fresh-async", str(fresh),
+    ])
+    assert rc == 1
+
+
+# -- run_suite --aggregate ---------------------------------------------------
+
+
+def test_run_suite_aggregate_merges_group_ledgers(tmp_path):
+    from run_suite import _write_group_ledger
+
+    ledger_dir = str(tmp_path)
+    suite = RunLedger(ledger_dir)
+    suite.event("run_header", kind="test_suite", groups=2, files=4,
+                process_index=0, process_count=3)
+    suite.event("run_end", ok=True, total_secs=1.0)
+    suite.close()
+    _write_group_ledger(ledger_dir, 1, ["test_a.py"], secs=1.5, rc=0)
+    _write_group_ledger(ledger_dir, 2, ["test_b.py"], secs=2.5, rc=1)
+
+    agg = fleet_lib.fleet_summary(ledger_dir)
+    assert agg["processes"] == 3
+    kinds = {
+        r["process_index"]: r["kind"] for r in agg["per_process"]
+    }
+    assert kinds == {0: "test_suite", 1: "suite_group", 2: "suite_group"}
+    group2 = obs.read_ledger(os.path.join(ledger_dir, "telemetry-2.jsonl"))
+    assert group2[-1] == {
+        **group2[-1], "event": "run_end", "ok": False,
+    }

@@ -117,7 +117,9 @@ def test_report_cli_renders_and_json(fit_workdir, capsys):
 def test_report_cli_missing_workdir_fails_cleanly(tmp_path, capsys):
     from tensorflowdistributedlearning_tpu.cli import main
 
-    assert main(["telemetry-report", str(tmp_path / "nope")]) == 1
+    # missing workdir / missing ledger is rc 2 (a CI pipeline pointing at
+    # the wrong dir must fail loudly) with a one-line stderr hint
+    assert main(["telemetry-report", str(tmp_path / "nope")]) == 2
     assert "telemetry-report" in capsys.readouterr().err
 
 
@@ -125,6 +127,70 @@ def test_report_empty_ledger_raises(tmp_path):
     (tmp_path / obs.LEDGER_FILENAME).write_text("")
     with pytest.raises(ValueError, match="empty telemetry ledger"):
         build_report(str(tmp_path))
+
+
+# -- section renderers against partial ledgers (every producer writes the
+# same schema, but not every workdir has every section) ----------------------
+
+
+def _header_only_ledger(workdir, **fields):
+    ledger = obs.RunLedger(str(workdir))
+    ledger.event("run_header", schema_version=1, **fields)
+    return ledger
+
+
+def test_report_serving_only_workdir(tmp_path):
+    """A serve --workdir has serve_window events and NO step windows: the
+    report must build and render with a serving section and n/a splits."""
+    ledger = _header_only_ledger(tmp_path, kind="serve", replica=0)
+    ledger.event(
+        "serve_window", replica=0, requests=10, completed=9,
+        rejected_queue_full=1, deadline_exceeded=0, errors=0, batches=3,
+        batched_examples=9, bucket_hits={"4": 3},
+        latency_ms={"compute": {
+            "count": 3.0, "mean_ms": 2.0, "p50_ms": 2.0, "p90_ms": 3.0,
+            "p99_ms": 4.0, "max_ms": 4.0,
+        }},
+    )
+    ledger.close()
+    report = build_report(str(tmp_path))
+    assert report["run"]["last_step"] is None
+    assert report["run"]["windows"] == 0
+    assert report["serve"]["requests"] == 10
+    assert report["serve"]["mean_batch_fill"] == 3.0
+    text = render_report(report)
+    assert "serving" in text
+    assert "9 completed" in text
+
+
+def test_report_health_events_only_workdir(tmp_path):
+    """Health alerts with no windows (e.g. a run that died in warmup after
+    an injected NaN) still render a health section."""
+    ledger = _header_only_ledger(tmp_path, task="classification")
+    ledger.event(
+        "health_alert", monitor="nan_loss", severity="critical", step=1,
+        loss="nan", action="abort",
+    )
+    ledger.close()
+    report = build_report(str(tmp_path))
+    assert report["health"]["alerts"] == 1
+    assert report["health"]["degraded"] == ["nan_loss"]
+    text = render_report(report)
+    assert "health: 1 alert(s)" in text
+    assert "nan_loss" in text
+
+
+def test_report_header_only_workdir(tmp_path):
+    """A run header and nothing else (crashed before the first window):
+    report and rendering survive with empty sections."""
+    _header_only_ledger(tmp_path, task="classification").close()
+    report = build_report(str(tmp_path))
+    assert report["run"]["windows"] == 0
+    assert not report["run"]["completed"]
+    assert report["evals"]["count"] == 0
+    text = render_report(report)
+    assert "goodput report" in text
+    assert "IN PROGRESS / interrupted" in text
 
 
 def test_op_breakdown_failure_paths(tmp_path):

@@ -111,7 +111,7 @@ def test_grouped_breakdown_buckets():
     rows = [
         xplane.OpTime("convolution.9", 5.0, 1, 0.5),
         xplane.OpTime("loop_fusion.2", 3.0, 1, 0.3),
-        xplane.OpTime("all-reduce.1", 1.0, 1, 0.1),
+        xplane.OpTime("reduce.7", 1.0, 1, 0.1),
         xplane.OpTime("weird-op", 1.0, 1, 0.1),
     ]
     groups = xplane.grouped_breakdown(rows)
@@ -119,6 +119,24 @@ def test_grouped_breakdown_buckets():
     assert groups["fusion(elementwise/bn)"] == 3.0
     assert groups["reduce"] == 1.0
     assert groups["other"] == 1.0
+
+
+def test_grouped_breakdown_splits_collectives_from_compute():
+    """Cross-chip communication is its own bucket — all-reduce/all-gather/
+    reduce-scatter/collective-permute time must NOT fold into the generic
+    reduce bucket (the "slow network" half of straggler attribution)."""
+    rows = [
+        xplane.OpTime("all-reduce.1", 2.0, 4, 0.2),
+        xplane.OpTime("all-gather.3", 1.0, 2, 0.1),
+        xplane.OpTime("reduce-scatter.2", 1.5, 2, 0.15),
+        xplane.OpTime("collective-permute.5", 0.5, 1, 0.05),
+        xplane.OpTime("reduce.11", 1.0, 1, 0.1),
+        xplane.OpTime("convolution.9", 4.0, 1, 0.4),
+    ]
+    groups = xplane.grouped_breakdown(rows)
+    assert groups["collectives"] == 5.0
+    assert groups["reduce"] == 1.0
+    assert groups["conv"] == 4.0
 
 
 def test_nested_lines_do_not_double_count(tmp_path):

@@ -55,6 +55,34 @@ def partition(files: list[str], n_groups: int) -> list[list[str]]:
     return [g for g in groups if g]
 
 
+def _write_group_ledger(ledger_dir: str, group_index: int, names, **fields):
+    """--aggregate: one complete mini-ledger per pytest group under the fleet
+    naming contract (telemetry-{i}.jsonl; the suite's own ledger is process
+    0's telemetry.jsonl), so the end-of-suite obs.fleet merge exercises the
+    same discovery+aggregation path a multi-host training run uses."""
+    try:
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        from tensorflowdistributedlearning_tpu.obs import RunLedger
+        from tensorflowdistributedlearning_tpu.obs.ledger import (
+            per_process_filename,
+        )
+
+        ledger = RunLedger(
+            ledger_dir, filename=per_process_filename(group_index)
+        )
+        ledger.event(
+            "run_header", kind="suite_group", process_index=group_index,
+            files=list(names),
+        )
+        ledger.event("suite_group", group=group_index, files=list(names),
+                     **fields)
+        ledger.event("run_end", ok=fields.get("rc") == 0)
+        ledger.close()
+    except Exception as e:  # noqa: BLE001 — never take the suite down
+        print(f"group ledger disabled: {e}", file=sys.stderr)
+
+
 def _open_ledger(ledger_dir: str):
     """Suite runs write the same JSONL ledger schema training runs do
     (obs/ledger.py): a run_header, one ``suite_group`` event per pytest
@@ -79,6 +107,12 @@ def main() -> int:
     parser.add_argument("--ledger-dir", default=None,
                         help="append suite events to {dir}/telemetry.jsonl "
                         "(the obs run-ledger schema); omitted = no ledger")
+    parser.add_argument("--aggregate", action="store_true",
+                        help="additionally write one PER-GROUP ledger "
+                        "(telemetry-{i}.jsonl, the fleet naming contract) "
+                        "into --ledger-dir and finish by merging them "
+                        "through obs.fleet — the multi-ledger aggregation "
+                        "path proven on a real suite run")
     parser.add_argument("--pytest-args", default="-q",
                         help="extra args passed to each pytest child; values "
                         "starting with '-' need the = form "
@@ -102,6 +136,9 @@ def main() -> int:
                         "supervisor, and assert the final params match an "
                         "uninterrupted run bit-for-bit")
     args = parser.parse_args()
+    if args.aggregate and not args.ledger_dir:
+        print("--aggregate requires --ledger-dir", file=sys.stderr)
+        return 2
 
     files = sorted(glob.glob(os.path.join(REPO, "tests", "test_*.py")))
     if not files:
@@ -170,6 +207,11 @@ def main() -> int:
                     "suite_group", group=i + 1, files=names, secs=secs,
                     timed_out=True,
                 )
+            if args.aggregate:
+                _write_group_ledger(
+                    args.ledger_dir, i + 1, names, secs=secs, rc=-1,
+                    timed_out=True,
+                )
             continue
 
         secs = round(time.time() - t0, 1)
@@ -193,6 +235,12 @@ def main() -> int:
             group_times.record(secs)
             ledger.event(
                 "suite_group", group=i + 1, files=names, secs=secs,
+                rc=child.returncode,
+                summary=summary.group(1) if summary else tail,
+            )
+        if args.aggregate:
+            _write_group_ledger(
+                args.ledger_dir, i + 1, names, secs=secs,
                 rc=child.returncode,
                 summary=summary.group(1) if summary else tail,
             )
@@ -259,6 +307,31 @@ def main() -> int:
         record["ok"] = record["ok"] and rc == 0
         if ledger is not None:
             ledger.event("resilience_smoke", rc=rc, secs=secs, summary=summary)
+
+    if args.aggregate:
+        # merge every per-group ledger (plus the suite's own) through the
+        # fleet aggregation path — the same discovery+merge telemetry-report
+        # runs on a multi-host workdir
+        try:
+            from tensorflowdistributedlearning_tpu.obs import fleet
+
+            agg = fleet.fleet_summary(args.ledger_dir)
+            record["aggregate"] = agg
+            print(
+                "=== aggregate: "
+                + json.dumps({
+                    "ledgers": agg["processes"],
+                    "parse_errors": agg["ledger_parse_errors"],
+                    "groups": [
+                        {"p": r["process_index"], "kind": r["kind"]}
+                        for r in agg["per_process"]
+                    ],
+                }),
+                flush=True,
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"aggregate stage failed: {e}", file=sys.stderr)
+            record["ok"] = False
 
     record["total_secs"] = round(time.time() - t_all, 1)
     if ledger is not None:
