@@ -298,6 +298,90 @@ def build_parser() -> argparse.ArgumentParser:
                          "replica i>0 writes telemetry-{i}.jsonl so N "
                          "replicas sharing one --workdir produce per-replica "
                          "ledgers that telemetry-report merges (obs/fleet.py)")
+    p_serve.add_argument("--inject-fault", default=None, metavar="SPEC",
+                         help="serving-tier fault drill (resilience/faults.py"
+                         "): 'sigkill@N' hard-kills this replica after its "
+                         "Nth answered request — the deterministic mid-soak "
+                         "replica death the fleet failover tests and "
+                         "bench_serve --fleet's kill soak converge through")
+    p_serve.add_argument("--seed", type=int, default=0,
+                         help="seed for ranged --inject-fault specs")
+
+    p_fleet = sub.add_parser(
+        "serve-fleet",
+        help="multi-replica serving tier: N `serve` subprocesses (ephemeral "
+        "ports, per-replica ledgers, restart-on-death supervision) behind a "
+        "queue-depth/p99 load-balancing router with graceful 429 shedding, "
+        "plus optional autoscaling on sustained queue depth and the SLO "
+        "error budget (fleet_scale ledger events)",
+    )
+    p_fleet.add_argument("--artifact-dir", required=True,
+                         help="artifact directory every replica serves "
+                         "(export_serving output)")
+    p_fleet.add_argument("--workdir", default=None,
+                         help="shared fleet workdir: the controller writes "
+                         "telemetry.jsonl, replica i telemetry-{i}.jsonl — "
+                         "one telemetry-report merges the whole fleet "
+                         "(default: the artifact dir)")
+    p_fleet.add_argument("--host", default="127.0.0.1",
+                         help="router bind host (replicas bind loopback)")
+    p_fleet.add_argument("--port", type=int, default=8000,
+                         help="router port; 0 = any free port (reported on "
+                         "stdout and in the run-header ledger event)")
+    p_fleet.add_argument("--replicas", type=int, default=2,
+                         help="initial replica count")
+    p_fleet.add_argument("--min-replicas", type=int, default=1)
+    p_fleet.add_argument("--max-replicas", type=int, default=4)
+    p_fleet.add_argument("--no-autoscale", action="store_true",
+                         help="fix the fleet at --replicas (supervision and "
+                         "routing still run; only scaling decisions are off)")
+    p_fleet.add_argument("--queue-high", type=float, default=4.0,
+                         help="autoscale pressure threshold: mean queued+"
+                         "in-flight requests per replica that count as "
+                         "sustained pressure")
+    p_fleet.add_argument("--queue-low", type=float, default=0.25,
+                         help="autoscale idle threshold (scale-down drain)")
+    p_fleet.add_argument("--scale-sustain", type=int, default=3,
+                         help="consecutive evaluations a signal must persist "
+                         "before a scale decision")
+    p_fleet.add_argument("--scale-cooldown-s", type=float, default=15.0,
+                         help="seconds after a decision before the next may "
+                         "fire")
+    p_fleet.add_argument("--autoscale-interval-s", type=float, default=2.0,
+                         help="seconds between autoscaler evaluations")
+    p_fleet.add_argument("--poll-interval-s", type=float, default=0.5,
+                         help="router -> replica /metrics poll cadence (the "
+                         "queue-depth/p99/status the routing policy reads)")
+    p_fleet.add_argument("--buckets", type=int, nargs="+",
+                         default=(1, 4, 16, 64),
+                         help="per-replica batch-bucket ladder")
+    p_fleet.add_argument("--max-wait-ms", type=float, default=5.0,
+                         help="per-replica continuous-batching coalesce "
+                         "budget (idle arrivals only; backlog dispatches "
+                         "immediately)")
+    p_fleet.add_argument("--queue-size", type=int, default=256,
+                         help="per-replica bounded request queue (full = "
+                         "429 + Retry-After)")
+    p_fleet.add_argument("--default-deadline-ms", type=float, default=None)
+    p_fleet.add_argument("--window-secs", type=float, default=15.0,
+                         help="replica + router ledger window cadence")
+    p_fleet.add_argument("--slo-p99-ms", type=float, default=None,
+                         help="per-replica serving SLO: breaches flip the "
+                         "replica to status=degraded, which the router "
+                         "routes around and the autoscaler scales on")
+    p_fleet.add_argument("--slo-error-budget", type=float, default=0.01)
+    p_fleet.add_argument("--max-restarts-per-replica", type=int, default=3,
+                         help="supervision budget: a replica dying more "
+                         "than this is abandoned (ledgered), not "
+                         "crash-looped")
+    p_fleet.add_argument("--replica-inject-fault", action="append",
+                         default=None, metavar="ID:SPEC",
+                         help="fault drill: pass --inject-fault SPEC to "
+                         "replica ID's FIRST launch (e.g. '2:sigkill@200' "
+                         "kills replica 2 after 200 answered requests; the "
+                         "restart relaunches clean) — how the failover "
+                         "tests and bench_serve --fleet's kill soak "
+                         "schedule a deterministic mid-soak replica death")
 
     p_qc = sub.add_parser(
         "quantize-check",
@@ -718,12 +802,20 @@ def cmd_serve(args) -> int:
     import signal
 
     from tensorflowdistributedlearning_tpu.obs import Telemetry
+    from tensorflowdistributedlearning_tpu.resilience import faults
     from tensorflowdistributedlearning_tpu.serve import (
         InferenceEngine,
         MicroBatcher,
         ServingServer,
+        bind_ephemeral,
     )
 
+    # bind BEFORE telemetry: with --port 0 the kernel picks the port, and the
+    # run header (written at Telemetry construction) must carry the REAL one
+    # — it is how a fleet test/manager spawning N replicas learns each
+    # endpoint without port races
+    sock = bind_ephemeral(args.host, args.port)
+    port = sock.getsockname()[1]
     workdir = args.workdir or args.artifact_dir
     telemetry = Telemetry(
         workdir,
@@ -739,8 +831,14 @@ def cmd_serve(args) -> int:
             "buckets": list(args.buckets),
             "max_wait_ms": args.max_wait_ms,
             "queue_size": args.queue_size,
+            "port": port,
+            "endpoint": f"http://{args.host}:{port}",
         },
     )
+    if getattr(args, "inject_fault", None):
+        # the serving-tier drill seam: sigkill@N fires off the request path
+        # (serve/server.py) — a replica that vanishes mid-soak, on schedule
+        faults.install(args.inject_fault, seed=getattr(args, "seed", 0))
     engine = InferenceEngine.from_artifact(
         args.artifact_dir,
         buckets=args.buckets,
@@ -764,12 +862,14 @@ def cmd_serve(args) -> int:
         slo_p99_ms=args.slo_p99_ms,
         slo_error_budget=args.slo_error_budget,
         replica_id=args.replica_id,
+        sock=sock,
     )
     server.start()
     print(
         json.dumps(
             {
                 "serving": server.url,
+                "port": server.port,
                 "replica": args.replica_id,
                 "buckets": list(engine.buckets),
                 "warmup_s": {str(b): s for b, s in warmup_s.items()},
@@ -784,6 +884,105 @@ def cmd_serve(args) -> int:
         server.wait()
     finally:
         server.shutdown()
+        faults.uninstall()
+    return 0
+
+
+def cmd_serve_fleet(args) -> int:
+    """The serving tier: N supervised replicas behind the queue-depth/p99
+    router, with optional autoscaling — one SIGTERM drains the whole fleet.
+    All ledgers (controller + replicas) land in one workdir; render the
+    merged story with ``telemetry-report``."""
+    import signal
+
+    from tensorflowdistributedlearning_tpu.obs import Telemetry
+    from tensorflowdistributedlearning_tpu.serve import (
+        AutoscaleConfig,
+        FleetConfig,
+        ServeFleet,
+        bind_ephemeral,
+    )
+
+    fault_specs = {}
+    for item in args.replica_inject_fault or ():
+        rid, _, spec = item.partition(":")
+        if not spec or not rid.isdigit():
+            print(
+                f"serve-fleet: bad --replica-inject-fault {item!r} "
+                "(expected ID:SPEC, e.g. 2:sigkill@200)",
+                file=sys.stderr,
+            )
+            return 2
+        fault_specs[int(rid)] = spec
+    sock = bind_ephemeral(args.host, args.port)
+    port = sock.getsockname()[1]
+    workdir = args.workdir or args.artifact_dir
+    telemetry = Telemetry(
+        workdir,
+        run_info={
+            "kind": "serve-fleet",
+            "artifact_dir": args.artifact_dir,
+            "replicas": args.replicas,
+            "autoscale": not args.no_autoscale,
+            "port": port,
+            "endpoint": f"http://{args.host}:{port}",
+        },
+    )
+    fleet = ServeFleet(
+        FleetConfig(
+            artifact_dir=args.artifact_dir,
+            workdir=workdir,
+            buckets=tuple(args.buckets),
+            max_wait_ms=args.max_wait_ms,
+            queue_size=args.queue_size,
+            window_secs=args.window_secs,
+            default_deadline_ms=args.default_deadline_ms,
+            slo_p99_ms=args.slo_p99_ms,
+            slo_error_budget=args.slo_error_budget,
+            max_restarts_per_replica=args.max_restarts_per_replica,
+            fault_specs=fault_specs or None,
+        ),
+        router_host=args.host,
+        router_sock=sock,
+        telemetry=telemetry,
+        autoscale=(
+            None
+            if args.no_autoscale
+            else AutoscaleConfig(
+                min_replicas=args.min_replicas,
+                max_replicas=args.max_replicas,
+                queue_high=args.queue_high,
+                queue_low=args.queue_low,
+                sustain=args.scale_sustain,
+                cooldown_s=args.scale_cooldown_s,
+            )
+        ),
+        autoscale_interval_s=args.autoscale_interval_s,
+        poll_interval_s=args.poll_interval_s,
+        window_secs=args.window_secs,
+    )
+    fleet.start(args.replicas)
+    print(
+        json.dumps(
+            {
+                "router": fleet.url,
+                "port": port,
+                "replicas": [
+                    {"replica": rid, "endpoint": url}
+                    for rid, url in fleet.manager.endpoints()
+                ],
+                "autoscale": not args.no_autoscale,
+                "ledger": workdir,
+            }
+        ),
+        flush=True,
+    )
+    fleet.install_signal_handlers((signal.SIGINT, signal.SIGTERM))
+    try:
+        fleet.wait()
+    finally:
+        fleet.shutdown()
+        telemetry.close(kind="serve-fleet")
     return 0
 
 
@@ -1128,6 +1327,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "smoke": cmd_smoke,
         "fit": cmd_fit,
         "serve": cmd_serve,
+        "serve-fleet": cmd_serve_fleet,
         "quantize-check": cmd_quantize_check,
         "presets": cmd_presets,
         "telemetry-report": cmd_telemetry_report,

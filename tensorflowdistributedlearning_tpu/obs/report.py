@@ -126,6 +126,65 @@ def _serve_section(windows: List[Dict]) -> Dict:
     return section
 
 
+def _serve_fleet_section(events: List[Dict]) -> Optional[Dict]:
+    """Aggregate the serving-fleet controller's events (serve/fleet.py +
+    serve/router.py + serve/autoscale.py): router traffic counters,
+    ``fleet_scale`` autoscale decisions, and replica lifecycle churn. None
+    when the run was not a fleet controller."""
+    router_windows = [e for e in events if e.get("event") == "router_window"]
+    scales = [e for e in events if e.get("event") == "fleet_scale"]
+    lifecycle = {
+        kind: sum(1 for e in events if e.get("event") == f"replica_{kind}")
+        for kind in ("spawn", "ready", "exit", "restart", "drain", "abandoned")
+    }
+    if not (router_windows or scales or any(lifecycle.values())):
+        return None
+    section: Dict = {}
+    if router_windows:
+        last = router_windows[-1]
+        section["router"] = {
+            "windows": len(router_windows),
+            **{
+                k: last.get(k, 0)
+                for k in (
+                    "requests",
+                    "routed",
+                    "retries",
+                    "shed",
+                    "no_replica",
+                    "replica_failures",
+                )
+            },
+            "per_replica_routed": last.get("per_replica_routed", {}),
+            "fleet": last.get("fleet", {}),
+        }
+    if scales:
+        section["autoscale"] = {
+            "decisions": len(scales),
+            "scale_up": sum(1 for e in scales if e.get("action") == "scale_up"),
+            "scale_down": sum(
+                1 for e in scales if e.get("action") == "scale_down"
+            ),
+            "final_replicas": scales[-1].get("to_replicas"),
+            "events": [
+                {
+                    k: e.get(k)
+                    for k in (
+                        "action",
+                        "from_replicas",
+                        "to_replicas",
+                        "reason",
+                        "mean_queue_depth",
+                    )
+                }
+                for e in scales[-10:]
+            ],
+        }
+    if any(lifecycle.values()):
+        section["replicas"] = lifecycle
+    return section
+
+
 def _health_section(events: List[Dict]) -> Optional[Dict]:
     """Aggregate ``health_alert`` events (obs/health.py) for the last run:
     per-monitor counts, active-vs-resolved state, and the most recent alert's
@@ -382,6 +441,10 @@ def build_report(
     serve_windows = [e for e in events if e.get("event") == "serve_window"]
     if serve_windows:
         report["serve"] = _serve_section(serve_windows)
+
+    serve_fleet = _serve_fleet_section(events)
+    if serve_fleet:
+        report["serve_fleet"] = serve_fleet
 
     quant_checks = [e for e in events if e.get("event") == "quant_check"]
     if quant_checks:
@@ -716,6 +779,57 @@ def render_report(report: Dict) -> str:
             )
         elif rc_s == 0:
             lines.append("  post-warmup recompiles on the request path: none")
+    sf = report.get("serve_fleet")
+    if sf:
+        rt = sf.get("router")
+        if rt:
+            lines.append(
+                f"\nserving fleet router ({rt['windows']} window(s)): "
+                f"{rt['requests']} requests, {rt['routed']} forwards "
+                f"({rt['retries']} retries), {rt['shed']} shed (429), "
+                f"{rt['no_replica']} no-replica (503), "
+                f"{rt['replica_failures']} replica failure(s)"
+            )
+            if rt.get("per_replica_routed"):
+                routed = "  ".join(
+                    f"r{rid}:{n}" for rid, n in sorted(
+                        rt["per_replica_routed"].items(),
+                        key=lambda kv: int(kv[0]),
+                    )
+                )
+                lines.append(f"  routed per replica: {routed}")
+            fl = rt.get("fleet") or {}
+            if fl:
+                lines.append(
+                    f"  fleet state: {fl.get('status', '?')} — "
+                    f"{fl.get('live', 0)} live, "
+                    f"{fl.get('starting', 0)} starting, "
+                    f"{fl.get('draining', 0)} draining, "
+                    f"{fl.get('dead', 0)} dead"
+                )
+        sc = sf.get("autoscale")
+        if sc:
+            lines.append(
+                f"  autoscale: {sc['decisions']} decision(s) "
+                f"({sc['scale_up']} up / {sc['scale_down']} down), "
+                f"final target {sc['final_replicas']} replica(s)"
+            )
+            for e in sc["events"][-3:]:
+                lines.append(
+                    f"    - {e['action']}: {e['from_replicas']} -> "
+                    f"{e['to_replicas']} ({e['reason']}, mean queue "
+                    f"{e['mean_queue_depth']})"
+                )
+        rl = sf.get("replicas")
+        if rl:
+            line = (
+                f"  replica lifecycle: {rl['spawn']} spawn(s), "
+                f"{rl['exit']} unplanned exit(s), {rl['restart']} "
+                f"restart(s), {rl['drain']} drain(s)"
+            )
+            if rl.get("abandoned"):
+                line += f", !! {rl['abandoned']} ABANDONED"
+            lines.append(line)
     for qc in report.get("quant_checks", ()):
         verdict = "PASSED" if qc.get("passed") else "FAILED"
         details = []

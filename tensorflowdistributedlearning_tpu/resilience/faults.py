@@ -24,6 +24,11 @@ Spec grammar (``--inject-fault``)::
     io-read@2       transient IOError on the 2nd tracked file open
                     (record shards, kaggle CSVs)
     io-ckpt@1       transient IOError on the 1st checkpoint save attempt
+    sigkill@30      SIGKILL this process after the 30th answered serve
+                    request (serve/server.py fires SITE_REQUEST per
+                    response) — the un-drainable replica death the fleet
+                    router/supervisor must converge through; unlike sigterm
+                    there is no graceful path, the process just vanishes
     nan-loss@2      poison the 2nd OBSERVED loss (log window) with NaN — the
                     health-monitor drill (obs/health.py): the NaN guard must
                     alert, and warn-vs-abort must behave as configured.
@@ -59,10 +64,12 @@ SITE_DATA = "data"  # data/records.py, per emitted record batch
 SITE_IO = "io"  # tracked file opens (record shards, kaggle CSVs)
 SITE_CHECKPOINT = "checkpoint"  # CheckpointManager, per save attempt
 SITE_LOSS = "loss"  # obs/health.py, per observed loss window (poisoned())
+SITE_REQUEST = "request"  # serve/server.py, per answered /v1/predict
 
 _KIND_SITE = {
     "raise": SITE_STEP,
     "sigterm": SITE_STEP,
+    "sigkill": SITE_REQUEST,
     "io-data": SITE_DATA,
     "io-read": SITE_IO,
     "io-ckpt": SITE_CHECKPOINT,
@@ -70,7 +77,7 @@ _KIND_SITE = {
 }
 
 _SPEC_RE = re.compile(
-    r"^(?P<kind>raise|sigterm|io-data|io-read|io-ckpt|nan-loss)"
+    r"^(?P<kind>raise|sigterm|sigkill|io-data|io-read|io-ckpt|nan-loss)"
     r"@(?P<lo>\d+)(?:-(?P<hi>\d+))?"
     r"(?:x(?P<count>\d+))?$"
 )
@@ -171,6 +178,11 @@ class FaultInjector:
             raise InjectedFault(f"injected fault: raise at step {spec.at}")
         if spec.kind == "sigterm":
             os.kill(os.getpid(), signal.SIGTERM)
+            return
+        if spec.kind == "sigkill":
+            # uncatchable by design: the replica-death drill must model a
+            # process that VANISHES (OOM kill, node loss), not one that drains
+            os.kill(os.getpid(), signal.SIGKILL)
             return
         raise TransientInjectedIOError(
             f"injected transient I/O error ({spec.kind} occurrence "

@@ -19,13 +19,30 @@ goodput goes.
   ``telemetry.jsonl`` (rendered by ``obs.report`` / ``telemetry-report``);
 - ``serve.quant_check`` — :func:`run_quant_check`: the accuracy gate between
   a float32 artifact and its bf16/int8 sibling (pinned eval batch,
-  per-precision thresholds, ``quant_check`` ledger events).
+  per-precision thresholds, ``quant_check`` ledger events);
+- ``serve.fleet``   — :class:`FleetManager` / :class:`ServeFleet`: N replica
+  subprocesses (each ``serve --port 0 --replica-id i`` against a shared
+  workdir) supervised with restart-on-death and graceful scale-down drain;
+- ``serve.router``  — :class:`FleetRouter`: the fleet's HTTP front end —
+  load-balances ``/v1/predict`` on live queue depth + windowed p99, routes
+  around ``draining``/``degraded``/dead replicas, retries accepted requests
+  onto survivors, sheds with 429 + ``Retry-After`` at fleet saturation, and
+  aggregates fleet-wide ``/healthz`` + ``/metrics``;
+- ``serve.autoscale`` — :class:`Autoscaler`: replica count from sustained
+  queue depth, SLO degradation, and shed volume; decisions ledgered as
+  ``fleet_scale`` events.
 
-CLI: ``python -m tensorflowdistributedlearning_tpu serve --artifact-dir D``;
+CLI: ``python -m tensorflowdistributedlearning_tpu serve --artifact-dir D``
+(one replica) or ``serve-fleet --artifact-dir D --replicas N`` (the tier);
 accuracy gate: ``... quantize-check --reference-dir F32 --candidate-dir Q``;
-load generator + precision A/B benchmark: ``tools/bench_serve.py [--quant]``.
+load generator + precision/fleet benches: ``tools/bench_serve.py [--quant]
+[--fleet]``.
 """
 
+from tensorflowdistributedlearning_tpu.serve.autoscale import (
+    AutoscaleConfig,
+    Autoscaler,
+)
 from tensorflowdistributedlearning_tpu.serve.batcher import (
     DeadlineExceededError,
     MicroBatcher,
@@ -38,22 +55,38 @@ from tensorflowdistributedlearning_tpu.serve.engine import (
     InferenceEngine,
     RequestTooLargeError,
 )
+from tensorflowdistributedlearning_tpu.serve.fleet import (
+    FleetConfig,
+    FleetManager,
+    ServeFleet,
+)
 from tensorflowdistributedlearning_tpu.serve.quant_check import (
     DEFAULT_THRESHOLDS,
     run_quant_check,
 )
-from tensorflowdistributedlearning_tpu.serve.server import ServingServer
+from tensorflowdistributedlearning_tpu.serve.router import FleetRouter
+from tensorflowdistributedlearning_tpu.serve.server import (
+    ServingServer,
+    bind_ephemeral,
+)
 
 __all__ = [
     "DEFAULT_BUCKETS",
     "DEFAULT_THRESHOLDS",
+    "AutoscaleConfig",
+    "Autoscaler",
     "DeadlineExceededError",
+    "FleetConfig",
+    "FleetManager",
+    "FleetRouter",
     "InferenceEngine",
     "MicroBatcher",
     "QueueFullError",
     "Request",
     "RequestTooLargeError",
+    "ServeFleet",
     "ServerClosedError",
     "ServingServer",
+    "bind_ephemeral",
     "run_quant_check",
 ]

@@ -1,0 +1,196 @@
+"""Fleet autoscaler: replica count driven by backlog and the SLO error budget.
+
+The router (router.py) knows, every poll, how deep the fleet's queues run,
+whether any replica blew its latency SLO (``status: degraded`` — the error
+budget the serve tier tracks via ``obs.health.SloTracker``), and how many
+requests were shed with 429. This module turns those signals into replica
+count decisions; the fleet manager (fleet.py) executes them — spawn on scale
+up, graceful drain on scale down.
+
+The state machine is deliberately boring, because flapping autoscalers are
+worse than static fleets:
+
+- **pressure** (scale-up signal): mean backlog per unit of capacity at or
+  above ``queue_high``, OR any replica SLO-degraded, OR requests shed since
+  the last evaluation. Sustained for ``sustain`` consecutive evaluations →
+  scale up by one (capacity counts *starting* replicas, so a spawn in
+  progress suppresses further scale-ups while it warms);
+- **idle** (scale-down signal): mean backlog at or below ``queue_low`` with
+  zero degradation and zero shed, sustained for ``sustain`` evaluations →
+  scale down by one, executed as a DRAIN (the replica finishes accepted work,
+  the router routes around its ``draining`` status, then the process exits);
+- everything else is **steady**; a ``cooldown_s`` window after any decision
+  blocks the next one, so a scale-up gets to absorb load before the idle
+  detector can see the resulting slack and immediately undo it.
+
+Bounds are hard: never below ``min_replicas``, never above ``max_replicas``.
+Every decision is returned as a dict the caller ledgers as a ``fleet_scale``
+event (rendered by ``telemetry-report``) — the scaling history is part of the
+run's story, not an operator's memory.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional
+
+FLEET_SCALE_EVENT = "fleet_scale"
+
+STATE_STEADY = "steady"
+STATE_PRESSURE = "pressure"
+STATE_IDLE = "idle"
+
+
+@dataclasses.dataclass
+class AutoscaleConfig:
+    """Knobs of the scale decision (defaults sized for the CLI's cadence of
+    one evaluation every couple of seconds)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # mean (queue depth + in-flight) per unit of capacity that counts as
+    # pressure / as idle slack
+    queue_high: float = 4.0
+    queue_low: float = 0.25
+    # consecutive evaluations a signal must persist before acting — one
+    # bursty poll must not buy a replica
+    sustain: int = 3
+    # seconds after a decision during which no further decision fires
+    cooldown_s: float = 15.0
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {self.min_replicas}"
+            )
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas ({self.max_replicas}) < min_replicas "
+                f"({self.min_replicas})"
+            )
+        if self.queue_low >= self.queue_high:
+            raise ValueError(
+                f"queue_low ({self.queue_low}) must be < queue_high "
+                f"({self.queue_high})"
+            )
+        if self.sustain < 1:
+            raise ValueError(f"sustain must be >= 1, got {self.sustain}")
+
+
+class Autoscaler:
+    """Pure decision core: feed it fleet snapshots, get scale decisions.
+
+    ``evaluate`` consumes the router's ``fleet_snapshot()`` shape (``live``,
+    ``starting``, ``degraded``, ``queue_depth_total``, ``shed_total``) and
+    returns a decision dict or None. It owns no threads and touches no
+    processes — the ServeFleet loop (fleet.py) applies what it decides, which
+    is what makes the state machine unit-testable clock-by-clock."""
+
+    def __init__(
+        self,
+        config: Optional[AutoscaleConfig] = None,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.config = config if config is not None else AutoscaleConfig()
+        self._clock = clock
+        self._high_streak = 0
+        self._low_streak = 0
+        self._last_decision_t: Optional[float] = None
+        self._last_shed_total = 0
+        self.state = STATE_STEADY
+        self.decisions: List[Dict] = []
+
+    def evaluate(self, snapshot: Dict) -> Optional[Dict]:
+        """One evaluation tick. ``snapshot`` keys consumed: ``live`` (ok +
+        degraded replica count), ``starting``, ``degraded``,
+        ``queue_depth_total``, ``shed_total`` (cumulative router 429s)."""
+        cfg = self.config
+        live = int(snapshot.get("live", 0))
+        starting = int(snapshot.get("starting", 0))
+        degraded = int(snapshot.get("degraded", 0))
+        queue_total = float(snapshot.get("queue_depth_total", 0.0))
+        shed_total = int(snapshot.get("shed_total", 0))
+        shed_delta = max(0, shed_total - self._last_shed_total)
+        self._last_shed_total = shed_total
+
+        # capacity includes starting replicas: a spawn already in flight is
+        # the response to pressure — do not double-order
+        capacity = live + starting
+        mean_queue = queue_total / max(1, capacity)
+        pressure = (
+            mean_queue >= cfg.queue_high or degraded > 0 or shed_delta > 0
+        )
+        idle = (
+            mean_queue <= cfg.queue_low and degraded == 0 and shed_delta == 0
+        )
+        if pressure:
+            self._high_streak += 1
+            self._low_streak = 0
+            self.state = STATE_PRESSURE
+        elif idle:
+            self._low_streak += 1
+            self._high_streak = 0
+            self.state = STATE_IDLE
+        else:
+            self._high_streak = self._low_streak = 0
+            self.state = STATE_STEADY
+
+        now = self._clock()
+        # no capacity at all (everything died at once) is an emergency that
+        # bypasses BOTH the sustain counter and the cooldown — the fleet
+        # manager's restart path normally beats this, but the scaler must
+        # never be the reason a dead fleet stays dead
+        if capacity == 0 and cfg.min_replicas > 0:
+            return self._decide(
+                "scale_up", capacity, cfg.min_replicas, "no_capacity",
+                mean_queue, shed_delta, degraded, now,
+            )
+        if (
+            self._last_decision_t is not None
+            and now - self._last_decision_t < cfg.cooldown_s
+        ):
+            return None
+        if self._high_streak >= cfg.sustain and capacity < cfg.max_replicas:
+            reason = (
+                "shed"
+                if shed_delta
+                else ("slo_degraded" if degraded else "queue_depth")
+            )
+            return self._decide(
+                "scale_up", capacity, capacity + 1, reason,
+                mean_queue, shed_delta, degraded, now,
+            )
+        if self._low_streak >= cfg.sustain and capacity > cfg.min_replicas:
+            return self._decide(
+                "scale_down", capacity, capacity - 1, "idle",
+                mean_queue, shed_delta, degraded, now,
+            )
+        return None
+
+    def _decide(
+        self,
+        action: str,
+        from_replicas: int,
+        to_replicas: int,
+        reason: str,
+        mean_queue: float,
+        shed_delta: int,
+        degraded: int,
+        now: float,
+    ) -> Dict:
+        self._last_decision_t = now
+        self._high_streak = self._low_streak = 0
+        decision = {
+            "action": action,
+            "from_replicas": from_replicas,
+            "to_replicas": to_replicas,
+            "reason": reason,
+            "mean_queue_depth": round(mean_queue, 3),
+            "shed_delta": shed_delta,
+            "slo_degraded_replicas": degraded,
+            "sustain": self.config.sustain,
+        }
+        self.decisions.append(decision)
+        return decision

@@ -8,6 +8,19 @@ so a lone request still meets its latency budget — the classic
 throughput/latency dial of server-side batching (TF-Serving's BatchingSession;
 Gemma-on-TPU, arXiv:2605.25645 §4).
 
+Batching is **continuous** (the default): the coalesce window is anchored at
+the FIRST queued request's *enqueue* time, not at the moment the worker gets
+around to it. Requests that arrived while the previous batch was computing
+have therefore already spent their coalesce budget waiting, so the next
+dispatch goes out immediately instead of idling the device for a fresh
+``max_wait_ms`` — under sustained load the worker alternates compute/collect
+with zero inserted waits (admission into "the next bucket dispatch", the
+continuous-batching semantics of production inference servers). A request
+arriving at an idle server still waits up to ``max_wait_ms`` for companions,
+so the lone-request latency contract is unchanged. ``continuous=False``
+restores the legacy fixed-window behavior (the A/B baseline
+``tools/bench_serve.py`` measures against).
+
 Failure discipline, because an inference server melts down by queueing, not by
 crashing:
 
@@ -119,8 +132,13 @@ class MicroBatcher:
         max_wait_ms: float = 5.0,
         max_queue: int = 256,
         default_deadline_ms: Optional[float] = None,
+        continuous: bool = True,
     ):
         self.engine = engine
+        # continuous batching: the coalesce window is measured from the head
+        # request's enqueue time, so backlog built up during a compute
+        # dispatches immediately; False = legacy fixed window from collect time
+        self.continuous = bool(continuous)
         self.max_batch_size = min(
             max_batch_size or engine.max_batch_size, engine.max_batch_size
         )
@@ -234,7 +252,13 @@ class MicroBatcher:
                     batch.append(req)
                     total += req.n
                     if window_end is None:
-                        window_end = now + self.max_wait_s
+                        # continuous batching: the head request's wait budget
+                        # started when IT enqueued — time it spent queued
+                        # behind the previous batch's compute counts, so a
+                        # backlogged dispatch goes out with no inserted wait
+                        window_end = (
+                            req.enqueued_t if self.continuous else now
+                        ) + self.max_wait_s
                     if total >= self.max_batch_size:
                         break
                     continue

@@ -1,0 +1,588 @@
+"""Replica lifecycle for the serving fleet: spawn, watch, restart, drain.
+
+One fleet = one shared workdir + N ``serve`` subprocesses, each launched with
+``--port 0`` (the replica binds an ephemeral port and reports it on stdout —
+no port races, satellite of server.py's ``bind_ephemeral``) and
+``--replica-id i`` (i >= 1: the per-process ledger contract from obs/fleet.py
+gives each replica its own ``telemetry-{i}.jsonl`` beside the controller's
+canonical ``telemetry.jsonl``, so ``telemetry-report`` merges the whole fleet
+from one directory).
+
+:class:`FleetManager` is the resilience supervisor pattern
+(resilience/supervisor.py) applied to long-lived replicas instead of a
+run-to-completion trainer: a monitor thread reaps dead replicas and relaunches
+them with the shared exponential backoff (``resilience.retry.backoff_delay``)
+under a per-replica restart budget; a replica that exhausts it is abandoned
+(ledgered, never silently) rather than crash-looped forever. Scale-down is a
+DRAIN, not a kill: SIGTERM triggers the replica's graceful-drain contract
+(accepted requests finish, the final ledger window lands), the router routes
+around its ``draining`` status meanwhile, and the monitor reaps the clean
+exit. Every lifecycle transition writes a ledger event (``replica_spawn`` /
+``replica_ready`` / ``replica_exit`` / ``replica_restart`` /
+``replica_drain`` / ``replica_abandoned``).
+
+:class:`ServeFleet` is the whole tier wired together — manager + router
+(router.py) + autoscaler (autoscale.py) — behind one ``start()``/
+``shutdown()`` pair; the ``serve-fleet`` CLI subcommand is a thin shell
+around it. Fault drills ride the existing seam: ``fault_specs={replica_id:
+"sigkill@N"}`` passes ``--inject-fault`` to that replica's FIRST launch only
+(the relaunch after the drill is clean), which is how the failover tests and
+``tools/bench_serve.py --fleet``'s kill soak produce a deterministic
+mid-soak replica death.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tensorflowdistributedlearning_tpu.obs.telemetry import NULL_TELEMETRY
+from tensorflowdistributedlearning_tpu.serve.autoscale import (
+    FLEET_SCALE_EVENT,
+    AutoscaleConfig,
+    Autoscaler,
+)
+from tensorflowdistributedlearning_tpu.serve.router import FleetRouter
+
+logger = logging.getLogger(__name__)
+
+# replica process states
+R_STARTING = "starting"
+R_LIVE = "live"
+R_DRAINING = "draining"
+R_BACKOFF = "backoff"  # dead, restart scheduled (non-blocking)
+R_ABANDONED = "abandoned"
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """How every replica in the fleet is launched."""
+
+    artifact_dir: str
+    workdir: str
+    host: str = "127.0.0.1"
+    buckets: Sequence[int] = (1, 4, 16, 64)
+    max_wait_ms: float = 5.0
+    queue_size: int = 256
+    window_secs: float = 15.0
+    default_deadline_ms: Optional[float] = None
+    slo_p99_ms: Optional[float] = None
+    slo_error_budget: float = 0.01
+    # supervisor knobs (resilience pattern): per-replica restart budget +
+    # the shared backoff schedule
+    max_restarts_per_replica: int = 3
+    backoff_base_s: float = 0.5
+    backoff_max_s: float = 10.0
+    spawn_timeout_s: float = 180.0
+    # replica_id -> --inject-fault spec for that replica's FIRST launch
+    # (drills: "sigkill@N" kills it after N answered requests; restarts are
+    # clean so the drill converges instead of crash-looping)
+    fault_specs: Optional[Dict[int, str]] = None
+    # extra environment for replica processes (the bench pins XLA's CPU
+    # threading here so replica scaling is honest on a shared host)
+    extra_env: Optional[Dict[str, str]] = None
+    python: str = sys.executable
+
+
+class ReplicaProcess:
+    """Handle on one replica subprocess."""
+
+    def __init__(self, replica_id: int):
+        self.replica_id = int(replica_id)
+        self.process: Optional[subprocess.Popen] = None
+        self.url: Optional[str] = None
+        self.state = R_STARTING
+        self.restarts = 0
+        self.started_t = time.monotonic()
+        self.ready = threading.Event()
+        self.exit_code: Optional[int] = None
+        # when a scheduled restart becomes due (R_BACKOFF); deadlines, not
+        # sleeps, so one replica's backoff never stalls supervision of the
+        # rest of the fleet
+        self.restart_at: Optional[float] = None
+        self.restart_backoff_s: float = 0.0
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.process.pid if self.process is not None else None
+
+    def snapshot(self) -> Dict:
+        return {
+            "replica": self.replica_id,
+            "state": self.state,
+            "url": self.url,
+            "pid": self.pid,
+            "restarts": self.restarts,
+        }
+
+
+class FleetManager:
+    """Spawns and supervises N ``serve`` replica subprocesses."""
+
+    def __init__(self, config: FleetConfig, *, telemetry=None, seed: int = 0):
+        import random
+
+        self.config = config
+        self.telemetry = telemetry if telemetry is not None else NULL_TELEMETRY
+        self._replicas: Dict[int, ReplicaProcess] = {}
+        self._next_id = 1  # the controller is ledger process 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._rng = random.Random(seed)  # restart-backoff jitter
+        os.makedirs(config.workdir, exist_ok=True)
+
+    # -- launch --------------------------------------------------------------
+
+    def _replica_argv(
+        self, replica_id: int, fault_spec: Optional[str]
+    ) -> List[str]:
+        cfg = self.config
+        argv = [
+            cfg.python, "-m", "tensorflowdistributedlearning_tpu", "serve",
+            "--artifact-dir", cfg.artifact_dir,
+            "--workdir", cfg.workdir,
+            "--host", cfg.host,
+            "--port", "0",
+            "--replica-id", str(replica_id),
+            "--window-secs", str(cfg.window_secs),
+            "--max-wait-ms", str(cfg.max_wait_ms),
+            "--queue-size", str(cfg.queue_size),
+            "--buckets", *[str(b) for b in cfg.buckets],
+        ]
+        if cfg.default_deadline_ms is not None:
+            argv += ["--default-deadline-ms", str(cfg.default_deadline_ms)]
+        if cfg.slo_p99_ms is not None:
+            argv += [
+                "--slo-p99-ms", str(cfg.slo_p99_ms),
+                "--slo-error-budget", str(cfg.slo_error_budget),
+            ]
+        if fault_spec:
+            argv += ["--inject-fault", fault_spec]
+        return argv
+
+    def _spawn(self, replica_id: int, *, restart_of: Optional[ReplicaProcess] = None) -> ReplicaProcess:
+        cfg = self.config
+        rep = restart_of if restart_of is not None else ReplicaProcess(replica_id)
+        rep.state = R_STARTING
+        rep.url = None
+        rep.ready.clear()
+        rep.exit_code = None
+        rep.started_t = time.monotonic()
+        # fault drills apply to the FIRST launch only — a restarted replica
+        # relaunches clean, so a kill drill converges instead of crash-looping
+        fault_spec = None
+        if restart_of is None and cfg.fault_specs:
+            fault_spec = cfg.fault_specs.get(replica_id)
+        argv = self._replica_argv(replica_id, fault_spec)
+        env = dict(os.environ)
+        # the child runs `-m tensorflowdistributedlearning_tpu`: make the
+        # package importable even when the repo is used from a checkout
+        # (tests, dev boxes) rather than a pip install
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = (
+            pkg_root + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH")
+            else pkg_root
+        )
+        if cfg.extra_env:
+            env.update(cfg.extra_env)
+        log_path = os.path.join(cfg.workdir, f"replica-{replica_id}.log")
+        log_fh = open(log_path, "ab")
+        try:
+            rep.process = subprocess.Popen(
+                argv,
+                stdout=subprocess.PIPE,
+                stderr=log_fh,
+                env=env,
+                text=True,
+            )
+        finally:
+            # Popen dup'd the fd; the parent's handle is no longer needed
+            log_fh.close()
+        threading.Thread(
+            target=self._read_stdout,
+            args=(rep, rep.process),
+            name=f"replica-{replica_id}-stdout",
+            daemon=True,
+        ).start()
+        self.telemetry.event(
+            "replica_spawn",
+            replica=replica_id,
+            pid=rep.process.pid,
+            restart=rep.restarts,
+            fault_spec=fault_spec,
+        )
+        return rep
+
+    def _read_stdout(self, rep: ReplicaProcess, process: subprocess.Popen) -> None:
+        """Consume the replica's stdout: the first JSON line carrying
+        ``serving`` is the readiness report (with the ephemerally-bound
+        endpoint); everything is drained so the pipe can never fill."""
+        try:
+            for line in process.stdout:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if "serving" in obj and not rep.ready.is_set():
+                    rep.url = obj["serving"]
+                    rep.state = R_LIVE
+                    rep.ready.set()
+                    self.telemetry.event(
+                        "replica_ready",
+                        replica=rep.replica_id,
+                        endpoint=rep.url,
+                        pid=process.pid,
+                        port=obj.get("port"),
+                    )
+        except (OSError, ValueError):
+            pass
+
+    def start(self, n: int) -> "FleetManager":
+        """Spawn ``n`` replicas, wait for every one to report ready, start
+        the monitor. Raises if any replica fails to come up in time."""
+        with self._lock:
+            reps = []
+            for _ in range(n):
+                rid = self._next_id
+                self._next_id += 1
+                rep = self._spawn(rid)
+                self._replicas[rid] = rep
+                reps.append(rep)
+        deadline = time.monotonic() + self.config.spawn_timeout_s
+        for rep in reps:
+            if not rep.ready.wait(max(0.1, deadline - time.monotonic())):
+                self.shutdown(drain=False)
+                raise RuntimeError(
+                    f"replica {rep.replica_id} not ready after "
+                    f"{self.config.spawn_timeout_s}s — see "
+                    f"{self.config.workdir}/replica-{rep.replica_id}.log"
+                )
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="fleet-monitor", daemon=True
+        )
+        self._monitor.start()
+        return self
+
+    # -- live view -----------------------------------------------------------
+
+    def replicas(self) -> List[ReplicaProcess]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def endpoints(self) -> List[Tuple[int, str]]:
+        """What the router balances over: ready, non-draining replicas."""
+        return [
+            (rep.replica_id, rep.url)
+            for rep in self.replicas()
+            if rep.url is not None and rep.state == R_LIVE
+        ]
+
+    def counts(self) -> Dict[str, int]:
+        by_state: Dict[str, int] = {}
+        for rep in self.replicas():
+            by_state[rep.state] = by_state.get(rep.state, 0) + 1
+        return by_state
+
+    # -- scaling -------------------------------------------------------------
+
+    def scale_up(self) -> int:
+        """Spawn one more replica (returns its id). Non-blocking: the replica
+        warms in the background and joins ``endpoints()`` when ready."""
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            rep = self._spawn(rid)
+            self._replicas[rid] = rep
+        return rid
+
+    def scale_down(self, replica_id: Optional[int] = None) -> Optional[int]:
+        """Drain one replica gracefully (highest-id live one by default):
+        SIGTERM triggers the serve drain contract, the monitor reaps the
+        clean exit. Returns the drained id, or None when nothing is live."""
+        with self._lock:
+            candidates = [
+                r for r in self._replicas.values() if r.state == R_LIVE
+            ]
+            if replica_id is not None:
+                candidates = [
+                    r for r in candidates if r.replica_id == replica_id
+                ]
+            if not candidates:
+                return None
+            rep = max(candidates, key=lambda r: r.replica_id)
+            rep.state = R_DRAINING
+        try:
+            rep.process.send_signal(signal.SIGTERM)
+        except (ProcessLookupError, OSError):
+            pass
+        self.telemetry.event(
+            "replica_drain", replica=rep.replica_id, pid=rep.pid
+        )
+        return rep.replica_id
+
+    # -- supervision ---------------------------------------------------------
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(0.25):
+            try:
+                self.check()
+            except Exception:  # noqa: BLE001 — supervision must never die
+                logger.exception("fleet monitor sweep failed")
+
+    def check(self) -> None:
+        """One supervision sweep: reap exits, schedule/execute restarts
+        (deadline-based backoff — never a sleep, so N replicas dying at once
+        recover on the max backoff, not the sum), forget drained replicas."""
+        now = time.monotonic()
+        for rep in self.replicas():
+            if rep.state == R_BACKOFF:
+                if now >= (rep.restart_at or 0) and not self._stop.is_set():
+                    self._spawn(rep.replica_id, restart_of=rep)
+                    self.telemetry.event(
+                        "replica_restart",
+                        replica=rep.replica_id,
+                        attempt=rep.restarts,
+                        backoff_s=round(rep.restart_backoff_s, 3),
+                    )
+                continue
+            proc = rep.process
+            if proc is None:
+                continue
+            rc = proc.poll()
+            if rc is None:
+                continue
+            rep.exit_code = rc
+            if rep.state == R_DRAINING:
+                self.telemetry.event(
+                    "replica_drained", replica=rep.replica_id, rc=rc
+                )
+                with self._lock:
+                    self._replicas.pop(rep.replica_id, None)
+                continue
+            if rep.state == R_ABANDONED:
+                continue
+            # signal-killed children report -N; surface the conventional form
+            rc_conv = 128 - rc if rc < 0 else rc
+            self.telemetry.event(
+                "replica_exit",
+                replica=rep.replica_id,
+                rc=rc_conv,
+                restarts=rep.restarts,
+            )
+            if rep.restarts >= self.config.max_restarts_per_replica:
+                rep.state = R_ABANDONED
+                self.telemetry.event(
+                    "replica_abandoned",
+                    replica=rep.replica_id,
+                    rc=rc_conv,
+                    restarts=rep.restarts,
+                )
+                logger.error(
+                    "replica %d abandoned after %d restart(s) (rc=%s)",
+                    rep.replica_id, rep.restarts, rc_conv,
+                )
+                continue
+            rep.restarts += 1
+            from tensorflowdistributedlearning_tpu.resilience.retry import (
+                backoff_delay,
+            )
+
+            delay = backoff_delay(
+                rep.restarts,
+                base_delay_s=self.config.backoff_base_s,
+                max_delay_s=self.config.backoff_max_s,
+                jitter_frac=0.25,
+                rng=self._rng,
+            )
+            logger.warning(
+                "replica %d died (rc=%s) — restart %d/%d in %.2fs",
+                rep.replica_id, rc_conv, rep.restarts,
+                self.config.max_restarts_per_replica, delay,
+            )
+            rep.state = R_BACKOFF
+            rep.restart_at = now + delay
+            rep.restart_backoff_s = delay
+
+    def shutdown(self, drain: bool = True, timeout_s: float = 30.0) -> None:
+        """Stop supervision and take the fleet down — SIGTERM everyone (the
+        graceful drain) and reap; stragglers past ``timeout_s`` are killed."""
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+            self._monitor = None
+        reps = self.replicas()
+        for rep in reps:
+            if rep.process is None or rep.process.poll() is not None:
+                continue
+            try:
+                rep.process.send_signal(
+                    signal.SIGTERM if drain else signal.SIGKILL
+                )
+            except (ProcessLookupError, OSError):
+                pass
+        deadline = time.monotonic() + timeout_s
+        for rep in reps:
+            if rep.process is None:
+                continue
+            try:
+                rep.process.wait(max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                logger.warning(
+                    "replica %d did not drain in time — killing",
+                    rep.replica_id,
+                )
+                rep.process.kill()
+                try:
+                    rep.process.wait(5)
+                except subprocess.TimeoutExpired:
+                    pass
+        with self._lock:
+            self._replicas.clear()
+
+
+class ServeFleet:
+    """The whole serving tier: replicas + router + autoscaler, one lifecycle.
+
+    ``start(n)`` brings up n replicas, routes traffic through a
+    :class:`FleetRouter`, and (when ``autoscale`` is given) evaluates the
+    :class:`Autoscaler` every ``autoscale_interval_s`` against the router's
+    live fleet snapshot — each decision is ledgered as a ``fleet_scale``
+    event and applied through the manager (spawn / graceful drain)."""
+
+    def __init__(
+        self,
+        config: FleetConfig,
+        *,
+        router_host: str = "127.0.0.1",
+        router_port: int = 0,
+        router_sock=None,
+        telemetry=None,
+        autoscale: Optional[AutoscaleConfig] = None,
+        autoscale_interval_s: float = 2.0,
+        poll_interval_s: float = 0.5,
+        window_secs: float = 15.0,
+    ):
+        self.config = config
+        self.telemetry = telemetry if telemetry is not None else NULL_TELEMETRY
+        self.manager = FleetManager(config, telemetry=self.telemetry)
+        self.router = FleetRouter(
+            self.manager.endpoints,
+            host=router_host,
+            port=router_port,
+            sock=router_sock,
+            telemetry=self.telemetry,
+            poll_interval_s=poll_interval_s,
+            window_secs=window_secs,
+        )
+        self.autoscaler = (
+            Autoscaler(autoscale) if autoscale is not None else None
+        )
+        self.autoscale_interval_s = float(autoscale_interval_s)
+        self._stop = threading.Event()
+        self._autoscale_thread: Optional[threading.Thread] = None
+        # shutdown runs from the signal handler's thread AND the CLI's
+        # finally block — second entry must be a no-op, not a double drain
+        self._shutdown_lock = threading.Lock()
+        self._shut_down = False
+
+    @property
+    def url(self) -> str:
+        return self.router.url
+
+    def start(self, replicas: int) -> "ServeFleet":
+        if self.autoscaler is not None:
+            cfg = self.autoscaler.config
+            replicas = min(max(replicas, cfg.min_replicas), cfg.max_replicas)
+        self.manager.start(replicas)
+        self.router.start()
+        if self.autoscaler is not None:
+            self._autoscale_thread = threading.Thread(
+                target=self._autoscale_loop, name="fleet-autoscale",
+                daemon=True,
+            )
+            self._autoscale_thread.start()
+        self.telemetry.event(
+            "fleet_start",
+            router=self.router.url,
+            replicas=replicas,
+            autoscale=self.autoscaler is not None,
+        )
+        return self
+
+    def _autoscale_loop(self) -> None:
+        while not self._stop.wait(self.autoscale_interval_s):
+            try:
+                self.autoscale_tick()
+            except Exception:  # noqa: BLE001 — scaling must never kill serving
+                logger.exception("autoscale evaluation failed")
+
+    def autoscale_tick(self) -> Optional[Dict]:
+        """One evaluate-and-apply cycle (also driven directly by tests)."""
+        snapshot = self.router.fleet_snapshot()
+        # the router only sees replicas the manager lists as ready, so a
+        # spawn still warming (manager state "starting") is invisible to it
+        # — merge it in, or the scaler double-orders during every warmup
+        snapshot["starting"] = snapshot.get("starting", 0) + (
+            self.manager.counts().get(R_STARTING, 0)
+        )
+        decision = self.autoscaler.evaluate(snapshot)
+        if decision is None:
+            return None
+        # ledger BEFORE applying: if the spawn/drain dies, the intent is
+        # still on record
+        self.telemetry.event(FLEET_SCALE_EVENT, **decision)
+        # apply the FULL delta (the no_capacity emergency jumps straight to
+        # min_replicas, not by one)
+        delta = decision["to_replicas"] - decision["from_replicas"]
+        if decision["action"] == "scale_up":
+            for _ in range(max(1, delta)):
+                self.manager.scale_up()
+        else:
+            for _ in range(max(1, -delta)):
+                self.manager.scale_down()
+        logger.info(
+            "fleet_scale: %s %d -> %d (%s)",
+            decision["action"], decision["from_replicas"],
+            decision["to_replicas"], decision["reason"],
+        )
+        return decision
+
+    def wait(self) -> None:
+        self.router.wait()
+
+    def install_signal_handlers(self, signals=None) -> None:
+        """SIGTERM/SIGINT = drain the whole fleet then stop the router."""
+        import signal as signal_lib
+
+        for sig in signals or (signal_lib.SIGINT, signal_lib.SIGTERM):
+            signal_lib.signal(sig, lambda *_: threading.Thread(
+                target=self.shutdown, daemon=True
+            ).start())
+
+    def shutdown(self) -> None:
+        with self._shutdown_lock:
+            if self._shut_down:
+                return
+            self._shut_down = True
+        self._stop.set()
+        if self._autoscale_thread is not None:
+            self._autoscale_thread.join(timeout=5)
+            self._autoscale_thread = None
+        self.manager.shutdown(drain=True)
+        self.router.shutdown()
+        self.telemetry.event("fleet_stop")

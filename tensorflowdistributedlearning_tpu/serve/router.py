@@ -1,0 +1,623 @@
+"""Fleet router: one HTTP front end load-balancing N serving replicas.
+
+One replica (server.py) is one engine behind one listener; millions-of-users
+traffic needs N of them — and something that knows, request by request, which
+replica to hand work to. This is that something: a stdlib HTTP server that
+forwards ``/v1/predict`` to the least-loaded live replica and aggregates the
+fleet's health and metrics behind one endpoint.
+
+Routing policy (the signals PRs 7-8 built, finally consumed):
+
+- a background poller GETs every replica's ``/metrics`` each
+  ``poll_interval_s``: live queue depth (``serve/queue_depth`` gauge), the
+  window's request p99 (``serve/request`` histogram summary), and the
+  ``status`` field the SLO tracker maintains (``ok|degraded|draining``);
+- each request goes to the routable replica with the lowest score —
+  ``queue_depth + in-flight`` (the router's own un-acked forwards bridge the
+  gap between polls), windowed p99 as the tiebreak — so load follows actual
+  backlog, not round-robin position;
+- ``draining`` and ``dead`` replicas are routed AROUND; ``degraded`` (SLO
+  budget blown but still answering) replicas are used only when no ``ok``
+  replica exists — traffic sheds toward healthy capacity first;
+- a replica that refuses connections is marked dead after
+  ``dead_after_failures`` consecutive failures and the request is RETRIED on
+  a survivor — an accepted request is never lost to a replica death, it is
+  re-dispatched (inference is idempotent, so a duplicate forward is safe);
+  the poller re-admits the replica the moment its ``/metrics`` answers again
+  (the fleet manager restarts dead replicas; the router just converges);
+- when EVERY routable replica answers 429, the router sheds with its own
+  429 and a ``Retry-After`` header — the smallest backoff any replica
+  advertised — so saturation is explicit backpressure end to end, never
+  unbounded queueing; no replicas at all is 503 ``no_replicas``.
+
+``/healthz`` aggregates fleet state (``ok`` while at least one replica is
+healthy; ``degraded``/``draining``/``down`` otherwise, with per-replica
+detail); ``/metrics`` returns the router's counters plus every replica's last
+polled snapshot. Periodic ``router_window`` ledger events carry the same
+counters, rendered by ``telemetry-report``.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import socket
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+from tensorflowdistributedlearning_tpu.obs.telemetry import NULL_TELEMETRY
+
+logger = logging.getLogger(__name__)
+
+ROUTER_WINDOW_EVENT = "router_window"
+
+# replica states the router tracks; "routable" = ok or degraded (degraded is
+# last-resort capacity, see _candidates)
+STATUS_STARTING = "starting"
+STATUS_OK = "ok"
+STATUS_DEGRADED = "degraded"
+STATUS_DRAINING = "draining"
+STATUS_DEAD = "dead"
+
+_COUNTERS = (
+    "requests",        # client requests that reached the predict handler
+    "routed",          # forwards attempted (includes retries)
+    "retries",         # re-dispatches after a replica failure/drain/429
+    "shed",            # answered 429: every routable replica saturated
+    "no_replica",      # answered 503: no routable replica at all
+    "replica_failures",  # network-level forward failures observed
+)
+
+
+class ReplicaState:
+    """The router's live view of one replica (updated by polls + forwards)."""
+
+    def __init__(self, replica_id: int, url: str):
+        self.replica_id = int(replica_id)
+        self.url = url.rstrip("/")
+        parsed = urllib.parse.urlsplit(self.url)
+        self.host = parsed.hostname or "127.0.0.1"
+        self.port = parsed.port or 80
+        self.status = STATUS_STARTING
+        self.queue_depth = 0.0
+        self.p99_ms: Optional[float] = None
+        self.inflight = 0  # router-side forwards not yet answered
+        self.routed = 0  # requests this replica answered for the router
+        self.failures = 0  # consecutive poll/forward network failures
+        self.last_poll_t: Optional[float] = None
+
+    @property
+    def routable(self) -> bool:
+        return self.status in (STATUS_OK, STATUS_DEGRADED)
+
+    def score(self) -> Tuple[float, float]:
+        """Lower routes first: backlog (polled queue depth + the router's own
+        in-flight forwards since that poll), then windowed p99."""
+        return (self.queue_depth + self.inflight, self.p99_ms or 0.0)
+
+    def snapshot(self) -> Dict:
+        return {
+            "replica": self.replica_id,
+            "url": self.url,
+            "status": self.status,
+            "queue_depth": self.queue_depth,
+            "p99_ms": self.p99_ms,
+            "inflight": self.inflight,
+            "routed": self.routed,
+        }
+
+
+EndpointsLike = Union[
+    Callable[[], Sequence[Tuple[int, str]]], Sequence[Tuple[int, str]]
+]
+
+
+class FleetRouter:
+    """HTTP front end over a (possibly changing) set of serving replicas.
+
+    ``endpoints`` is either a static ``[(replica_id, url), ...]`` or a
+    callable returning the current set (``FleetManager.endpoints`` — replicas
+    appear as they come up and vanish when drained/abandoned). The poller
+    reconciles the router's replica table against it every interval.
+    """
+
+    def __init__(
+        self,
+        endpoints: EndpointsLike,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        telemetry=None,
+        window_secs: float = 30.0,
+        poll_interval_s: float = 0.5,
+        poll_timeout_s: float = 2.0,
+        request_timeout_s: float = 60.0,
+        dead_after_failures: int = 2,
+        sock: Optional[socket.socket] = None,
+    ):
+        self._endpoints_fn = (
+            endpoints if callable(endpoints) else (lambda: list(endpoints))
+        )
+        self.telemetry = telemetry if telemetry is not None else NULL_TELEMETRY
+        self.window_secs = float(window_secs)
+        self.poll_interval_s = float(poll_interval_s)
+        self.poll_timeout_s = float(poll_timeout_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self.dead_after_failures = max(1, int(dead_after_failures))
+        self._replicas: Dict[int, ReplicaState] = {}
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {k: 0 for k in _COUNTERS}
+        self._started_t = time.time()
+        self._stop = threading.Event()
+        self._shutdown_lock = threading.Lock()
+        self._shut_down = False
+        self._conn_local = threading.local()
+        handler = type("RouterHandler", (_RouterHandler,), {"ctx": self})
+        self._httpd = ThreadingHTTPServer(
+            (host, port), handler, bind_and_activate=False
+        )
+        self._httpd.request_queue_size = 128
+        if sock is not None:
+            self._httpd.socket.close()
+            self._httpd.socket = sock
+            bound_host, bound_port = sock.getsockname()[:2]
+            self._httpd.server_address = (bound_host, bound_port)
+            self._httpd.server_name = socket.getfqdn(bound_host)
+            self._httpd.server_port = bound_port
+        else:
+            self._httpd.allow_reuse_address = True
+            self._httpd.server_bind()
+        self._httpd.server_activate()
+        self._httpd.daemon_threads = True
+        self._serve_thread: Optional[threading.Thread] = None
+        self._poll_thread: Optional[threading.Thread] = None
+        self._ticker: Optional[threading.Thread] = None
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "FleetRouter":
+        # one synchronous poll before accepting traffic: the first request
+        # must not race an empty replica table
+        self.poll_once()
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fleet-router-http",
+            daemon=True,
+        )
+        self._serve_thread.start()
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, name="fleet-router-poll", daemon=True
+        )
+        self._poll_thread.start()
+        if self.window_secs > 0:
+            self._ticker = threading.Thread(
+                target=self._tick, name="fleet-router-window", daemon=True
+            )
+            self._ticker.start()
+        self.telemetry.event(
+            "router_start",
+            endpoint=self.url,
+            replicas=[r.snapshot() for r in self._replica_list()],
+        )
+        logger.info("fleet router on %s", self.url)
+        return self
+
+    def wait(self) -> None:
+        self._stop.wait()
+
+    def shutdown(self) -> None:
+        """Stop routing: final window, stop the poller, close the listener.
+        Replica drain is the fleet manager's job, not the router's."""
+        with self._shutdown_lock:
+            if self._shut_down:
+                return
+            self._shut_down = True
+        self._stop.set()
+        for t in (self._ticker, self._poll_thread):
+            if t is not None:
+                t.join(timeout=5)
+        try:
+            self.emit_window(final=True)
+        except Exception:  # noqa: BLE001 — telemetry never blocks shutdown
+            logger.exception("final router window emission failed")
+        self.telemetry.event("router_stop", **self.counters())
+        if self._serve_thread is not None:
+            self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=5)
+
+    # -- replica table -------------------------------------------------------
+
+    def _replica_list(self) -> List[ReplicaState]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def _reconcile(self) -> None:
+        """Sync the replica table with the endpoint source: new ids appear
+        (status "starting" until their first successful poll), removed ids
+        (drained/abandoned replicas) drop out."""
+        try:
+            current = {int(i): u for i, u in self._endpoints_fn()}
+        except Exception:  # noqa: BLE001 — a dying manager must not kill polls
+            logger.exception("endpoint source failed; keeping current table")
+            return
+        with self._lock:
+            for rid in list(self._replicas):
+                if rid not in current:
+                    del self._replicas[rid]
+                elif self._replicas[rid].url != current[rid].rstrip("/"):
+                    # restarted on a new port: replace the state wholesale
+                    self._replicas[rid] = ReplicaState(rid, current[rid])
+            for rid, url in current.items():
+                if rid not in self._replicas:
+                    self._replicas[rid] = ReplicaState(rid, url)
+
+    def poll_once(self) -> None:
+        """One reconcile + metrics sweep over every replica (also called
+        synchronously by ``start`` and by tests)."""
+        self._reconcile()
+        for rep in self._replica_list():
+            self._poll_replica(rep)
+
+    def _poll_replica(self, rep: ReplicaState) -> None:
+        conn = None
+        try:
+            conn = http.client.HTTPConnection(
+                rep.host, rep.port, timeout=self.poll_timeout_s
+            )
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+        except (OSError, http.client.HTTPException, ValueError):
+            rep.failures += 1
+            if rep.failures >= self.dead_after_failures:
+                if rep.status != STATUS_DEAD:
+                    logger.warning(
+                        "replica %d (%s) unreachable x%d — marking dead",
+                        rep.replica_id, rep.url, rep.failures,
+                    )
+                rep.status = STATUS_DEAD
+            return
+        finally:
+            if conn is not None:
+                conn.close()
+        rep.failures = 0
+        rep.last_poll_t = time.monotonic()
+        rep.status = body.get("status", STATUS_OK)
+        rep.queue_depth = float(body.get("queue_depth", 0) or 0)
+        hist = (body.get("registry") or {}).get("histograms") or {}
+        summary = hist.get("serve/request")
+        if summary and summary.get("p99_s") is not None:
+            rep.p99_ms = round(summary["p99_s"] * 1000, 3)
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — polling must never die
+                logger.exception("replica poll sweep failed")
+
+    # -- routing -------------------------------------------------------------
+
+    def _candidates(self) -> List[ReplicaState]:
+        """Replicas to try, in order: healthy first (by score), degraded only
+        after every ok replica — the SLO breach IS the drain signal."""
+        reps = [r for r in self._replica_list() if r.routable]
+        ok = sorted(
+            (r for r in reps if r.status == STATUS_OK), key=ReplicaState.score
+        )
+        degraded = sorted(
+            (r for r in reps if r.status == STATUS_DEGRADED),
+            key=ReplicaState.score,
+        )
+        return ok + degraded
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[key] += n
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def fleet_status(self) -> str:
+        """One word for the whole fleet: ok > degraded > draining > down."""
+        statuses = {r.status for r in self._replica_list()}
+        if STATUS_OK in statuses:
+            return STATUS_OK
+        if STATUS_DEGRADED in statuses:
+            return STATUS_DEGRADED
+        if STATUS_DRAINING in statuses or STATUS_STARTING in statuses:
+            return STATUS_DRAINING
+        return "down"
+
+    def fleet_snapshot(self) -> Dict:
+        """The aggregate view the autoscaler evaluates (and /metrics embeds):
+        per-status replica counts, total backlog, cumulative shed count."""
+        reps = self._replica_list()
+        by_status: Dict[str, int] = {}
+        for r in reps:
+            by_status[r.status] = by_status.get(r.status, 0) + 1
+        queue_total = sum(
+            r.queue_depth + r.inflight for r in reps if r.routable
+        )
+        p99s = [r.p99_ms for r in reps if r.routable and r.p99_ms is not None]
+        return {
+            "replicas": len(reps),
+            "live": by_status.get(STATUS_OK, 0)
+            + by_status.get(STATUS_DEGRADED, 0),
+            "starting": by_status.get(STATUS_STARTING, 0),
+            "draining": by_status.get(STATUS_DRAINING, 0),
+            "dead": by_status.get(STATUS_DEAD, 0),
+            "degraded": by_status.get(STATUS_DEGRADED, 0),
+            "queue_depth_total": round(queue_total, 2),
+            "worst_p99_ms": max(p99s) if p99s else None,
+            "shed_total": self.counters()["shed"],
+            "status": self.fleet_status(),
+        }
+
+    # -- forwarding ----------------------------------------------------------
+
+    def _conn(self, rep: ReplicaState) -> http.client.HTTPConnection:
+        """Per-(handler-thread, replica) keep-alive connection: handler
+        threads are per-client-connection, so this pools exactly one upstream
+        socket per client connection per replica."""
+        conns = getattr(self._conn_local, "conns", None)
+        if conns is None:
+            conns = self._conn_local.conns = {}
+        key = (rep.replica_id, rep.url)
+        conn = conns.get(key)
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                rep.host, rep.port, timeout=self.request_timeout_s
+            )
+            conn.connect()
+            conn.sock.setsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+            )
+            conns[key] = conn
+        return conn
+
+    def _drop_conn(self, rep: ReplicaState) -> None:
+        conns = getattr(self._conn_local, "conns", None)
+        if conns:
+            conn = conns.pop((rep.replica_id, rep.url), None)
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def forward(
+        self, rep: ReplicaState, body: bytes, request_id: str
+    ) -> Tuple[int, Dict[str, str], bytes]:
+        """One forward to one replica; raises ``OSError``/``HTTPException``
+        on network failure (the caller retries elsewhere)."""
+        conn = self._conn(rep)
+        try:
+            conn.request(
+                "POST",
+                "/v1/predict",
+                body,
+                {
+                    "Content-Type": "application/json",
+                    "x-request-id": request_id,
+                },
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+        except (http.client.HTTPException, OSError):
+            self._drop_conn(rep)
+            raise
+        headers = {
+            k: v
+            for k, v in (
+                ("x-request-id", resp.getheader("x-request-id")),
+                ("Retry-After", resp.getheader("Retry-After")),
+            )
+            if v
+        }
+        return resp.status, headers, data
+
+    def route_predict(
+        self, body: bytes, request_id: str
+    ) -> Tuple[int, Dict[str, str], bytes]:
+        """The routing loop: try candidates best-score-first; retry on
+        network failure / drain / saturation; shed structurally when the
+        whole fleet is saturated or empty."""
+        self._count("requests")
+        candidates = self._candidates()
+        if not candidates:
+            self._count("no_replica")
+            return self._structured_error(
+                503,
+                "no_replicas",
+                "no live replica in the fleet (starting or recovering?)",
+                request_id,
+                retry_after=1,
+            )
+        saw_429 = False
+        retry_afters: List[int] = []
+        for i, rep in enumerate(candidates):
+            if i:
+                self._count("retries")
+            self._count("routed")
+            with self._lock:
+                rep.inflight += 1
+            try:
+                status, headers, data = self.forward(rep, body, request_id)
+            except (http.client.HTTPException, OSError):
+                self._count("replica_failures")
+                rep.failures += 1
+                if rep.failures >= self.dead_after_failures:
+                    rep.status = STATUS_DEAD
+                continue
+            finally:
+                with self._lock:
+                    rep.inflight = max(0, rep.inflight - 1)
+            if status == 429:
+                saw_429 = True
+                ra = headers.get("Retry-After")
+                if ra and ra.isdigit():
+                    retry_afters.append(int(ra))
+                # the poll will refresh the real depth; until then, stop
+                # preferring a replica that just told us it is full
+                rep.queue_depth = max(rep.queue_depth, 1.0)
+                continue
+            if status == 503:
+                # replica-level drain: route around it from now on
+                rep.status = STATUS_DRAINING
+                continue
+            with self._lock:
+                rep.routed += 1
+            return status, headers, data
+        if saw_429:
+            self._count("shed")
+            # fleet-wide saturation: shed with the SMALLEST backoff any
+            # replica advertised — capacity frees up as soon as the fastest
+            # drain completes
+            return self._structured_error(
+                429,
+                "fleet_saturated",
+                "every replica's queue is full; back off",
+                request_id,
+                retry_after=min(retry_afters) if retry_afters else 1,
+            )
+        self._count("no_replica")
+        return self._structured_error(
+            503,
+            "no_replicas",
+            "every replica is draining or unreachable",
+            request_id,
+            retry_after=1,
+        )
+
+    @staticmethod
+    def _structured_error(
+        status: int,
+        code: str,
+        message: str,
+        request_id: str,
+        retry_after: Optional[int] = None,
+    ) -> Tuple[int, Dict[str, str], bytes]:
+        error: Dict = {"code": code, "message": message, "request_id": request_id}
+        if retry_after is not None:
+            error["retry_after_s"] = int(retry_after)
+        headers = {"x-request-id": request_id}
+        if retry_after is not None:
+            headers["Retry-After"] = str(int(retry_after))
+        return status, headers, json.dumps({"error": error}).encode()
+
+    # -- aggregation ---------------------------------------------------------
+
+    def healthz(self) -> Dict:
+        status = self.fleet_status()
+        reps = [r.snapshot() for r in self._replica_list()]
+        return {
+            "ok": status == STATUS_OK,
+            "status": status,
+            "role": "router",
+            "live": sum(1 for r in reps if r["status"] in
+                        (STATUS_OK, STATUS_DEGRADED)),
+            "replicas": reps,
+            "uptime_s": round(time.time() - self._started_t, 3),
+        }
+
+    def metrics_snapshot(self) -> Dict:
+        return {
+            "role": "router",
+            "uptime_s": round(time.time() - self._started_t, 3),
+            "router": self.counters(),
+            "fleet": self.fleet_snapshot(),
+            "replicas": [r.snapshot() for r in self._replica_list()],
+        }
+
+    def emit_window(self, final: bool = False) -> Dict:
+        fields: Dict = {
+            **self.counters(),
+            "fleet": self.fleet_snapshot(),
+            "per_replica_routed": {
+                str(r.replica_id): r.routed for r in self._replica_list()
+            },
+        }
+        if final:
+            fields["final"] = True
+        self.telemetry.event(ROUTER_WINDOW_EVENT, **fields)
+        return fields
+
+    def _tick(self) -> None:
+        while not self._stop.wait(self.window_secs):
+            try:
+                self.emit_window()
+            except Exception:  # noqa: BLE001 — telemetry never kills routing
+                logger.exception("router window emission failed")
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    ctx: FleetRouter
+    protocol_version = "HTTP/1.1"
+    disable_nagle_algorithm = True
+
+    def log_message(self, fmt, *args):
+        logger.debug("%s - %s", self.address_string(), fmt % args)
+
+    def _respond(
+        self, status: int, headers: Dict[str, str], body: bytes
+    ) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, status: int, payload: Dict) -> None:
+        self._respond(status, {}, json.dumps(payload).encode())
+
+    def do_GET(self):  # noqa: N802
+        parsed = urllib.parse.urlparse(self.path)
+        if parsed.path == "/healthz":
+            body = self.ctx.healthz()
+            self._json(200 if body["status"] != "down" else 503, body)
+        elif parsed.path == "/metrics":
+            self._json(200, self.ctx.metrics_snapshot())
+        else:
+            self._json(
+                404,
+                {"error": {"code": "not_found",
+                           "message": f"no route for GET {self.path}"}},
+            )
+
+    def do_POST(self):  # noqa: N802
+        from tensorflowdistributedlearning_tpu.obs import trace as trace_lib
+
+        if self.path != "/v1/predict":
+            self._json(
+                404,
+                {"error": {"code": "not_found",
+                           "message": f"no route for POST {self.path}"}},
+            )
+            return
+        request_id = self.headers.get("x-request-id") or trace_lib.new_id()
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b"{}"
+        status, headers, data = self.ctx.route_predict(body, request_id)
+        headers.setdefault("x-request-id", request_id)
+        self._respond(status, headers, data)

@@ -40,18 +40,22 @@ section of the goodput report.
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
+import math
+import socket
 import threading
 import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
+from typing import Deque, Dict, Optional, Tuple
 
 import numpy as np
 
 from tensorflowdistributedlearning_tpu.obs import health as health_lib
 from tensorflowdistributedlearning_tpu.obs import trace as trace_lib
+from tensorflowdistributedlearning_tpu.resilience import faults as faults_lib
 from tensorflowdistributedlearning_tpu.obs.metrics import (
     time_summary,
     window_count,
@@ -84,6 +88,31 @@ _WINDOW_COUNTERS = (
 # handler latency — what the SLO tracker budgets against
 _WINDOW_HISTOGRAMS = ("queue_wait", "pad", "compute", "request")
 
+# Retry-After bounds (seconds): a rejected client must neither hot-loop (<1s)
+# nor give up on a replica that drains its queue in a few seconds (cap 30)
+_RETRY_AFTER_MIN_S = 1
+_RETRY_AFTER_MAX_S = 30
+# with no observed drain yet (cold or fully stalled server) advertise a
+# middle-of-the-road backoff rather than pretending to know the drain rate
+_RETRY_AFTER_DEFAULT_S = 5
+
+
+def bind_ephemeral(host: str = "127.0.0.1", port: int = 0) -> socket.socket:
+    """Bind (without listening) a TCP socket — ``port=0`` picks a free
+    ephemeral port the caller can read back via ``getsockname()`` BEFORE
+    constructing the server around it. This is how ``serve --port 0`` knows
+    its real port early enough to stamp it into the telemetry run header
+    (written at ``Telemetry`` construction, before ``ServingServer`` exists),
+    and how N replicas spawn into one test without port races."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+        sock.bind((host, port))
+    except OSError:
+        sock.close()
+        raise
+    return sock
+
 
 class ServingServer:
     """Engine + batcher behind a ThreadingHTTPServer, with ledger windows."""
@@ -101,6 +130,7 @@ class ServingServer:
         slo_p99_ms: Optional[float] = None,
         slo_error_budget: float = 0.01,
         replica_id: int = 0,
+        sock: Optional[socket.socket] = None,
     ):
         self.engine = engine
         self.batcher = batcher
@@ -133,14 +163,34 @@ class ServingServer:
         self._stop = threading.Event()
         self._shutdown_lock = threading.Lock()
         self._shut_down = False
+        # drain-rate samples (monotonic_t, cumulative completed): what the
+        # Retry-After header on 429/503 is derived from — how fast THIS
+        # window's queue is actually emptying, not a fixed constant.
+        # Locked: handler threads append AND expire concurrently (a 429
+        # burst hits retry_after_s from dozens of threads at once)
+        self._drain_samples: Deque[Tuple[float, int]] = collections.deque(
+            maxlen=64
+        )
+        self._drain_lock = threading.Lock()
         handler = type("Handler", (_Handler,), {"ctx": self})
         self._httpd = ThreadingHTTPServer((host, port), handler, bind_and_activate=False)
         # stdlib default listen backlog is 5: a burst of concurrent connects
         # overflows it and the overflow retransmits SYNs for seconds — size it
         # like the request queue, and let quick restarts rebind the port
         self._httpd.request_queue_size = max(128, batcher.max_queue)
-        self._httpd.allow_reuse_address = True
-        self._httpd.server_bind()
+        if sock is not None:
+            # adopt a pre-bound socket (bind_ephemeral): the caller learned
+            # the real port before building Telemetry around this server
+            self._httpd.socket.close()
+            self._httpd.socket = sock
+            bound_host, bound_port = sock.getsockname()[:2]
+            self._httpd.server_address = (bound_host, bound_port)
+            # what HTTPServer.server_bind would have set
+            self._httpd.server_name = socket.getfqdn(bound_host)
+            self._httpd.server_port = bound_port
+        else:
+            self._httpd.allow_reuse_address = True
+            self._httpd.server_bind()
         self._httpd.server_activate()
         self._httpd.daemon_threads = True
         self._serve_thread: Optional[threading.Thread] = None
@@ -218,6 +268,49 @@ class ServingServer:
             "dtype": q.get("dtype"),
             "source_fingerprint": q.get("source_fingerprint"),
         }
+
+    def note_drain_progress(self) -> None:
+        """Sample the cumulative completed counter (throttled to ~5Hz) so
+        ``retry_after_s`` can estimate the live drain rate. Called from the
+        request path — one deque append per answered request at most."""
+        now = time.monotonic()
+        with self._drain_lock:
+            if self._drain_samples and now - self._drain_samples[-1][0] < 0.2:
+                return
+            completed = self.engine.registry.counter("serve/completed").value
+            self._drain_samples.append((now, completed))
+
+    def retry_after_s(self) -> int:
+        """Seconds a rejected (429 queue-full / 503 draining) client should
+        back off: current queue depth / the window's observed drain rate,
+        clamped to [1, 30]. With no drain observed yet the estimate is a
+        conservative default — better than hot-looping clients either way."""
+        reg = self.engine.registry
+        depth = reg.gauge("serve/queue_depth").value or 0
+        now = time.monotonic()
+        completed = reg.counter("serve/completed").value
+        rate = 0.0
+        with self._drain_lock:
+            # rate over the recent past only: drop samples older than ~10s
+            # so a long-idle server does not average its burst rate into
+            # oblivion
+            while (
+                self._drain_samples
+                and now - self._drain_samples[0][0] > 10.0
+            ):
+                self._drain_samples.popleft()
+            if self._drain_samples:
+                t0, c0 = self._drain_samples[0]
+                if now - t0 >= 0.05 and completed > c0:
+                    rate = (completed - c0) / (now - t0)
+        if rate <= 0.0:
+            return _RETRY_AFTER_DEFAULT_S
+        return int(
+            min(
+                max(math.ceil(depth / rate), _RETRY_AFTER_MIN_S),
+                _RETRY_AFTER_MAX_S,
+            )
+        )
 
     def metrics_snapshot(self) -> Dict:
         """The ``/metrics`` body: live registry view + serving identity."""
@@ -362,13 +455,20 @@ class _Handler(BaseHTTPRequestHandler):
     # set per request by do_POST; echoed on every response it produces
     _request_id: Optional[str] = None
 
-    def _json(self, status: int, payload: Dict) -> None:
+    def _json(
+        self,
+        status: int,
+        payload: Dict,
+        extra_headers: Optional[Dict[str, str]] = None,
+    ) -> None:
         body = json.dumps(payload).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         if self._request_id:
             self.send_header("x-request-id", self._request_id)
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -380,17 +480,30 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(raw)
 
-    def _error(self, status: int, code: str, message: str) -> int:
+    def _error(
+        self,
+        status: int,
+        code: str,
+        message: str,
+        retry_after: Optional[int] = None,
+    ) -> int:
         """Structured error: ``code`` is the machine-readable kind, and the
         request id (when one exists — every /v1/predict error has one, 429s
         and timeouts included) rides in the body AND the x-request-id header
         so a shed request is correlatable with server-side telemetry.
-        Returns ``status`` so the predict path can hand it back in one
-        expression."""
+        Backpressure statuses (429 queue-full, 503 draining) carry a
+        ``Retry-After`` header derived from the window's drain rate
+        (``ServingServer.retry_after_s``) so clients — the fleet router
+        included — back off intelligently instead of hot-looping. Returns
+        ``status`` so the predict path can hand it back in one expression."""
         error: Dict = {"code": code, "message": message}
         if self._request_id:
             error["request_id"] = self._request_id
-        self._json(status, {"error": error})
+        headers = None
+        if retry_after is not None:
+            error["retry_after_s"] = int(retry_after)
+            headers = {"Retry-After": str(int(retry_after))}
+        self._json(status, {"error": error}, extra_headers=headers)
         return status
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
@@ -458,6 +571,14 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             status = self._predict(None)
         self._account_latency(status, time.perf_counter() - t0)
+        self.ctx.note_drain_progress()
+        # drill seam (resilience/faults.py): `serve --inject-fault
+        # sigkill@N` hard-kills this replica after its Nth answered request —
+        # the deterministic mid-soak replica death the fleet failover tests
+        # and the bench's kill soak drive. Fired AFTER the response so the
+        # triggering request itself is answered; in-flight requests on other
+        # handler threads die with the process, which is the point.
+        faults_lib.fire(faults_lib.SITE_REQUEST)
 
     def _account_latency(self, status: int, dt: float) -> None:
         """End-to-end handler latency: answered requests feed the `request`
@@ -478,7 +599,10 @@ class _Handler(BaseHTTPRequestHandler):
         request's queue/pad/compute child spans."""
         if self.ctx.draining:
             return self._error(
-                503, "draining", "server is draining; retry elsewhere"
+                503,
+                "draining",
+                "server is draining; retry elsewhere",
+                retry_after=self.ctx.retry_after_s(),
             )
         try:
             length = int(self.headers.get("Content-Length", 0))
@@ -501,11 +625,17 @@ class _Handler(BaseHTTPRequestHandler):
             )
             out = request.result(timeout=self.ctx.result_timeout_s)
         except QueueFullError as e:
-            return self._error(429, "queue_full", str(e))
+            return self._error(
+                429, "queue_full", str(e),
+                retry_after=self.ctx.retry_after_s(),
+            )
         except RequestTooLargeError as e:
             return self._error(413, "request_too_large", str(e))
         except ServerClosedError as e:
-            return self._error(503, "draining", str(e))
+            return self._error(
+                503, "draining", str(e),
+                retry_after=self.ctx.retry_after_s(),
+            )
         except DeadlineExceededError as e:
             return self._error(504, "deadline_exceeded", str(e))
         except TimeoutError as e:

@@ -1,0 +1,848 @@
+"""Serving fleet: router, continuous batching, autoscaler, replica failover.
+
+The contracts under test are the ones a fleet is operated by: backlog built
+up during a compute dispatches into the NEXT batch with no inserted wait
+(continuous batching), 429/503 responses tell clients WHEN to come back
+(Retry-After from the live drain rate), the router balances on real queue
+depth and survives replica death without losing an accepted request, the
+autoscaler's state machine is boring (sustained signals, cooldown, hard
+bounds), and the whole tier's story — routing counters, fleet_scale
+decisions, replica lifecycle — renders from one merged workdir.
+
+The subprocess end-to-end tests (slow-marked out of the tier-1 window, run
+unfiltered by the focused ci.yml step) drive the real thing: `serve --port 0`
+reporting its ephemeral port, and the headline failover soak — SIGKILL a
+replica mid-load via the fault seam (`--inject-fault sigkill@N`), assert the
+router converges with zero client-visible errors and the supervisor restarts
+the dead replica.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.obs import Telemetry
+from tensorflowdistributedlearning_tpu.serve import (
+    AutoscaleConfig,
+    Autoscaler,
+    InferenceEngine,
+    MicroBatcher,
+    ServingServer,
+    bind_ephemeral,
+)
+from tensorflowdistributedlearning_tpu.serve.router import (
+    FleetRouter,
+    ReplicaState,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FEATURES = 6
+CLASSES = 3
+
+
+@pytest.fixture(scope="module")
+def serve_fn():
+    import jax
+    import jax.numpy as jnp
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (FEATURES, CLASSES)) * 0.3
+
+    @jax.jit
+    def fn(x):
+        return {
+            "probabilities": jax.nn.softmax(x @ w, axis=-1),
+            "class": jnp.argmax(x @ w, axis=-1),
+        }
+
+    return fn
+
+
+def _server(serve_fn, *, replica_id=0, max_queue=16, buckets=(1, 4),
+            max_wait_ms=2, telemetry=None, window_secs=0):
+    engine = InferenceEngine(
+        serve_fn, (FEATURES,), buckets=buckets,
+        registry=telemetry.registry if telemetry else None,
+    )
+    engine.warmup()
+    batcher = MicroBatcher(engine, max_wait_ms=max_wait_ms, max_queue=max_queue)
+    server = ServingServer(
+        engine, batcher, port=0, replica_id=replica_id,
+        telemetry=telemetry, window_secs=window_secs,
+    )
+    return server.start()
+
+
+def _post(url, payload, timeout=10, headers=None):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read()), dict(resp.headers)
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+# -- continuous batching -----------------------------------------------------
+
+
+def _timed_stall_engine(hold_s):
+    """Engine whose serve_fn records (start, end) per call and stalls the
+    FIRST call for ``hold_s`` — the compute a backlog builds up behind.
+    Bucket 4, so a lone request never fills the batch (a full batch
+    dispatches instantly in both modes, which would mask the window)."""
+    calls = []
+    first = threading.Event()
+
+    def fn(x):
+        t0 = time.monotonic()
+        hold = not first.is_set()
+        first.set()
+        if hold:
+            time.sleep(hold_s)
+        calls.append((t0, time.monotonic()))
+        return {"y": np.asarray(x)}
+
+    return InferenceEngine(fn, (FEATURES,), buckets=(4,)), calls, first
+
+
+def test_continuous_batching_dispatches_backlog_immediately():
+    """A request that queued during the previous batch's compute has already
+    spent its coalesce budget — the next dispatch must go out with no
+    inserted max_wait_ms wait."""
+    engine, calls, first = _timed_stall_engine(hold_s=0.4)
+    batcher = MicroBatcher(engine, max_wait_ms=250, max_queue=8)
+    x = np.zeros((1, FEATURES), np.float32)
+    r1 = batcher.submit(x)
+    assert first.wait(10)  # r1 is in its 0.4s compute
+    r2 = batcher.submit(x)  # queues during compute: waits ~0.4s >= 250ms
+    r1.result(timeout=10)
+    r2.result(timeout=10)
+    batcher.close()
+    assert len(calls) == 2
+    gap = calls[1][0] - calls[0][1]
+    assert gap < 0.15, (
+        f"backlogged dispatch waited {gap * 1000:.0f}ms — continuous "
+        "batching must not re-run the coalesce window"
+    )
+
+
+def test_legacy_fixed_window_still_waits():
+    """continuous=False restores the A/B baseline: a fresh coalesce window
+    opens when the worker collects, even for backlog."""
+    engine, calls, first = _timed_stall_engine(hold_s=0.4)
+    batcher = MicroBatcher(
+        engine, max_wait_ms=250, max_queue=8, continuous=False
+    )
+    x = np.zeros((1, FEATURES), np.float32)
+    r1 = batcher.submit(x)
+    assert first.wait(10)
+    r2 = batcher.submit(x)
+    r1.result(timeout=10)
+    r2.result(timeout=10)
+    batcher.close()
+    gap = calls[1][0] - calls[0][1]
+    assert gap >= 0.2, (
+        f"legacy mode dispatched after only {gap * 1000:.0f}ms — expected "
+        "a fresh max_wait_ms window"
+    )
+
+
+# -- Retry-After -------------------------------------------------------------
+
+
+def test_retry_after_math():
+    """queue_depth / observed drain rate, clamped to [1, 30]; no drain
+    observed => the conservative default."""
+    release = threading.Event()
+
+    def stalled(x):
+        release.wait(5)
+        return {"y": np.asarray(x)}
+
+    engine = InferenceEngine(stalled, (FEATURES,), buckets=(1,))
+    batcher = MicroBatcher(engine, max_queue=4, max_wait_ms=0.0)
+    server = ServingServer(engine, batcher, port=0, window_secs=0)
+    try:
+        # nothing completed yet: conservative default
+        assert server.retry_after_s() == 5
+        # fabricate a drain history: 40 completions over 2s = 20/s
+        now = time.monotonic()
+        server._drain_samples.append((now - 2.0, 0))
+        engine.registry.counter("serve/completed").inc(40)
+        engine.registry.gauge("serve/queue_depth").set(60)
+        # 60 queued / ~20 per sec ~ 3s (the estimator's own clock read
+        # makes the window a hair over 2s, so ceil may land on 4)
+        assert server.retry_after_s() in (3, 4)
+        engine.registry.gauge("serve/queue_depth").set(10_000)
+        assert server.retry_after_s() == 30  # clamped
+        engine.registry.gauge("serve/queue_depth").set(0)
+        assert server.retry_after_s() == 1  # clamped from below
+    finally:
+        release.set()
+        batcher.close()
+        server.shutdown()
+
+
+def test_http_429_and_503_carry_retry_after(serve_fn):
+    """The backpressure statuses tell clients when to come back: 429 (queue
+    full) and 503 (draining) carry Retry-After derived from the drain rate,
+    in the header AND the structured body."""
+    release = threading.Event()
+
+    def stalled(x):
+        release.wait(10)
+        return {"y": np.asarray(x)}
+
+    engine = InferenceEngine(stalled, (FEATURES,), buckets=(1,))
+    batcher = MicroBatcher(engine, max_queue=1, max_wait_ms=0.0)
+    server = ServingServer(engine, batcher, port=0, window_secs=0).start()
+    x = np.zeros((1, FEATURES), np.float32)
+    try:
+        blocker = batcher.submit(x)  # occupies the worker
+        time.sleep(0.05)
+        filler = batcher.submit(x)  # fills the queue (max_queue=1)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(server.url + "/v1/predict", {"instances": x.tolist()})
+        assert err.value.code == 429
+        retry_after = err.value.headers.get("Retry-After")
+        assert retry_after is not None and int(retry_after) >= 1
+        body = json.loads(err.value.read())
+        assert body["error"]["code"] == "queue_full"
+        assert body["error"]["retry_after_s"] == int(retry_after)
+
+        # draining: same contract on the 503
+        server.draining = True
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(server.url + "/v1/predict", {"instances": x.tolist()})
+        assert err.value.code == 503
+        assert int(err.value.headers.get("Retry-After")) >= 1
+        assert json.loads(err.value.read())["error"]["code"] == "draining"
+        server.draining = False
+        release.set()
+        blocker.result(10)
+        filler.result(10)
+    finally:
+        release.set()
+        server.shutdown()
+
+
+# -- ephemeral port ----------------------------------------------------------
+
+
+def test_bind_ephemeral_port_known_before_server(serve_fn):
+    """bind_ephemeral gives the real port BEFORE the server (and therefore
+    before the telemetry run header) exists; the server adopts the socket."""
+    sock = bind_ephemeral("127.0.0.1", 0)
+    port = sock.getsockname()[1]
+    assert port > 0
+    engine = InferenceEngine(serve_fn, (FEATURES,), buckets=(1,))
+    engine.warmup()
+    batcher = MicroBatcher(engine, max_wait_ms=1)
+    server = ServingServer(
+        engine, batcher, port=0, window_secs=0, sock=sock
+    ).start()
+    try:
+        assert server.port == port
+        health = _get(f"http://127.0.0.1:{port}/healthz")
+        assert health["ok"]
+    finally:
+        server.shutdown()
+
+
+# -- router ------------------------------------------------------------------
+
+
+def test_router_candidate_ordering():
+    """Healthy-lowest-backlog first; degraded only after every ok replica;
+    draining and dead never routed."""
+    router = FleetRouter([], port=0, window_secs=0)
+
+    def rep(rid, status, queue, inflight=0, p99=None):
+        r = ReplicaState(rid, f"http://127.0.0.1:{9000 + rid}")
+        r.status = status
+        r.queue_depth = queue
+        r.inflight = inflight
+        r.p99_ms = p99
+        router._replicas[rid] = r
+        return r
+
+    rep(1, "ok", 5.0)
+    rep(2, "ok", 1.0, inflight=1)
+    rep(3, "degraded", 0.0)
+    rep(4, "draining", 0.0)
+    rep(5, "dead", 0.0)
+    rep(6, "ok", 2.0, p99=10.0)
+    order = [r.replica_id for r in router._candidates()]
+    assert order == [2, 6, 1, 3]  # ok by backlog, degraded last
+    router._httpd.server_close()
+
+
+def test_router_round_trip_and_failover(serve_fn):
+    """Predict through the router; kill one replica's listener; every
+    subsequent request is re-dispatched onto the survivor — no accepted
+    request is lost."""
+    s1 = _server(serve_fn, replica_id=1)
+    s2 = _server(serve_fn, replica_id=2)
+    router = FleetRouter(
+        [(1, s1.url), (2, s2.url)], port=0, window_secs=0,
+        poll_interval_s=0.2,
+    ).start()
+    x = np.random.default_rng(0).normal(0, 1, (2, FEATURES)).astype(np.float32)
+    try:
+        status, body, headers = _post(
+            router.url + "/v1/predict", {"instances": x.tolist()},
+            headers={"x-request-id": "fleet-test-1"},
+        )
+        assert status == 200 and body["n"] == 2
+        # the client's id survives the hop to the replica and back
+        assert headers.get("x-request-id") == "fleet-test-1"
+        health = _get(router.url + "/healthz")
+        assert health["status"] == "ok" and health["live"] == 2
+
+        s1.shutdown()  # replica 1 vanishes (listener closed)
+        for _ in range(6):
+            status, body, _ = _post(
+                router.url + "/v1/predict", {"instances": x.tolist()}
+            )
+            assert status == 200
+        router.poll_once()
+        router.poll_once()  # dead after 2 consecutive failures
+        health = _get(router.url + "/healthz")
+        assert health["live"] == 1
+        states = {r["replica"]: r["status"] for r in health["replicas"]}
+        assert states[1] == "dead" and states[2] == "ok"
+    finally:
+        router.shutdown()
+        s2.shutdown()
+
+
+def test_router_sheds_with_retry_after_when_fleet_saturated(serve_fn):
+    """Every replica saturated => the router sheds with its own 429 and the
+    smallest Retry-After any replica advertised — explicit backpressure end
+    to end, no unbounded queueing anywhere."""
+    release = threading.Event()
+
+    def stalled(x):
+        release.wait(10)
+        return {"y": np.asarray(x)}
+
+    servers = []
+    fillers = []
+    x = np.zeros((1, FEATURES), np.float32)
+    for rid in (1, 2):
+        engine = InferenceEngine(stalled, (FEATURES,), buckets=(1,))
+        batcher = MicroBatcher(engine, max_queue=1, max_wait_ms=0.0)
+        server = ServingServer(
+            engine, batcher, port=0, window_secs=0, replica_id=rid
+        ).start()
+        fillers.append(batcher.submit(x))  # worker busy
+        time.sleep(0.05)
+        fillers.append(batcher.submit(x))  # queue full
+        servers.append(server)
+    router = FleetRouter(
+        [(1, servers[0].url), (2, servers[1].url)], port=0, window_secs=0
+    ).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(router.url + "/v1/predict", {"instances": x.tolist()})
+        assert err.value.code == 429
+        assert int(err.value.headers.get("Retry-After")) >= 1
+        body = json.loads(err.value.read())
+        assert body["error"]["code"] == "fleet_saturated"
+        assert router.counters()["shed"] == 1
+    finally:
+        release.set()
+        for f in fillers:
+            f.result(10)
+        router.shutdown()
+        for s in servers:
+            s.shutdown()
+
+
+def test_router_no_replicas_is_structured_503():
+    router = FleetRouter([], port=0, window_secs=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(router.url + "/v1/predict", {"instances": [[0.0] * 6]})
+        assert err.value.code == 503
+        assert json.loads(err.value.read())["error"]["code"] == "no_replicas"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(router.url + "/healthz")
+        assert err.value.code == 503  # a fleet of nothing is down
+    finally:
+        router.shutdown()
+
+
+def test_router_routes_around_draining(serve_fn):
+    """A draining replica (reported by its own /metrics status) stops
+    receiving traffic while it finishes accepted work."""
+    s1 = _server(serve_fn, replica_id=1)
+    s2 = _server(serve_fn, replica_id=2)
+    router = FleetRouter(
+        [(1, s1.url), (2, s2.url)], port=0, window_secs=0
+    ).start()
+    x = np.zeros((1, FEATURES), np.float32)
+    try:
+        s1.draining = True  # flips its /metrics status to "draining"
+        router.poll_once()
+        for _ in range(5):
+            status, _, _ = _post(
+                router.url + "/v1/predict", {"instances": x.tolist()}
+            )
+            assert status == 200
+        snap = {r["replica"]: r for r in router.metrics_snapshot()["replicas"]}
+        assert snap[1]["status"] == "draining"
+        assert snap[1]["routed"] == 0 and snap[2]["routed"] == 5
+    finally:
+        router.shutdown()
+        s1.draining = False
+        s1.shutdown()
+        s2.shutdown()
+
+
+# -- autoscaler --------------------------------------------------------------
+
+
+def _snap(live=1, starting=0, degraded=0, queue=0.0, shed=0):
+    return {
+        "live": live,
+        "starting": starting,
+        "degraded": degraded,
+        "queue_depth_total": queue,
+        "shed_total": shed,
+    }
+
+
+def test_autoscaler_sustained_pressure_scales_up():
+    clock = [0.0]
+    a = Autoscaler(
+        AutoscaleConfig(max_replicas=3, sustain=3, cooldown_s=10),
+        clock=lambda: clock[0],
+    )
+    assert a.evaluate(_snap(queue=10.0)) is None
+    assert a.evaluate(_snap(queue=10.0)) is None
+    d = a.evaluate(_snap(queue=10.0))
+    assert d is not None and d["action"] == "scale_up"
+    assert d["from_replicas"] == 1 and d["to_replicas"] == 2
+    assert d["reason"] == "queue_depth"
+    # cooldown: pressure persists but no second decision inside the window
+    clock[0] = 5.0
+    for _ in range(5):
+        assert a.evaluate(_snap(live=2, queue=20.0)) is None
+    # past the cooldown the sustained streak fires on the next evaluation
+    clock[0] = 20.0
+    d = None
+    for _ in range(a.config.sustain):
+        d = d or a.evaluate(_snap(live=2, queue=20.0))
+    assert d is not None and d["to_replicas"] == 3
+    # max bound: never past max_replicas
+    clock[0] = 60.0
+    for _ in range(5):
+        assert a.evaluate(_snap(live=3, queue=50.0)) is None
+
+
+def test_autoscaler_counts_starting_capacity():
+    """A spawn in progress is already the response to pressure — the scaler
+    must not double-order."""
+    a = Autoscaler(
+        AutoscaleConfig(max_replicas=2, sustain=1, cooldown_s=0),
+        clock=lambda: 0.0,
+    )
+    assert a.evaluate(_snap(live=1, starting=1, queue=100.0)) is None
+
+
+def test_autoscaler_idle_scales_down_and_respects_min():
+    clock = [0.0]
+    a = Autoscaler(
+        AutoscaleConfig(min_replicas=1, max_replicas=3, sustain=2,
+                        cooldown_s=0),
+        clock=lambda: clock[0],
+    )
+    assert a.evaluate(_snap(live=2, queue=0.0)) is None
+    d = a.evaluate(_snap(live=2, queue=0.0))
+    assert d["action"] == "scale_down" and d["reason"] == "idle"
+    assert d["to_replicas"] == 1
+    # at min: idle forever never goes below
+    for _ in range(5):
+        assert a.evaluate(_snap(live=1, queue=0.0)) is None
+
+
+def test_autoscaler_slo_and_shed_signals():
+    a = Autoscaler(
+        AutoscaleConfig(sustain=2, cooldown_s=0), clock=lambda: 0.0
+    )
+    a.evaluate(_snap(degraded=1))
+    d = a.evaluate(_snap(degraded=1))
+    assert d["action"] == "scale_up" and d["reason"] == "slo_degraded"
+
+    b = Autoscaler(
+        AutoscaleConfig(sustain=2, cooldown_s=0), clock=lambda: 0.0
+    )
+    b.evaluate(_snap(shed=10))  # delta 10 vs initial 0
+    d = b.evaluate(_snap(shed=20))
+    assert d["action"] == "scale_up" and d["reason"] == "shed"
+
+
+def test_autoscaler_dead_fleet_is_an_emergency():
+    """Zero capacity bypasses the sustain counter AND the cooldown — a dead
+    fleet must never stay dead because the scaler was being patient."""
+    clock = [0.0]
+    a = Autoscaler(
+        AutoscaleConfig(min_replicas=2, max_replicas=4, sustain=5,
+                        cooldown_s=30),
+        clock=lambda: clock[0],
+    )
+    # a decision just fired (cooldown freshly armed) ...
+    a._last_decision_t = 0.0
+    clock[0] = 1.0
+    # ... and then everything died: the emergency still fires, straight to
+    # min_replicas (not by one)
+    d = a.evaluate(_snap(live=0, queue=0.0))
+    assert d["action"] == "scale_up" and d["reason"] == "no_capacity"
+    assert d["from_replicas"] == 0 and d["to_replicas"] == 2
+
+
+def test_autoscaler_config_validation():
+    with pytest.raises(ValueError):
+        AutoscaleConfig(min_replicas=0)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(queue_high=1.0, queue_low=2.0)
+
+
+# -- fault seam --------------------------------------------------------------
+
+
+def test_sigkill_fault_spec_fires_on_request_site(monkeypatch):
+    from tensorflowdistributedlearning_tpu.resilience import faults
+
+    spec = faults.parse_fault_spec("sigkill@3")
+    assert spec.site == faults.SITE_REQUEST and spec.at == 3
+    kills = []
+    monkeypatch.setattr(os, "kill", lambda pid, sig: kills.append(sig))
+    injector = faults.FaultInjector(spec)
+    injector.fire(faults.SITE_REQUEST)
+    injector.fire(faults.SITE_REQUEST)
+    assert not kills
+    injector.fire(faults.SITE_REQUEST)
+    assert kills == [signal.SIGKILL]
+    injector.fire(faults.SITE_REQUEST)  # count=1: fires exactly once
+    assert kills == [signal.SIGKILL]
+
+
+# -- ledger + report ---------------------------------------------------------
+
+
+def test_fleet_scale_events_render_in_report(tmp_path):
+    """The controller's ledger renders the fleet story: router counters,
+    autoscale decisions, replica lifecycle — in text and JSON."""
+    from tensorflowdistributedlearning_tpu.obs.report import report_workdir
+
+    workdir = str(tmp_path / "fleet")
+    tel = Telemetry(workdir, run_info={"kind": "serve-fleet"})
+    tel.event("replica_spawn", replica=1, pid=1)
+    tel.event("replica_ready", replica=1, endpoint="http://x:1")
+    tel.event(
+        "fleet_scale", action="scale_up", from_replicas=1, to_replicas=2,
+        reason="queue_depth", mean_queue_depth=7.5, shed_delta=0,
+        slo_degraded_replicas=0, sustain=3,
+    )
+    tel.event("replica_exit", replica=2, rc=137, restarts=0)
+    tel.event("replica_restart", replica=2, attempt=1, backoff_s=0.5)
+    tel.event(
+        "router_window", requests=100, routed=104, retries=4, shed=2,
+        no_replica=0, replica_failures=1,
+        per_replica_routed={"1": 60, "2": 40},
+        fleet={"status": "ok", "live": 2, "starting": 0, "draining": 0,
+               "dead": 0},
+    )
+    tel.close()
+    rendered = report_workdir(workdir)
+    assert "serving fleet router" in rendered
+    assert "autoscale: 1 decision(s)" in rendered
+    assert "scale_up: 1 -> 2 (queue_depth" in rendered
+    assert "replica lifecycle: 1 spawn(s), 1 unplanned exit(s), 1 restart(s)" in rendered
+    as_json = json.loads(report_workdir(workdir, as_json=True))
+    sf = as_json["serve_fleet"]
+    assert sf["router"]["shed"] == 2
+    assert sf["autoscale"]["final_replicas"] == 2
+    assert sf["replicas"]["restart"] == 1
+
+
+def test_sentinel_fleet_gates():
+    """check_fleet replays a committed fleet section: good numbers pass,
+    a broken scaling floor / recompile / lost-request record fails."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from regression_sentinel import check_fleet
+
+    good = {
+        "fleet": {
+            "replica_counts": {
+                "1": {"replicas": {"1": {"recompiles_post_warmup": 0}}},
+                "2": {"replicas": {"1": {"recompiles_post_warmup": 0},
+                                   "2": {"recompiles_post_warmup": 0}}},
+            },
+            "scaling": {"2": {"speedup_vs_1": 1.85}},
+            "saturation": {"shed_429": 100, "shed_with_retry_after": 100,
+                           "errors_5xx": 0},
+            "kill_soak": {"client_errors": 0, "converged": True},
+        }
+    }
+    findings = check_fleet(good)
+    assert findings and all(f["ok"] for f in findings)
+
+    bad = json.loads(json.dumps(good))
+    bad["fleet"]["scaling"]["2"]["speedup_vs_1"] = 1.2
+    bad["fleet"]["replica_counts"]["2"]["replicas"]["2"][
+        "recompiles_post_warmup"] = 1
+    bad["fleet"]["kill_soak"]["client_errors"] = 3
+    failed = {f["metric"] for f in check_fleet(bad) if not f["ok"]}
+    assert failed == {
+        "scaling.2.speedup_vs_1",
+        "replica_post_warmup_recompiles",
+        "kill_soak.client_errors",
+    }
+    # a record with no fleet section compares nothing (pre-fleet baselines)
+    assert check_fleet({}) == []
+
+
+# -- CLI surface -------------------------------------------------------------
+
+
+def test_cli_serve_fleet_parser_defaults():
+    from tensorflowdistributedlearning_tpu.cli import build_parser
+
+    args = build_parser().parse_args(["serve-fleet", "--artifact-dir", "d"])
+    assert args.replicas == 2
+    assert args.min_replicas == 1 and args.max_replicas == 4
+    assert not args.no_autoscale
+    assert args.replica_inject_fault is None
+    args = build_parser().parse_args(
+        ["serve", "--artifact-dir", "d", "--inject-fault", "sigkill@30"]
+    )
+    assert args.inject_fault == "sigkill@30"
+
+
+def test_cli_serve_fleet_rejects_bad_fault_spec(capsys):
+    from tensorflowdistributedlearning_tpu.cli import main
+
+    rc = main([
+        "serve-fleet", "--artifact-dir", "d",
+        "--replica-inject-fault", "nonsense",
+    ])
+    assert rc == 2
+    assert "replica-inject-fault" in capsys.readouterr().err
+
+
+# -- subprocess end-to-end ---------------------------------------------------
+
+
+def _export_artifact(tmp_path, serve_fn):
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    directory = str(tmp_path / "artifact")
+    serving_lib.export_serving_artifact(serve_fn, (1, FEATURES), directory)
+    return directory
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return env
+
+
+@pytest.mark.slow
+def test_serve_port0_reports_bound_port(serve_fn, tmp_path):
+    """`serve --port 0`: the ephemeral port lands on stdout AND in the run
+    header ledger event — the contract fleet spawns and tests rely on."""
+    artifact = _export_artifact(tmp_path, serve_fn)
+    workdir = str(tmp_path / "wd")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tensorflowdistributedlearning_tpu", "serve",
+         "--artifact-dir", artifact, "--workdir", workdir,
+         "--port", "0", "--window-secs", "0", "--buckets", "1", "4"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=_env(), text=True,
+    )
+    try:
+        line = ""
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline().strip()
+            if line.startswith("{"):
+                break
+        header = json.loads(line)
+        port = header["port"]
+        assert port > 0
+        assert header["serving"].endswith(f":{port}")
+        health = _get(f"http://127.0.0.1:{port}/healthz")
+        assert health["ok"]
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(30)
+    assert rc == 0  # graceful drain
+    from tensorflowdistributedlearning_tpu.obs.ledger import read_ledger
+
+    events = read_ledger(workdir)
+    run_header = events[0]
+    assert run_header["event"] == "run_header"
+    assert run_header["port"] == port
+    assert run_header["endpoint"].endswith(f":{port}")
+
+
+@pytest.mark.slow
+def test_fleet_sigkill_failover_converges(serve_fn, tmp_path):
+    """The headline failover soak: two real replica subprocesses behind the
+    router, one SIGKILLed mid-load via the fault seam — zero accepted
+    requests lost, the dead replica restarted, traffic on both afterwards,
+    and the whole story in the merged ledger."""
+    from tensorflowdistributedlearning_tpu.obs import fleet as obs_fleet
+    from tensorflowdistributedlearning_tpu.serve import (
+        FleetConfig,
+        FleetManager,
+    )
+
+    artifact = _export_artifact(tmp_path, serve_fn)
+    workdir = str(tmp_path / "fleet")
+    tel = Telemetry(workdir, run_info={"kind": "serve-fleet"})
+    manager = FleetManager(
+        FleetConfig(
+            artifact_dir=artifact,
+            workdir=workdir,
+            buckets=(1, 4),
+            max_wait_ms=1.0,
+            window_secs=2.0,
+            spawn_timeout_s=300.0,
+            # the fault seam: replica 2's first launch dies (SIGKILL — no
+            # drain, no goodbye) after its 25th answered request
+            fault_specs={2: "sigkill@25"},
+        ),
+        telemetry=tel,
+    )
+    manager.start(2)
+    router = FleetRouter(
+        manager.endpoints, port=0, telemetry=tel, window_secs=0,
+        poll_interval_s=0.2,
+    ).start()
+    x = np.random.default_rng(1).normal(0, 1, (1, FEATURES)).astype(np.float32)
+    try:
+        # soak: enough requests that the kill fires mid-stream (the 25th
+        # answered request on replica 2 ~ the 50th overall under balance)
+        statuses = []
+        for _ in range(120):
+            status, _, _ = _post(
+                router.url + "/v1/predict", {"instances": x.tolist()}
+            )
+            statuses.append(status)
+        assert statuses == [200] * 120, "an accepted request was lost"
+
+        # convergence: the supervisor restarts replica 2 (clean relaunch —
+        # the drill spec applies to the first launch only)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and len(manager.endpoints()) < 2:
+            time.sleep(0.25)
+        assert len(manager.endpoints()) == 2
+        replicas = {r.replica_id: r for r in manager.replicas()}
+        assert replicas[2].restarts == 1
+        # the router re-admits the restarted replica within a poll or two
+        deadline = time.monotonic() + 30
+        while (
+            time.monotonic() < deadline
+            and router.fleet_snapshot()["live"] < 2
+        ):
+            router.poll_once()
+            time.sleep(0.2)
+        assert router.fleet_snapshot()["live"] == 2
+
+        # the restarted replica takes traffic again
+        routed_before = {
+            r.replica_id: r.routed for r in router._replicas.values()
+        }
+        for _ in range(30):
+            status, _, _ = _post(
+                router.url + "/v1/predict", {"instances": x.tolist()}
+            )
+            assert status == 200
+        routed_after = {
+            r.replica_id: r.routed for r in router._replicas.values()
+        }
+        assert routed_after[2] > routed_before.get(2, 0)
+    finally:
+        router.shutdown()
+        manager.shutdown()
+        tel.close()
+
+    from tensorflowdistributedlearning_tpu.obs.ledger import read_ledger
+
+    events = read_ledger(workdir)
+    kinds = [e["event"] for e in events]
+    assert "replica_exit" in kinds and "replica_restart" in kinds
+    exit_event = next(e for e in events if e["event"] == "replica_exit")
+    assert exit_event["rc"] == 128 + signal.SIGKILL  # 137: killed, not drained
+
+    # the merged fleet view covers controller + both replica ledgers, with
+    # zero post-warmup recompiles on every replica
+    ledgers = obs_fleet.discover_ledgers(workdir)
+    assert {led.process_index for led in ledgers} >= {0, 1, 2}
+    for led in ledgers:
+        windows = [
+            e for e in led.events if e.get("event") == "serve_window"
+        ]
+        for w in windows:
+            assert w.get("recompiles_post_warmup", 0) == 0
+
+
+@pytest.mark.slow
+def test_serve_fleet_cli_end_to_end(serve_fn, tmp_path):
+    """The serve-fleet CLI: comes up, answers through the router, reports
+    aggregate health, drains the whole fleet on SIGTERM with rc 0."""
+    artifact = _export_artifact(tmp_path, serve_fn)
+    workdir = str(tmp_path / "fleet-cli")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tensorflowdistributedlearning_tpu",
+         "serve-fleet", "--artifact-dir", artifact, "--workdir", workdir,
+         "--port", "0", "--replicas", "1", "--no-autoscale",
+         "--window-secs", "2", "--buckets", "1", "4"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=_env(), text=True,
+    )
+    try:
+        line = ""
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline().strip()
+            if line.startswith("{"):
+                break
+        header = json.loads(line)
+        url = header["router"]
+        assert header["replicas"][0]["replica"] == 1
+        x = np.zeros((1, FEATURES), np.float32)
+        status, body, _ = _post(url + "/v1/predict", {"instances": x.tolist()})
+        assert status == 200 and body["n"] == 1
+        health = _get(url + "/healthz")
+        assert health["status"] == "ok" and health["live"] == 1
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(60)
+    assert rc == 0
+    from tensorflowdistributedlearning_tpu.obs.ledger import read_ledger
+
+    events = read_ledger(workdir)
+    kinds = [e["event"] for e in events]
+    assert "router_start" in kinds and "fleet_start" in kinds

@@ -31,6 +31,21 @@ quantized precision (the Gemma-on-TPU methodology: curves per precision, not
 single points; arXiv:2605.25645). ``--quant-only`` skips the batching A/B for
 a fast, CPU-reproducible gate run.
 
+``--fleet`` adds the serving-tier soak (the Gemma-on-TPU methodology at fleet
+granularity: curves across REPLICA COUNTS, not single points): a bigger
+synthetic artifact is exported once, then for each count in
+``--fleet-replicas`` a real fleet — N ``serve`` subprocesses supervised by
+``serve.fleet.FleetManager`` behind a ``serve.router.FleetRouter`` — is
+driven by closed-loop HTTP clients through the router. The record gains a
+``fleet`` section: per-count throughput/latency, per-replica routed counts
+and post-warmup recompiles (from the per-replica ledgers), a scaling table
+(speedup and efficiency vs 1 replica), a saturation probe (tiny replica
+queues, oversubscribed clients — the fleet must shed with 429 + Retry-After,
+never any other 5xx, never unbounded queueing), and a kill-a-replica soak
+(``--inject-fault sigkill@N`` on one replica mid-load: the router must
+re-dispatch onto survivors with ZERO client-visible errors, the manager must
+restart the replica, and the fleet must converge back to full strength).
+
 Writes a JSON record (default BENCH_SERVE.json). ``--check`` exits non-zero
 unless batched/per_request speedup >= --min-speedup, recompiles == 0, and the
 backpressure probe rejected structurally — the CI serve-smoke gate
@@ -41,7 +56,11 @@ defaults to 1.5 on TPU (the HBM-roofline win the path exists for) and to a
 0.8 not-materially-slower tripwire elsewhere (XLA:CPU upcasts bf16, so the
 bandwidth win does not exist off-TPU; measured on this container, see
 BENCH_SERVE.json precisions.note), which keeps the gate reproducible on CPU
-CI.
+CI. With ``--fleet`` it additionally requires 2-replica throughput >=
+``--min-fleet-scaling`` x single-replica at no-worse p99 (x``--max-fleet-p99-
+ratio`` slack for tail noise), zero post-warmup recompiles on EVERY replica,
+graceful shedding (429s present, zero non-drain 5xx), and the kill soak to
+converge with zero lost accepted requests.
 """
 
 from __future__ import annotations
@@ -245,6 +264,471 @@ def quant_precision_ab(args, telemetry) -> dict:
     return section
 
 
+# -- fleet soak ---------------------------------------------------------------
+
+# the fleet model answers with a MASK-sized output (this repo's serving
+# workload is segmentation: a 101x101 mask is ~10k floats per example), so
+# per-request work is dominated by the REPLICA (forward + response encoding)
+# rather than by the router's byte-copy proxy path — which is what makes the
+# replica-count sweep measure fleet capacity instead of front-end overhead
+FLEET_HIDDEN = 1024
+FLEET_OUT = 4096
+
+
+def export_fleet_artifact(directory: str) -> str:
+    """Export the fleet-soak model through the real serving seam so replicas
+    load it exactly like production artifacts (manifest + StableHLO)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(5))
+    w1 = jax.random.normal(k1, (FEATURES, FLEET_HIDDEN), jnp.float32) * 0.05
+    w2 = jax.random.normal(k2, (FLEET_HIDDEN, FLEET_OUT), jnp.float32) * 0.05
+
+    def serve(x):
+        h = jnp.maximum(x @ w1, 0.0)
+        return {"mask_probabilities": jax.nn.sigmoid(h @ w2)}
+
+    return serving_lib.export_serving_artifact(serve, (1, FEATURES), directory)
+
+
+def fleet_closed_loop(url: str, concurrency: int, duration_s: float) -> dict:
+    """Closed-loop clients against the ROUTER, status-aware: 200s count
+    toward throughput, 429s are recorded as shed (with Retry-After presence
+    checked — the back-off contract), anything 5xx other than the drain
+    family is a hard error, and transport failures are counted separately
+    (a router must never drop a connection on the floor)."""
+    import http.client
+    import socket as socket_lib
+    import urllib.parse
+
+    parsed = urllib.parse.urlsplit(url)
+    stop = time.monotonic() + duration_s
+    ok = [0] * concurrency
+    shed = [0] * concurrency
+    shed_with_retry_after = [0] * concurrency
+    no_replica = [0] * concurrency
+    errors_5xx = [0] * concurrency
+    errors_conn = [0] * concurrency
+    latencies: list = [[] for _ in range(concurrency)]
+    barrier = threading.Barrier(concurrency + 1)
+    rng = np.random.default_rng(11)
+    examples = rng.normal(0, 1, (concurrency, FEATURES)).astype(np.float32)
+
+    def client(i: int):
+        body = json.dumps({"instances": examples[i : i + 1].tolist()})
+        conn = None
+        barrier.wait()
+        while time.monotonic() < stop:
+            if conn is None:
+                try:
+                    conn = http.client.HTTPConnection(
+                        parsed.hostname, parsed.port, timeout=30
+                    )
+                    conn.connect()
+                    conn.sock.setsockopt(
+                        socket_lib.IPPROTO_TCP, socket_lib.TCP_NODELAY, 1
+                    )
+                except OSError:
+                    conn = None
+                    errors_conn[i] += 1
+                    time.sleep(0.05)
+                    continue
+            t0 = time.perf_counter()
+            try:
+                conn.request("POST", "/v1/predict", body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+            except (http.client.HTTPException, OSError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                conn = None
+                errors_conn[i] += 1
+                continue
+            if resp.status == 200:
+                latencies[i].append(time.perf_counter() - t0)
+                ok[i] += 1
+            elif resp.status == 429:
+                shed[i] += 1
+                ra = resp.getheader("Retry-After")
+                if ra and ra.isdigit() and int(ra) >= 1:
+                    shed_with_retry_after[i] += 1
+                # brief fixed backoff after a shed (a closed loop that
+                # hammers straight back just measures the reject path's
+                # ceiling); the full advertised Retry-After would idle the
+                # soak, so honoring it end-to-end is the router tests' job
+                time.sleep(0.05)
+            elif resp.status == 503:
+                no_replica[i] += 1
+                time.sleep(0.02)
+            else:
+                errors_5xx[i] += resp.status >= 500
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(concurrency)
+    ]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t_start = time.monotonic()
+    for t in threads:
+        t.join(duration_s + 60)
+    elapsed = time.monotonic() - t_start
+    lat = np.asarray([s for per in latencies for s in per], np.float64)
+    out = {
+        "ok": int(sum(ok)),
+        "shed_429": int(sum(shed)),
+        "shed_with_retry_after": int(sum(shed_with_retry_after)),
+        "no_replica_503": int(sum(no_replica)),
+        "errors_5xx": int(sum(errors_5xx)),
+        "errors_conn": int(sum(errors_conn)),
+        "elapsed_s": round(elapsed, 3),
+        "requests_per_sec": round(sum(ok) / elapsed, 1) if elapsed else 0.0,
+    }
+    if len(lat):
+        out["latency_ms"] = {
+            "mean": round(float(lat.mean()) * 1000, 3),
+            "p50": round(float(np.percentile(lat, 50)) * 1000, 3),
+            "p99": round(float(np.percentile(lat, 99)) * 1000, 3),
+        }
+    return out
+
+
+def _spawn_fleet_cli(
+    args,
+    artifact_dir: str,
+    workdir: str,
+    n: int,
+    *,
+    queue_size: int = 256,
+    inject: str = None,
+    window_secs: float = 2.0,
+    timeout_s: float = 300.0,
+):
+    """Launch the REAL tier — ``serve-fleet`` CLI in its own process (router
+    + supervisor there, replica subprocesses under it) — and return
+    ``(proc, router_url)``. Out-of-process matters for honesty: the router
+    must not share the load generator's interpreter, or client-side Python
+    time pollutes the fleet's measured capacity."""
+    import subprocess
+
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep + os.environ.get(
+        "PYTHONPATH", ""))
+    cmd = [
+        sys.executable, "-m", "tensorflowdistributedlearning_tpu",
+        "serve-fleet",
+        "--artifact-dir", artifact_dir,
+        "--workdir", workdir,
+        "--port", "0",
+        "--replicas", str(n),
+        "--no-autoscale",
+        "--window-secs", str(window_secs),
+        "--max-wait-ms", str(args.max_wait_ms),
+        "--queue-size", str(queue_size),
+        "--buckets", *[str(b) for b in args.buckets],
+        "--poll-interval-s", "0.25",
+    ]
+    if inject:
+        cmd += ["--replica-inject-fault", inject]
+    os.makedirs(workdir, exist_ok=True)
+    log_fh = open(os.path.join(workdir, "controller.log"), "ab")
+    try:
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=log_fh, env=env, text=True
+        )
+    finally:
+        log_fh.close()
+    url: dict = {}
+
+    def reader():
+        for line in proc.stdout:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if "router" in obj:
+                url["router"] = obj["router"]
+                return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "router" not in url:
+        proc.kill()
+        raise RuntimeError(
+            f"serve-fleet x{n} not ready after {timeout_s}s — see "
+            f"{workdir}/controller.log"
+        )
+    return proc, url["router"]
+
+
+def _stop_fleet_cli(proc) -> None:
+    """SIGTERM = drain the whole fleet; the controller exits when every
+    replica finished its graceful drain."""
+    import signal as signal_lib
+    import subprocess
+
+    if proc.poll() is not None:
+        return
+    proc.send_signal(signal_lib.SIGTERM)
+    try:
+        proc.wait(90)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(10)
+
+
+def _get_json(url: str, timeout: float = 5.0) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _fleet_ledger_stats(workdir: str) -> dict:
+    """Per-replica post-warmup recompiles + completion totals, read from the
+    per-replica ledgers the fleet left behind (the same files
+    ``telemetry-report`` merges)."""
+    from tensorflowdistributedlearning_tpu.obs import fleet as obs_fleet
+
+    stats: dict = {}
+    for led in obs_fleet.discover_ledgers(workdir):
+        windows = [
+            e for e in led.events if e.get("event") == "serve_window"
+        ]
+        if not windows:
+            continue
+        last = windows[-1]
+        stats[str(led.process_index)] = {
+            "completed": last.get("completed", 0),
+            "recompiles_post_warmup": last.get("recompiles_post_warmup", 0),
+        }
+    return stats
+
+
+def fleet_soak(args, telemetry) -> dict:
+    """The fleet section: replica-count sweep, saturation shed probe, and
+    the kill-a-replica convergence soak — every phase through the REAL tier
+    (the ``serve-fleet`` CLI in its own process: router + supervision there,
+    one ``serve`` subprocess per replica under it)."""
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="bench_fleet_")
+    artifact = os.path.join(root, "artifact")
+    export_fleet_artifact(artifact)
+    section: dict = {
+        "model": {"features": FEATURES, "hidden": FLEET_HIDDEN,
+                  "mask_out": FLEET_OUT},
+        "concurrency": args.fleet_concurrency,
+        "duration_s": args.fleet_duration,
+        "replica_counts": {},
+    }
+
+    for n in args.fleet_replicas:
+        print(f"fleet x{n}: {args.fleet_concurrency} clients, "
+              f"{args.trials} x {args.fleet_duration}s ...", flush=True)
+        workdir = os.path.join(root, f"fleet-{n}")
+        proc, router_url = _spawn_fleet_cli(args, artifact, workdir, n)
+        try:
+            runs = [
+                fleet_closed_loop(
+                    router_url, args.fleet_concurrency, args.fleet_duration
+                )
+                for _ in range(args.trials)
+            ]
+            entry = max(runs, key=lambda r: r["requests_per_sec"])
+            entry["trial_rps"] = [r["requests_per_sec"] for r in runs]
+            # errors aggregate across ALL trials: best-of-N is a throughput
+            # estimator, but a 5xx/transport error in any trial is a real
+            # defect the --check gate must see
+            for key in ("errors_5xx", "errors_conn", "no_replica_503"):
+                entry[key] = sum(r.get(key, 0) for r in runs)
+            try:
+                metrics = _get_json(router_url + "/metrics")
+                entry["per_replica_routed"] = {
+                    str(r["replica"]): r["routed"]
+                    for r in metrics.get("replicas", [])
+                }
+            except OSError:
+                pass
+        finally:
+            _stop_fleet_cli(proc)
+        entry["replicas"] = _fleet_ledger_stats(workdir)
+        section["replica_counts"][str(n)] = entry
+        telemetry.event("bench_mode", mode=f"fleet_{n}", **entry)
+
+    base = section["replica_counts"].get("1")
+    if base and base.get("requests_per_sec"):
+        scaling: dict = {}
+        for n in args.fleet_replicas:
+            if n == 1:
+                continue
+            entry = section["replica_counts"][str(n)]
+            row = {
+                "speedup_vs_1": round(
+                    entry["requests_per_sec"] / base["requests_per_sec"], 3
+                ),
+            }
+            row["efficiency"] = round(row["speedup_vs_1"] / n, 3)
+            if "latency_ms" in entry and "latency_ms" in base:
+                row["p99_ratio_vs_1"] = round(
+                    entry["latency_ms"]["p99"] / base["latency_ms"]["p99"], 3
+                )
+            scaling[str(n)] = row
+        section["scaling"] = scaling
+
+    # saturation probe: tiny per-replica queues + oversubscribed clients —
+    # past saturation the fleet must shed with structured 429 + Retry-After,
+    # never answer any other 5xx, and never queue unboundedly
+    print("fleet saturation probe (tiny queues, oversubscribed) ...",
+          flush=True)
+    sat_dir = os.path.join(root, "fleet-sat")
+    proc, router_url = _spawn_fleet_cli(
+        args, artifact, sat_dir, 1, queue_size=4
+    )
+    try:
+        sat = fleet_closed_loop(
+            router_url,
+            max(args.fleet_concurrency * 2, 48),
+            min(args.fleet_duration, 3.0),
+        )
+    finally:
+        _stop_fleet_cli(proc)
+    sat["queue_size"] = 4
+    section["saturation"] = sat
+    telemetry.event("bench_mode", mode="fleet_saturation", **sat)
+
+    # kill soak: SIGKILL one of two replicas mid-load via the fault seam
+    # (`serve --inject-fault sigkill@N`); the router must lose ZERO accepted
+    # requests, the supervisor must restart the dead replica, and the fleet
+    # must converge back to 2 live replicas
+    print("fleet kill-a-replica soak ...", flush=True)
+    kill_dir = os.path.join(root, "fleet-kill")
+    proc, router_url = _spawn_fleet_cli(
+        args, artifact, kill_dir, 2,
+        inject=f"2:sigkill@{args.fleet_kill_after}",
+    )
+    try:
+        kill = fleet_closed_loop(
+            router_url,
+            args.fleet_concurrency,
+            max(args.fleet_duration * 2, 6.0),
+        )
+        # convergence: poll the router's aggregate /healthz until both
+        # replicas are live again (the restarted one included)
+        converged = False
+        deadline = time.monotonic() + 45
+        while time.monotonic() < deadline:
+            try:
+                health = _get_json(router_url + "/healthz")
+            except OSError:
+                health = {}
+            if health.get("live", 0) >= 2 and health.get("status") == "ok":
+                converged = True
+                break
+            time.sleep(0.25)
+        kill["killed_replica"] = 2
+        kill["kill_after_requests"] = args.fleet_kill_after
+        kill["converged"] = converged
+        kill["client_errors"] = kill["errors_5xx"] + kill["errors_conn"]
+    finally:
+        _stop_fleet_cli(proc)
+    # restart accounting from the controller's ledger (the same events
+    # telemetry-report renders)
+    from tensorflowdistributedlearning_tpu.obs.ledger import read_ledger
+
+    try:
+        events = read_ledger(kill_dir)
+    except (OSError, ValueError):
+        events = []
+    kill["restarts"] = sum(
+        1 for e in events if e.get("event") == "replica_restart"
+    )
+    section["kill_soak"] = kill
+    telemetry.event("bench_mode", mode="fleet_kill_soak", **kill)
+    return section
+
+
+def _check_fleet(fleet: dict, args) -> list:
+    """The fleet gates (--check with --fleet): scaling floor at no-worse
+    p99, zero recompiles on every replica, graceful shed, kill-soak
+    convergence with zero lost accepted requests."""
+    problems = []
+    scaling = (fleet.get("scaling") or {}).get("2")
+    if scaling is None:
+        problems.append("fleet: no 2-replica scaling row measured")
+    else:
+        if scaling["speedup_vs_1"] < args.min_fleet_scaling:
+            problems.append(
+                f"fleet 2-replica speedup {scaling['speedup_vs_1']} < "
+                f"required {args.min_fleet_scaling}"
+            )
+        if scaling.get("p99_ratio_vs_1", 1.0) > args.max_fleet_p99_ratio:
+            problems.append(
+                f"fleet 2-replica p99 regressed "
+                f"{scaling['p99_ratio_vs_1']}x vs 1 replica — throughput "
+                "at degraded latency does not count"
+            )
+    for n, entry in fleet.get("replica_counts", {}).items():
+        for rid, stats in entry.get("replicas", {}).items():
+            if stats.get("recompiles_post_warmup"):
+                problems.append(
+                    f"fleet x{n}: replica {rid} saw "
+                    f"{stats['recompiles_post_warmup']} post-warmup "
+                    "recompile(s)"
+                )
+        if entry.get("errors_5xx") or entry.get("errors_conn"):
+            problems.append(
+                f"fleet x{n}: {entry.get('errors_5xx', 0)} 5xx / "
+                f"{entry.get('errors_conn', 0)} transport error(s) under "
+                "steady load"
+            )
+    sat = fleet.get("saturation")
+    if sat is not None:
+        if not sat.get("shed_429"):
+            problems.append(
+                "saturation probe shed nothing — queues grew instead of "
+                "rejecting"
+            )
+        elif not sat.get("shed_with_retry_after"):
+            problems.append("429s carried no usable Retry-After header")
+        if sat.get("errors_5xx"):
+            problems.append(
+                f"saturation probe answered {sat['errors_5xx']} non-drain "
+                "5xx(s)"
+            )
+    kill = fleet.get("kill_soak")
+    if kill is None:
+        problems.append("fleet: kill soak did not run")
+    else:
+        if kill.get("client_errors"):
+            problems.append(
+                f"kill soak lost {kill['client_errors']} accepted "
+                "request(s) (client-visible errors)"
+            )
+        if not kill.get("restarts"):
+            problems.append("kill soak: dead replica was never restarted")
+        if not kill.get("converged"):
+            problems.append(
+                "kill soak: fleet did not converge back to 2 live replicas"
+            )
+    return problems
+
+
 def closed_loop(issue, concurrency: int, duration_s: float) -> dict:
     """Run ``concurrency`` closed-loop clients for ``duration_s``; returns
     completed-request throughput and client-observed latency percentiles."""
@@ -389,9 +873,43 @@ def main() -> int:
                         "path exists for), 0.8 elsewhere (XLA:CPU upcasts "
                         "bf16 — the tripwire just catches a quantized path "
                         "that got materially slower)")
+    parser.add_argument("--fleet", action="store_true",
+                        help="add the serving-tier soak: sweep replica "
+                        "counts through real subprocess fleets behind the "
+                        "router, probe saturation shedding, and run the "
+                        "kill-a-replica convergence soak (record section: "
+                        "fleet)")
+    parser.add_argument("--fleet-only", action="store_true",
+                        help="run ONLY the fleet soak (implies --fleet)")
+    parser.add_argument("--fleet-replicas", type=int, nargs="+",
+                        default=(1, 2),
+                        help="replica counts to sweep; must include 1 for "
+                        "the scaling table and 2 for the --check gate")
+    parser.add_argument("--fleet-concurrency", type=int, default=32,
+                        help="closed-loop clients against the router")
+    parser.add_argument("--fleet-duration", type=float, default=4.0,
+                        help="seconds per fleet trial (the kill soak runs "
+                        "2x this, min 6s, so death + restart + convergence "
+                        "fit inside the soak)")
+    parser.add_argument("--fleet-kill-after", type=int, default=200,
+                        help="kill-soak drill: SIGKILL replica 2 after its "
+                        "Nth answered request (serve --inject-fault "
+                        "sigkill@N)")
+    parser.add_argument("--min-fleet-scaling", type=float, default=1.6,
+                        help="--check floor for 2-replica vs 1-replica "
+                        "throughput")
+    parser.add_argument("--max-fleet-p99-ratio", type=float, default=1.25,
+                        help="--check ceiling for 2-replica p99 / 1-replica "
+                        "p99 (tail-noise slack on the no-worse-p99 rule)")
     args = parser.parse_args()
     if args.quant_only:
         args.quant = True
+    if args.fleet_only:
+        args.fleet = True
+    if args.fleet_only and args.quant_only:
+        print("--fleet-only and --quant-only are mutually exclusive",
+              file=sys.stderr)
+        return 2
 
     from tensorflowdistributedlearning_tpu.obs import Telemetry
     from tensorflowdistributedlearning_tpu.serve import (
@@ -427,7 +945,8 @@ def main() -> int:
         "max_wait_ms": args.max_wait_ms,
     }
 
-    if not args.quant_only:
+    skip_ab = args.quant_only or args.fleet_only
+    if not skip_ab:
         serve_fn = make_synthetic_model()
         # one engine (with its OWN registry) per mode so counters and
         # per-bucket hits stay attributable to a mode — the ledger is the
@@ -468,7 +987,7 @@ def main() -> int:
         }
         telemetry.event("bench_mode", mode="batched", **record["batched"])
 
-    if args.http and not args.quant_only:
+    if args.http and not skip_ab:
         print("http (full stack, localhost) ...", flush=True)
         import http.client
         import socket
@@ -511,7 +1030,7 @@ def main() -> int:
         telemetry.event("bench_mode", mode="http", **record["http"])
         server.shutdown()
 
-    if not args.quant_only:
+    if not skip_ab:
         record["backpressure"] = probe_backpressure()
         pr_rps = record["per_request"]["requests_per_sec"]
         b_rps = record["batched"]["requests_per_sec"]
@@ -535,6 +1054,9 @@ def main() -> int:
                 "bytes scale with dtype)"
             )
         record["quant"] = quant
+
+    if args.fleet:
+        record["fleet"] = fleet_soak(args, telemetry)
 
     if standalone_detector is not None:
         standalone_detector.detach()
@@ -568,11 +1090,26 @@ def main() -> int:
         summary["quant_check_passed"] = {
             d: v["passed"] for d, v in record["quant"]["quant_check"].items()
         }
+    if args.fleet:
+        fleet = record["fleet"]
+        summary["fleet_rps"] = {
+            n: e.get("requests_per_sec")
+            for n, e in fleet["replica_counts"].items()
+        }
+        summary["fleet_scaling"] = fleet.get("scaling")
+        summary["fleet_shed_429"] = (fleet.get("saturation") or {}).get(
+            "shed_429"
+        )
+        kill = fleet.get("kill_soak") or {}
+        summary["fleet_kill_soak"] = {
+            k: kill.get(k)
+            for k in ("client_errors", "restarts", "converged")
+        }
     print(json.dumps(summary))
 
     if args.check:
         problems = []
-        if not args.quant_only:
+        if not skip_ab:
             speedup = record["speedup_batched_vs_per_request"] or 0
             if speedup < args.min_speedup:
                 problems.append(
@@ -592,6 +1129,8 @@ def main() -> int:
                 )
         if args.quant:
             problems.extend(_check_quant(record["quant"], args))
+        if args.fleet:
+            problems.extend(_check_fleet(record["fleet"], args))
         if problems:
             print("CHECK FAILED: " + "; ".join(problems), file=sys.stderr)
             return 1

@@ -29,6 +29,14 @@ Usage (CI runs the first form ahead of tier-1)::
 ``--fresh-*`` skips running the benches (tests inject doctored results
 through it; operators can re-check an old run). Without them the sentinel
 runs ``bench.py --async-loop`` and ``tools/bench_serve.py`` on the CPU shape.
+
+The ``fleet`` bench REPLAYS the committed BENCH_SERVE.json ``fleet`` section
+(bench_serve --fleet is too heavy for every CI run): the committed 2-replica
+scaling must clear the 1.6x floor, every replica must report zero post-warmup
+recompiles, the saturation probe must have shed with Retry-After and zero
+non-drain 5xx, and the kill soak must have converged with zero lost accepted
+requests — all dimensionless/hard, so no machine slack applies. A
+``--fresh-serve`` record carrying its own ``fleet`` section is gated instead.
 """
 
 from __future__ import annotations
@@ -158,6 +166,74 @@ def check_serve(
     return out
 
 
+# the fleet acceptance floor: 2 replicas must buy >= 1.6x single-replica
+# throughput (scaling efficiency 0.8) — below that the tier's premise
+# (capacity scales with replicas) is broken, whatever the machine
+DEFAULT_FLEET_SCALING_FLOOR = 1.6
+
+
+def check_fleet(
+    baseline: Dict,
+    fresh: Optional[Dict] = None,
+    *,
+    scaling_floor: float = DEFAULT_FLEET_SCALING_FLOOR,
+) -> List[Dict]:
+    """Replay the BENCH_SERVE.json ``fleet`` section against its hard gates.
+
+    The fleet soak is too heavy to re-run on every CI invocation, so the
+    default mode REPLAYS the committed section (``fresh`` falls back to the
+    baseline): a PR editing the serving tier must re-run ``bench_serve
+    --fleet`` and commit numbers that still clear the gates — scaling floor,
+    zero post-warmup recompiles on every replica, shed-with-Retry-After and
+    zero non-drain 5xx past saturation, kill-soak convergence with zero lost
+    accepted requests. A ``--fresh-serve`` record carrying its own ``fleet``
+    section is gated instead (dimensionless, so no machine slack needed)."""
+    record = fresh if fresh and fresh.get("fleet") else baseline
+    fleet = record.get("fleet")
+    if not fleet:
+        return []
+    out: List[Dict] = []
+    scaling = (fleet.get("scaling") or {}).get("2") or {}
+    speedup = scaling.get("speedup_vs_1")
+    if speedup is not None:
+        out.append(_finding(
+            "fleet", "scaling.2.speedup_vs_1", scaling_floor, speedup,
+            f">= {scaling_floor} (hard)", speedup >= scaling_floor,
+        ))
+    recompiles = sum(
+        stats.get("recompiles_post_warmup", 0) or 0
+        for entry in fleet.get("replica_counts", {}).values()
+        for stats in (entry.get("replicas") or {}).values()
+    )
+    out.append(_finding(
+        "fleet", "replica_post_warmup_recompiles", 0, recompiles,
+        "== 0 (hard)", recompiles == 0,
+    ))
+    sat = fleet.get("saturation")
+    if sat is not None:
+        out.append(_finding(
+            "fleet", "saturation.shed_with_retry_after", ">= 1",
+            sat.get("shed_with_retry_after", 0), ">= 1 (structured shed)",
+            sat.get("shed_with_retry_after", 0) >= 1,
+        ))
+        out.append(_finding(
+            "fleet", "saturation.errors_5xx", 0, sat.get("errors_5xx", 0),
+            "== 0 (hard)", not sat.get("errors_5xx"),
+        ))
+    kill = fleet.get("kill_soak")
+    if kill is not None:
+        out.append(_finding(
+            "fleet", "kill_soak.client_errors", 0,
+            kill.get("client_errors", 0), "== 0 (hard)",
+            not kill.get("client_errors"),
+        ))
+        out.append(_finding(
+            "fleet", "kill_soak.converged", True, kill.get("converged"),
+            "== true (hard)", bool(kill.get("converged")),
+        ))
+    return out
+
+
 # -- fresh-run plumbing ------------------------------------------------------
 
 
@@ -207,7 +283,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="run the comparisons and gate on them (the only "
                         "mode; the flag exists so the CI step reads as a "
                         "gate)")
-    parser.add_argument("--benches", default="async,serve",
+    parser.add_argument("--benches", default="async,serve,fleet",
                         help="comma-separated subset to check")
     parser.add_argument("--baseline-async",
                         default=os.path.join(REPO, "BENCH_ASYNC.json"))
@@ -276,6 +352,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         except (OSError, RuntimeError, ValueError,
                 subprocess.TimeoutExpired) as e:
             errors.append(f"serve: {e}")
+    if "fleet" in benches:
+        try:
+            baseline = _load(args.baseline_serve)
+            fresh = _load(args.fresh_serve) if args.fresh_serve else None
+            findings += check_fleet(baseline, fresh)
+        except (OSError, ValueError) as e:
+            errors.append(f"fleet: {e}")
 
     failed = [f for f in findings if not f["ok"]]
     for f in findings:
