@@ -462,6 +462,24 @@ def build_parser() -> argparse.ArgumentParser:
                        "or registered run ids with --registry-dir): step "
                        "time, data/fetch wait, eval metrics, serving p99")
 
+    p_top = sub.add_parser(
+        "telemetry-top",
+        help="live fleet console: a refreshing terminal view tailing the "
+        "workdir's merged run ledgers (training goodput, serving backlog "
+        "and p99, HBM headroom, chip-seconds cost rates, straggler and "
+        "health flags); --once prints a single frame for scripts/CI",
+    )
+    p_top.add_argument("workdir",
+                       help="the shared workdir whose telemetry.jsonl / "
+                       "telemetry-{i}.jsonl ledgers to tail (a trainer's "
+                       "model-dir or a serve/serve-fleet --workdir)")
+    p_top.add_argument("--interval", type=float, default=2.0,
+                       help="seconds between frame refreshes")
+    p_top.add_argument("--once", action="store_true",
+                       help="print one frame and exit (no screen clearing) — "
+                       "the scripting/CI-smoke mode; an empty workdir "
+                       "renders an honest 'no ledgers yet' frame, rc 0")
+
     p_doc = sub.add_parser(
         "doctor",
         help="diagnose the environment and (optionally) a dataset layout",
@@ -792,6 +810,14 @@ def cmd_telemetry_report(args) -> int:
         print(f"telemetry-report: {e}", file=sys.stderr)
         return 1
     return 0
+
+
+def cmd_telemetry_top(args) -> int:
+    """The live operator console (obs/top.py): tail the workdir's merged
+    ledgers and refresh a one-screen fleet view; ``--once`` for scripting."""
+    from tensorflowdistributedlearning_tpu.obs.top import top
+
+    return top(args.workdir, interval_s=args.interval, once=args.once)
 
 
 def cmd_serve(args) -> int:
@@ -1331,6 +1357,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "quantize-check": cmd_quantize_check,
         "presets": cmd_presets,
         "telemetry-report": cmd_telemetry_report,
+        "telemetry-top": cmd_telemetry_top,
         "doctor": cmd_doctor,
     }[args.command](args)
 

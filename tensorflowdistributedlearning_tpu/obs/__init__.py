@@ -31,6 +31,12 @@ metrics+tracing as a core subsystem, Abadi et al., arXiv:1605.08695):
   structured ``health_alert`` ledger events.
 """
 
+from tensorflowdistributedlearning_tpu.obs.capacity import (
+    COST_EVENT,
+    WATERMARK_EVENT,
+    CostMeter,
+    WatermarkTracker,
+)
 from tensorflowdistributedlearning_tpu.obs.compare import (
     compare_workdirs,
     load_registry,
@@ -45,6 +51,7 @@ from tensorflowdistributedlearning_tpu.obs.fleet import (
 )
 from tensorflowdistributedlearning_tpu.obs.health import (
     HEALTH_ALERT_EVENT,
+    HeadroomMonitor,
     HealthAbortError,
     HealthMonitor,
     SloTracker,
@@ -52,6 +59,7 @@ from tensorflowdistributedlearning_tpu.obs.health import (
 from tensorflowdistributedlearning_tpu.obs.ledger import (
     LEDGER_FILENAME,
     RunLedger,
+    flush_all_ledgers,
     per_process_filename,
     read_ledger,
     read_ledger_with_errors,
@@ -85,6 +93,7 @@ from tensorflowdistributedlearning_tpu.obs.trace import (
 )
 
 __all__ = [
+    "COST_EVENT",
     "HEALTH_ALERT_EVENT",
     "PREFETCH_DEPTH_HISTOGRAM",
     "SPAN_BARRIER",
@@ -95,8 +104,11 @@ __all__ = [
     "SPAN_STEP",
     "STRAGGLER_ALERT_EVENT",
     "TRACE_EVENT",
+    "WATERMARK_EVENT",
+    "CostMeter",
     "Counter",
     "Gauge",
+    "HeadroomMonitor",
     "HealthAbortError",
     "HealthMonitor",
     "LEDGER_FILENAME",
@@ -110,11 +122,13 @@ __all__ = [
     "TimeHistogram",
     "TraceContext",
     "Tracer",
+    "WatermarkTracker",
     "compare_workdirs",
     "discover_ledgers",
     "export_chrome_trace",
     "fleet_section",
     "fleet_summary",
+    "flush_all_ledgers",
     "load_registry",
     "per_process_filename",
     "read_ledger",

@@ -1,0 +1,555 @@
+"""Capacity & cost accounting: HBM watermarks and chip-seconds meters.
+
+Two questions the ledger could not answer before this module existed:
+
+- **"How close is this run to OOM?"** The periodic ``memory`` snapshot
+  recorded whatever the allocator said at sampling time, but nothing tracked
+  the PEAK per phase (compile vs steady-state step vs eval vs checkpoint vs
+  inference), nothing compared the measured bytes/chip against the
+  ``tree_bytes_per_device`` prediction the parallelism modes budget with
+  (the pjit/TPUv4 methodology, arXiv:2204.06514, plans placements off exactly
+  that number), and nothing estimated whether the trend crosses the device
+  limit. :class:`WatermarkTracker` does all three, emitting a
+  ``memory_watermark`` ledger event whenever the fleet-wide peak advances.
+
+- **"What does one prediction cost in chip-seconds?"** Throughput tells you
+  images/sec; a capacity planner needs device-time-per-unit-of-work — the
+  cost-per-qps lens of the Gemma-on-TPU serving comparison (arXiv:2605.25645).
+  :class:`CostMeter` attributes device time to training windows
+  (``chip_seconds_per_step``, ``images_per_chip_second``) and, via
+  batch-share, to individual serving requests (``chip_seconds_per_request``
+  percentiles, ``rps_per_chip``), emitting ``cost`` ledger events.
+
+Both meters are HOST-side bookkeeping on the existing window cadence — one
+allocator query and a handful of float ops per ledger window, never per step
+— so their overhead hides under real device work (gated <= 1% step time by
+``bench.py --capacity-overhead``, the same discipline as the tracing gate).
+
+Failure stance matches the rest of ``obs/``: backends without the allocator
+query (CPU builds — ``memory_stats`` returns nothing there) degrade to
+None-samples, never to a crash, and the cost meter works everywhere (wall
+time x chip count needs no backend support).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
+
+from tensorflowdistributedlearning_tpu.obs.metrics import (
+    TimeHistogram,
+    window_count,
+    window_total_s,
+)
+
+# ledger event kinds this module owns (see docs/LEDGER_SCHEMA.md)
+WATERMARK_EVENT = "memory_watermark"
+COST_EVENT = "cost"
+
+# the phases a watermark is attributed to — the coarse lifecycle of a run
+PHASE_COMPILE = "compile"
+PHASE_STEP = "step"
+PHASE_EVAL = "eval"
+PHASE_CKPT = "ckpt"
+PHASE_INFER = "infer"
+PHASES = (PHASE_COMPILE, PHASE_STEP, PHASE_EVAL, PHASE_CKPT, PHASE_INFER)
+
+
+def _trend_bytes_per_sample(history: Sequence[Tuple[float, int]]) -> Optional[float]:
+    """Least-squares slope of peak_bytes over the retained samples: a
+    steadily climbing peak (a leak, a growing cache, a fragmenting allocator)
+    shows up as bytes/sample long before the limit. None under 3 samples."""
+    if len(history) < 3:
+        return None
+    n = len(history)
+    ys = [p for _, p in history]
+    mean_x = (n - 1) / 2.0
+    mean_y = sum(ys) / n
+    denom = sum((x - mean_x) ** 2 for x in range(n))
+    if not denom:
+        return 0.0
+    return sum(
+        (x - mean_x) * (y - mean_y) for x, y in zip(range(n), ys)
+    ) / denom
+
+
+def _default_stats() -> Dict[str, Dict[str, int]]:
+    from tensorflowdistributedlearning_tpu.utils.profiling import memory_stats
+
+    return memory_stats()
+
+
+def peak_bytes_across_devices(
+    stats: Optional[Dict[str, Dict[str, int]]] = None,
+) -> int:
+    """Max ``peak_bytes_in_use`` (falling back to ``bytes_in_use``) across
+    local devices — THE peak-extraction rule, shared by the watermark
+    tracker and the bench fields so the sentinel's ``peak_hbm_bytes`` gate
+    can never diverge from the ledger's watermark numbers. 0 when the
+    backend reports nothing (CPU builds) or the probe fails."""
+    if stats is None:
+        try:
+            stats = _default_stats() or {}
+        except Exception:  # noqa: BLE001 — a failed probe must not crash
+            return 0
+    return max(
+        (
+            int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
+            for s in stats.values()
+        ),
+        default=0,
+    )
+
+
+def device_count() -> int:
+    """Local chip count for cost accounting; 1 when the backend probe fails
+    (cost then degrades to plain wall-seconds, still monotonic and
+    comparable run-to-run on the same shape)."""
+    try:
+        import jax
+
+        return max(1, len(jax.local_devices()))
+    except Exception:  # noqa: BLE001 — a down backend must not kill telemetry
+        return 1
+
+
+class WatermarkTracker:
+    """Per-phase peak-HBM watermarks with measured-vs-predicted accounting.
+
+    ``sample(phase)`` queries the allocator (``profiling.memory_stats``) and,
+    when the fleet-wide ``peak_bytes_in_use`` advanced past the recorded
+    high-water mark, returns the fields of a ``memory_watermark`` ledger
+    event attributing the new peak to ``phase`` — the phase that was running
+    when the watermark moved is the phase that owns the memory. A phase's
+    FIRST sample under an existing peak is also recorded (``advanced:
+    false``, ``delta_bytes: 0`` — an observation, not an allocation), so the
+    per-phase table stays complete while steady-state steps under a
+    compile-time peak remain the healthy, delta-free case.
+
+    ``predicted_bytes_per_device`` is the exact ``tree_bytes_per_device``
+    accounting the trainers attach (params + optimizer state); every
+    watermark event carries ``measured_minus_predicted_bytes`` so the
+    activations/workspace residual — the number a placement planner must
+    margin for — is ledgered per run.
+
+    ``headroom()`` is the live OOM-risk view: current headroom fraction
+    against ``bytes_limit`` plus a linear trend over the recent samples and
+    the projected samples-to-limit. Backends without the allocator query
+    yield ``sample() -> None`` and ``headroom() -> None``; nothing crashes.
+    """
+
+    # recent (t, peak_bytes) pairs the trend is fit over
+    TREND_SAMPLES = 16
+
+    def __init__(
+        self,
+        predicted_bytes_per_device: Optional[int] = None,
+        *,
+        stats_fn: Callable[[], Dict[str, Dict[str, int]]] = _default_stats,
+    ):
+        self.predicted_bytes_per_device = predicted_bytes_per_device
+        self._stats_fn = stats_fn
+        self._lock = threading.Lock()
+        self.peak_bytes = 0  # fleet-wide high-water mark seen so far
+        self.bytes_limit: Optional[int] = None
+        self.phase_peaks: Dict[str, Dict] = {}
+        self._history: Deque[Tuple[float, int]] = collections.deque(
+            maxlen=self.TREND_SAMPLES
+        )
+        self.samples = 0  # queries that returned device stats
+
+    def set_predicted(self, bytes_per_device: Optional[int]) -> None:
+        if bytes_per_device:
+            self.predicted_bytes_per_device = int(bytes_per_device)
+
+    def _query(
+        self, stats: Optional[Dict[str, Dict[str, int]]] = None
+    ) -> Tuple[int, Optional[int], int]:
+        """(max peak, max limit, live bytes) across local devices; zeros when
+        the backend reports nothing (CPU builds). ``stats`` lets a caller that
+        already holds a snapshot (Telemetry.memory_event) avoid a second
+        allocator round trip — one query per window is the contract. An
+        EMPTY snapshot falls through to ``stats_fn``: real backends with the
+        query never produce one, and it keeps an injected stats_fn (tests)
+        authoritative over a statless caller's probe."""
+        if not stats:
+            try:
+                stats = self._stats_fn() or {}
+            except Exception:  # noqa: BLE001 — a failed probe must not crash
+                return 0, None, 0
+        peak = peak_bytes_across_devices(stats)
+        live = 0
+        limit: Optional[int] = None
+        for s in stats.values():
+            live = max(live, int(s.get("bytes_in_use", 0)))
+            if s.get("bytes_limit"):
+                limit = max(limit or 0, int(s["bytes_limit"]))
+        return peak, limit, live
+
+    def sample(
+        self,
+        phase: str,
+        step: Optional[int] = None,
+        stats: Optional[Dict[str, Dict[str, int]]] = None,
+    ) -> Optional[Dict]:
+        """One allocator query attributed to ``phase`` (or zero queries when
+        the caller passes its already-fetched ``stats``). Returns the
+        ``memory_watermark`` event fields when the global peak advanced (or
+        this phase records its first peak), None otherwise — including on
+        backends with no allocator query at all."""
+        peak, limit, live = self._query(stats)
+        if peak <= 0:
+            return None
+        with self._lock:
+            self.samples += 1
+            if limit is not None:
+                self.bytes_limit = limit
+            self._history.append((time.monotonic(), peak))
+            prev_global = self.peak_bytes
+            advanced = peak > prev_global
+            first_for_phase = phase not in self.phase_peaks
+            if advanced:
+                self.peak_bytes = peak
+            if not (advanced or first_for_phase):
+                return None
+            self.phase_peaks[phase] = {
+                "peak_bytes": peak,
+                "step": step,
+            }
+            fields: Dict = {
+                "phase": phase,
+                "peak_bytes": peak,
+                # only an ADVANCE owns new memory: a phase's first sample
+                # under an existing (e.g. compile-time) peak records the
+                # observation with delta 0 rather than claiming bytes some
+                # earlier phase actually allocated
+                "delta_bytes": peak - prev_global if advanced else 0,
+                "advanced": advanced,
+                "bytes_in_use": live,
+            }
+            if step is not None:
+                fields["step"] = step
+            if self.bytes_limit:
+                fields["bytes_limit"] = self.bytes_limit
+                fields["headroom_frac"] = round(
+                    max(0.0, 1.0 - peak / self.bytes_limit), 4
+                )
+                slope = _trend_bytes_per_sample(list(self._history))
+                if slope is not None and slope > 0:
+                    fields["samples_to_limit"] = int(
+                        (self.bytes_limit - peak) / slope
+                    )
+            if self.predicted_bytes_per_device:
+                fields["predicted_bytes_per_device"] = (
+                    self.predicted_bytes_per_device
+                )
+                fields["measured_minus_predicted_bytes"] = (
+                    peak - self.predicted_bytes_per_device
+                )
+            return fields
+
+    def headroom(self) -> Optional[Dict]:
+        """Live headroom + trend: how much HBM is left and how fast the peak
+        is moving. None until a device sample exists."""
+        with self._lock:
+            if not self.peak_bytes:
+                return None
+            out: Dict = {"peak_bytes": self.peak_bytes}
+            if self.bytes_limit:
+                out["bytes_limit"] = self.bytes_limit
+                out["headroom_frac"] = round(
+                    max(0.0, 1.0 - self.peak_bytes / self.bytes_limit), 4
+                )
+            history = list(self._history)
+        slope = _trend_bytes_per_sample(history)
+        if slope is not None:
+            out["trend_bytes_per_sample"] = int(slope)
+            if self.bytes_limit and slope > 0:
+                out["samples_to_limit"] = int(
+                    (self.bytes_limit - self.peak_bytes) / slope
+                )
+        return out
+
+    def snapshot(self) -> Dict:
+        """The /metrics view: per-phase peaks + the headroom estimate."""
+        with self._lock:
+            out: Dict = {
+                "peak_bytes": self.peak_bytes,
+                "phases": {
+                    p: dict(v) for p, v in self.phase_peaks.items()
+                },
+            }
+            if self.bytes_limit:
+                out["bytes_limit"] = self.bytes_limit
+            if self.predicted_bytes_per_device:
+                out["predicted_bytes_per_device"] = (
+                    self.predicted_bytes_per_device
+                )
+        hr = self.headroom()
+        if hr:
+            out["headroom"] = hr
+        return out
+
+
+class CostMeter:
+    """Chip-seconds accounting for training windows and serving requests.
+
+    One chip-second = one device busy for one second; a window's device time
+    times the local chip count. Training: the window's ``compute_s`` span
+    total IS the device-busy wall time (SPMD steps run every chip in
+    lockstep), so ``chip_seconds = compute_s * n_chips``. Serving: each
+    dispatched batch's engine time is split across its member requests by
+    batch-share (a request with ``n_i`` of the batch's ``N`` examples owns
+    ``n_i/N`` of the batch's chip-seconds) — padding waste is deliberately
+    charged to the requests that rode the bucket, because the padded slots
+    were burned on their behalf.
+    """
+
+    def __init__(self, n_chips: Optional[int] = None):
+        # lazy: resolving the chip count touches the jax backend, which must
+        # not happen at module import (NULL_TELEMETRY) or before the caller's
+        # platform selection ran
+        self._n_chips = n_chips
+        self._lock = threading.Lock()
+        self.chip_seconds_total = 0.0
+        self.train_steps = 0
+        self.train_examples = 0.0
+        # per-request chip-second samples, drained per serving window
+        self._request_hist = TimeHistogram("cost/chip_seconds_per_request")
+        self._completed_requests = 0
+        self._window_started_t = time.monotonic()
+        self._window_chip_seconds = 0.0
+        self._window_completed = 0
+
+    @property
+    def n_chips(self) -> int:
+        if self._n_chips is None:
+            self._n_chips = device_count()
+        return self._n_chips
+
+    # -- training ----------------------------------------------------------
+
+    def train_window(
+        self,
+        compute_s: float,
+        steps: int,
+        *,
+        examples: Optional[float] = None,
+        step: Optional[int] = None,
+    ) -> Optional[Dict]:
+        """Account one training log window; returns the ``cost`` ledger event
+        fields (None for an empty window)."""
+        if compute_s <= 0 or steps <= 0:
+            return None
+        chip_s = compute_s * self.n_chips
+        with self._lock:
+            self.chip_seconds_total += chip_s
+            self.train_steps += steps
+            if examples:
+                self.train_examples += examples
+            total = self.chip_seconds_total
+        fields: Dict = {
+            "scope": "train",
+            "n_chips": self.n_chips,
+            "chip_seconds": round(chip_s, 6),
+            "chip_seconds_total": round(total, 6),
+            "chip_seconds_per_step": round(chip_s / steps, 6),
+        }
+        if step is not None:
+            fields["step"] = step
+        if examples:
+            fields["examples"] = int(examples)
+            fields["examples_per_chip_second"] = round(examples / chip_s, 2)
+        return fields
+
+    # -- serving -----------------------------------------------------------
+
+    def add_batch(
+        self, compute_s: float, request_examples: Sequence[int]
+    ) -> None:
+        """Attribute one dispatched batch's device time to its member
+        requests by batch-share. Called from the batcher worker — one
+        histogram record per request, no allocation beyond that."""
+        total = sum(request_examples)
+        if compute_s <= 0 or total <= 0:
+            return
+        chip_s = compute_s * self.n_chips
+        with self._lock:
+            self.chip_seconds_total += chip_s
+            self._window_chip_seconds += chip_s
+            self._window_completed += len(request_examples)
+            self._completed_requests += len(request_examples)
+        for n in request_examples:
+            self._request_hist.record(chip_s * n / total)
+
+    def serve_window(self) -> Optional[Dict]:
+        """Drain one serving window: the ``cost`` ledger event fields —
+        window + cumulative chip-seconds, ``rps_per_chip``, per-request
+        chip-second percentiles, and the duty cycle (fraction of the fleet's
+        chip capacity the window actually used). None for an idle window."""
+        samples = self._request_hist.drain()
+        with self._lock:
+            now = time.monotonic()
+            window_s = max(now - self._window_started_t, 1e-9)
+            chip_s = self._window_chip_seconds
+            completed = self._window_completed
+            total = self.chip_seconds_total
+            self._window_started_t = now
+            self._window_chip_seconds = 0.0
+            self._window_completed = 0
+        if not completed:
+            return None
+        fields: Dict = {
+            "scope": "serve",
+            "n_chips": self.n_chips,
+            "window_s": round(window_s, 3),
+            "chip_seconds": round(chip_s, 6),
+            "chip_seconds_total": round(total, 6),
+            "requests": completed,
+            "rps_per_chip": round(completed / window_s / self.n_chips, 3),
+            # chip-seconds the window burned / chip-seconds it had: <1 means
+            # idle capacity, the autoscale-down signal of the cost view
+            "duty_cycle": round(chip_s / (window_s * self.n_chips), 4),
+        }
+        if samples:
+            import numpy as np
+
+            arr = np.asarray(list(samples), np.float64)
+            count = window_count(samples)
+            total_s = window_total_s(samples)
+            fields["chip_seconds_per_request"] = {
+                "mean": round(total_s / max(count, 1), 9),
+                "p50": round(float(np.percentile(arr, 50)), 9),
+                "p90": round(float(np.percentile(arr, 90)), 9),
+                "p99": round(float(np.percentile(arr, 99)), 9),
+            }
+        return fields
+
+    def snapshot(self) -> Dict:
+        """The /metrics view (cumulative; rates belong to windows)."""
+        with self._lock:
+            out = {
+                "n_chips": self.n_chips,
+                "chip_seconds_total": round(self.chip_seconds_total, 6),
+            }
+            if self.train_steps:
+                out["train_steps"] = self.train_steps
+                out["chip_seconds_per_step"] = round(
+                    self.chip_seconds_total / self.train_steps, 6
+                )
+            if self._completed_requests:
+                out["completed_requests"] = self._completed_requests
+        return out
+
+
+def aggregate_cost_events(events: List[Dict]) -> Optional[Dict]:
+    """Report-side aggregation of a ledger's ``cost`` events: one dict with
+    ``train`` / ``serve`` sub-sections (stable keys — the ``telemetry-report
+    --json`` schema). None when the run ledgered no cost."""
+    cost = [e for e in events if e.get("event") == COST_EVENT]
+    if not cost:
+        return None
+    out: Dict = {"events": len(cost)}
+    train = [e for e in cost if e.get("scope") == "train"]
+    serve = [e for e in cost if e.get("scope") == "serve"]
+    if train:
+        last = train[-1]
+        total_chip_s = last.get("chip_seconds_total", 0.0)
+        steps = sum(
+            e.get("chip_seconds", 0.0) / e["chip_seconds_per_step"]
+            for e in train
+            if e.get("chip_seconds_per_step")
+        )
+        section: Dict = {
+            "n_chips": last.get("n_chips"),
+            "chip_seconds_total": round(total_chip_s, 3),
+        }
+        if steps:
+            section["chip_seconds_per_step"] = round(
+                sum(e.get("chip_seconds", 0.0) for e in train) / steps, 6
+            )
+        examples = sum(e.get("examples", 0) for e in train)
+        window_chip_s = sum(e.get("chip_seconds", 0.0) for e in train)
+        if examples and window_chip_s:
+            section["examples_per_chip_second"] = round(
+                examples / window_chip_s, 2
+            )
+        out["train"] = section
+    if serve:
+        last = serve[-1]
+        window_s = sum(e.get("window_s", 0.0) for e in serve)
+        requests = sum(e.get("requests", 0) for e in serve)
+        n_chips = last.get("n_chips") or 1
+        section = {
+            "n_chips": n_chips,
+            "chip_seconds_total": round(
+                last.get("chip_seconds_total", 0.0), 3
+            ),
+            "requests": requests,
+        }
+        if window_s:
+            section["rps_per_chip"] = round(
+                requests / window_s / n_chips, 3
+            )
+            section["duty_cycle"] = round(
+                sum(e.get("chip_seconds", 0.0) for e in serve)
+                / (window_s * n_chips),
+                4,
+            )
+        per_req = [
+            e["chip_seconds_per_request"]
+            for e in serve
+            if "chip_seconds_per_request" in e
+        ]
+        if per_req:
+            weights = [e.get("requests", 1) for e in serve if "chip_seconds_per_request" in e]
+            total_w = sum(weights) or 1
+
+            def merged(key: str) -> float:
+                return sum(
+                    s[key] * w for s, w in zip(per_req, weights)
+                ) / total_w
+
+            section["chip_seconds_per_request"] = {
+                "mean": round(merged("mean"), 9),
+                "p50": round(merged("p50"), 9),
+                "p90": round(merged("p90"), 9),
+                # percentile merging across windows is approximate everywhere
+                # else in the report (step_time_ms) — worst window for p99
+                "p99_worst_window": round(max(s["p99"] for s in per_req), 9),
+            }
+        out["serve"] = section
+    return out
+
+
+def aggregate_watermark_events(events: List[Dict]) -> Optional[Dict]:
+    """Report-side aggregation of ``memory_watermark`` events: per-phase
+    final peaks, the global peak, and the last measured-vs-predicted delta.
+    None when the run ledgered no watermarks (CPU backends)."""
+    marks = [e for e in events if e.get("event") == WATERMARK_EVENT]
+    if not marks:
+        return None
+    phases: Dict[str, Dict] = {}
+    for e in marks:
+        phase = e.get("phase", "unknown")
+        row = {"peak_bytes": e.get("peak_bytes", 0)}
+        if e.get("step") is not None:
+            row["step"] = e["step"]
+        phases[phase] = row  # last write wins: the phase's final watermark
+    last = marks[-1]
+    out: Dict = {
+        "events": len(marks),
+        "peak_bytes": max(e.get("peak_bytes", 0) for e in marks),
+        "phases": phases,
+    }
+    for key in (
+        "bytes_limit",
+        "headroom_frac",
+        "predicted_bytes_per_device",
+        "measured_minus_predicted_bytes",
+    ):
+        if last.get(key) is not None:
+            out[key] = last[key]
+    return out

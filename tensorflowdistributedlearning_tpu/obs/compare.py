@@ -103,6 +103,37 @@ def run_summary(workdir: str) -> Dict:
     fleet = report.get("fleet")
     if fleet and fleet.get("straggler"):
         row["straggler_max_skew"] = fleet["straggler"]["max_skew"]
+    # capacity/cost (obs/capacity.py): the chip-seconds and watermark
+    # numbers run-vs-run compares track as first-class perf trajectories
+    cost = report.get("cost") or {}
+    cost_row: Dict = {}
+    train_cost = cost.get("train")
+    if train_cost:
+        if train_cost.get("chip_seconds_per_step") is not None:
+            cost_row["chip_seconds_per_step"] = train_cost[
+                "chip_seconds_per_step"
+            ]
+        if train_cost.get("examples_per_chip_second") is not None:
+            cost_row["examples_per_chip_second"] = train_cost[
+                "examples_per_chip_second"
+            ]
+    serve_cost = cost.get("serve")
+    if serve_cost:
+        if serve_cost.get("rps_per_chip") is not None:
+            cost_row["rps_per_chip"] = serve_cost["rps_per_chip"]
+        per_req = serve_cost.get("chip_seconds_per_request") or {}
+        if per_req.get("p99_worst_window") is not None:
+            cost_row["chip_seconds_per_request_p99"] = per_req[
+                "p99_worst_window"
+            ]
+    if cost_row:
+        row["cost"] = cost_row
+    watermarks = (report.get("memory") or {}).get("watermarks") or {}
+    if watermarks.get("peak_bytes"):
+        mem_row: Dict = {"peak_bytes": watermarks["peak_bytes"]}
+        if watermarks.get("headroom_frac") is not None:
+            mem_row["headroom_frac"] = watermarks["headroom_frac"]
+        row["memory"] = mem_row
     return row
 
 
@@ -180,6 +211,22 @@ _METRICS = (
     ("serve_request_p99_ms",
      lambda r: (r.get("serve") or {}).get("request_p99_ms"),
      "lower", 0.15, "rel"),
+    # capacity/cost trajectories (obs/capacity.py): chip-seconds numbers
+    # derive from span wall time (same jitter as step time → same 10% band);
+    # the per-request p99 inherits the tail-noise band; device peak bytes is
+    # near-deterministic for a fixed config, so a 5% move is a real change
+    ("chip_seconds_per_step",
+     lambda r: (r.get("cost") or {}).get("chip_seconds_per_step"),
+     "lower", 0.10, "rel"),
+    ("rps_per_chip",
+     lambda r: (r.get("cost") or {}).get("rps_per_chip"),
+     "higher", 0.10, "rel"),
+    ("chip_seconds_per_request_p99",
+     lambda r: (r.get("cost") or {}).get("chip_seconds_per_request_p99"),
+     "lower", 0.25, "rel"),
+    ("hbm_peak_bytes",
+     lambda r: (r.get("memory") or {}).get("peak_bytes"),
+     "lower", 0.05, "rel"),
 )
 
 

@@ -243,6 +243,39 @@ def _process_row(led: ProcessLedger) -> Dict:
     fp = header.get("fingerprint") or {}
     if fp and "error" not in fp:
         row["device_kind"] = fp.get("device_kind")
+    # capacity/cost accounting per process (obs/capacity.py): cumulative
+    # chip-seconds, per-chip request rate, and the HBM watermark — the
+    # per-host halves of the fleet-wide cost/headroom aggregates
+    from tensorflowdistributedlearning_tpu.obs import capacity as capacity_lib
+
+    cost = capacity_lib.aggregate_cost_events(led.events)
+    if cost:
+        cost_row: Dict = {}
+        for scope in ("train", "serve"):
+            section = cost.get(scope)
+            if not section:
+                continue
+            cost_row["n_chips"] = section.get("n_chips")
+            cost_row["chip_seconds_total"] = section.get("chip_seconds_total")
+            if scope == "serve" and section.get("rps_per_chip") is not None:
+                cost_row["rps_per_chip"] = section["rps_per_chip"]
+            if scope == "serve" and section.get("chip_seconds_per_request"):
+                cost_row["chip_seconds_per_request"] = section[
+                    "chip_seconds_per_request"
+                ]
+                cost_row["requests"] = section.get("requests")
+            if scope == "train" and section.get("chip_seconds_per_step") is not None:
+                cost_row["chip_seconds_per_step"] = section[
+                    "chip_seconds_per_step"
+                ]
+        if cost_row:
+            row["cost"] = cost_row
+    marks = capacity_lib.aggregate_watermark_events(led.events)
+    if marks:
+        mem_row: Dict = {"peak_bytes": marks["peak_bytes"]}
+        if marks.get("headroom_frac") is not None:
+            mem_row["headroom_frac"] = marks["headroom_frac"]
+        row["memory"] = mem_row
     if serve_windows:
         last = serve_windows[-1]
         serve: Dict = {
@@ -311,6 +344,52 @@ def fleet_section(
         "ledger_parse_errors": sum(led.parse_errors for led in ledgers),
         "per_process": rows,
     }
+    # fleet-wide cost/capacity rollup: total chip-seconds across every
+    # process, summed per-chip request rate (the Gemma-on-TPU cost-per-qps
+    # lens at fleet scale), and the tightest replica's headroom
+    chip_s = [r["cost"]["chip_seconds_total"] for r in rows if r.get("cost")]
+    rps = [
+        r["cost"]["rps_per_chip"]
+        for r in rows
+        if r.get("cost", {}).get("rps_per_chip") is not None
+    ]
+    headrooms = [
+        r["memory"]["headroom_frac"]
+        for r in rows
+        if r.get("memory", {}).get("headroom_frac") is not None
+    ]
+    if chip_s or rps or headrooms:
+        rollup: Dict = {}
+        if chip_s:
+            rollup["chip_seconds_total"] = round(sum(chip_s), 3)
+        if rps:
+            rollup["rps_per_chip_total"] = round(sum(rps), 3)
+        if headrooms:
+            rollup["min_headroom_frac"] = min(headrooms)
+        # fleet-wide chip-seconds/request: request-count-weighted merge of
+        # the replicas' percentiles (worst replica for p99 — the same
+        # approximate merge every other cross-window percentile uses)
+        per_req = [
+            (r["cost"]["chip_seconds_per_request"], r["cost"].get("requests") or 1)
+            for r in rows
+            if r.get("cost", {}).get("chip_seconds_per_request")
+        ]
+        if per_req:
+            total_w = sum(w for _, w in per_req)
+            rollup["chip_seconds_per_request"] = {
+                key: round(
+                    sum(s[key] * w for s, w in per_req) / total_w, 9
+                )
+                for key in ("mean", "p50", "p90")
+            }
+            rollup["chip_seconds_per_request"]["p99_worst_replica"] = round(
+                max(
+                    s.get("p99_worst_window", s.get("p99", 0.0))
+                    for s, _ in per_req
+                ),
+                9,
+            )
+        section["capacity"] = rollup
     straggler = straggler_section(ledgers, skew_threshold=skew_threshold)
     if straggler:
         section["straggler"] = straggler
@@ -365,9 +444,37 @@ def render_fleet_section(section: Dict) -> List[str]:
             parts.append(
                 f"serve{replica}: {sv['completed']}/{sv['requests']} ok"
             )
+        if row.get("cost", {}).get("rps_per_chip") is not None:
+            parts.append(f"{row['cost']['rps_per_chip']:.1f} rps/chip")
+        if row.get("memory", {}).get("headroom_frac") is not None:
+            parts.append(
+                f"headroom {row['memory']['headroom_frac']:.1%}"
+            )
         if row.get("parse_errors"):
             parts.append(f"!! {row['parse_errors']} parse error(s)")
         lines.append("  ".join(parts))
+    cap = section.get("capacity")
+    if cap:
+        parts = []
+        if cap.get("chip_seconds_total") is not None:
+            parts.append(f"{cap['chip_seconds_total']:.1f} chip-seconds total")
+        if cap.get("rps_per_chip_total") is not None:
+            parts.append(
+                f"{cap['rps_per_chip_total']:.1f} rps/chip fleet-wide"
+            )
+        if cap.get("min_headroom_frac") is not None:
+            parts.append(
+                f"min HBM headroom {cap['min_headroom_frac']:.1%}"
+            )
+        lines.append("  capacity: " + ", ".join(parts))
+        pr = cap.get("chip_seconds_per_request")
+        if pr:
+            lines.append(
+                "    chip-ms/request: "
+                f"mean {pr['mean'] * 1000:.3f}  p50 {pr['p50'] * 1000:.3f}  "
+                f"p90 {pr['p90'] * 1000:.3f}  "
+                f"p99(worst replica) {pr['p99_worst_replica'] * 1000:.3f}"
+            )
     st = section.get("straggler")
     if st:
         lines.append(

@@ -179,6 +179,85 @@ class StepTimeRegressionDetector:
         return None
 
 
+class HeadroomMonitor:
+    """HBM headroom: is this process about to OOM?
+
+    Consumes the watermark stream (obs/capacity.py — ``memory_watermark``
+    events carry ``peak_bytes``/``bytes_limit``) and alerts on the
+    ok→degraded transition when either:
+
+    - headroom drops below ``min_headroom_frac`` of the device limit (the
+      absolute floor: past it any allocation spike — a bigger eval batch, a
+      fresh compile's workspace — is an OOM); or
+    - the watermark TREND projects the limit will be crossed within
+      ``horizon_samples`` more watermark samples (the leak/fragmentation
+      case: plenty of headroom today, none next week).
+
+    Recovery (headroom restored — e.g. a resize or cache drop) writes a
+    ``resolved`` alert, same transition discipline as the step-time monitor.
+    Backends with no allocator query never feed this monitor, so it stays
+    healthy on CPU builds by construction. ``degraded`` is the live state a
+    ``/healthz`` endpoint folds in."""
+
+    def __init__(
+        self,
+        min_headroom_frac: float = 0.05,
+        horizon_samples: int = 50,
+    ):
+        if not 0.0 < min_headroom_frac < 1.0:
+            raise ValueError(
+                f"min_headroom_frac must be in (0, 1), got {min_headroom_frac}"
+            )
+        self.min_headroom_frac = float(min_headroom_frac)
+        self.horizon_samples = max(1, int(horizon_samples))
+        self.degraded = False
+        self.last: Optional[Dict] = None
+
+    def check(
+        self,
+        step: Optional[int],
+        peak_bytes: int,
+        bytes_limit: Optional[int],
+        samples_to_limit: Optional[int] = None,
+    ) -> Optional[Dict]:
+        if not bytes_limit or peak_bytes <= 0:
+            return None  # no limit reported = nothing to budget against
+        headroom = max(0.0, 1.0 - peak_bytes / bytes_limit)
+        low = headroom < self.min_headroom_frac
+        trending_out = (
+            samples_to_limit is not None
+            and samples_to_limit <= self.horizon_samples
+        )
+        self.last = {
+            "headroom_frac": round(headroom, 4),
+            "peak_bytes": int(peak_bytes),
+            "bytes_limit": int(bytes_limit),
+        }
+        at_risk = low or trending_out
+        fields = {
+            "monitor": "hbm_headroom",
+            "severity": "critical" if low else "warn",
+            "headroom_frac": round(headroom, 4),
+            "min_headroom_frac": self.min_headroom_frac,
+            "peak_bytes": int(peak_bytes),
+            "bytes_limit": int(bytes_limit),
+        }
+        if step is not None:
+            fields["step"] = step
+        if samples_to_limit is not None:
+            fields["samples_to_limit"] = int(samples_to_limit)
+        if at_risk and not self.degraded:
+            self.degraded = True
+            fields["reason"] = "low_headroom" if low else "trend"
+            return fields
+        if not at_risk and self.degraded:
+            self.degraded = False
+            fields["severity"] = "warn"
+            fields["resolved"] = True
+            return fields
+        return None
+
+
 @dataclasses.dataclass
 class SloWindow:
     """One evaluation window's SLO accounting (returned by ``evaluate``)."""
@@ -312,12 +391,16 @@ class HealthMonitor:
         nan_action: str = "warn",
         spike: Optional[LossSpikeDetector] = None,
         step_time: Optional[StepTimeRegressionDetector] = None,
+        headroom: Optional[HeadroomMonitor] = None,
     ):
         self.nan_guard = NanGuard(nan_action)
         self.spike = spike if spike is not None else LossSpikeDetector()
         self.step_time = (
             step_time if step_time is not None else StepTimeRegressionDetector()
         )
+        # HBM headroom/OOM-risk (fed by Telemetry.sample_watermark — never
+        # fires on backends without the allocator query)
+        self.headroom = headroom if headroom is not None else HeadroomMonitor()
         self.alerts: List[Dict] = []
 
     @classmethod
@@ -329,7 +412,8 @@ class HealthMonitor:
 
     @property
     def status(self) -> str:
-        return "degraded" if self.step_time.degraded else "ok"
+        degraded = self.step_time.degraded or self.headroom.degraded
+        return "degraded" if degraded else "ok"
 
     def reset(self) -> None:
         """Start a fresh training phase: drop the rolling loss history and
@@ -348,6 +432,23 @@ class HealthMonitor:
             baseline_windows=self.step_time.baseline_windows,
             factor=self.step_time.factor,
         )
+
+    def observe_memory(
+        self, telemetry, step: Optional[int], watermark: Dict
+    ) -> Optional[Dict]:
+        """Run the headroom monitor against one ``memory_watermark`` sample
+        (Telemetry.sample_watermark calls this); the alert — if any — is
+        ledgered through ``telemetry`` like every other monitor's."""
+        alert = self.headroom.check(
+            step,
+            watermark.get("peak_bytes", 0),
+            watermark.get("bytes_limit"),
+            samples_to_limit=watermark.get("samples_to_limit"),
+        )
+        if alert:
+            self.alerts.append(alert)
+            telemetry.event(HEALTH_ALERT_EVENT, **alert)
+        return alert
 
     def observe_window(
         self, telemetry, step: int, scalars: Dict, fields: Dict

@@ -14,18 +14,83 @@ and every subsequent ``event()`` becomes a no-op.
 
 from __future__ import annotations
 
+import atexit
 import io
 import json
 import logging
 import os
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
 LEDGER_FILENAME = "telemetry.jsonl"
 SCHEMA_VERSION = 1
+
+# every open ledger, so the exit hooks can flush ALL of them: the buffered
+# high-rate path (event_buffered — per-span traces, multiple per train step)
+# holds lines in the stdio buffer between flushed events, and a process that
+# dies between flushes used to lose that tail — exactly the final window the
+# fleet kill/drain drills need to reconstruct what happened
+_LIVE_LEDGERS: "weakref.WeakSet[RunLedger]" = weakref.WeakSet()
+_EXIT_HOOKS_INSTALLED = False
+
+
+def flush_all_ledgers(blocking: bool = True) -> None:
+    """Flush every open ledger's buffered lines to disk. Signal/atexit-safe:
+    per-ledger failures are swallowed (each flush already degrades
+    gracefully), and a torn set during interpreter teardown is tolerated.
+    ``blocking=False`` is the SIGNAL-HANDLER mode: the handler runs ON the
+    main thread, so if it interrupted ``_write()`` mid-line the write lock is
+    held by the very thread now asking for it — a blocking acquire would
+    deadlock the exit; skipping that one ledger is the only safe choice."""
+    try:
+        ledgers = list(_LIVE_LEDGERS)
+    except Exception:  # noqa: BLE001 — teardown-order hazards
+        return
+    for ledger in ledgers:
+        try:
+            ledger.flush(blocking=blocking)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _sigterm_flush(signum, frame):  # pragma: no cover — exercised in a child
+    flush_all_ledgers(blocking=False)
+    # restore the default action and re-raise so the exit code stays the
+    # conventional 128+SIGTERM a supervisor keys restart decisions on
+    import signal as signal_lib
+
+    signal_lib.signal(signum, signal_lib.SIG_DFL)
+    os.kill(os.getpid(), signum)
+
+
+def _install_exit_hooks() -> None:
+    """Once per process, at first ledger open: an atexit flush (covers normal
+    interpreter exits that skip ``close()``), plus a SIGTERM flusher when —
+    and only when — nothing else handles SIGTERM yet. Producers with their
+    own SIGTERM story (the trainers' preemption handler, the serving tier's
+    graceful drain) keep it: their paths flush through ``Telemetry.close``,
+    and installing over them would break the preempt/drain contracts."""
+    global _EXIT_HOOKS_INSTALLED
+    if _EXIT_HOOKS_INSTALLED:
+        return
+    _EXIT_HOOKS_INSTALLED = True
+    atexit.register(flush_all_ledgers)
+    try:
+        import signal as signal_lib
+
+        if (
+            threading.current_thread() is threading.main_thread()
+            and signal_lib.getsignal(signal_lib.SIGTERM)
+            == signal_lib.SIG_DFL
+        ):
+            signal_lib.signal(signal_lib.SIGTERM, _sigterm_flush)
+    except (ValueError, OSError, RuntimeError):
+        # non-main thread / exotic embedding: atexit still covers clean exits
+        pass
 
 
 def per_process_filename(process_index: int) -> str:
@@ -53,6 +118,8 @@ class RunLedger:
         try:
             os.makedirs(workdir, exist_ok=True)
             self._f = open(self.path, "a", encoding="utf-8")
+            _LIVE_LEDGERS.add(self)
+            _install_exit_hooks()
         except OSError as e:
             logger.warning(
                 "telemetry ledger disabled: cannot open %s (%s) — training "
@@ -77,9 +144,10 @@ class RunLedger:
         (per-span ``trace`` events can fire multiple times per train step)
         where a syscall per line measurably steals CPU from compute. Buffered
         lines reach disk when the stdio buffer fills, at the next flushed
-        ``event()`` (same file object), on ``flush()``, or at ``close()`` —
-        a crash can lose only the tail of *sampled traces*, never the
-        windows/alerts the flushed path carries."""
+        ``event()`` (same file object), on ``flush()``, at ``close()``, or
+        via the process-exit hooks (atexit + default-SIGTERM flush,
+        ``flush_all_ledgers``) — only a hard kill (SIGKILL, OOM) can lose the
+        buffered tail, never a drain or a normal exit."""
         self._write(kind, fields, flush=False)
 
     def _write(self, kind: str, fields: Dict, flush: bool) -> None:
@@ -102,15 +170,33 @@ class RunLedger:
             )
             self._f = None
 
-    def flush(self) -> None:
+    # signal-handler flush wait: long enough for a writer THREAD mid-_write
+    # to finish its line (microseconds normally), short enough that the
+    # self-deadlock case (the handler interrupted the MAIN thread inside
+    # _write, so the lock can never be released) stays a bounded stall
+    _SIGNAL_FLUSH_TIMEOUT_S = 0.25
+
+    def flush(self, blocking: bool = True) -> None:
         """Push any buffered events to disk (readers of a LIVE ledger — tests,
-        a tailing operator — call this through ``Telemetry.flush``)."""
-        with self._lock:
+        a tailing operator — call this through ``Telemetry.flush``).
+        ``blocking=False`` (the signal-handler path, ``flush_all_ledgers``)
+        bounds the lock wait instead of blocking forever: if a background
+        writer holds the lock it releases within microseconds and the flush
+        proceeds; if the handler interrupted THIS thread mid-``_write`` the
+        lock can never be released, and only the timeout averts a deadlock
+        (that one ledger's tail is the price of a clean exit)."""
+        if blocking:
+            self._lock.acquire()
+        elif not self._lock.acquire(timeout=self._SIGNAL_FLUSH_TIMEOUT_S):
+            return
+        try:
             if self._f is not None:
                 try:
                     self._f.flush()
                 except OSError:
                     pass
+        finally:
+            self._lock.release()
 
     def close(self) -> None:
         with self._lock:
@@ -120,6 +206,7 @@ class RunLedger:
                 except OSError:
                     pass
                 self._f = None
+        _LIVE_LEDGERS.discard(self)
 
 
 def _jsonable(obj):

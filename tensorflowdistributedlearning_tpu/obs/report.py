@@ -19,6 +19,7 @@ import dataclasses
 import os
 from typing import Dict, List, Optional
 
+from tensorflowdistributedlearning_tpu.obs import capacity as capacity_lib
 from tensorflowdistributedlearning_tpu.obs import fleet as fleet_lib
 
 
@@ -542,6 +543,21 @@ def build_report(
             mem["weight_update_sharding"] = wus[-1]
         report["memory"] = mem
 
+    # capacity layer (obs/capacity.py): per-phase peak-HBM watermarks with
+    # the measured-vs-predicted bytes/chip delta, and chip-seconds cost.
+    # Stable --json keys: memory.watermarks.{events,peak_bytes,phases,
+    # bytes_limit,headroom_frac,predicted_bytes_per_device,
+    # measured_minus_predicted_bytes} and cost.{events,train,serve} (train:
+    # n_chips/chip_seconds_total/chip_seconds_per_step/
+    # examples_per_chip_second; serve: n_chips/chip_seconds_total/requests/
+    # rps_per_chip/duty_cycle/chip_seconds_per_request).
+    watermarks = capacity_lib.aggregate_watermark_events(events)
+    if watermarks:
+        report.setdefault("memory", {})["watermarks"] = watermarks
+    cost = capacity_lib.aggregate_cost_events(events)
+    if cost:
+        report["cost"] = cost
+
     try:
         report["trace"] = _trace_section(trace_dir or workdir, top)
     except (FileNotFoundError, ValueError, OSError):
@@ -712,7 +728,9 @@ def render_report(report: Dict) -> str:
         )
     mem = report.get("memory")
     if mem:
-        parts = [f"{mem['snapshots']} snapshot(s)"]
+        parts = []
+        if "snapshots" in mem:
+            parts.append(f"{mem['snapshots']} snapshot(s)")
         if "device_peak_bytes" in mem:
             parts.append(f"device peak {mem['device_peak_bytes'] / 2**20:.1f} MiB")
         if "host_rss_peak_bytes" in mem:
@@ -723,7 +741,71 @@ def render_report(report: Dict) -> str:
                 f"opt state {mem['opt_state_bytes_per_device'] / 2**20:.1f} "
                 f"MiB/device{tag}"
             )
-        lines.append("memory: " + ", ".join(parts))
+        if parts:
+            lines.append("memory: " + ", ".join(parts))
+        wm = mem.get("watermarks")
+        if wm:
+            line = f"HBM watermarks: peak {wm['peak_bytes'] / 2**20:.1f} MiB"
+            if wm.get("bytes_limit"):
+                line += (
+                    f" of {wm['bytes_limit'] / 2**20:.1f} MiB limit "
+                    f"({wm.get('headroom_frac', 0):.1%} headroom)"
+                )
+            lines.append(line)
+            for phase, row in sorted(wm["phases"].items()):
+                at = (
+                    f" @ step {row['step']}"
+                    if row.get("step") is not None
+                    else ""
+                )
+                lines.append(
+                    f"  {phase:<8} {row['peak_bytes'] / 2**20:>9.1f} MiB{at}"
+                )
+            if wm.get("predicted_bytes_per_device") is not None:
+                delta = wm.get("measured_minus_predicted_bytes", 0)
+                lines.append(
+                    f"  measured vs predicted bytes/chip: "
+                    f"{wm['predicted_bytes_per_device'] / 2**20:.1f} MiB "
+                    f"predicted (params+opt state), "
+                    f"{delta / 2**20:+.1f} MiB residual "
+                    "(activations/workspace the planner must margin for)"
+                )
+    cost = report.get("cost")
+    if cost:
+        ct = cost.get("train")
+        if ct:
+            line = (
+                f"cost (train): {ct['chip_seconds_total']:.1f} chip-seconds "
+                f"on {ct.get('n_chips', '?')} chip(s)"
+            )
+            if ct.get("chip_seconds_per_step") is not None:
+                line += f", {ct['chip_seconds_per_step'] * 1000:.2f} chip-ms/step"
+            if ct.get("examples_per_chip_second") is not None:
+                line += (
+                    f", {ct['examples_per_chip_second']:.1f} "
+                    "examples/chip-second"
+                )
+            lines.append(line)
+        cs = cost.get("serve")
+        if cs:
+            line = (
+                f"cost (serve): {cs['chip_seconds_total']:.1f} chip-seconds "
+                f"on {cs.get('n_chips', '?')} chip(s)"
+            )
+            if cs.get("rps_per_chip") is not None:
+                line += f", {cs['rps_per_chip']:.1f} requests/sec/chip"
+            if cs.get("duty_cycle") is not None:
+                line += f", duty cycle {cs['duty_cycle']:.1%}"
+            lines.append(line)
+            pr = cs.get("chip_seconds_per_request")
+            if pr:
+                lines.append(
+                    "  chip-ms/request: "
+                    f"mean {pr['mean'] * 1000:.3f}  "
+                    f"p50 {pr['p50'] * 1000:.3f}  "
+                    f"p90 {pr['p90'] * 1000:.3f}  "
+                    f"p99(worst window) {pr['p99_worst_window'] * 1000:.3f}"
+                )
     sv = report.get("serve")
     if sv:
         dtype_tag = (

@@ -31,6 +31,7 @@ import logging
 import time
 from typing import Dict, List, Optional
 
+from tensorflowdistributedlearning_tpu.obs import capacity as capacity_lib
 from tensorflowdistributedlearning_tpu.obs import trace as trace_lib
 from tensorflowdistributedlearning_tpu.obs.ledger import RunLedger
 from tensorflowdistributedlearning_tpu.obs.metrics import (
@@ -100,8 +101,17 @@ class Telemetry:
         health=None,
         process_index: Optional[int] = None,
         process_count: Optional[int] = None,
+        capacity_sampling: bool = True,
     ):
         self.enabled = enabled and workdir is not None
+        # capacity/cost layer (obs/capacity.py): per-phase HBM watermarks and
+        # chip-seconds accounting, sampled on the WINDOW cadence (never per
+        # step — the <=1% overhead gate, bench.py --capacity-overhead).
+        # Constructed unconditionally (cheap, no backend touch) so callers
+        # never branch on None; only an enabled Telemetry emits events.
+        self.capacity_sampling = bool(capacity_sampling)
+        self.watermarks = capacity_lib.WatermarkTracker()
+        self.cost = capacity_lib.CostMeter()
         self.registry = MetricsRegistry()
         self._span_stack: List[str] = []
         self._windows = 0
@@ -282,6 +292,7 @@ class Telemetry:
         scalars: Optional[Dict[str, float]] = None,
         dirty: bool = False,
         samples: Optional[Dict[str, List[float]]] = None,
+        examples: Optional[int] = None,
         **extra,
     ) -> None:
         """One per-log-window record: throughput, data-wait vs step-compute
@@ -341,6 +352,15 @@ class Telemetry:
         if self.detector is not None:
             fields["recompiles_post_warmup"] = self.detector.post_warmup_count
         self._event("step_window", **fields)
+        if self.capacity_sampling:
+            # chip-seconds attribution for the window (obs/capacity.py):
+            # compute_s is device-busy wall time on every chip (SPMD), so the
+            # cost event rides the same cadence as the window itself
+            cost_fields = self.cost.train_window(
+                compute_s, steps, examples=examples, step=step
+            )
+            if cost_fields:
+                self._event(capacity_lib.COST_EVENT, **cost_fields)
         self._windows += 1
         if self._windows % self._memory_every_windows == 0:
             self.memory_event(step=step)
@@ -359,9 +379,13 @@ class Telemetry:
             metrics={k: float(v) for k, v in metrics.items()},
             **extra,
         )
+        # eval just ran: if the pass pushed the allocator's peak past the
+        # train watermark, the eval phase owns the new high-water mark
+        self.sample_watermark(capacity_lib.PHASE_EVAL, step=step)
 
     def checkpoint_event(self, step: int, **extra) -> None:
         self._event("checkpoint", step=step, **extra)
+        self.sample_watermark(capacity_lib.PHASE_CKPT, step=step)
 
     def memory_event(self, step: Optional[int] = None, **extra) -> None:
         """Per-device HBM snapshot (``profiling.memory_stats``) plus host RSS —
@@ -389,6 +413,56 @@ class Telemetry:
         if step is not None:
             fields["step"] = step
         self._event("memory", **fields)
+        # capacity layer (obs/capacity.py): the trainers' exact
+        # tree_bytes_per_device accounting becomes the watermark tracker's
+        # prediction, and every memory snapshot doubles as a watermark sample
+        # attributed to the phase that was running
+        predicted = (extra.get("params_bytes_per_device") or 0) + (
+            extra.get("opt_state_bytes_per_device") or 0
+        )
+        if predicted:
+            self.watermarks.set_predicted(predicted)
+        # reuse the snapshot already in hand: one allocator query per window
+        self.sample_watermark(self._memory_phase(), step=step, stats=devices)
+
+    def _memory_phase(self) -> str:
+        """Which lifecycle phase owns a watermark sampled NOW: the active
+        eval/checkpoint span wins; otherwise "step" once the train step is
+        warm, "compile" before that (the first windows' peaks are the
+        compiler's workspace, not steady state)."""
+        span = self.current_span
+        if span == SPAN_EVAL:
+            return capacity_lib.PHASE_EVAL
+        if span == SPAN_CHECKPOINT:
+            return capacity_lib.PHASE_CKPT
+        if self.detector is not None and self.detector.is_warm(SPAN_STEP):
+            return capacity_lib.PHASE_STEP
+        return capacity_lib.PHASE_COMPILE
+
+    def sample_watermark(
+        self,
+        phase: str,
+        step: Optional[int] = None,
+        stats: Optional[Dict] = None,
+    ) -> Optional[Dict]:
+        """Query the allocator once (or reuse the caller's ``stats``
+        snapshot), attributed to ``phase``; ledger a ``memory_watermark``
+        event when the peak advanced and feed the headroom health monitor.
+        The monitor runs on EVERY sample — not only peak advances — so a
+        trend-triggered degraded state can resolve once the peak plateaus.
+        No-op (None) when telemetry or capacity sampling is off, and on
+        backends without the allocator query."""
+        if not (self.enabled and self.capacity_sampling):
+            return None
+        fields = self.watermarks.sample(phase, step=step, stats=stats)
+        if fields:
+            self._event(capacity_lib.WATERMARK_EVENT, **fields)
+        observe = getattr(self.health, "observe_memory", None)
+        if observe is not None:
+            headroom = self.watermarks.headroom()
+            if headroom and headroom.get("bytes_limit"):
+                observe(self, step, headroom)
+        return fields
 
     def mark_warm(self, *phases: str) -> None:
         """Steady state reached for ``phases`` (none = all): compiles

@@ -1,0 +1,354 @@
+"""``telemetry-top``: the live fleet console over the merged run ledgers.
+
+``telemetry-report`` is the post-hoc story; an operator babysitting a live
+run (or a serving fleet mid-incident) needs the NOW view: is the fleet making
+progress, where is the backlog, which host is the straggler, how close is HBM
+to the limit, what is a request costing. This module tails the same
+per-process ledgers the report merges (``obs/fleet.py`` discovery — the
+canonical ``telemetry.jsonl`` plus every ``telemetry-{i}.jsonl``) and renders
+one compact refreshing frame:
+
+    python -m tensorflowdistributedlearning_tpu telemetry-top WORKDIR
+    python -m tensorflowdistributedlearning_tpu telemetry-top WORKDIR --once
+
+Per process: goodput split and step time (training), requests/backlog/p99
+(serving), HBM headroom and cost rates (obs/capacity.py events), health and
+straggler flags. ``--once`` prints a single frame and exits 0 — the scripting
+/ CI-smoke mode. Reading is report-side only (no cost on the producers), and
+every degraded shape is a frame, not a crash: an empty workdir renders "no
+ledgers yet", a serving-only workdir has no training rows, a training-only
+workdir no serving rows.
+
+Cost note: each REBUILD re-parses the ledgers in full (the discovery/merge
+machinery is shared with ``telemetry-report``, which has no incremental
+mode); the refresh loop therefore stats the files first and reuses the
+previous frame when nothing changed, so an idle fleet costs one stat sweep
+per interval. A very large ledger (a long run with high-rate sampled traces)
+still pays a full parse per CHANGE — prefer a longer ``--interval`` there.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tensorflowdistributedlearning_tpu.obs import capacity as capacity_lib
+from tensorflowdistributedlearning_tpu.obs import fleet as fleet_lib
+
+# ANSI: clear screen + home; plain strings so tests can strip them trivially
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+def _last(events: List[Dict], kind: str) -> Optional[Dict]:
+    for e in reversed(events):
+        if e.get("event") == kind:
+            return e
+    return None
+
+
+def _fmt_bytes(n: float) -> str:
+    if n >= 2**30:
+        return f"{n / 2**30:.2f}GiB"
+    return f"{n / 2**20:.1f}MiB"
+
+
+def _fmt_age(seconds: float) -> str:
+    if seconds < 120:
+        return f"{seconds:.0f}s"
+    if seconds < 7200:
+        return f"{seconds / 60:.0f}m"
+    return f"{seconds / 3600:.1f}h"
+
+
+def _process_status(led: fleet_lib.ProcessLedger, now: float) -> Dict:
+    """One frame row from one process ledger's last run."""
+    events = led.events
+    header = led.header
+    row: Dict = {
+        "process_index": led.process_index,
+        "kind": header.get("kind") or header.get("task") or "unknown",
+        "parse_errors": led.parse_errors,
+    }
+    if events:
+        row["last_event_age_s"] = max(0.0, now - events[-1].get("t", now))
+    run_end = _last(events, "run_end")
+    row["live"] = run_end is None
+    window = _last(events, "step_window")
+    if window is not None:
+        row["step"] = window.get("step")
+        st = window.get("step_time_ms") or {}
+        if st.get("mean_ms") is not None:
+            row["step_time_mean_ms"] = st["mean_ms"]
+        busy = sum(
+            window.get(k, 0.0)
+            for k in (
+                "data_wait_s",
+                "compute_s",
+                "fetch_wait_s",
+                "barrier_wait_s",
+            )
+        )
+        if busy:
+            row["goodput"] = {
+                "compute_frac": round(window.get("compute_s", 0.0) / busy, 3),
+                "data_wait_frac": round(
+                    window.get("data_wait_s", 0.0) / busy, 3
+                ),
+            }
+        if window.get("images_per_sec") is not None:
+            row["images_per_sec"] = window["images_per_sec"]
+        if window.get("recompiles_post_warmup"):
+            row["recompiles_post_warmup"] = window["recompiles_post_warmup"]
+    serve = _last(events, "serve_window")
+    if serve is not None:
+        srow: Dict = {
+            "requests": serve.get("requests", 0),
+            "completed": serve.get("completed", 0),
+            "backlog": serve.get("queue_depth", 0),
+        }
+        if serve.get("replica") is not None:
+            srow["replica"] = serve["replica"]
+        req = (serve.get("latency_ms") or {}).get("request") or {}
+        if req.get("p99_ms") is not None:
+            srow["p99_ms"] = req["p99_ms"]
+        slo = serve.get("slo")
+        if slo is not None:
+            srow["slo_healthy"] = bool(slo.get("healthy", True))
+        row["serve"] = srow
+    router = _last(events, "router_window")
+    if router is not None:
+        fleet_state = router.get("fleet") or {}
+        row["router"] = {
+            "requests": router.get("requests", 0),
+            "shed": router.get("shed", 0),
+            "backlog": fleet_state.get("queue_depth_total", 0),
+            "live": fleet_state.get("live", 0),
+            "status": fleet_state.get("status", "?"),
+        }
+    marks = capacity_lib.aggregate_watermark_events(events)
+    if marks:
+        mem: Dict = {"peak_bytes": marks["peak_bytes"]}
+        if marks.get("headroom_frac") is not None:
+            mem["headroom_frac"] = marks["headroom_frac"]
+        row["memory"] = mem
+    cost = capacity_lib.aggregate_cost_events(events)
+    if cost:
+        crow: Dict = {}
+        train = cost.get("train") or {}
+        if train.get("chip_seconds_per_step") is not None:
+            crow["chip_seconds_per_step"] = train["chip_seconds_per_step"]
+        if train.get("examples_per_chip_second") is not None:
+            crow["examples_per_chip_second"] = train[
+                "examples_per_chip_second"
+            ]
+        serve_cost = cost.get("serve") or {}
+        if serve_cost.get("rps_per_chip") is not None:
+            crow["rps_per_chip"] = serve_cost["rps_per_chip"]
+        if serve_cost.get("chip_seconds_total") is not None:
+            crow["chip_seconds_total"] = serve_cost["chip_seconds_total"]
+        elif train.get("chip_seconds_total") is not None:
+            crow["chip_seconds_total"] = train["chip_seconds_total"]
+        if crow:
+            row["cost"] = crow
+    alerts = [e for e in events if e.get("event") == "health_alert"]
+    if alerts:
+        active: Dict[str, bool] = {}
+        for a in alerts:
+            active[a.get("monitor", "unknown")] = not a.get("resolved")
+        degraded = sorted(m for m, live in active.items() if live)
+        row["health"] = {"alerts": len(alerts), "degraded": degraded}
+    return row
+
+
+def build_frame(workdir: str, *, now: Optional[float] = None) -> Dict:
+    """One console frame as data (the ``--once``/test contract; rendering is
+    presentation only). Never raises on empty/foreign workdirs — a frame with
+    ``processes == 0`` means nothing is writing ledgers yet."""
+    now = now if now is not None else time.time()
+    try:
+        ledgers = fleet_lib.discover_ledgers(workdir)
+    except OSError:
+        ledgers = []
+    frame: Dict = {
+        "workdir": workdir,
+        "t": now,
+        "processes": len(ledgers),
+        "rows": [_process_status(led, now) for led in ledgers],
+    }
+    if len(ledgers) >= 2:
+        straggler = fleet_lib.straggler_section(ledgers)
+        if straggler:
+            frame["straggler"] = {
+                "max_skew": straggler["max_skew"],
+                "alert_count": straggler["alert_count"],
+                "worst_process": straggler["worst_process"],
+            }
+    return frame
+
+
+def render_frame(frame: Dict) -> str:
+    lines: List[str] = [
+        f"telemetry-top — {frame['workdir']} — "
+        f"{time.strftime('%H:%M:%S', time.localtime(frame['t']))}"
+    ]
+    if not frame["processes"]:
+        lines.append(
+            "  no ledgers yet (telemetry.jsonl / telemetry-N.jsonl absent) — "
+            "is the run pointed at this workdir?"
+        )
+        return "\n".join(lines)
+    for row in frame["rows"]:
+        state = "live" if row.get("live") else "ended"
+        age = row.get("last_event_age_s")
+        if age is not None:
+            state += f", last event {_fmt_age(age)} ago"
+        lines.append(f"p{row['process_index']} [{row['kind']}] ({state})")
+        if "step" in row:
+            bits = [f"  step {row['step']}"]
+            if row.get("step_time_mean_ms") is not None:
+                bits.append(f"{row['step_time_mean_ms']:.1f}ms/step")
+            gp = row.get("goodput")
+            if gp:
+                bits.append(
+                    f"compute {gp['compute_frac']:.0%} / "
+                    f"data-wait {gp['data_wait_frac']:.0%}"
+                )
+            if row.get("images_per_sec") is not None:
+                bits.append(f"{row['images_per_sec']:.1f} img/s")
+            lines.append("  ".join(bits))
+        sv = row.get("serve")
+        if sv:
+            bits = [
+                f"  serve"
+                + (f" r{sv['replica']}" if "replica" in sv else "")
+                + f": {sv['completed']}/{sv['requests']} ok",
+                f"backlog {sv['backlog']}",
+            ]
+            if sv.get("p99_ms") is not None:
+                bits.append(f"p99 {sv['p99_ms']:.1f}ms")
+            if sv.get("slo_healthy") is False:
+                bits.append("!! SLO BREACHED")
+            lines.append("  ".join(bits))
+        rt = row.get("router")
+        if rt:
+            lines.append(
+                f"  router: {rt['requests']} req, {rt['shed']} shed, "
+                f"backlog {rt['backlog']}, {rt['live']} live "
+                f"[{rt['status']}]"
+            )
+        mem = row.get("memory")
+        if mem:
+            line = f"  hbm peak {_fmt_bytes(mem['peak_bytes'])}"
+            if mem.get("headroom_frac") is not None:
+                line += f", headroom {mem['headroom_frac']:.1%}"
+                if mem["headroom_frac"] < 0.1:
+                    line += "  !! LOW"
+            lines.append(line)
+        cost = row.get("cost")
+        if cost:
+            bits = ["  cost:"]
+            if cost.get("chip_seconds_per_step") is not None:
+                bits.append(
+                    f"{cost['chip_seconds_per_step'] * 1000:.2f} chip-ms/step"
+                )
+            if cost.get("examples_per_chip_second") is not None:
+                bits.append(
+                    f"{cost['examples_per_chip_second']:.1f} ex/chip-s"
+                )
+            if cost.get("rps_per_chip") is not None:
+                bits.append(f"{cost['rps_per_chip']:.1f} rps/chip")
+            if cost.get("chip_seconds_total") is not None:
+                bits.append(
+                    f"{cost['chip_seconds_total']:.1f} chip-s total"
+                )
+            lines.append("  ".join(bits))
+        hl = row.get("health")
+        if hl:
+            if hl["degraded"]:
+                lines.append(
+                    f"  !! health degraded: {', '.join(hl['degraded'])} "
+                    f"({hl['alerts']} alert(s))"
+                )
+            else:
+                lines.append(
+                    f"  health: {hl['alerts']} alert(s), all resolved"
+                )
+        if row.get("recompiles_post_warmup"):
+            lines.append(
+                f"  !! {row['recompiles_post_warmup']} post-warmup "
+                "recompile(s)"
+            )
+        if row.get("parse_errors"):
+            lines.append(
+                f"  !! {row['parse_errors']} unparseable ledger line(s)"
+            )
+    st = frame.get("straggler")
+    if st:
+        flag = (
+            f" — !! {st['alert_count']} alert(s), worst p{st['worst_process']}"
+            if st["alert_count"]
+            else ""
+        )
+        lines.append(f"straggler skew: {st['max_skew']:.2f}x{flag}")
+    return "\n".join(lines)
+
+
+def _ledger_signature(workdir: str) -> Tuple:
+    """(path, size, mtime) of every ledger file — the cheap change detector
+    the refresh loop uses to skip full re-parses of an unchanged fleet."""
+    sig = []
+    for path in sorted(glob.glob(os.path.join(workdir, "telemetry*.jsonl"))):
+        try:
+            st = os.stat(path)
+            sig.append((path, st.st_size, st.st_mtime_ns))
+        except OSError:
+            continue
+    return tuple(sig)
+
+
+def top(
+    workdir: str,
+    *,
+    interval_s: float = 2.0,
+    once: bool = False,
+    iterations: Optional[int] = None,
+    out=None,
+) -> int:
+    """The ``telemetry-top`` loop: render a frame every ``interval_s``
+    seconds until interrupted. ``once`` prints a single frame (scripting /
+    CI smoke); ``iterations`` bounds the loop for tests. Exit code 0 always —
+    an empty workdir is an honest frame, not an error (a run that has not
+    started yet is the normal first thing an operator watches)."""
+    out = out if out is not None else sys.stdout
+    count = 0
+    last_sig: Optional[Tuple] = None
+    frame: Dict = {}
+    try:
+        while True:
+            sig = _ledger_signature(workdir)
+            if frame and sig == last_sig:
+                # nothing wrote since the last frame: refresh the clock and
+                # ages only — an idle fleet costs one stat sweep per interval
+                now = time.time()
+                elapsed = now - frame["t"]
+                frame["t"] = now
+                for row in frame["rows"]:
+                    if "last_event_age_s" in row:
+                        row["last_event_age_s"] += elapsed
+            else:
+                frame = build_frame(workdir)
+                last_sig = sig
+            text = render_frame(frame)
+            if once or iterations is not None:
+                print(text, file=out, flush=True)
+            else:
+                print(_CLEAR + text, file=out, flush=True)
+            count += 1
+            if once or (iterations is not None and count >= iterations):
+                return 0
+            time.sleep(interval_s)
+    except KeyboardInterrupt:
+        return 0

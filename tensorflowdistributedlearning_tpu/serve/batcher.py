@@ -148,6 +148,10 @@ class MicroBatcher:
             raise ValueError("max_queue must be >= 1")
         self.default_deadline_ms = default_deadline_ms
         self.registry = engine.registry
+        # chip-seconds attribution (obs/capacity.py): when a CostMeter is
+        # attached (ServingServer does), every dispatched batch's engine time
+        # is split across its member requests by batch-share
+        self.cost_meter = None
         self._queue: Deque[Request] = collections.deque()
         self._cond = threading.Condition()
         self._closed = False
@@ -310,6 +314,7 @@ class MicroBatcher:
                     duration_s=now - req.enqueued_t,
                     sampled=req.trace.sampled,
                 )
+        infer_t0 = time.perf_counter()
         try:
             if traced:
                 with tracer.span(
@@ -328,6 +333,10 @@ class MicroBatcher:
             for req in batch:
                 req._finish(error=e)
             return
+        if self.cost_meter is not None:
+            self.cost_meter.add_batch(
+                time.perf_counter() - infer_t0, [r.n for r in batch]
+            )
         if batch_span is not None:
             self._emit_member_spans(tracer, traced, batch_span)
         offset = 0

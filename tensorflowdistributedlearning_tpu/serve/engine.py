@@ -245,6 +245,16 @@ class InferenceEngine:
                 **warm_fields,
             )
             telemetry.mark_warm()
+            # bucket compilation is the serving tier's peak-HBM moment on
+            # most artifacts — ledger it as the compile-phase watermark
+            # before request traffic attributes anything to "infer"
+            sample = getattr(telemetry, "sample_watermark", None)
+            if sample is not None:
+                from tensorflowdistributedlearning_tpu.obs import (
+                    capacity as capacity_lib,
+                )
+
+                sample(capacity_lib.PHASE_COMPILE)
         return timings
 
     def infer(self, x) -> Dict:

@@ -89,6 +89,13 @@ class ReplicaState:
         self.routed = 0  # requests this replica answered for the router
         self.failures = 0  # consecutive poll/forward network failures
         self.last_poll_t: Optional[float] = None
+        # capacity/cost series from the replica's /metrics (obs/capacity.py):
+        # HBM headroom (None on statless backends) and the last window's
+        # per-chip request rate + cumulative chip-seconds
+        self.headroom_frac: Optional[float] = None
+        self.rps_per_chip: Optional[float] = None
+        self.chip_seconds_total: float = 0.0
+        self.n_chips: int = 1
 
     @property
     def routable(self) -> bool:
@@ -100,7 +107,7 @@ class ReplicaState:
         return (self.queue_depth + self.inflight, self.p99_ms or 0.0)
 
     def snapshot(self) -> Dict:
-        return {
+        out = {
             "replica": self.replica_id,
             "url": self.url,
             "status": self.status,
@@ -109,6 +116,13 @@ class ReplicaState:
             "inflight": self.inflight,
             "routed": self.routed,
         }
+        if self.headroom_frac is not None:
+            out["headroom_frac"] = self.headroom_frac
+        if self.rps_per_chip is not None:
+            out["rps_per_chip"] = self.rps_per_chip
+        if self.chip_seconds_total:
+            out["chip_seconds_total"] = self.chip_seconds_total
+        return out
 
 
 EndpointsLike = Union[
@@ -305,6 +319,19 @@ class FleetRouter:
         summary = hist.get("serve/request")
         if summary and summary.get("p99_s") is not None:
             rep.p99_ms = round(summary["p99_s"] * 1000, 3)
+        cost = body.get("cost") or {}
+        rep.n_chips = int(cost.get("n_chips", 1) or 1)
+        rep.chip_seconds_total = float(cost.get("chip_seconds_total", 0.0))
+        # unconditional: an idle replica stops publishing last_window, and a
+        # stale rate here would sum phantom throughput into the fleet gauges
+        last_window = cost.get("last_window") or {}
+        rps = last_window.get("rps_per_chip")
+        rep.rps_per_chip = float(rps) if rps is not None else None
+        memory = body.get("memory") or {}
+        headroom = (memory.get("headroom") or {}).get("headroom_frac")
+        rep.headroom_frac = (
+            float(headroom) if headroom is not None else None
+        )
 
     def _poll_loop(self) -> None:
         while not self._stop.wait(self.poll_interval_s):
@@ -358,7 +385,25 @@ class FleetRouter:
             r.queue_depth + r.inflight for r in reps if r.routable
         )
         p99s = [r.p99_ms for r in reps if r.routable and r.p99_ms is not None]
+        headrooms = [
+            r.headroom_frac for r in reps if r.headroom_frac is not None
+        ]
+        rps_chips = [
+            r.rps_per_chip for r in reps if r.rps_per_chip is not None
+        ]
+        capacity: Dict = {}
+        if headrooms:
+            # the fleet is as close to OOM as its tightest replica
+            capacity["min_headroom_frac"] = min(headrooms)
+        if rps_chips:
+            # fleet-wide serving efficiency: per-chip request rate summed
+            # over replicas (replicas run one chip-set each)
+            capacity["rps_per_chip_total"] = round(sum(rps_chips), 3)
+        total_chip_s = sum(r.chip_seconds_total for r in reps)
+        if total_chip_s:
+            capacity["chip_seconds_total"] = round(total_chip_s, 3)
         return {
+            **capacity,
             "replicas": len(reps),
             "live": by_status.get(STATUS_OK, 0)
             + by_status.get(STATUS_DEGRADED, 0),
@@ -548,6 +593,41 @@ class FleetRouter:
             "replicas": [r.snapshot() for r in self._replica_list()],
         }
 
+    def prometheus_text(self) -> str:
+        """Prometheus exposition for the router's ``/metrics`` (``?format=
+        prometheus`` or ``Accept: text/plain``): traffic counters plus the
+        fleet-aggregate capacity gauges — min replica headroom, fleet-wide
+        rps-per-chip, cumulative chip-seconds — so one scrape of the router
+        sees cost and OOM risk without touching individual replicas."""
+        lines: List[str] = []
+
+        def counter(name: str, value) -> None:
+            lines.append(f"# TYPE tfdl_router_{name}_total counter")
+            lines.append(f"tfdl_router_{name}_total {value}")
+
+        def gauge(name: str, value) -> None:
+            lines.append(f"# TYPE tfdl_router_{name} gauge")
+            lines.append(f"tfdl_router_{name} {value}")
+
+        for name, value in sorted(self.counters().items()):
+            counter(name, value)
+        fleet = self.fleet_snapshot()
+        gauge("uptime_s", round(time.time() - self._started_t, 3))
+        gauge("replicas", fleet["replicas"])
+        gauge("replicas_live", fleet["live"])
+        gauge("replicas_dead", fleet["dead"])
+        gauge("queue_depth_total", fleet["queue_depth_total"])
+        gauge("healthy", 1.0 if fleet["status"] == STATUS_OK else 0.0)
+        if fleet.get("worst_p99_ms") is not None:
+            gauge("worst_p99_ms", fleet["worst_p99_ms"])
+        if fleet.get("min_headroom_frac") is not None:
+            gauge("hbm_min_headroom_frac", fleet["min_headroom_frac"])
+        if fleet.get("rps_per_chip_total") is not None:
+            gauge("rps_per_chip_total", fleet["rps_per_chip_total"])
+        if fleet.get("chip_seconds_total") is not None:
+            gauge("chip_seconds_total", fleet["chip_seconds_total"])
+        return "\n".join(lines) + "\n"
+
     def emit_window(self, final: bool = False) -> Dict:
         fields: Dict = {
             **self.counters(),
@@ -578,10 +658,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
         logger.debug("%s - %s", self.address_string(), fmt % args)
 
     def _respond(
-        self, status: int, headers: Dict[str, str], body: bytes
+        self,
+        status: int,
+        headers: Dict[str, str],
+        body: bytes,
+        content_type: str = "application/json",
     ) -> None:
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         for k, v in headers.items():
             self.send_header(k, v)
@@ -597,7 +681,21 @@ class _RouterHandler(BaseHTTPRequestHandler):
             body = self.ctx.healthz()
             self._json(200 if body["status"] != "down" else 503, body)
         elif parsed.path == "/metrics":
-            self._json(200, self.ctx.metrics_snapshot())
+            query = urllib.parse.parse_qs(parsed.query)
+            accept = self.headers.get("Accept", "")
+            if (
+                query.get("format", [""])[0] == "prometheus"
+                or "text/plain" in accept
+                or "openmetrics" in accept
+            ):
+                self._respond(
+                    200,
+                    {},
+                    self.ctx.prometheus_text().encode(),
+                    content_type="text/plain; version=0.0.4; charset=utf-8",
+                )
+            else:
+                self._json(200, self.ctx.metrics_snapshot())
         else:
             self._json(
                 404,

@@ -149,6 +149,38 @@ class ServingServer:
             if slo_p99_ms is not None
             else None
         )
+        # HBM headroom monitor (obs/health.py): fed by the per-window
+        # watermark sample below; a replica running out of device memory
+        # degrades /healthz BEFORE it OOMs, so the fleet router drains it
+        # while it can still answer. Inert on backends with no allocator
+        # query (CPU builds never report a limit).
+        self.headroom = health_lib.HeadroomMonitor()
+        # per-request chip-seconds attribution (obs/capacity.py): the batcher
+        # worker feeds the meter as batches dispatch; emit_window drains it
+        # into `cost` ledger events and the rps-per-chip gauges. A server on
+        # DISABLED telemetry gets its own meter: the default telemetry is the
+        # process-global NULL_TELEMETRY singleton, and wiring two servers'
+        # batchers into its one meter would cross-contaminate their windows
+        from tensorflowdistributedlearning_tpu.obs import (
+            capacity as capacity_lib,
+        )
+
+        self.cost_meter = (
+            self.telemetry.cost
+            if self.telemetry.enabled
+            else capacity_lib.CostMeter()
+        )
+        self.batcher.cost_meter = self.cost_meter
+        # same ownership rule for the watermark tracker: without live
+        # telemetry nothing ledgers, but the /healthz OOM-drain protection
+        # and the hbm gauges must still work — the server samples its own
+        # tracker directly in that case (_emit_capacity_window)
+        self.watermarks = (
+            self.telemetry.watermarks
+            if self.telemetry.enabled
+            else capacity_lib.WatermarkTracker()
+        )
+        self._last_cost: Dict = {}
         if self.slo is not None and self.window_secs <= 0:
             # the budget evaluates at window boundaries; with periodic windows
             # off only shutdown's final window (or a manual emit_window) runs
@@ -249,10 +281,12 @@ class ServingServer:
     @property
     def health_status(self) -> str:
         """The replica's live state a fleet router routes on: "draining" >
-        "degraded" (SLO budget blown) > "ok"."""
+        "degraded" (SLO budget blown, or HBM headroom at OOM risk) > "ok"."""
         if self.draining:
             return "draining"
         if self.slo is not None and not self.slo.healthy:
+            return "degraded"
+        if self.headroom.degraded:
             return "degraded"
         return "ok"
 
@@ -332,6 +366,15 @@ class ServingServer:
             snapshot["slo"] = self.slo.snapshot()
         if self.engine.quantization is not None:
             snapshot["serving_dtype"] = self.engine.quantization.get("dtype")
+        # capacity/cost views (obs/capacity.py): per-phase HBM peaks +
+        # headroom estimate, cumulative chip-seconds + last window's rates —
+        # what a scraper needs to see cost and OOM risk without the ledger
+        snapshot["cost"] = self.cost_meter.snapshot()
+        if self._last_cost:
+            snapshot["cost"]["last_window"] = self._last_cost
+        memory = self.watermarks.snapshot()
+        if memory.get("peak_bytes"):
+            snapshot["memory"] = memory
         return snapshot
 
     def prometheus_text(self) -> str:
@@ -348,6 +391,34 @@ class ServingServer:
         )
         if self.slo is not None:
             reg.gauge("serve/slo_p99_target_ms").set(self.slo.p99_target_ms)
+        # device-memory and cost series (obs/capacity.py): external scrapers
+        # see headroom and chip-seconds without parsing ledgers
+        cost = self.cost_meter.snapshot()
+        reg.gauge("serve/chip_seconds_total").set(
+            cost.get("chip_seconds_total", 0.0)
+        )
+        # unconditional: gauges persist in the registry, so an idle window
+        # must overwrite the last busy window's rates with zero
+        reg.gauge("serve/rps_per_chip").set(
+            self._last_cost.get("rps_per_chip", 0.0)
+        )
+        reg.gauge("serve/cost_duty_cycle").set(
+            self._last_cost.get("duty_cycle", 0.0)
+        )
+        per_req = self._last_cost.get("chip_seconds_per_request") or {}
+        reg.gauge("serve/chip_seconds_per_request_p99").set(
+            per_req.get("p99", 0.0)
+        )
+        memory = self.watermarks.snapshot()
+        if memory.get("peak_bytes"):
+            reg.gauge("serve/hbm_peak_bytes").set(memory["peak_bytes"])
+            headroom = memory.get("headroom") or {}
+            if headroom.get("headroom_frac") is not None:
+                reg.gauge("serve/hbm_headroom_frac").set(
+                    headroom["headroom_frac"]
+                )
+            if memory.get("bytes_limit"):
+                reg.gauge("serve/hbm_bytes_limit").set(memory["bytes_limit"])
         return reg.render_prometheus()
 
     def emit_window(self, final: bool = False) -> Dict:
@@ -397,7 +468,49 @@ class ServingServer:
         if final:
             fields["final"] = True
         self.telemetry.event("serve_window", **fields)
+        self._emit_capacity_window()
         return fields
+
+    def _emit_capacity_window(self) -> None:
+        """The capacity/cost half of a window boundary (obs/capacity.py):
+        one allocator watermark sample attributed to the infer phase (fed to
+        the headroom monitor — low headroom degrades /healthz), and one
+        ``cost`` ledger event draining the window's per-request chip-second
+        attribution. Both are no-ops on an idle window / statless backend."""
+        from tensorflowdistributedlearning_tpu.obs import (
+            capacity as capacity_lib,
+        )
+
+        if self.telemetry.enabled:
+            self.telemetry.sample_watermark(capacity_lib.PHASE_INFER)
+        else:
+            # no ledger, but the tracker still samples so /healthz and the
+            # hbm gauges keep their OOM-drain protection
+            self.watermarks.sample(capacity_lib.PHASE_INFER)
+        # the monitor runs on the tracker's LIVE headroom every window — not
+        # only when the peak advanced — so a trend-triggered degraded state
+        # resolves once the peak plateaus instead of sticking forever
+        headroom = self.watermarks.headroom()
+        if headroom and headroom.get("bytes_limit"):
+            alert = self.headroom.check(
+                None,
+                headroom["peak_bytes"],
+                headroom["bytes_limit"],
+                samples_to_limit=headroom.get("samples_to_limit"),
+            )
+            if alert:
+                alert["replica"] = self.replica_id
+                self.telemetry.event(health_lib.HEALTH_ALERT_EVENT, **alert)
+        cost = self.cost_meter.serve_window()
+        if cost:
+            cost["replica"] = self.replica_id
+            self._last_cost = cost
+            self.telemetry.event(capacity_lib.COST_EVENT, **cost)
+        else:
+            # idle window: the last busy window's RATES are stale the moment
+            # a new window closes without traffic — scrapers and the router
+            # must see zero, not phantom throughput
+            self._last_cost = {}
 
     def _tick(self) -> None:
         while not self._stop.wait(self.window_secs):
@@ -529,6 +642,13 @@ class _Handler(BaseHTTPRequestHandler):
             }
             if self.ctx.slo is not None:
                 body["slo"] = self.ctx.slo.snapshot()
+            if self.ctx.headroom.last is not None:
+                # the OOM-risk view a fleet controller drains on (None until
+                # a device watermark sample exists — CPU builds stay silent)
+                body["memory"] = dict(
+                    self.ctx.headroom.last,
+                    degraded=self.ctx.headroom.degraded,
+                )
             self._json(status, body)
         elif parsed.path == "/metrics":
             query = urllib.parse.parse_qs(parsed.query)

@@ -491,6 +491,12 @@ class ClassifierTrainer:
                 scalars=scalars,
                 dirty=rec.dirty,
                 samples=rec.samples,
+                # cost accounting (obs/capacity.py): examples THIS PROCESS's
+                # chips handled this window — the meter counts local devices,
+                # so a multi-host run must price the per-process batch share,
+                # not the global batch (which would inflate per-chip
+                # throughput by the process count)
+                examples=rec.steps * multihost.per_process_batch_size(batch_size),
             )
 
         # dispatch-ahead + deferred window fetch (train/async_loop.py);

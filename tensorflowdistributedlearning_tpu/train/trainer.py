@@ -454,6 +454,11 @@ class Trainer:
                 scalars=scalars,
                 dirty=rec.dirty,
                 samples=rec.samples,
+                # cost accounting (obs/capacity.py): examples THIS PROCESS's
+                # chips handled this window — the meter counts local devices,
+                # so a multi-host run must price the per-process batch share,
+                # not the global batch
+                examples=rec.steps * multihost.per_process_batch_size(batch_size),
                 **rec.extra,
             )
 

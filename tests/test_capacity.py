@@ -1,0 +1,553 @@
+"""Capacity/cost layer tests (obs/capacity.py + its wiring): HBM watermark
+tracking with measured-vs-predicted deltas, chip-seconds cost accounting for
+training windows and serving requests, the headroom health monitor, the
+``telemetry-top`` console, the ledger exit-flush fix, and the regression
+sentinel's new tolerance bands.
+
+Degraded paths are first-class here (the ISSUE's satellite): CPU-only JAX
+reports NO allocator stats (``device.memory_stats()`` returns None), so
+every watermark test that needs device numbers injects a stats_fn — and the
+statless path itself is pinned as a no-event, no-crash contract."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tensorflowdistributedlearning_tpu import obs
+from tensorflowdistributedlearning_tpu.obs import capacity as capacity_lib
+from tensorflowdistributedlearning_tpu.obs import top as top_lib
+from tensorflowdistributedlearning_tpu.obs.health import HeadroomMonitor
+
+
+def _stats(peak, limit=None, in_use=None):
+    s = {"peak_bytes_in_use": peak, "bytes_in_use": in_use or peak}
+    if limit is not None:
+        s["bytes_limit"] = limit
+    return {"TPU_0": s}
+
+
+# -- WatermarkTracker --------------------------------------------------------
+
+
+def test_watermark_statless_backend_degrades_to_none():
+    """CPU-only JAX: memory_stats is empty — samples yield nothing, nothing
+    crashes, headroom stays unknown."""
+    tr = capacity_lib.WatermarkTracker(stats_fn=dict)
+    assert tr.sample(capacity_lib.PHASE_STEP) is None
+    assert tr.headroom() is None
+    assert tr.snapshot()["peak_bytes"] == 0
+
+
+def test_watermark_stats_fn_raising_degrades_to_none():
+    def boom():
+        raise RuntimeError("allocator query unsupported")
+
+    tr = capacity_lib.WatermarkTracker(stats_fn=boom)
+    assert tr.sample(capacity_lib.PHASE_STEP) is None
+
+
+def test_watermark_attributes_phases_and_predicted_delta():
+    state = {"stats": _stats(1000, limit=10_000)}
+    tr = capacity_lib.WatermarkTracker(
+        predicted_bytes_per_device=800, stats_fn=lambda: state["stats"]
+    )
+    first = tr.sample(capacity_lib.PHASE_COMPILE, step=0)
+    assert first["phase"] == "compile" and first["peak_bytes"] == 1000
+    assert first["measured_minus_predicted_bytes"] == 200
+    assert first["headroom_frac"] == pytest.approx(0.9)
+    # peak unchanged: the step phase records its first watermark once, then
+    # stays silent (steady state under the compile peak is the healthy case)
+    assert tr.sample(capacity_lib.PHASE_STEP, step=5) is not None
+    assert tr.sample(capacity_lib.PHASE_STEP, step=10) is None
+    # eval pushes the peak: the advance is attributed to eval
+    state["stats"] = _stats(4000, limit=10_000)
+    ev = tr.sample(capacity_lib.PHASE_EVAL, step=20)
+    assert ev["phase"] == "eval" and ev["peak_bytes"] == 4000
+    snap = tr.snapshot()
+    assert set(snap["phases"]) == {"compile", "step", "eval"}
+    assert snap["phases"]["eval"]["peak_bytes"] == 4000
+
+
+def test_watermark_trend_projects_samples_to_limit():
+    state = {"peak": 1000}
+    tr = capacity_lib.WatermarkTracker(
+        stats_fn=lambda: _stats(state["peak"], limit=100_000)
+    )
+    for _ in range(6):
+        tr.sample(capacity_lib.PHASE_STEP)
+        state["peak"] += 1000  # a steady climb: ~1000 bytes/sample
+    hr = tr.headroom()
+    assert hr["trend_bytes_per_sample"] == pytest.approx(1000, rel=0.01)
+    assert 0 < hr["samples_to_limit"] < 120
+
+
+# -- CostMeter ---------------------------------------------------------------
+
+
+def test_cost_meter_train_window_accounting():
+    cm = capacity_lib.CostMeter(n_chips=8)
+    fields = cm.train_window(2.0, 10, examples=1280, step=50)
+    assert fields["chip_seconds"] == pytest.approx(16.0)
+    assert fields["chip_seconds_per_step"] == pytest.approx(1.6)
+    assert fields["examples_per_chip_second"] == pytest.approx(80.0)
+    fields = cm.train_window(1.0, 10)
+    assert fields["chip_seconds_total"] == pytest.approx(24.0)
+    # empty windows never emit
+    assert cm.train_window(0.0, 10) is None
+    assert cm.train_window(1.0, 0) is None
+
+
+def test_cost_meter_serve_batch_share_attribution():
+    cm = capacity_lib.CostMeter(n_chips=2)
+    # one batch of 0.1s compute split 1:3 across two requests
+    cm.add_batch(0.1, [1, 3])
+    out = cm.serve_window()
+    assert out["requests"] == 2
+    assert out["chip_seconds"] == pytest.approx(0.2)
+    per = out["chip_seconds_per_request"]
+    # batch-share: 0.05 and 0.15 chip-seconds
+    assert per["p50"] == pytest.approx(0.05, abs=0.06)
+    assert per["mean"] == pytest.approx(0.1)
+    # drained: an idle window emits nothing
+    assert cm.serve_window() is None
+
+
+def test_cost_meter_lazy_chip_count_does_not_touch_backend():
+    cm = capacity_lib.CostMeter()
+    assert cm._n_chips is None  # no jax call at construction
+    assert cm.n_chips >= 1
+
+
+# -- HeadroomMonitor ---------------------------------------------------------
+
+
+def test_headroom_monitor_transitions_and_recovery():
+    mon = HeadroomMonitor(min_headroom_frac=0.10)
+    assert mon.check(1, 5_000, 10_000) is None  # 50% headroom: fine
+    alert = mon.check(2, 9_500, 10_000)  # 5% headroom: degrade
+    assert alert["monitor"] == "hbm_headroom"
+    assert alert["severity"] == "critical"
+    assert alert["reason"] == "low_headroom"
+    assert mon.degraded
+    assert mon.check(3, 9_600, 10_000) is None  # still degraded: no flood
+    resolved = mon.check(4, 5_000, 10_000)
+    assert resolved["resolved"] is True
+    assert not mon.degraded
+
+
+def test_headroom_monitor_trend_alert_and_no_limit_noop():
+    mon = HeadroomMonitor(min_headroom_frac=0.05, horizon_samples=10)
+    alert = mon.check(1, 2_000, 10_000, samples_to_limit=3)
+    assert alert and alert["reason"] == "trend"
+    mon2 = HeadroomMonitor()
+    assert mon2.check(1, 2_000, None) is None  # no limit = nothing to budget
+
+
+# -- Telemetry wiring --------------------------------------------------------
+
+
+def test_telemetry_emits_watermark_and_cost_events(tmp_path):
+    tel = obs.Telemetry(str(tmp_path), is_main=True, run_info={"task": "t"})
+    state = {"stats": _stats(3_000, limit=10_000)}
+    tel.watermarks._stats_fn = lambda: state["stats"]
+    with tel.span(obs.SPAN_STEP):
+        time.sleep(0.01)
+    tel.window_event(5, steps=5, examples=320)
+    tel.memory_event(
+        step=5, params_bytes_per_device=1_000, opt_state_bytes_per_device=500
+    )
+    tel.close(steps=5)
+    events = obs.read_ledger(str(tmp_path))
+    kinds = [e["event"] for e in events]
+    assert "cost" in kinds and "memory_watermark" in kinds
+    cost = next(e for e in events if e["event"] == "cost")
+    assert cost["scope"] == "train" and cost["chip_seconds"] > 0
+    assert cost["examples"] == 320
+    wm = next(e for e in events if e["event"] == "memory_watermark")
+    # the trainers' tree_bytes_per_device extras became the prediction
+    assert wm["predicted_bytes_per_device"] == 1_500
+    assert wm["measured_minus_predicted_bytes"] == 1_500
+    assert wm["phase"] in ("compile", "step")
+
+
+def test_telemetry_statless_backend_emits_no_watermarks(tmp_path):
+    """The CPU degraded path end to end: memory events flow, watermark events
+    do not, nothing crashes (profiling.memory_stats is empty here)."""
+    tel = obs.Telemetry(str(tmp_path), is_main=True)
+    tel.memory_event(step=1)
+    tel.eval_event(1, {"loss": 1.0}, 0.1)
+    tel.checkpoint_event(1)
+    tel.close()
+    kinds = [e["event"] for e in obs.read_ledger(str(tmp_path))]
+    assert "memory" in kinds
+    assert "memory_watermark" not in kinds
+
+
+def test_cost_events_on_unwritable_workdir_never_crash(tmp_path):
+    target = tmp_path / "file_in_the_way"
+    target.write_text("occupied")
+    tel = obs.Telemetry(str(target), is_main=True)
+    tel.watermarks._stats_fn = lambda: _stats(1_000, limit=10_000)
+    with tel.span(obs.SPAN_STEP):
+        pass
+    tel.window_event(1, steps=1, examples=8)  # cost path, ledger disabled
+    tel.memory_event(step=1)  # watermark path, ledger disabled
+    tel.close()
+    assert tel.ledger is None or not tel.ledger.enabled
+
+
+def test_capacity_sampling_off_is_inert(tmp_path):
+    tel = obs.Telemetry(
+        str(tmp_path), is_main=True, capacity_sampling=False
+    )
+    tel.watermarks._stats_fn = lambda: _stats(1_000, limit=10_000)
+    with tel.span(obs.SPAN_STEP):
+        pass
+    tel.window_event(1, steps=1, examples=8)
+    tel.memory_event(step=1)
+    tel.close()
+    kinds = [e["event"] for e in obs.read_ledger(str(tmp_path))]
+    assert "cost" not in kinds and "memory_watermark" not in kinds
+
+
+def test_headroom_alert_flows_through_health_monitor(tmp_path):
+    mon = obs.HealthMonitor()
+    tel = obs.Telemetry(str(tmp_path), is_main=True, health=mon)
+    tel.watermarks._stats_fn = lambda: _stats(9_900, limit=10_000)
+    tel.memory_event(step=1)
+    tel.close()
+    alerts = [
+        e
+        for e in obs.read_ledger(str(tmp_path))
+        if e["event"] == "health_alert"
+    ]
+    assert any(a["monitor"] == "hbm_headroom" for a in alerts)
+    assert mon.status == "degraded"
+
+
+def test_trend_degraded_resolves_after_plateau(tmp_path):
+    """Review pin: a trend-triggered degraded state must RESOLVE once the
+    peak plateaus — the monitor re-evaluates on every sample, not only on
+    peak advances (a lifetime peak stops advancing by definition)."""
+    mon = obs.HealthMonitor(
+        headroom=HeadroomMonitor(min_headroom_frac=0.05, horizon_samples=30)
+    )
+    tel = obs.Telemetry(str(tmp_path), is_main=True, health=mon)
+    state = {"peak": 50_000}
+    tel.watermarks._stats_fn = lambda: _stats(state["peak"], limit=1_000_000)
+    for _ in range(8):  # steep climb: trend projects the limit crossing
+        tel.memory_event(step=1)
+        state["peak"] += 30_000
+    assert mon.headroom.degraded
+    for _ in range(20):  # plateau: slope decays, projection clears
+        tel.memory_event(step=2)
+    assert not mon.headroom.degraded
+    tel.close()
+    alerts = [
+        e
+        for e in obs.read_ledger(str(tmp_path))
+        if e["event"] == "health_alert" and e["monitor"] == "hbm_headroom"
+    ]
+    assert any(a.get("resolved") for a in alerts)
+
+
+def test_memory_event_queries_allocator_once(tmp_path):
+    """Review pin: the window's memory snapshot is REUSED by the watermark
+    sample — one allocator query per memory_event, not two."""
+    calls = {"n": 0}
+
+    def stats():
+        calls["n"] += 1
+        return _stats(1_000, limit=10_000)
+
+    tel = obs.Telemetry(str(tmp_path), is_main=True)
+    tel.watermarks._stats_fn = stats
+    import tensorflowdistributedlearning_tpu.utils.profiling as profiling
+
+    orig = profiling.memory_stats
+    profiling.memory_stats = stats
+    try:
+        tel.memory_event(step=1)
+    finally:
+        profiling.memory_stats = orig
+    tel.close()
+    assert calls["n"] == 1
+    kinds = [e["event"] for e in obs.read_ledger(str(tmp_path))]
+    assert "memory_watermark" in kinds
+
+
+def test_server_capacity_works_without_telemetry():
+    """Review pin: a ServingServer on the default NULL_TELEMETRY still owns a
+    PRIVATE cost meter and watermark tracker — two servers cannot
+    cross-contaminate through the shared null singleton, and the /healthz
+    OOM-drain protection stays live (no ledger, but gauges and health do)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from tensorflowdistributedlearning_tpu.obs.telemetry import NULL_TELEMETRY
+    from tensorflowdistributedlearning_tpu.serve import (
+        InferenceEngine,
+        MicroBatcher,
+    )
+    from tensorflowdistributedlearning_tpu.serve.server import ServingServer
+
+    def fn(x):
+        return {"y": jnp.asarray(x).sum(axis=1)}
+
+    servers = []
+    for _ in range(2):
+        eng = InferenceEngine(
+            fn, example_shape=(4,), buckets=(1, 4), input_dtype=np.float32
+        )
+        servers.append(
+            ServingServer(eng, MicroBatcher(eng, max_wait_ms=1.0), window_secs=0)
+        )
+    a, b = servers
+    try:
+        assert a.cost_meter is not NULL_TELEMETRY.cost
+        assert a.cost_meter is not b.cost_meter
+        assert a.watermarks is not NULL_TELEMETRY.watermarks
+        # drive one server; the other's meter must stay untouched
+        a.batcher.submit(np.ones((1, 4), np.float32)).result(10)
+        a.emit_window()
+        assert a.cost_meter.chip_seconds_total > 0
+        assert b.cost_meter.chip_seconds_total == 0
+        # the headroom protection runs off the server-owned tracker
+        a.watermarks._stats_fn = lambda: _stats(9_900, limit=10_000)
+        a.emit_window()
+        assert a.health_status == "degraded"
+        assert b.health_status == "ok"
+    finally:
+        for s in servers:
+            s.shutdown()
+
+
+# -- report / compare sections -----------------------------------------------
+
+
+def _run_with_capacity(workdir, *, serve=False):
+    tel = obs.Telemetry(str(workdir), is_main=True, run_info={"task": "t"})
+    tel.watermarks._stats_fn = lambda: _stats(3_000, limit=10_000)
+    with tel.span(obs.SPAN_STEP):
+        time.sleep(0.01)
+    tel.window_event(5, steps=5, examples=320, images_per_sec=100.0)
+    tel.memory_event(step=5, params_bytes_per_device=1_000)
+    if serve:
+        tel.cost.add_batch(0.02, [1, 3])
+        fields = tel.cost.serve_window()
+        tel.event(capacity_lib.COST_EVENT, **fields)
+    tel.close(steps=5)
+
+
+def test_report_renders_watermark_and_cost_sections(tmp_path):
+    from tensorflowdistributedlearning_tpu.obs.report import (
+        build_report,
+        render_report,
+    )
+
+    _run_with_capacity(tmp_path, serve=True)
+    report = build_report(str(tmp_path))
+    wm = report["memory"]["watermarks"]
+    assert wm["peak_bytes"] == 3_000
+    assert wm["predicted_bytes_per_device"] == 1_000
+    cost = report["cost"]
+    assert cost["train"]["chip_seconds_total"] > 0
+    assert cost["serve"]["rps_per_chip"] > 0
+    assert "p99_worst_window" in cost["serve"]["chip_seconds_per_request"]
+    text = render_report(report)
+    assert "HBM watermarks" in text
+    assert "measured vs predicted" in text
+    assert "chip-seconds" in text
+    # stable --json schema: the keys CI consumers parse
+    blob = json.loads(json.dumps(report))
+    assert {"events", "peak_bytes", "phases"} <= set(
+        blob["memory"]["watermarks"]
+    )
+    assert {"train", "serve"} <= set(blob["cost"])
+
+
+def test_compare_emits_cost_deltas(tmp_path):
+    from tensorflowdistributedlearning_tpu.obs import compare as compare_lib
+
+    a, b = tmp_path / "a", tmp_path / "b"
+    _run_with_capacity(a)
+    _run_with_capacity(b)
+    result = compare_lib.compare_workdirs(str(a), str(b))
+    metrics = {d["metric"] for d in result["deltas"]}
+    assert "chip_seconds_per_step" in metrics
+    assert "hbm_peak_bytes" in metrics
+    hbm = next(d for d in result["deltas"] if d["metric"] == "hbm_peak_bytes")
+    assert hbm["verdict"] == "neutral"  # identical runs
+
+
+# -- telemetry-top -----------------------------------------------------------
+
+
+def test_top_empty_workdir_renders_honest_frame(tmp_path):
+    frame = top_lib.build_frame(str(tmp_path))
+    assert frame["processes"] == 0
+    assert "no ledgers yet" in top_lib.render_frame(frame)
+
+
+def test_top_training_only_ledger(tmp_path):
+    _run_with_capacity(tmp_path)
+    frame = top_lib.build_frame(str(tmp_path))
+    assert frame["processes"] == 1
+    row = frame["rows"][0]
+    assert row["step"] == 5
+    assert row["cost"]["chip_seconds_per_step"] > 0
+    assert row["memory"]["peak_bytes"] == 3_000
+    text = top_lib.render_frame(frame)
+    assert "step 5" in text and "hbm peak" in text
+    assert "serve" not in text.split("\n")[1]
+
+
+def test_top_serving_only_ledger(tmp_path):
+    tel = obs.Telemetry(str(tmp_path), run_info={"kind": "serve", "replica": 0})
+    tel.event(
+        "serve_window",
+        requests=10,
+        completed=9,
+        queue_depth=3,
+        replica=0,
+        latency_ms={"request": {"p99_ms": 12.5}},
+        slo={"healthy": False},
+    )
+    tel.close()
+    frame = top_lib.build_frame(str(tmp_path))
+    row = frame["rows"][0]
+    assert row["serve"]["backlog"] == 3
+    assert row["serve"]["p99_ms"] == 12.5
+    text = top_lib.render_frame(frame)
+    assert "9/10 ok" in text and "SLO BREACHED" in text
+
+
+def test_top_once_cli_exits_zero_on_all_shapes(tmp_path):
+    """The CI smoke contract: `telemetry-top WORKDIR --once` exits 0 on an
+    empty workdir and on a populated one, printing a frame either way."""
+    from tensorflowdistributedlearning_tpu.cli import main
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main(["telemetry-top", str(empty), "--once"]) == 0
+    _run_with_capacity(tmp_path / "run")
+    assert main(["telemetry-top", str(tmp_path / "run"), "--once"]) == 0
+
+
+def test_top_fleet_merge_and_straggler_flag(tmp_path):
+    for proc, mean_ms in ((0, 10.0), (1, 30.0)):
+        tel = obs.Telemetry(
+            str(tmp_path), is_main=proc == 0, process_index=proc,
+            run_info={"task": "t"},
+        )
+        for step in (5, 10):
+            tel.event(
+                "step_window",
+                step=step,
+                steps=5,
+                compute_s=mean_ms / 1000 * 5,
+                data_wait_s=0.0,
+                step_time_ms={"mean_ms": mean_ms, "p50_ms": mean_ms,
+                              "p90_ms": mean_ms, "p99_ms": mean_ms},
+            )
+        tel.close()
+    frame = top_lib.build_frame(str(tmp_path))
+    assert frame["processes"] == 2
+    assert frame["straggler"]["worst_process"] == 1
+    assert frame["straggler"]["alert_count"] > 0
+    assert "straggler skew" in top_lib.render_frame(frame)
+
+
+# -- ledger exit flush (the tail-loss satellite) -----------------------------
+
+
+_FLUSH_DRILL = """
+import os, sys, time
+from tensorflowdistributedlearning_tpu.obs.ledger import RunLedger
+
+led = RunLedger(sys.argv[1])
+led.event("run_header", drill=True)
+for i in range(50):
+    led.event_buffered("trace", name="span", i=i)  # buffered: no flush
+print("READY", flush=True)
+time.sleep(30)  # killed here — the exit hooks must flush the buffered tail
+"""
+
+
+def test_sigterm_flushes_buffered_ledger_tail(tmp_path):
+    """Kill drill: a process holding buffered high-rate events dies on
+    SIGTERM between flushes; the default-SIGTERM flush hook must land the
+    tail (and preserve the 128+SIGTERM exit convention)."""
+    drill = tmp_path / "drill.py"
+    drill.write_text(_FLUSH_DRILL)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, str(drill), str(tmp_path)],
+        stdout=subprocess.PIPE,
+        text=True,
+        cwd=repo,
+        env=env,
+    )
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=20)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == -signal.SIGTERM  # default action preserved after the flush
+    events = obs.read_ledger(str(tmp_path))
+    traces = [e for e in events if e["event"] == "trace"]
+    assert len(traces) == 50  # nothing buffered was lost
+
+
+def test_flush_all_ledgers_flushes_buffered_lines(tmp_path):
+    led = obs.RunLedger(str(tmp_path))
+    led.event_buffered("trace", i=1)
+    # not yet on disk (stdio-buffered) — barring an unluckily tiny buffer
+    obs.flush_all_ledgers()
+    events = obs.read_ledger(str(tmp_path))
+    assert [e["event"] for e in events] == ["trace"]
+    led.close()
+    obs.flush_all_ledgers()  # closed ledgers are dropped from the registry
+
+
+# -- regression sentinel bands -----------------------------------------------
+
+
+def test_sentinel_gates_peak_hbm_growth():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    try:
+        import regression_sentinel as rs
+    finally:
+        sys.path.pop(0)
+    base = {"async": {"step_time_ms": 10.0}, "peak_hbm_bytes": 1_000_000}
+    ok = rs.check_async(base, dict(base, peak_hbm_bytes=1_100_000))
+    bad = rs.check_async(base, dict(base, peak_hbm_bytes=2_000_000))
+    hbm_ok = next(f for f in ok if f["metric"] == "peak_hbm_bytes")
+    hbm_bad = next(f for f in bad if f["metric"] == "peak_hbm_bytes")
+    assert hbm_ok["ok"] and not hbm_bad["ok"]
+    # absent on either side (CPU baseline): no finding, not a failure
+    none = rs.check_async({"async": {"step_time_ms": 10.0}}, base)
+    assert not any(f["metric"] == "peak_hbm_bytes" for f in none)
+
+
+def test_sentinel_gates_rps_per_chip():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    try:
+        import regression_sentinel as rs
+    finally:
+        sys.path.pop(0)
+    base = {"batched": {"requests_per_sec": 1000.0, "rps_per_chip": 1000.0}}
+    ok = rs.check_serve(base, {"batched": {"rps_per_chip": 900.0}})
+    bad = rs.check_serve(base, {"batched": {"rps_per_chip": 100.0}})
+    rpc_ok = next(f for f in ok if f["metric"] == "batched.rps_per_chip")
+    rpc_bad = next(f for f in bad if f["metric"] == "batched.rps_per_chip")
+    assert rpc_ok["ok"] and not rpc_bad["ok"]

@@ -1038,6 +1038,20 @@ def main() -> int:
             round(b_rps / pr_rps, 2) if pr_rps else None
         )
         record["post_warmup_recompiles"] = detector.post_warmup_count
+        # cost-per-qps lens (obs/capacity.py; the Gemma-on-TPU serving
+        # comparison's metric): per-chip request rate per mode, so the
+        # committed baseline is comparable across device shapes and the
+        # regression sentinel can gate serving efficiency, not just rps
+        from tensorflowdistributedlearning_tpu.obs import capacity as capacity_lib
+
+        n_chips = capacity_lib.device_count()
+        record["n_chips"] = n_chips
+        for mode in ("per_request", "batched", "http"):
+            entry = record.get(mode)
+            if entry and entry.get("requests_per_sec"):
+                entry["rps_per_chip"] = round(
+                    entry["requests_per_sec"] / n_chips, 1
+                )
 
     if args.quant:
         import jax

@@ -68,6 +68,12 @@ DEFAULT_SPEEDUP_FLOOR_FRAC = 0.5
 # only the order-of-magnitude class fails — a genuinely serialized request
 # path also collapses requests_per_sec and the speedup, which are tighter
 DEFAULT_P99_SLACK = 6.0
+# peak HBM is near-deterministic for a fixed config (the allocator's
+# lifetime peak, not a timing), so the band is far tighter than wall-clock:
+# 1.25x catches a working-set regression (an extra params copy, an
+# un-donated buffer) while tolerating allocator/version jitter. Only the
+# GROWTH direction gates — a smaller peak is an improvement.
+DEFAULT_HBM_SLACK = 1.25
 
 
 def _finding(
@@ -94,6 +100,7 @@ def check_async(
     *,
     wall_slack: float = DEFAULT_WALL_SLACK,
     ratio_limit: float = DEFAULT_ASYNC_RATIO_LIMIT,
+    hbm_slack: float = DEFAULT_HBM_SLACK,
 ) -> List[Dict]:
     """BENCH_ASYNC.json comparisons (bench.py --async-loop output shape)."""
     out: List[Dict] = []
@@ -103,6 +110,16 @@ def check_async(
         out.append(_finding(
             "async", "async.step_time_ms", base_ms, fresh_ms,
             f"<= {wall_slack}x baseline", fresh_ms <= wall_slack * base_ms,
+        ))
+    base_hbm = baseline.get("peak_hbm_bytes")
+    fresh_hbm = fresh.get("peak_hbm_bytes")
+    if base_hbm and fresh_hbm:
+        # memory is capacity, not speed: a run that silently grows its
+        # working set OOMs the flagship shape long before CI notices a
+        # timing change (only gated where the backend reports the peak)
+        out.append(_finding(
+            "async", "peak_hbm_bytes", base_hbm, fresh_hbm,
+            f"<= {hbm_slack}x baseline", fresh_hbm <= hbm_slack * base_hbm,
         ))
     ratio = fresh.get("step_time_ratio_async_over_sync")
     if ratio is not None:
@@ -146,6 +163,17 @@ def check_serve(
         out.append(_finding(
             "serve", "batched.latency_ms.p99", base_p99, fresh_p99,
             f"<= {p99_slack}x baseline", fresh_p99 <= p99_slack * base_p99,
+        ))
+    # serving efficiency (the cost-per-qps lens): per-chip request rate —
+    # on a fixed-shape runner this tracks requests_per_sec, but the
+    # committed number stays comparable when the device count changes
+    base_rpc = base_b.get("rps_per_chip")
+    fresh_rpc = fresh_b.get("rps_per_chip")
+    if base_rpc and fresh_rpc:
+        out.append(_finding(
+            "serve", "batched.rps_per_chip", base_rpc, fresh_rpc,
+            f">= baseline / {wall_slack}",
+            fresh_rpc >= base_rpc / wall_slack,
         ))
     base_speedup = baseline.get("speedup_batched_vs_per_request")
     fresh_speedup = fresh.get("speedup_batched_vs_per_request")
@@ -307,6 +335,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "latency (the noisiest metric on shared runners; "
                         "throughput/speedup gates catch real request-path "
                         "regressions far tighter)")
+    parser.add_argument("--hbm-slack", type=float, default=DEFAULT_HBM_SLACK,
+                        help="multiplicative slack on the peak-HBM bench "
+                        "field (near-deterministic for a fixed config, so "
+                        "much tighter than wall-clock; growth-only gate)")
     parser.add_argument("--json-out", default=None)
     args = parser.parse_args(argv)
 
@@ -326,6 +358,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 baseline, fresh,
                 wall_slack=args.wall_slack,
                 ratio_limit=args.async_ratio_limit,
+                hbm_slack=args.hbm_slack,
             )
         except (OSError, RuntimeError, ValueError,
                 subprocess.TimeoutExpired) as e:
