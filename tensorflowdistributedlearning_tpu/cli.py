@@ -69,6 +69,12 @@ def _add_host_loop(p: argparse.ArgumentParser) -> None:
                    "device queue never drains on a log line; 0 = the "
                    "synchronous legacy loop (numerics identical either way; "
                    "default: the config's, 2)")
+    p.add_argument("--data-workers", type=int, default=None,
+                   help="parallel input-service workers (data/service.py): "
+                   "N background read+decode workers execute the index-keyed "
+                   "global-shuffle batch plan; batch CONTENT is worker-count "
+                   "invariant, so this is pure throughput. 0 = the legacy "
+                   "in-line input streams (default: the config's, 2)")
 
 
 def _add_observability(p: argparse.ArgumentParser) -> None:
@@ -480,6 +486,17 @@ def build_parser() -> argparse.ArgumentParser:
                        "the scripting/CI-smoke mode; an empty workdir "
                        "renders an honest 'no ledgers yet' frame, rc 0")
 
+    p_idx = sub.add_parser(
+        "records-index",
+        help="write .idx count/offset sidecars for existing TFRecord shards "
+        "(data/records.py write_shard_index) — new shards get them at "
+        "write_classification_shards time; this backfills old datasets so "
+        "count_records and the data service skip the full-file scan",
+    )
+    p_idx.add_argument("data_dir", help="directory holding *.tfrecord shards")
+    p_idx.add_argument("--glob", default="*.tfrecord",
+                       help="shard filename pattern (default: *.tfrecord)")
+
     p_doc = sub.add_parser(
         "doctor",
         help="diagnose the environment and (optionally) a dataset layout",
@@ -509,6 +526,8 @@ def _trainer(args):
         overlap["prefetch_depth"] = args.prefetch_depth
     if getattr(args, "dispatch_ahead", None) is not None:
         overlap["dispatch_ahead_steps"] = args.dispatch_ahead
+    if getattr(args, "data_workers", None) is not None:
+        overlap["data_service_workers"] = args.data_workers
     if getattr(args, "trace_sample_rate", None) is not None:
         overlap["trace_sample_rate"] = args.trace_sample_rate
     if getattr(args, "nan_guard", None) is not None:
@@ -726,6 +745,7 @@ def cmd_fit(args) -> int:
         grad_clip_norm=args.grad_clip,
         prefetch_depth=args.prefetch_depth,
         dispatch_ahead_steps=args.dispatch_ahead,
+        data_service_workers=args.data_workers,
         trace_sample_rate=args.trace_sample_rate,
         nan_guard=args.nan_guard,
     )
@@ -735,6 +755,29 @@ def cmd_fit(args) -> int:
         "n_params": result.n_params,
         "final_metrics": result.final_metrics,
     }))
+    return 0
+
+
+def cmd_records_index(args) -> int:
+    """Backfill ``.idx`` count/offset sidecars for on-disk record shards."""
+    import glob as glob_lib
+    import os
+
+    from tensorflowdistributedlearning_tpu.data import records as records_lib
+
+    paths = sorted(
+        glob_lib.glob(os.path.join(args.data_dir, args.glob))
+    )
+    if not paths:
+        print(f"no shards matching {args.glob!r} under {args.data_dir}",
+              file=sys.stderr)
+        return 1
+    total = 0
+    for path in paths:
+        n = len(records_lib.write_shard_index(path))
+        total += n
+        print(f"{records_lib.shard_index_path(path)}: {n} record(s)")
+    print(json.dumps({"shards": len(paths), "records": total}))
     return 0
 
 
@@ -1356,6 +1399,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "serve-fleet": cmd_serve_fleet,
         "quantize-check": cmd_quantize_check,
         "presets": cmd_presets,
+        "records-index": cmd_records_index,
         "telemetry-report": cmd_telemetry_report,
         "telemetry-top": cmd_telemetry_top,
         "doctor": cmd_doctor,

@@ -364,6 +364,15 @@ class TrainConfig:
     # numerics are bit-identical either way (tests/test_async_loop.py,
     # BENCH_ASYNC.json).
     dispatch_ahead_steps: int = 2
+    # parallel input-service workers (data/service.py): N background
+    # read+decode workers execute the index-keyed global-shuffle batch plan
+    # and hand batches back in order — record-sharded training streams scale
+    # past the single reader thread, and the K-fold trainer's in-memory fold
+    # streams assemble off the host loop. Batch CONTENT is worker-count
+    # invariant (the plan is a pure function of the seed), so this knob is
+    # pure throughput. 0 = the legacy in-line streams (records.py batches /
+    # pipeline.train_batches) with their seed-folded resume.
+    data_service_workers: int = 2
     # fit() with record shards and NO val split: hold out this fraction of the
     # train record shards (at least one) as the eval split, so best-checkpoint
     # selection runs on data the model never trains on. 0.0 keeps every shard
@@ -504,6 +513,11 @@ class TrainConfig:
             raise ValueError(
                 f"prefetch_depth must be >= 1, got {self.prefetch_depth} "
                 "(1 = single-buffered; there is no unprefetched mode)"
+            )
+        if self.data_service_workers < 0:
+            raise ValueError(
+                "data_service_workers must be >= 0 (0 = the legacy in-line "
+                f"input streams), got {self.data_service_workers}"
             )
         if self.dispatch_ahead_steps < 0:
             raise ValueError(

@@ -20,6 +20,13 @@ from tensorflowdistributedlearning_tpu.data.pipeline import (
     host_shard,
     train_batches,
 )
+from tensorflowdistributedlearning_tpu.data.service import (
+    ArrayBatchSource,
+    ClassificationRecordSource,
+    DataServiceState,
+    StreamingDataService,
+    epoch_shard_assignment,
+)
 from tensorflowdistributedlearning_tpu.data.synthetic import synthetic_batches
 
 __all__ = [
@@ -40,4 +47,9 @@ __all__ = [
     "host_shard",
     "train_batches",
     "synthetic_batches",
+    "ArrayBatchSource",
+    "ClassificationRecordSource",
+    "DataServiceState",
+    "StreamingDataService",
+    "epoch_shard_assignment",
 ]

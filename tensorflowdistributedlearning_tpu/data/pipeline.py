@@ -221,7 +221,9 @@ def device_prefetch(
     model.py:319-320): a daemon thread stays ``depth`` batches ahead so HBM copies
     overlap the previous step's compute. ``place`` maps a host batch to device arrays
     (e.g. ``lambda b: shard_batch(b, mesh)``); ``depth`` is
-    ``TrainConfig.prefetch_depth`` in the trainers.
+    ``TrainConfig.prefetch_depth`` in the trainers. The streaming data
+    service (data/service.py) plugs its in-order batch stream into this same
+    producer — assembly parallelism upstream, placement overlap here.
 
     ``registry`` (an ``obs.metrics.MetricsRegistry``) records the ready-queue
     depth observed at each consumer take into the ``prefetch/queue_depth``

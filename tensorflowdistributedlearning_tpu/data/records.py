@@ -14,11 +14,19 @@ ImageNet-scale corpora, where per-file ImageFolder IO is seek-bound):
 - ``ClassificationRecords`` + ``train_stream``/``eval_stream``: the fit-loop
   source for record shards. Payload layout: ``int32 LE label | encoded image``
   (PNG/JPEG bytes, decoded by the native batch decoder in data/imagefolder's
-  pipeline style).
+  pipeline style); image decodes run ``decode_ahead`` batches ahead of the
+  consumer so decode overlaps the (already background) read.
+- ``write_shard_index``/``shard_offsets``: the ``.idx`` count/offset sidecar
+  (written at shard-prep time, verified against the shard's byte size and
+  mtime) — ``count_records`` and the data service skip the full-file scan.
+- ``ShardRangeReader``: random-access record reads at indexed byte offsets
+  (native fseek+crc via ``tfdl_ranges_*``, pure-Python fallback) — the
+  read primitive under ``data/service.py``'s parallel workers.
 
 Sharding contract for multi-host runs: pass each process a disjoint subset of
 shard files (``host_shard_paths``), the record-level generalization of
-pipeline.host_shard.
+pipeline.host_shard — or let ``data.service.epoch_shard_assignment`` re-deal
+the full shard set every epoch (the global-shuffle generalization).
 """
 
 from __future__ import annotations
@@ -27,6 +35,7 @@ import ctypes
 import glob as glob_lib
 import os
 import struct
+from zipfile import BadZipFile as zipfile_BadZipFile
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -94,6 +103,13 @@ def write_records(path: str, records: Sequence[bytes]) -> None:
             f.write(struct.pack("<I", masked_crc(header)))
             f.write(rec)
             f.write(struct.pack("<I", masked_crc(rec)))
+    # a rewritten shard invalidates any existing .idx sidecar NOW: a
+    # same-byte-size rewrite landing within one mtime tick would otherwise
+    # pass shard_offsets' freshness check and serve stale offsets
+    try:
+        os.remove(shard_index_path(path))
+    except FileNotFoundError:
+        pass
 
 
 def read_records(path: str, verify: bool = True) -> Iterator[bytes]:
@@ -148,6 +164,22 @@ def _records_lib() -> Optional[ctypes.CDLL]:
     ]
     lib.tfdl_rec_close.restype = None
     lib.tfdl_rec_close.argtypes = [ctypes.c_int64]
+    # offset-indexed range reads (data/service.py workers); absent on a stale
+    # pre-rebuild .so — callers hasattr-check and fall back to pure Python
+    if hasattr(lib, "tfdl_ranges_open"):
+        lib.tfdl_ranges_open.restype = ctypes.c_int64
+        lib.tfdl_ranges_open.argtypes = [ctypes.c_char_p]
+        lib.tfdl_ranges_read.restype = ctypes.c_int
+        lib.tfdl_ranges_read.argtypes = [
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.tfdl_ranges_close.restype = None
+        lib.tfdl_ranges_close.argtypes = [ctypes.c_int64]
     return lib
 
 
@@ -246,6 +278,61 @@ def encode_classification_record(label: int, image_bytes: bytes) -> bytes:
     return struct.pack("<i", label) + image_bytes
 
 
+def check_classification_labels(
+    labels: np.ndarray, num_classes: Optional[int]
+) -> None:
+    """Label-range validation shared by every classification record consumer
+    (``None`` skips — unknown class count)."""
+    if num_classes is not None and labels.size:
+        lo, hi = int(labels.min()), int(labels.max())
+        if lo < 0 or hi >= num_classes:
+            raise ValueError(
+                f"record label out of range [0, {num_classes}): "
+                f"saw {lo}..{hi} — the shards hold more classes than the "
+                "model's num_classes"
+            )
+
+
+def decode_classification_batch(
+    blobs: Sequence[bytes],
+    labels: Sequence[int],
+    valid_rows: int,
+    *,
+    image_shape: Tuple[int, int],
+    channels: int,
+    num_classes: Optional[int] = None,
+) -> Dict[str, np.ndarray]:
+    """THE blobs+labels -> ``{'images','labels','valid'}`` assembly: label
+    validation (valid rows only), native blob decode behind the retryable
+    ``io-data`` fault site, normalization. The single decode recipe shared by
+    the legacy stream (``ClassificationRecords``) and the data service's
+    workers (``data/service.py``) — one place for the semantics both paths
+    must agree on."""
+    from tensorflowdistributedlearning_tpu.data.imagefolder import _normalize
+
+    h, w = image_shape
+    arr_labels = np.asarray(labels, np.int32)
+    check_classification_labels(arr_labels[:valid_rows], num_classes)
+
+    def attempt():
+        # decode is re-runnable from the buffered blobs, so a transient
+        # decode-side I/O failure on the Nth batch (the injectable
+        # ``io-data`` site) retries instead of killing the stream
+        faults.fire(faults.SITE_DATA)
+        return native_loader.decode_image_blobs(blobs, (h, w), channels)
+
+    images = retry_lib.call_with_retry(
+        attempt, name="record_batch", exceptions=(OSError,)
+    )
+    valid = np.zeros(len(blobs), np.float32)
+    valid[:valid_rows] = 1.0
+    return {
+        "images": _normalize(images, channels),
+        "labels": arr_labels,
+        "valid": valid,
+    }
+
+
 def decode_classification_record(payload: bytes) -> Tuple[int, bytes]:
     (label,) = struct.unpack("<i", payload[:4])
     return label, payload[4:]
@@ -277,43 +364,196 @@ def write_classification_shards(
     for s in range(shards):
         path = os.path.join(out_dir, f"{prefix}-{s:05d}-of-{shards:05d}.tfrecord")
         write_records(path, records[s])
+        # count/offset sidecar at prep time: count_records and the data
+        # service's offset-indexed workers skip the full-file scan
+        write_shard_index(path)
         paths.append(path)
     return paths
 
 
+# -- shard record index (.idx sidecar) ----------------------------------------
+
+INDEX_SUFFIX = ".idx"
+
+
+def shard_index_path(path: str) -> str:
+    return path + INDEX_SUFFIX
+
+
+def _scan_offsets(path: str) -> np.ndarray:
+    """Record start offsets via a header-only scan (seeks over payloads — no
+    crc, no decode; cheap even for large shards). Raises on truncation."""
+    offsets: List[int] = []
+    size = os.path.getsize(path)
+    with _open_shard(path) as f:
+        pos = 0
+        while True:
+            header = f.read(12)
+            if not header:
+                break
+            if len(header) != 12:
+                raise ValueError(f"{path}: truncated record header")
+            (length,) = struct.unpack("<Q", header[:8])
+            f.seek(length + 4, os.SEEK_CUR)
+            # seeking past EOF succeeds silently — without this check a
+            # shard truncated mid-record would be COUNTED as whole while
+            # the verifying reader later fails, desynchronizing the eval
+            # batch count from what the stream can deliver
+            if f.tell() > size:
+                raise ValueError(f"{path}: truncated record body")
+            offsets.append(pos)
+            pos += 12 + length + 4
+    return np.asarray(offsets, np.uint64)
+
+
+def write_shard_index(path: str) -> np.ndarray:
+    """Write the ``.idx`` count/offset sidecar for one shard: record start
+    offsets plus the shard's byte size for staleness detection. Written by
+    ``write_classification_shards`` at prep time so ``count_records`` and the
+    data service never pay the full-file scan; atomic install, so a torn
+    writer cannot leave a half-index that parses. Returns the offsets it
+    indexed (callers wanting the count need not re-read the sidecar)."""
+    idx = shard_index_path(path)
+    offsets = _scan_offsets(path)
+    tmp = f"{idx}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, offsets=offsets, file_size=np.int64(os.path.getsize(path)))
+    os.replace(tmp, idx)
+    return offsets
+
+
+def shard_offsets(path: str) -> np.ndarray:
+    """Record start offsets for one shard: from the ``.idx`` sidecar when it
+    is present and FRESH (stored byte size matches the shard and the sidecar
+    is not older than it — a rewritten shard invalidates its index), else a
+    header scan. Never trusts a stale index: wrong offsets would read garbage
+    framing and fail far from the cause."""
+    idx = shard_index_path(path)
+    try:
+        if os.path.getmtime(idx) >= os.path.getmtime(path):
+            with np.load(idx) as z:
+                if int(z["file_size"]) == os.path.getsize(path):
+                    return z["offsets"].astype(np.uint64)
+    except (OSError, KeyError, ValueError, zipfile_BadZipFile):
+        pass  # missing/corrupt/legacy sidecar: the scan is the oracle
+    return _scan_offsets(path)
+
+
 def count_records(paths: Sequence[str]) -> int:
-    """Number of records across shards via a header-only scan (seeks over
-    payloads — no crc, no decode; cheap even for large shards)."""
-    total = 0
-    for path in paths:
-        size = os.path.getsize(path)
-        with _open_shard(path) as f:
-            while True:
-                header = f.read(12)
-                if not header:
-                    break
-                if len(header) != 12:
-                    raise ValueError(f"{path}: truncated record header")
-                (length,) = struct.unpack("<Q", header[:8])
-                f.seek(length + 4, os.SEEK_CUR)
-                # seeking past EOF succeeds silently — without this check a
-                # shard truncated mid-record would be COUNTED as whole while
-                # the verifying reader later fails, desynchronizing the eval
-                # batch count from what the stream can deliver
-                if f.tell() > size:
-                    raise ValueError(f"{path}: truncated record body")
-                total += 1
-    return total
+    """Number of records across shards — the ``.idx`` sidecar when fresh
+    (O(1) per shard), else the header-only scan."""
+    return sum(len(shard_offsets(p)) for p in paths)
 
 
-def host_shard_paths(paths: Sequence[str]) -> List[str]:
-    """This process's round-robin subset of shard files (multi-host contract)."""
-    import jax
+class ShardRangeReader:
+    """Random-access record reads at known byte offsets — the data-service
+    worker read path (offsets come from ``shard_offsets``). Native fseek/fread
+    with crc verification in C++ when available, pure-Python fallback with the
+    same semantics. One reader serves ONE thread; each service worker opens
+    its own."""
 
+    def __init__(self, path: str, *, verify_crc: bool = True):
+        self.path = os.path.abspath(path)
+        self.verify_crc = verify_crc
+        self._lib = None
+        self._handle = 0
+        self._file = None
+        lib = _records_lib()
+        if lib is not None and hasattr(lib, "tfdl_ranges_open"):
+            handle = lib.tfdl_ranges_open(self.path.encode())
+            if handle == 0:
+                raise IOError(f"cannot open record shard {self.path}")
+            self._lib, self._handle = lib, handle
+        else:
+            self._file = _open_shard(self.path)
+
+    def read(self, offsets: Sequence[int]) -> List[bytes]:
+        """Record payloads at ``offsets``, in the given order."""
+        offsets = list(offsets)
+        if not offsets:
+            return []
+        if self._lib is not None:
+            n = len(offsets)
+            arr = (ctypes.c_uint64 * n)(*[int(o) for o in offsets])
+            datas = (ctypes.POINTER(ctypes.c_uint8) * n)()
+            lens = (ctypes.c_uint64 * n)()
+            rc = self._lib.tfdl_ranges_read(
+                self._handle, arr, n, 1 if self.verify_crc else 0, datas, lens
+            )
+            if rc == -3:
+                raise RuntimeError(
+                    "ShardRangeReader handle is invalid or already closed"
+                )
+            if rc == -2:
+                raise IOError(f"read failed in record shard {self.path}")
+            if rc != 0:
+                raise ValueError(
+                    f"{self.path}: corrupt record at an indexed offset "
+                    "(crc/framing mismatch — stale .idx or shard damage)"
+                )
+            return [ctypes.string_at(datas[i], lens[i]) for i in range(n)]
+        out = []
+        for off in offsets:
+            self._file.seek(int(off))
+            header = self._file.read(12)
+            if len(header) != 12:
+                raise ValueError(f"{self.path}: truncated record header")
+            (length,) = struct.unpack("<Q", header[:8])
+            if self.verify_crc:
+                (want,) = struct.unpack("<I", header[8:12])
+                if masked_crc(header[:8]) != want:
+                    raise ValueError(f"{self.path}: corrupt length crc")
+            data = self._file.read(length)
+            footer = self._file.read(4)
+            if len(data) != length or len(footer) != 4:
+                raise ValueError(f"{self.path}: truncated record body")
+            if self.verify_crc:
+                (want,) = struct.unpack("<I", footer)
+                if masked_crc(data) != want:
+                    raise ValueError(f"{self.path}: corrupt data crc")
+            out.append(data)
+        return out
+
+    def close(self) -> None:
+        if self._lib is not None and self._handle:
+            self._lib.tfdl_ranges_close(self._handle)
+            self._handle = 0
+            self._lib = None
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self) -> "ShardRangeReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # best-effort: workers cache readers thread-locally
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+def host_shard_paths(
+    paths: Sequence[str],
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> List[str]:
+    """This process's round-robin subset of shard files (multi-host contract;
+    the STATIC assignment — ``data.service.epoch_shard_assignment`` is the
+    epoch-reshuffled generalization). Explicit process arguments exist for
+    tests and tools; the default reads the jax cluster."""
+    if process_index is None or process_count is None:
+        import jax
+
+        process_index = jax.process_index()
+        process_count = jax.process_count()
     return [
         p
         for i, p in enumerate(sorted(paths))
-        if i % jax.process_count() == jax.process_index()
+        if i % process_count == process_index
     ]
 
 
@@ -343,40 +583,15 @@ class ClassificationRecords:
         self.channels = channels
         self.num_classes = num_classes
 
-    def _check_labels(self, labels: np.ndarray) -> None:
-        if self.num_classes is not None and labels.size:
-            lo, hi = int(labels.min()), int(labels.max())
-            if lo < 0 or hi >= self.num_classes:
-                raise ValueError(
-                    f"record label out of range [0, {self.num_classes}): "
-                    f"saw {lo}..{hi} — the shards hold more classes than the "
-                    "model's num_classes"
-                )
-
     def _emit(self, blobs: List[bytes], labels: List[int], valid_rows: int):
-        from tensorflowdistributedlearning_tpu.data.imagefolder import _normalize
-
-        h, w = self.image_shape
-        arr_labels = np.asarray(labels, np.int32)
-        self._check_labels(arr_labels[:valid_rows])
-
-        def attempt():
-            # decode is re-runnable from the buffered blobs, so a transient
-            # decode-side I/O failure on the Nth batch (the injectable
-            # ``io-data`` site) retries instead of killing the stream
-            faults.fire(faults.SITE_DATA)
-            return native_loader.decode_image_blobs(blobs, (h, w), self.channels)
-
-        images = retry_lib.call_with_retry(
-            attempt, name="record_batch", exceptions=(OSError,)
+        return decode_classification_batch(
+            blobs,
+            labels,
+            valid_rows,
+            image_shape=self.image_shape,
+            channels=self.channels,
+            num_classes=self.num_classes,
         )
-        valid = np.zeros(len(blobs), np.float32)
-        valid[:valid_rows] = 1.0
-        return {
-            "images": _normalize(images, self.channels),
-            "labels": arr_labels,
-            "valid": valid,
-        }
 
     def batches(
         self,
@@ -387,6 +602,7 @@ class ClassificationRecords:
         repeat: bool = True,
         steps: Optional[int] = None,
         pad_to_batches: Optional[int] = None,
+        decode_ahead: int = 1,
     ) -> Iterator[Dict[str, np.ndarray]]:
         """Batched {'images','labels','valid'} stream.
 
@@ -399,7 +615,53 @@ class ClassificationRecords:
         by wrapping around to the start with ``valid=0`` rows (the streaming
         analogue of pipeline.eval_batches' wrap-around padding — metrics
         exclude the padding, and every multi-host process can run the same
-        number of collective-bearing eval steps)."""
+        number of collective-bearing eval steps).
+
+        ``decode_ahead``: image decodes run in a background thread up to this
+        many batches ahead of the consumer, so decode OVERLAPS the (native,
+        already-background) record read instead of serializing behind it —
+        the end2end fix for RECORDS_BENCH's decode-loses-to-PIL regression.
+        Batch order and contents are unchanged (one decode thread, in-order
+        completion); 0 restores the fully in-line path."""
+        assembled = self._assemble(
+            batch_size,
+            seed=seed,
+            shuffle_buffer=shuffle_buffer,
+            repeat=repeat,
+            steps=steps,
+            pad_to_batches=pad_to_batches,
+        )
+        if decode_ahead <= 0:
+            for blobs, labels, valid_rows in assembled:
+                yield self._emit(blobs, labels, valid_rows)
+            return
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        pending: deque = deque()
+        with ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="records-decode"
+        ) as pool:
+            for work in assembled:
+                pending.append(pool.submit(self._emit, *work))
+                while len(pending) > decode_ahead:
+                    yield pending.popleft().result()
+            while pending:
+                yield pending.popleft().result()
+
+    def _assemble(
+        self,
+        batch_size: int,
+        *,
+        seed: int,
+        shuffle_buffer: int,
+        repeat: bool,
+        steps: Optional[int],
+        pad_to_batches: Optional[int],
+    ) -> Iterator[Tuple[List[bytes], List[int], int]]:
+        """The stream's accumulation half: yields ``(blobs, labels,
+        valid_rows)`` work items in emission order; ``batches`` decodes them
+        (inline or decode-ahead)."""
         emitted = 0
         epoch = 0
         labels: List[int] = []
@@ -417,7 +679,7 @@ class ClassificationRecords:
                 labels.append(label)
                 blobs.append(img)
                 if len(blobs) == batch_size:
-                    yield self._emit(blobs, labels, batch_size)
+                    yield (blobs, labels, batch_size)
                     emitted += 1
                     labels, blobs = [], []
                     if repeat and steps is not None and emitted >= steps:
@@ -454,7 +716,7 @@ class ClassificationRecords:
                             label, img = decode_classification_record(payload)
                             labels.append(label)
                             blobs.append(img)
-                        yield self._emit(blobs, labels, tail_valid)
+                        yield (blobs, labels, tail_valid)
                         emitted += 1
                         labels, blobs = [], []
                         tail_valid = 0  # later padded batches are fully invalid

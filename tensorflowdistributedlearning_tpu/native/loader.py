@@ -146,6 +146,23 @@ def _decode_pil_resize(
     return out
 
 
+# default decode parallelism for IN-MEMORY BLOBS: the native decoders spawn
+# fresh threads per CALL, so one-thread-per-core on a small blob batch (the
+# streaming record path's batch-at-a-time shape) spends more wall time
+# creating/joining threads than decoding — measured 2.4x SLOWER than a
+# 4-thread decode for 64 blobs on a 24-core host, the end2end_decode
+# regression RECORDS_BENCH.json recorded. Scale threads with the work
+# instead: at least _MIN_ITEMS_PER_THREAD blobs each, capped by the core
+# count. The PATH-based decoders keep the one-thread-per-core default: their
+# per-item cost (full-size on-disk images + filesystem IO) dwarfs the spawn
+# overhead this heuristic amortizes, and only the blob path was measured.
+_MIN_ITEMS_PER_THREAD = 16
+
+
+def _default_threads(n_items: int) -> int:
+    return max(1, min(os.cpu_count() or 1, n_items // _MIN_ITEMS_PER_THREAD))
+
+
 def _run_batch(fn, paths, out, h, w, channels, n_threads, what):
     c_paths = (ctypes.c_char_p * len(paths))(*[os.fsencode(p) for p in paths])
     rc = fn(
@@ -270,7 +287,7 @@ def decode_image_blobs(
     if lib is None or not hasattr(lib, "tfdl_decode_image_blob_batch"):
         return _decode_pil_blobs(blobs, h, w, channels)
     if n_threads is None:
-        n_threads = min(len(blobs), os.cpu_count() or 1)
+        n_threads = _default_threads(len(blobs))
     out = np.empty((len(blobs), h, w, channels), np.float32)
     bufs = [np.frombuffer(b, np.uint8) for b in blobs]  # keep refs alive
     start = 0

@@ -20,6 +20,16 @@
 // (file IO overlaps the caller's decode/augment work); the consumer side keeps
 // a shuffle pool of `shuffle_buf` records and emits a uniformly random one per
 // call (shard order is itself shuffled by `seed`).
+//
+// Offset-indexed range reads (the data-service worker read path — records at
+// known byte offsets from a shard's .idx sidecar, any order):
+//   int64 tfdl_ranges_open(const char* path)
+//   int   tfdl_ranges_read(int64 handle, const uint64_t* offsets, int n,
+//                          int verify, const uint8_t** datas, uint64_t* lens)
+//           -> 0 ok (datas/lens filled), -1 corrupt, -2 io, -3 bad handle
+//   void  tfdl_ranges_close(int64 handle)
+// Pointers stay valid until the next read/close on the same handle; a handle
+// serves ONE caller at a time (each service worker opens its own).
 
 #include <algorithm>
 #include <condition_variable>
@@ -185,6 +195,18 @@ std::mutex g_mu;
 std::unordered_map<int64_t, Reader*> g_readers;
 int64_t g_next_handle = 1;
 
+// One shard file opened for random-access record reads. The byte storage for
+// the latest read call lives on the handle, so returned pointers stay valid
+// until the next call — the same lifetime contract as tfdl_rec_next.
+struct RangeReader {
+  FILE* f = nullptr;
+  std::vector<std::vector<uint8_t>> recs;
+};
+
+std::mutex g_range_mu;
+std::unordered_map<int64_t, RangeReader*> g_range_readers;
+int64_t g_next_range_handle = 1;
+
 }  // namespace
 
 extern "C" {
@@ -243,6 +265,87 @@ void tfdl_rec_close(int64_t handle) {
     r->cv_push.notify_all();
   }
   if (r->producer.joinable()) r->producer.join();
+  delete r;
+}
+
+int64_t tfdl_ranges_open(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return 0;
+  auto* r = new RangeReader();
+  r->f = f;
+  std::lock_guard<std::mutex> lk(g_range_mu);
+  int64_t h = g_next_range_handle++;
+  g_range_readers[h] = r;
+  return h;
+}
+
+int tfdl_ranges_read(int64_t handle, const uint64_t* offsets, int n,
+                     int verify, const uint8_t** datas, uint64_t* lens) {
+  RangeReader* r;
+  {
+    std::lock_guard<std::mutex> lk(g_range_mu);
+    auto it = g_range_readers.find(handle);
+    if (it == g_range_readers.end()) return -3;
+    r = it->second;
+  }
+  r->recs.clear();
+  r->recs.reserve(n);
+  for (int i = 0; i < n; ++i) {
+    // a PRIOR call's transient error must not make this call's clean short
+    // reads (real truncation) look like retryable I/O — handles are cached
+    // and reused across retries
+    std::clearerr(r->f);
+    if (fseeko(r->f, static_cast<off_t>(offsets[i]), SEEK_SET) != 0) return -2;
+    uint8_t header[12];
+    if (std::fread(header, 1, 12, r->f) != 12) {
+      // ferror = transient I/O (retryable -2, like the Python fallback's
+      // OSError); clean short read = truncated framing / bad offset (-1)
+      return std::ferror(r->f) ? -2 : -1;
+    }
+    uint64_t len;
+    std::memcpy(&len, header, 8);
+    if (len > (1ull << 31)) return -1;  // garbage length: wrong offset/corrupt
+    if (verify) {
+      uint32_t want;
+      std::memcpy(&want, header + 8, 4);
+      if (MaskedCrc(header, 8) != want) return -1;
+    }
+    std::vector<uint8_t> rec;
+    try {
+      rec.resize(len);
+    } catch (const std::bad_alloc&) {
+      // with verify=0 a mid-record offset's garbage length can pass the
+      // 2^31 guard; an exception must not cross the extern "C" boundary
+      // (std::terminate) — report it as the corruption it is
+      return -1;
+    }
+    uint8_t footer[4];
+    if (std::fread(rec.data(), 1, len, r->f) != len ||
+        std::fread(footer, 1, 4, r->f) != 4) {
+      return std::ferror(r->f) ? -2 : -1;
+    }
+    if (verify) {
+      uint32_t want;
+      std::memcpy(&want, footer, 4);
+      if (MaskedCrc(rec.data(), len) != want) return -1;
+    }
+    r->recs.push_back(std::move(rec));
+    datas[i] = r->recs.back().data();
+    lens[i] = r->recs.back().size();
+  }
+  return 0;
+}
+
+void tfdl_ranges_close(int64_t handle) {
+  RangeReader* r = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_range_mu);
+    auto it = g_range_readers.find(handle);
+    if (it == g_range_readers.end()) return;
+    r = it->second;
+    g_range_readers.erase(it);
+  }
+  std::fclose(r->f);
   delete r;
 }
 

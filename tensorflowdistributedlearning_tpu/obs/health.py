@@ -23,6 +23,9 @@ Monitors:
 - :class:`StepTimeRegressionDetector` — median-of-first-clean-windows
   baseline, alert on sustained regression (dirty windows — compile/eval/
   checkpoint — are excluded exactly as they are from throughput);
+- :class:`DataStarvedDetector` — ``data_wait`` dominating consecutive clean
+  windows (the accelerator is input-bound; raise ``data_service_workers`` /
+  prefetch depth — the signal the streaming data service drives to ~0);
 - :class:`SloTracker` — serving p99 target expressed as a windowed error
   budget: with budget ``b``, "p99 <= target" IS "at most ``b`` of requests
   over target" (b=0.01 by default), so one fraction drives both the alert
@@ -176,6 +179,52 @@ class StepTimeRegressionDetector:
                 "baseline_ms": round(self.baseline_ms, 3),
                 "resolved": True,
             }
+        return None
+
+
+class DataStarvedDetector:
+    """Input-bound training: ``data_wait`` dominates the window's host time.
+
+    Consumes the per-window ``data_wait_frac`` the trainers already ledger
+    (host blocked on the input iterator / total host busy time). Alerts on
+    the ok→starved transition after ``consecutive`` CLEAN windows above
+    ``threshold`` (dirty windows carry compile/eval/checkpoint time and are
+    excluded, as everywhere), and writes a ``resolved`` event on recovery —
+    transitions, not every window. The remedy is named in the alert: more
+    ``data_service_workers`` / deeper prefetch, the knobs the data service
+    exists for."""
+
+    def __init__(self, threshold: float = 0.5, consecutive: int = 2):
+        if not 0.0 < threshold < 1.0:
+            raise ValueError(
+                f"data_starved threshold must be in (0, 1), got {threshold}"
+            )
+        self.threshold = float(threshold)
+        self.consecutive = max(1, int(consecutive))
+        self._over = 0
+        self.degraded = False
+
+    def check(
+        self, step: int, data_wait_frac: float, dirty: bool = False
+    ) -> Optional[Dict]:
+        if dirty:
+            return None
+        starved = data_wait_frac > self.threshold
+        self._over = self._over + 1 if starved else 0
+        fields = {
+            "monitor": "data_starved",
+            "severity": "warn",
+            "step": step,
+            "data_wait_frac": round(float(data_wait_frac), 4),
+            "threshold": self.threshold,
+        }
+        if self._over >= self.consecutive and not self.degraded:
+            self.degraded = True
+            return fields
+        if not starved and self.degraded:
+            self.degraded = False
+            fields["resolved"] = True
+            return fields
         return None
 
 
@@ -392,6 +441,7 @@ class HealthMonitor:
         spike: Optional[LossSpikeDetector] = None,
         step_time: Optional[StepTimeRegressionDetector] = None,
         headroom: Optional[HeadroomMonitor] = None,
+        data_starved: Optional[DataStarvedDetector] = None,
     ):
         self.nan_guard = NanGuard(nan_action)
         self.spike = spike if spike is not None else LossSpikeDetector()
@@ -401,6 +451,10 @@ class HealthMonitor:
         # HBM headroom/OOM-risk (fed by Telemetry.sample_watermark — never
         # fires on backends without the allocator query)
         self.headroom = headroom if headroom is not None else HeadroomMonitor()
+        # input-bound training (data_wait dominating clean windows)
+        self.data_starved = (
+            data_starved if data_starved is not None else DataStarvedDetector()
+        )
         self.alerts: List[Dict] = []
 
     @classmethod
@@ -412,7 +466,11 @@ class HealthMonitor:
 
     @property
     def status(self) -> str:
-        degraded = self.step_time.degraded or self.headroom.degraded
+        degraded = (
+            self.step_time.degraded
+            or self.headroom.degraded
+            or self.data_starved.degraded
+        )
         return "degraded" if degraded else "ok"
 
     def reset(self) -> None:
@@ -431,6 +489,10 @@ class HealthMonitor:
         self.step_time = StepTimeRegressionDetector(
             baseline_windows=self.step_time.baseline_windows,
             factor=self.step_time.factor,
+        )
+        self.data_starved = DataStarvedDetector(
+            threshold=self.data_starved.threshold,
+            consecutive=self.data_starved.consecutive,
         )
 
     def observe_memory(
@@ -480,6 +542,13 @@ class HealthMonitor:
             )
             if st:
                 alerts.append(st)
+        frac = fields.get("data_wait_frac")
+        if frac is not None:
+            starved = self.data_starved.check(
+                step, float(frac), dirty=bool(fields.get("dirty"))
+            )
+            if starved:
+                alerts.append(starved)
         for alert in alerts:
             self.alerts.append(alert)
             telemetry.event(HEALTH_ALERT_EVENT, **alert)

@@ -476,6 +476,31 @@ def build_report(
             # ahead of the device at least once in them
             "underrun_windows": sum(1 for d in depths if d["min"] == 0),
         }
+    # dirty windows carry compile/eval/checkpoint stalls whose input-side
+    # hiccups are startup noise, not the workers failing to keep pace —
+    # excluded exactly as they are from the throughput trend
+    svc = [
+        e["data_service"]
+        for e in windows
+        if "data_service" in e and not e.get("dirty")
+    ]
+    if svc:
+        # the input service's own backpressure (data/service.py): reorder-
+        # buffer depth behind the prefetcher, consumer-starved takes, and
+        # worker utilization — the "is the service keeping up" row
+        entry = {
+            "windows": len(svc),
+            "underruns": sum(int(s.get("underruns", 0)) for s in svc),
+        }
+        ready = [s["ready_depth"] for s in svc if "ready_depth" in s]
+        if ready:
+            entry["mean_ready_depth"] = round(
+                sum(r["mean"] for r in ready) / len(ready), 2
+            )
+        utils = [s["worker_util"] for s in svc if "worker_util" in s]
+        if utils:
+            entry["mean_worker_util"] = round(sum(utils) / len(utils), 3)
+        report.setdefault("prefetch", {})["data_service"] = entry
 
     ips = [
         (e["step"], e["images_per_sec"])
@@ -647,16 +672,31 @@ def render_report(report: Dict) -> str:
         lines.append("\nrecompiles after warmup: none")
     pf = report.get("prefetch")
     if pf:
-        line = (
-            f"input prefetch: mean queue depth {pf['mean_queue_depth']:.1f} "
-            f"(min {pf['min_queue_depth']}) over {pf['windows']} window(s)"
-        )
-        if pf["underrun_windows"]:
-            line += (
-                f" — !! {pf['underrun_windows']} window(s) underran (queue "
-                "hit empty; raise --prefetch-depth or speed the loader up)"
+        if "mean_queue_depth" in pf:
+            line = (
+                f"input prefetch: mean queue depth {pf['mean_queue_depth']:.1f} "
+                f"(min {pf['min_queue_depth']}) over {pf['windows']} window(s)"
             )
-        lines.append(line)
+            if pf["underrun_windows"]:
+                line += (
+                    f" — !! {pf['underrun_windows']} window(s) underran (queue "
+                    "hit empty; raise --prefetch-depth or speed the loader up)"
+                )
+            lines.append(line)
+        ds = pf.get("data_service")
+        if ds:
+            line = f"data service: {ds['underruns']} underrun(s)"
+            if "mean_ready_depth" in ds:
+                line += f", mean ready depth {ds['mean_ready_depth']:.1f}"
+            if "mean_worker_util" in ds:
+                line += f", worker util {ds['mean_worker_util']:.0%}"
+            line += f" over {ds['windows']} window(s)"
+            if ds["underruns"]:
+                line += (
+                    " — !! consumers outran the workers; raise "
+                    "--data-workers"
+                )
+            lines.append(line)
     ev = report["evals"]
     lines.append(
         f"evals: {ev['count']}"

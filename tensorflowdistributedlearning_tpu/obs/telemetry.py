@@ -37,6 +37,7 @@ from tensorflowdistributedlearning_tpu.obs.ledger import RunLedger
 from tensorflowdistributedlearning_tpu.obs.metrics import (
     MetricsRegistry,
     time_summary,
+    window_count,
     window_total_s,
 )
 from tensorflowdistributedlearning_tpu.obs.recompile import (
@@ -68,6 +69,17 @@ SPAN_BARRIER = "barrier_wait"
 # (data/pipeline.py:device_prefetch); drained per window like the spans, so
 # prefetch underruns are visible in the ledger and telemetry-report
 PREFETCH_DEPTH_HISTOGRAM = "prefetch/queue_depth"
+
+# data-service backpressure telemetry (data/service.py): reorder-buffer depth
+# at each consumer take, one sample per consumer-blocked-on-workers event
+# (an underrun: the device side is about to starve), per-batch worker busy
+# seconds (utilization = busy / (workers x window wall)), and the live worker
+# count. Drained per window like the prefetch gauge; rendered by
+# telemetry-report's prefetch section and watched by the data_starved monitor
+DATA_READY_HISTOGRAM = "data_service/ready_depth"
+DATA_UNDERRUN_HISTOGRAM = "data_service/underruns"
+DATA_WORKER_BUSY_HISTOGRAM = "data_service/worker_busy"
+DATA_WORKERS_GAUGE = "data_service/workers"
 
 
 def run_fingerprint() -> Dict:
@@ -258,6 +270,15 @@ class Telemetry:
         samples["prefetch_depth"] = self.registry.histogram(
             PREFETCH_DEPTH_HISTOGRAM
         ).drain()
+        samples["data_ready_depth"] = self.registry.histogram(
+            DATA_READY_HISTOGRAM
+        ).drain()
+        samples["data_underruns"] = self.registry.histogram(
+            DATA_UNDERRUN_HISTOGRAM
+        ).drain()
+        samples["data_worker_busy"] = self.registry.histogram(
+            DATA_WORKER_BUSY_HISTOGRAM
+        ).drain()
         return samples
 
     # -- events ------------------------------------------------------------
@@ -338,6 +359,25 @@ class Telemetry:
                 "mean": round(sum(depth) / len(depth), 2),
                 "min": int(min(depth)),
             }
+        svc_ready = samples.get("data_ready_depth", [])
+        svc_under = samples.get("data_underruns", [])
+        svc_busy = samples.get("data_worker_busy", [])
+        if svc_ready or svc_under or svc_busy:
+            # data-service backpressure for this window (data/service.py):
+            # reorder-buffer depth at each take, consumer-starved events, and
+            # worker utilization against the window's host wall time
+            svc_fields: Dict = {"underruns": window_count(svc_under)}
+            if svc_ready:
+                svc_fields["ready_depth"] = {
+                    "mean": round(sum(svc_ready) / len(svc_ready), 2),
+                    "min": int(min(svc_ready)),
+                }
+            n_workers = self.registry.gauge(DATA_WORKERS_GAUGE).value
+            if svc_busy and n_workers and busy > 0:
+                svc_fields["worker_util"] = round(
+                    min(1.0, window_total_s(svc_busy) / (n_workers * busy)), 3
+                )
+            fields["data_service"] = svc_fields
         if compute:
             s = time_summary(compute)
             fields["step_time_ms"] = {

@@ -192,6 +192,74 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._ckpt.latest_step()
 
+    # -- data-service resume state (sidecar) -------------------------------
+
+    def _data_state_path(self, step: int) -> str:
+        return os.path.join(
+            self.directory, "checkpoints", f"data_state-{step}.json"
+        )
+
+    def save_data_state(self, step: int, state: Dict) -> None:
+        """Persist the input stream's resume state (a ``DataServiceState``
+        json dict, data/service.py) NEXT TO the step's checkpoint — the
+        index-keyed stream contract's durable half: restore reads it back and
+        the service validates it against (seed, resume step), so a mid-epoch
+        preemption provably resumes the exact remaining stream. Written
+        atomically; stale sidecars beyond the newest ``max_to_keep``-ish
+        window are pruned opportunistically (they are a few bytes — pruning
+        is hygiene, not correctness)."""
+        import glob
+        import json
+
+        path = self._data_state_path(step)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"step": int(step), **state}, f)
+        os.replace(tmp, path)
+        kept = set(self._ckpt.all_steps())
+        for old in glob.glob(
+            os.path.join(self.directory, "checkpoints", "data_state-*.json")
+        ):
+            try:
+                old_step = int(
+                    os.path.basename(old)[len("data_state-"):-len(".json")]
+                )
+            except ValueError:
+                continue
+            if old_step != step and old_step not in kept:
+                try:
+                    os.remove(old)
+                except OSError:
+                    pass
+
+    def restore_data_state(self, step: int) -> Optional[Dict]:
+        """The data-service resume state saved with ``step``, or None (no
+        sidecar — a pre-service checkpoint, or a non-service run; the stream
+        state is then derived purely from the step, which the index-keyed
+        contract makes exact anyway). Corrupt sidecars warn and return None
+        rather than kill a resume the derivation can complete."""
+        import json
+
+        path = self._data_state_path(step)
+        try:
+            with open(path, encoding="utf-8") as f:
+                state = json.load(f)
+            if not isinstance(state, dict) or not {
+                "seed", "batch_index"
+            } <= state.keys():
+                # parseable but not a sidecar: same stance as unreadable
+                raise ValueError(f"not a data_state sidecar: {state!r:.120}")
+            return state
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError) as e:
+            logger.warning(
+                "data-state sidecar for step %d is unreadable (%s) — "
+                "deriving the stream state from the step instead", step, e,
+            )
+            return None
+
     def restore_latest(self, template: TrainState) -> TrainState:
         """Estimator-style auto-resume: if a checkpoint exists, restore it into the
         template's shardings; else return the template unchanged (reference: implicit

@@ -176,6 +176,12 @@ class ClassifierTrainer:
         # fit() swaps in a live Telemetry; the null instance keeps every other
         # entry point (serving restore, direct _evaluate) span-safe
         self._telemetry = obs_lib.NULL_TELEMETRY
+        # streaming input service (data/service.py) for the record-sharded
+        # train path; built by _train_stream, closed on run teardown. The
+        # restored sidecar state (if resuming) is stashed before the stream
+        # is built so the service can validate it.
+        self._data_service = None
+        self._restored_data_state = None
         os.makedirs(model_dir, exist_ok=True)
 
     @property
@@ -210,13 +216,18 @@ class ClassifierTrainer:
             )
         return list(paths[:-n_hold]), list(paths[-n_hold:])
 
-    def _open_records(self, split: str):
+    def _open_records(self, split: str, host_shard: bool = True):
         """Record-sharded source for ``split`` ({data_dir}/{split}-*.tfrecord),
         already reduced to this process's shard subset; None when absent.
 
         With ``eval_holdout_fraction`` set and no on-disk ``val`` shards, the
         train shards are deterministically partitioned: ``split='train'``
-        excludes the held-out shards, ``split='val'`` serves them."""
+        excludes the held-out shards, ``split='val'`` serves them.
+
+        ``host_shard=False`` keeps the FULL (holdout-filtered) shard list —
+        the data-service train path assigns shards per epoch itself
+        (``data.service.epoch_shard_assignment``), validating the
+        shards-per-process floor at construction."""
         if self.data_dir is None:
             return None
         from tensorflowdistributedlearning_tpu.data import records as records_lib
@@ -246,6 +257,8 @@ class ClassifierTrainer:
                     _, ds.paths = self._holdout_partition(ds.paths)
         if ds is None:
             return None
+        if not host_shard:
+            return ds
         n_shards = len(ds.paths)
         ds.paths = records_lib.host_shard_paths(ds.paths)
         if not ds.paths:
@@ -287,10 +300,58 @@ class ClassifierTrainer:
         # multi-host batch assembly stays aligned.
         seed = tcfg.seed + jax.process_index() + 7919 * start_step
         # record-sharded source first: {data_dir}/train-*.tfrecord (the
-        # ImageNet-scale on-disk form; native threaded reader + blob decode,
-        # data/records.py). Each process streams its own shard subset.
-        records_ds = self._open_records("train")
+        # ImageNet-scale on-disk form). Default: the streaming data service
+        # (data/service.py) — N parallel read+decode workers over per-epoch
+        # global-shuffle shard assignment, index-keyed so batch i is a pure
+        # function of (seed, i) and a resumed run replays the exact remaining
+        # stream (the sidecar state restored below is validated against it).
+        # data_service_workers=0 keeps the legacy single-thread stream with
+        # its seed-folded resume.
+        use_service = tcfg.data_service_workers > 0
+        records_ds = self._open_records("train", host_shard=not use_service)
         if records_ds is not None:
+            if use_service:
+                from tensorflowdistributedlearning_tpu.data import (
+                    service as service_lib,
+                )
+
+                cfg = self.model_config
+                source = service_lib.ClassificationRecordSource(
+                    records_ds.paths,
+                    image_shape=cfg.input_shape,
+                    channels=cfg.input_channels,
+                    num_classes=cfg.num_classes,
+                )
+                tel = self._telemetry
+                svc = service_lib.StreamingDataService(
+                    source,
+                    batch_size=local_bs,
+                    seed=tcfg.seed,
+                    workers=tcfg.data_service_workers,
+                    start_batch=start_step,
+                    # same gating as device_prefetch: only a window-writing
+                    # process drains these samples
+                    registry=(
+                        tel.registry
+                        if tel.enabled and jax.process_index() == 0
+                        else None
+                    ),
+                    resume_state=self._restored_data_state,
+                )
+                self._data_service = svc
+                return svc.batches(steps=steps)
+            if self._restored_data_state is not None:
+                # the checkpoint was written by a service-fed run (sidecar
+                # present): the legacy stream would silently replay/skip
+                # records relative to the index-keyed plan — the exact
+                # failure the sidecar validation exists to refuse
+                raise ValueError(
+                    "this checkpoint carries a data-service resume sidecar "
+                    "but data_service_workers=0 selects the legacy stream — "
+                    "resuming would silently replay or skip training data; "
+                    "resume with --data-workers >= 1 (any count: batch "
+                    "content is worker-invariant)"
+                )
             return records_ds.batches(
                 local_bs,
                 seed=seed,
@@ -389,6 +450,10 @@ class ClassifierTrainer:
             # idempotent: the success path already closed with final metrics;
             # an exceptional exit reaches this close first and is recorded as
             # interrupted (and the compile listener never leaks either way)
+            if self._data_service is not None:
+                self._data_service.close()
+                self._data_service = None
+            self._restored_data_state = None
             multihost.uninstrument(self._telemetry)
             self._telemetry.close(interrupted=True)
             self._telemetry = obs_lib.NULL_TELEMETRY
@@ -426,6 +491,10 @@ class ClassifierTrainer:
             # records the resume point so telemetry-report can line restarts
             # up with recovered progress
             tel.event("resumed", step=start_step)
+            # the input stream's sidecar state saved with this checkpoint:
+            # _train_stream hands it to the data service, which validates it
+            # against (seed, start_step) — the index-keyed resume contract
+            self._restored_data_state = ckpt.restore_data_state(start_step)
 
         if self._tp:
             from tensorflowdistributedlearning_tpu.parallel import tensor as tp_lib
@@ -504,6 +573,16 @@ class ClassifierTrainer:
         overlap = async_loop.HostOverlap(
             tel, dispatch_ahead=tcfg.dispatch_ahead_steps, emit=emit_window
         )
+
+        def save_data_sidecar(step: int) -> None:
+            # the input stream's resume state rides every checkpoint
+            # (process 0 writes; the validated fields — seed, batch_index —
+            # are identical on every host by construction)
+            if self._data_service is not None and is_main:
+                ckpt.save_data_state(
+                    step, self._data_service.state(step).to_json()
+                )
+
         batches_it = iter(batches)
         _end = object()
         while True:
@@ -536,6 +615,7 @@ class ClassifierTrainer:
                     pass
                 with tel.span(obs_lib.SPAN_CHECKPOINT):
                     ckpt.save(state, force=True)
+                save_data_sidecar(step_no)
                 tel.checkpoint_event(step_no, preempted=True)
                 tel.event(
                     "preempted", step=step_no, reason=preempt_lib.reason()
@@ -578,6 +658,7 @@ class ClassifierTrainer:
             if saved:
                 overlap.flush()
                 window_dirty = True
+                save_data_sidecar(step_no)
                 tel.checkpoint_event(step_no)
             if step_no % eval_every == 0:
                 overlap.flush()
@@ -601,6 +682,7 @@ class ClassifierTrainer:
             abort_err = e
         with tel.span(obs_lib.SPAN_CHECKPOINT):
             ckpt.save(state, force=True)
+        save_data_sidecar(step_no)
         tel.checkpoint_event(step_no, final=True)
         if abort_err is not None:
             raise abort_err
@@ -966,6 +1048,7 @@ def fit_preset(
     grad_clip_norm: Optional[float] = None,
     prefetch_depth: Optional[int] = None,
     dispatch_ahead_steps: Optional[int] = None,
+    data_service_workers: Optional[int] = None,
     trace_sample_rate: Optional[float] = None,
     nan_guard: Optional[str] = None,
 ) -> FitResult:
@@ -1005,6 +1088,7 @@ def fit_preset(
         or grad_clip_norm is not None
         or prefetch_depth is not None
         or dispatch_ahead_steps is not None
+        or data_service_workers is not None
         or trace_sample_rate is not None
         or nan_guard is not None
     ):
@@ -1055,6 +1139,11 @@ def fit_preset(
                 dispatch_ahead_steps
                 if dispatch_ahead_steps is not None
                 else train_cfg.dispatch_ahead_steps
+            ),
+            data_service_workers=(
+                data_service_workers
+                if data_service_workers is not None
+                else train_cfg.data_service_workers
             ),
             trace_sample_rate=(
                 trace_sample_rate

@@ -407,15 +407,42 @@ class Trainer:
         last_eval_time = 0.0
         final_metrics: Dict[str, float] = {}
 
-        batches = pipeline_lib.train_batches(
-            train_ds,
-            local_bs,
-            # fold the resume point into the shuffle seed so a resumed run
-            # does not replay the same shuffled order from the beginning
-            # (see ClassifierTrainer._train_stream)
-            seed=tcfg.seed + fold + 7919 * start_step,
-            steps=steps - start_step,
-        )
+        if tcfg.data_service_workers > 0:
+            # streaming data service over the in-memory fold (data/service.py
+            # ArrayBatchSource): batch assembly moves off the host loop onto
+            # N workers, and the stream is INDEX-KEYED — batch i is a pure
+            # function of (seed+fold, i), so a resumed fold replays the exact
+            # remaining stream instead of approximating it by folding the
+            # resume step into the seed
+            from tensorflowdistributedlearning_tpu.data import (
+                service as service_lib,
+            )
+
+            svc = service_lib.StreamingDataService(
+                service_lib.ArrayBatchSource(
+                    {"images": train_ds.images, "masks": train_ds.masks}
+                ),
+                batch_size=local_bs,
+                seed=tcfg.seed + fold,
+                workers=tcfg.data_service_workers,
+                start_batch=start_step,
+                registry=(
+                    self._telemetry.registry
+                    if self._telemetry.enabled and tb_train is not None
+                    else None
+                ),
+            )
+            batches = svc.batches(steps=steps - start_step)
+        else:
+            batches = pipeline_lib.train_batches(
+                train_ds,
+                local_bs,
+                # fold the resume point into the shuffle seed so a resumed
+                # run does not replay the same shuffled order from the
+                # beginning (see ClassifierTrainer._train_stream)
+                seed=tcfg.seed + fold + 7919 * start_step,
+                steps=steps - start_step,
+            )
         batches = pipeline_lib.device_prefetch(
             batches,
             lambda b: multihost.global_shard_batch(

@@ -45,13 +45,18 @@ def _setup_jax_env() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def _tiny_trainer(model_dir: str):
+def _tiny_trainer(model_dir: str, data_dir: str = None):
     from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
     from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
 
     return ClassifierTrainer(
         model_dir,
-        None,  # synthetic data — index-keyed, restart-invariant
+        # None: synthetic data — index-keyed, restart-invariant. A data_dir
+        # holding train-*.tfrecord shards exercises the SAME contract through
+        # the streaming data service (global-shuffle epochs, parallel
+        # workers, DataServiceState sidecar resume) — the headline drill of
+        # tests/test_data_service.py.
+        data_dir,
         ModelConfig(
             num_classes=4,
             input_shape=(16, 16),
@@ -92,7 +97,7 @@ def cmd_run(args) -> int:
     preempt.install(notice_file=args.notice_file)
     if args.inject_fault:
         faults.install(args.inject_fault, seed=args.seed)
-    trainer = _tiny_trainer(args.model_dir)
+    trainer = _tiny_trainer(args.model_dir, args.data_dir)
     try:
         result = trainer.fit(
             batch_size=4, steps=args.steps, eval_every_steps=args.steps
@@ -138,9 +143,10 @@ def cmd_smoke(args) -> int:
     golden_npz = os.path.join(args.workdir, "golden.npz")
     sup_npz = os.path.join(args.workdir, "supervised.npz")
 
+    data_args = ["--data-dir", args.data_dir] if args.data_dir else []
     rc = _run_child(
         ["run", "--model-dir", golden_dir, "--steps", str(args.steps),
-         "--params-out", golden_npz]
+         "--params-out", golden_npz, *data_args]
     )
     if rc != 0:
         print(json.dumps({"ok": False, "stage": "golden", "rc": rc}))
@@ -154,7 +160,7 @@ def cmd_smoke(args) -> int:
         [sys.executable, os.path.abspath(__file__), "run",
          "--model-dir", sup_dir, "--steps", str(args.steps),
          "--params-out", sup_npz, "--inject-fault", fault,
-         "--seed", str(args.seed)],
+         "--seed", str(args.seed), *data_args],
         workdir=sup_dir,
         max_restarts=3,
         backoff_base_s=0.1,
@@ -211,10 +217,12 @@ def main() -> int:
     p_run.add_argument("--notice-file", default=None)
     p_run.add_argument("--params-out", default=None)
     p_run.add_argument("--seed", type=int, default=0)
+    p_run.add_argument("--data-dir", default=None)
     p_smoke = sub.add_parser("smoke")
     p_smoke.add_argument("--workdir", required=True)
     p_smoke.add_argument("--steps", type=int, default=8)
     p_smoke.add_argument("--seed", type=int, default=0)
+    p_smoke.add_argument("--data-dir", default=None)
     args = parser.parse_args()
     return {"run": cmd_run, "smoke": cmd_smoke}[args.mode](args)
 

@@ -30,6 +30,13 @@ Usage (CI runs the first form ahead of tier-1)::
 through it; operators can re-check an old run). Without them the sentinel
 runs ``bench.py --async-loop`` and ``tools/bench_serve.py`` on the CPU shape.
 
+The ``records`` bench likewise REPLAYS the committed RECORDS_BENCH.json
+(tools/bench_records.py): resume bit-parity and the serviced trainer's
+data_wait ceiling are hard, multi-worker scaling and the native-vs-PIL
+end-to-end decode ratio are dimensionless floors, and a ``--fresh-records``
+record additionally gates per-worker records/sec against machine-drift
+slack.
+
 The ``fleet`` bench REPLAYS the committed BENCH_SERVE.json ``fleet`` section
 (bench_serve --fleet is too heavy for every CI run): the committed 2-replica
 scaling must clear the 1.6x floor, every replica must report zero post-warmup
@@ -199,6 +206,97 @@ def check_serve(
 # (capacity scales with replicas) is broken, whatever the machine
 DEFAULT_FLEET_SCALING_FLOOR = 1.6
 
+# data-service floors (RECORDS_BENCH.json multi_worker section): the best
+# worker count must beat one worker by this much (dimensionless — if adding
+# workers stops paying, the service's premise broke), and the serviced
+# trainer's mean per-window data_wait fraction must stay ~0 (the ISSUE-12
+# acceptance ceiling). Both replay the COMMITTED record by default, like the
+# fleet gates — a PR touching the input path must re-run tools/bench_records
+# and commit numbers that still clear them.
+DEFAULT_RECORDS_SCALING_FLOOR = 1.2
+DEFAULT_DATA_WAIT_CEILING = 0.05
+# serviced trainer throughput vs the single-thread baseline: the service
+# must not cost steady-state throughput; 0.9 absorbs scheduling noise on a
+# CPU backend where worker threads and "device" compute share the cores
+DEFAULT_SERVICE_THROUGHPUT_FLOOR = 0.9
+
+
+def check_records(
+    baseline: Dict,
+    fresh: Optional[Dict] = None,
+    *,
+    wall_slack: float = DEFAULT_WALL_SLACK,
+    scaling_floor: float = DEFAULT_RECORDS_SCALING_FLOOR,
+    data_wait_ceiling: float = DEFAULT_DATA_WAIT_CEILING,
+) -> List[Dict]:
+    """RECORDS_BENCH.json gates (tools/bench_records.py output shape).
+
+    Default mode REPLAYS the committed record (``fresh`` falls back to the
+    baseline): resume bit-parity and the serviced data_wait ceiling are HARD
+    (correctness/acceptance, no machine slack); worker scaling and the
+    end-to-end native-vs-PIL decode ratio are dimensionless floors. A
+    ``--fresh-records`` run is gated instead, with the wall-clock throughput
+    additionally held to the machine-drift slack band; the decode ratio is
+    only gated when the fresh host has >= 4 cores (below that the native
+    decoder's one-thread floor legitimately ties/loses to PIL — the honest
+    CPU floor RECORDS_BENCH documents)."""
+    record = fresh if fresh is not None else baseline
+    out: List[Dict] = []
+    e2e = (record.get("end2end_decode") or {}).get("speedup")
+    if e2e is not None and (record.get("cpu_count") or 4) >= 4:
+        out.append(_finding(
+            "records", "end2end_decode.speedup", 1.0, e2e,
+            ">= 1.0 (native decode must not lose to PIL)", e2e >= 1.0,
+        ))
+    mw = record.get("multi_worker")
+    if not mw:
+        return out
+    parity = mw.get("resume_bit_identical")
+    if parity is not None:
+        out.append(_finding(
+            "records", "multi_worker.resume_bit_identical", True, parity,
+            "== true (hard)", bool(parity),
+        ))
+    speedup = mw.get("speedup_best_vs_1")
+    if speedup is not None:
+        out.append(_finding(
+            "records", "multi_worker.speedup_best_vs_1",
+            scaling_floor, speedup,
+            f">= {scaling_floor} (worker scaling floor)",
+            speedup >= scaling_floor,
+        ))
+    ab = mw.get("trainer_ab") or {}
+    frac = ab.get("service_data_wait_frac")
+    if frac is not None:
+        out.append(_finding(
+            "records", "trainer_ab.service_data_wait_frac",
+            data_wait_ceiling, frac,
+            f"<= {data_wait_ceiling} (data_wait ~0, hard)",
+            frac <= data_wait_ceiling,
+        ))
+    ratio = ab.get("throughput_ratio_service_over_baseline")
+    if ratio is not None:
+        floor = DEFAULT_SERVICE_THROUGHPUT_FLOOR
+        out.append(_finding(
+            "records", "trainer_ab.throughput_ratio_service_over_baseline",
+            floor, ratio,
+            f">= {floor} (service must not cost steady-state throughput)",
+            ratio >= floor,
+        ))
+    if fresh is not None:
+        base_mw = (baseline.get("multi_worker") or {}).get("workers") or {}
+        fresh_mw = mw.get("workers") or {}
+        for w, entry in base_mw.items():
+            b_ips = entry.get("images_per_sec")
+            f_ips = (fresh_mw.get(w) or {}).get("images_per_sec")
+            if b_ips and f_ips:
+                out.append(_finding(
+                    "records", f"multi_worker.workers.{w}.images_per_sec",
+                    b_ips, f_ips, f">= baseline / {wall_slack}",
+                    f_ips >= b_ips / wall_slack,
+                ))
+    return out
+
 
 def check_fleet(
     baseline: Dict,
@@ -311,12 +409,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="run the comparisons and gate on them (the only "
                         "mode; the flag exists so the CI step reads as a "
                         "gate)")
-    parser.add_argument("--benches", default="async,serve,fleet",
+    parser.add_argument("--benches", default="async,serve,fleet,records",
                         help="comma-separated subset to check")
     parser.add_argument("--baseline-async",
                         default=os.path.join(REPO, "BENCH_ASYNC.json"))
     parser.add_argument("--baseline-serve",
                         default=os.path.join(REPO, "BENCH_SERVE.json"))
+    parser.add_argument("--baseline-records",
+                        default=os.path.join(REPO, "RECORDS_BENCH.json"))
+    parser.add_argument("--fresh-records", default=None, metavar="JSON",
+                        help="pre-computed tools/bench_records.py output "
+                        "(default: replay the committed baseline's gates, "
+                        "like the fleet section)")
     parser.add_argument("--fresh-async", default=None, metavar="JSON",
                         help="pre-computed bench.py --async-loop output "
                         "(skips running the bench)")
@@ -392,6 +496,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             findings += check_fleet(baseline, fresh)
         except (OSError, ValueError) as e:
             errors.append(f"fleet: {e}")
+    if "records" in benches:
+        try:
+            baseline = _load(args.baseline_records)
+            fresh = _load(args.fresh_records) if args.fresh_records else None
+            findings += check_records(
+                baseline, fresh, wall_slack=args.wall_slack
+            )
+        except (OSError, ValueError) as e:
+            errors.append(f"records: {e}")
 
     failed = [f for f in findings if not f["ok"]]
     for f in findings:
