@@ -389,6 +389,83 @@ def build_parser() -> argparse.ArgumentParser:
                          "tests and bench_serve --fleet's kill soak "
                          "schedule a deterministic mid-soak replica death")
 
+    p_prom = sub.add_parser(
+        "promote",
+        help="roll a candidate artifact across a LIVE serve-fleet: "
+        "quantize-check admission, shadow-compared canary (a traffic slice "
+        "is duplicated to it, never answered from it), replica-by-replica "
+        "rollout through the router's drain/readmit path, automatic "
+        "rollback on accuracy/latency regression or canary crash-loop — "
+        "the whole deployment ledgered (promotion_*/shadow_window events) "
+        "and rendered by telemetry-report",
+    )
+    p_prom.add_argument("--candidate-dir", default=None,
+                        help="the artifact directory to promote "
+                        "(export_serving output); required unless --abort")
+    p_prom.add_argument("--reference-dir", default=None,
+                        help="float32 reference for the quantize-check "
+                        "admission gate (fingerprint pairing + accuracy "
+                        "budgets); omitted = manifest-only admission")
+    p_prom.add_argument("--workdir", default=None,
+                        help="the live fleet's workdir: the router endpoint "
+                        "is read from its run-header ledger event "
+                        "(alternative to --router)")
+    p_prom.add_argument("--router", default=None, metavar="URL",
+                        help="the live fleet router's base URL (e.g. "
+                        "http://127.0.0.1:8000); overrides --workdir")
+    p_prom.add_argument("--shadow-secs", type=float, default=None,
+                        help="shadow window length; 0 skips the shadow "
+                        "phase (default: the controller's, 10)")
+    p_prom.add_argument("--shadow-fraction", type=float, default=None,
+                        help="slice of accepted traffic duplicated to the "
+                        "canary (default 0.25)")
+    p_prom.add_argument("--shadow-min-requests", type=int, default=None,
+                        help="compared requests a shadow window needs "
+                        "before it counts as evidence (an emptier window "
+                        "HOLDS the phase; default 8)")
+    p_prom.add_argument("--shadow-max-secs", type=float, default=None,
+                        help="give up (roll back) when shadow traffic "
+                        "stays below --shadow-min-requests this long "
+                        "(default 120)")
+    p_prom.add_argument("--min-iou", type=float, default=None,
+                        dest="shadow_min_iou",
+                        help="mask-IoU floor for the shadow compare "
+                        "(default 0.90)")
+    p_prom.add_argument("--max-disagree", type=float, default=None,
+                        dest="shadow_max_disagree",
+                        help="class-disagreement ceiling for the shadow "
+                        "compare (default 0.10)")
+    p_prom.add_argument("--max-abs-delta", type=float, default=None,
+                        dest="shadow_max_abs_delta",
+                        help="max |delta| ceiling on float outputs "
+                        "(default 0.25)")
+    p_prom.add_argument("--max-mean-delta", type=float, default=None,
+                        dest="shadow_max_mean_delta",
+                        help="mean |delta| ceiling on float outputs "
+                        "(default 0.05)")
+    p_prom.add_argument("--max-p99-ratio", type=float, default=None,
+                        help="latency gate: canary/fleet p99 vs baseline "
+                        "past this ratio (obs/compare noise-band verdict) "
+                        "rolls back (default 1.5)")
+    p_prom.add_argument("--observe-secs", type=float, default=None,
+                        help="post-step observation dwell during rollout "
+                        "(default 2)")
+    p_prom.add_argument("--canary-inject-fault", default=None,
+                        metavar="SPEC",
+                        help="drill: pass `serve --inject-fault SPEC` to "
+                        "the canary's FIRST launch (e.g. sigkill@25 kills "
+                        "it mid-shadow; the monitor restarts it on the "
+                        "candidate and the controller must converge)")
+    p_prom.add_argument("--abort", action="store_true",
+                        help="abort the fleet's in-flight promotion "
+                        "(rolls back) instead of starting one")
+    p_prom.add_argument("--timeout", type=float, default=600.0,
+                        help="seconds to wait for a terminal state before "
+                        "giving up (the promotion keeps running fleet-side)")
+    p_prom.add_argument("--json", action="store_true",
+                        help="print the final status as JSON instead of "
+                        "the phase-by-phase progress log")
+
     p_qc = sub.add_parser(
         "quantize-check",
         help="accuracy gate between a float32 serving artifact and a "
@@ -1055,6 +1132,148 @@ def cmd_serve_fleet(args) -> int:
     return 0
 
 
+def _resolve_router_url(args) -> Optional[str]:
+    """Where the live fleet's router listens: --router verbatim, or the
+    ``endpoint`` of the last serve-fleet run header in --workdir's ledger —
+    the same merged-workdir contract everything else in the fleet rides."""
+    if getattr(args, "router", None):
+        return args.router.rstrip("/")
+    workdir = getattr(args, "workdir", None)
+    if not workdir:
+        return None
+    from tensorflowdistributedlearning_tpu.obs.ledger import read_ledger
+
+    try:
+        events = read_ledger(workdir)
+    except (OSError, ValueError):
+        return None
+    for e in reversed(events):
+        if e.get("event") == "run_header" and e.get("kind") == "serve-fleet":
+            return (e.get("endpoint") or "").rstrip("/") or None
+    return None
+
+
+def cmd_promote(args) -> int:
+    """Drive a live fleet's promotion controller over /admin/promotion:
+    start (or --abort), then follow the phase history until a terminal
+    state. Exit status IS the verdict: 0 promoted, 1 rolled back / refused /
+    aborted, 2 usage or connectivity errors."""
+    import os
+    import time as time_lib
+    import urllib.error
+    import urllib.request
+
+    if not args.abort and not args.candidate_dir:
+        print(
+            "promote: --candidate-dir is required (unless --abort)",
+            file=sys.stderr,
+        )
+        return 2
+    url = _resolve_router_url(args)
+    if not url:
+        print(
+            "promote: no router found — pass --router URL, or --workdir "
+            "pointing at a live serve-fleet's ledger dir",
+            file=sys.stderr,
+        )
+        return 2
+
+    def call(method: str, payload=None):
+        req = urllib.request.Request(
+            url + "/admin/promotion",
+            data=json.dumps(payload).encode() if payload is not None else None,
+            headers={"Content-Type": "application/json"},
+            method=method,
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    try:
+        if args.abort:
+            status = call("POST", {"action": "abort"})
+        else:
+            payload = {
+                "action": "start",
+                "candidate_dir": os.path.abspath(args.candidate_dir),
+            }
+            if args.reference_dir:
+                payload["reference_dir"] = os.path.abspath(args.reference_dir)
+            if args.canary_inject_fault:
+                payload["fault_spec"] = args.canary_inject_fault
+            for key in (
+                "shadow_secs",
+                "shadow_fraction",
+                "shadow_min_requests",
+                "shadow_max_secs",
+                "shadow_min_iou",
+                "shadow_max_disagree",
+                "shadow_max_abs_delta",
+                "shadow_max_mean_delta",
+                "max_p99_ratio",
+                "observe_secs",
+            ):
+                value = getattr(args, key, None)
+                if value is not None:
+                    payload[key] = value
+            status = call("POST", payload)
+    except urllib.error.HTTPError as e:
+        body = e.read().decode(errors="replace")
+        print(f"promote: router answered {e.code}: {body}", file=sys.stderr)
+        return 2
+    except (OSError, ValueError) as e:
+        print(f"promote: cannot reach router at {url}: {e}", file=sys.stderr)
+        return 2
+
+    terminal = ("complete", "rolled_back", "refused", "aborted", "idle")
+    deadline = time_lib.monotonic() + args.timeout
+    seen_phases = 0
+    while True:
+        history = status.get("history") or []
+        if not args.json:
+            for entry in history[seen_phases:]:
+                detail = ", ".join(
+                    f"{k}={v}"
+                    for k, v in entry.items()
+                    if k not in ("phase", "t") and v is not None
+                )
+                print(
+                    f"promotion: {entry['phase']}"
+                    + (f" ({detail})" if detail else ""),
+                    flush=True,
+                )
+            seen_phases = len(history)
+        if status.get("state") in terminal:
+            break
+        if time_lib.monotonic() >= deadline:
+            print(
+                f"promote: no terminal state after {args.timeout:.0f}s — "
+                "the promotion is still running fleet-side; re-run to "
+                "re-attach or pass --abort",
+                file=sys.stderr,
+            )
+            return 1
+        time_lib.sleep(0.5)
+        try:
+            status = call("GET")
+        except (OSError, ValueError) as e:
+            print(
+                f"promote: lost the router mid-promotion: {e}",
+                file=sys.stderr,
+            )
+            return 2
+    if args.json:
+        print(json.dumps(status))
+    else:
+        state = status.get("state")
+        line = f"promotion {state}"
+        if status.get("reason"):
+            line += f": {status['reason']}"
+        if status.get("artifacts"):
+            line += f" — fleet artifacts: {status['artifacts']}"
+        print(line, flush=True)
+    return 0 if status.get("state") == "complete" else 1
+
+
 def cmd_quantize_check(args) -> int:
     """Run the f32-vs-quantized accuracy gate (serve/quant_check.py) and
     ledger the verdict; exit status IS the gate."""
@@ -1397,6 +1616,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "fit": cmd_fit,
         "serve": cmd_serve,
         "serve-fleet": cmd_serve_fleet,
+        "promote": cmd_promote,
         "quantize-check": cmd_quantize_check,
         "presets": cmd_presets,
         "records-index": cmd_records_index,

@@ -188,6 +188,11 @@ def resolve_run(ref: str, registry_dir: Optional[str] = None) -> Dict:
 
 # -- deltas ------------------------------------------------------------------
 
+# serving p99 noise band (rel): shared with the promotion controller's
+# canary-latency gate so "regressed" means the same thing in a run compare
+# and in a rollout decision
+SERVE_P99_BAND = 0.15
+
 # (metric label, extractor, direction, threshold, threshold kind)
 # - "rel": |b-a|/|a| must exceed it to leave neutral
 # - "abs": |b-a| must exceed it (fractions and accuracy-like metrics, where
@@ -210,7 +215,7 @@ _METRICS = (
      "lower", 0.0, "abs"),
     ("serve_request_p99_ms",
      lambda r: (r.get("serve") or {}).get("request_p99_ms"),
-     "lower", 0.15, "rel"),
+     "lower", SERVE_P99_BAND, "rel"),
     # capacity/cost trajectories (obs/capacity.py): chip-seconds numbers
     # derive from span wall time (same jitter as step time → same 10% band);
     # the per-request p99 inherits the tail-noise band; device peak bytes is
@@ -239,7 +244,11 @@ def _eval_metric_spec(name: str):
     return "higher", 0.005, "abs"
 
 
-def _verdict(a, b, direction: str, threshold: float, kind: str) -> str:
+def verdict(a, b, direction: str, threshold: float, kind: str) -> str:
+    """Noise-banded A→B verdict: ``neutral`` inside the band, else
+    ``regressed``/``improved`` by ``direction``. Public: the promotion
+    controller (serve/promote.py) gates canary latency deltas through the
+    same bands the run-vs-run compare uses."""
     delta = b - a
     magnitude = abs(delta) if kind == "abs" else (
         abs(delta) / abs(a) if a else float("inf") if delta else 0.0
@@ -248,6 +257,9 @@ def _verdict(a, b, direction: str, threshold: float, kind: str) -> str:
         return "neutral"
     worse = delta > 0 if direction == "lower" else delta < 0
     return "regressed" if worse else "improved"
+
+
+_verdict = verdict  # original private name, kept for callers/tests
 
 
 def compare_rows(row_a: Dict, row_b: Dict) -> Dict:

@@ -127,6 +127,16 @@ def _serve_section(windows: List[Dict]) -> Dict:
     return section
 
 
+def silent_mixed_fleet(fleet_state: Optional[Dict]) -> bool:
+    """The warning condition the report and ``telemetry-top`` must agree
+    on: replicas answering from more than one artifact identity with no
+    promotion controller in charge (``fleet_state`` is a router_window
+    event's ``fleet`` payload)."""
+    fleet_state = fleet_state or {}
+    artifacts = fleet_state.get("artifacts") or {}
+    return len(artifacts) > 1 and not fleet_state.get("promotion_active")
+
+
 def _serve_fleet_section(events: List[Dict]) -> Optional[Dict]:
     """Aggregate the serving-fleet controller's events (serve/fleet.py +
     serve/router.py + serve/autoscale.py): router traffic counters,
@@ -159,6 +169,17 @@ def _serve_fleet_section(events: List[Dict]) -> Optional[Dict]:
             "per_replica_routed": last.get("per_replica_routed", {}),
             "fleet": last.get("fleet", {}),
         }
+        # artifact mix (serve/router.py polls each replica's /healthz
+        # identity): >1 distinct artifact OUTSIDE an active promotion is a
+        # silent mixed fleet — rendered as a warning, not trivia
+        fleet_state = last.get("fleet") or {}
+        artifacts = fleet_state.get("artifacts") or {}
+        if artifacts:
+            section["router"]["artifacts"] = artifacts
+            section["router"]["mixed_artifacts"] = len(artifacts) > 1
+            section["router"]["silent_mixed_fleet"] = silent_mixed_fleet(
+                fleet_state
+            )
     if scales:
         section["autoscale"] = {
             "decisions": len(scales),
@@ -183,6 +204,72 @@ def _serve_fleet_section(events: List[Dict]) -> Optional[Dict]:
         }
     if any(lifecycle.values()):
         section["replicas"] = lifecycle
+    return section
+
+
+_PROMOTION_KINDS = (
+    "promotion_start",
+    "phase_advance",
+    "shadow_window",
+    "promotion_rollback",
+    "promotion_complete",
+)
+
+
+def _promotion_section(events: List[Dict]) -> Optional[Dict]:
+    """The deployment history (serve/promote.py): every promotion the run's
+    controller drove, phase by phase — starts, canary/rollout advances,
+    shadow-compare windows, rollbacks (with reasons), completions. None when
+    the run never promoted."""
+    rows = [e for e in events if e.get("event") in _PROMOTION_KINDS]
+    if not rows:
+        return None
+    shadows = [e for e in rows if e.get("event") == "shadow_window"]
+    rollbacks = [e for e in rows if e.get("event") == "promotion_rollback"]
+    section: Dict = {
+        "events": len(rows),
+        "starts": sum(
+            1
+            for e in rows
+            if e.get("event") == "promotion_start" and not e.get("refused")
+        ),
+        "completed": sum(
+            1 for e in rows if e.get("event") == "promotion_complete"
+        ),
+        "rolled_back": sum(
+            1 for e in rollbacks if e.get("status") == "rolled_back"
+        ),
+        "refused": sum(
+            1 for e in rollbacks if e.get("status") == "refused"
+        ),
+        "aborted": sum(
+            1 for e in rollbacks if e.get("status") == "aborted"
+        ),
+        "shadow_windows": len(shadows),
+        "shadow_compared": sum(e.get("compared", 0) for e in shadows),
+    }
+    history = []
+    for e in rows:
+        entry = {
+            "t": e.get("t"),
+            "kind": e.get("event"),
+        }
+        for k in (
+            "phase", "candidate_dir", "dtype", "fingerprint", "replica",
+            "replaced", "remaining", "reason", "status", "refused",
+            "compared", "min_iou", "mean_disagree", "max_abs_delta",
+            "restored", "drained", "abort_reason", "duration_s", "windows",
+        ):
+            if e.get(k) is not None:
+                entry[k] = e[k]
+        history.append(entry)
+    section["history"] = history
+    if rollbacks:
+        section["last_rollback"] = {
+            k: rollbacks[-1].get(k)
+            for k in ("phase", "reason", "status", "restored", "abort_reason")
+            if rollbacks[-1].get(k) is not None
+        }
     return section
 
 
@@ -446,6 +533,10 @@ def build_report(
     serve_fleet = _serve_fleet_section(events)
     if serve_fleet:
         report["serve_fleet"] = serve_fleet
+
+    promotion = _promotion_section(events)
+    if promotion:
+        report["promotion"] = promotion
 
     quant_checks = [e for e in events if e.get("event") == "quant_check"]
     if quant_checks:
@@ -929,6 +1020,18 @@ def render_report(report: Dict) -> str:
                     f"{fl.get('draining', 0)} draining, "
                     f"{fl.get('dead', 0)} dead"
                 )
+            if rt.get("artifacts"):
+                mix = "  ".join(
+                    f"{key}:{n}" for key, n in sorted(rt["artifacts"].items())
+                )
+                lines.append(f"  artifacts served: {mix}")
+                if rt.get("silent_mixed_fleet"):
+                    lines.append(
+                        "  !! MIXED FLEET outside an active promotion — "
+                        "replicas are answering from different artifacts "
+                        "with no controller in charge; promote or drain "
+                        "until the fingerprints converge"
+                    )
         sc = sf.get("autoscale")
         if sc:
             lines.append(
@@ -952,6 +1055,69 @@ def render_report(report: Dict) -> str:
             if rl.get("abandoned"):
                 line += f", !! {rl['abandoned']} ABANDONED"
             lines.append(line)
+    pm = report.get("promotion")
+    if pm:
+        verdictbits = []
+        if pm["completed"]:
+            verdictbits.append(f"{pm['completed']} completed")
+        if pm["rolled_back"]:
+            verdictbits.append(f"{pm['rolled_back']} ROLLED BACK")
+        if pm["refused"]:
+            verdictbits.append(f"{pm['refused']} refused at admission")
+        if pm["aborted"]:
+            verdictbits.append(f"{pm['aborted']} ABORTED mid-rollback")
+        lines.append(
+            f"\ndeployment history: {pm['starts']} promotion(s) — "
+            + (", ".join(verdictbits) if verdictbits else "in progress")
+            + f"; {pm['shadow_windows']} shadow window(s), "
+            f"{pm['shadow_compared']} request(s) shadow-compared"
+        )
+        for e in pm["history"]:
+            kind = e["kind"]
+            if kind == "promotion_start":
+                what = "refused at admission" if e.get("refused") else "start"
+                lines.append(
+                    f"  - {what}: {e.get('candidate_dir', '?')}"
+                    + (f" [{e['dtype']}]" if e.get("dtype") else "")
+                )
+            elif kind == "phase_advance":
+                detail = ", ".join(
+                    f"{k}={e[k]}"
+                    for k in ("replica", "replaced", "remaining", "windows",
+                              "compared")
+                    if e.get(k) is not None
+                )
+                lines.append(
+                    f"  - phase {e.get('phase')}"
+                    + (f" ({detail})" if detail else "")
+                )
+            elif kind == "shadow_window":
+                detail = ", ".join(
+                    f"{k}={e[k]}"
+                    for k in ("compared", "min_iou", "mean_disagree",
+                              "max_abs_delta")
+                    if e.get(k) is not None
+                )
+                lines.append(f"  - shadow window ({detail})")
+            elif kind == "promotion_rollback":
+                lines.append(
+                    f"  - !! {e.get('status', 'rollback').upper()} at "
+                    f"{e.get('phase', '?')}: {e.get('reason', '?')}"
+                    + (
+                        f" — {e['abort_reason']}"
+                        if e.get("abort_reason")
+                        else ""
+                    )
+                )
+            elif kind == "promotion_complete":
+                lines.append(
+                    f"  - complete: fleet on {e.get('candidate_dir', '?')}"
+                    + (
+                        f" in {e['duration_s']}s"
+                        if e.get("duration_s") is not None
+                        else ""
+                    )
+                )
     for qc in report.get("quant_checks", ()):
         verdict = "PASSED" if qc.get("passed") else "FAILED"
         details = []

@@ -102,6 +102,20 @@ def _process_status(led: fleet_lib.ProcessLedger, now: float) -> Dict:
             row["images_per_sec"] = window["images_per_sec"]
         if window.get("recompiles_post_warmup"):
             row["recompiles_post_warmup"] = window["recompiles_post_warmup"]
+        svc = window.get("data_service")
+        if svc is not None:
+            # the input service's live backpressure (data/service.py):
+            # reorder-buffer depth, consumer-starved takes, worker busy
+            # fraction — "is the input side keeping up", right now
+            srow: Dict = {"underruns": int(svc.get("underruns", 0))}
+            ready = svc.get("ready_depth") or {}
+            if ready.get("mean") is not None:
+                srow["ready_depth_mean"] = ready["mean"]
+            if ready.get("min") is not None:
+                srow["ready_depth_min"] = ready["min"]
+            if svc.get("worker_util") is not None:
+                srow["worker_util"] = svc["worker_util"]
+            row["data_service"] = srow
     serve = _last(events, "serve_window")
     if serve is not None:
         srow: Dict = {
@@ -128,6 +142,17 @@ def _process_status(led: fleet_lib.ProcessLedger, now: float) -> Dict:
             "live": fleet_state.get("live", 0),
             "status": fleet_state.get("status", "?"),
         }
+        artifacts = fleet_state.get("artifacts") or {}
+        if artifacts:
+            from tensorflowdistributedlearning_tpu.obs import (
+                report as report_lib,
+            )
+
+            row["router"]["artifacts"] = artifacts
+            # one definition of "silently mixed" for report AND top
+            row["router"]["mixed"] = report_lib.silent_mixed_fleet(
+                fleet_state
+            )
     marks = capacity_lib.aggregate_watermark_events(events)
     if marks:
         mem: Dict = {"peak_bytes": marks["peak_bytes"]}
@@ -219,6 +244,17 @@ def render_frame(frame: Dict) -> str:
             if row.get("images_per_sec") is not None:
                 bits.append(f"{row['images_per_sec']:.1f} img/s")
             lines.append("  ".join(bits))
+        ds = row.get("data_service")
+        if ds:
+            bits = ["  data-svc:"]
+            if ds.get("ready_depth_mean") is not None:
+                bits.append(f"ready {ds['ready_depth_mean']:.1f}")
+            if ds.get("worker_util") is not None:
+                bits.append(f"workers {ds['worker_util']:.0%} busy")
+            bits.append(f"{ds['underruns']} underrun(s)")
+            if ds["underruns"]:
+                bits.append("!! STARVED")
+            lines.append("  ".join(bits))
         sv = row.get("serve")
         if sv:
             bits = [
@@ -234,11 +270,14 @@ def render_frame(frame: Dict) -> str:
             lines.append("  ".join(bits))
         rt = row.get("router")
         if rt:
-            lines.append(
+            line = (
                 f"  router: {rt['requests']} req, {rt['shed']} shed, "
                 f"backlog {rt['backlog']}, {rt['live']} live "
                 f"[{rt['status']}]"
             )
+            if rt.get("mixed"):
+                line += "  !! MIXED ARTIFACTS (no promotion active)"
+            lines.append(line)
         mem = row.get("memory")
         if mem:
             line = f"  hbm peak {_fmt_bytes(mem['peak_bytes'])}"

@@ -31,6 +31,12 @@ goodput goes.
 - ``serve.autoscale`` — :class:`Autoscaler`: replica count from sustained
   queue depth, SLO degradation, and shed volume; decisions ledgered as
   ``fleet_scale`` events.
+- ``serve.promote`` — :class:`PromotionController`: rolls a candidate
+  artifact across a live fleet — quantize-check admission, shadow-compared
+  canary (the router duplicates a traffic slice, never answers from it),
+  replica-by-replica rollout through drain→relaunch→readmit, automatic
+  rollback on accuracy/latency regression or crash-loop — every transition
+  a ``promotion_*``/``shadow_window`` ledger event.
 
 CLI: ``python -m tensorflowdistributedlearning_tpu serve --artifact-dir D``
 (one replica) or ``serve-fleet --artifact-dir D --replicas N`` (the tier);
@@ -60,8 +66,13 @@ from tensorflowdistributedlearning_tpu.serve.fleet import (
     FleetManager,
     ServeFleet,
 )
+from tensorflowdistributedlearning_tpu.serve.promote import (
+    PromoteConfig,
+    PromotionController,
+)
 from tensorflowdistributedlearning_tpu.serve.quant_check import (
     DEFAULT_THRESHOLDS,
+    output_delta,
     run_quant_check,
 )
 from tensorflowdistributedlearning_tpu.serve.router import FleetRouter
@@ -81,6 +92,8 @@ __all__ = [
     "FleetRouter",
     "InferenceEngine",
     "MicroBatcher",
+    "PromoteConfig",
+    "PromotionController",
     "QueueFullError",
     "Request",
     "RequestTooLargeError",
@@ -88,5 +101,6 @@ __all__ = [
     "ServerClosedError",
     "ServingServer",
     "bind_ephemeral",
+    "output_delta",
     "run_quant_check",
 ]

@@ -64,7 +64,14 @@ R_ABANDONED = "abandoned"
 
 @dataclasses.dataclass
 class FleetConfig:
-    """How every replica in the fleet is launched."""
+    """How every replica in the fleet is launched.
+
+    ``artifact_dir`` is the fleet DEFAULT; individual replicas may carry a
+    per-replica override (``ReplicaProcess.artifact_dir``, set by
+    ``scale_up(artifact_dir=...)``) — the seam the promotion controller
+    (serve/promote.py) rolls a candidate artifact through replica by replica.
+    An override survives monitor restarts: a canary that dies mid-rollout
+    relaunches on the SAME candidate artifact, never silently reverts."""
 
     artifact_dir: str
     workdir: str
@@ -109,19 +116,31 @@ class ReplicaProcess:
         # rest of the fleet
         self.restart_at: Optional[float] = None
         self.restart_backoff_s: float = 0.0
+        # per-replica artifact override (None = the fleet default): persists
+        # across restarts, so a promoted canary stays on its candidate
+        self.artifact_dir: Optional[str] = None
+        # fault drill for this replica's FIRST launch only (scale_up path)
+        self.pending_fault_spec: Optional[str] = None
+        # a drain was explicitly requested (scale_down): the decision is
+        # final — the monitor must never restart this replica, even if its
+        # death raced the reaper into the backoff/restart path
+        self.drain_requested = False
 
     @property
     def pid(self) -> Optional[int]:
         return self.process.pid if self.process is not None else None
 
     def snapshot(self) -> Dict:
-        return {
+        out = {
             "replica": self.replica_id,
             "state": self.state,
             "url": self.url,
             "pid": self.pid,
             "restarts": self.restarts,
         }
+        if self.artifact_dir is not None:
+            out["artifact_dir"] = self.artifact_dir
+        return out
 
 
 class FleetManager:
@@ -143,12 +162,15 @@ class FleetManager:
     # -- launch --------------------------------------------------------------
 
     def _replica_argv(
-        self, replica_id: int, fault_spec: Optional[str]
+        self,
+        replica_id: int,
+        fault_spec: Optional[str],
+        artifact_dir: Optional[str] = None,
     ) -> List[str]:
         cfg = self.config
         argv = [
             cfg.python, "-m", "tensorflowdistributedlearning_tpu", "serve",
-            "--artifact-dir", cfg.artifact_dir,
+            "--artifact-dir", artifact_dir or cfg.artifact_dir,
             "--workdir", cfg.workdir,
             "--host", cfg.host,
             "--port", "0",
@@ -169,9 +191,19 @@ class FleetManager:
             argv += ["--inject-fault", fault_spec]
         return argv
 
-    def _spawn(self, replica_id: int, *, restart_of: Optional[ReplicaProcess] = None) -> ReplicaProcess:
+    def _spawn(
+        self,
+        replica_id: int,
+        *,
+        restart_of: Optional[ReplicaProcess] = None,
+        artifact_dir: Optional[str] = None,
+        fault_spec: Optional[str] = None,
+    ) -> ReplicaProcess:
         cfg = self.config
         rep = restart_of if restart_of is not None else ReplicaProcess(replica_id)
+        if restart_of is None:
+            rep.artifact_dir = artifact_dir
+            rep.pending_fault_spec = fault_spec
         rep.state = R_STARTING
         rep.url = None
         rep.ready.clear()
@@ -180,9 +212,15 @@ class FleetManager:
         # fault drills apply to the FIRST launch only — a restarted replica
         # relaunches clean, so a kill drill converges instead of crash-looping
         fault_spec = None
-        if restart_of is None and cfg.fault_specs:
-            fault_spec = cfg.fault_specs.get(replica_id)
-        argv = self._replica_argv(replica_id, fault_spec)
+        if restart_of is None:
+            if cfg.fault_specs:
+                fault_spec = cfg.fault_specs.get(replica_id)
+            if fault_spec is None and rep.pending_fault_spec:
+                fault_spec = rep.pending_fault_spec
+        rep.pending_fault_spec = None
+        argv = self._replica_argv(
+            replica_id, fault_spec, artifact_dir=rep.artifact_dir
+        )
         env = dict(os.environ)
         # the child runs `-m tensorflowdistributedlearning_tpu`: make the
         # package importable even when the repo is used from a checkout
@@ -222,6 +260,7 @@ class FleetManager:
             pid=rep.process.pid,
             restart=rep.restarts,
             fault_spec=fault_spec,
+            artifact_dir=rep.artifact_dir or cfg.artifact_dir,
         )
         return rep
 
@@ -300,39 +339,78 @@ class FleetManager:
 
     # -- scaling -------------------------------------------------------------
 
-    def scale_up(self) -> int:
+    def scale_up(
+        self,
+        artifact_dir: Optional[str] = None,
+        fault_spec: Optional[str] = None,
+    ) -> int:
         """Spawn one more replica (returns its id). Non-blocking: the replica
-        warms in the background and joins ``endpoints()`` when ready."""
+        warms in the background and joins ``endpoints()`` when ready.
+        ``artifact_dir`` overrides the fleet default for THIS replica (and
+        its restarts) — how the promotion controller introduces a canary;
+        ``fault_spec`` rides its first launch only (drills)."""
         with self._lock:
             rid = self._next_id
             self._next_id += 1
-            rep = self._spawn(rid)
+            rep = self._spawn(
+                rid, artifact_dir=artifact_dir, fault_spec=fault_spec
+            )
             self._replicas[rid] = rep
         return rid
 
     def scale_down(self, replica_id: Optional[int] = None) -> Optional[int]:
         """Drain one replica gracefully (highest-id live one by default):
         SIGTERM triggers the serve drain contract, the monitor reaps the
-        clean exit. Returns the drained id, or None when nothing is live."""
+        clean exit. Returns the drained id, or None when nothing matched.
+
+        The drain decision is FINAL: ``drain_requested`` is stamped before
+        the signal, and the monitor honors it over its own restart machinery
+        — a replica that dies (or already died) while being drained is
+        forgotten, never relaunched. A replica currently in restart backoff
+        (dead, relaunch scheduled) can also be drained: it has no process to
+        signal, so it is simply forgotten and its pending restart cancelled."""
         with self._lock:
             candidates = [
-                r for r in self._replicas.values() if r.state == R_LIVE
+                r
+                for r in self._replicas.values()
+                if r.state in (R_LIVE, R_STARTING, R_BACKOFF)
             ]
             if replica_id is not None:
                 candidates = [
                     r for r in candidates if r.replica_id == replica_id
                 ]
+            else:
+                # never pick a dead-in-backoff replica implicitly: draining
+                # a replica that can actually honor SIGTERM beats cancelling
+                # a restart the operator cannot see
+                signalable = [
+                    r
+                    for r in candidates
+                    if r.state in (R_LIVE, R_STARTING)
+                ]
+                candidates = signalable or candidates
             if not candidates:
                 return None
             rep = max(candidates, key=lambda r: r.replica_id)
+            rep.drain_requested = True
+            was_backoff = rep.state == R_BACKOFF
             rep.state = R_DRAINING
+            if was_backoff:
+                # dead already: nothing to signal, cancel the scheduled
+                # restart by forgetting the replica outright
+                self._replicas.pop(rep.replica_id, None)
+        self.telemetry.event(
+            "replica_drain", replica=rep.replica_id, pid=rep.pid
+        )
+        if was_backoff:
+            self.telemetry.event(
+                "replica_drained", replica=rep.replica_id, rc=rep.exit_code
+            )
+            return rep.replica_id
         try:
             rep.process.send_signal(signal.SIGTERM)
         except (ProcessLookupError, OSError):
             pass
-        self.telemetry.event(
-            "replica_drain", replica=rep.replica_id, pid=rep.pid
-        )
         return rep.replica_id
 
     # -- supervision ---------------------------------------------------------
@@ -351,6 +429,18 @@ class FleetManager:
         now = time.monotonic()
         for rep in self.replicas():
             if rep.state == R_BACKOFF:
+                # the drain decision wins over the reaper: a replica whose
+                # death raced an in-flight scale_down into the backoff path
+                # must be forgotten, not relaunched
+                if rep.drain_requested:
+                    self.telemetry.event(
+                        "replica_drained",
+                        replica=rep.replica_id,
+                        rc=rep.exit_code,
+                    )
+                    with self._lock:
+                        self._replicas.pop(rep.replica_id, None)
+                    continue
                 if now >= (rep.restart_at or 0) and not self._stop.is_set():
                     self._spawn(rep.replica_id, restart_of=rep)
                     self.telemetry.event(
@@ -367,7 +457,7 @@ class FleetManager:
             if rc is None:
                 continue
             rep.exit_code = rc
-            if rep.state == R_DRAINING:
+            if rep.state == R_DRAINING or rep.drain_requested:
                 self.telemetry.event(
                     "replica_drained", replica=rep.replica_id, rc=rc
                 )
@@ -492,6 +582,18 @@ class ServeFleet:
         self.autoscaler = (
             Autoscaler(autoscale) if autoscale is not None else None
         )
+        # promotion surface (serve/promote.py): every fleet can roll a
+        # candidate artifact through canary/shadow/rollback; the router's
+        # /admin/promotion endpoints delegate here (lazy import — promote
+        # imports serve pieces, so a module-level import would cycle)
+        from tensorflowdistributedlearning_tpu.serve.promote import (
+            PromotionController,
+        )
+
+        self.promoter = PromotionController(
+            self.manager, self.router, telemetry=self.telemetry
+        )
+        self.router.promoter = self.promoter
         self.autoscale_interval_s = float(autoscale_interval_s)
         self._stop = threading.Event()
         self._autoscale_thread: Optional[threading.Thread] = None
@@ -533,6 +635,14 @@ class ServeFleet:
 
     def autoscale_tick(self) -> Optional[Dict]:
         """One evaluate-and-apply cycle (also driven directly by tests)."""
+        # scaling pauses while a promotion is in flight: scale_down drains
+        # the highest-id live replica, which mid-promotion is the canary or
+        # the newest candidate — the autoscaler would cancel the rollout it
+        # cannot see (and the routing-excluded shadow canary inflates the
+        # capacity the idle detector divides by). Promotions are short;
+        # pressure resumes scaling the moment the controller finishes.
+        if getattr(self.router, "promotion_active", False):
+            return None
         snapshot = self.router.fleet_snapshot()
         # the router only sees replicas the manager lists as ready, so a
         # spawn still warming (manager state "starting") is invisible to it
@@ -583,6 +693,9 @@ class ServeFleet:
         if self._autoscale_thread is not None:
             self._autoscale_thread.join(timeout=5)
             self._autoscale_thread = None
+        # an in-flight promotion stops promptly (no rollback: the replicas
+        # are being drained out from under it anyway)
+        self.promoter.close()
         self.manager.shutdown(drain=True)
         self.router.shutdown()
         self.telemetry.event("fleet_stop")

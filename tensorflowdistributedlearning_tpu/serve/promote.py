@@ -1,0 +1,851 @@
+"""Promotion controller: canary rollout, shadow traffic, automatic rollback.
+
+The missing edge of the production loop: everything upstream ends at an
+artifact directory (best-fold export, quantized siblings, ``quantize-check``
+gates) and everything downstream starts at a fleet already serving one.
+Nothing connected them — a new artifact reached users at full blast or not
+at all. This module is the connector, in the deployment discipline of
+"TensorFlow: A system for large-scale machine learning" (arXiv:1605.08695)
+and the cost-economics framing of the Gemma-on-TPU serving comparison
+(arXiv:2605.25645): a regression caught one replica deep is a rounding
+error; caught fleet-deep it is an incident.
+
+:class:`PromotionController` drives a live fleet (``serve/fleet.py`` manager
++ ``serve/router.py``) through a phase machine, every transition a ledger
+event ``telemetry-report`` renders as a deployment history:
+
+1. **admission** — offline, before any replica moves: the candidate's
+   manifest must parse, and when a reference artifact is given the full
+   ``quantize-check`` runs (source-fingerprint pairing + per-precision
+   accuracy budgets). A refused candidate never touches the fleet.
+2. **canary** — one replica is rolled through the router's existing
+   drain→relaunch→readmit path onto the candidate artifact (surge style:
+   the canary spawns FIRST, so fleet capacity never dips), and its polled
+   ``/healthz`` artifact identity must verify as the candidate fingerprint
+   before the phase advances.
+3. **shadow** — the router duplicates a configurable slice of accepted
+   traffic to the canary, compares outputs (mask IoU / disagreement /
+   |delta|, ``quant_check.output_delta``) and latency against the serving
+   replica, and NEVER answers clients from it. Each window is a
+   ``shadow_window`` ledger event; an empty-traffic window HOLDS the phase
+   (no divide-by-zero, no advance on no evidence).
+4. **rollout** — remaining incumbents are replaced one at a time
+   (spawn-candidate → ready+identity-verified → drain-incumbent), each step
+   gated on ledgered deltas through ``obs/compare.py`` noise bands.
+5. **complete** — the fleet default artifact flips to the candidate, so
+   autoscaler spawns and monitor restarts stay on it.
+
+Rollback is automatic — accuracy regression past the shadow budgets, canary
+latency regressed past the noise-banded p99 ratio, canary crash-loop, or an
+operator abort — and re-drains every candidate replica back to the incumbent
+artifact, restoring a replacement BEFORE draining so the fleet never dips
+below strength. If the incumbent artifact itself has vanished mid-promotion
+(the one case rollback cannot restore), the controller aborts STRUCTURALLY:
+it ledgers the abort and leaves the surviving replicas answering — a mixed
+or candidate-only fleet beats a dead one.
+
+Drills ride the existing fault seams: the canary's first launch can carry a
+``serve --inject-fault`` spec (``sigkill@N`` kills it mid-shadow); the fleet
+monitor restarts it on the SAME candidate artifact, the router's retry path
+keeps clients whole, and the controller converges — complete or clean
+rollback — distinguishing a single death (tolerated) from a crash-loop
+(rolled back).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+# ledger event kinds (docs/LEDGER_SCHEMA.md "Promotion pipeline")
+PROMOTION_START_EVENT = "promotion_start"
+PHASE_ADVANCE_EVENT = "phase_advance"
+SHADOW_WINDOW_EVENT = "shadow_window"
+PROMOTION_ROLLBACK_EVENT = "promotion_rollback"
+PROMOTION_COMPLETE_EVENT = "promotion_complete"
+
+# controller states (status()["state"])
+S_IDLE = "idle"
+S_RUNNING = "running"
+S_COMPLETE = "complete"
+S_ROLLED_BACK = "rolled_back"
+S_REFUSED = "refused"
+S_ABORTED = "aborted"  # rollback itself could not restore the incumbent
+
+# replica states mirrored from serve/fleet.py (string constants, not an
+# import: fleet.py must stay import-light from here so ServeFleet can own a
+# controller without a module cycle)
+_R_LIVE = "live"
+_R_ABANDONED = "abandoned"
+
+
+@dataclasses.dataclass
+class PromoteConfig:
+    """Knobs for one promotion. Accuracy budgets default LOOSER than the
+    quantize-check per-precision budgets on purpose: a promoted candidate is
+    usually a genuinely different model, so the shadow gate bounds
+    behavioral drift and latency rather than demanding near-equality — a
+    deliberately large model change needs these loosened explicitly."""
+
+    # shadow phase: duplicate ~fraction of accepted traffic per window of
+    # shadow_secs; 0 seconds skips the phase entirely
+    shadow_secs: float = 10.0
+    shadow_fraction: float = 0.25
+    # a window must have compared at least this many requests to be evidence
+    # either way; below it the phase HOLDS (another window runs)
+    shadow_min_requests: int = 8
+    # total time the shadow phase may hold without evidence before the
+    # controller gives up and rolls back (a canary nobody exercised is not a
+    # promotable canary)
+    shadow_max_secs: float = 120.0
+    # accuracy budgets on the shadow compare (quant_check.output_delta math)
+    shadow_max_abs_delta: float = 0.25
+    shadow_max_mean_delta: float = 0.05
+    shadow_min_iou: float = 0.90
+    shadow_max_disagree: float = 0.10
+    # canary non-200s tolerated across a shadow window (shed 429s and
+    # transport failures while the canary restarts are counted separately
+    # and HOLD rather than roll back)
+    shadow_canary_error_tolerance: int = 0
+    # latency gate: the canary's p99 against the serving replicas', decided
+    # through obs/compare.verdict with this ratio as the noise band — 1.5
+    # means "regressed" fires past 1.5x, the promotion-grade width of the
+    # compare module's serve-p99 band
+    max_p99_ratio: float = 1.5
+    # per-rollout-step observation dwell before the gate is evaluated
+    observe_secs: float = 2.0
+    # canary/candidate replica restarts at or past this = crash loop =
+    # rollback (one death is a tolerated blip the supervisor absorbs)
+    crash_loop_threshold: int = 2
+    ready_timeout_s: float = 180.0
+    drain_timeout_s: float = 60.0
+    identity_timeout_s: float = 30.0
+    poll_interval_s: float = 0.25
+
+    def __post_init__(self):
+        if self.shadow_secs < 0:
+            raise ValueError("shadow_secs must be >= 0")
+        if not 0.0 < self.shadow_fraction <= 1.0:
+            raise ValueError("shadow_fraction must be in (0, 1]")
+        if self.max_p99_ratio <= 1.0:
+            raise ValueError("max_p99_ratio must be > 1.0")
+        if self.crash_loop_threshold < 1:
+            raise ValueError("crash_loop_threshold must be >= 1")
+        if self.shadow_min_requests < 1:
+            # 0 would let the first EMPTY window pass every gate vacuously
+            # — the knob that silently disables the safety phase
+            raise ValueError("shadow_min_requests must be >= 1")
+
+
+class _Rollback(Exception):
+    """Internal control flow: a gate tripped — unwind to rollback."""
+
+    def __init__(self, reason: str, phase: str):
+        super().__init__(reason)
+        self.reason = reason
+        self.phase = phase
+
+
+class _Terminal(Exception):
+    """Raised after a terminal state was already recorded (refusal)."""
+
+
+class PromotionController:
+    """One fleet's promotion state machine (at most one in flight)."""
+
+    def __init__(self, manager, router, *, telemetry=None):
+        from tensorflowdistributedlearning_tpu.obs.telemetry import (
+            NULL_TELEMETRY,
+        )
+
+        self.manager = manager
+        self.router = router
+        self.telemetry = telemetry if telemetry is not None else NULL_TELEMETRY
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._abort = threading.Event()
+        self._state = S_IDLE
+        self._phase = "idle"
+        self._reason: Optional[str] = None
+        self._history: List[Dict] = []
+        self._last_shadow: Optional[Dict] = None
+        self._candidate_dir: Optional[str] = None
+        self._reference_dir: Optional[str] = None
+        self._candidate_identity: Optional[Dict] = None
+        self._incumbent_dir: Optional[str] = None
+        self._config = PromoteConfig()
+        self._fault_spec: Optional[str] = None
+        self._started_t: Optional[float] = None
+        # fleet strength at promotion start — what rollback restores to
+        self._orig_count: Optional[int] = None
+
+    # -- public surface ------------------------------------------------------
+
+    def status(self) -> Dict:
+        with self._lock:
+            out: Dict = {
+                "state": self._state,
+                "phase": self._phase,
+                "candidate_dir": self._candidate_dir,
+                "reference_dir": self._reference_dir,
+                "incumbent_dir": self._incumbent_dir,
+                "history": list(self._history),
+            }
+            if self._candidate_identity:
+                out["candidate"] = self._candidate_identity
+            if self._last_shadow:
+                out["shadow"] = self._last_shadow
+            if self._reason:
+                out["reason"] = self._reason
+            if self._started_t:
+                out["started_t"] = self._started_t
+        try:
+            out["artifacts"] = self.router.artifact_mix()
+        except Exception:  # noqa: BLE001 — status must always answer
+            pass
+        return out
+
+    def start(
+        self,
+        candidate_dir: str,
+        *,
+        reference_dir: Optional[str] = None,
+        config: Optional[PromoteConfig] = None,
+        fault_spec: Optional[str] = None,
+    ) -> Dict:
+        """Launch a promotion in the background; returns the initial status.
+        Raises ``RuntimeError`` when one is already in flight."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                raise RuntimeError(
+                    f"a promotion is already in flight (phase {self._phase})"
+                )
+            self._abort.clear()
+            self._state = S_RUNNING
+            self._phase = "admission"
+            self._reason = None
+            self._history = []
+            self._last_shadow = None
+            self._candidate_dir = candidate_dir
+            self._reference_dir = reference_dir
+            self._candidate_identity = None
+            self._incumbent_dir = self.manager.config.artifact_dir
+            self._config = config or PromoteConfig()
+            self._fault_spec = fault_spec
+            self._started_t = time.time()
+            self._thread = threading.Thread(
+                target=self._run, name="promotion", daemon=True
+            )
+            self._thread.start()
+        return self.status()
+
+    def admin_start(self, payload: Dict) -> Dict:
+        """The /admin/promotion POST body → ``start`` (the `promote` CLI's
+        wire format). Unknown keys are rejected loudly — a typoed threshold
+        silently ignored would be a gate that never fires."""
+        payload = dict(payload)
+        payload.pop("action", None)
+        candidate_dir = payload.pop("candidate_dir", None)
+        if not candidate_dir:
+            raise ValueError("candidate_dir is required")
+        reference_dir = payload.pop("reference_dir", None)
+        fault_spec = payload.pop("fault_spec", None)
+        fields = {f.name for f in dataclasses.fields(PromoteConfig)}
+        unknown = set(payload) - fields
+        if unknown:
+            raise ValueError(
+                f"unknown promotion option(s): {sorted(unknown)} "
+                f"(valid: {sorted(fields)})"
+            )
+        config = PromoteConfig(**payload)
+        return self.start(
+            candidate_dir,
+            reference_dir=reference_dir,
+            config=config,
+            fault_spec=fault_spec,
+        )
+
+    def abort(self) -> None:
+        """Operator abort: the running promotion unwinds to rollback at its
+        next gate check. A no-op when nothing is in flight."""
+        self._abort.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        t = self._thread
+        if t is None:
+            return True
+        t.join(timeout)
+        return not t.is_alive()
+
+    def close(self) -> None:
+        """Fleet shutdown: stop promptly, don't roll back — the replicas are
+        being drained out from under us anyway."""
+        self._abort.set()
+        self.wait(timeout=5)
+
+    # -- phase machine -------------------------------------------------------
+
+    def _set_phase(self, phase: str, **fields) -> None:
+        with self._lock:
+            self._phase = phase
+            self._history.append(
+                {"phase": phase, "t": round(time.time(), 3), **fields}
+            )
+
+    def _check_abort(self, phase: str) -> None:
+        if self._abort.is_set():
+            raise _Rollback("operator abort", phase)
+
+    def _run(self) -> None:
+        cfg = self._config
+        candidate_dir = self._candidate_dir
+        try:
+            identity = self._admission()
+            self.router.promotion_active = True
+            self.telemetry.event(
+                PROMOTION_START_EVENT,
+                candidate_dir=candidate_dir,
+                reference_dir=self._reference_dir,
+                dtype=(identity or {}).get("dtype"),
+                fingerprint=(identity or {}).get("source_fingerprint"),
+                replicas=len(self._live_replicas()),
+                shadow_secs=cfg.shadow_secs,
+                shadow_fraction=cfg.shadow_fraction,
+            )
+            baseline_p99 = self._fleet_p99()
+            # the fleet strength rollback must restore (captured BEFORE the
+            # canary makes it N+1)
+            self._orig_count = len(self._live_replicas())
+            canary = self._canary(identity)
+            self._shadow(canary, baseline_p99)
+            self._rollout(identity, baseline_p99)
+            self._complete(identity)
+        except _Terminal:
+            pass  # refusal: already ledgered, fleet untouched
+        except _Rollback as rb:
+            self._rollback(rb.reason, rb.phase)
+        except Exception as e:  # noqa: BLE001 — a controller bug must still
+            # leave a consistent fleet and a ledgered verdict, never a
+            # silently-dead thread mid-rollout
+            logger.exception("promotion failed unexpectedly")
+            self._rollback(f"internal: {type(e).__name__}: {e}", self._phase)
+        finally:
+            self.router.promotion_active = False
+
+    # -- phases --------------------------------------------------------------
+
+    def _admission(self) -> Optional[Dict]:
+        """Offline gate: manifest parses; with a reference, the full
+        quantize-check (fingerprint pairing + accuracy budgets) must pass.
+        Refusal never touches the fleet — there is nothing to roll back."""
+        from tensorflowdistributedlearning_tpu.train import (
+            serving as serving_lib,
+        )
+
+        self._set_phase("admission", candidate_dir=self._candidate_dir)
+        try:
+            manifest = serving_lib.read_manifest(self._candidate_dir)
+        except (OSError, ValueError, KeyError) as e:
+            self._refuse(f"candidate manifest unreadable: {e}")
+        identity = manifest.get("quantization")
+        if self._reference_dir:
+            from tensorflowdistributedlearning_tpu.serve.quant_check import (
+                run_quant_check,
+            )
+
+            result = run_quant_check(
+                self._reference_dir,
+                self._candidate_dir,
+                telemetry=self.telemetry,
+            )
+            if not result["passed"]:
+                self._refuse(
+                    "quantize-check failed: " + "; ".join(result["failures"])
+                )
+        with self._lock:
+            self._candidate_identity = (
+                {
+                    "dtype": identity.get("dtype"),
+                    "source_fingerprint": identity.get("source_fingerprint"),
+                }
+                if identity
+                else None
+            )
+        return self._candidate_identity
+
+    def _refuse(self, reason: str) -> None:
+        """Admission refusal: terminal, fleet untouched."""
+        self.telemetry.event(
+            PROMOTION_START_EVENT,
+            candidate_dir=self._candidate_dir,
+            reference_dir=self._reference_dir,
+            refused=True,
+        )
+        self.telemetry.event(
+            PROMOTION_ROLLBACK_EVENT,
+            phase="admission",
+            reason=reason,
+            status=S_REFUSED,
+            candidate_dir=self._candidate_dir,
+        )
+        with self._lock:
+            self._state = S_REFUSED
+            self._reason = reason
+            self._phase = "refused"
+        logger.warning("promotion refused at admission: %s", reason)
+        raise _Terminal()
+
+    def _canary(self, identity: Optional[Dict]) -> int:
+        """Spawn the canary on the candidate artifact, excluded from routing
+        (shadow-armed) from the first instant, and verify its polled
+        identity IS the candidate before anything advances."""
+        cfg = self._config
+        self._check_abort("canary")
+        self._set_phase("canary")
+        rid = self.manager.scale_up(
+            artifact_dir=self._candidate_dir, fault_spec=self._fault_spec
+        )
+        # exclusion before readiness: the router must never route a client
+        # to the canary, including the poll cycle that first admits it
+        self.router.start_shadow(rid, cfg.shadow_fraction)
+        self._wait_ready(rid, "canary")
+        self._verify_identity(rid, identity, "canary")
+        # readiness + identity verified: re-arm (start_shadow resets the
+        # stats window) so the shadow windows measure only post-warmup
+        # traffic, not spawn-time noise
+        self.router.start_shadow(rid, cfg.shadow_fraction)
+        self._set_phase("canary_ready", replica=rid)
+        self.telemetry.event(
+            PHASE_ADVANCE_EVENT,
+            phase="canary",
+            replica=rid,
+            candidate_dir=self._candidate_dir,
+            fingerprint=(identity or {}).get("source_fingerprint"),
+        )
+        return rid
+
+    def _shadow(self, canary_rid: int, baseline_p99: Optional[float]) -> None:
+        """Shadow windows until one carries enough evidence to advance —
+        or a budget/latency gate rolls the whole thing back. Empty windows
+        hold; a canary death mid-window holds too (the supervisor restarts
+        it on the same artifact), but a crash-loop rolls back."""
+        from tensorflowdistributedlearning_tpu.obs import compare as compare_lib
+
+        cfg = self._config
+        if cfg.shadow_secs <= 0:
+            self.router.stop_shadow()
+            return
+        self._set_phase("shadow", replica=canary_rid)
+        deadline = time.monotonic() + cfg.shadow_max_secs
+        window = 0
+        while True:
+            self._check_abort("shadow")
+            self._abort.wait(cfg.shadow_secs)
+            self._check_abort("shadow")
+            self._watch_crash_loop("shadow")
+            window += 1
+            snap = self.router.shadow_snapshot(drain=True) or {}
+            snap["window"] = window
+            snap["phase"] = "shadow"
+            with self._lock:
+                self._last_shadow = snap
+            self.telemetry.event(SHADOW_WINDOW_EVENT, **snap)
+            compared = snap.get("compared", 0)
+            if compared >= cfg.shadow_min_requests:
+                self._gate_shadow(snap, baseline_p99, compare_lib)
+                break
+            # not enough evidence: the phase HOLDS — but not forever
+            if time.monotonic() >= deadline:
+                raise _Rollback(
+                    f"shadow starved: {compared} compared request(s) in "
+                    f"{cfg.shadow_max_secs:.0f}s (need "
+                    f"{cfg.shadow_min_requests})",
+                    "shadow",
+                )
+            logger.info(
+                "shadow window %d holds: %d/%d compared",
+                window, compared, cfg.shadow_min_requests,
+            )
+        self.router.stop_shadow()
+        self.telemetry.event(
+            PHASE_ADVANCE_EVENT,
+            phase="shadow_complete",
+            replica=canary_rid,
+            windows=window,
+            compared=snap.get("compared", 0),
+        )
+        self._set_phase("shadow_complete", windows=window)
+
+    def _gate_shadow(self, snap: Dict, baseline_p99, compare_lib) -> None:
+        """The shadow verdict: accuracy budgets (quant_check math) and the
+        noise-banded latency ratio. Any trip = rollback with the metric in
+        the reason."""
+        cfg = self._config
+        if snap.get("canary_errors", 0) > cfg.shadow_canary_error_tolerance:
+            raise _Rollback(
+                f"canary answered {snap['canary_errors']} error(s) on "
+                "shadow traffic",
+                "shadow",
+            )
+        if snap.get("max_abs_delta", 0.0) > cfg.shadow_max_abs_delta:
+            raise _Rollback(
+                f"accuracy: max|delta| {snap['max_abs_delta']} > "
+                f"{cfg.shadow_max_abs_delta}",
+                "shadow",
+            )
+        if snap.get("mean_abs_delta", 0.0) > cfg.shadow_max_mean_delta:
+            raise _Rollback(
+                f"accuracy: mean|delta| {snap['mean_abs_delta']} > "
+                f"{cfg.shadow_max_mean_delta}",
+                "shadow",
+            )
+        if (
+            snap.get("min_iou") is not None
+            and snap["min_iou"] < cfg.shadow_min_iou
+        ):
+            raise _Rollback(
+                f"accuracy: mask IoU {snap['min_iou']} < {cfg.shadow_min_iou}",
+                "shadow",
+            )
+        if (
+            snap.get("mean_disagree") is not None
+            and snap["mean_disagree"] > cfg.shadow_max_disagree
+        ):
+            raise _Rollback(
+                f"accuracy: disagreement {snap['mean_disagree']} > "
+                f"{cfg.shadow_max_disagree}",
+                "shadow",
+            )
+        latency = snap.get("latency_ms") or {}
+        primary_p99 = latency.get("primary_p99") or baseline_p99
+        canary_p99 = latency.get("canary_p99")
+        if primary_p99 and canary_p99:
+            lat_verdict = compare_lib.verdict(
+                primary_p99,
+                canary_p99,
+                "lower",
+                cfg.max_p99_ratio - 1.0,
+                "rel",
+            )
+            snap["latency_verdict"] = lat_verdict
+            if lat_verdict == "regressed":
+                raise _Rollback(
+                    f"latency: canary p99 {canary_p99}ms vs serving "
+                    f"{primary_p99}ms regressed past the "
+                    f"{cfg.max_p99_ratio}x band",
+                    "shadow",
+                )
+
+    def _rollout(
+        self, identity: Optional[Dict], baseline_p99: Optional[float]
+    ) -> None:
+        """Replace remaining incumbents one at a time, spawn-first so fleet
+        capacity never dips, each step gated before the next begins."""
+        from tensorflowdistributedlearning_tpu.obs import compare as compare_lib
+
+        # the canary (admitted to routing by now) made the fleet N+1 strong;
+        # drain one incumbent to return to N, then replace the rest
+        incumbents = self._incumbent_replicas()
+        first = True
+        while incumbents:
+            self._check_abort("rollout")
+            old = incumbents.pop(0)
+            if first:
+                first = False
+            else:
+                new_rid = self.manager.scale_up(
+                    artifact_dir=self._candidate_dir
+                )
+                self._wait_ready(new_rid, "rollout")
+                self._verify_identity(new_rid, identity, "rollout")
+            self._drain(old.replica_id, "rollout")
+            self._observe_gate(baseline_p99, compare_lib)
+            remaining = len(self._incumbent_replicas())
+            self.telemetry.event(
+                PHASE_ADVANCE_EVENT,
+                phase="rollout",
+                replaced=old.replica_id,
+                remaining=remaining,
+            )
+            self._set_phase(
+                "rollout", replaced=old.replica_id, remaining=remaining
+            )
+            incumbents = self._incumbent_replicas()
+
+    def _observe_gate(self, baseline_p99, compare_lib) -> None:
+        """Post-step dwell + gate: candidate replicas must stay healthy and
+        the fleet p99 inside the noise-banded ratio of the pre-promotion
+        baseline."""
+        cfg = self._config
+        self._abort.wait(cfg.observe_secs)
+        self._check_abort("rollout")
+        self._watch_crash_loop("rollout")
+        try:
+            self.router.poll_once()
+        except Exception:  # noqa: BLE001 — the background poller covers this
+            pass
+        p99 = self._fleet_p99()
+        if baseline_p99 and p99:
+            lat_verdict = compare_lib.verdict(
+                baseline_p99, p99, "lower", cfg.max_p99_ratio - 1.0, "rel"
+            )
+            if lat_verdict == "regressed":
+                raise _Rollback(
+                    f"latency: fleet p99 {p99}ms vs baseline "
+                    f"{baseline_p99}ms regressed past the "
+                    f"{cfg.max_p99_ratio}x band",
+                    "rollout",
+                )
+
+    def _complete(self, identity: Optional[Dict]) -> None:
+        # future spawns (autoscaler, restarts) come up on the candidate:
+        # the promotion is durable, not a transient override
+        self.manager.config.artifact_dir = self._candidate_dir
+        self.telemetry.event(
+            PROMOTION_COMPLETE_EVENT,
+            candidate_dir=self._candidate_dir,
+            fingerprint=(identity or {}).get("source_fingerprint"),
+            dtype=(identity or {}).get("dtype"),
+            replicas=len(self._live_replicas()),
+            duration_s=round(time.time() - (self._started_t or time.time()), 3),
+        )
+        with self._lock:
+            self._state = S_COMPLETE
+            self._phase = "complete"
+        logger.info(
+            "promotion complete: fleet on %s", self._candidate_dir
+        )
+
+    # -- rollback ------------------------------------------------------------
+
+    def _rollback(self, reason: str, phase: str) -> None:
+        """Re-drain every candidate replica back to the incumbent artifact,
+        restore-before-drain so capacity never dips. The unrecoverable case
+        — the incumbent artifact is gone — aborts structurally: ledgered,
+        surviving replicas left answering, never a dead fleet."""
+        if self._state_is_terminal():
+            return
+        logger.warning("promotion rolling back (%s): %s", phase, reason)
+        self._set_phase("rollback", reason=reason)
+        try:
+            self.router.stop_shadow()
+        except Exception:  # noqa: BLE001
+            pass
+        restored = 0
+        drained = 0
+        # restore to the strength the fleet had BEFORE the promotion: a
+        # shadow-only canary drains without a replacement (the fleet never
+        # lost capacity), replaced incumbents each get one back first
+        target = self._orig_count or len(self._live_replicas()) or 1
+        for rep in self._candidate_replicas():
+            need_replacement = len(self._incumbent_replicas()) < target
+            if need_replacement:
+                new_rid = self.manager.scale_up(artifact_dir=None)
+                try:
+                    self._wait_ready(new_rid, "rollback")
+                except _Rollback as e:
+                    # the incumbent artifact cannot come back (deleted dir,
+                    # broken export): structured abort — forget the failed
+                    # replacement, KEEP the candidate replicas serving
+                    self.manager.scale_down(new_rid)
+                    self.telemetry.event(
+                        PROMOTION_ROLLBACK_EVENT,
+                        phase=phase,
+                        reason=reason,
+                        status=S_ABORTED,
+                        abort_reason=(
+                            "incumbent artifact unavailable during "
+                            f"rollback: {e.reason}"
+                        ),
+                        restored=restored,
+                        candidate_replicas_kept=len(
+                            self._candidate_replicas()
+                        ),
+                    )
+                    with self._lock:
+                        self._state = S_ABORTED
+                        self._phase = "aborted"
+                        self._reason = (
+                            f"{reason}; rollback aborted: incumbent "
+                            f"unavailable ({e.reason})"
+                        )
+                    logger.error(
+                        "rollback ABORTED: incumbent artifact unavailable — "
+                        "leaving %d candidate replica(s) serving",
+                        len(self._candidate_replicas()),
+                    )
+                    return
+                restored += 1
+            try:
+                self._drain(rep.replica_id, "rollback")
+            except _Rollback:
+                # a candidate replica that will not drain is eventually
+                # reaped by the manager; keep going — the goal is incumbent
+                # capacity, which the replacement already restored
+                logger.warning(
+                    "candidate replica %d did not drain in time",
+                    rep.replica_id,
+                )
+            else:
+                drained += 1
+        self.telemetry.event(
+            PROMOTION_ROLLBACK_EVENT,
+            phase=phase,
+            reason=reason,
+            status=S_ROLLED_BACK,
+            restored=restored,
+            drained=drained,
+        )
+        with self._lock:
+            self._state = S_ROLLED_BACK
+            self._phase = "rolled_back"
+            self._reason = reason
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _state_is_terminal(self) -> bool:
+        with self._lock:
+            return self._state in (S_REFUSED, S_COMPLETE)
+
+    def _live_replicas(self, exclude: Optional[int] = None) -> List:
+        return [
+            r
+            for r in self.manager.replicas()
+            if r.state == _R_LIVE and r.replica_id != exclude
+        ]
+
+    def _rep_artifact_dir(self, rep) -> str:
+        return rep.artifact_dir or self._incumbent_dir
+
+    def _incumbent_replicas(self) -> List:
+        return [
+            r
+            for r in self._live_replicas()
+            if self._rep_artifact_dir(r) != self._candidate_dir
+        ]
+
+    def _candidate_replicas(self) -> List:
+        return [
+            r
+            for r in self.manager.replicas()
+            if r.artifact_dir == self._candidate_dir
+            and r.state != _R_ABANDONED
+        ]
+
+    def _find(self, rid: int):
+        for r in self.manager.replicas():
+            if r.replica_id == rid:
+                return r
+        return None
+
+    def _watch_crash_loop(self, phase: str) -> None:
+        threshold = self._config.crash_loop_threshold
+        for rep in self._candidate_replicas():
+            if rep.restarts >= threshold or rep.state == _R_ABANDONED:
+                raise _Rollback(
+                    f"candidate replica {rep.replica_id} crash-looping "
+                    f"({rep.restarts} restart(s), state {rep.state})",
+                    phase,
+                )
+
+    def _wait_ready(self, rid: int, phase: str) -> None:
+        """Block until the replica reports ready; bail early on abandonment
+        or a spawn that dies before EVER becoming ready (a missing artifact
+        fails in seconds — no point burning the full timeout)."""
+        cfg = self._config
+        deadline = time.monotonic() + cfg.ready_timeout_s
+        while time.monotonic() < deadline:
+            rep = self._find(rid)
+            if rep is None:
+                raise _Rollback(f"replica {rid} vanished during spawn", phase)
+            if rep.state == _R_ABANDONED:
+                raise _Rollback(
+                    f"replica {rid} abandoned during spawn "
+                    f"({rep.restarts} failed launch(es))",
+                    phase,
+                )
+            if rep.ready.is_set() and rep.state == _R_LIVE:
+                return
+            if (
+                rep.url is None
+                and rep.restarts >= self._config.crash_loop_threshold
+            ):
+                # died repeatedly before EVER becoming ready: the spawn
+                # itself is broken (missing artifact, bad export). One
+                # death stays a tolerated blip — the monitor's backoff
+                # relaunch gets its chance before we give the spawn up
+                raise _Rollback(
+                    f"replica {rid} died {rep.restarts} time(s) before "
+                    f"becoming ready (rc={rep.exit_code})",
+                    phase,
+                )
+            if self._abort.wait(cfg.poll_interval_s):
+                raise _Rollback("operator abort", phase)
+        raise _Rollback(
+            f"replica {rid} not ready after {cfg.ready_timeout_s:.0f}s",
+            phase,
+        )
+
+    def _verify_identity(
+        self, rid: int, identity: Optional[Dict], phase: str
+    ) -> None:
+        """The router's polled /healthz identity for ``rid`` must BE the
+        candidate — trust what the replica answers, not what was launched.
+        Candidates without a fingerprint (legacy manifests) skip the check."""
+        if not identity or not identity.get("source_fingerprint"):
+            logger.info(
+                "candidate carries no source fingerprint — identity "
+                "verification skipped"
+            )
+            return
+        cfg = self._config
+        want = identity["source_fingerprint"]
+        deadline = time.monotonic() + cfg.identity_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                self.router.poll_once()
+            except Exception:  # noqa: BLE001
+                pass
+            seen = self.router.replica_artifacts().get(rid)
+            if seen and seen.get("source_fingerprint") == want:
+                return
+            if seen and seen.get("source_fingerprint") not in (None, want):
+                raise _Rollback(
+                    f"replica {rid} serves fingerprint "
+                    f"{seen['source_fingerprint'][:8]}…, expected "
+                    f"{want[:8]}…",
+                    phase,
+                )
+            if self._abort.wait(cfg.poll_interval_s):
+                raise _Rollback("operator abort", phase)
+        raise _Rollback(
+            f"replica {rid} identity unverified after "
+            f"{cfg.identity_timeout_s:.0f}s",
+            phase,
+        )
+
+    def _drain(self, rid: int, phase: str) -> None:
+        cfg = self._config
+        if self.manager.scale_down(rid) is None:
+            # already gone (reaped, abandoned): the goal state holds
+            return
+        deadline = time.monotonic() + cfg.drain_timeout_s
+        while time.monotonic() < deadline:
+            if self._find(rid) is None:
+                return
+            time.sleep(cfg.poll_interval_s)
+        raise _Rollback(
+            f"replica {rid} did not drain within {cfg.drain_timeout_s:.0f}s",
+            phase,
+        )
+
+    def _fleet_p99(self) -> Optional[float]:
+        try:
+            return self.router.fleet_snapshot().get("worst_p99_ms")
+        except Exception:  # noqa: BLE001
+            return None

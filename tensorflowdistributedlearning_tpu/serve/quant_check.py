@@ -78,9 +78,12 @@ def _is_binary(a: np.ndarray) -> bool:
     return a.size > 0 and bool(np.isin(np.unique(a), (0, 1)).all())
 
 
-def _output_delta(name: str, ref: np.ndarray, cand: np.ndarray) -> Dict:
+def output_delta(name: str, ref: np.ndarray, cand: np.ndarray) -> Dict:
     """Delta record for one output; the applicable threshold keys depend on
-    which of the three output kinds this is."""
+    which of the three output kinds this is. Public: the promotion
+    controller's shadow compare (serve/router.py) reuses exactly this math
+    on live traffic — the canary's answer plays ``cand`` against the serving
+    replica's ``ref``."""
     if ref.shape != cand.shape:
         return {"error": f"shape mismatch: {ref.shape} vs {cand.shape}"}
     if np.issubdtype(ref.dtype, np.integer) or np.issubdtype(
@@ -162,7 +165,7 @@ def run_quant_check(
                 f"output names differ: {sorted(ref_out)} vs {sorted(cand_out)}"
             )
         for name in sorted(set(ref_out) & set(cand_out)):
-            rec = _output_delta(
+            rec = output_delta(
                 name, np.asarray(ref_out[name]), np.asarray(cand_out[name])
             )
             outputs[name] = rec

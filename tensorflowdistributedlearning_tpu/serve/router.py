@@ -30,11 +30,32 @@ Routing policy (the signals PRs 7-8 built, finally consumed):
   advertised — so saturation is explicit backpressure end to end, never
   unbounded queueing; no replicas at all is 503 ``no_replicas``.
 
+Artifact identity: every poll also captures the replica's ``/healthz``
+artifact identity (quantization dtype + source fingerprint), so a MIXED
+fleet — replicas answering from different artifacts — is first-class state:
+the aggregate ``/healthz`` and every ``router_window`` event report the
+fleet's artifact mix, and ``telemetry-report`` warns when a fleet is mixed
+OUTSIDE an active promotion (``promotion_active`` is stamped by the
+promotion controller while a rollout is legitimately mixed).
+
+Shadow traffic (the promotion controller's canary probe): while a shadow
+target is armed (``start_shadow``), the router duplicates a configurable
+slice of ACCEPTED ``/v1/predict`` traffic to that replica off the request
+path — bounded queue, drop-on-full — compares the canary's outputs against
+the answer the client actually received (mask IoU / disagreement / |delta|,
+``serve.quant_check.output_delta``) and its latency against the serving
+replica's, and NEVER answers a client from the shadow target (it is excluded
+from routing candidates entirely). The accumulated stats drain through
+``shadow_snapshot`` into the controller's ``shadow_window`` ledger events.
+
 ``/healthz`` aggregates fleet state (``ok`` while at least one replica is
 healthy; ``degraded``/``draining``/``down`` otherwise, with per-replica
 detail); ``/metrics`` returns the router's counters plus every replica's last
 polled snapshot. Periodic ``router_window`` ledger events carry the same
-counters, rendered by ``telemetry-report``.
+counters, rendered by ``telemetry-report``. ``/admin/promotion`` (GET state,
+POST start/abort) delegates to the promotion controller the owning
+``ServeFleet`` registers as ``router.promoter`` — the remote-control surface
+the ``promote`` CLI drives a live fleet through.
 """
 
 from __future__ import annotations
@@ -42,6 +63,7 @@ from __future__ import annotations
 import http.client
 import json
 import logging
+import queue
 import socket
 import threading
 import time
@@ -73,6 +95,20 @@ _COUNTERS = (
 )
 
 
+def artifact_key(artifact: Optional[Dict]) -> str:
+    """One short label for an artifact identity — ``dtype:fingerprint8`` —
+    used everywhere the fleet's artifact mix is aggregated (healthz,
+    router_window, the report's mixed-fleet warning). Unknown identities
+    (raw-closure engines, pre-identity replicas) all fold into "unknown"."""
+    if not artifact:
+        return "unknown"
+    fp = artifact.get("source_fingerprint") or ""
+    # fingerprints are "sha256:<hex>" (train/quantize.py): strip the hash
+    # name so the 8 chars that remain actually discriminate artifacts
+    fp = fp.split(":", 1)[-1]
+    return f"{artifact.get('dtype') or '?'}:{fp[:8] or '?'}"
+
+
 class ReplicaState:
     """The router's live view of one replica (updated by polls + forwards)."""
 
@@ -96,6 +132,12 @@ class ReplicaState:
         self.rps_per_chip: Optional[float] = None
         self.chip_seconds_total: float = 0.0
         self.n_chips: int = 1
+        # the replica's /healthz artifact identity (quantization dtype +
+        # source fingerprint), captured on every poll: mixed-fleet state is
+        # first-class — the promotion controller verifies a canary actually
+        # serves the candidate through this, and the aggregate healthz /
+        # router_window report the fleet's artifact mix from it
+        self.artifact: Optional[Dict] = None
 
     @property
     def routable(self) -> bool:
@@ -122,12 +164,107 @@ class ReplicaState:
             out["rps_per_chip"] = self.rps_per_chip
         if self.chip_seconds_total:
             out["chip_seconds_total"] = self.chip_seconds_total
+        if self.artifact is not None:
+            out["artifact"] = self.artifact
         return out
 
 
 EndpointsLike = Union[
     Callable[[], Sequence[Tuple[int, str]]], Sequence[Tuple[int, str]]
 ]
+
+
+class ShadowStats:
+    """Accumulated shadow-compare results for one shadow window.
+
+    Filled by the router's shadow worker (off the request path), drained by
+    the promotion controller into ``shadow_window`` ledger events. Every
+    aggregate is defined for the EMPTY window (no divide-by-zero anywhere):
+    a window with ``compared == 0`` simply reports counts of zero and None
+    deltas — the controller holds the phase instead of advancing on it."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.selected = 0        # accepted requests picked for duplication
+        self.dropped = 0         # shadow queue full: sample skipped
+        self.compared = 0        # canary answered and outputs were compared
+        self.canary_errors = 0   # canary answered non-200
+        self.send_failures = 0   # network failure talking to the canary
+        self.max_abs_delta = 0.0
+        self.sum_mean_abs_delta = 0.0
+        self.min_iou: Optional[float] = None
+        self.sum_disagree = 0.0
+        self.disagree_n = 0      # compares that produced a disagree/IoU row
+        self.primary_s: List[float] = []
+        self.canary_s: List[float] = []
+
+    def note_outputs(self, deltas: Dict[str, Dict]) -> None:
+        """Fold one request's per-output delta records (quant_check math)."""
+        with self.lock:
+            self.compared += 1
+            for rec in deltas.values():
+                if "max_abs_delta" in rec:
+                    self.max_abs_delta = max(
+                        self.max_abs_delta, rec["max_abs_delta"]
+                    )
+                    self.sum_mean_abs_delta += rec["mean_abs_delta"]
+                if "iou" in rec:
+                    self.min_iou = (
+                        rec["iou"]
+                        if self.min_iou is None
+                        else min(self.min_iou, rec["iou"])
+                    )
+                if "disagree" in rec:
+                    self.sum_disagree += rec["disagree"]
+                    self.disagree_n += 1
+
+    def note_latency(self, primary_s: float, canary_s: float) -> None:
+        with self.lock:
+            # bounded: shadow windows are short; 4096 samples is plenty for
+            # a p99 and keeps a runaway window from growing host memory
+            if len(self.primary_s) < 4096:
+                self.primary_s.append(primary_s)
+                self.canary_s.append(canary_s)
+
+    def snapshot(self) -> Dict:
+        """One window record; all ratios None when nothing was compared."""
+        with self.lock:
+            out: Dict = {
+                "selected": self.selected,
+                "compared": self.compared,
+                "dropped": self.dropped,
+                "canary_errors": self.canary_errors,
+                "send_failures": self.send_failures,
+            }
+            if self.compared:
+                out["max_abs_delta"] = round(self.max_abs_delta, 6)
+                out["mean_abs_delta"] = round(
+                    self.sum_mean_abs_delta / self.compared, 6
+                )
+            if self.min_iou is not None:
+                out["min_iou"] = round(self.min_iou, 6)
+            if self.disagree_n:
+                out["mean_disagree"] = round(
+                    self.sum_disagree / self.disagree_n, 6
+                )
+            if self.primary_s and self.canary_s:
+                p = sorted(self.primary_s)
+                c = sorted(self.canary_s)
+
+                def pct(xs, q):
+                    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+                out["latency_ms"] = {
+                    "primary_p50": round(pct(p, 0.50) * 1000, 3),
+                    "primary_p99": round(pct(p, 0.99) * 1000, 3),
+                    "canary_p50": round(pct(c, 0.50) * 1000, 3),
+                    "canary_p99": round(pct(c, 0.99) * 1000, 3),
+                }
+                if pct(p, 0.99) > 0:
+                    out["latency_ms"]["canary_p99_ratio"] = round(
+                        pct(c, 0.99) / pct(p, 0.99), 3
+                    )
+            return out
 
 
 class FleetRouter:
@@ -170,6 +307,24 @@ class FleetRouter:
         self._shutdown_lock = threading.Lock()
         self._shut_down = False
         self._conn_local = threading.local()
+        # promotion surface: the owning ServeFleet registers its controller
+        # here; /admin/promotion delegates to it. promotion_active is stamped
+        # by the controller while the fleet is LEGITIMATELY mixed (mid-
+        # rollout), so the report can warn about a silent mixed fleet without
+        # false-alarming on every promotion.
+        self.promoter = None
+        self.promotion_active = False
+        # shadow traffic state (promotion canary probe): while armed, a
+        # 1-in-shadow_stride slice of accepted requests is duplicated to the
+        # shadow replica off the request path; the shadow replica is never a
+        # routing candidate
+        self._shadow_replica: Optional[int] = None
+        self._shadow_stride = 1
+        self._shadow_counter = 0
+        self._shadow_stats: Optional[ShadowStats] = None
+        self._shadow_queue: Optional["queue.Queue"] = None
+        self._shadow_thread: Optional[threading.Thread] = None
+        self._shadow_stop = threading.Event()
         handler = type("RouterHandler", (_RouterHandler,), {"ctx": self})
         self._httpd = ThreadingHTTPServer(
             (host, port), handler, bind_and_activate=False
@@ -242,6 +397,7 @@ class FleetRouter:
                 return
             self._shut_down = True
         self._stop.set()
+        self.stop_shadow()
         for t in (self._ticker, self._poll_thread):
             if t is not None:
                 t.join(timeout=5)
@@ -315,6 +471,24 @@ class FleetRouter:
         rep.last_poll_t = time.monotonic()
         rep.status = body.get("status", STATUS_OK)
         rep.queue_depth = float(body.get("queue_depth", 0) or 0)
+        # the /healthz artifact identity, captured every poll. The replica's
+        # /metrics body now carries it too (one request covers both); older
+        # replicas without the field get a /healthz follow-up request.
+        # None (raw-closure engines) stays None — the "unknown" mix bucket.
+        if "artifact" in body:
+            rep.artifact = body.get("artifact")
+        else:
+            try:
+                conn = http.client.HTTPConnection(
+                    rep.host, rep.port, timeout=self.poll_timeout_s
+                )
+                conn.request("GET", "/healthz")
+                health = json.loads(conn.getresponse().read())
+                rep.artifact = health.get("artifact")
+            except (OSError, http.client.HTTPException, ValueError):
+                pass
+            finally:
+                conn.close()
         hist = (body.get("registry") or {}).get("histograms") or {}
         summary = hist.get("serve/request")
         if summary and summary.get("p99_s") is not None:
@@ -340,12 +514,187 @@ class FleetRouter:
             except Exception:  # noqa: BLE001 — polling must never die
                 logger.exception("replica poll sweep failed")
 
+    # -- shadow traffic --------------------------------------------------------
+
+    def start_shadow(self, replica_id: int, fraction: float = 0.25) -> None:
+        """Arm shadow mode: duplicate ~``fraction`` of accepted traffic to
+        ``replica_id`` (never answering clients from it). Restartable: a new
+        ``start_shadow`` resets the stats window."""
+        fraction = min(1.0, max(fraction, 1e-6))
+        self._shadow_stop.clear()
+        with self._lock:
+            self._shadow_replica = int(replica_id)
+            self._shadow_stride = max(1, round(1.0 / fraction))
+            self._shadow_counter = 0
+            self._shadow_stats = ShadowStats()
+            if self._shadow_queue is None:
+                self._shadow_queue = queue.Queue(maxsize=64)
+        if self._shadow_thread is None or not self._shadow_thread.is_alive():
+            self._shadow_thread = threading.Thread(
+                target=self._shadow_loop, name="fleet-router-shadow",
+                daemon=True,
+            )
+            self._shadow_thread.start()
+
+    def stop_shadow(self) -> None:
+        """Disarm shadow mode: the target becomes a normal routing candidate
+        again (readmission is the poller's job). The worker thread parks."""
+        with self._lock:
+            self._shadow_replica = None
+        self._shadow_stop.set()
+        if self._shadow_queue is not None:
+            # unblock the worker's get()
+            try:
+                self._shadow_queue.put_nowait(None)
+            except queue.Full:
+                pass
+        if self._shadow_thread is not None:
+            self._shadow_thread.join(timeout=5)
+            self._shadow_thread = None
+
+    def shadow_snapshot(self, drain: bool = False) -> Optional[Dict]:
+        """The current shadow window's stats; ``drain=True`` starts a fresh
+        window (the controller's per-window read)."""
+        with self._lock:
+            stats = self._shadow_stats
+            if stats is None:
+                return None
+            snap = stats.snapshot()
+            snap["replica"] = self._shadow_replica
+            if drain:
+                self._shadow_stats = ShadowStats()
+        return snap
+
+    def _maybe_shadow(
+        self, primary: ReplicaState, body: bytes, answer: bytes, primary_dt: float
+    ) -> None:
+        """Request-path hook (success answers only): pick every
+        ``shadow_stride``-th accepted request and enqueue it for duplication.
+        Never blocks — a full shadow queue drops the sample and counts it."""
+        with self._lock:
+            sid = self._shadow_replica
+            stats = self._shadow_stats
+            if sid is None or stats is None or primary.replica_id == sid:
+                return
+            self._shadow_counter += 1
+            if self._shadow_counter % self._shadow_stride:
+                return
+            target = self._replicas.get(sid)
+        if target is None:
+            return
+        with stats.lock:
+            stats.selected += 1
+        try:
+            self._shadow_queue.put_nowait(
+                (target, body, answer, primary_dt, stats)
+            )
+        except queue.Full:
+            with stats.lock:
+                stats.dropped += 1
+
+    def _shadow_loop(self) -> None:
+        """The shadow worker: replay sampled requests against the canary and
+        fold output deltas + latency into the window stats. Entirely off the
+        client request path; every failure is a counted stat, never an
+        exception a client could see."""
+        from tensorflowdistributedlearning_tpu.serve import quant_check
+
+        # one keep-alive connection per canary endpoint: the canary's
+        # measured latency must not carry a TCP connect per sample the
+        # serving replicas' keep-alive path does not pay
+        conns: Dict[Tuple[str, int], http.client.HTTPConnection] = {}
+        while not self._shadow_stop.is_set():
+            try:
+                item = self._shadow_queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if item is None:
+                continue
+            target, body, answer, primary_dt, stats = item
+            key = (target.host, target.port)
+            t0 = time.perf_counter()
+            try:
+                conn = conns.get(key)
+                if conn is None:
+                    conn = http.client.HTTPConnection(
+                        target.host, target.port,
+                        timeout=self.request_timeout_s,
+                    )
+                    conns[key] = conn
+                conn.request(
+                    "POST", "/v1/predict", body,
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                data = resp.read()
+            except (OSError, http.client.HTTPException):
+                stale = conns.pop(key, None)
+                if stale is not None:
+                    try:
+                        stale.close()
+                    except OSError:
+                        pass
+                with stats.lock:
+                    stats.send_failures += 1
+                continue
+            canary_dt = time.perf_counter() - t0
+            if resp.status == 429:
+                # canary backpressure sheds the SAMPLE, it is not a wrong
+                # answer — shadow load is best-effort sampling by design
+                with stats.lock:
+                    stats.dropped += 1
+                continue
+            if resp.status != 200:
+                with stats.lock:
+                    stats.canary_errors += 1
+                continue
+            try:
+                import numpy as np
+
+                primary_out = json.loads(answer).get("predictions") or {}
+                canary_out = json.loads(data).get("predictions") or {}
+                deltas = {
+                    name: quant_check.output_delta(
+                        name,
+                        np.asarray(primary_out[name]),
+                        np.asarray(canary_out[name]),
+                    )
+                    for name in set(primary_out) & set(canary_out)
+                }
+            except (ValueError, TypeError):
+                with stats.lock:
+                    stats.canary_errors += 1
+                continue
+            # a canary answering with DIFFERENT output names or shapes is a
+            # wrong answer, not a comparison to skip: counting it as
+            # "compared" would let every accuracy gate pass vacuously (no
+            # metrics to trip) and promote a behaviorally unrelated model
+            if not deltas or any("error" in rec for rec in deltas.values()):
+                with stats.lock:
+                    stats.canary_errors += 1
+                continue
+            stats.note_outputs(deltas)
+            stats.note_latency(primary_dt, canary_dt)
+        for conn in conns.values():
+            try:
+                conn.close()
+            except OSError:
+                pass
+
     # -- routing -------------------------------------------------------------
 
     def _candidates(self) -> List[ReplicaState]:
         """Replicas to try, in order: healthy first (by score), degraded only
-        after every ok replica — the SLO breach IS the drain signal."""
-        reps = [r for r in self._replica_list() if r.routable]
+        after every ok replica — the SLO breach IS the drain signal. The
+        shadow target (an armed canary) is NEVER a candidate: shadow mode
+        must not answer clients."""
+        with self._lock:
+            shadow = self._shadow_replica
+        reps = [
+            r
+            for r in self._replica_list()
+            if r.routable and r.replica_id != shadow
+        ]
         ok = sorted(
             (r for r in reps if r.status == STATUS_OK), key=ReplicaState.score
         )
@@ -362,6 +711,21 @@ class FleetRouter:
     def counters(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._counters)
+
+    def artifact_mix(self) -> Dict[str, int]:
+        """Replica count per served artifact identity (``dtype:fp8`` keys).
+        More than one key = a mixed fleet — expected mid-promotion, a
+        rendered warning otherwise."""
+        mix: Dict[str, int] = {}
+        for r in self._replica_list():
+            key = artifact_key(r.artifact)
+            mix[key] = mix.get(key, 0) + 1
+        return mix
+
+    def replica_artifacts(self) -> Dict[int, Optional[Dict]]:
+        """Per-replica polled artifact identity — what the promotion
+        controller verifies a relaunched replica against."""
+        return {r.replica_id: r.artifact for r in self._replica_list()}
 
     def fleet_status(self) -> str:
         """One word for the whole fleet: ok > degraded > draining > down."""
@@ -402,7 +766,7 @@ class FleetRouter:
         total_chip_s = sum(r.chip_seconds_total for r in reps)
         if total_chip_s:
             capacity["chip_seconds_total"] = round(total_chip_s, 3)
-        return {
+        snapshot = {
             **capacity,
             "replicas": len(reps),
             "live": by_status.get(STATUS_OK, 0)
@@ -415,7 +779,13 @@ class FleetRouter:
             "worst_p99_ms": max(p99s) if p99s else None,
             "shed_total": self.counters()["shed"],
             "status": self.fleet_status(),
+            "artifacts": self.artifact_mix(),
+            "promotion_active": self.promotion_active,
         }
+        with self._lock:
+            if self._shadow_replica is not None:
+                snapshot["shadow_replica"] = self._shadow_replica
+        return snapshot
 
     # -- forwarding ----------------------------------------------------------
 
@@ -505,6 +875,7 @@ class FleetRouter:
             self._count("routed")
             with self._lock:
                 rep.inflight += 1
+            t0 = time.perf_counter()
             try:
                 status, headers, data = self.forward(rep, body, request_id)
             except (http.client.HTTPException, OSError):
@@ -531,6 +902,12 @@ class FleetRouter:
                 continue
             with self._lock:
                 rep.routed += 1
+            if status == 200:
+                # shadow duplication rides ONLY answered requests (the
+                # canary sees what real traffic saw), enqueued off-path
+                self._maybe_shadow(
+                    rep, body, data, time.perf_counter() - t0
+                )
             return status, headers, data
         if saw_429:
             self._count("shed")
@@ -574,6 +951,7 @@ class FleetRouter:
     def healthz(self) -> Dict:
         status = self.fleet_status()
         reps = [r.snapshot() for r in self._replica_list()]
+        mix = self.artifact_mix()
         return {
             "ok": status == STATUS_OK,
             "status": status,
@@ -581,6 +959,12 @@ class FleetRouter:
             "live": sum(1 for r in reps if r["status"] in
                         (STATUS_OK, STATUS_DEGRADED)),
             "replicas": reps,
+            # the fleet's artifact mix: which exports are answering, and a
+            # first-class flag when more than one is (expected only during
+            # an active promotion)
+            "artifacts": mix,
+            "mixed_artifacts": len(mix) > 1,
+            "promotion_active": self.promotion_active,
             "uptime_s": round(time.time() - self._started_t, 3),
         }
 
@@ -680,6 +1064,17 @@ class _RouterHandler(BaseHTTPRequestHandler):
         if parsed.path == "/healthz":
             body = self.ctx.healthz()
             self._json(200 if body["status"] != "down" else 503, body)
+        elif parsed.path == "/admin/promotion":
+            promoter = self.ctx.promoter
+            if promoter is None:
+                self._json(
+                    404,
+                    {"error": {"code": "no_promoter",
+                               "message": "this router has no promotion "
+                               "controller (not a serve-fleet?)"}},
+                )
+            else:
+                self._json(200, promoter.status())
         elif parsed.path == "/metrics":
             query = urllib.parse.parse_qs(parsed.query)
             accept = self.headers.get("Accept", "")
@@ -706,6 +1101,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
     def do_POST(self):  # noqa: N802
         from tensorflowdistributedlearning_tpu.obs import trace as trace_lib
 
+        if self.path == "/admin/promotion":
+            self._admin_promotion()
+            return
         if self.path != "/v1/predict":
             self._json(
                 404,
@@ -719,3 +1117,52 @@ class _RouterHandler(BaseHTTPRequestHandler):
         status, headers, data = self.ctx.route_predict(body, request_id)
         headers.setdefault("x-request-id", request_id)
         self._respond(status, headers, data)
+
+    def _admin_promotion(self) -> None:
+        """POST /admin/promotion: {"action": "start", "candidate_dir": ...}
+        starts a promotion on the fleet's controller, {"action": "abort"}
+        rolls an in-flight one back. The remote-control seam the `promote`
+        CLI drives; structured errors, never a traceback on the wire."""
+        promoter = self.ctx.promoter
+        if promoter is None:
+            self._json(
+                404,
+                {"error": {"code": "no_promoter",
+                           "message": "this router has no promotion "
+                           "controller (not a serve-fleet?)"}},
+            )
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            action = payload.get("action")
+        except ValueError as e:
+            self._json(400, {"error": {"code": "bad_request",
+                                       "message": str(e)}})
+            return
+        try:
+            if action == "start":
+                self._json(202, promoter.admin_start(payload))
+            elif action == "abort":
+                promoter.abort()
+                self._json(202, promoter.status())
+            else:
+                self._json(
+                    400,
+                    {"error": {"code": "bad_request",
+                               "message": f"unknown action {action!r} "
+                               "(expected start|abort)"}},
+                )
+        except (ValueError, TypeError) as e:
+            # TypeError covers wrongly-typed config values (a string where
+            # PromoteConfig expects a number) — caller error, not a 500
+            self._json(400, {"error": {"code": "bad_request",
+                                       "message": str(e)}})
+        except RuntimeError as e:
+            # a promotion is already in flight
+            self._json(409, {"error": {"code": "promotion_in_flight",
+                                       "message": str(e)}})
+        except Exception as e:  # noqa: BLE001 — admin must answer structurally
+            logger.exception("admin promotion request failed")
+            self._json(500, {"error": {"code": "internal",
+                                       "message": f"{type(e).__name__}: {e}"}})

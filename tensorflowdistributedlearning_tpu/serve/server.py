@@ -366,6 +366,9 @@ class ServingServer:
             snapshot["slo"] = self.slo.snapshot()
         if self.engine.quantization is not None:
             snapshot["serving_dtype"] = self.engine.quantization.get("dtype")
+        # the /healthz artifact identity, mirrored here so the fleet
+        # router's poll captures what this replica serves in one request
+        snapshot["artifact"] = self.artifact_identity()
         # capacity/cost views (obs/capacity.py): per-phase HBM peaks +
         # headroom estimate, cumulative chip-seconds + last window's rates —
         # what a scraper needs to see cost and OOM risk without the ledger

@@ -1,0 +1,1344 @@
+"""Promotion controller: canary, shadow traffic, rollback — and satellites.
+
+Unit layers (tier-1 fast): the drain-wins-over-reaper fleet fix, per-replica
+artifact overrides, shadow duplication through REAL in-process servers (the
+canary never answers a client), per-replica artifact identity polling with
+the mixed-fleet aggregate, the controller's phase machine against fake
+manager/router doubles (admission refusal, empty-shadow-window hold,
+accuracy/latency/crash-loop rollback, the incumbent-deleted structured
+abort), the deployment-history report rendering, and the telemetry-top
+data-service row.
+
+Subprocess end-to-end (slow-marked, run unfiltered by the focused ci.yml
+step): the headline drill — a real 3-replica ``serve-fleet`` under
+closed-loop load, ``promote`` CLI with ``sigkill@N`` on the canary
+mid-rollout, zero client-visible errors, fleet converged on the candidate
+fingerprint — and the rollback drill with a poisoned candidate.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.obs import Telemetry
+from tensorflowdistributedlearning_tpu.serve import fleet as fleet_lib
+from tensorflowdistributedlearning_tpu.serve import promote as promote_lib
+from tensorflowdistributedlearning_tpu.serve.engine import InferenceEngine
+from tensorflowdistributedlearning_tpu.serve.batcher import MicroBatcher
+from tensorflowdistributedlearning_tpu.serve.promote import (
+    PromoteConfig,
+    PromotionController,
+)
+from tensorflowdistributedlearning_tpu.serve.router import (
+    FleetRouter,
+    ShadowStats,
+    artifact_key,
+)
+from tensorflowdistributedlearning_tpu.serve.server import ServingServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FEATURES = 6
+CLASSES = 3
+
+
+# -- fleet manager: per-replica artifacts + the drain/reaper race -------------
+
+
+class _FakeProc:
+    _next_pid = [1000]
+
+    def __init__(self, argv):
+        self.argv = argv
+        self.pid = self._next_pid[0]
+        self._next_pid[0] += 1
+        self.rc = None
+        self.signals = []
+        self.stdout = []
+
+    def poll(self):
+        return self.rc
+
+    def wait(self, timeout=None):
+        return self.rc
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+
+
+@pytest.fixture
+def fake_manager(tmp_path, monkeypatch):
+    """A FleetManager whose replica 'subprocesses' are in-memory fakes: the
+    supervision/drain state machine runs for real, nothing forks."""
+    spawned = []
+
+    def fake_popen(argv, **kwargs):
+        proc = _FakeProc(argv)
+        spawned.append(proc)
+        return proc
+
+    monkeypatch.setattr(fleet_lib.subprocess, "Popen", fake_popen)
+    manager = fleet_lib.FleetManager(
+        fleet_lib.FleetConfig(
+            artifact_dir="/incumbent", workdir=str(tmp_path / "wd"),
+            backoff_base_s=0.01, backoff_max_s=0.02,
+        )
+    )
+    return manager, spawned
+
+
+def _argv_value(argv, flag):
+    return argv[argv.index(flag) + 1] if flag in argv else None
+
+
+def test_scale_up_artifact_override_persists_across_restart(fake_manager):
+    """A canary spawned on a candidate artifact RESTARTS on it too — and the
+    first-launch-only fault drill does not ride the restart."""
+    manager, spawned = fake_manager
+    rid = manager.scale_up(
+        artifact_dir="/candidate", fault_spec="sigkill@5"
+    )
+    first = spawned[-1]
+    assert _argv_value(first.argv, "--artifact-dir") == "/candidate"
+    assert _argv_value(first.argv, "--inject-fault") == "sigkill@5"
+    rep = manager.replicas()[0]
+    assert rep.artifact_dir == "/candidate"
+
+    first.rc = -signal.SIGKILL  # the drill fired
+    manager.check()  # schedules the restart
+    assert rep.state == fleet_lib.R_BACKOFF
+    rep.restart_at = 0.0
+    manager.check()  # executes it
+    relaunch = spawned[-1]
+    assert relaunch is not first
+    assert _argv_value(relaunch.argv, "--artifact-dir") == "/candidate"
+    assert "--inject-fault" not in relaunch.argv  # restarts are clean
+    assert rep.restarts == 1
+
+
+def test_default_spawn_uses_fleet_artifact(fake_manager):
+    manager, spawned = fake_manager
+    manager.scale_up()
+    assert _argv_value(spawned[-1].argv, "--artifact-dir") == "/incumbent"
+
+
+def test_drain_wins_over_pending_restart(fake_manager):
+    """The satellite fix: a replica that died (restart scheduled) and is
+    then drained must be forgotten — the monitor must NOT relaunch it."""
+    manager, spawned = fake_manager
+    rid = manager.scale_up()
+    rep = manager.replicas()[0]
+    rep.process.rc = 1  # crashed
+    manager.check()
+    assert rep.state == fleet_lib.R_BACKOFF
+    n_spawns = len(spawned)
+
+    assert manager.scale_down(rid) == rid  # drain decision on a dead replica
+    assert manager.replicas() == []  # forgotten immediately
+    rep.restart_at = 0.0
+    manager.check()  # a due restart must not resurrect it
+    assert manager.replicas() == []
+    assert len(spawned) == n_spawns
+
+
+def test_drain_request_survives_reaper_clobber(fake_manager):
+    """The tighter race: scale_down marked the replica draining, but the
+    monitor's sweep had already observed the death and moves it into the
+    backoff path — drain_requested still wins, no relaunch."""
+    manager, spawned = fake_manager
+    rid = manager.scale_up()
+    rep = manager.replicas()[0]
+    assert manager.scale_down(rid) == rid
+    assert rep.drain_requested
+    assert signal.SIGTERM in rep.process.signals
+    # simulate the reaper racing the drain: death observed, state clobbered
+    # into the restart machinery
+    rep.process.rc = -signal.SIGTERM
+    rep.state = fleet_lib.R_BACKOFF
+    rep.restart_at = 0.0
+    n_spawns = len(spawned)
+    manager.check()
+    assert all(r.replica_id != rid for r in manager.replicas())
+    assert len(spawned) == n_spawns  # never relaunched
+
+
+def test_scale_down_default_prefers_live_over_backoff(fake_manager):
+    manager, spawned = fake_manager
+    manager.scale_up()
+    manager.scale_up()
+    reps = sorted(manager.replicas(), key=lambda r: r.replica_id)
+    reps[1].process.rc = 1
+    manager.check()  # replica 2 in backoff
+    # default pick must drain the LIVE replica 1, not cancel 2's restart
+    assert manager.scale_down() == reps[0].replica_id
+
+
+# -- shadow traffic through real in-process servers ---------------------------
+
+
+def _server(fn, *, replica_id, quantization=None, buckets=(1, 4)):
+    engine = InferenceEngine(
+        fn, (FEATURES,), buckets=buckets, quantization=quantization
+    )
+    engine.warmup()
+    batcher = MicroBatcher(engine, max_wait_ms=1, max_queue=64)
+    return ServingServer(
+        engine, batcher, port=0, replica_id=replica_id, window_secs=0
+    ).start()
+
+
+def _post(url, payload, timeout=10):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+@pytest.fixture(scope="module")
+def paired_fns():
+    """Primary and a deliberately-different canary model: the shadow compare
+    must SEE disagreement (class flips + probability deltas)."""
+    import jax
+    import jax.numpy as jnp
+
+    w1 = jax.random.normal(jax.random.PRNGKey(0), (FEATURES, CLASSES)) * 0.5
+    w2 = jax.random.normal(jax.random.PRNGKey(9), (FEATURES, CLASSES)) * 0.5
+
+    def make(w):
+        @jax.jit
+        def fn(x):
+            return {
+                "probabilities": jax.nn.softmax(x @ w, axis=-1),
+                "class": jnp.argmax(x @ w, axis=-1),
+            }
+
+        return fn
+
+    return make(w1), make(w2)
+
+
+def test_shadow_duplicates_but_never_answers(paired_fns):
+    primary_fn, canary_fn = paired_fns
+    s1 = _server(primary_fn, replica_id=1)
+    s2 = _server(canary_fn, replica_id=2)
+    router = FleetRouter(
+        [(1, s1.url), (2, s2.url)], port=0, window_secs=0,
+        poll_interval_s=0.2,
+    ).start()
+    x = np.random.default_rng(3).normal(0, 1, (2, FEATURES)).astype(np.float32)
+    try:
+        router.start_shadow(2, fraction=1.0)
+        # the shadow target is not a candidate: all traffic answers from 1
+        assert [r.replica_id for r in router._candidates()] == [1]
+        for _ in range(10):
+            status, _ = _post(
+                router.url + "/v1/predict", {"instances": x.tolist()}
+            )
+            assert status == 200
+        snap = {r["replica"]: r for r in router.metrics_snapshot()["replicas"]}
+        assert snap[1]["routed"] == 10
+        assert snap[2]["routed"] == 0  # NEVER answered a client
+
+        deadline = time.monotonic() + 30
+        stats = {}
+        while time.monotonic() < deadline:
+            stats = router.shadow_snapshot() or {}
+            if stats.get("compared", 0) >= 10:
+                break
+            time.sleep(0.1)
+        assert stats["compared"] >= 10
+        assert stats["selected"] >= stats["compared"]
+        # genuinely different models must show up in the compare
+        assert stats["max_abs_delta"] > 0.01
+        assert stats.get("mean_disagree", 0) > 0.0
+        lat = stats["latency_ms"]
+        assert lat["primary_p99"] > 0 and lat["canary_p99"] > 0
+
+        router.stop_shadow()
+        router.poll_once()
+        # disarmed: the canary is a normal candidate again
+        assert 2 in [r.replica_id for r in router._candidates()]
+    finally:
+        router.shutdown()
+        s1.shutdown()
+        s2.shutdown()
+
+
+def test_shadow_empty_window_has_no_math_errors():
+    stats = ShadowStats()
+    snap = stats.snapshot()
+    assert snap["compared"] == 0
+    assert "max_abs_delta" not in snap and "latency_ms" not in snap
+
+
+def test_identical_models_compare_clean(paired_fns):
+    """Same artifact on both sides: the shadow compare reports (near-)zero
+    deltas — the promotion happy path's evidence."""
+    primary_fn, _ = paired_fns
+    s1 = _server(primary_fn, replica_id=1)
+    s2 = _server(primary_fn, replica_id=2)
+    router = FleetRouter(
+        [(1, s1.url), (2, s2.url)], port=0, window_secs=0
+    ).start()
+    x = np.random.default_rng(4).normal(0, 1, (1, FEATURES)).astype(np.float32)
+    try:
+        router.start_shadow(2, fraction=1.0)
+        for _ in range(5):
+            _post(router.url + "/v1/predict", {"instances": x.tolist()})
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            stats = router.shadow_snapshot() or {}
+            if stats.get("compared", 0) >= 5:
+                break
+            time.sleep(0.1)
+        assert stats["compared"] >= 5
+        # float round-trip through JSON is exact: identical models agree
+        assert stats["max_abs_delta"] == 0.0
+        assert stats.get("mean_disagree", 0.0) == 0.0
+    finally:
+        router.shutdown()
+        s1.shutdown()
+        s2.shutdown()
+
+
+def test_shadow_mismatched_outputs_count_as_canary_errors(paired_fns):
+    """A canary answering with different output NAMES (or shapes) is a wrong
+    answer, not a comparison to skip — counting it as 'compared' would let
+    every accuracy gate pass vacuously."""
+    import jax
+
+    primary_fn, _ = paired_fns
+
+    @jax.jit
+    def renamed_fn(x):
+        out = primary_fn(x)
+        return {"logits": out["probabilities"]}  # different output name
+
+    s1 = _server(primary_fn, replica_id=1)
+    s2 = _server(renamed_fn, replica_id=2)
+    router = FleetRouter(
+        [(1, s1.url), (2, s2.url)], port=0, window_secs=0
+    ).start()
+    x = np.random.default_rng(6).normal(0, 1, (1, FEATURES)).astype(np.float32)
+    try:
+        router.start_shadow(2, fraction=1.0)
+        for _ in range(5):
+            _post(router.url + "/v1/predict", {"instances": x.tolist()})
+        deadline = time.monotonic() + 30
+        stats = {}
+        while time.monotonic() < deadline:
+            stats = router.shadow_snapshot() or {}
+            if stats.get("canary_errors", 0) >= 5:
+                break
+            time.sleep(0.1)
+        assert stats["canary_errors"] >= 5
+        assert stats["compared"] == 0  # never evidence, never a pass
+    finally:
+        router.shutdown()
+        s1.shutdown()
+        s2.shutdown()
+
+
+# -- artifact identity + mixed-fleet aggregation ------------------------------
+
+
+def test_router_polls_artifact_identity_and_reports_mix(paired_fns):
+    primary_fn, canary_fn = paired_fns
+    q1 = {"dtype": "float32", "source_fingerprint": "a" * 16}
+    q2 = {"dtype": "int8", "source_fingerprint": "b" * 16}
+    s1 = _server(primary_fn, replica_id=1, quantization=q1)
+    s2 = _server(canary_fn, replica_id=2, quantization=q2)
+    router = FleetRouter(
+        [(1, s1.url), (2, s2.url)], port=0, window_secs=0
+    )
+    try:
+        router.poll_once()
+        arts = router.replica_artifacts()
+        assert arts[1]["source_fingerprint"] == "a" * 16
+        assert arts[2]["dtype"] == "int8"
+        mix = router.artifact_mix()
+        assert mix == {"float32:aaaaaaaa": 1, "int8:bbbbbbbb": 1}
+        health = router.healthz()
+        assert health["mixed_artifacts"] is True
+        assert health["artifacts"] == mix
+        assert health["promotion_active"] is False
+        window = router.emit_window()
+        assert window["fleet"]["artifacts"] == mix
+    finally:
+        router._httpd.server_close()
+        s1.shutdown()
+        s2.shutdown()
+
+
+def test_artifact_key_shapes():
+    assert artifact_key(None) == "unknown"
+    assert artifact_key({"dtype": "int8"}) == "int8:?"
+    assert (
+        artifact_key({"dtype": "float32", "source_fingerprint": "c" * 64})
+        == "float32:cccccccc"
+    )
+
+
+# -- controller phase machine (fake fleet) ------------------------------------
+
+
+class _FakeReplica:
+    def __init__(self, rid, artifact_dir=None):
+        self.replica_id = rid
+        self.state = "live"
+        self.restarts = 0
+        self.url = f"http://127.0.0.1:{9000 + rid}"
+        self.artifact_dir = artifact_dir
+        self.exit_code = None
+        self.ready = threading.Event()
+        self.ready.set()
+
+
+class _FakeManager:
+    def __init__(self, n_incumbents=3, incumbent_dir="/v1"):
+        self.config = types.SimpleNamespace(artifact_dir=incumbent_dir)
+        self._reps = {
+            i: _FakeReplica(i) for i in range(1, n_incumbents + 1)
+        }
+        self._next = n_incumbents + 1
+        self.spawn_fails_for = set()  # artifact dirs whose spawn never readies
+        self.scale_ups = []
+        self.scale_downs = []
+
+    def replicas(self):
+        return list(self._reps.values())
+
+    def scale_up(self, artifact_dir=None, fault_spec=None):
+        rid = self._next
+        self._next += 1
+        rep = _FakeReplica(rid, artifact_dir=artifact_dir)
+        resolved = artifact_dir or self.config.artifact_dir
+        if resolved in self.spawn_fails_for:
+            # a spawn crash-looping without ever becoming ready (>= the
+            # crash_loop_threshold: ONE death is a tolerated blip)
+            rep.state = "backoff"
+            rep.ready.clear()
+            rep.url = None
+            rep.restarts = 2
+            rep.exit_code = 1
+        self._reps[rid] = rep
+        self.scale_ups.append((rid, artifact_dir, fault_spec))
+        return rid
+
+    def scale_down(self, replica_id=None):
+        if replica_id is None or replica_id not in self._reps:
+            return None
+        self._reps.pop(replica_id)
+        self.scale_downs.append(replica_id)
+        return replica_id
+
+
+class _FakeRouter:
+    def __init__(self, manager, candidate_dir, candidate_fp="fp-cand"):
+        self.manager = manager
+        self.candidate_dir = candidate_dir
+        self.candidate_fp = candidate_fp
+        self.promotion_active = False
+        self.promoter = None
+        self.shadow_calls = []
+        self.shadow_snaps = []  # scripted windows, popped per drain
+        self._armed = None
+
+    def start_shadow(self, rid, fraction):
+        self._armed = rid
+        self.shadow_calls.append(("start", rid, fraction))
+
+    def stop_shadow(self):
+        self.shadow_calls.append(("stop", self._armed))
+        self._armed = None
+
+    def shadow_snapshot(self, drain=False):
+        if not self.shadow_snaps:
+            return {"selected": 0, "compared": 0, "dropped": 0,
+                    "canary_errors": 0, "send_failures": 0}
+        if drain:
+            return self.shadow_snaps.pop(0)
+        return dict(self.shadow_snaps[0])
+
+    def poll_once(self):
+        pass
+
+    def replica_artifacts(self):
+        out = {}
+        for rep in self.manager.replicas():
+            if rep.artifact_dir == self.candidate_dir:
+                fp = self.candidate_fp
+            else:
+                fp = "fp-incumbent"
+            out[rep.replica_id] = {
+                "dtype": "float32", "source_fingerprint": fp,
+            }
+        return out
+
+    def artifact_mix(self):
+        mix = {}
+        for a in self.replica_artifacts().values():
+            key = artifact_key(a)
+            mix[key] = mix.get(key, 0) + 1
+        return mix
+
+    def fleet_snapshot(self):
+        return {"worst_p99_ms": None}
+
+
+def _fast_config(**overrides):
+    base = dict(
+        shadow_secs=0.02,
+        shadow_fraction=0.5,
+        shadow_min_requests=4,
+        shadow_max_secs=1.0,
+        observe_secs=0.01,
+        ready_timeout_s=5.0,
+        drain_timeout_s=5.0,
+        identity_timeout_s=5.0,
+        poll_interval_s=0.01,
+    )
+    base.update(overrides)
+    return PromoteConfig(**base)
+
+
+GOOD_WINDOW = {
+    "selected": 20, "compared": 10, "dropped": 0, "canary_errors": 0,
+    "send_failures": 0, "max_abs_delta": 0.01, "mean_abs_delta": 0.002,
+    "min_iou": 0.99, "mean_disagree": 0.0,
+    "latency_ms": {"primary_p50": 4.0, "primary_p99": 10.0,
+                   "canary_p50": 4.2, "canary_p99": 11.0,
+                   "canary_p99_ratio": 1.1},
+}
+
+
+def _controller(tmp_path, monkeypatch, *, n=3, candidate="/v2",
+                manifest_quant=True):
+    manager = _FakeManager(n_incumbents=n)
+    router = _FakeRouter(manager, candidate)
+    tel = Telemetry(str(tmp_path / "ledger"), run_info={"kind": "serve-fleet"})
+    tel.test_workdir = str(tmp_path / "ledger")
+    controller = PromotionController(manager, router, telemetry=tel)
+
+    def fake_read_manifest(directory):
+        m = {"input_shape": [None, FEATURES], "input_dtype": "float32"}
+        if manifest_quant:
+            m["quantization"] = {
+                "dtype": "float32",
+                "source_fingerprint": "fp-cand",
+            }
+        return m
+
+    monkeypatch.setattr(
+        "tensorflowdistributedlearning_tpu.train.serving.read_manifest",
+        fake_read_manifest,
+    )
+    return controller, manager, router, tel
+
+
+def _events(tel):
+    from tensorflowdistributedlearning_tpu.obs.ledger import read_ledger
+
+    tel.flush()
+    return read_ledger(tel.test_workdir)
+
+
+def test_controller_happy_path_promotes_every_replica(tmp_path, monkeypatch):
+    controller, manager, router, tel = _controller(tmp_path, monkeypatch)
+    router.shadow_snaps = [dict(GOOD_WINDOW)]
+    controller.start("/v2", config=_fast_config())
+    assert controller.wait(timeout=30)
+    status = controller.status()
+    assert status["state"] == "complete", status
+    # every live replica is on the candidate; the fleet default flipped
+    assert manager.config.artifact_dir == "/v2"
+    assert all(
+        r.artifact_dir == "/v2" for r in manager.replicas()
+    )
+    assert len(manager.replicas()) == 3  # strength preserved
+    # shadow was armed on the canary and disarmed before rollout
+    assert router.shadow_calls[0][0] == "start"
+    assert ("stop", router.shadow_calls[0][1]) in router.shadow_calls
+    kinds = [e["event"] for e in _events(tel)]
+    assert "promotion_start" in kinds
+    assert "shadow_window" in kinds
+    assert kinds.count("phase_advance") >= 3  # canary, shadow, rollouts
+    assert kinds[-1] == "promotion_complete" or "promotion_complete" in kinds
+    assert "promotion_rollback" not in kinds
+    tel.close()
+
+
+def test_controller_empty_shadow_window_holds_then_advances(
+    tmp_path, monkeypatch
+):
+    """An empty-traffic window is NOT evidence: the phase holds (another
+    window runs) and only a window with enough compares advances."""
+    controller, manager, router, tel = _controller(tmp_path, monkeypatch)
+    empty = {"selected": 0, "compared": 0, "dropped": 0, "canary_errors": 0,
+             "send_failures": 0}
+    router.shadow_snaps = [dict(empty), dict(empty), dict(GOOD_WINDOW)]
+    controller.start("/v2", config=_fast_config(shadow_max_secs=30.0))
+    assert controller.wait(timeout=30)
+    assert controller.status()["state"] == "complete"
+    windows = [
+        e for e in _events(tel) if e["event"] == "shadow_window"
+    ]
+    assert len(windows) == 3  # two held, one advanced
+    assert windows[0]["compared"] == 0
+    tel.close()
+
+
+def test_controller_shadow_starvation_rolls_back(tmp_path, monkeypatch):
+    controller, manager, router, tel = _controller(tmp_path, monkeypatch)
+    router.shadow_snaps = []  # never any traffic
+    controller.start(
+        "/v2", config=_fast_config(shadow_max_secs=0.1)
+    )
+    assert controller.wait(timeout=30)
+    status = controller.status()
+    assert status["state"] == "rolled_back"
+    assert "starved" in status["reason"]
+    # fleet restored: 3 incumbents, no candidate replicas
+    assert len(manager.replicas()) == 3
+    assert all(r.artifact_dir is None for r in manager.replicas())
+    assert manager.config.artifact_dir == "/v1"
+    tel.close()
+
+
+def test_controller_accuracy_regression_rolls_back(tmp_path, monkeypatch):
+    controller, manager, router, tel = _controller(tmp_path, monkeypatch)
+    bad = dict(GOOD_WINDOW, min_iou=0.5, mean_disagree=0.4)
+    router.shadow_snaps = [bad]
+    controller.start("/v2", config=_fast_config())
+    assert controller.wait(timeout=30)
+    status = controller.status()
+    assert status["state"] == "rolled_back"
+    assert "accuracy" in status["reason"]
+    events = _events(tel)
+    rollback = next(
+        e for e in events if e["event"] == "promotion_rollback"
+    )
+    assert rollback["status"] == "rolled_back"
+    assert rollback["phase"] == "shadow"
+    # the canary was shadow-only: drained without a replacement spawn
+    assert len(manager.replicas()) == 3
+    assert all(r.artifact_dir is None for r in manager.replicas())
+    tel.close()
+
+
+def test_controller_latency_regression_rolls_back(tmp_path, monkeypatch):
+    controller, manager, router, tel = _controller(tmp_path, monkeypatch)
+    slow = dict(
+        GOOD_WINDOW,
+        latency_ms={"primary_p50": 4.0, "primary_p99": 10.0,
+                    "canary_p50": 9.0, "canary_p99": 40.0,
+                    "canary_p99_ratio": 4.0},
+    )
+    router.shadow_snaps = [slow]
+    controller.start("/v2", config=_fast_config(max_p99_ratio=1.5))
+    assert controller.wait(timeout=30)
+    status = controller.status()
+    assert status["state"] == "rolled_back"
+    assert "latency" in status["reason"]
+    tel.close()
+
+
+def test_controller_canary_crash_loop_rolls_back(tmp_path, monkeypatch):
+    controller, manager, router, tel = _controller(tmp_path, monkeypatch)
+    empty = {"selected": 0, "compared": 0, "dropped": 0, "canary_errors": 0,
+             "send_failures": 0}
+    router.shadow_snaps = [dict(empty) for _ in range(50)]
+
+    orig_scale_up = manager.scale_up
+
+    def crashing_scale_up(artifact_dir=None, fault_spec=None):
+        rid = orig_scale_up(artifact_dir=artifact_dir, fault_spec=fault_spec)
+        if artifact_dir == "/v2":
+            # ready once, then flapping: restarts past the threshold
+            manager._reps[rid].restarts = 3
+        return rid
+
+    manager.scale_up = crashing_scale_up
+    controller.start("/v2", config=_fast_config(crash_loop_threshold=2))
+    assert controller.wait(timeout=30)
+    status = controller.status()
+    assert status["state"] == "rolled_back"
+    assert "crash-loop" in status["reason"]
+    assert len(manager.replicas()) == 3
+    tel.close()
+
+
+def test_controller_single_restart_is_tolerated(tmp_path, monkeypatch):
+    """One canary death (the sigkill drill) is a blip the supervisor
+    absorbs, NOT a crash loop — the promotion must converge."""
+    controller, manager, router, tel = _controller(tmp_path, monkeypatch)
+    router.shadow_snaps = [dict(GOOD_WINDOW)]
+
+    orig_scale_up = manager.scale_up
+
+    def one_restart_scale_up(artifact_dir=None, fault_spec=None):
+        rid = orig_scale_up(artifact_dir=artifact_dir, fault_spec=fault_spec)
+        if fault_spec:
+            manager._reps[rid].restarts = 1  # died once, restarted clean
+        return rid
+
+    manager.scale_up = one_restart_scale_up
+    controller.start(
+        "/v2", config=_fast_config(), fault_spec="sigkill@10"
+    )
+    assert controller.wait(timeout=30)
+    assert controller.status()["state"] == "complete"
+    assert manager.scale_ups[0] == (4, "/v2", "sigkill@10")
+    tel.close()
+
+
+def test_controller_admission_refuses_unreadable_manifest(
+    tmp_path, monkeypatch
+):
+    controller, manager, router, tel = _controller(tmp_path, monkeypatch)
+
+    def broken_read_manifest(directory):
+        raise ValueError("no manifest.json")
+
+    monkeypatch.setattr(
+        "tensorflowdistributedlearning_tpu.train.serving.read_manifest",
+        broken_read_manifest,
+    )
+    controller.start("/v2", config=_fast_config())
+    assert controller.wait(timeout=30)
+    status = controller.status()
+    assert status["state"] == "refused"
+    assert "manifest" in status["reason"]
+    # the fleet was never touched
+    assert manager.scale_ups == [] and manager.scale_downs == []
+    events = _events(tel)
+    rollback = next(
+        e for e in events if e["event"] == "promotion_rollback"
+    )
+    assert rollback["status"] == "refused"
+    assert rollback["phase"] == "admission"
+    tel.close()
+
+
+def test_controller_admission_refuses_fingerprint_mismatch(
+    tmp_path, monkeypatch
+):
+    """quantize-check is the admission gate: a failed pairing (fingerprint
+    mismatch) refuses the candidate before any replica moves."""
+    controller, manager, router, tel = _controller(tmp_path, monkeypatch)
+
+    def failing_quant_check(reference_dir, candidate_dir, **kwargs):
+        return {
+            "passed": False,
+            "failures": [
+                "source fingerprint mismatch — the artifacts derive from "
+                "different checkpoints, the comparison is meaningless"
+            ],
+        }
+
+    monkeypatch.setattr(
+        "tensorflowdistributedlearning_tpu.serve.quant_check.run_quant_check",
+        failing_quant_check,
+    )
+    controller.start(
+        "/v2", reference_dir="/ref", config=_fast_config()
+    )
+    assert controller.wait(timeout=30)
+    status = controller.status()
+    assert status["state"] == "refused"
+    assert "fingerprint mismatch" in status["reason"]
+    assert manager.scale_ups == []
+    tel.close()
+
+
+def test_controller_incumbent_deleted_aborts_structurally(
+    tmp_path, monkeypatch
+):
+    """Rollback needs the incumbent artifact back; when it is gone the
+    controller must ABORT with a ledgered verdict and leave the surviving
+    candidate replicas serving — never a dead fleet."""
+    controller, manager, router, tel = _controller(tmp_path, monkeypatch)
+    bad = dict(GOOD_WINDOW, min_iou=0.2)
+    router.shadow_snaps = [bad]
+    # the incumbent artifact dir vanishes mid-promotion: every incumbent
+    # respawn fails
+    manager.spawn_fails_for.add("/v1")
+    # make rollback NEED a replacement: kill one incumbent at shadow time so
+    # the fleet is below original strength when the gate trips
+    orig_snapshot = router.shadow_snapshot
+
+    def snapshot_and_lose_incumbent(drain=False):
+        for rep in list(manager._reps.values()):
+            if rep.artifact_dir is None:
+                manager._reps.pop(rep.replica_id)
+                break
+        return orig_snapshot(drain=drain)
+
+    router.shadow_snapshot = snapshot_and_lose_incumbent
+    controller.start("/v2", config=_fast_config())
+    assert controller.wait(timeout=30)
+    status = controller.status()
+    assert status["state"] == "aborted"
+    assert "incumbent" in status["reason"]
+    # the canary is still there, still serving — not a dead fleet
+    survivors = manager.replicas()
+    assert any(r.artifact_dir == "/v2" for r in survivors)
+    events = _events(tel)
+    rollback = next(
+        e for e in events if e["event"] == "promotion_rollback"
+    )
+    assert rollback["status"] == "aborted"
+    assert rollback["candidate_replicas_kept"] >= 1
+    tel.close()
+
+
+def test_controller_rejects_concurrent_promotions(tmp_path, monkeypatch):
+    controller, manager, router, tel = _controller(tmp_path, monkeypatch)
+    empty = {"selected": 0, "compared": 0, "dropped": 0, "canary_errors": 0,
+             "send_failures": 0}
+    router.shadow_snaps = [dict(empty) for _ in range(100)]
+    controller.start("/v2", config=_fast_config(shadow_max_secs=20.0))
+    with pytest.raises(RuntimeError):
+        controller.start("/v3", config=_fast_config())
+    controller.abort()
+    assert controller.wait(timeout=30)
+    assert controller.status()["state"] == "rolled_back"
+    assert controller.status()["reason"] == "operator abort"
+    tel.close()
+
+
+def test_admin_start_payload_validation(tmp_path, monkeypatch):
+    controller, manager, router, tel = _controller(tmp_path, monkeypatch)
+    with pytest.raises(ValueError, match="candidate_dir"):
+        controller.admin_start({"action": "start"})
+    with pytest.raises(ValueError, match="unknown promotion option"):
+        controller.admin_start(
+            {"action": "start", "candidate_dir": "/v2", "min_iou": 0.5}
+        )
+    # 0 would let the first empty shadow window pass every gate vacuously
+    with pytest.raises(ValueError, match="shadow_min_requests"):
+        controller.admin_start(
+            {"action": "start", "candidate_dir": "/v2",
+             "shadow_min_requests": 0}
+        )
+    tel.close()
+
+
+def test_admin_endpoint_maps_caller_errors_to_400(tmp_path, monkeypatch):
+    """A wrongly-typed config value over the wire is a 400 bad_request, not
+    a 500 — the admin surface answers caller errors structurally."""
+    controller, manager, _fake_router, tel = _controller(
+        tmp_path, monkeypatch
+    )
+    router = FleetRouter([], port=0, window_secs=0).start()
+    router.promoter = controller
+    try:
+        def post(payload):
+            req = urllib.request.Request(
+                router.url + "/admin/promotion",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        status, body = post({"action": "start", "candidate_dir": "/v2",
+                             "shadow_secs": "ten"})
+        assert status == 400
+        assert body["error"]["code"] == "bad_request"
+        status, body = post({"action": "sideways"})
+        assert status == 400
+        status, _ = post({"action": "abort"})  # no-op when nothing runs
+        assert status == 202
+    finally:
+        router.shutdown()
+        tel.close()
+
+
+def test_autoscaler_pauses_during_promotion(tmp_path):
+    """Mid-promotion the autoscaler must not scale (scale_down would drain
+    the canary / newest candidate); ticks resume when the controller
+    finishes."""
+    from tensorflowdistributedlearning_tpu.serve import (
+        AutoscaleConfig,
+        FleetConfig,
+        ServeFleet,
+    )
+
+    fleet = ServeFleet(
+        FleetConfig(artifact_dir="/a", workdir=str(tmp_path / "wd")),
+        autoscale=AutoscaleConfig(min_replicas=2, sustain=1, cooldown_s=0),
+    )
+    scale_ups = []
+    fleet.manager.scale_up = lambda *a, **k: scale_ups.append(1)
+    try:
+        # a dead fleet normally triggers the no_capacity emergency — but
+        # not while a promotion is in flight
+        fleet.router.promotion_active = True
+        assert fleet.autoscale_tick() is None
+        assert scale_ups == []
+        fleet.router.promotion_active = False
+        decision = fleet.autoscale_tick()
+        assert decision is not None and decision["reason"] == "no_capacity"
+        assert scale_ups  # resumed the moment the promotion ended
+    finally:
+        fleet.router._httpd.server_close()
+
+
+# -- report + console satellites ----------------------------------------------
+
+
+def test_promotion_events_render_as_deployment_history(tmp_path):
+    from tensorflowdistributedlearning_tpu.obs.report import report_workdir
+
+    workdir = str(tmp_path / "fleet")
+    tel = Telemetry(workdir, run_info={"kind": "serve-fleet"})
+    tel.event("promotion_start", candidate_dir="/v2", dtype="float32",
+              fingerprint="f" * 16, replicas=3)
+    tel.event("phase_advance", phase="canary", replica=4)
+    tel.event("shadow_window", replica=4, window=1, compared=12,
+              max_abs_delta=0.01, mean_disagree=0.0, min_iou=0.99)
+    tel.event("phase_advance", phase="shadow_complete", replica=4,
+              windows=1, compared=12)
+    tel.event("phase_advance", phase="rollout", replaced=1, remaining=1)
+    tel.event("promotion_rollback", phase="rollout",
+              reason="latency: fleet p99 regressed", status="rolled_back",
+              restored=2, drained=2)
+    tel.close()
+    rendered = report_workdir(workdir)
+    assert "deployment history" in rendered
+    assert "1 ROLLED BACK" in rendered
+    assert "phase canary" in rendered
+    assert "shadow window" in rendered
+    assert "latency: fleet p99 regressed" in rendered
+    as_json = json.loads(report_workdir(workdir, as_json=True))
+    pm = as_json["promotion"]
+    assert pm["starts"] == 1 and pm["rolled_back"] == 1
+    assert pm["shadow_windows"] == 1 and pm["shadow_compared"] == 12
+    assert pm["last_rollback"]["phase"] == "rollout"
+
+
+def test_silent_mixed_fleet_warns_in_report(tmp_path):
+    from tensorflowdistributedlearning_tpu.obs.report import report_workdir
+
+    workdir = str(tmp_path / "mixed")
+    tel = Telemetry(workdir, run_info={"kind": "serve-fleet"})
+    tel.event(
+        "router_window", requests=10, routed=10, retries=0, shed=0,
+        no_replica=0, replica_failures=0,
+        per_replica_routed={"1": 5, "2": 5},
+        fleet={"status": "ok", "live": 2, "starting": 0, "draining": 0,
+               "dead": 0,
+               "artifacts": {"float32:aaaaaaaa": 1, "int8:bbbbbbbb": 1},
+               "promotion_active": False},
+    )
+    tel.close()
+    rendered = report_workdir(workdir)
+    assert "MIXED FLEET outside an active promotion" in rendered
+    as_json = json.loads(report_workdir(workdir, as_json=True))
+    assert as_json["serve_fleet"]["router"]["silent_mixed_fleet"] is True
+
+    # the same mix DURING a promotion is expected, not a warning
+    workdir2 = str(tmp_path / "promoting")
+    tel = Telemetry(workdir2, run_info={"kind": "serve-fleet"})
+    tel.event(
+        "router_window", requests=10, routed=10, retries=0, shed=0,
+        no_replica=0, replica_failures=0, per_replica_routed={},
+        fleet={"status": "ok", "live": 2, "starting": 0, "draining": 0,
+               "dead": 0,
+               "artifacts": {"float32:aaaaaaaa": 1, "int8:bbbbbbbb": 1},
+               "promotion_active": True},
+    )
+    tel.close()
+    assert "MIXED FLEET" not in report_workdir(workdir2)
+
+
+def test_telemetry_top_shows_data_service_row(tmp_path):
+    from tensorflowdistributedlearning_tpu.obs import top as top_lib
+
+    workdir = str(tmp_path / "train")
+    tel = Telemetry(workdir, run_info={"kind": "fit"})
+    tel.event(
+        "step_window", step=40, steps=20, data_wait_s=0.1, compute_s=2.0,
+        step_time_ms={"mean_ms": 100.0, "p50_ms": 99.0, "p90_ms": 110.0,
+                      "p99_ms": 120.0, "max_ms": 130.0, "count": 20},
+        data_service={"underruns": 2,
+                      "ready_depth": {"mean": 1.5, "min": 0},
+                      "worker_util": 0.83},
+    )
+    tel.close()
+    frame = top_lib.build_frame(workdir)
+    row = frame["rows"][0]
+    assert row["data_service"]["underruns"] == 2
+    assert row["data_service"]["ready_depth_mean"] == 1.5
+    assert row["data_service"]["worker_util"] == 0.83
+    rendered = top_lib.render_frame(frame)
+    assert "data-svc:" in rendered
+    assert "workers 83% busy" in rendered
+    assert "STARVED" in rendered
+
+
+# -- CLI surface --------------------------------------------------------------
+
+
+def test_cli_promote_parser_defaults():
+    from tensorflowdistributedlearning_tpu.cli import build_parser
+
+    args = build_parser().parse_args(["promote", "--candidate-dir", "/v2"])
+    assert args.candidate_dir == "/v2"
+    assert args.router is None and args.workdir is None
+    assert not args.abort
+    assert args.shadow_secs is None  # controller defaults rule
+    args = build_parser().parse_args(
+        ["promote", "--candidate-dir", "/v2", "--router",
+         "http://127.0.0.1:8000", "--canary-inject-fault", "sigkill@25",
+         "--min-iou", "0.95"]
+    )
+    assert args.canary_inject_fault == "sigkill@25"
+    assert args.shadow_min_iou == 0.95
+
+
+def test_cli_promote_resolves_router_from_workdir_ledger(tmp_path):
+    from tensorflowdistributedlearning_tpu.cli import _resolve_router_url
+
+    workdir = str(tmp_path / "fleet")
+    tel = Telemetry(
+        workdir,
+        run_info={"kind": "serve-fleet",
+                  "endpoint": "http://127.0.0.1:7777"},
+    )
+    tel.close()
+    args = types.SimpleNamespace(router=None, workdir=workdir)
+    assert _resolve_router_url(args) == "http://127.0.0.1:7777"
+    args = types.SimpleNamespace(
+        router="http://10.0.0.1:9/", workdir=workdir
+    )
+    assert _resolve_router_url(args) == "http://10.0.0.1:9"
+    args = types.SimpleNamespace(router=None, workdir=str(tmp_path / "nope"))
+    assert _resolve_router_url(args) is None
+
+
+def test_cli_promote_without_target_is_usage_error(capsys):
+    from tensorflowdistributedlearning_tpu.cli import main
+
+    rc = main(["promote", "--candidate-dir", "/v2"])
+    assert rc == 2
+    assert "no router found" in capsys.readouterr().err
+    # a start without a candidate is a usage error ...
+    rc = main(["promote", "--router", "http://127.0.0.1:1"])
+    assert rc == 2
+    assert "--candidate-dir is required" in capsys.readouterr().err
+    # ... but --abort alone must parse (the emergency stop needs no
+    # candidate); it then fails on connectivity, not usage
+    from tensorflowdistributedlearning_tpu.cli import build_parser
+
+    args = build_parser().parse_args(["promote", "--abort"])
+    assert args.abort and args.candidate_dir is None
+
+
+# -- sentinel gate units ------------------------------------------------------
+
+
+def test_sentinel_promotion_gates():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from regression_sentinel import check_promotion
+
+    good = {
+        "promotion": {
+            "kill_canary": {"completed": True, "converged": True,
+                            "client_errors": 0, "restarts": 1},
+            "rollback": {"rolled_back": True, "client_errors": 0,
+                         "restored": True},
+        }
+    }
+    findings = check_promotion(good)
+    assert findings and all(f["ok"] for f in findings)
+
+    bad = json.loads(json.dumps(good))
+    bad["promotion"]["kill_canary"]["client_errors"] = 2
+    bad["promotion"]["kill_canary"]["converged"] = False
+    bad["promotion"]["rollback"]["rolled_back"] = False
+    failed = {f["metric"] for f in check_promotion(bad) if not f["ok"]}
+    assert failed == {
+        "kill_canary.client_errors",
+        "kill_canary.converged",
+        "rollback.rolled_back",
+    }
+    # pre-promotion baselines compare nothing
+    assert check_promotion({}) == []
+
+
+# -- subprocess end-to-end ----------------------------------------------------
+
+
+def _export_identified_artifact(directory, seed, perturb=0.0):
+    """Export a real artifact WITH a quantization identity section (float32
+    identity recipe → dtype + source fingerprint over the params), so the
+    promotion controller's identity verification is exercised for real.
+    ``perturb`` nudges the weights: small = a passing candidate, large = a
+    poisoned one the shadow gate must catch."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowdistributedlearning_tpu.train import quantize
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    w = jax.random.normal(
+        jax.random.PRNGKey(seed), (FEATURES, CLASSES)
+    ) * 0.5
+    if perturb:
+        w = w + perturb * jax.random.normal(
+            jax.random.PRNGKey(seed + 100), w.shape
+        )
+    params = {"dense": {"kernel": w}}
+    _, section = quantize.quantize_pytree(params, "float32")
+
+    def serve(x):
+        logits = x @ params["dense"]["kernel"]
+        return {
+            "probabilities": jax.nn.softmax(logits, axis=-1),
+            "class": jnp.argmax(logits, axis=-1),
+        }
+
+    serving_lib.export_serving_artifact(
+        serve, (1, FEATURES), directory, quantization=section
+    )
+    return directory
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return env
+
+
+def _spawn_fleet(artifact, workdir, replicas):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tensorflowdistributedlearning_tpu",
+         "serve-fleet", "--artifact-dir", artifact, "--workdir", workdir,
+         "--port", "0", "--replicas", str(replicas), "--no-autoscale",
+         "--window-secs", "2", "--buckets", "1", "4",
+         "--poll-interval-s", "0.25"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=_env(), text=True,
+    )
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline().strip()
+        if line.startswith("{"):
+            return proc, json.loads(line)["router"]
+    proc.kill()
+    raise RuntimeError("serve-fleet not ready")
+
+
+def _stop_fleet(proc):
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(90)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(10)
+
+
+class _LoadThread:
+    """Closed-loop client against the router; every non-200 is recorded."""
+
+    def __init__(self, url):
+        self.url = url
+        self.ok = 0
+        self.errors = []
+        self._stop = threading.Event()
+        rng = np.random.default_rng(5)
+        self.x = rng.normal(0, 1, (1, FEATURES)).astype(np.float32)
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        import http.client
+        import urllib.parse
+
+        parsed = urllib.parse.urlsplit(self.url)
+        body = json.dumps({"instances": self.x.tolist()})
+        conn = None
+        while not self._stop.is_set():
+            try:
+                if conn is None:
+                    conn = http.client.HTTPConnection(
+                        parsed.hostname, parsed.port, timeout=30
+                    )
+                conn.request("POST", "/v1/predict", body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status == 200:
+                    self.ok += 1
+                else:
+                    self.errors.append(resp.status)
+            except (OSError, http.client.HTTPException) as e:
+                try:
+                    if conn is not None:
+                        conn.close()
+                except OSError:
+                    pass
+                conn = None
+                self.errors.append(f"conn:{type(e).__name__}")
+            time.sleep(0.01)
+
+    def stop(self):
+        self._stop.set()
+        self.thread.join(10)
+
+
+def _promote_cli(workdir, candidate, extra=()):
+    return subprocess.run(
+        [sys.executable, "-m", "tensorflowdistributedlearning_tpu",
+         "promote", "--workdir", workdir, "--candidate-dir", candidate,
+         "--shadow-secs", "1.5", "--shadow-fraction", "1.0",
+         "--shadow-min-requests", "5", "--observe-secs", "0.5",
+         # CPU tail latency swings several-fold under subprocess load (the
+         # sentinel uses a 6x p99 band for the same reason); the accuracy
+         # gates are what these drills pin
+         "--max-p99-ratio", "5.0",
+         "--timeout", "420", "--json", *extra],
+        capture_output=True, text=True, env=_env(), timeout=600,
+    )
+
+
+@pytest.mark.slow
+def test_promotion_e2e_kill_canary_converges(tmp_path):
+    """The headline drill: 3-replica fleet under closed-loop load, promote a
+    fresh (passing) artifact with the canary SIGKILLed mid-shadow — zero
+    client-visible errors, the fleet converges on the candidate fingerprint,
+    and telemetry-report renders the whole deployment history."""
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    v1 = _export_identified_artifact(str(tmp_path / "v1"), seed=1)
+    v2 = _export_identified_artifact(
+        str(tmp_path / "v2"), seed=1, perturb=0.002
+    )
+    v2_fp = serving_lib.read_manifest(v2)["quantization"][
+        "source_fingerprint"
+    ].split(":", 1)[-1]
+    workdir = str(tmp_path / "fleet")
+    proc, router_url = _spawn_fleet(v1, workdir, replicas=3)
+    load = _LoadThread(router_url)
+    try:
+        time.sleep(1.0)  # some pre-promotion traffic
+        result = _promote_cli(
+            workdir, v2, extra=["--canary-inject-fault", "sigkill@10"]
+        )
+        assert result.returncode == 0, (
+            f"promote failed: {result.stdout}\n{result.stderr}"
+        )
+        status = json.loads(result.stdout.strip().splitlines()[-1])
+        assert status["state"] == "complete"
+        # the whole fleet answers from the candidate fingerprint
+        health = json.loads(
+            urllib.request.urlopen(router_url + "/healthz", timeout=10).read()
+        )
+        assert health["mixed_artifacts"] is False
+        assert list(health["artifacts"]) == [f"float32:{v2_fp[:8]}"]
+        load.stop()
+        assert load.errors == [], f"client-visible errors: {load.errors[:10]}"
+        assert load.ok > 50
+    finally:
+        load.stop()
+        _stop_fleet(proc)
+
+    from tensorflowdistributedlearning_tpu.obs.report import report_workdir
+
+    rendered = report_workdir(workdir)
+    assert "deployment history" in rendered
+    assert "complete: fleet on" in rendered
+    as_json = json.loads(report_workdir(workdir, as_json=True))
+    assert as_json["promotion"]["completed"] == 1
+    assert as_json["promotion"]["shadow_compared"] >= 5
+    # the canary death is on record: a replica_exit with rc 137 and exactly
+    # one restart, absorbed without a rollback
+    from tensorflowdistributedlearning_tpu.obs.ledger import read_ledger
+
+    events = read_ledger(workdir)
+    kinds = [e["event"] for e in events]
+    assert "replica_exit" in kinds and "replica_restart" in kinds
+    assert "promotion_rollback" not in kinds
+
+
+@pytest.mark.slow
+def test_promotion_e2e_poisoned_candidate_rolls_back(tmp_path):
+    """A behaviorally-regressed candidate passes admission (it is internally
+    consistent) but the shadow compare catches it: automatic rollback, fleet
+    back on the incumbent fingerprint, zero client-visible errors. Also pins
+    admission refusal: a reference whose fingerprint mismatches is refused
+    without touching the fleet."""
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    v1 = _export_identified_artifact(str(tmp_path / "v1"), seed=1)
+    poisoned = _export_identified_artifact(
+        str(tmp_path / "poisoned"), seed=1, perturb=2.0
+    )
+    v1_fp = serving_lib.read_manifest(v1)["quantization"][
+        "source_fingerprint"
+    ].split(":", 1)[-1]
+    workdir = str(tmp_path / "fleet")
+    proc, router_url = _spawn_fleet(v1, workdir, replicas=2)
+    load = _LoadThread(router_url)
+    try:
+        # admission refusal first: pairing the poisoned candidate against
+        # the v1 reference is a fingerprint mismatch — refused, fleet
+        # untouched (no replica ever spawns on it)
+        refused = _promote_cli(
+            workdir, poisoned, extra=["--reference-dir", v1]
+        )
+        assert refused.returncode == 1
+        refused_status = json.loads(
+            refused.stdout.strip().splitlines()[-1]
+        )
+        assert refused_status["state"] == "refused"
+
+        # now the real rollback drill: manifest-only admission passes, the
+        # shadow compare must catch the behavioral regression
+        result = _promote_cli(workdir, poisoned)
+        assert result.returncode == 1, (
+            f"poisoned candidate was promoted: {result.stdout}"
+        )
+        status = json.loads(result.stdout.strip().splitlines()[-1])
+        assert status["state"] == "rolled_back"
+        assert "accuracy" in status.get("reason", "")
+        health = json.loads(
+            urllib.request.urlopen(router_url + "/healthz", timeout=10).read()
+        )
+        assert health["mixed_artifacts"] is False
+        assert list(health["artifacts"]) == [f"float32:{v1_fp[:8]}"]
+        assert health["live"] == 2
+        load.stop()
+        assert load.errors == [], f"client-visible errors: {load.errors[:10]}"
+    finally:
+        load.stop()
+        _stop_fleet(proc)
+
+    as_json = json.loads(
+        __import__(
+            "tensorflowdistributedlearning_tpu.obs.report",
+            fromlist=["report_workdir"],
+        ).report_workdir(workdir, as_json=True)
+    )
+    pm = as_json["promotion"]
+    assert pm["rolled_back"] == 1 and pm["refused"] == 1
+    assert pm["completed"] == 0

@@ -46,6 +46,17 @@ never any other 5xx, never unbounded queueing), and a kill-a-replica soak
 re-dispatch onto survivors with ZERO client-visible errors, the manager must
 restart the replica, and the fleet must converge back to full strength).
 
+``--promotion`` adds the train→serve promotion soak (serve/promote.py
+through the real CLIs, closed-loop load the whole time): the
+kill-mid-canary drill — promote a passing candidate across a 3-replica
+fleet with ``sigkill@N`` injected into the canary's first launch; the
+controller must CONVERGE (promotion complete, canary restarted on the
+candidate, zero client-visible errors) — and the rollback-on-regression
+drill — a poisoned candidate must pass manifest admission but be caught by
+the shadow compare and rolled back automatically, fleet restored to the
+incumbent fingerprint. The record gains a ``promotion`` section replayed as
+hard gates by ``tools/regression_sentinel.py``.
+
 Writes a JSON record (default BENCH_SERVE.json). ``--check`` exits non-zero
 unless batched/per_request speedup >= --min-speedup, recompiles == 0, and the
 backpressure probe rejected structurally — the CI serve-smoke gate
@@ -292,6 +303,284 @@ def export_fleet_artifact(directory: str) -> str:
         return {"mask_probabilities": jax.nn.sigmoid(h @ w2)}
 
     return serving_lib.export_serving_artifact(serve, (1, FEATURES), directory)
+
+
+def export_promotion_artifact(
+    directory: str, seed: int, perturb: float = 0.0
+) -> str:
+    """Export a promotion-soak artifact WITH an identity section (float32
+    identity recipe: dtype + sha256 source fingerprint over the params) so
+    the controller's replica-identity verification runs for real. ``perturb``
+    nudges the weights off the seed model: small = a passing candidate,
+    large = the poisoned one the shadow gate must catch."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowdistributedlearning_tpu.train import quantize
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    w1 = jax.random.normal(k1, (FEATURES, 256), jnp.float32) * 0.05
+    w2 = jax.random.normal(k2, (256, 512), jnp.float32) * 0.05
+    if perturb:
+        kp = jax.random.PRNGKey(seed + 1000)
+        w2 = w2 + perturb * jax.random.normal(kp, w2.shape, jnp.float32)
+    params = {"l1": {"kernel": w1}, "l2": {"kernel": w2}}
+    _, section = quantize.quantize_pytree(params, "float32")
+
+    def serve(x):
+        h = jnp.maximum(x @ params["l1"]["kernel"], 0.0)
+        return {"mask_probabilities": jax.nn.sigmoid(h @ params["l2"]["kernel"])}
+
+    serving_lib.export_serving_artifact(
+        serve, (1, FEATURES), directory, quantization=section
+    )
+    return directory
+
+
+class _PromotionLoad:
+    """Continuous closed-loop client for the promotion soak: runs until
+    stopped (a promotion's length is not known up front), counts every
+    non-200 as a client-visible error."""
+
+    def __init__(self, url: str):
+        import urllib.parse
+
+        self.parsed = urllib.parse.urlsplit(url)
+        self.ok = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        rng = np.random.default_rng(17)
+        self.body = json.dumps(
+            {"instances": rng.normal(0, 1, (1, FEATURES)).tolist()}
+        )
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        import http.client
+
+        conn = None
+        while not self._stop.is_set():
+            try:
+                if conn is None:
+                    conn = http.client.HTTPConnection(
+                        self.parsed.hostname, self.parsed.port, timeout=30
+                    )
+                conn.request("POST", "/v1/predict", self.body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status == 200:
+                    self.ok += 1
+                else:
+                    self.errors += 1
+            except (OSError, http.client.HTTPException):
+                try:
+                    if conn is not None:
+                        conn.close()
+                except OSError:
+                    pass
+                conn = None
+                self.errors += 1
+            time.sleep(0.005)
+
+    def stop(self):
+        self._stop.set()
+        self.thread.join(10)
+
+
+def _run_promote_cli(workdir: str, candidate: str, extra=()) -> dict:
+    """Drive the real ``promote`` CLI against the live fleet; returns the
+    parsed terminal status plus the exit code."""
+    import subprocess
+
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep + os.environ.get(
+        "PYTHONPATH", ""))
+    t0 = time.monotonic()
+    out = subprocess.run(
+        [sys.executable, "-m", "tensorflowdistributedlearning_tpu",
+         "promote", "--workdir", workdir, "--candidate-dir", candidate,
+         "--shadow-secs", "2", "--shadow-fraction", "1.0",
+         "--shadow-min-requests", "8", "--observe-secs", "0.5",
+         "--max-p99-ratio", "5.0", "--timeout", "420", "--json", *extra],
+        capture_output=True, text=True, env=env, timeout=600,
+    )
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    status = json.loads(lines[-1]) if lines else {}
+    status["rc"] = out.returncode
+    status["duration_s"] = round(time.monotonic() - t0, 3)
+    if out.returncode == 2:
+        status["stderr"] = out.stderr.strip()[-300:]
+    return status
+
+
+def promotion_soak(args, telemetry) -> dict:
+    """The ``promotion`` section: two drills through the REAL stack
+    (serve-fleet CLI fleet + promote CLI controller, closed-loop load the
+    whole time). (1) kill-mid-canary: promote a passing candidate with
+    ``sigkill@N`` injected into the canary's first launch — the controller
+    must CONVERGE (promotion completes, the dead canary restarted on the
+    candidate) with zero client-visible errors; (2) rollback-on-regression:
+    promote a poisoned candidate — the shadow compare must fire the
+    automatic rollback, fleet back on the incumbent fingerprint, again with
+    zero client-visible errors."""
+    import tempfile
+    import urllib.request
+
+    from tensorflowdistributedlearning_tpu.obs.ledger import read_ledger
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    root = tempfile.mkdtemp(prefix="bench_promo_")
+    v1 = export_promotion_artifact(os.path.join(root, "v1"), seed=21)
+    v2 = export_promotion_artifact(
+        os.path.join(root, "v2"), seed=21, perturb=1e-3
+    )
+    poisoned = export_promotion_artifact(
+        os.path.join(root, "poisoned"), seed=21, perturb=2.0
+    )
+    fp = {
+        name: serving_lib.read_manifest(d)["quantization"][
+            "source_fingerprint"].split(":", 1)[-1][:8]
+        for name, d in (("v1", v1), ("v2", v2), ("poisoned", poisoned))
+    }
+    section: dict = {"fingerprints": fp}
+
+    def healthz(url):
+        with urllib.request.urlopen(url + "/healthz", timeout=10) as resp:
+            return json.loads(resp.read())
+
+    # drill 1: kill the canary mid-rollout; the promotion must converge
+    print("promotion kill-mid-canary drill (3 replicas) ...", flush=True)
+    kill_dir = os.path.join(root, "promo-kill")
+    proc, router_url = _spawn_fleet_cli(
+        args, v1, kill_dir, 3, window_secs=2.0
+    )
+    load = _PromotionLoad(router_url)
+    try:
+        time.sleep(1.0)
+        status = _run_promote_cli(
+            kill_dir, v2,
+            extra=["--canary-inject-fault",
+                   f"sigkill@{args.promotion_kill_after}"],
+        )
+        health = healthz(router_url)
+        load.stop()
+        kill = {
+            "completed": status.get("state") == "complete",
+            "state": status.get("state"),
+            "reason": status.get("reason"),
+            "duration_s": status.get("duration_s"),
+            "kill_after_requests": args.promotion_kill_after,
+            "client_ok": load.ok,
+            "client_errors": load.errors,
+            "converged": (
+                health.get("live") == 3
+                and not health.get("mixed_artifacts")
+                and list(health.get("artifacts", {}))
+                == [f"float32:{fp['v2']}"]
+            ),
+            "final_artifacts": health.get("artifacts"),
+        }
+    finally:
+        load.stop()
+        _stop_fleet_cli(proc)
+    events = read_ledger(kill_dir)
+    kill["restarts"] = sum(
+        1 for e in events if e.get("event") == "replica_restart"
+    )
+    kill["shadow_compared"] = sum(
+        e.get("compared", 0)
+        for e in events
+        if e.get("event") == "shadow_window"
+    )
+    section["kill_canary"] = kill
+    telemetry.event("bench_mode", mode="promotion_kill_canary", **kill)
+
+    # drill 2: a poisoned candidate must be caught by the shadow compare
+    # and rolled back automatically
+    print("promotion rollback-on-regression drill (2 replicas) ...",
+          flush=True)
+    rb_dir = os.path.join(root, "promo-rollback")
+    proc, router_url = _spawn_fleet_cli(
+        args, v1, rb_dir, 2, window_secs=2.0
+    )
+    load = _PromotionLoad(router_url)
+    try:
+        time.sleep(1.0)
+        status = _run_promote_cli(rb_dir, poisoned)
+        health = healthz(router_url)
+        load.stop()
+        rollback = {
+            "rolled_back": status.get("state") == "rolled_back",
+            "state": status.get("state"),
+            "reason": status.get("reason"),
+            "duration_s": status.get("duration_s"),
+            "client_ok": load.ok,
+            "client_errors": load.errors,
+            "restored": (
+                health.get("live") == 2
+                and not health.get("mixed_artifacts")
+                and list(health.get("artifacts", {}))
+                == [f"float32:{fp['v1']}"]
+            ),
+            "final_artifacts": health.get("artifacts"),
+        }
+    finally:
+        load.stop()
+        _stop_fleet_cli(proc)
+    section["rollback"] = rollback
+    telemetry.event("bench_mode", mode="promotion_rollback", **rollback)
+    return section
+
+
+def _check_promotion_section(promo: dict) -> list:
+    """The promotion gates (--check with --promotion): mirror of
+    tools/regression_sentinel.check_promotion on a fresh run."""
+    problems = []
+    kill = promo.get("kill_canary")
+    if kill is None:
+        problems.append("promotion: kill-mid-canary drill did not run")
+    else:
+        if not kill.get("completed"):
+            problems.append(
+                f"kill-mid-canary promotion did not complete "
+                f"(state {kill.get('state')}: {kill.get('reason')})"
+            )
+        if not kill.get("converged"):
+            problems.append(
+                "kill-mid-canary fleet did not converge on the candidate "
+                f"fingerprint (artifacts {kill.get('final_artifacts')})"
+            )
+        if kill.get("client_errors"):
+            problems.append(
+                f"kill-mid-canary drill saw {kill['client_errors']} "
+                "client-visible error(s)"
+            )
+        if not kill.get("restarts"):
+            problems.append(
+                "kill-mid-canary drill never killed the canary (0 restarts)"
+            )
+    rollback = promo.get("rollback")
+    if rollback is None:
+        problems.append("promotion: rollback drill did not run")
+    else:
+        if not rollback.get("rolled_back"):
+            problems.append(
+                "poisoned candidate was NOT rolled back "
+                f"(state {rollback.get('state')})"
+            )
+        if not rollback.get("restored"):
+            problems.append(
+                "rollback did not restore the incumbent fingerprint "
+                f"(artifacts {rollback.get('final_artifacts')})"
+            )
+        if rollback.get("client_errors"):
+            problems.append(
+                f"rollback drill saw {rollback['client_errors']} "
+                "client-visible error(s)"
+            )
+    return problems
 
 
 def fleet_closed_loop(url: str, concurrency: int, duration_s: float) -> dict:
@@ -895,6 +1184,20 @@ def main() -> int:
                         help="kill-soak drill: SIGKILL replica 2 after its "
                         "Nth answered request (serve --inject-fault "
                         "sigkill@N)")
+    parser.add_argument("--promotion", action="store_true",
+                        help="add the promotion soak: kill-mid-canary "
+                        "convergence (promote a passing candidate across a "
+                        "3-replica fleet with sigkill@N injected into the "
+                        "canary, zero client-visible errors) and the "
+                        "rollback-on-regression drill (a poisoned "
+                        "candidate MUST be caught by the shadow compare "
+                        "and rolled back) — record section: promotion")
+    parser.add_argument("--promotion-only", action="store_true",
+                        help="run ONLY the promotion soak (implies "
+                        "--promotion)")
+    parser.add_argument("--promotion-kill-after", type=int, default=25,
+                        help="kill-mid-canary drill: SIGKILL the canary "
+                        "after its Nth answered (shadow) request")
     parser.add_argument("--min-fleet-scaling", type=float, default=1.6,
                         help="--check floor for 2-replica vs 1-replica "
                         "throughput")
@@ -906,9 +1209,11 @@ def main() -> int:
         args.quant = True
     if args.fleet_only:
         args.fleet = True
-    if args.fleet_only and args.quant_only:
-        print("--fleet-only and --quant-only are mutually exclusive",
-              file=sys.stderr)
+    if args.promotion_only:
+        args.promotion = True
+    if sum((args.fleet_only, args.quant_only, args.promotion_only)) > 1:
+        print("--fleet-only/--quant-only/--promotion-only are mutually "
+              "exclusive", file=sys.stderr)
         return 2
 
     from tensorflowdistributedlearning_tpu.obs import Telemetry
@@ -945,7 +1250,7 @@ def main() -> int:
         "max_wait_ms": args.max_wait_ms,
     }
 
-    skip_ab = args.quant_only or args.fleet_only
+    skip_ab = args.quant_only or args.fleet_only or args.promotion_only
     if not skip_ab:
         serve_fn = make_synthetic_model()
         # one engine (with its OWN registry) per mode so counters and
@@ -1072,6 +1377,9 @@ def main() -> int:
     if args.fleet:
         record["fleet"] = fleet_soak(args, telemetry)
 
+    if args.promotion:
+        record["promotion"] = promotion_soak(args, telemetry)
+
     if standalone_detector is not None:
         standalone_detector.detach()
     telemetry.event("bench_serve", **{
@@ -1119,6 +1427,16 @@ def main() -> int:
             k: kill.get(k)
             for k in ("client_errors", "restarts", "converged")
         }
+    if args.promotion:
+        promo = record["promotion"]
+        summary["promotion_kill_canary"] = {
+            k: (promo.get("kill_canary") or {}).get(k)
+            for k in ("completed", "converged", "client_errors", "restarts")
+        }
+        summary["promotion_rollback"] = {
+            k: (promo.get("rollback") or {}).get(k)
+            for k in ("rolled_back", "restored", "client_errors")
+        }
     print(json.dumps(summary))
 
     if args.check:
@@ -1145,6 +1463,8 @@ def main() -> int:
             problems.extend(_check_quant(record["quant"], args))
         if args.fleet:
             problems.extend(_check_fleet(record["fleet"], args))
+        if args.promotion:
+            problems.extend(_check_promotion_section(record["promotion"]))
         if problems:
             print("CHECK FAILED: " + "; ".join(problems), file=sys.stderr)
             return 1

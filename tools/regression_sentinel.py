@@ -360,6 +360,71 @@ def check_fleet(
     return out
 
 
+def check_promotion(
+    baseline: Dict,
+    fresh: Optional[Dict] = None,
+) -> List[Dict]:
+    """Replay the BENCH_SERVE.json ``promotion`` section's hard gates.
+
+    Like the fleet soak, the promotion soak (``bench_serve --promotion``) is
+    too heavy for every CI run, so the default mode REPLAYS the committed
+    section: the kill-mid-canary drill must have CONVERGED (promotion
+    completed, dead canary restarted) with zero client-visible errors, and
+    the poisoned-candidate drill must have actually ROLLED BACK — a
+    promotion pipeline whose rollback never fires is worse than none,
+    because operators trust it. All gates are correctness-hard
+    (dimensionless), no machine slack. A ``--fresh-serve`` record carrying
+    its own ``promotion`` section is gated instead."""
+    record = fresh if fresh and fresh.get("promotion") else baseline
+    promo = record.get("promotion")
+    if not promo:
+        return []
+    out: List[Dict] = []
+    kill = promo.get("kill_canary")
+    if kill is not None:
+        out.append(_finding(
+            "promotion", "kill_canary.completed", True,
+            kill.get("completed"), "== true (hard)",
+            bool(kill.get("completed")),
+        ))
+        out.append(_finding(
+            "promotion", "kill_canary.converged", True,
+            kill.get("converged"), "== true (hard)",
+            bool(kill.get("converged")),
+        ))
+        out.append(_finding(
+            "promotion", "kill_canary.client_errors", 0,
+            kill.get("client_errors", 0), "== 0 (hard)",
+            not kill.get("client_errors"),
+        ))
+        out.append(_finding(
+            "promotion", "kill_canary.restarts", ">= 1",
+            kill.get("restarts", 0),
+            ">= 1 (the drill must actually have killed the canary)",
+            kill.get("restarts", 0) >= 1,
+        ))
+    rollback = promo.get("rollback")
+    if rollback is not None:
+        out.append(_finding(
+            "promotion", "rollback.rolled_back", True,
+            rollback.get("rolled_back"),
+            "== true (an injected regression MUST fire the rollback)",
+            bool(rollback.get("rolled_back")),
+        ))
+        out.append(_finding(
+            "promotion", "rollback.client_errors", 0,
+            rollback.get("client_errors", 0), "== 0 (hard)",
+            not rollback.get("client_errors"),
+        ))
+        out.append(_finding(
+            "promotion", "rollback.restored", True,
+            rollback.get("restored"),
+            "== true (fleet back on the incumbent fingerprint)",
+            bool(rollback.get("restored")),
+        ))
+    return out
+
+
 # -- fresh-run plumbing ------------------------------------------------------
 
 
@@ -409,7 +474,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="run the comparisons and gate on them (the only "
                         "mode; the flag exists so the CI step reads as a "
                         "gate)")
-    parser.add_argument("--benches", default="async,serve,fleet,records",
+    parser.add_argument("--benches",
+                        default="async,serve,fleet,records,promotion",
                         help="comma-separated subset to check")
     parser.add_argument("--baseline-async",
                         default=os.path.join(REPO, "BENCH_ASYNC.json"))
@@ -496,6 +562,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             findings += check_fleet(baseline, fresh)
         except (OSError, ValueError) as e:
             errors.append(f"fleet: {e}")
+    if "promotion" in benches:
+        try:
+            baseline = _load(args.baseline_serve)
+            fresh = _load(args.fresh_serve) if args.fresh_serve else None
+            findings += check_promotion(baseline, fresh)
+        except (OSError, ValueError) as e:
+            errors.append(f"promotion: {e}")
     if "records" in benches:
         try:
             baseline = _load(args.baseline_records)
