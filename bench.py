@@ -918,6 +918,234 @@ def bench_async_loop(
     return result
 
 
+def bench_plan(
+    n: int | None = None, check: bool = False, max_ratio: float = 1.05,
+) -> dict:
+    """Parallelism-planner A/B (``--parallelism auto`` vs hand-tuned preset
+    layouts), committed as BENCH_PLAN.json and replayed as hard gates by
+    ``tools/regression_sentinel.py``.
+
+    For each entry the planner derives the auto layout (the 8k entry gets an
+    HBM budget computed to exclude the replicated optimizer state — the
+    budget-driven ZeRO-1 choice the planner exists for), then BOTH layouts
+    run real train steps through the production step builders, best-of-N
+    windows. Gates (``--check``): auto step time <= ``max_ratio`` x hand
+    (auto must match or beat the hand-tuned layout), and the plan's
+    predicted params+opt+stats bytes/chip must equal the placed state's
+    ``tree_bytes_per_device`` EXACTLY (the planner's accounting contract).
+    """
+    import dataclasses as dc
+
+    import jax
+    import numpy as np
+    from flax.core import unfreeze
+
+    from tensorflowdistributedlearning_tpu.configs import PRESETS
+    from tensorflowdistributedlearning_tpu.models import build_model
+    from tensorflowdistributedlearning_tpu.parallel import planner as planner_lib
+    from tensorflowdistributedlearning_tpu.parallel import tensor as tp_lib
+    from tensorflowdistributedlearning_tpu.parallel import zero as zero_lib
+    from tensorflowdistributedlearning_tpu.parallel.mesh import (
+        make_mesh,
+        replicate,
+        shard_batch,
+    )
+    from tensorflowdistributedlearning_tpu.train.state import (
+        create_train_state,
+        tree_bytes_per_device,
+    )
+    from tensorflowdistributedlearning_tpu.train.step import (
+        ClassificationTask,
+        make_optimizer,
+        make_train_step,
+    )
+    from tensorflowdistributedlearning_tpu.utils.profiling import StepTimer, sync
+
+    n = n or len(jax.devices())
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        steps, warm, trials = 30, 3, 3
+    else:
+        steps, warm, trials = 8, 2, 3
+
+    def run_layout(mcfg, tcfg, layout, global_batch) -> dict:
+        """Timed steps + measured state bytes under one layout, through the
+        same builders the trainers dispatch on (shard_map dp/zero1, GSPMD
+        tp) — pipeline/spatial layouts are out of this bench's scope."""
+        if layout.pipeline_parallel > 1 or layout.sequence_parallel > 1 or (
+            layout.expert_parallel > 1
+        ):
+            raise RuntimeError(
+                f"bench_plan does not time layout {layout.describe()}"
+            )
+        tp = layout.model_parallel > 1
+        mesh = make_mesh(n, model_parallel=layout.model_parallel)
+        model = build_model(mcfg)
+        tx = make_optimizer(tcfg)
+        state = create_train_state(
+            model, tx, jax.random.PRNGKey(0),
+            np.zeros((1, *mcfg.input_shape, mcfg.input_channels), np.float32),
+        )
+        state = state.replace(batch_stats=unfreeze(state.batch_stats))
+        if layout.weight_update_sharding:
+            state = zero_lib.shard_state_weight_update(
+                state, mesh, tensor_parallel=tp
+            )
+        elif tp:
+            state = tp_lib.shard_state_tensor_parallel(state, mesh)
+        else:
+            state = replicate(state, mesh)
+        measured_bytes = (
+            tree_bytes_per_device(state.params)
+            + tree_bytes_per_device(state.batch_stats)
+            + tree_bytes_per_device(state.opt_state)
+        )
+        gen = np.random.default_rng(0)
+        batch = shard_batch(
+            {
+                "images": gen.normal(
+                    0, 1,
+                    (global_batch, *mcfg.input_shape, mcfg.input_channels),
+                ).astype(np.float32),
+                "labels": gen.integers(
+                    0, mcfg.num_classes, global_batch
+                ).astype(np.int32),
+            },
+            mesh,
+        )
+        if tp:
+            step = tp_lib.make_train_step_gspmd(
+                mesh, ClassificationTask(), donate=False,
+                weight_update_sharding=layout.weight_update_sharding,
+            )
+        else:
+            step = make_train_step(
+                mesh, ClassificationTask(), donate=False,
+                weight_update_sharding=layout.weight_update_sharding,
+            )
+        comp = step.lower(state, batch).compile()
+        s = state
+        for _ in range(warm):
+            s, m = comp(s, batch)
+        sync(m)
+        dts = []
+        for _ in range(trials):
+            timer = StepTimer()
+            timer.start()
+            for _ in range(steps):
+                s, m = comp(s, batch)
+            dts.append(timer.stop(m) / steps)
+        return {
+            "layout": layout.to_json(),
+            "step_time_ms": round(min(dts) * 1000, 3),
+            "state_bytes_per_chip": measured_bytes,
+        }
+
+    def scaled_8k_model():
+        """The resnet50_bf16_8k architecture shrunk to bench scale (input +
+        width only — the layout story, LARS + ZeRO-1, is what is under
+        test, not the FLOPs)."""
+        return dc.replace(
+            PRESETS["resnet50_bf16_8k"].model,
+            input_shape=(32, 32),
+            width_multiplier=0.25,
+        )
+
+    entries = {
+        "cifar10_smoke": {
+            "model": PRESETS["cifar10_smoke"].model,
+            "train": PRESETS["cifar10_smoke"].train,
+            "batch": 8 * n,
+            "budgeted": False,
+        },
+        "resnet50_bf16_8k": {
+            "model": scaled_8k_model(),
+            "train": PRESETS["resnet50_bf16_8k"].train,
+            "batch": 4 * n,
+            # budget computed below to exclude the replicated optimizer
+            # state: the planner must re-derive the preset's hand-tuned
+            # ZeRO-1 choice from the budget, not copy it
+            "budgeted": True,
+        },
+    }
+
+    result: dict = {
+        "n_chips": n,
+        "timed_steps": steps,
+        "trials": trials,
+        "presets": {},
+    }
+    for name, entry in entries.items():
+        mcfg, hand_tcfg = entry["model"], entry["train"]
+        batch = entry["batch"]
+        base_tcfg = dc.replace(
+            hand_tcfg,
+            model_parallel=1, pipeline_parallel=1, sequence_parallel=1,
+            expert_parallel=1, weight_update_sharding=False,
+        )
+        profile = planner_lib.profile_model(mcfg, base_tcfg)
+        topo = planner_lib.detect_topology(n)
+        budget = None
+        if entry["budgeted"]:
+            # halfway between the plain-DP footprint and the ZeRO-1 one:
+            # replicated opt state cannot fit, the sharded layouts can
+            free = planner_lib.plan(
+                mcfg, base_tcfg, batch, topology=topo, profile=profile,
+                source="auto",
+            )
+            totals = {
+                c.layout.describe(): c.bytes["total_bytes_per_chip"]
+                for c in free.candidates
+                if c.bytes
+            }
+            budget = (totals[f"dp{n}"] + totals[f"dp{n}xzero1"]) // 2
+        plan = planner_lib.plan(
+            mcfg, base_tcfg, batch, topology=topo, profile=profile,
+            hbm_bytes_per_device=budget, source="auto",
+        )
+        hand_layout = planner_lib.Layout(
+            data_parallel=n // max(
+                hand_tcfg.model_parallel, hand_tcfg.pipeline_parallel,
+                hand_tcfg.expert_parallel,
+            ) // hand_tcfg.sequence_parallel,
+            model_parallel=hand_tcfg.model_parallel,
+            pipeline_parallel=hand_tcfg.pipeline_parallel,
+            sequence_parallel=hand_tcfg.sequence_parallel,
+            expert_parallel=hand_tcfg.expert_parallel,
+            weight_update_sharding=hand_tcfg.weight_update_sharding,
+        )
+        auto = run_layout(mcfg, base_tcfg, plan.layout, batch)
+        hand = run_layout(mcfg, hand_tcfg, hand_layout, batch)
+        predicted = plan.chosen.bytes or {}
+        predicted_state = (
+            predicted.get("params_bytes_per_chip", 0)
+            + predicted.get("batch_stats_bytes_per_chip", 0)
+            + predicted.get("opt_state_bytes_per_chip", 0)
+        )
+        auto["predicted_state_bytes_per_chip"] = predicted_state
+        auto["predicted_bytes_match"] = (
+            predicted_state == auto["state_bytes_per_chip"]
+        )
+        ratio = auto["step_time_ms"] / max(hand["step_time_ms"], 1e-9)
+        result["presets"][name] = {
+            "global_batch": batch,
+            "budget_bytes": budget,
+            "auto": auto,
+            "hand": hand,
+            "layout_match": auto["layout"] == hand["layout"],
+            "step_time_ratio_auto_over_hand": round(ratio, 3),
+        }
+    if check:
+        ok = all(
+            p["step_time_ratio_auto_over_hand"] <= max_ratio
+            and p["auto"]["predicted_bytes_match"]
+            for p in result["presets"].values()
+        )
+        result["check"] = {"max_ratio": max_ratio}
+        result["check_passed"] = bool(ok)
+    return result
+
+
 def _peak_hbm_bytes() -> int:
     """Max ``peak_bytes_in_use`` across local devices; 0 when the backend
     does not implement the allocator query. Delegates to the capacity
@@ -1425,6 +1653,26 @@ def main() -> None:
         if "--max-ratio" in sys.argv:
             max_ratio = float(sys.argv[sys.argv.index("--max-ratio") + 1])
         out = bench_capacity_overhead(check=check, max_ratio=max_ratio)
+        out["platform"] = jax.devices()[0].platform
+        out["device_kind"] = getattr(jax.devices()[0], "device_kind", "unknown")
+        print(json.dumps(out), flush=True)
+        if check and not out.get("check_passed"):
+            sys.exit(1)
+        return
+    if "--plan" in sys.argv:
+        # Parallelism-planner A/B: auto layout vs the hand-tuned preset
+        # layouts through real train steps (committed as BENCH_PLAN.json);
+        # --check gates step-time ratio <= 1.05 and exact bytes accounting.
+        _force_host_devices()
+        import jax
+
+        if "--platform=cpu" in sys.argv:
+            jax.config.update("jax_platforms", "cpu")
+        check = "--check" in sys.argv
+        max_ratio = 1.05
+        if "--max-ratio" in sys.argv:
+            max_ratio = float(sys.argv[sys.argv.index("--max-ratio") + 1])
+        out = bench_plan(check=check, max_ratio=max_ratio)
         out["platform"] = jax.devices()[0].platform
         out["device_kind"] = getattr(jax.devices()[0], "device_kind", "unknown")
         print(json.dumps(out), flush=True)

@@ -96,6 +96,27 @@ def _add_observability(p: argparse.ArgumentParser) -> None:
                    "with --inject-fault nan-loss@N")
 
 
+def _add_planner(p: argparse.ArgumentParser) -> None:
+    """Layout-selection knobs shared by the training commands (train/fit) —
+    parallel/planner.py."""
+    p.add_argument("--parallelism", choices=("explicit", "auto"),
+                   default="explicit",
+                   help="'auto' derives the whole (dp, tp, pp, spatial, "
+                   "zero1) layout from the model's exact param/opt-state "
+                   "accounting, the per-chip HBM budget, and the device "
+                   "topology (parallel/planner.py); any parallelism flag "
+                   "you set explicitly stays pinned (explicit flags win). "
+                   "'explicit' (default) runs your flags verbatim, "
+                   "validated through the same planner so indivisible "
+                   "degrees fail fast with a named constraint. Either way "
+                   "the chosen plan rides the run-header ledger event; "
+                   "inspect candidates with the `plan` subcommand")
+    p.add_argument("--hbm-budget-gb", type=float, default=None,
+                   help="per-chip HBM budget in GiB for the planner's "
+                   "feasibility gate (default: the backend's reported "
+                   "bytes_limit; CPU builds report none)")
+
+
 def _add_resilience(p: argparse.ArgumentParser) -> None:
     """Flags shared by the training commands (train/fit) — resilience/."""
     from tensorflowdistributedlearning_tpu.resilience.preempt import (
@@ -153,6 +174,7 @@ def build_parser() -> argparse.ArgumentParser:
                          "(activations bf16); quantized exports land in "
                          "export/serving-{dtype} beside the float32 "
                          "reference and must pass quantize-check to ship")
+    _add_planner(p_train)
     _add_host_loop(p_train)
     _add_observability(p_train)
     _add_resilience(p_train)
@@ -246,9 +268,54 @@ def build_parser() -> argparse.ArgumentParser:
                        "(crop drops the mirror — digits/text; none streams "
                        "batches untouched; mixup/cutmix add image/label "
                        "mixing on top of flip_crop)")
+    _add_planner(p_fit)
     _add_host_loop(p_fit)
     _add_observability(p_fit)
     _add_resilience(p_fit)
+
+    p_plan = sub.add_parser(
+        "plan",
+        help="print the parallelism planner's candidate table for a model + "
+        "batch + topology: chosen layout, predicted params/opt/activation "
+        "bytes per chip (exact tree_bytes_per_device accounting for "
+        "params+opt), headroom against the HBM budget, and why each "
+        "rejected candidate lost (parallel/planner.py)",
+    )
+    p_plan.add_argument("--preset", default=None,
+                        help="plan for a named preset's model+train config "
+                        "(batch defaults to the preset's global batch)")
+    p_plan.add_argument("--batch-size", type=int, default=None,
+                        help="global batch (default: the preset's, else 64)")
+    p_plan.add_argument("--n-devices", type=int, default=None)
+    p_plan.add_argument("--hbm-gb", type=float, default=None,
+                        help="per-chip HBM budget in GiB (default: the "
+                        "backend's reported bytes_limit; CPU builds report "
+                        "none — feasibility is then divisibility-only)")
+    p_plan.add_argument("--grad-accum", type=int, default=None)
+    # pin any subset of the layout; the planner fills the rest by score
+    p_plan.add_argument("--model-parallel", type=int, default=None)
+    p_plan.add_argument("--pipeline-parallel", type=int, default=None)
+    p_plan.add_argument("--sequence-parallel", type=int, default=None)
+    p_plan.add_argument("--expert-parallel", type=int, default=None)
+    p_plan.add_argument("--weight-update-sharding", action="store_true",
+                        default=None)
+    # model args for preset-less planning (mirror `train`'s)
+    p_plan.add_argument("--backbone", choices=("resnet", "xception", "vit"),
+                        default="resnet")
+    p_plan.add_argument("--input-shape", type=int, nargs=2, default=(101, 101))
+    p_plan.add_argument("--n-blocks", type=int, nargs="+", default=(3, 4, 6))
+    p_plan.add_argument("--base-depth", type=int, default=256)
+    p_plan.add_argument("--block-type",
+                        choices=("bottleneck", "basic_block"),
+                        default="bottleneck")
+    p_plan.add_argument("--dtype", choices=("float32", "bfloat16"),
+                        default="float32")
+    p_plan.add_argument("--num-classes", type=int, default=None,
+                        help="classification head (default: the "
+                        "segmentation head, like `train`)")
+    p_plan.add_argument("--json", action="store_true",
+                        help="full machine-readable plan (chosen layout + "
+                        "every candidate's verdict) instead of the table")
 
     p_serve = sub.add_parser(
         "serve",
@@ -621,12 +688,49 @@ def _trainer(args):
         model_parallel=getattr(args, "model_parallel", 1),
         sync_batch_norm=getattr(args, "sync_bn", False),
         weight_update_sharding=getattr(args, "weight_update_sharding", False),
+        parallelism=getattr(args, "parallelism", None) or "explicit",
+        hbm_budget_gb=getattr(args, "hbm_budget_gb", None),
         **overlap,
     )
+    if tcfg.parallelism == "auto":
+        # derive the layout BEFORE the Trainer builds its mesh; flags the
+        # user set explicitly stay pinned (explicit flags win)
+        import dataclasses
+
+        from tensorflowdistributedlearning_tpu.config import ModelConfig
+        from tensorflowdistributedlearning_tpu.parallel import multihost
+        from tensorflowdistributedlearning_tpu.parallel import (
+            planner as planner_lib,
+        )
+
+        multihost.initialize()
+        mcfg = ModelConfig(
+            backbone=args.backbone,
+            input_shape=tuple(args.input_shape),
+            n_blocks=tuple(args.n_blocks),
+            base_depth=args.base_depth,
+            block_type=args.block_type,
+            dtype=args.dtype,
+        )
+        pinned = {}
+        if getattr(args, "sequence_parallel", 1) != 1:
+            pinned["sequence_parallel"] = args.sequence_parallel
+        if getattr(args, "model_parallel", 1) != 1:
+            pinned["model_parallel"] = args.model_parallel
+        if getattr(args, "weight_update_sharding", False):
+            pinned["weight_update_sharding"] = True
+        run_plan = planner_lib.plan(
+            mcfg, tcfg, args.batch_size, pinned=pinned, source="auto"
+        )
+        tcfg = dataclasses.replace(tcfg, **run_plan.overrides())
+        plan_header = run_plan.header()
+    else:
+        plan_header = None
     return Trainer(
         args.model_dir,
         getattr(args, "data_dir", ""),
         train_config=tcfg,
+        plan=plan_header,
         backbone=args.backbone,
         input_shape=tuple(args.input_shape),
         n_blocks=tuple(args.n_blocks),
@@ -825,6 +929,8 @@ def cmd_fit(args) -> int:
         data_service_workers=args.data_workers,
         trace_sample_rate=args.trace_sample_rate,
         nan_guard=args.nan_guard,
+        parallelism=args.parallelism,
+        hbm_budget_gb=args.hbm_budget_gb,
     )
     print(json.dumps({
         "preset": args.preset,
@@ -833,6 +939,77 @@ def cmd_fit(args) -> int:
         "final_metrics": result.final_metrics,
     }))
     return 0
+
+
+def cmd_plan(args) -> int:
+    """Print the parallelism planner's candidate table (or the full JSON
+    plan): how `--parallelism auto` would lay this model out on this
+    topology, with exact predicted bytes/chip and a named reason for every
+    rejected candidate. Exit status: 0 = a feasible layout exists, 1 = the
+    planner found none (or the pinned spec is infeasible), 2 = usage."""
+    import dataclasses
+
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+    from tensorflowdistributedlearning_tpu.parallel import multihost
+    from tensorflowdistributedlearning_tpu.parallel import planner as planner_lib
+
+    multihost.initialize()
+    if args.preset:
+        from tensorflowdistributedlearning_tpu.configs import get_preset
+
+        try:
+            preset = get_preset(args.preset)
+        except ValueError as e:
+            print(f"plan: {e}", file=sys.stderr)
+            return 2
+        mcfg, tcfg = preset.model, preset.train
+        batch = args.batch_size or preset.global_batch
+    else:
+        mcfg = ModelConfig(
+            backbone=args.backbone,
+            input_shape=tuple(args.input_shape),
+            n_blocks=tuple(args.n_blocks),
+            base_depth=args.base_depth,
+            block_type=args.block_type,
+            dtype=args.dtype,
+            num_classes=args.num_classes,
+        )
+        tcfg = TrainConfig()
+        batch = args.batch_size or 64
+    replace = {"n_devices": args.n_devices}
+    if args.grad_accum is not None:
+        replace["grad_accum_steps"] = args.grad_accum
+    if args.hbm_gb is not None:
+        replace["hbm_budget_gb"] = args.hbm_gb
+    # strip the preset's own layout: the table should show what AUTO would
+    # pick, with only the flags the user passed pinned on top
+    replace.update(
+        model_parallel=1, pipeline_parallel=1, sequence_parallel=1,
+        expert_parallel=1, weight_update_sharding=False,
+    )
+    tcfg = dataclasses.replace(tcfg, **replace)
+    pinned = {
+        key: value
+        for key, value in (
+            ("model_parallel", args.model_parallel),
+            ("pipeline_parallel", args.pipeline_parallel),
+            ("sequence_parallel", args.sequence_parallel),
+            ("expert_parallel", args.expert_parallel),
+            ("weight_update_sharding", args.weight_update_sharding),
+        )
+        if value is not None
+    }
+    try:
+        result = planner_lib.plan(mcfg, tcfg, batch, pinned=pinned)
+    except planner_lib.PlanError as e:
+        print(f"plan: {e}", file=sys.stderr)
+        return 1
+    print(
+        json.dumps(result.to_json())
+        if args.json
+        else planner_lib.render_plan_table(result)
+    )
+    return 0 if result.chosen.feasible else 1
 
 
 def cmd_records_index(args) -> int:
@@ -1619,6 +1796,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "promote": cmd_promote,
         "quantize-check": cmd_quantize_check,
         "presets": cmd_presets,
+        "plan": cmd_plan,
         "records-index": cmd_records_index,
         "telemetry-report": cmd_telemetry_report,
         "telemetry-top": cmd_telemetry_top,

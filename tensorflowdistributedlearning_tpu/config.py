@@ -241,6 +241,19 @@ class TrainConfig:
     lr_warmup_steps: int = 0
     # number of devices to use; None = all (reference: n_gpus, model.py:33)
     n_devices: Optional[int] = None
+    # layout selection mode (parallel/planner.py): "explicit" runs the
+    # degrees below verbatim (validated through the planner so indivisible
+    # specs fail at parse time with a named constraint); "auto" derives the
+    # whole (dp, tp, pp, spatial, zero1) layout from the model's exact
+    # param/opt-state accounting, the per-chip HBM budget, and the device
+    # topology — any degree explicitly set above its default stays PINNED
+    # (explicit flags win) and the planner fills the rest. The chosen plan
+    # rides the run-header ledger event either way.
+    parallelism: str = "explicit"
+    # per-chip HBM budget in GiB for the planner's feasibility gate; None
+    # reads the backend's bytes_limit (CPU builds report none — the budget
+    # gate then only fires when this is set)
+    hbm_budget_gb: Optional[float] = None
     # sequence (spatial) parallel degree: shard the image H dimension over this
     # many devices per data-parallel replica (halo-exchange convs,
     # parallel/spatial.py). 1 = pure data parallelism (the reference's only mode).
@@ -385,6 +398,15 @@ class TrainConfig:
         if self.data_format not in ("NCHW", "NHWC"):
             raise ValueError(
                 f"Unknown data format {self.data_format}. Has to be either NCHW or NHWC"
+            )
+        if self.parallelism not in ("explicit", "auto"):
+            raise ValueError(
+                "parallelism must be 'explicit' or 'auto', got "
+                f"{self.parallelism!r}"
+            )
+        if self.hbm_budget_gb is not None and self.hbm_budget_gb <= 0:
+            raise ValueError(
+                f"hbm_budget_gb must be positive, got {self.hbm_budget_gb}"
             )
         if self.sequence_parallel < 1:
             raise ValueError(

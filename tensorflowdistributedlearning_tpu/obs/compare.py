@@ -36,15 +36,49 @@ from tensorflowdistributedlearning_tpu.obs import report as report_lib
 REGISTRY_FILENAME = "runs.jsonl"
 
 
+def _normalized_layout(header: Dict) -> Optional[Dict]:
+    """The run's parallelism layout, independent of whether the best-effort
+    plan resolved: the plan's layout verbatim when present, else the same
+    six fields reconstructed from the train config + mesh (the trainers
+    ledger the POST-override config, so the two forms always agree)."""
+    plan_layout = (header.get("plan") or {}).get("layout")
+    if plan_layout is not None:
+        return plan_layout
+    tcfg = header.get("train_config") or {}
+    mesh = header.get("mesh") or {}
+    if not tcfg and not mesh:
+        return None
+    return {
+        "data_parallel": mesh.get("batch"),
+        "model_parallel": tcfg.get("model_parallel", 1),
+        "pipeline_parallel": tcfg.get("pipeline_parallel", 1),
+        "sequence_parallel": tcfg.get("sequence_parallel", 1),
+        "expert_parallel": tcfg.get("expert_parallel", 1),
+        "weight_update_sharding": tcfg.get("weight_update_sharding", False),
+    }
+
+
 def config_hash(header: Dict) -> Optional[str]:
     """Short stable hash over the run's model+train config (the run header
     carries both as dicts) — two runs compare apples-to-apples iff it
-    matches. None when the header has no config (foreign/serve ledgers)."""
+    matches. None when the header has no config (foreign/serve ledgers).
+
+    The parallelism plan's LAYOUT is part of the identity: two runs of the
+    same config whose planner chose different layouts (``--parallelism
+    auto`` at different world sizes or budgets) are different executions —
+    their perf deltas are expected, and must never read as config_match.
+    The plan itself is attached best-effort, so when the header carries no
+    ``plan`` the layout is reconstructed from the (always-present) train
+    config degrees + mesh — a run with a plan and an identical run without
+    one hash the same."""
     cfg = {
         k: header.get(k)
         for k in ("model_config", "train_config", "mesh")
         if header.get(k) is not None
     }
+    layout = _normalized_layout(header)
+    if layout is not None:
+        cfg["plan_layout"] = layout
     if not cfg:
         return None
     blob = json.dumps(cfg, sort_keys=True, default=str).encode()
@@ -81,6 +115,17 @@ def run_summary(workdir: str) -> Dict:
         "recompiles_post_warmup": report["recompiles"]["post_warmup_count"],
         "ledger_parse_errors": header.get("ledger_parse_errors", 0),
     }
+    plan = header.get("plan") or {}
+    if plan.get("layout"):
+        # the layout rides the row so a registry diff names WHICH mesh each
+        # run trained under, not just that the hashes differ
+        row["plan"] = {
+            "source": plan.get("source"),
+            "layout": plan["layout"],
+            "predicted_total_bytes_per_chip": (
+                plan.get("predicted") or {}
+            ).get("total_bytes_per_chip"),
+        }
     st = report.get("step_time_ms")
     if st:
         row["step_time_ms"] = st

@@ -674,6 +674,26 @@ def build_report(
     if cost:
         report["cost"] = cost
 
+    # parallelism plan (parallel/planner.py, riding the run header): the
+    # chosen layout + predicted bytes/chip, closed against the measured
+    # watermark peak when the backend ledgered one — the margin the
+    # planner's activation model needs, per run. Stable --json keys:
+    # plan.{source,layout,predicted,headroom_frac,measured_peak_bytes,
+    # measured_minus_predicted_bytes}
+    plan = (header or {}).get("plan")
+    if plan:
+        plan_section: Dict = dict(plan)
+        predicted_total = (plan.get("predicted") or {}).get(
+            "total_bytes_per_chip"
+        )
+        measured = (watermarks or {}).get("peak_bytes")
+        if predicted_total and measured:
+            plan_section["measured_peak_bytes"] = measured
+            plan_section["measured_minus_predicted_bytes"] = (
+                measured - predicted_total
+            )
+        report["plan"] = plan_section
+
     try:
         report["trace"] = _trace_section(trace_dir or workdir, top)
     except (FileNotFoundError, ValueError, OSError):
@@ -710,6 +730,54 @@ def render_report(report: Dict) -> str:
         f"{run['windows']} windows ({run['clean_windows']} clean), "
         f"run {'completed' if run['completed'] else 'IN PROGRESS / interrupted'}"
     )
+    plan = report.get("plan")
+    if plan:
+        lay = plan.get("layout") or {}
+        parts = [f"dp{lay.get('data_parallel', '?')}"]
+        for key, tag in (
+            ("model_parallel", "tp"),
+            ("pipeline_parallel", "pp"),
+            ("sequence_parallel", "sp"),
+            ("expert_parallel", "ep"),
+        ):
+            if (lay.get(key) or 1) > 1:
+                parts.append(f"{tag}{lay[key]}")
+        if lay.get("weight_update_sharding"):
+            parts.append("zero1")
+        pred = plan.get("predicted") or {}
+        line = (
+            f"\nparallelism plan ({plan.get('source', '?')}): "
+            + "x".join(parts)
+        )
+        if pred.get("total_bytes_per_chip"):
+            line += (
+                f" — predicted {pred['total_bytes_per_chip'] / (1 << 20):.1f}"
+                " MB/chip"
+            )
+            detail = [
+                f"{tag} {pred[key] / (1 << 20):.1f}"
+                for key, tag in (
+                    ("params_bytes_per_chip", "params"),
+                    ("opt_state_bytes_per_chip", "opt"),
+                    ("activation_bytes_per_chip", "act"),
+                )
+                if pred.get(key) is not None
+            ]
+            if detail:
+                line += f" ({', '.join(detail)})"
+        if plan.get("headroom_frac") is not None:
+            line += f", headroom {plan['headroom_frac']:.1%}"
+        lines.append(line)
+        if plan.get("measured_peak_bytes"):
+            delta = plan.get("measured_minus_predicted_bytes", 0)
+            lines.append(
+                f"   measured peak {plan['measured_peak_bytes'] / (1 << 20):.1f}"
+                f" MB/chip — {'+' if delta >= 0 else ''}"
+                f"{delta / (1 << 20):.1f} MB vs predicted (the margin the "
+                "planner's activation model needs)"
+            )
+        for warning in plan.get("warnings") or ():
+            lines.append(f"   !! {warning}")
     tp = report.get("throughput")
     if tp:
         lines.append(

@@ -14,6 +14,16 @@ from tensorflowdistributedlearning_tpu.parallel.mesh import (
     shard_batch,
     shard_batch_stacked,
 )
+from tensorflowdistributedlearning_tpu.parallel.planner import (
+    Layout,
+    ParallelPlan,
+    PlanError,
+    Topology,
+    plan,
+    plan_for_config,
+    render_plan_table,
+    validate_config,
+)
 from tensorflowdistributedlearning_tpu.parallel.collectives import (
     pmean_tree,
     psum_tree,

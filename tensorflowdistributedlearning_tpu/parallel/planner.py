@@ -1,0 +1,1066 @@
+"""Unified parallelism planner: one mesh spec from model + HBM budget + topology.
+
+The repo grew five composable parallelism modes (``parallel/``: zero.py,
+tensor.py, pipeline.py, spatial.py, expert.py) but every run still hand-picked
+``--model-parallel/--pipeline-parallel/--expert-parallel/--sequence-parallel/
+--weight-update-sharding`` per invocation — exactly the manual-layout problem
+the GSPMD/pjit scaling methodology (arXiv:2204.06514) automates, priced by the
+chips-for-qps lens of the Gemma-on-TPU report (arXiv:2605.25645): the wrong
+layout wastes chips. This module derives the layout instead:
+
+- **Enumerate** candidate ``(dp, tp, pp, spatial, expert, zero1)`` layouts over
+  the device topology (local devices plus the ``multihost.process_info`` pod
+  shape — a batch shard must never span processes);
+- **Reject** the indivisible ones with a NAMED constraint (the same
+  divisibility rules the execution strategies enforce at trace time, surfaced
+  at plan time) and the over-budget ones with exact predicted bytes/chip — the
+  params/opt-state accounting reuses the REAL spec rules
+  (``tensor.tensor_parallel_spec_for_shape``,
+  ``zero.weight_update_spec_for_degrees``) over an abstract ``eval_shape`` of
+  the actual TrainState, so the prediction matches
+  ``train.state.tree_bytes_per_device`` of the placed state EXACTLY;
+- **Score** the survivors with a simple comms-vs-compute cost model (per-chip
+  all-reduce volume per step against per-chip FLOPs — constants documented on
+  the functions; only the RELATIVE ordering matters) and emit a
+  :class:`ParallelPlan` — the single object both trainers consume.
+
+Entry points:
+
+- :func:`plan` — the engine: pin any subset of the layout fields (explicit
+  flags always win), plan the rest. ``pinned={}`` is ``--parallelism auto``;
+  pinning everything is the explicit-flags validator (indivisible degrees fail
+  fast with the named constraint instead of deep inside pjit; an over-budget
+  EXPLICIT spec is a warning on the plan, not an error — the activation term
+  is an estimate and the operator said what they wanted).
+- :func:`plan_for_config` / :func:`validate_config` — the trainer-facing
+  wrappers over a ``(ModelConfig, TrainConfig, global_batch)`` triple.
+- :func:`render_plan_table` — the ``plan`` CLI's candidate table: chosen
+  layout, predicted params/opt/activation bytes per chip, headroom against
+  the budget, and why each rejected candidate lost.
+
+The chosen plan rides the run-header ledger event (``plan`` field, rendered by
+``telemetry-report``), and the capacity layer's ``memory_watermark`` events
+carry measured-vs-predicted deltas against the same accounting — the feedback
+loop that tells you how much margin this cost model needs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "PlanError",
+    "Layout",
+    "Topology",
+    "ModelProfile",
+    "Candidate",
+    "ParallelPlan",
+    "detect_topology",
+    "profile_model",
+    "plan",
+    "plan_for_config",
+    "validate_config",
+    "render_plan_table",
+]
+
+
+class PlanError(ValueError):
+    """A layout (requested or required) cannot run: the message carries the
+    NAMED constraint (e.g. ``model_axis_indivisible``) so failures are
+    actionable at parse time, not mid-compile."""
+
+
+# -- cost-model constants ----------------------------------------------------
+
+# peak bf16 matmul FLOP/s per chip by device_kind substring (public figures;
+# the same table bench.py prices MFU with). Unknown kinds (CPU hosts) fall
+# back to DEFAULT_PEAK_FLOPS — on a homogeneous mesh only the compute/comms
+# RATIO matters for candidate ordering, not the absolute scale.
+PEAK_FLOPS_BY_KIND = {
+    "v6e": 918e12,
+    "v6": 918e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v5": 197e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+DEFAULT_PEAK_FLOPS = 100e12
+# per-chip interconnect bandwidth the comm terms divide by (order-of-magnitude
+# ICI figure; DCN-crossing layouts are already excluded by the
+# spans-processes rule, so one constant suffices)
+ICI_BYTES_PER_SEC = 4.5e10
+# backward-pass factor on live activations (forward intermediates kept for
+# grad); remat trades them back for recompute
+ACTIVATION_BWD_FACTOR = 2.0
+# fixed launch/sync latency per collective op: data-parallel pays it once
+# (the bucketed gradient all-reduce), tensor/expert parallel pay it per
+# LAYER — the term that keeps TP from winning on small models where its
+# lower all-reduce volume would otherwise look free. Hosts without a real
+# interconnect (CPU meshes — tests, laptops) pay an order of magnitude more
+# per op, which correctly biases CPU plans toward plain DP/ZeRO-1.
+COLLECTIVE_LATENCY_S = 1e-5
+COLLECTIVE_LATENCY_CPU_S = 1e-4
+# spatial halo exchange: fraction of the per-chip activation bytes that
+# crosses the sequence axis per step (boundary rows only)
+SPATIAL_HALO_FRAC = 0.1
+
+# reject-reason names (stable strings — tests and the CLI table key on them)
+REJECT_MODEL_AXIS = "model_axis_indivisible"
+REJECT_SPANS_PROCESSES = "batch_shard_spans_processes"
+REJECT_BATCH = "batch_indivisible"
+REJECT_PROCESS_BATCH = "process_batch_indivisible"
+REJECT_GRAD_ACCUM = "grad_accum_indivisible"
+REJECT_MICROBATCH = "microbatch_indivisible"
+REJECT_PIPELINE = "pipeline_unsupported"
+REJECT_SPATIAL = "spatial_stride_indivisible"
+REJECT_EXPERT = "expert_mismatch"
+REJECT_CONFLICT = "strategy_conflict"
+REJECT_BUDGET = "over_budget"
+# the SOFT reject set: a pinned/explicit layout failing only these comes back
+# with a warning instead of raising (the activation term is an estimate, and
+# the operator asked for that layout); everything else is a hard constraint
+# no execution strategy can run, which raises with the named reason
+_SOFT_REJECTS = frozenset({REJECT_BUDGET})
+
+
+@dataclasses.dataclass(frozen=True)
+class Layout:
+    """One concrete assignment of every parallelism knob (the fields mirror
+    ``TrainConfig``; ``data_parallel`` is derived, carried for display)."""
+
+    data_parallel: int
+    model_parallel: int = 1
+    pipeline_parallel: int = 1
+    sequence_parallel: int = 1
+    expert_parallel: int = 1
+    weight_update_sharding: bool = False
+
+    @property
+    def model_axis(self) -> int:
+        """The mesh's model-axis degree: tp, pp and ep are mutually exclusive
+        riders on the same axis (parallel/mesh.py contract)."""
+        return max(
+            self.model_parallel, self.pipeline_parallel, self.expert_parallel
+        )
+
+    @property
+    def denom(self) -> int:
+        return self.model_axis * self.sequence_parallel
+
+    def describe(self) -> str:
+        parts = [f"dp{self.data_parallel}"]
+        if self.model_parallel > 1:
+            parts.append(f"tp{self.model_parallel}")
+        if self.pipeline_parallel > 1:
+            parts.append(f"pp{self.pipeline_parallel}")
+        if self.sequence_parallel > 1:
+            parts.append(f"sp{self.sequence_parallel}")
+        if self.expert_parallel > 1:
+            parts.append(f"ep{self.expert_parallel}")
+        if self.weight_update_sharding:
+            parts.append("zero1")
+        return "x".join(parts)
+
+    def to_json(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """The device fabric a plan targets. Constructed from the live backend by
+    :func:`detect_topology`, or by hand for what-if planning (a pod layout
+    planned from a laptop, the fake-``process_info`` tests)."""
+
+    n_devices: int
+    local_device_count: int
+    process_count: int = 1
+    hbm_bytes_per_device: Optional[int] = None
+    device_kind: str = "cpu"
+
+    def peak_flops(self) -> float:
+        kind = self.device_kind.lower()
+        for key, flops in PEAK_FLOPS_BY_KIND.items():
+            if key in kind:
+                return flops
+        return DEFAULT_PEAK_FLOPS
+
+    def collective_latency_s(self) -> float:
+        kind = self.device_kind.lower()
+        if any(key in kind for key in PEAK_FLOPS_BY_KIND):
+            return COLLECTIVE_LATENCY_S
+        return COLLECTIVE_LATENCY_CPU_S
+
+
+def detect_topology(
+    n_devices: Optional[int] = None,
+    hbm_bytes_per_device: Optional[int] = None,
+) -> Topology:
+    """Topology of the live backend (the trainers' path): device count from
+    ``jax.devices()`` (truncated to ``n_devices`` exactly like ``make_mesh``),
+    pod shape from ``multihost.process_info``, per-chip HBM from the
+    allocator's ``bytes_limit`` when the backend reports one (CPU builds
+    report nothing — the budget gate then only fires on an explicit budget)."""
+    import jax
+
+    from tensorflowdistributedlearning_tpu.parallel import multihost
+
+    devices = jax.devices()
+    n = len(devices) if n_devices is None else n_devices
+    if n > len(devices):
+        raise PlanError(
+            f"requested {n} devices but only {len(devices)} are visible"
+        )
+    info = multihost.process_info()
+    if hbm_bytes_per_device is None:
+        from tensorflowdistributedlearning_tpu.utils.profiling import (
+            memory_stats,
+        )
+
+        try:
+            stats = memory_stats() or {}
+        except Exception:  # noqa: BLE001 — a down allocator probe is not fatal
+            stats = {}
+        limits = [
+            int(s["bytes_limit"]) for s in stats.values() if s.get("bytes_limit")
+        ]
+        hbm_bytes_per_device = min(limits) if limits else None
+    return Topology(
+        n_devices=n,
+        local_device_count=min(n, info["local_device_count"]),
+        process_count=info["process_count"],
+        hbm_bytes_per_device=hbm_bytes_per_device,
+        device_kind=getattr(devices[0], "device_kind", devices[0].platform),
+    )
+
+
+@dataclasses.dataclass
+class ModelProfile:
+    """Abstract (ShapeDtypeStruct) view of one training state + an activation
+    estimate — everything candidate evaluation needs, no device memory
+    touched. Tests construct these by hand for synthetic scoring cases."""
+
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    activation_bytes_per_example: int
+    param_count: int
+    # layer-ish count (matrix/conv param leaves) for the per-collective
+    # latency term; synthetic test profiles may set it directly
+    n_layers: int = 1
+
+    @property
+    def params_bytes(self) -> int:
+        return _tree_bytes(self.params, lambda s: ())
+
+    @property
+    def opt_state_bytes(self) -> int:
+        return _tree_bytes(self.opt_state, lambda s: ())
+
+
+def _leaf_bytes(leaf) -> int:
+    shape = getattr(leaf, "shape", None)
+    if shape is None:
+        return 0
+    return int(np.prod(shape, dtype=np.int64)) * np.dtype(leaf.dtype).itemsize
+
+
+def profile_model(model_config, train_config) -> ModelProfile:
+    """Abstract profile of the training state ``(model_config, train_config)``
+    would build: the EXACT params/opt-state pytree (``jax.eval_shape`` over
+    ``create_train_state`` — the optimizer chain included, so Adam moments,
+    LARS slots and the EMA tracker all count), plus an activation estimate
+    from a captured-intermediates abstract forward (every module's output
+    summed; coarse by design — the watermark events' measured-vs-predicted
+    delta is where its error is ledgered).
+
+    Memoized on ``(model_config, tx)``: ``make_optimizer`` already returns
+    one object per equivalent optimizer config, so repeated plans over the
+    same architecture (K-fold loops, every fit() in a test suite) skip the
+    two abstract traces entirely."""
+    from tensorflowdistributedlearning_tpu.train import step as step_lib
+
+    return _profile_model_cached(
+        model_config, step_lib.make_optimizer(train_config)
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _profile_model_cached(model_config, tx) -> ModelProfile:
+    import jax
+
+    from tensorflowdistributedlearning_tpu.models import build_model
+    from tensorflowdistributedlearning_tpu.train.state import create_train_state
+    from tensorflowdistributedlearning_tpu.utils.params import count_params
+
+    model = build_model(model_config)
+    h, w = model_config.input_shape
+    sample = jax.ShapeDtypeStruct(
+        (1, h, w, model_config.input_channels), np.float32
+    )
+    state = jax.eval_shape(
+        lambda rng, x: create_train_state(model, tx, rng, x),
+        jax.ShapeDtypeStruct((2,), np.uint32),
+        sample,
+    )
+    act_bytes = _activation_bytes_per_example(
+        model, state.params, state.batch_stats, sample
+    )
+    n_layers = sum(
+        1
+        for leaf in jax.tree_util.tree_leaves(state.params)
+        if getattr(leaf, "ndim", 0) >= 2
+    )
+    return ModelProfile(
+        params=state.params,
+        batch_stats=state.batch_stats,
+        opt_state=state.opt_state,
+        activation_bytes_per_example=act_bytes,
+        param_count=count_params(state.params),
+        n_layers=max(n_layers, 1),
+    )
+
+
+def _activation_bytes_per_example(model, params, batch_stats, sample) -> int:
+    """Sum of every module's output bytes for one example (abstract
+    captured-intermediates forward) — the forward activation footprint a
+    non-remat backward keeps live. Falls back to a coarse multiple of the
+    input when the abstract forward cannot run (a model that insists on
+    collectives outside shard_map)."""
+    import jax
+
+    input_bytes = _leaf_bytes(sample)
+    variables = {"params": params}
+    if jax.tree_util.tree_leaves(batch_stats):
+        variables["batch_stats"] = batch_stats
+
+    def fwd(v, x):
+        return model.apply(
+            v, x, train=False, capture_intermediates=True,
+            mutable=["intermediates"],
+        )
+
+    try:
+        _, inter = jax.eval_shape(fwd, variables, sample)
+        total = input_bytes + sum(
+            _leaf_bytes(leaf) for leaf in jax.tree_util.tree_leaves(inter)
+        )
+        return int(total)
+    except Exception:  # noqa: BLE001 — estimate, not a gate
+        return int(input_bytes * 64)
+
+
+# -- exact shard accounting --------------------------------------------------
+
+
+def _tree_bytes(tree, spec_for_shape, sizes: Optional[Dict[str, int]] = None) -> int:
+    """Per-chip bytes of an abstract pytree under a spec rule: each dimension
+    named in the leaf's PartitionSpec divides by the product of its axis
+    degrees — integer-exact, because the spec rules only ever shard divisible
+    dimensions, which is precisely ``NamedSharding.shard_shape``'s contract.
+    This is what makes the planner's prediction match
+    ``tree_bytes_per_device`` of the placed state bit-for-bit."""
+    import jax
+
+    sizes = sizes or {}
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            continue
+        dims = list(shape)
+        for i, names in enumerate(spec_for_shape(tuple(shape))):
+            if names is None:
+                continue
+            for name in names if isinstance(names, tuple) else (names,):
+                dims[i] //= sizes.get(name, 1)
+        total += int(np.prod(dims, dtype=np.int64)) * np.dtype(leaf.dtype).itemsize
+    return total
+
+
+def _layout_bytes(
+    profile: ModelProfile,
+    layout: Layout,
+    *,
+    per_chip_examples: float,
+    remat: bool,
+) -> Dict[str, int]:
+    """Predicted bytes/chip per component under ``layout``'s REAL spec rules
+    (replicated / tensor / ZeRO-1 — the same functions placement uses)."""
+    from tensorflowdistributedlearning_tpu.parallel.mesh import (
+        BATCH_AXIS,
+        MODEL_AXIS,
+        SEQUENCE_AXIS,
+    )
+    from tensorflowdistributedlearning_tpu.parallel.tensor import (
+        tensor_parallel_spec_for_shape,
+    )
+    from tensorflowdistributedlearning_tpu.parallel.zero import (
+        weight_update_spec_for_degrees,
+    )
+
+    tp = layout.model_parallel
+    sizes = {
+        BATCH_AXIS: layout.data_parallel,
+        MODEL_AXIS: layout.model_axis,
+        SEQUENCE_AXIS: layout.sequence_parallel,
+    }
+    replicated = lambda shape: ()  # noqa: E731 — the trivial spec rule
+    param_rule = (
+        (lambda s: tensor_parallel_spec_for_shape(s, tp)) if tp > 1 else replicated
+    )
+    if layout.weight_update_sharding:
+        opt_rule = lambda s: weight_update_spec_for_degrees(  # noqa: E731
+            s, dp=layout.data_parallel, tp=tp
+        )
+    else:
+        opt_rule = param_rule
+    params_bytes = _tree_bytes(profile.params, param_rule, sizes)
+    stats_bytes = _tree_bytes(profile.batch_stats, param_rule, sizes)
+    opt_bytes = _tree_bytes(profile.opt_state, opt_rule, sizes)
+    act = profile.activation_bytes_per_example * per_chip_examples
+    act *= 1.0 if remat else ACTIVATION_BWD_FACTOR
+    act /= max(layout.sequence_parallel, 1)
+    return {
+        "params_bytes_per_chip": params_bytes,
+        "batch_stats_bytes_per_chip": stats_bytes,
+        "opt_state_bytes_per_chip": opt_bytes,
+        "activation_bytes_per_chip": int(act),
+        "total_bytes_per_chip": params_bytes + stats_bytes + opt_bytes + int(act),
+    }
+
+
+# -- candidate evaluation ----------------------------------------------------
+
+
+@dataclasses.dataclass
+class Candidate:
+    layout: Layout
+    feasible: bool = False
+    reject_reason: Optional[str] = None
+    reject_detail: Optional[str] = None
+    bytes: Optional[Dict[str, int]] = None
+    headroom_frac: Optional[float] = None
+    compute_s: Optional[float] = None
+    comm_s: Optional[float] = None
+    score: Optional[float] = None
+
+    def to_json(self) -> Dict:
+        out: Dict = {
+            "layout": self.layout.to_json(),
+            "feasible": self.feasible,
+        }
+        if self.reject_reason:
+            out["reject_reason"] = self.reject_reason
+            if self.reject_detail:
+                out["reject_detail"] = self.reject_detail
+        if self.bytes:
+            out["predicted"] = dict(self.bytes)
+        if self.headroom_frac is not None:
+            out["headroom_frac"] = self.headroom_frac
+        if self.score is not None:
+            out["score"] = self.score
+        return out
+
+
+def _check_conflicts(layout: Layout, train_config) -> Optional[Tuple[str, str]]:
+    """The strategy mutual-exclusivity matrix (mirroring
+    ``TrainConfig.__post_init__`` and the trainers): tp/pp/ep each own the
+    model axis exclusively, sequence parallelism is its own execution
+    strategy, the GPipe runner owns its own update placement (no ZeRO-1) and
+    batch math (no grad accumulation), and the mixing augmentations thread
+    extra batch fields only the data/tensor-parallel step carries. Enumerated
+    layouts never combine riders, so this primarily guards PINNED combos —
+    and keeps auto from choosing a layout the config would then reject."""
+    riders = [
+        d for d in (
+            layout.model_parallel, layout.pipeline_parallel,
+            layout.expert_parallel,
+        ) if d > 1
+    ]
+    if len(riders) > 1 or (riders and layout.sequence_parallel > 1):
+        return REJECT_CONFLICT, (
+            f"{layout.describe()}: tensor/pipeline/expert/sequence "
+            "parallelism are mutually exclusive execution strategies over "
+            "the same mesh axes (one rider at a time)"
+        )
+    if layout.pipeline_parallel > 1 and layout.weight_update_sharding:
+        return REJECT_CONFLICT, (
+            "weight_update_sharding cannot combine with pipeline_parallel: "
+            "the GPipe stage runner owns its own update placement"
+        )
+    accum = getattr(train_config, "grad_accum_steps", 1)
+    if accum > 1 and (
+        layout.model_parallel > 1 or layout.pipeline_parallel > 1
+    ):
+        return REJECT_CONFLICT, (
+            f"grad_accum_steps={accum} runs inside the shard_map "
+            "data/spatial step; the GSPMD tensor-parallel and pipeline "
+            "strategies define their own batch math"
+        )
+    augmentation = getattr(train_config, "augmentation", "flip_crop")
+    if augmentation in ("mixup", "cutmix") and (
+        layout.sequence_parallel > 1 or layout.pipeline_parallel > 1
+    ):
+        return REJECT_CONFLICT, (
+            f"augmentation={augmentation!r} threads paired-example batch "
+            "fields the sequence-parallel and pipeline strategies do not "
+            "carry"
+        )
+    if layout.pipeline_parallel > 1 and getattr(
+        train_config, "sync_batch_norm", False
+    ):
+        return REJECT_CONFLICT, (
+            "sync_batch_norm cannot combine with pipeline_parallel: the "
+            "GPipe schedule computes BN statistics microbatch-wise"
+        )
+    return None
+
+
+def _check_divisibility(
+    layout: Layout,
+    model_config,
+    topo: Topology,
+    global_batch: int,
+    grad_accum: int,
+    microbatches: Optional[int],
+) -> Optional[Tuple[str, str]]:
+    """First failed (reason, detail) pair, None when the layout divides. The
+    rules mirror the execution strategies' own trace-time checks — pipeline
+    and spatial delegate to the REAL validators so the constraints can never
+    drift apart."""
+    n, denom = topo.n_devices, layout.denom
+    if n % denom:
+        return REJECT_MODEL_AXIS, (
+            f"{n} devices not divisible by model_axis*sequence = {denom}"
+        )
+    if topo.process_count > 1 and topo.local_device_count % denom:
+        return REJECT_SPANS_PROCESSES, (
+            f"model_axis*sequence = {denom} does not divide the "
+            f"{topo.local_device_count} devices local to each process — a "
+            "data-parallel shard would span processes"
+        )
+    # process divisibility first: every valid dp is a multiple of the
+    # process count (a batch shard never spans processes), so checking dp
+    # first would mask this with the less actionable per-dp message
+    if global_batch % topo.process_count:
+        return REJECT_PROCESS_BATCH, (
+            f"global batch {global_batch} not divisible by process count "
+            f"{topo.process_count}"
+        )
+    dp = layout.data_parallel
+    if global_batch % dp:
+        return REJECT_BATCH, (
+            f"global batch {global_batch} not divisible by data-parallel "
+            f"degree {dp}"
+        )
+    local_bs = global_batch // dp
+    if local_bs % grad_accum:
+        return REJECT_GRAD_ACCUM, (
+            f"per-shard batch {local_bs} not divisible by "
+            f"grad_accum_steps={grad_accum}"
+        )
+    if layout.pipeline_parallel > 1:
+        from tensorflowdistributedlearning_tpu.train.pipeline_step import (
+            validate_pipeline_config,
+        )
+
+        micro = microbatches or layout.pipeline_parallel
+        try:
+            validate_pipeline_config(
+                model_config, layout.pipeline_parallel, micro
+            )
+        except ValueError as e:
+            return REJECT_PIPELINE, str(e)
+        if local_bs % micro:
+            return REJECT_MICROBATCH, (
+                f"per-replica batch {local_bs} not divisible into "
+                f"{micro} pipeline microbatches"
+            )
+    if layout.sequence_parallel > 1:
+        from tensorflowdistributedlearning_tpu.parallel.spatial import (
+            validate_spatial_config,
+        )
+
+        try:
+            validate_spatial_config(model_config, layout.sequence_parallel)
+        except ValueError as e:
+            return REJECT_SPATIAL, str(e)
+    if layout.expert_parallel > 1:
+        experts = getattr(model_config, "moe_experts", 0)
+        if layout.expert_parallel != experts:
+            return REJECT_EXPERT, (
+                f"expert_parallel={layout.expert_parallel} requires "
+                f"moe_experts={layout.expert_parallel} (one expert per "
+                f"shard); the model has {experts}"
+            )
+    return None
+
+
+def _cost(
+    profile: ModelProfile,
+    layout: Layout,
+    topo: Topology,
+    bytes_per_chip: Dict[str, int],
+    global_batch: int,
+    microbatches: Optional[int],
+) -> Tuple[float, float]:
+    """(compute_s, comm_s) for one step under the simple cost model.
+
+    Compute: a dense-proxy ``6 * params * examples`` FLOP count split over
+    the chips, inflated by the GPipe bubble ``(K-1)/M`` for pipeline layouts.
+    Comms, per chip per step (ring-collective volumes over ICI):
+
+    - data-parallel gradient all-reduce: ``2 * P_chip * (dp-1)/dp`` where
+      ``P_chip`` is the per-chip gradient bytes (full params, /tp under TP);
+    - ZeRO-1 adds the parameter all-gather ``P_chip * (dp-1)/dp`` (its win is
+      memory and 1/dp update compute, which the budget gate prices — at
+      equal feasibility plain DP therefore scores no worse, the intended
+      tie-break);
+    - tensor parallel adds per-layer activation all-reduces, approximated by
+      the summed intermediate activations ``2 * A * (tp-1)/tp``;
+    - pipeline adds stage-boundary activations ``2 * A / pp``;
+    - spatial adds the halo exchange ``SPATIAL_HALO_FRAC * A``;
+    - expert parallel adds the token all-to-all ``2 * A * (ep-1)/ep``.
+
+    Every collective additionally pays ``COLLECTIVE_LATENCY_S`` per op:
+    data parallel launches ONE bucketed all-reduce, tensor/expert parallel
+    launch ~2 per layer — the fixed cost that keeps TP from winning on small
+    models where its lower all-reduce volume would otherwise look free.
+    """
+    dp = layout.data_parallel
+    tp = layout.model_parallel
+    act = float(bytes_per_chip["activation_bytes_per_chip"])
+    grad_bytes = float(bytes_per_chip["params_bytes_per_chip"])
+
+    flops = 6.0 * profile.param_count * global_batch
+    compute = flops / topo.n_devices / topo.peak_flops()
+    if layout.pipeline_parallel > 1:
+        micro = microbatches or layout.pipeline_parallel
+        compute *= 1.0 + (layout.pipeline_parallel - 1) / micro
+
+    comm = 0.0
+    latency_ops = 0
+    if dp > 1:
+        comm += 2.0 * grad_bytes * (dp - 1) / dp
+        latency_ops += 1
+        if layout.weight_update_sharding:
+            comm += grad_bytes * (dp - 1) / dp
+            latency_ops += 1
+    if tp > 1:
+        comm += 2.0 * act * (tp - 1) / tp
+        latency_ops += 2 * profile.n_layers
+    if layout.pipeline_parallel > 1:
+        comm += 2.0 * act / layout.pipeline_parallel
+        latency_ops += 2 * (microbatches or layout.pipeline_parallel)
+    if layout.sequence_parallel > 1:
+        comm += SPATIAL_HALO_FRAC * act
+        latency_ops += profile.n_layers
+    if layout.expert_parallel > 1:
+        ep = layout.expert_parallel
+        comm += 2.0 * act * (ep - 1) / ep
+        latency_ops += 2 * profile.n_layers
+    return (
+        compute,
+        comm / ICI_BYTES_PER_SEC
+        + latency_ops * topo.collective_latency_s(),
+    )
+
+
+def _evaluate(
+    profile: ModelProfile,
+    layout: Layout,
+    model_config,
+    train_config,
+    topo: Topology,
+    global_batch: int,
+    grad_accum: int,
+    microbatches: Optional[int],
+    budget_bytes: Optional[int],
+) -> Candidate:
+    cand = Candidate(layout=layout)
+    failed = _check_conflicts(layout, train_config) or _check_divisibility(
+        layout, model_config, topo, global_batch, grad_accum, microbatches
+    )
+    if failed:
+        cand.reject_reason, cand.reject_detail = failed
+        return cand
+    local_bs = global_batch // layout.data_parallel
+    per_chip_examples = local_bs / max(grad_accum, 1)
+    if layout.pipeline_parallel > 1:
+        per_chip_examples = local_bs / (
+            microbatches or layout.pipeline_parallel
+        )
+    cand.bytes = _layout_bytes(
+        profile,
+        layout,
+        per_chip_examples=per_chip_examples,
+        remat=bool(getattr(model_config, "remat", False)),
+    )
+    if budget_bytes:
+        cand.headroom_frac = round(
+            1.0 - cand.bytes["total_bytes_per_chip"] / budget_bytes, 4
+        )
+        if cand.bytes["total_bytes_per_chip"] > budget_bytes:
+            cand.reject_reason = REJECT_BUDGET
+            cand.reject_detail = (
+                f"predicted {cand.bytes['total_bytes_per_chip']} bytes/chip "
+                f"> budget {budget_bytes}"
+            )
+            return cand
+    cand.feasible = True
+    compute, comm = _cost(
+        profile, layout, topo, cand.bytes, global_batch, microbatches
+    )
+    cand.compute_s, cand.comm_s = compute, comm
+    cand.score = compute + comm
+    return cand
+
+
+def _enumerate_layouts(model_config, topo: Topology) -> List[Layout]:
+    """Every layout shape the execution strategies can run on ``topo``:
+    pure DP, one model-axis rider (tp | pp | ep) OR spatial at each divisor
+    of the device count, each with and without ZeRO-1 where it composes
+    (dp > 1, not pipeline — the GPipe runner owns its own update placement)."""
+    n = topo.n_devices
+    divisors = [d for d in range(2, n + 1) if n % d == 0]
+    shapes: List[Dict] = [{}]
+    shapes += [{"model_parallel": d} for d in divisors]
+    if getattr(model_config, "backbone", None) in ("vit", "xception"):
+        shapes += [{"pipeline_parallel": d} for d in divisors]
+    shapes += [{"sequence_parallel": d} for d in divisors]
+    experts = getattr(model_config, "moe_experts", 0)
+    if experts and n % experts == 0 and experts > 1:
+        shapes.append({"expert_parallel": experts})
+    layouts: List[Layout] = []
+    for shape in shapes:
+        base = Layout(data_parallel=1, **shape)
+        # every enumerated shape's denom divides n (divisor-driven); pinned
+        # combinations that do not are appended by plan() and rejected with
+        # the named constraint
+        layout = dataclasses.replace(base, data_parallel=max(n // base.denom, 1))
+        layouts.append(layout)
+        if layout.data_parallel > 1 and layout.pipeline_parallel == 1:
+            layouts.append(
+                dataclasses.replace(layout, weight_update_sharding=True)
+            )
+    return layouts
+
+
+def _matches_pinned(layout: Layout, pinned: Dict) -> bool:
+    return all(getattr(layout, k) == v for k, v in pinned.items())
+
+
+def _layout_from_pinned(pinned: Dict, topo: Topology) -> Layout:
+    base = Layout(data_parallel=1, **pinned)
+    denom = base.denom
+    dp = topo.n_devices // denom if topo.n_devices % denom == 0 else 1
+    return dataclasses.replace(base, data_parallel=max(dp, 1))
+
+
+def _complexity(layout: Layout) -> Tuple:
+    """Deterministic tie-break: at equal score prefer the simpler layout —
+    pure DP beats any model-axis rider, no-ZeRO beats ZeRO (nothing to gain
+    when memory already fits), lower degrees beat higher."""
+    return (
+        layout.denom,
+        int(layout.weight_update_sharding),
+        layout.model_parallel,
+        layout.pipeline_parallel,
+        layout.sequence_parallel,
+        layout.expert_parallel,
+    )
+
+
+@dataclasses.dataclass
+class ParallelPlan:
+    """The planner's verdict: the chosen layout plus the whole candidate
+    table. ``source`` records how it was reached (``auto`` — scored — vs
+    ``explicit`` — requested degrees validated through the same machinery)."""
+
+    chosen: Candidate
+    candidates: List[Candidate]
+    source: str
+    global_batch: int
+    topology: Topology
+    hbm_bytes_per_device: Optional[int]
+    warnings: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def layout(self) -> Layout:
+        return self.chosen.layout
+
+    def overrides(self) -> Dict:
+        """``dataclasses.replace(TrainConfig, **overrides)`` kwargs applying
+        this plan's layout (the single consumption point both trainers use)."""
+        lay = self.layout
+        return {
+            "model_parallel": lay.model_parallel,
+            "pipeline_parallel": lay.pipeline_parallel,
+            "sequence_parallel": lay.sequence_parallel,
+            "expert_parallel": lay.expert_parallel,
+            "weight_update_sharding": lay.weight_update_sharding,
+        }
+
+    def header(self) -> Dict:
+        """The run-header ledger field (``plan`` — see docs/LEDGER_SCHEMA.md):
+        layout + predicted bytes/chip + verdict, JSON-clean."""
+        out: Dict = {
+            "source": self.source,
+            "layout": self.layout.to_json(),
+            "predicted": dict(self.chosen.bytes or {}),
+            "feasible": self.chosen.feasible,
+            "candidates_considered": len(self.candidates),
+            "candidates_feasible": sum(
+                1 for c in self.candidates if c.feasible
+            ),
+        }
+        if self.hbm_bytes_per_device:
+            out["hbm_bytes_per_device"] = self.hbm_bytes_per_device
+            if self.chosen.headroom_frac is not None:
+                out["headroom_frac"] = self.chosen.headroom_frac
+        if self.chosen.score is not None:
+            out["score"] = round(self.chosen.score, 9)
+        if self.chosen.reject_reason:
+            out["reject_reason"] = self.chosen.reject_reason
+        if self.warnings:
+            out["warnings"] = list(self.warnings)
+        return out
+
+    def to_json(self) -> Dict:
+        return {
+            **self.header(),
+            "global_batch": self.global_batch,
+            "topology": dataclasses.asdict(self.topology),
+            "candidates": [c.to_json() for c in self.candidates],
+        }
+
+
+def plan(
+    model_config,
+    train_config,
+    global_batch: int,
+    *,
+    topology: Optional[Topology] = None,
+    profile: Optional[ModelProfile] = None,
+    pinned: Optional[Dict] = None,
+    hbm_bytes_per_device: Optional[int] = None,
+    source: Optional[str] = None,
+) -> ParallelPlan:
+    """The engine. ``pinned`` holds the layout fields explicit flags fixed
+    (explicit flags always win); the planner fills the rest by score. With
+    every field pinned this is the hand-spec validator: a layout failing a
+    HARD (divisibility) constraint raises :class:`PlanError` with the named
+    reason; an over-budget pinned layout comes back with a warning instead
+    (the activation estimate must not veto an explicit request)."""
+    pinned = dict(pinned or {})
+    if topology is None:
+        topology = detect_topology(getattr(train_config, "n_devices", None))
+    budget = hbm_bytes_per_device
+    if budget is None:
+        gb = getattr(train_config, "hbm_budget_gb", None)
+        if gb:
+            budget = int(gb * (1 << 30))
+    if budget is None:
+        budget = topology.hbm_bytes_per_device
+    if profile is None:
+        profile = profile_model(model_config, train_config)
+    grad_accum = getattr(train_config, "grad_accum_steps", 1)
+    microbatches = getattr(train_config, "pipeline_microbatches", None)
+
+    layouts = _enumerate_layouts(model_config, topology)
+    if pinned and not any(_matches_pinned(l, pinned) for l in layouts):
+        # a pinned combination outside the enumerated shapes (e.g. an
+        # indivisible model-axis degree) still gets evaluated so the
+        # rejection carries the named constraint
+        layouts.append(_layout_from_pinned(pinned, topology))
+    seen = set()
+    candidates: List[Candidate] = []
+    for layout in layouts:
+        if layout in seen:
+            continue
+        seen.add(layout)
+        candidates.append(
+            _evaluate(
+                profile, layout, model_config, train_config, topology,
+                global_batch, grad_accum, microbatches, budget,
+            )
+        )
+    matching = [c for c in candidates if _matches_pinned(c.layout, pinned)]
+    feasible = [c for c in matching if c.feasible]
+    fully_pinned = set(pinned) >= {
+        "model_parallel", "pipeline_parallel", "sequence_parallel",
+        "expert_parallel", "weight_update_sharding",
+    }
+    warnings: List[str] = []
+    if not feasible:
+        rejected = matching or candidates
+        soft = [
+            c for c in rejected
+            if c.reject_reason in _SOFT_REJECTS
+        ]
+        if fully_pinned and soft:
+            # explicit spec over budget: warn, do not veto
+            chosen = soft[0]
+            warnings.append(
+                f"requested layout {chosen.layout.describe()} predicted over "
+                f"the HBM budget: {chosen.reject_detail}"
+            )
+        else:
+            reasons = "; ".join(
+                f"{c.layout.describe()}: {c.reject_reason}"
+                + (f" ({c.reject_detail})" if c.reject_detail else "")
+                for c in rejected[:8]
+            )
+            raise PlanError(
+                ("no feasible parallelism layout" if not pinned else
+                 "requested parallelism layout is not feasible")
+                + f" for {topology.n_devices} device(s), global batch "
+                f"{global_batch}: {reasons}"
+            )
+    else:
+        chosen = min(
+            feasible, key=lambda c: (c.score, _complexity(c.layout))
+        )
+    return ParallelPlan(
+        chosen=chosen,
+        candidates=candidates,
+        source=source or ("explicit" if fully_pinned else "auto"),
+        global_batch=global_batch,
+        topology=topology,
+        hbm_bytes_per_device=budget,
+        warnings=warnings,
+    )
+
+
+def _pinned_from_config(train_config) -> Dict:
+    return {
+        "model_parallel": train_config.model_parallel,
+        "pipeline_parallel": train_config.pipeline_parallel,
+        "sequence_parallel": train_config.sequence_parallel,
+        "expert_parallel": train_config.expert_parallel,
+        "weight_update_sharding": train_config.weight_update_sharding,
+    }
+
+
+def plan_for_config(
+    model_config,
+    train_config,
+    global_batch: int,
+    *,
+    topology: Optional[Topology] = None,
+    profile: Optional[ModelProfile] = None,
+) -> ParallelPlan:
+    """The trainer-facing entry: ``parallelism='auto'`` plans freely with any
+    non-default degree pinned (explicit flags win); ``'explicit'`` validates
+    the requested layout through the same machinery."""
+    if getattr(train_config, "parallelism", "explicit") == "auto":
+        pinned = {}
+        for k, v in _pinned_from_config(train_config).items():
+            # NB: a `v not in (1, False)` filter would drop a pinned ZeRO
+            # flag, because True == 1 in Python — compare per-field defaults
+            default = False if k == "weight_update_sharding" else 1
+            if v != default:
+                pinned[k] = v
+        return plan(
+            model_config, train_config, global_batch,
+            topology=topology, profile=profile, pinned=pinned, source="auto",
+        )
+    return validate_config(
+        model_config, train_config, global_batch,
+        topology=topology, profile=profile,
+    )
+
+
+def validate_config(
+    model_config,
+    train_config,
+    global_batch: int,
+    *,
+    topology: Optional[Topology] = None,
+    profile: Optional[ModelProfile] = None,
+) -> ParallelPlan:
+    """Route a hand spec (or a preset's hardcoded flags) through the planner:
+    indivisible degrees fail at parse time with the NAMED constraint; the
+    returned plan carries the exact predicted bytes/chip for the run header."""
+    return plan(
+        model_config, train_config, global_batch,
+        topology=topology, profile=profile,
+        pinned=_pinned_from_config(train_config), source="explicit",
+    )
+
+
+# -- rendering ---------------------------------------------------------------
+
+
+def _mb(x: Optional[int]) -> str:
+    return f"{x / (1 << 20):9.1f}" if x is not None else "      n/a"
+
+
+def render_plan_table(p: ParallelPlan) -> str:
+    """The ``plan`` CLI's human view: one row per candidate — layout,
+    predicted params/opt/activation/total MB per chip, headroom against the
+    budget, score — with the chosen row marked and every rejection named."""
+    topo = p.topology
+    lines = [
+        f"== parallelism plan ({p.source}): {topo.n_devices} device(s) "
+        f"[{topo.device_kind}], {topo.process_count} process(es), "
+        f"global batch {p.global_batch}",
+    ]
+    if p.hbm_bytes_per_device:
+        lines.append(
+            f"   HBM budget: {p.hbm_bytes_per_device / (1 << 30):.2f} GiB/chip"
+        )
+    else:
+        lines.append(
+            "   HBM budget: none (divisibility-only feasibility; pass "
+            "--hbm-gb or run on a backend that reports bytes_limit)"
+        )
+    lines.append(
+        f"   {'layout':<22} {'params':>9} {'opt':>9} {'act':>9} "
+        f"{'total':>9}  {'headroom':>8}  {'score':>12}  verdict"
+    )
+    order = sorted(
+        p.candidates,
+        key=lambda c: (
+            not c.feasible,
+            c.score if c.score is not None else math.inf,
+            _complexity(c.layout),
+        ),
+    )
+    for c in order:
+        mark = "->" if c.layout == p.layout else "  "
+        b = c.bytes or {}
+        headroom = (
+            f"{c.headroom_frac:8.1%}" if c.headroom_frac is not None else "     n/a"
+        )
+        score = f"{c.score:12.6f}" if c.score is not None else "         n/a"
+        verdict = (
+            "chosen" if c.layout == p.layout else
+            ("ok" if c.feasible else
+             f"rejected: {c.reject_reason}")
+        )
+        lines.append(
+            f" {mark} {c.layout.describe():<22} "
+            f"{_mb(b.get('params_bytes_per_chip'))} "
+            f"{_mb(b.get('opt_state_bytes_per_chip'))} "
+            f"{_mb(b.get('activation_bytes_per_chip'))} "
+            f"{_mb(b.get('total_bytes_per_chip'))}  "
+            f"{headroom}  {score}  {verdict}"
+        )
+        if not c.feasible and c.reject_detail:
+            lines.append(f"      {c.reject_detail}")
+    for w in p.warnings:
+        lines.append(f"   WARNING: {w}")
+    lines.append(
+        f"   chosen: {p.layout.describe()} "
+        f"(MB/chip are per-chip predictions under the real placement specs; "
+        f"params+opt match tree_bytes_per_device exactly)"
+    )
+    return "\n".join(lines)

@@ -74,6 +74,17 @@ def tensor_parallel_specs(tree: Any, mesh: Mesh) -> Any:
     return jax.tree.map(lambda leaf: _spec_for_leaf(leaf, axes), tree)
 
 
+def tensor_parallel_spec_for_shape(shape, tp: int):
+    """The tensor-parallel eligibility rule queryable by plain degree — no
+    mesh needed. The parallelism planner predicts candidate layouts' exact
+    per-chip param bytes through this, so the prediction and the placement
+    (``tensor_parallel_specs`` above, which shares ``_spec_for_leaf``) can
+    never disagree."""
+    return _spec_for_leaf(
+        jax.ShapeDtypeStruct(tuple(shape), jnp.float32), ((MODEL_AXIS, tp),)
+    )
+
+
 def _place_full_value(x, sharding: NamedSharding):
     """Place a host value (identical on every process — e.g. a seeded init)
     under ``sharding``. Single-process this is a plain device_put; multi-process

@@ -67,15 +67,27 @@ def weight_update_spec(
     applying it to an Adam moment reproduces the param's spec exactly), and
     the batch axis takes the largest dimension the model axis left unsharded —
     or stacks onto the channel dimension when nothing else divides."""
+    return weight_update_spec_for_degrees(
+        shape,
+        dp=mesh.shape[BATCH_AXIS],
+        tp=mesh.shape[MODEL_AXIS] if tensor_parallel else 1,
+    )
+
+
+def weight_update_spec_for_degrees(
+    shape: Tuple[int, ...], *, dp: int, tp: int = 1
+) -> P:
+    """:func:`weight_update_spec` queryable by plain degrees — no mesh (and
+    no devices) needed, so the parallelism planner can predict a candidate
+    layout's exact per-chip optimizer bytes with the SAME rule placement
+    uses (the rules cannot drift apart: the mesh form delegates here)."""
     from tensorflowdistributedlearning_tpu.parallel.tensor import _spec_for_leaf
 
-    tp = mesh.shape[MODEL_AXIS] if tensor_parallel else 1
     base = (
         _spec_for_leaf(jax.ShapeDtypeStruct(shape, jnp.float32), ((MODEL_AXIS, tp),))
         if tp > 1
         else P()
     )
-    dp = mesh.shape[BATCH_AXIS]
     if dp <= 1:
         return base
     taken = {i for i, names in enumerate(base) if names is not None}

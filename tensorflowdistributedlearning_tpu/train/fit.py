@@ -93,6 +93,7 @@ class ClassifierTrainer:
         data_dir: Optional[str],
         model_config: ModelConfig,
         train_config: Optional[TrainConfig] = None,
+        plan: Optional[Dict] = None,
     ):
         if model_config.num_classes is None:
             raise ValueError(
@@ -104,6 +105,20 @@ class ClassifierTrainer:
         self.data_dir = data_dir
         self.model_config = model_config
         self.train_config = train_config or TrainConfig()
+        if self.train_config.parallelism == "auto" and plan is None:
+            # the mesh is built below from the config's explicit degrees, so
+            # an unresolved 'auto' here would silently train explicit while
+            # the ledger claims otherwise — auto must be resolved BEFORE the
+            # trainer exists (fit_preset / the CLI do this; programmatic
+            # callers use parallel.planner.plan() and apply overrides())
+            raise ValueError(
+                "parallelism='auto' must be resolved before constructing "
+                "ClassifierTrainer: plan the layout first (fit_preset / the "
+                "fit CLI do this automatically; programmatically, call "
+                "parallel.planner.plan(model_config, train_config, "
+                "global_batch), apply plan.overrides() onto the config, and "
+                "pass plan=plan.header())"
+            )
         self.task = step_lib.ClassificationTask(
             label_smoothing=self.train_config.label_smoothing
         )
@@ -173,6 +188,11 @@ class ClassifierTrainer:
             else self.model
         )
         self._n_params: Optional[int] = None
+        # the parallelism plan this run trains under (parallel/planner.py
+        # header dict): handed in by fit_preset (auto or validated-explicit),
+        # else derived best-effort at fit() time — it rides the run-header
+        # ledger event either way (docs/LEDGER_SCHEMA.md `plan`)
+        self._plan = plan
         # fit() swaps in a live Telemetry; the null instance keeps every other
         # entry point (serving restore, direct _evaluate) span-safe
         self._telemetry = obs_lib.NULL_TELEMETRY
@@ -419,6 +439,25 @@ class ClassifierTrainer:
         # otherwise only surface at the first eval, potentially hours in)
         self._open_records("val")
 
+        if self._plan is None and tcfg.telemetry:
+            # direct-construction path (no fit_preset): describe the explicit
+            # layout through the planner so the run header carries the plan
+            # (predicted bytes/chip) like every other run. Best-effort — the
+            # mesh already validated divisibility in __init__, so a planner
+            # hiccup here is telemetry loss, not a training error. Skipped
+            # when telemetry is off: the plan's only consumer here is the
+            # run header.
+            try:
+                from tensorflowdistributedlearning_tpu.parallel import (
+                    planner as planner_lib,
+                )
+
+                self._plan = planner_lib.validate_config(
+                    self.model_config, tcfg, batch_size
+                ).header()
+            except Exception as e:  # noqa: BLE001 — plan is telemetry here
+                logger.warning("parallelism plan unavailable: %s", e)
+
         self._telemetry = obs_lib.Telemetry(
             self.model_dir,
             enabled=tcfg.telemetry,
@@ -439,6 +478,11 @@ class ClassifierTrainer:
                 },
                 "model_config": dataclasses.asdict(self.model_config),
                 "train_config": dataclasses.asdict(tcfg),
+                # the parallelism plan (chosen layout + predicted bytes/chip):
+                # telemetry-report renders it, obs/compare hashes its layout,
+                # and the watermark events' measured-vs-predicted deltas are
+                # judged against its prediction
+                **({"plan": self._plan} if self._plan else {}),
             },
         )
         # time cross-process sync points as this run's barrier_wait span —
@@ -1051,8 +1095,18 @@ def fit_preset(
     data_service_workers: Optional[int] = None,
     trace_sample_rate: Optional[float] = None,
     nan_guard: Optional[str] = None,
+    parallelism: Optional[str] = None,
+    hbm_budget_gb: Optional[float] = None,
 ) -> FitResult:
-    """Train a named config preset end-to-end (the CLI `fit` entry point)."""
+    """Train a named config preset end-to-end (the CLI `fit` entry point).
+
+    ``parallelism='auto'`` derives the whole layout via the parallelism
+    planner (``parallel/planner.py``) from the preset's model, the HBM
+    budget, and the live topology — any parallelism flag explicitly set
+    above its default stays pinned (explicit flags win). The default
+    (explicit) path routes the preset's hardcoded layout through the SAME
+    planner validator, so an indivisible or over-budget preset fails here,
+    at parse time, with the named constraint instead of mid-compile."""
     from tensorflowdistributedlearning_tpu.configs import get_preset
 
     preset = get_preset(preset_name)
@@ -1074,6 +1128,8 @@ def fit_preset(
     if (
         sequence_parallel != 1
         or sync_batch_norm
+        or parallelism is not None
+        or hbm_budget_gb is not None
         or model_parallel != 1
         or pipeline_parallel != 1
         or pipeline_microbatches is not None
@@ -1094,6 +1150,12 @@ def fit_preset(
     ):
         train_cfg = dataclasses.replace(
             train_cfg,
+            parallelism=parallelism or train_cfg.parallelism,
+            hbm_budget_gb=(
+                hbm_budget_gb
+                if hbm_budget_gb is not None
+                else train_cfg.hbm_budget_gb
+            ),
             sequence_parallel=sequence_parallel,
             sync_batch_norm=sync_batch_norm or train_cfg.sync_batch_norm,
             model_parallel=model_parallel,
@@ -1154,11 +1216,43 @@ def fit_preset(
                 nan_guard if nan_guard is not None else train_cfg.nan_guard
             ),
         )
+    # route EVERY preset's layout through the parallelism planner before the
+    # trainer is built: auto derives the layout (explicit flags pinned),
+    # explicit validates the hand spec — either way an indivisible preset
+    # fails HERE, at parse time, with the named constraint, and the plan's
+    # predicted bytes/chip ride the run header
+    from tensorflowdistributedlearning_tpu.parallel import multihost
+    from tensorflowdistributedlearning_tpu.parallel import planner as planner_lib
+
+    multihost.initialize()  # topology must see the full pod, like the mesh
+    global_batch = batch_size or preset.global_batch
+    if train_cfg.parallelism == "auto":
+        # pin only what the CALLER explicitly asked for (explicit flags win);
+        # the preset's own hardcoded layout is exactly what auto re-derives
+        pinned = {}
+        if model_parallel != 1:
+            pinned["model_parallel"] = model_parallel
+        if pipeline_parallel != 1:
+            pinned["pipeline_parallel"] = pipeline_parallel
+        if sequence_parallel != 1:
+            pinned["sequence_parallel"] = sequence_parallel
+        if expert_parallel != 1:
+            pinned["expert_parallel"] = expert_parallel
+        if weight_update_sharding is not None:
+            pinned["weight_update_sharding"] = weight_update_sharding
+        run_plan = planner_lib.plan(
+            preset.model, train_cfg, global_batch, pinned=pinned, source="auto"
+        )
+        train_cfg = dataclasses.replace(train_cfg, **run_plan.overrides())
+    else:
+        run_plan = planner_lib.validate_config(
+            preset.model, train_cfg, global_batch
+        )
     trainer = ClassifierTrainer(
-        model_dir, data_dir, preset.model, train_cfg
+        model_dir, data_dir, preset.model, train_cfg, plan=run_plan.header()
     )
     return trainer.fit(
-        batch_size=batch_size or preset.global_batch,
+        batch_size=global_batch,
         steps=steps,
         eval_every_steps=eval_every_steps,
     )

@@ -121,6 +121,7 @@ class Trainer:
         save_best: int = 5,
         train_config: Optional[TrainConfig] = None,
         augment_config: Optional[augment_lib.AugmentConfig] = None,
+        plan: Optional[Dict] = None,
         **kwargs,
     ):
         unknown = set(kwargs) - _MODEL_FIELDS
@@ -144,6 +145,19 @@ class Trainer:
         self.augment_config = augment_config or augment_lib.AugmentConfig(
             crop_probability=0.0
         )
+        if self.train_config.parallelism == "auto" and plan is None:
+            # same contract as ClassifierTrainer: the mesh is built below
+            # from the explicit degrees, so 'auto' must be resolved (and its
+            # plan handed in) before the trainer exists — the `train` CLI
+            # does this; programmatic callers use parallel.planner.plan()
+            raise ValueError(
+                "parallelism='auto' must be resolved before constructing "
+                "Trainer: plan the layout first (the train CLI does this "
+                "automatically; programmatically, call parallel.planner."
+                "plan(model_config, train_config, global_batch), apply "
+                "plan.overrides() onto the config, and pass "
+                "plan=plan.header())"
+            )
         self.task = step_lib.SegmentationTask()
         tcfg = self.train_config
         # model_parallel > 1: tensor parallelism via shard_map's hybrid
@@ -184,6 +198,10 @@ class Trainer:
             self.model_config, bn_axis_name=bn_axis, spatial_axis_name=axis
         )
         self._n_params: Optional[int] = None
+        # the parallelism plan this run trains under (planner header dict):
+        # handed in by the CLI's --parallelism auto path, else derived
+        # best-effort at train() time for the run-header ledger event
+        self._plan = plan
         # train() swaps in a live Telemetry; the null instance keeps predict/
         # serving (which reuse _evaluate-adjacent paths) span-safe
         self._telemetry = obs_lib.NULL_TELEMETRY
@@ -291,6 +309,24 @@ class Trainer:
         manifests = folds_lib.write_fold_manifests(
             self.model_dir, list(X), list(np.asarray(y)), tcfg.n_folds, tcfg.seed
         )
+        # describe this run's layout through the parallelism planner so the
+        # run header carries the plan (predicted bytes/chip); best-effort —
+        # the mesh already validated divisibility in __init__, so a planner
+        # hiccup here is telemetry loss, not a training error (the CLI's
+        # --parallelism auto resolves its plan BEFORE this trainer exists)
+        run_plan = self._plan
+        if run_plan is None and tcfg.telemetry:
+            # the plan's only consumer here is the run header
+            try:
+                from tensorflowdistributedlearning_tpu.parallel import (
+                    planner as planner_lib,
+                )
+
+                run_plan = planner_lib.validate_config(
+                    self.model_config, tcfg, batch_size
+                ).header()
+            except Exception as e:  # noqa: BLE001 — plan is telemetry here
+                logger.warning("parallelism plan unavailable: %s", e)
         # one ledger for the whole K-fold run; events carry their fold
         self._telemetry = obs_lib.Telemetry(
             self.model_dir,
@@ -313,6 +349,9 @@ class Trainer:
                 },
                 "model_config": dataclasses.asdict(self.model_config),
                 "train_config": dataclasses.asdict(tcfg),
+                # chosen layout + predicted bytes/chip (parallel/planner.py):
+                # rendered by telemetry-report, hashed by obs/compare
+                **({"plan": run_plan} if run_plan else {}),
             },
         )
         # time cross-process sync points as this run's barrier_wait span —

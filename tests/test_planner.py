@@ -1,0 +1,537 @@
+"""Parallelism planner (parallel/planner.py): divisibility rejection with
+named constraints, budget-driven layout choice, scoring tie-breaks, fake pod
+topologies, exact bytes/chip accounting against ``tree_bytes_per_device``,
+the ``plan`` CLI, and the headline equivalence drill — ``--parallelism auto``
+on the 8-device CPU mesh lands bit-identical params vs the same layout passed
+as explicit flags."""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+from tensorflowdistributedlearning_tpu.parallel import planner
+
+
+def _sds(shape, dtype=np.float32):
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def _profile(params, opt, act=0, n_layers=1):
+    count = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
+    return planner.ModelProfile(
+        params=params,
+        batch_stats={},
+        opt_state=opt,
+        activation_bytes_per_example=act,
+        param_count=count,
+        n_layers=n_layers,
+    )
+
+
+CIFARISH = ModelConfig(
+    num_classes=10,
+    input_shape=(32, 32),
+    input_channels=3,
+    n_blocks=(1, 1, 1),
+    base_depth=8,
+    width_multiplier=0.0625,
+    output_stride=None,
+)
+TOPO8 = planner.Topology(n_devices=8, local_device_count=8)
+
+
+# -- divisibility / named constraints ---------------------------------------
+
+
+def test_indivisible_model_axis_named():
+    profile = _profile({"w": _sds((8, 4))}, {"mu": _sds((8, 4))})
+    with pytest.raises(planner.PlanError, match=planner.REJECT_MODEL_AXIS):
+        planner.plan(
+            CIFARISH, TrainConfig(), 64, topology=TOPO8, profile=profile,
+            pinned={"model_parallel": 3},
+        )
+
+
+def test_batch_indivisible_named():
+    profile = _profile({"w": _sds((8, 4))}, {"mu": _sds((8, 4))})
+    # batch 12 over dp8 does not divide; pinning pure dp (all other degrees 1)
+    # leaves no fallback layout
+    with pytest.raises(planner.PlanError, match=planner.REJECT_BATCH):
+        planner.plan(
+            CIFARISH, TrainConfig(), 12, topology=TOPO8, profile=profile,
+            pinned={
+                "model_parallel": 1, "pipeline_parallel": 1,
+                "sequence_parallel": 1, "expert_parallel": 1,
+                "weight_update_sharding": False,
+            },
+        )
+
+
+def test_spatial_rejected_with_stride_detail():
+    profile = _profile({"w": _sds((8, 4))}, {"mu": _sds((8, 4))})
+    p = planner.plan(CIFARISH, TrainConfig(), 64, topology=TOPO8, profile=profile)
+    spatial = [
+        c for c in p.candidates if c.layout.sequence_parallel > 1
+    ]
+    assert spatial, "spatial candidates must be enumerated"
+    assert all(c.reject_reason == planner.REJECT_SPATIAL for c in spatial)
+    # 32x32 stride-32 trunk cannot H-shard: the detail names the rule
+    assert "stride" in spatial[0].reject_detail
+
+
+def test_grad_accum_indivisible_named():
+    profile = _profile({"w": _sds((8, 4))}, {"mu": _sds((8, 4))})
+    cfg = TrainConfig(grad_accum_steps=3)
+    with pytest.raises(planner.PlanError, match=planner.REJECT_GRAD_ACCUM):
+        planner.plan(
+            CIFARISH, cfg, 64, topology=TOPO8, profile=profile,
+            pinned={
+                "model_parallel": 1, "pipeline_parallel": 1,
+                "sequence_parallel": 1, "expert_parallel": 1,
+                "weight_update_sharding": False,
+            },
+        )
+
+
+def test_pipeline_only_for_stage_backbones():
+    profile = _profile({"w": _sds((8, 4))}, {"mu": _sds((8, 4))})
+    p = planner.plan(CIFARISH, TrainConfig(), 64, topology=TOPO8, profile=profile)
+    assert not any(c.layout.pipeline_parallel > 1 for c in p.candidates), (
+        "resnet cannot pipeline — pp layouts must not be enumerated for it"
+    )
+    vit = ModelConfig(
+        backbone="vit", num_classes=10, input_shape=(32, 32), input_channels=3,
+        patch_size=8, embed_dim=64, vit_layers=4, num_heads=2, output_stride=None,
+    )
+    p = planner.plan(vit, TrainConfig(), 64, topology=TOPO8, profile=profile)
+    pp = [c for c in p.candidates if c.layout.pipeline_parallel > 1]
+    assert pp
+    # 4 ViT layers: pp2/pp4 divide, pp8 is rejected with the stage rule
+    verdicts = {c.layout.pipeline_parallel: c for c in pp}
+    assert verdicts[2].feasible and verdicts[4].feasible
+    assert verdicts[8].reject_reason == planner.REJECT_PIPELINE
+
+
+def test_conflicting_strategies_rejected_named():
+    """The execution strategies' mutual-exclusivity matrix holds at plan
+    time: a pinned tp x pp combination (which no step builder can run, and
+    TrainConfig would reject) fails with the named strategy_conflict, not a
+    green-lit impossible layout."""
+    profile = _profile({"w": _sds((8, 4))}, {"mu": _sds((8, 4))})
+    vit = ModelConfig(
+        backbone="vit", num_classes=10, input_shape=(32, 32), input_channels=3,
+        patch_size=8, embed_dim=64, vit_layers=4, num_heads=2, output_stride=None,
+    )
+    with pytest.raises(planner.PlanError, match=planner.REJECT_CONFLICT):
+        planner.plan(
+            vit, TrainConfig(), 64, topology=TOPO8, profile=profile,
+            pinned={"model_parallel": 2, "pipeline_parallel": 2},
+        )
+    with pytest.raises(planner.PlanError, match=planner.REJECT_CONFLICT):
+        planner.plan(
+            vit, TrainConfig(), 64, topology=TOPO8, profile=profile,
+            pinned={"pipeline_parallel": 2, "weight_update_sharding": True},
+        )
+
+
+def test_auto_respects_train_config_composition_rules():
+    """Auto must never choose a layout the TrainConfig would then reject:
+    under grad accumulation the tensor/pipeline candidates are out, and
+    under mixup so are sequence/pipeline."""
+    profile = _profile(
+        {"w": _sds((4096, 4096))}, {"mu": _sds((4096, 4096))}, act=1024
+    )
+    # this profile prefers TP when unconstrained (pinned by the scoring
+    # test); grad accumulation must veto that choice
+    cfg = TrainConfig(grad_accum_steps=2)
+    p = planner.plan(CIFARISH, cfg, 16, topology=TOPO8, profile=profile)
+    assert p.layout.model_parallel == 1
+    tp = [c for c in p.candidates if c.layout.model_parallel > 1]
+    assert tp and all(
+        c.reject_reason == planner.REJECT_CONFLICT for c in tp
+    )
+
+
+def test_plan_for_config_dispatch():
+    """plan_for_config: 'auto' plans with non-default degrees pinned,
+    'explicit' validates the hand spec through the same machinery."""
+    profile = _profile({"w": _sds((8, 16))}, {"mu": _sds((8, 16))})
+    auto = TrainConfig(parallelism="auto", weight_update_sharding=True)
+    p = planner.plan_for_config(
+        CIFARISH, auto, 64, topology=TOPO8, profile=profile
+    )
+    assert p.source == "auto" and p.layout.weight_update_sharding
+    explicit = TrainConfig()
+    p = planner.plan_for_config(
+        CIFARISH, explicit, 64, topology=TOPO8, profile=profile
+    )
+    assert p.source == "explicit"
+    assert p.layout == planner.Layout(data_parallel=8)
+
+
+def test_trainer_refuses_unresolved_auto(tmp_path):
+    """parallelism='auto' on a directly-constructed trainer is a loud
+    contract error, never a silent explicit-layout run."""
+    from tensorflowdistributedlearning_tpu.train.fit import ClassifierTrainer
+
+    with pytest.raises(ValueError, match="resolved before constructing"):
+        ClassifierTrainer(
+            str(tmp_path), None,
+            dataclasses.replace(CIFARISH),
+            TrainConfig(parallelism="auto"),
+        )
+
+
+# -- budget ------------------------------------------------------------------
+
+
+def test_budget_rejects_replicated_and_picks_zero1():
+    params = {"w": _sds((8, 3))}   # 96 bytes, trailing dim resists tp
+    opt = {"mu": _sds((8, 3))}     # ZeRO-1 shards the leading dim /dp
+    profile = _profile(params, opt)
+    p_bytes = 8 * 3 * 4
+    budget = p_bytes + p_bytes // 4 - 1  # fits only the dp8 ZeRO-1 shard
+    p = planner.plan(
+        CIFARISH, TrainConfig(), 64, topology=TOPO8, profile=profile,
+        hbm_bytes_per_device=budget,
+    )
+    assert p.layout.weight_update_sharding
+    assert p.layout.data_parallel == 8
+    assert p.chosen.bytes["opt_state_bytes_per_chip"] == p_bytes // 8
+    plain = [
+        c for c in p.candidates
+        if c.layout == planner.Layout(data_parallel=8)
+    ][0]
+    assert plain.reject_reason == planner.REJECT_BUDGET
+    assert "bytes/chip" in plain.reject_detail
+
+
+def test_explicit_over_budget_warns_not_raises():
+    profile = _profile({"w": _sds((8, 4))}, {"mu": _sds((8, 4))})
+    cfg = TrainConfig()
+    p = planner.plan(
+        CIFARISH, cfg, 64, topology=TOPO8, profile=profile,
+        pinned=planner._pinned_from_config(cfg), hbm_bytes_per_device=16,
+    )
+    assert p.source == "explicit"
+    assert not p.chosen.feasible
+    assert p.chosen.reject_reason == planner.REJECT_BUDGET
+    assert p.warnings and "budget" in p.warnings[0]
+
+
+# -- scoring -----------------------------------------------------------------
+
+
+def test_scoring_tie_prefers_simpler_layout():
+    """An all-zero profile leaves only the per-collective latency term:
+    pure DP (one bucketed all-reduce) wins outright, and the genuinely TIED
+    pair (dp4xtp2 vs dp2xtp4 — identical op counts, zero volume) must order
+    deterministically by the complexity tie-break (lower degree first)."""
+    profile = _profile({}, {}, act=0, n_layers=1)
+    p = planner.plan(
+        CIFARISH, TrainConfig(), 64, topology=TOPO8, profile=profile
+    )
+    assert p.layout == planner.Layout(data_parallel=8)
+    by_layout = {c.layout: c for c in p.candidates}
+    tp2 = by_layout[planner.Layout(data_parallel=4, model_parallel=2)]
+    tp4 = by_layout[planner.Layout(data_parallel=2, model_parallel=4)]
+    assert tp2.score == tp4.score  # genuinely tied
+    ordered = sorted(
+        [tp4, tp2], key=lambda c: (c.score, planner._complexity(c.layout))
+    )
+    assert ordered[0] is tp2
+
+
+def test_large_params_small_batch_prefers_tensor_parallel():
+    """The comms-vs-compute trade: gradient all-reduce volume dominating
+    per-chip activations makes a TP layout score better than pure DP."""
+    params = {"w": _sds((4096, 4096))}  # 64 MB of gradient per step
+    opt = {"mu": _sds((4096, 4096))}
+    profile = _profile(params, opt, act=1024, n_layers=1)
+    p = planner.plan(CIFARISH, TrainConfig(), 8, topology=TOPO8, profile=profile)
+    assert p.layout.model_parallel > 1
+
+
+# -- pod topologies (fake process_info) --------------------------------------
+
+
+def test_pod_topology_rejects_process_spanning_shards():
+    pod = planner.Topology(n_devices=32, local_device_count=8, process_count=4)
+    profile = _profile({"w": _sds((8, 16))}, {"mu": _sds((8, 16))})
+    with pytest.raises(
+        planner.PlanError, match=planner.REJECT_SPANS_PROCESSES
+    ):
+        planner.plan(
+            CIFARISH, TrainConfig(), 64, topology=pod, profile=profile,
+            pinned={"model_parallel": 16},
+        )
+    # tp8 stays within one host's 8 chips: feasible
+    p = planner.plan(
+        CIFARISH, TrainConfig(), 64, topology=pod, profile=profile,
+        pinned={"model_parallel": 8},
+    )
+    assert p.layout.model_parallel == 8
+    assert p.layout.data_parallel == 4
+
+
+def test_pod_topology_process_batch_divisibility():
+    pod = planner.Topology(n_devices=32, local_device_count=8, process_count=4)
+    profile = _profile({"w": _sds((8, 16))}, {"mu": _sds((8, 16))})
+    with pytest.raises(planner.PlanError, match=planner.REJECT_PROCESS_BATCH):
+        planner.plan(
+            CIFARISH, TrainConfig(), 30, topology=pod, profile=profile
+        )
+
+
+# -- exact bytes accounting ---------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "layout_kwargs",
+    [
+        {},
+        {"weight_update_sharding": True},
+        {"model_parallel": 2},
+        {"model_parallel": 2, "weight_update_sharding": True},
+    ],
+    ids=["replicated", "zero1", "tp2", "tp2_zero1"],
+)
+def test_predicted_bytes_match_tree_bytes_per_device(layout_kwargs):
+    """The acceptance contract: the planner's predicted params/opt bytes per
+    chip equal ``tree_bytes_per_device`` of the actually-placed state, bit
+    for bit, for every placement mode."""
+    from tensorflowdistributedlearning_tpu.models import build_model
+    from tensorflowdistributedlearning_tpu.parallel import mesh as mesh_lib
+    from tensorflowdistributedlearning_tpu.parallel import tensor as tp_lib
+    from tensorflowdistributedlearning_tpu.parallel import zero as zero_lib
+    from tensorflowdistributedlearning_tpu.train import step as step_lib
+    from tensorflowdistributedlearning_tpu.train.state import (
+        create_train_state,
+        tree_bytes_per_device,
+    )
+
+    tcfg = TrainConfig(**{
+        k: v for k, v in layout_kwargs.items() if k == "model_parallel"
+    })
+    tcfg = dataclasses.replace(
+        tcfg,
+        weight_update_sharding=layout_kwargs.get(
+            "weight_update_sharding", False
+        ),
+    )
+    plan = planner.validate_config(CIFARISH, tcfg, 16, topology=TOPO8)
+    predicted = plan.chosen.bytes
+
+    mesh = mesh_lib.make_mesh(
+        8, model_parallel=layout_kwargs.get("model_parallel", 1)
+    )
+    model = build_model(CIFARISH)
+    state = create_train_state(
+        model,
+        step_lib.make_optimizer(tcfg),
+        jax.random.PRNGKey(0),
+        np.zeros((1, 32, 32, 3), np.float32),
+    )
+    tp = layout_kwargs.get("model_parallel", 1) > 1
+    if layout_kwargs.get("weight_update_sharding"):
+        state = zero_lib.shard_state_weight_update(
+            state, mesh, tensor_parallel=tp
+        )
+    elif tp:
+        state = tp_lib.shard_state_tensor_parallel(state, mesh)
+    else:
+        state = mesh_lib.replicate(state, mesh)
+
+    assert predicted["params_bytes_per_chip"] == tree_bytes_per_device(
+        state.params
+    )
+    assert predicted["opt_state_bytes_per_chip"] == tree_bytes_per_device(
+        state.opt_state
+    )
+    assert predicted["batch_stats_bytes_per_chip"] == tree_bytes_per_device(
+        state.batch_stats
+    )
+
+
+# -- plan application ---------------------------------------------------------
+
+
+def test_auto_pins_explicit_flags():
+    profile = _profile(
+        {"w": _sds((8, 16))}, {"mu": _sds((8, 16))}, act=64, n_layers=2
+    )
+    p = planner.plan(
+        CIFARISH, TrainConfig(), 64, topology=TOPO8, profile=profile,
+        pinned={"weight_update_sharding": True},
+    )
+    assert p.layout.weight_update_sharding  # the pinned flag won
+    overrides = p.overrides()
+    cfg = dataclasses.replace(TrainConfig(parallelism="auto"), **overrides)
+    assert cfg.weight_update_sharding
+
+
+def test_plan_header_is_json_clean():
+    profile = _profile({"w": _sds((8, 16))}, {"mu": _sds((8, 16))})
+    p = planner.plan(
+        CIFARISH, TrainConfig(), 64, topology=TOPO8, profile=profile
+    )
+    header = json.loads(json.dumps(p.header()))
+    assert header["source"] == "auto"
+    assert header["layout"]["data_parallel"] >= 1
+    assert "total_bytes_per_chip" in header["predicted"]
+    json.loads(json.dumps(p.to_json()))  # the full table too
+
+
+def test_config_hash_distinguishes_plan_layouts():
+    from tensorflowdistributedlearning_tpu.obs import compare as compare_lib
+
+    base = {
+        "model_config": {"backbone": "resnet"},
+        "train_config": {"lr": 0.1},
+        "mesh": {"batch": 8},
+    }
+    a = dict(base, plan={"layout": {"data_parallel": 8}})
+    b = dict(base, plan={"layout": {"data_parallel": 4, "model_parallel": 2}})
+    assert compare_lib.config_hash(a) != compare_lib.config_hash(b)
+    # and identical layouts still match
+    assert compare_lib.config_hash(a) == compare_lib.config_hash(
+        json.loads(json.dumps(a))
+    )
+    # plan absence must not change the identity: a header whose best-effort
+    # plan failed to resolve hashes like its planned twin (the layout is
+    # reconstructed from train_config + mesh)
+    planned = {
+        "model_config": {"backbone": "resnet"},
+        "train_config": {
+            "lr": 0.1, "model_parallel": 2, "pipeline_parallel": 1,
+            "sequence_parallel": 1, "expert_parallel": 1,
+            "weight_update_sharding": False,
+        },
+        "mesh": {"batch": 4, "model": 2, "sequence": 1},
+    }
+    with_plan = dict(planned, plan={"layout": {
+        "data_parallel": 4, "model_parallel": 2, "pipeline_parallel": 1,
+        "sequence_parallel": 1, "expert_parallel": 1,
+        "weight_update_sharding": False,
+    }})
+    assert compare_lib.config_hash(planned) == compare_lib.config_hash(
+        with_plan
+    )
+
+
+def test_validate_config_names_constraint_for_presets():
+    """Satellite: a preset whose hardcoded layout cannot run on this topology
+    fails at parse time with the named constraint."""
+    bad = TrainConfig(model_parallel=5)
+    with pytest.raises(planner.PlanError, match=planner.REJECT_MODEL_AXIS):
+        planner.validate_config(CIFARISH, bad, 64, topology=TOPO8)
+
+
+def test_plan_cli_table_and_json(capsys):
+    from tensorflowdistributedlearning_tpu import cli
+
+    rc = cli.main([
+        "plan", "--preset", "cifar10_smoke", "--batch-size", "64",
+        "--n-devices", "8",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "chosen" in out and "parallelism plan" in out
+
+    rc = cli.main([
+        "plan", "--preset", "cifar10_smoke", "--batch-size", "64",
+        "--n-devices", "8", "--json",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    parsed = json.loads(out)
+    assert parsed["feasible"] and parsed["candidates"]
+
+
+def test_plan_cli_infeasible_pin_fails_with_named_reason(capsys):
+    from tensorflowdistributedlearning_tpu import cli
+
+    rc = cli.main([
+        "plan", "--preset", "cifar10_smoke", "--batch-size", "64",
+        "--n-devices", "8", "--model-parallel", "3",
+    ])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert planner.REJECT_MODEL_AXIS in err
+
+
+# -- the headline equivalence drill -------------------------------------------
+
+
+@pytest.mark.slow
+def test_auto_equals_explicit_bit_identical(tmp_path):
+    """``--parallelism auto`` on the 8-device CPU mesh picks a valid layout
+    and lands bit-identical params vs the same layout passed as explicit
+    flags (the two runs share seeds and the synthetic stream)."""
+    from tensorflowdistributedlearning_tpu.obs.ledger import read_ledger
+    from tensorflowdistributedlearning_tpu.train.fit import fit_preset
+
+    steps, batch = 4, 16
+    fit_preset(
+        "cifar10_smoke", str(tmp_path / "auto"), steps=steps,
+        batch_size=batch, eval_every_steps=100, parallelism="auto",
+    )
+    header = next(
+        e for e in read_ledger(str(tmp_path / "auto"))
+        if e.get("event") == "run_header"
+    )
+    plan = header["plan"]
+    assert plan["source"] == "auto" and plan["feasible"]
+    layout = plan["layout"]
+
+    fit_preset(
+        "cifar10_smoke", str(tmp_path / "explicit"), steps=steps,
+        batch_size=batch, eval_every_steps=100,
+        model_parallel=layout["model_parallel"],
+        pipeline_parallel=layout["pipeline_parallel"],
+        sequence_parallel=layout["sequence_parallel"],
+        expert_parallel=layout["expert_parallel"],
+        weight_update_sharding=layout["weight_update_sharding"],
+    )
+    exp_header = next(
+        e for e in read_ledger(str(tmp_path / "explicit"))
+        if e.get("event") == "run_header"
+    )
+    assert exp_header["plan"]["source"] == "explicit"
+    assert exp_header["plan"]["layout"] == layout
+
+    def final_params(model_dir, layout):
+        from tensorflowdistributedlearning_tpu.configs import get_preset
+        from tensorflowdistributedlearning_tpu.train.fit import (
+            ClassifierTrainer,
+        )
+
+        preset = get_preset("cifar10_smoke")
+        tcfg = dataclasses.replace(
+            preset.train,
+            model_parallel=layout["model_parallel"],
+            pipeline_parallel=layout["pipeline_parallel"],
+            sequence_parallel=layout["sequence_parallel"],
+            expert_parallel=layout["expert_parallel"],
+            weight_update_sharding=layout["weight_update_sharding"],
+        )
+        trainer = ClassifierTrainer(str(model_dir), None, preset.model, tcfg)
+        ckpt = trainer._checkpointer()
+        try:
+            state = ckpt.restore_latest(trainer._host_template())
+        finally:
+            ckpt.close()
+        assert int(jax.device_get(state.step)) == steps
+        return jax.device_get(state.params)
+
+    a = final_params(tmp_path / "auto", layout)
+    b = final_params(tmp_path / "explicit", layout)
+    flat_a = jax.tree_util.tree_leaves(a)
+    flat_b = jax.tree_util.tree_leaves(b)
+    assert flat_a and len(flat_a) == len(flat_b)
+    for la, lb in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))

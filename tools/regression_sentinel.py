@@ -360,6 +360,49 @@ def check_fleet(
     return out
 
 
+# the planner acceptance floor: auto must match or beat the hand-tuned
+# preset layout (ISSUE-14); dimensionless, so it replays without machine
+# slack like the fleet gates
+DEFAULT_PLAN_RATIO_LIMIT = 1.05
+
+
+def check_plan(
+    baseline: Dict,
+    fresh: Optional[Dict] = None,
+    *,
+    ratio_limit: float = DEFAULT_PLAN_RATIO_LIMIT,
+) -> List[Dict]:
+    """BENCH_PLAN.json gates (bench.py --plan output shape).
+
+    Default mode REPLAYS the committed record (like the fleet section — a PR
+    touching the planner or a preset layout must re-run ``bench.py --plan``
+    and commit numbers that still clear the gates): per preset, the auto
+    layout's step time must stay <= ``ratio_limit`` x the hand-tuned
+    layout's (dimensionless, transfers across machines), and the planner's
+    predicted params+opt+stats bytes/chip must equal the placed state's
+    ``tree_bytes_per_device`` EXACTLY (accounting correctness — hard). A
+    ``--fresh-plan`` record is gated instead."""
+    record = fresh if fresh is not None else baseline
+    out: List[Dict] = []
+    for name, entry in (record.get("presets") or {}).items():
+        ratio = entry.get("step_time_ratio_auto_over_hand")
+        if ratio is not None:
+            out.append(_finding(
+                "plan", f"{name}.step_time_ratio_auto_over_hand",
+                ratio_limit, ratio,
+                f"<= {ratio_limit} (auto matches or beats hand-tuned)",
+                ratio <= ratio_limit,
+            ))
+        match = (entry.get("auto") or {}).get("predicted_bytes_match")
+        if match is not None:
+            out.append(_finding(
+                "plan", f"{name}.auto.predicted_bytes_match", True, match,
+                "== true (exact tree_bytes_per_device accounting, hard)",
+                bool(match),
+            ))
+    return out
+
+
 def check_promotion(
     baseline: Dict,
     fresh: Optional[Dict] = None,
@@ -475,7 +518,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "mode; the flag exists so the CI step reads as a "
                         "gate)")
     parser.add_argument("--benches",
-                        default="async,serve,fleet,records,promotion",
+                        default="async,serve,fleet,records,promotion,plan",
                         help="comma-separated subset to check")
     parser.add_argument("--baseline-async",
                         default=os.path.join(REPO, "BENCH_ASYNC.json"))
@@ -483,6 +526,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                         default=os.path.join(REPO, "BENCH_SERVE.json"))
     parser.add_argument("--baseline-records",
                         default=os.path.join(REPO, "RECORDS_BENCH.json"))
+    parser.add_argument("--baseline-plan",
+                        default=os.path.join(REPO, "BENCH_PLAN.json"))
+    parser.add_argument("--fresh-plan", default=None, metavar="JSON",
+                        help="pre-computed bench.py --plan output (default: "
+                        "replay the committed baseline's gates, like the "
+                        "fleet section)")
+    parser.add_argument("--plan-ratio-limit", type=float,
+                        default=DEFAULT_PLAN_RATIO_LIMIT,
+                        help="auto/hand step-time ratio ceiling for the "
+                        "plan bench (dimensionless; the committed record "
+                        "must clear the 1.05 acceptance floor)")
     parser.add_argument("--fresh-records", default=None, metavar="JSON",
                         help="pre-computed tools/bench_records.py output "
                         "(default: replay the committed baseline's gates, "
@@ -569,6 +623,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             findings += check_promotion(baseline, fresh)
         except (OSError, ValueError) as e:
             errors.append(f"promotion: {e}")
+    if "plan" in benches:
+        try:
+            baseline = _load(args.baseline_plan)
+            fresh = _load(args.fresh_plan) if args.fresh_plan else None
+            findings += check_plan(
+                baseline, fresh, ratio_limit=args.plan_ratio_limit
+            )
+        except (OSError, ValueError) as e:
+            errors.append(f"plan: {e}")
     if "records" in benches:
         try:
             baseline = _load(args.baseline_records)
