@@ -131,17 +131,81 @@ def _add_resilience(p: argparse.ArgumentParser) -> None:
                    "step; 'io-ckpt@1' makes the first checkpoint write fail "
                    "transiently; 'nan-loss@2' poisons the 2nd observed loss "
                    "window with NaN — the health-monitor drill)")
-    p.add_argument("--max-restarts", type=int, default=0,
+    p.add_argument("--max-restarts", type=int, default=None,
                    help="run under the restart supervisor: relaunch this "
                    "command after crashes/preemptions (exponential backoff + "
                    "jitter) up to this many times, aborting early when no "
-                   "step progress is made between restarts; 0 = unsupervised")
+                   "step progress is made between restarts; 0 (the default) "
+                   "= unsupervised. Under --elastic this is the SAME-SHAPE "
+                   "restart budget for plain crashes (default 3 there; an "
+                   "explicit 0 disables same-shape restarts)")
     p.add_argument("--preempt-notice-file", default=None, metavar="PATH",
                    help="also treat the appearance of this file as a "
                    "preemption notice (for environments that cannot deliver "
                    "SIGTERM to the training process); same semantics as the "
                    "signal: final checkpoint at the next step boundary, "
                    f"exit code {EXIT_PREEMPTED}")
+
+
+def _add_elastic(p: argparse.ArgumentParser) -> None:
+    """Elastic multi-process training (parallel/elastic.py): run N host-slot
+    processes under the elastic coordinator; a host death or sustained
+    straggler triggers a checkpoint-coordinated world resize instead of a
+    dead run."""
+    p.add_argument("--elastic", type=int, default=0, metavar="HOSTS",
+                   help="run this command as an elastic multi-process pod of "
+                   "HOSTS host-slot processes (jax.distributed over gloo on "
+                   "CPU; one process per host on real pods): a SIGKILLed/"
+                   "OOMed host triggers a coordinated drain (preemption "
+                   "checkpoints where collectives still work), a planner "
+                   "re-plan at the new world size, and a resume at HOSTS-1 "
+                   "with ZeRO-1 optimizer state resharded and the data "
+                   "service re-dealt; plain crashes restart same-shape "
+                   "under the usual budget. 0 = off")
+    p.add_argument("--min-hosts", type=int, default=1,
+                   help="never resize below this world size: a resize that "
+                   "would cross it aborts the run instead (elastic_abort)")
+    p.add_argument("--devices-per-host", type=int, default=None,
+                   help="force this many XLA host-platform devices per child "
+                   "process (the CPU pod harness; real TPU hosts expose "
+                   "their chips without it)")
+    p.add_argument("--drain-timeout", type=float, default=45.0,
+                   help="seconds survivors get to finish their preemption "
+                   "checkpoint during a resize drain before being killed "
+                   "(a DEAD peer can wedge their collectives; resume then "
+                   "falls back to the last complete checkpoint)")
+    p.add_argument("--no-straggler-evict", action="store_true",
+                   help="disable straggler-triggered host eviction (the "
+                   "coordinator still resizes on host death)")
+    p.add_argument("--evict-threshold", type=float, default=1.25,
+                   help="straggler skew threshold (worst-host mean step time "
+                   "/ fleet median) a window must cross to count toward "
+                   "eviction — obs/fleet.py's straggler attribution")
+    p.add_argument("--evict-sustained", type=int, default=3,
+                   help="consecutive alerted windows naming the SAME host "
+                   "before it is evicted (a clean window resets the streak "
+                   "— flapping hosts never oscillate the world)")
+    p.add_argument("--evict-cooldown", type=float, default=60.0,
+                   help="seconds after any resize during which no eviction "
+                   "fires (the resized fleet re-warms, which looks exactly "
+                   "like a straggler)")
+    p.add_argument("--host-inject-fault", action="append", default=[],
+                   metavar="HOST:SPEC",
+                   help="drill: pass --inject-fault SPEC to host-slot HOST "
+                   "of the INITIAL generation (e.g. '1:sigkill-step@6' "
+                   "vanishes host 1 after step 6 — the headline host-death "
+                   "resize drill)")
+    # the coordinator's child-process seam: one host slot of an explicit
+    # jax.distributed world (also usable by hand for multi-host CPU/GPU runs)
+    p.add_argument("--coordinator-address", default=None, metavar="HOST:PORT",
+                   help="join an explicit jax.distributed cluster at this "
+                   "coordinator (multihost.initialize; TPU pods "
+                   "auto-discover without it). Set by the elastic "
+                   "coordinator for its children")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="world size of the explicit jax.distributed cluster")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="this process's rank in the explicit cluster")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -272,6 +336,7 @@ def build_parser() -> argparse.ArgumentParser:
     _add_host_loop(p_fit)
     _add_observability(p_fit)
     _add_resilience(p_fit)
+    _add_elastic(p_fit)
 
     p_plan = sub.add_parser(
         "plan",
@@ -313,6 +378,14 @@ def build_parser() -> argparse.ArgumentParser:
     p_plan.add_argument("--num-classes", type=int, default=None,
                         help="classification head (default: the "
                         "segmentation head, like `train`)")
+    p_plan.add_argument("--measured-margin-from", default=None,
+                        metavar="WORKDIR",
+                        help="close the activation-estimate feedback loop: "
+                        "read the ledgered measured-vs-predicted "
+                        "memory_watermark residual from this prior run's "
+                        "workdir and add it to every candidate's budget "
+                        "check (what the elastic coordinator does "
+                        "automatically on re-plan)")
     p_plan.add_argument("--json", action="store_true",
                         help="full machine-readable plan (chosen layout + "
                         "every candidate's verdict) instead of the table")
@@ -903,6 +976,22 @@ def cmd_smoke(args) -> int:
 def cmd_fit(args) -> int:
     from tensorflowdistributedlearning_tpu.train.fit import fit_preset
 
+    if (
+        getattr(args, "coordinator_address", None) is not None
+        or getattr(args, "num_processes", None) is not None
+        or getattr(args, "process_id", None) is not None
+    ):
+        # explicit jax.distributed world (one host slot of an elastic pod, or
+        # a hand-launched multi-host CPU/GPU run): must join BEFORE any jax
+        # call initializes the backend — fit_preset's own initialize() is a
+        # no-op once this has run
+        from tensorflowdistributedlearning_tpu.parallel import multihost
+
+        multihost.initialize(
+            coordinator_address=args.coordinator_address,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
     result = fit_preset(
         args.preset,
         args.model_dir,
@@ -999,8 +1088,22 @@ def cmd_plan(args) -> int:
         )
         if value is not None
     }
+    margin = None
+    if args.measured_margin_from:
+        margin = planner_lib.measured_margin_from_workdir(
+            args.measured_margin_from
+        )
+        if margin is None:
+            print(
+                f"plan: no measured watermark residual under "
+                f"{args.measured_margin_from} (CPU backends ledger none) — "
+                "planning without margin",
+                file=sys.stderr,
+            )
     try:
-        result = planner_lib.plan(mcfg, tcfg, batch, pinned=pinned)
+        result = planner_lib.plan(
+            mcfg, tcfg, batch, pinned=pinned, measured_margin_bytes=margin
+        )
     except planner_lib.PlanError as e:
         print(f"plan: {e}", file=sys.stderr)
         return 1
@@ -1679,23 +1782,204 @@ def cmd_doctor(args) -> int:
     return 0 if report["ok"] else 1
 
 
-def _strip_supervisor_flags(argv: List[str]) -> List[str]:
-    """The child command the supervisor relaunches: this invocation minus
-    ``--max-restarts`` (both ``--flag N`` and ``--flag=N`` forms) — every
-    other flag, fault injection included, replays verbatim."""
+def _strip_flags(argv: List[str], names: List[str]) -> List[str]:
+    """Remove ``--name VALUE`` / ``--name=VALUE`` (and bare ``--name`` for
+    store-true flags whose next token is another flag) for every name in
+    ``names``; everything else replays verbatim."""
     out: List[str] = []
     skip = False
     for token in argv:
         if skip:
             skip = False
             continue
-        if token == "--max-restarts":
+        if token in names:
             skip = True
             continue
-        if token.startswith("--max-restarts="):
+        if any(token.startswith(f"{name}=") for name in names):
             continue
         out.append(token)
     return out
+
+
+def _strip_supervisor_flags(argv: List[str]) -> List[str]:
+    """The child command the supervisor relaunches: this invocation minus
+    ``--max-restarts`` (both ``--flag N`` and ``--flag=N`` forms) — every
+    other flag, fault injection included, replays verbatim."""
+    return _strip_flags(argv, ["--max-restarts"])
+
+
+def _strip_elastic_flags(argv: List[str]) -> List[str]:
+    """The child command the elastic coordinator launches: this invocation
+    minus the coordinator-level knobs (children must never re-enter the
+    coordinator), minus ``--max-restarts`` (the coordinator owns restarts),
+    minus ``--batch-size``/``--inject-fault`` (re-issued per world size /
+    per host slot)."""
+    stripped = _strip_flags(argv, [
+        "--elastic", "--min-hosts", "--devices-per-host", "--drain-timeout",
+        "--evict-threshold", "--evict-sustained", "--evict-cooldown",
+        "--host-inject-fault", "--max-restarts", "--batch-size",
+        "--inject-fault",
+    ])
+    return [t for t in stripped if t != "--no-straggler-evict"]
+
+
+def _parse_host_faults(specs: List[str]) -> dict:
+    """``--host-inject-fault HOST:SPEC`` entries -> {host_slot: fault_spec},
+    validated eagerly (a typo'd drill must fail at parse time, not after the
+    pod spawned)."""
+    from tensorflowdistributedlearning_tpu.resilience import parse_fault_spec
+
+    out = {}
+    for item in specs:
+        host, sep, spec = item.partition(":")
+        if not sep or not host.isdigit() or not spec:
+            raise SystemExit(
+                f"fit: bad --host-inject-fault {item!r} (expected HOST:SPEC, "
+                "e.g. 1:sigkill-step@6)"
+            )
+        parse_fault_spec(spec)  # raises ValueError on a bad spec
+        out[int(host)] = spec
+    return out
+
+
+def _run_elastic(args, argv: List[str]) -> int:
+    """``fit --elastic N``: re-exec this command as N host-slot child
+    processes under the elastic coordinator (parallel/elastic.py). The
+    GLOBAL batch scales with the world (per-host batch stays fixed, so the
+    data-service sidecar re-validates across a resize and ZeRO-1 state
+    reshards to the new dp); with ``--parallelism auto`` each generation's
+    children re-derive their whole layout at the live world size, and the
+    coordinator additionally ledgers the off-device what-if plan delta on
+    every resize."""
+    import os
+
+    from tensorflowdistributedlearning_tpu.configs import get_preset
+    from tensorflowdistributedlearning_tpu.parallel.elastic import (
+        ElasticConfig,
+        ElasticCoordinator,
+    )
+    from tensorflowdistributedlearning_tpu.resilience.supervisor import (
+        shell_rc,
+    )
+
+    preset = get_preset(args.preset)
+    hosts = args.elastic
+    global_batch = args.batch_size or preset.global_batch
+    if global_batch % hosts:
+        raise SystemExit(
+            f"fit: global batch {global_batch} not divisible by "
+            f"--elastic {hosts} host(s)"
+        )
+    local_bs = global_batch // hosts
+    host_faults = _parse_host_faults(args.host_inject_fault)
+    base = _strip_elastic_flags(argv)
+
+    def child_argv_fn(world, pid, coordinator, generation):
+        child = [
+            sys.executable, "-m", "tensorflowdistributedlearning_tpu",
+            *base,
+            "--batch-size", str(local_bs * world),
+        ]
+        if coordinator is not None:
+            child += [
+                "--coordinator-address", coordinator,
+                "--num-processes", str(world),
+                "--process-id", str(pid),
+            ]
+        if generation == 0 and pid in host_faults:
+            child += ["--inject-fault", host_faults[pid]]
+        return child
+
+    def plan_fn(world, measured_margin_bytes):
+        # the coordinator's off-device what-if plan at the (new) world size:
+        # a plain Topology, no devices touched — exactly the planner's
+        # laptop-pod-planning contract. Children derive/validate their OWN
+        # layout again when they start (--parallelism auto re-plans live).
+        import jax
+
+        from tensorflowdistributedlearning_tpu.parallel import (
+            planner as planner_lib,
+        )
+
+        dph = args.devices_per_host or jax.local_device_count()
+        budget = None
+        if args.hbm_budget_gb:
+            budget = int(args.hbm_budget_gb * (1 << 30))
+        topo = planner_lib.Topology(
+            n_devices=world * dph,
+            local_device_count=dph,
+            process_count=world,
+            hbm_bytes_per_device=budget,
+            device_kind=getattr(
+                jax.devices()[0], "device_kind", jax.devices()[0].platform
+            ),
+        )
+        # pin the layout flags the operator passed explicitly, so the what-if
+        # plan describes the world the children will actually train (the
+        # children re-validate/derive their own layout again at startup)
+        pinned = {}
+        if args.model_parallel != 1:
+            pinned["model_parallel"] = args.model_parallel
+        if args.pipeline_parallel != 1:
+            pinned["pipeline_parallel"] = args.pipeline_parallel
+        if args.sequence_parallel != 1:
+            pinned["sequence_parallel"] = args.sequence_parallel
+        if args.expert_parallel != 1:
+            pinned["expert_parallel"] = args.expert_parallel
+        if args.weight_update_sharding is not None:
+            pinned["weight_update_sharding"] = args.weight_update_sharding
+        return planner_lib.plan(
+            preset.model,
+            preset.train,
+            local_bs * world,
+            topology=topo,
+            pinned=pinned,
+            measured_margin_bytes=measured_margin_bytes,
+        ).header()
+
+    cfg = ElasticConfig(
+        hosts=hosts,
+        min_hosts=args.min_hosts,
+        devices_per_host=args.devices_per_host,
+        drain_timeout_s=args.drain_timeout,
+        straggler_threshold=args.evict_threshold,
+        straggler_sustained=(
+            10**9 if args.no_straggler_evict else args.evict_sustained
+        ),
+        eviction_cooldown_s=args.evict_cooldown,
+        # None (flag not given) = the elastic default of 3; an EXPLICIT 0
+        # disables same-shape restarts (fail fast on deterministic crashes)
+        max_restarts=3 if args.max_restarts is None else args.max_restarts,
+        seed=getattr(args, "seed", 0),
+    )
+    child_env = dict(os.environ, TFDL_SUPERVISED_CHILD="1")
+    result = ElasticCoordinator(
+        child_argv_fn,
+        args.model_dir,
+        cfg,
+        plan_fn=plan_fn,
+        env=child_env,
+    ).run()
+    print(
+        json.dumps(
+            {
+                "elastic": True,
+                "ok": result.ok,
+                "world_size": result.world_size,
+                "resizes": result.resizes,
+                "restarts": result.restarts,
+                "evictions": result.evictions,
+                "aborted": result.aborted,
+                "final_step": result.final_step,
+                "resize_downtime_s": result.resize_downtime_s,
+            }
+        ),
+        file=sys.stderr,
+        flush=True,
+    )
+    if result.ok:
+        return 0
+    return shell_rc(result.exit_code) or 1
 
 
 def _run_supervised(args, argv: List[str]) -> int:
@@ -1734,10 +2018,13 @@ def _run_supervised(args, argv: List[str]) -> int:
     )
     if result.ok:
         return 0
-    rc = result.exit_code
     # a child killed by signal N reports rc=-N; surface the conventional
     # 128+N instead of a negative value the shell would fold mod 256
-    return 128 - rc if rc < 0 else (rc or 1)
+    from tensorflowdistributedlearning_tpu.resilience.supervisor import (
+        shell_rc,
+    )
+
+    return shell_rc(result.exit_code) or 1
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -1750,7 +2037,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.command in ("train", "fit"):
         import os
 
-        if getattr(args, "max_restarts", 0) > 0 and not os.environ.get(
+        if getattr(args, "elastic", 0) > 0 and not os.environ.get(
+            "TFDL_SUPERVISED_CHILD"
+        ):
+            return _run_elastic(args, raw_argv)
+        if (getattr(args, "max_restarts", None) or 0) > 0 and not os.environ.get(
             "TFDL_SUPERVISED_CHILD"
         ):
             return _run_supervised(args, raw_argv)

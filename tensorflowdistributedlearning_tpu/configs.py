@@ -83,6 +83,31 @@ PRESETS: Dict[str, Preset] = {
         global_batch=64,
         description="CIFAR-10-shaped smoke config runnable on a CPU mesh",
     ),
+    # the elastic/resilience drill shape: one step is milliseconds on a CPU
+    # mesh, checkpoints land every 2 steps (dense resume points for
+    # kill-and-resize drills), and every step writes a ledger window (the
+    # straggler probe needs per-step cross-host comparisons). Micro-sized on
+    # purpose: tests/bench_elastic drive REAL multi-process worlds with it.
+    "elastic_smoke": Preset(
+        model=ModelConfig(
+            num_classes=4,
+            input_shape=(16, 16),
+            input_channels=3,
+            n_blocks=(1, 1, 1),
+            base_depth=8,
+            width_multiplier=0.0625,
+            output_stride=None,
+        ),
+        train=TrainConfig(
+            checkpoint_every_steps=2,
+            train_log_every_steps=1,
+            augmentation="none",
+        ),
+        global_batch=8,
+        description="Micro classification config for elastic-resize and "
+        "kill-drill runs: millisecond steps on a CPU mesh, checkpoint "
+        "every 2 steps, a ledger window every step",
+    ),
     # BASELINE.json "ResNet-50 multi-tower data-parallel (ImageNet-1k)"
     "resnet50_imagenet": Preset(
         model=_imagenet_model(n_blocks=(3, 4, 6)),

@@ -367,11 +367,24 @@ class ArrayBatchSource:
     rng-stateful permutations (same mixing, but batch ``i`` is a pure
     function of the seed, so the K-fold trainer resumes deterministically
     without seed-folding tricks). ``arrays`` values must share a leading
-    dimension (e.g. ``{'images': ..., 'masks': ...}``)."""
+    dimension (e.g. ``{'images': ..., 'masks': ...}``).
 
-    def __init__(self, arrays: Dict[str, np.ndarray]):
+    ``process_count``: the world size the arrays were SHARDED FOR (callers
+    that host-shard before constructing — the K-fold trainer's
+    ``pipeline.host_shard`` fold split). When set it rides the service's
+    resume sidecar, so a resumed fold that crossed a world resize re-deals
+    explicitly (ledgered) instead of silently indexing a different host
+    shard; None/0 = world-independent arrays (nothing validated)."""
+
+    def __init__(
+        self,
+        arrays: Dict[str, np.ndarray],
+        *,
+        process_count: Optional[int] = None,
+    ):
         if not arrays:
             raise ValueError("ArrayBatchSource needs at least one array")
+        self.process_count = int(process_count or 0)
         lengths = {k: len(v) for k, v in arrays.items()}
         if len(set(lengths.values())) != 1:
             raise ValueError(f"array lengths disagree: {lengths}")
@@ -414,7 +427,18 @@ class StreamingDataService:
     ``resume_state`` (a ``DataServiceState`` json dict, from the checkpoint
     sidecar) is VALIDATED against ``(seed, start_batch)``: a mismatch means
     the run is about to silently replay or skip data, which must crash, not
-    train."""
+    train — with ONE deliberate exception: a changed ``process_count`` (an
+    elastic world resize, parallel/elastic.py) re-deals the per-epoch shard
+    assignment at the new world size instead of refusing. The re-deal keeps
+    the epoch-boundary math intact — batch ``i`` maps onto the NEW world's
+    per-host virtual record sequence through the same cumulative-epoch-size
+    accounting, so the resumed stream is still a pure function of
+    ``(seed, batch_index, process_index, process_count)`` and an elastic
+    resume lands bit-identical to a clean same-world run from the same
+    checkpoint. Seed, per-host batch size and the shard fingerprint are still
+    hard-refused on mismatch (those change WHAT the indices mean, not who
+    reads them); the accepted re-deal is surfaced as ``self.redeal`` so the
+    trainers can ledger it."""
 
     def __init__(
         self,
@@ -451,6 +475,10 @@ class StreamingDataService:
             int(queue_depth) if queue_depth else max(2, self.workers + 1)
         )
         self._registry = registry
+        # set when an accepted resume crossed a world resize: the validated
+        # re-deal's facts ({"old_process_count", "new_process_count",
+        # "batch_index"}) for the trainers to ledger as a `data_redeal` event
+        self.redeal: Optional[Dict] = None
         if resume_state is not None:
             restored = DataServiceState.from_json(resume_state)
             fingerprint = self._shard_fingerprint()
@@ -459,8 +487,6 @@ class StreamingDataService:
                 or restored.batch_index != self.start_batch
                 or (restored.batch_size
                     and restored.batch_size != self.batch_size)
-                or (restored.process_count
-                    and restored.process_count != self._process_count())
                 or (restored.shard_fingerprint and fingerprint
                     and restored.shard_fingerprint != fingerprint)
             )
@@ -470,15 +496,41 @@ class StreamingDataService:
                     f"has (seed={restored.seed}, "
                     f"batch_index={restored.batch_index}, "
                     f"batch_size={restored.batch_size or '?'}, "
-                    f"process_count={restored.process_count or '?'}, "
                     f"shards={restored.shard_fingerprint or '?'}) but "
                     f"this run wants (seed={self.seed}, "
                     f"batch_index={self.start_batch}, "
                     f"batch_size={self.batch_size}, "
-                    f"process_count={self._process_count() or '?'}, "
                     f"shards={fingerprint or '?'}) — resuming would replay "
                     "or skip training data; restore with the original "
-                    "seed/step/batch/world size and shard set"
+                    "seed/step/per-host batch size and shard set"
+                )
+            new_count = self._process_count()
+            if (
+                restored.process_count
+                and new_count
+                and restored.process_count != new_count
+            ):
+                # elastic world resize: the per-epoch shard deal is a pure
+                # function of (seed, epoch, process_index, process_count), so
+                # the NEW world re-derives every plan from scratch — nothing
+                # of the old deal survives to conflict. The epoch-boundary
+                # math (cumulative epoch sizes -> (epoch, offset) of any
+                # batch index) is re-priced under the new per-host epoch
+                # sizes by the same _locate/_extend_cum accounting, keeping
+                # the stream deterministic for every host of the new world.
+                self.redeal = {
+                    "old_process_count": int(restored.process_count),
+                    "new_process_count": int(new_count),
+                    "batch_index": int(self.start_batch),
+                }
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "data service resuming across a world resize: "
+                    "process_count %d -> %d at batch_index %d — re-dealing "
+                    "the per-epoch shard assignment (validated: seed, "
+                    "per-host batch size and shard set unchanged)",
+                    restored.process_count, new_count, self.start_batch,
                 )
         # cumulative epoch sizes: _cum[e] = records before epoch e
         self._cum: List[int] = [0]

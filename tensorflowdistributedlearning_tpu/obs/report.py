@@ -390,6 +390,68 @@ def _resilience_section(all_events: List[Dict]) -> Optional[Dict]:
     return section
 
 
+def _elastic_section(all_events: List[Dict]) -> Optional[Dict]:
+    """Aggregate the last elastic session's events (parallel/elastic.py):
+    ``elastic_start`` .. ``elastic_end`` brackets with every ``world_resize``
+    / ``host_evicted`` / ``data_redeal`` in between — the world-trajectory
+    and goodput-lost-to-resizes story. None when the history holds no
+    elastic session."""
+    starts = [
+        i for i, e in enumerate(all_events)
+        if e.get("event") == "elastic_start"
+    ]
+    if not starts:
+        return None
+    scope = all_events[starts[-1]:]
+    start = scope[0]
+    resizes = [e for e in scope if e.get("event") == "world_resize"]
+    evictions = [e for e in scope if e.get("event") == "host_evicted"]
+    redeals = [e for e in scope if e.get("event") == "data_redeal"]
+    aborts = [e for e in scope if e.get("event") == "elastic_abort"]
+    end = next(
+        (e for e in reversed(scope) if e.get("event") == "elastic_end"), None
+    )
+    hosts = start.get("hosts")
+    world = (
+        end.get("world_size") if end else
+        (resizes[-1].get("new_world") if resizes else hosts)
+    )
+    section: Dict = {
+        "hosts": hosts,
+        "min_hosts": start.get("min_hosts"),
+        "world_size": world,
+        "live": end is None,
+        "resizes": len(resizes),
+        "evictions": len(evictions),
+        "data_redeals": len(redeals),
+        # goodput lost to resizes: drain start -> new world spawned, as the
+        # coordinator measured it (the same accounting lens as the
+        # resilience section's restart downtime)
+        "resize_downtime_s": round(
+            sum(e.get("downtime_s", 0.0) for e in resizes), 3
+        ),
+        "resize_events": [
+            {
+                k: e.get(k)
+                for k in (
+                    "old_world", "new_world", "reason", "progress_step",
+                    "downtime_s", "process_index", "evicted_process",
+                    "measured_margin_bytes", "plan_old", "plan_new",
+                )
+                if e.get(k) is not None
+            }
+            for e in resizes
+        ],
+    }
+    if end is not None:
+        section["ok"] = bool(end.get("ok"))
+    if aborts:
+        section["aborted"] = aborts[-1].get("reason")
+    elif end is not None and end.get("aborted"):
+        section["aborted"] = end["aborted"]
+    return section
+
+
 def build_report(
     workdir: str,
     *,
@@ -518,6 +580,10 @@ def build_report(
     resilience = _resilience_section(all_events)
     if resilience:
         report["resilience"] = resilience
+
+    elastic = _elastic_section(all_events)
+    if elastic:
+        report["elastic"] = elastic
 
     health = _health_section(events)
     if health:
@@ -888,6 +954,52 @@ def render_report(report: Dict) -> str:
             }.get(res["aborted"], "see the supervisor_abort ledger event")
             lines.append(
                 f"  !! supervisor gave this run up: {res['aborted']} — "
+                f"{explanation}"
+            )
+    ela = report.get("elastic")
+    if ela:
+        state = "LIVE" if ela.get("live") else (
+            "ok" if ela.get("ok") else "failed"
+        )
+        lines.append(
+            f"\nelastic: world {ela['hosts']} -> {ela['world_size']} "
+            f"[{state}] — {ela['resizes']} resize(s), "
+            f"{ela['evictions']} eviction(s), "
+            f"{ela['data_redeals']} data re-deal(s), "
+            f"{ela['resize_downtime_s']:.2f}s goodput lost to resizes "
+            f"(min_hosts {ela['min_hosts']})"
+        )
+        for rz in ela.get("resize_events", []):
+            plan = ""
+            if rz.get("plan_old") or rz.get("plan_new"):
+                old_l = (rz.get("plan_old") or {}).get("layout") or {}
+                new_l = (rz.get("plan_new") or {}).get("layout") or {}
+                if old_l or new_l:
+                    plan = (
+                        f", plan dp{old_l.get('data_parallel', '?')} -> "
+                        f"dp{new_l.get('data_parallel', '?')}"
+                    )
+            evicted = (
+                f", evicted host {rz['evicted_process']}"
+                if rz.get("evicted_process") is not None else ""
+            )
+            lines.append(
+                f"   - {rz.get('old_world')} -> {rz.get('new_world')} "
+                f"({rz.get('reason')}) at step "
+                f"{rz.get('progress_step')}, "
+                f"{rz.get('downtime_s', 0.0):.2f}s downtime"
+                f"{evicted}{plan}"
+            )
+        if ela.get("aborted"):
+            explanation = {
+                "min-hosts": "a resize would have crossed --min-hosts",
+                "resize-budget": "the resize budget was exhausted",
+                "crash-loop": "no step progress between restarts",
+                "restart-budget": "the restart budget was exhausted",
+                "signaled": "the coordinator itself was signaled to stop",
+            }.get(ela["aborted"], "see the elastic_abort ledger event")
+            lines.append(
+                f"  !! elastic session aborted: {ela['aborted']} — "
                 f"{explanation}"
             )
     hl = report.get("health")

@@ -211,6 +211,21 @@ def build_frame(workdir: str, *, now: Optional[float] = None) -> Dict:
                 "alert_count": straggler["alert_count"],
                 "worst_process": straggler["worst_process"],
             }
+    if ledgers:
+        # elastic session status (parallel/elastic.py): the coordinator
+        # appends to the canonical (process-0) ledger, so its whole history
+        # carries the elastic_start/world_resize/elastic_end brackets
+        from tensorflowdistributedlearning_tpu.obs import report as report_lib
+
+        elastic = report_lib._elastic_section(ledgers[0].all_events)
+        if elastic:
+            frame["elastic"] = {
+                k: elastic.get(k)
+                for k in (
+                    "hosts", "min_hosts", "world_size", "live", "resizes",
+                    "evictions", "resize_downtime_s", "aborted",
+                )
+            }
     return frame
 
 
@@ -225,6 +240,17 @@ def render_frame(frame: Dict) -> str:
             "is the run pointed at this workdir?"
         )
         return "\n".join(lines)
+    ela = frame.get("elastic")
+    if ela:
+        state = "LIVE" if ela.get("live") else "ended"
+        line = (
+            f"elastic: world {ela['world_size']}/{ela['hosts']} [{state}] — "
+            f"{ela['resizes']} resize(s), {ela['evictions']} eviction(s), "
+            f"{(ela.get('resize_downtime_s') or 0.0):.1f}s resize downtime"
+        )
+        if ela.get("aborted"):
+            line += f"  !! ABORTED ({ela['aborted']})"
+        lines.append(line)
     for row in frame["rows"]:
         state = "live" if row.get("live") else "ended"
         age = row.get("last_event_age_s")

@@ -1,0 +1,867 @@
+"""Elastic pod-scale training: checkpoint-coordinated world resize.
+
+Preemptible/spot capacity is how large TPU runs get cheap, but a fixed world
+size turns one lost host into a dead run: the restart supervisor
+(resilience/supervisor.py) can only relaunch the SAME shape, which no longer
+exists. This module makes world size dynamic by composing pieces the repo
+already has:
+
+- **membership change detection**: the :class:`ElasticCoordinator` owns one
+  child process per host slot and notices a host death (child SIGKILL/OOM —
+  ``rc`` 137 — or a heartbeat stall), and the :class:`EvictionPolicy` turns
+  the fleet ledgers' live straggler attribution (``obs/fleet.py``, PR 8) into
+  a deliberate eviction — sustained skew past a threshold, never below
+  ``min_hosts``, cooldown against flapping;
+- **coordinated drain**: survivors ride the existing preemption seam
+  (resilience/preempt.py — SIGTERM ⇒ final checkpoint + data-state sidecar +
+  exit 75). A host DEATH leaves the survivors' collectives pointed at a dead
+  peer, so the drain is bounded: children that cannot complete their
+  preemption checkpoint within ``drain_timeout_s`` are killed and the resume
+  falls back to the last COMPLETE checkpoint (``restore_latest`` already
+  skips the torn one). An EVICTION drains everyone cooperatively — all hosts
+  still live — so it loses zero steps;
+- **re-plan**: the new world's mesh comes from ``parallel/planner.plan()`` at
+  the new :class:`~tensorflowdistributedlearning_tpu.parallel.planner.Topology`
+  (the planner takes a plain Topology, so the what-if plan runs in the
+  coordinator, off-device), fed the prior run's ledgered
+  measured-vs-predicted watermark residual
+  (``planner.measured_margin_from_workdir``) as activation margin;
+- **resize-aware resume**: children restart at the new world size and restore
+  through the layout-independent checkpoint path — the abstract template
+  carries the NEW placement's shardings, so ZeRO-1 optimizer state lands
+  resharded to the new dp degree (the cross-mode restore contract of
+  arXiv:2004.13336, pinned by tests/test_zero1.py) — while
+  ``data/service.py`` re-deals the per-epoch shard assignment at the new
+  ``process_count`` (batch ``i`` stays a pure function of
+  ``(seed, i, process_index, process_count)``, so an elastic resume is
+  bit-identical to a clean same-world run from the same checkpoint);
+- **ledgered accounting**: every resize writes a ``world_resize`` event
+  (old/new world, reason, measured downtime, plan delta) and every eviction a
+  ``host_evicted`` event into the workdir ledger, bracketed by
+  ``elastic_start``/``elastic_end`` — rendered by ``telemetry-report``'s
+  elastic section and ``telemetry-top``'s world row, with resize downtime
+  counted against goodput.
+
+The coordinator's child launcher is a single-machine pod harness (one
+subprocess per simulated host, explicit ``jax.distributed`` coordinator over
+gloo CPU collectives — the same shape tests/test_multiprocess.py proves), and
+every seam (``spawn``, ``child_argv_fn``, ``straggler_probe``, ``plan_fn``,
+``sleep``/``clock``) is injectable: on a real pod the same state machine runs
+with a scheduler-backed spawn. CLI: ``fit --elastic N --min-hosts M``.
+
+Resize state machine (one generation = one spawned world)::
+
+    spawn(W) ──all rc 0──────────────────────────────▶ done
+       │ child rc 137 / heartbeat stall with a dead peer
+       │        ──▶ drain survivors ─▶ resize(W-1)  [world_resize: host_death]
+       │ sustained straggler (EvictionPolicy)
+       │        ──▶ drain ALL (cooperative) ─▶ resize(W-1)
+       │                               [host_evicted + world_resize]
+       │ child crash (nonzero rc, host still fine)
+       │        ──▶ drain ─▶ respawn(W)  [same-shape restart, budgeted,
+       │                                  crash-loop detected via ledger]
+       └ resize below min_hosts / budgets exhausted ─▶ elastic_abort
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import random
+import signal as signal_lib
+import socket
+import subprocess
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from tensorflowdistributedlearning_tpu.resilience.preempt import EXIT_PREEMPTED
+
+logger = logging.getLogger(__name__)
+
+ABORT_MIN_HOSTS = "min-hosts"
+ABORT_RESIZE_BUDGET = "resize-budget"
+ABORT_RESTART_BUDGET = "restart-budget"
+ABORT_CRASH_LOOP = "crash-loop"
+ABORT_SIGNALED = "signaled"
+
+RESIZE_HOST_DEATH = "host_death"
+RESIZE_EVICTION = "straggler_evicted"
+
+# a child killed by SIGKILL reports rc -9 from Popen (137 once shell-folded):
+# the signature of a host that VANISHED (OOM kill, node loss) rather than
+# crashed — the distinction that turns a same-shape restart into a resize
+_SIGKILL_RCS = (-signal_lib.SIGKILL, 128 + signal_lib.SIGKILL)
+
+
+def free_port() -> int:
+    """An ephemeral localhost port for one generation's jax.distributed
+    coordinator (each generation binds a FRESH one — the dying world's
+    coordinator socket may linger in TIME_WAIT)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclasses.dataclass
+class ElasticConfig:
+    """Knobs of one elastic session. ``hosts`` is the initial world size;
+    the world only ever shrinks (hosts joining mid-run would need a rendezvous
+    jax.distributed does not offer — a re-launch at the larger size resumes
+    through the same re-deal path)."""
+
+    hosts: int
+    min_hosts: int = 1
+    devices_per_host: Optional[int] = None  # CPU harness: forced device count
+    drain_timeout_s: float = 45.0
+    poll_interval_s: float = 0.2
+    straggler_poll_s: float = 2.0
+    straggler_threshold: float = 1.25
+    straggler_sustained: int = 3
+    eviction_cooldown_s: float = 60.0
+    # no ledger step progress for this long while every child is alive = a
+    # wedged collective (e.g. a silently-lost peer): drain and restart. Must
+    # comfortably exceed compile time; 0 disables.
+    heartbeat_timeout_s: float = 600.0
+    max_restarts: int = 3  # same-shape restarts (crashes), like Supervisor
+    max_resizes: int = 8
+    crash_loop_tolerance: int = 2
+    backoff_base_s: float = 1.0
+    backoff_max_s: float = 30.0
+    jitter_frac: float = 0.25
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        if self.hosts < 1:
+            raise ValueError(f"hosts must be >= 1, got {self.hosts}")
+        if not 1 <= self.min_hosts <= self.hosts:
+            raise ValueError(
+                f"min_hosts must be in [1, hosts={self.hosts}], got "
+                f"{self.min_hosts}"
+            )
+        if self.straggler_sustained < 1:
+            raise ValueError(
+                f"straggler_sustained must be >= 1, got "
+                f"{self.straggler_sustained}"
+            )
+
+
+class EvictionPolicy:
+    """The straggler-eviction state machine — pure and clock-injected, so the
+    policy contract (tests/test_elastic.py) is pinned without processes.
+
+    Feed it one observation per straggler poll (:meth:`observe`): the newest
+    cross-host-compared window step and, when that window crossed the skew
+    threshold, the alert naming the worst host. An eviction fires only after
+    ``sustained`` CONSECUTIVE fresh alerted windows naming the SAME host — a
+    clean fresh window resets the streak, so a transiently-slow (flapping)
+    host never oscillates the world. Evictions never take the world below
+    ``min_hosts``, and after any resize (:meth:`notify_resize`) a cooldown
+    blocks further evictions while the resized fleet restabilizes."""
+
+    def __init__(
+        self,
+        *,
+        threshold: float = 1.25,
+        sustained: int = 3,
+        cooldown_s: float = 60.0,
+        min_hosts: int = 1,
+    ):
+        self.threshold = float(threshold)
+        self.sustained = int(sustained)
+        self.cooldown_s = float(cooldown_s)
+        self.min_hosts = int(min_hosts)
+        self._last_step: Optional[int] = None
+        self._candidate: Optional[int] = None
+        self._streak = 0
+        self._cooldown_until = 0.0
+
+    def observe(
+        self,
+        now: float,
+        world_size: int,
+        step: Optional[int],
+        alert: Optional[Dict],
+    ) -> Optional[int]:
+        """One poll: ``step`` is the newest step compared across >= 2 hosts
+        (None: nothing comparable yet), ``alert`` the straggler alert AT that
+        step ({"worst_process", "skew"}) or None when that window was clean.
+        Returns the process index to evict, or None."""
+        if step is None or (
+            self._last_step is not None and step <= self._last_step
+        ):
+            return None  # no fresh window since the last poll
+        self._last_step = step
+        if not alert or float(alert.get("skew", 0.0)) <= self.threshold:
+            self._candidate = None
+            self._streak = 0
+            return None
+        worst = int(alert["worst_process"])
+        if worst == self._candidate:
+            self._streak += 1
+        else:
+            self._candidate = worst
+            self._streak = 1
+        if self._streak < self.sustained:
+            return None
+        if now < self._cooldown_until:
+            return None
+        if world_size - 1 < self.min_hosts:
+            return None  # shedding the straggler would kill the run
+        return self._candidate
+
+    def notify_resize(self, now: float) -> None:
+        """Any resize (eviction OR death) restarts the clock: the resized
+        fleet re-warms (compile, cache refill), which looks exactly like a
+        straggler and must not trigger a cascade."""
+        self._cooldown_until = now + self.cooldown_s
+        self._candidate = None
+        self._streak = 0
+
+
+def ledger_straggler_probe(
+    workdir: str, world_size: int, *, threshold: float
+) -> Tuple[Optional[int], Optional[Dict]]:
+    """The default live straggler source: merge the CURRENT world's
+    per-process ledgers (process indices < ``world_size`` — stale ledgers of
+    evicted/dead slots are excluded) and return ``(latest_compared_step,
+    alert_at_that_step_or_None)`` in :meth:`EvictionPolicy.observe`'s shape.
+    """
+    from tensorflowdistributedlearning_tpu.obs import fleet as fleet_lib
+
+    try:
+        ledgers = [
+            led
+            for led in fleet_lib.discover_ledgers(workdir)
+            if led.process_index < world_size
+        ]
+    except OSError:
+        return None, None
+    section = fleet_lib.straggler_section(
+        ledgers, skew_threshold=threshold, max_alerts=10**6
+    )
+    if not section:
+        return None, None
+    # the newest cross-compared step: alerts carry steps; clean windows do
+    # not surface individually, but the worst_window_counts/windows_compared
+    # math runs over ALL shared steps — recover the newest via the per-ledger
+    # windows directly
+    latest = None
+    per_host_steps = []
+    for led in ledgers:
+        steps = {
+            int(e["step"])
+            for e in led.events
+            if e.get("event") == "step_window" and "step" in e
+            and "step_time_ms" in e
+        }
+        if steps:
+            per_host_steps.append(steps)
+    if len(per_host_steps) >= 2:
+        shared = set.intersection(*per_host_steps)
+        if shared:
+            latest = max(shared)
+    if latest is None:
+        return None, None
+    alert = next(
+        (
+            {"worst_process": a["worst_process"], "skew": a["skew"]}
+            for a in reversed(section.get("alerts", []))
+            if a.get("step") == latest
+        ),
+        None,
+    )
+    return latest, alert
+
+
+@dataclasses.dataclass
+class ElasticResult:
+    ok: bool
+    exit_code: int
+    world_size: int
+    resizes: int
+    restarts: int
+    evictions: int = 0
+    aborted: Optional[str] = None  # ABORT_* or None
+    final_step: Optional[int] = None
+    resize_downtime_s: float = 0.0
+
+
+class _Child:
+    """One spawned host slot: a thin Popen wrapper the fake-spawn tests
+    mirror (``poll``/``send_signal``/``kill``/``pid``)."""
+
+    def __init__(self, proc: subprocess.Popen):
+        self._proc = proc
+        self.pid = proc.pid
+
+    def poll(self) -> Optional[int]:
+        return self._proc.poll()
+
+    def send_signal(self, sig: int) -> None:
+        try:
+            self._proc.send_signal(sig)
+        except (ProcessLookupError, OSError):
+            pass
+
+    def kill(self) -> None:
+        try:
+            self._proc.kill()
+        except (ProcessLookupError, OSError):
+            pass
+
+
+class ElasticCoordinator:
+    """Run an elastic multi-process training session rooted at ``workdir``.
+
+    ``child_argv_fn(world_size, process_id, coordinator_address, generation)``
+    builds one host slot's command (``coordinator_address`` is None for a
+    single-host world — the child then runs plain single-process). The
+    coordinator appends ``world_resize``/``host_evicted``/``elastic_*``
+    events to the workdir's canonical ledger exactly like the restart
+    supervisor does — between child generations, plus spawn markers whose
+    interleaving with child lines is safe (O_APPEND single-line writes).
+
+    ``plan_fn(world_size, measured_margin_bytes)`` returns the new world's
+    plan header dict (``parallel/planner``) or None; the default is injected
+    by the CLI with the run's model/train config closed over."""
+
+    def __init__(
+        self,
+        child_argv_fn: Callable[[int, int, Optional[str], int], Sequence[str]],
+        workdir: str,
+        config: ElasticConfig,
+        *,
+        plan_fn: Optional[Callable[[int, Optional[int]], Optional[Dict]]] = None,
+        spawn: Optional[Callable[[Sequence[str], Dict[str, str]], _Child]] = None,
+        straggler_probe: Optional[
+            Callable[[int], Tuple[Optional[int], Optional[Dict]]]
+        ] = None,
+        env: Optional[Dict[str, str]] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.workdir = workdir
+        self.config = config
+        self._argv_fn = child_argv_fn
+        self._plan_fn = plan_fn
+        self._spawn = spawn or self._spawn_subprocess
+        self._probe = straggler_probe or (
+            lambda world: ledger_straggler_probe(
+                workdir, world, threshold=config.straggler_threshold
+            )
+        )
+        self._env = env
+        self._sleep = sleep
+        self._clock = clock
+        self._rng = random.Random(config.seed)
+        self._children: List[Optional[_Child]] = []
+        self._stop_signal: Optional[int] = None
+        self.policy = EvictionPolicy(
+            threshold=config.straggler_threshold,
+            sustained=config.straggler_sustained,
+            cooldown_s=config.eviction_cooldown_s,
+            min_hosts=config.min_hosts,
+        )
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _spawn_subprocess(
+        self, argv: Sequence[str], env: Dict[str, str]
+    ) -> _Child:
+        return _Child(subprocess.Popen(list(argv), env=env))
+
+    def _child_env(self) -> Dict[str, str]:
+        env = dict(self._env if self._env is not None else os.environ)
+        # same contract as the restart supervisor: children know they are
+        # supervised (stamps run headers, blocks supervisor recursion)
+        env["TFDL_SUPERVISED_CHILD"] = "1"
+        if self.config.devices_per_host:
+            env["XLA_FLAGS"] = (
+                "--xla_force_host_platform_device_count="
+                f"{self.config.devices_per_host}"
+            )
+        return env
+
+    def _ledger(self):
+        from tensorflowdistributedlearning_tpu.obs.ledger import RunLedger
+
+        return RunLedger(self.workdir)
+
+    def _progress(self) -> Optional[int]:
+        from tensorflowdistributedlearning_tpu.resilience.supervisor import (
+            ledger_progress,
+        )
+
+        return ledger_progress(self.workdir)
+
+    def _backoff(self, attempt: int) -> float:
+        from tensorflowdistributedlearning_tpu.resilience.retry import (
+            backoff_delay,
+        )
+
+        return backoff_delay(
+            attempt,
+            base_delay_s=self.config.backoff_base_s,
+            max_delay_s=self.config.backoff_max_s,
+            jitter_frac=self.config.jitter_frac,
+            rng=self._rng,
+        )
+
+    def _plan_header(
+        self, world: int, margin: Optional[int]
+    ) -> Optional[Dict]:
+        if self._plan_fn is None:
+            return None
+        try:
+            return self._plan_fn(world, margin)
+        except Exception as e:  # noqa: BLE001 — a failed what-if plan must
+            # never block the resize itself; the new world's own fit will
+            # validate its layout again anyway
+            logger.warning("re-plan at world %d failed: %s", world, e)
+            return {"error": str(e)[:300]}
+
+    @staticmethod
+    def _plan_lite(header: Optional[Dict]) -> Optional[Dict]:
+        """The resize event's compact plan view (the full header already
+        rides each generation's run_header)."""
+        if not header:
+            return None
+        if "error" in header:
+            return {"error": header["error"]}
+        out: Dict = {"layout": header.get("layout")}
+        predicted = header.get("predicted") or {}
+        if predicted.get("total_bytes_per_chip") is not None:
+            out["total_bytes_per_chip"] = predicted["total_bytes_per_chip"]
+        if header.get("headroom_frac") is not None:
+            out["headroom_frac"] = header["headroom_frac"]
+        return out
+
+    # -- signals -----------------------------------------------------------
+
+    def _on_signal(self, signum, frame) -> None:
+        self._stop_signal = signum
+        for child in self._children:
+            if child is not None and child.poll() is None:
+                child.send_signal(signal_lib.SIGTERM)
+
+    def _install_signals(self) -> Dict[int, object]:
+        prev: Dict[int, object] = {}
+        for sig in (signal_lib.SIGTERM, signal_lib.SIGINT):
+            try:
+                prev[sig] = signal_lib.signal(sig, self._on_signal)
+            except ValueError:  # non-main thread
+                pass
+        return prev
+
+    @staticmethod
+    def _restore_signals(prev: Dict[int, object]) -> None:
+        for sig, disposition in prev.items():
+            try:
+                signal_lib.signal(sig, disposition)
+            except (ValueError, TypeError):
+                pass
+
+    # -- generations -------------------------------------------------------
+
+    def _spawn_world(self, world: int, generation: int) -> None:
+        coord = f"127.0.0.1:{free_port()}" if world > 1 else None
+        env = self._child_env()
+        self._children = []
+        for pid in range(world):
+            argv = list(self._argv_fn(world, pid, coord, generation))
+            self._children.append(self._spawn(argv, env))
+
+    def _alive(self) -> List[int]:
+        return [
+            i
+            for i, c in enumerate(self._children)
+            if c is not None and c.poll() is None
+        ]
+
+    def _drain(self) -> float:
+        """SIGTERM every live child (the preemption seam: final checkpoint +
+        sidecar + exit 75 where the collectives still work), bounded by
+        ``drain_timeout_s``, then SIGKILL the rest. Returns the drain wall
+        time."""
+        t0 = self._clock()
+        for i in self._alive():
+            self._children[i].send_signal(signal_lib.SIGTERM)
+        deadline = t0 + self.config.drain_timeout_s
+        while self._alive() and self._clock() < deadline:
+            self._sleep(self.config.poll_interval_s)
+        stragglers = self._alive()
+        for i in stragglers:
+            logger.warning(
+                "child %d did not drain within %.0fs — killing (resume falls "
+                "back to the last complete checkpoint)",
+                i, self.config.drain_timeout_s,
+            )
+            self._children[i].kill()
+        # reap: give the kills a moment to register
+        deadline = self._clock() + 5.0
+        while self._alive() and self._clock() < deadline:
+            self._sleep(self.config.poll_interval_s)
+        return self._clock() - t0
+
+    # -- the session -------------------------------------------------------
+
+    def run(self) -> ElasticResult:  # noqa: C901 — the state machine
+        cfg = self.config
+        ledger = self._ledger()
+        prev_handlers = self._install_signals()
+        world = cfg.hosts
+        generation = 0
+        restarts = 0
+        resizes = 0
+        evictions = 0
+        no_progress = 0
+        resize_downtime = 0.0
+        prev_step = self._progress()
+        margin = None
+        plan_header = self._plan_header(world, None)
+        ledger.event(
+            "elastic_start",
+            hosts=cfg.hosts,
+            min_hosts=cfg.min_hosts,
+            devices_per_host=cfg.devices_per_host,
+            step=prev_step,
+            **({"plan": self._plan_lite(plan_header)} if plan_header else {}),
+        )
+
+        def finish(res: ElasticResult) -> ElasticResult:
+            ledger.event(
+                "elastic_end",
+                ok=res.ok,
+                world_size=res.world_size,
+                resizes=res.resizes,
+                restarts=res.restarts,
+                evictions=res.evictions,
+                aborted=res.aborted,
+                step=res.final_step,
+                resize_downtime_s=round(res.resize_downtime_s, 3),
+            )
+            return res
+
+        try:
+            while True:
+                self._spawn_world(world, generation)
+                ledger.event(
+                    "world_spawn",
+                    generation=generation,
+                    world_size=world,
+                    pids=[c.pid for c in self._children if c is not None],
+                )
+                event = self._monitor(world, ledger)
+                step = self._progress()
+                if self._stop_signal is not None or event["kind"] == "signaled":
+                    # the coordinator itself was told to stop: children were
+                    # already forwarded SIGTERM by the handler — wait them out
+                    # and report like the restart supervisor's signaled stop
+                    self._drain()
+                    rc = event.get("rc", 0) or 0
+                    return finish(
+                        ElasticResult(
+                            ok=rc == 0,
+                            exit_code=rc,
+                            world_size=world,
+                            resizes=resizes,
+                            restarts=restarts,
+                            evictions=evictions,
+                            aborted=None if rc == 0 else ABORT_SIGNALED,
+                            final_step=step,
+                            resize_downtime_s=resize_downtime,
+                        )
+                    )
+                if event["kind"] == "done":
+                    return finish(
+                        ElasticResult(
+                            ok=True,
+                            exit_code=0,
+                            world_size=world,
+                            resizes=resizes,
+                            restarts=restarts,
+                            evictions=evictions,
+                            final_step=step,
+                            resize_downtime_s=resize_downtime,
+                        )
+                    )
+
+                # membership change or crash: drain whatever still runs
+                drain_t0 = self._clock()
+                self._drain()
+                last_step = prev_step
+                step = self._progress()
+                progressed = step is not None and (
+                    prev_step is None or step > prev_step
+                )
+                prev_step = step
+
+                if event["kind"] in (RESIZE_HOST_DEATH, RESIZE_EVICTION):
+                    # a resize is a deliberate membership change, not a crash
+                    # loop: it must not feed the no-progress counter (two
+                    # quick host losses during warm-up would otherwise abort
+                    # the FIRST ordinary crash before any restart was tried)
+                    no_progress = 0
+                    new_world = world - 1
+                    if new_world < cfg.min_hosts:
+                        ledger.event(
+                            "elastic_abort",
+                            reason=ABORT_MIN_HOSTS,
+                            world_size=world,
+                            min_hosts=cfg.min_hosts,
+                            step=step,
+                        )
+                        return finish(
+                            ElasticResult(
+                                ok=False,
+                                exit_code=event.get("rc", 1) or 1,
+                                world_size=world,
+                                resizes=resizes,
+                                restarts=restarts,
+                                evictions=evictions,
+                                aborted=ABORT_MIN_HOSTS,
+                                final_step=step,
+                                resize_downtime_s=resize_downtime,
+                            )
+                        )
+                    if resizes >= cfg.max_resizes:
+                        ledger.event(
+                            "elastic_abort",
+                            reason=ABORT_RESIZE_BUDGET,
+                            resizes=resizes,
+                            step=step,
+                        )
+                        return finish(
+                            ElasticResult(
+                                ok=False,
+                                exit_code=event.get("rc", 1) or 1,
+                                world_size=world,
+                                resizes=resizes,
+                                restarts=restarts,
+                                evictions=evictions,
+                                aborted=ABORT_RESIZE_BUDGET,
+                                final_step=step,
+                                resize_downtime_s=resize_downtime,
+                            )
+                        )
+                    if event["kind"] == RESIZE_EVICTION:
+                        evictions += 1
+                        ledger.event(
+                            "host_evicted",
+                            process_index=event["process"],
+                            skew=event.get("skew"),
+                            world_size=world,
+                            step=step,
+                        )
+                    from tensorflowdistributedlearning_tpu.parallel import (
+                        planner as planner_lib,
+                    )
+
+                    margin = planner_lib.measured_margin_from_workdir(
+                        self.workdir
+                    )
+                    old_plan = plan_header
+                    plan_header = self._plan_header(new_world, margin)
+                    resizes += 1
+                    self.policy.notify_resize(self._clock())
+                    downtime = self._clock() - drain_t0
+                    resize_downtime += downtime
+                    from tensorflowdistributedlearning_tpu.resilience.supervisor import (  # noqa: E501
+                        shell_rc,
+                    )
+
+                    ledger.event(
+                        "world_resize",
+                        old_world=world,
+                        new_world=new_world,
+                        reason=event["kind"],
+                        generation=generation,
+                        rc=(
+                            shell_rc(event["rc"])
+                            if event.get("rc") is not None else None
+                        ),
+                        # the host slot that left the world (dead or evicted);
+                        # evicted_process names only DELIBERATE evictions
+                        process_index=event.get("process"),
+                        evicted_process=(
+                            event.get("process")
+                            if event["kind"] == RESIZE_EVICTION else None
+                        ),
+                        # last OBSERVED ledger progress at drain time; the
+                        # actual restore point is the new generation's
+                        # `resumed` event (restore_latest may fall back past
+                        # a checkpoint torn by the drain)
+                        progress_step=step,
+                        downtime_s=round(downtime, 3),
+                        measured_margin_bytes=margin,
+                        plan_old=self._plan_lite(old_plan),
+                        plan_new=self._plan_lite(plan_header),
+                    )
+                    logger.warning(
+                        "world resize %d -> %d (%s) at step %s — %.1fs "
+                        "downtime",
+                        world, new_world, event["kind"], step, downtime,
+                    )
+                    world = new_world
+                    generation += 1
+                    continue
+
+                # crash / stall: same-shape restart, budgeted like Supervisor
+                no_progress = 0 if progressed else no_progress + 1
+                abort = None
+                if no_progress >= cfg.crash_loop_tolerance:
+                    abort = ABORT_CRASH_LOOP
+                elif restarts >= cfg.max_restarts:
+                    abort = ABORT_RESTART_BUDGET
+                if abort:
+                    ledger.event(
+                        "elastic_abort",
+                        reason=abort,
+                        rc=event.get("rc"),
+                        restarts=restarts,
+                        step=step,
+                    )
+                    return finish(
+                        ElasticResult(
+                            ok=False,
+                            exit_code=event.get("rc", 1) or 1,
+                            world_size=world,
+                            resizes=resizes,
+                            restarts=restarts,
+                            evictions=evictions,
+                            aborted=abort,
+                            final_step=step,
+                            resize_downtime_s=resize_downtime,
+                        )
+                    )
+                restarts += 1
+                backoff = self._backoff(restarts)
+                logger.warning(
+                    "generation %d %s (rc=%s) at step %s — same-shape "
+                    "restart %d/%d in %.2fs",
+                    generation, event["kind"], event.get("rc"), step,
+                    restarts, cfg.max_restarts, backoff,
+                )
+                self._sleep(backoff)
+                if self._stop_signal is not None:
+                    return finish(
+                        ElasticResult(
+                            ok=False,
+                            exit_code=event.get("rc", 1) or 1,
+                            world_size=world,
+                            resizes=resizes,
+                            restarts=restarts - 1,
+                            evictions=evictions,
+                            aborted=ABORT_SIGNALED,
+                            final_step=step,
+                            resize_downtime_s=resize_downtime,
+                        )
+                    )
+                ledger.event(
+                    "restart",
+                    attempt=restarts,
+                    rc=event.get("rc"),
+                    reason=event["kind"],
+                    step=step,
+                    # the progress point BEFORE this generation died — the
+                    # same forensic pair the restart supervisor writes
+                    prev_step=last_step,
+                    backoff_s=round(backoff, 3),
+                    downtime_s=round(self._clock() - drain_t0, 3),
+                )
+                generation += 1
+        finally:
+            # finish() already ledgered elastic_end on every return path;
+            # this only covers an unexpected exception escaping the loop
+            self._restore_signals(prev_handlers)
+            ledger.close()
+
+    # -- per-generation monitor --------------------------------------------
+
+    def _monitor(self, world: int, ledger) -> Dict:
+        """Watch one generation until it completes or a membership/crash
+        event fires. Returns ``{"kind": ...}`` with kind one of ``done``,
+        ``signaled``, :data:`RESIZE_HOST_DEATH`, :data:`RESIZE_EVICTION`,
+        ``crash`` or ``stall`` (+ ``rc``/``process``/``skew`` context)."""
+        cfg = self.config
+        spawn_t = self._clock()
+        last_progress_t = spawn_t
+        last_step = self._progress()
+        next_straggler_t = spawn_t + cfg.straggler_poll_s
+        # heartbeat bookkeeping: the ledger reparse is O(file size), so it
+        # runs on its own (>= 1s) cadence and only when the canonical ledger
+        # actually GREW — progress cannot advance without a new line
+        ledger_path = os.path.join(self.workdir, "telemetry.jsonl")
+        heartbeat_poll_s = max(1.0, cfg.straggler_poll_s)
+        next_heartbeat_t = spawn_t + heartbeat_poll_s
+        last_ledger_size = -1
+        while True:
+            if self._stop_signal is not None:
+                return {"kind": "signaled", "rc": 0}
+            exited = {
+                i: c.poll()
+                for i, c in enumerate(self._children)
+                if c is not None and c.poll() is not None
+            }
+            failed = {i: rc for i, rc in exited.items() if rc != 0}
+            if failed:
+                # a nonzero exit while process 0 ALREADY finished cleanly is
+                # teardown noise of a completed run, not a membership event
+                if exited.get(0) == 0:
+                    logger.warning(
+                        "run complete; ignoring late nonzero exits: %s",
+                        failed,
+                    )
+                    return {"kind": "done"}
+                proc, rc = next(iter(sorted(failed.items())))
+                if rc in _SIGKILL_RCS:
+                    return {
+                        "kind": RESIZE_HOST_DEATH, "process": proc, "rc": rc,
+                    }
+                kind = "preempt" if rc == EXIT_PREEMPTED else "crash"
+                return {"kind": "crash", "rc": rc, "process": proc,
+                        "crash_kind": kind}
+            if len(exited) == len(self._children):
+                return {"kind": "done"}
+            now = self._clock()
+            # heartbeat: ledger step progress is the fleet's pulse
+            if cfg.heartbeat_timeout_s and now >= next_heartbeat_t:
+                next_heartbeat_t = now + heartbeat_poll_s
+                try:
+                    size = os.stat(ledger_path).st_size
+                except OSError:
+                    size = -1
+                if size != last_ledger_size:
+                    last_ledger_size = size
+                    step = self._progress()
+                    if step != last_step:
+                        last_step = step
+                        last_progress_t = now
+                if now - last_progress_t > cfg.heartbeat_timeout_s:
+                    return {"kind": "stall", "rc": None}
+            # straggler watch: only meaningful with >= 2 hosts
+            if world > 1 and now >= next_straggler_t:
+                next_straggler_t = now + cfg.straggler_poll_s
+                try:
+                    step, alert = self._probe(world)
+                except Exception as e:  # noqa: BLE001 — a probe hiccup (torn
+                    # ledger line mid-read) must never kill the coordinator
+                    logger.debug("straggler probe failed: %s", e)
+                    step, alert = None, None
+                victim = self.policy.observe(now, world, step, alert)
+                if victim is not None:
+                    logger.warning(
+                        "evicting straggler host %d (skew %.2f sustained "
+                        "across %d windows)",
+                        victim, float((alert or {}).get("skew", 0.0)),
+                        cfg.straggler_sustained,
+                    )
+                    return {
+                        "kind": RESIZE_EVICTION,
+                        "process": victim,
+                        "skew": (alert or {}).get("skew"),
+                    }
+            self._sleep(cfg.poll_interval_s)
+
+

@@ -94,6 +94,19 @@ def initialize(
     is_initialized = getattr(jax.distributed, "is_initialized", None)
     if is_initialized is not None and is_initialized():
         return
+    if explicit and not _platform_known_non_cpu():
+        # explicit multi-process on the CPU backend (elastic drills, the gloo
+        # integration tests, laptop pods): cross-process collectives need the
+        # gloo implementation selected BEFORE the backend initializes — the
+        # default CPU collectives are single-process only. Applied whenever
+        # the configured platform is cpu OR unset (a CPU-only machine with no
+        # JAX_PLATFORMS still lands on the cpu backend); the knob only
+        # affects the CPU backend, so it is inert on TPU/GPU pods.
+        # Best-effort: a jax build without it surfaces its real error below.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — older jax: no such config
+            pass
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -105,6 +118,22 @@ def initialize(
             raise
         # auto-discovery found no cluster: single-process run (the reference's
         # only mode)
+
+
+def _platform_known_non_cpu() -> bool:
+    """Whether this process is EXPLICITLY configured for a non-CPU backend,
+    checked WITHOUT initializing one (the env var / jax_platforms config both
+    precede backend selection). Unset means the platform is decided by what
+    the machine has — which on a CPU-only host is the cpu backend."""
+    import os
+
+    platforms = os.environ.get("JAX_PLATFORMS") or ""
+    try:
+        platforms = jax.config.jax_platforms or platforms
+    except AttributeError:
+        pass
+    platforms = str(platforms).lower()
+    return bool(platforms) and "cpu" not in platforms
 
 
 def process_info() -> Dict[str, int]:

@@ -62,6 +62,7 @@ __all__ = [
     "ParallelPlan",
     "detect_topology",
     "profile_model",
+    "measured_margin_from_workdir",
     "plan",
     "plan_for_config",
     "validate_config",
@@ -683,6 +684,7 @@ def _evaluate(
     grad_accum: int,
     microbatches: Optional[int],
     budget_bytes: Optional[int],
+    measured_margin_bytes: int = 0,
 ) -> Candidate:
     cand = Candidate(layout=layout)
     failed = _check_conflicts(layout, train_config) or _check_divisibility(
@@ -703,6 +705,14 @@ def _evaluate(
         per_chip_examples=per_chip_examples,
         remat=bool(getattr(model_config, "remat", False)),
     )
+    if measured_margin_bytes > 0:
+        # the ledgered measured-vs-predicted watermark residual of a PRIOR
+        # run (obs/capacity.py): activations/workspace the abstract estimate
+        # missed. A separate field (never folded into the per-component
+        # predictions — those stay tree_bytes_per_device-exact) that the
+        # budget gate adds on top.
+        cand.bytes["measured_margin_bytes"] = int(measured_margin_bytes)
+        cand.bytes["total_bytes_per_chip"] += int(measured_margin_bytes)
     if budget_bytes:
         cand.headroom_frac = round(
             1.0 - cand.bytes["total_bytes_per_chip"] / budget_bytes, 4
@@ -712,6 +722,10 @@ def _evaluate(
             cand.reject_detail = (
                 f"predicted {cand.bytes['total_bytes_per_chip']} bytes/chip "
                 f"> budget {budget_bytes}"
+                + (
+                    f" (incl. {measured_margin_bytes} measured margin)"
+                    if measured_margin_bytes > 0 else ""
+                )
             )
             return cand
     cand.feasible = True
@@ -852,13 +866,21 @@ def plan(
     pinned: Optional[Dict] = None,
     hbm_bytes_per_device: Optional[int] = None,
     source: Optional[str] = None,
+    measured_margin_bytes: Optional[int] = None,
 ) -> ParallelPlan:
     """The engine. ``pinned`` holds the layout fields explicit flags fixed
     (explicit flags always win); the planner fills the rest by score. With
     every field pinned this is the hand-spec validator: a layout failing a
     HARD (divisibility) constraint raises :class:`PlanError` with the named
     reason; an over-budget pinned layout comes back with a warning instead
-    (the activation estimate must not veto an explicit request)."""
+    (the activation estimate must not veto an explicit request).
+
+    ``measured_margin_bytes`` closes the activation-estimate feedback loop:
+    pass a prior run's ledgered measured-vs-predicted watermark residual
+    (:func:`measured_margin_from_workdir`) and every candidate's budget check
+    adds it on top of the abstract estimate — the elastic coordinator's
+    re-plan (parallel/elastic.py) sources it from the workdir it is about to
+    resume."""
     pinned = dict(pinned or {})
     if topology is None:
         topology = detect_topology(getattr(train_config, "n_devices", None))
@@ -890,6 +912,7 @@ def plan(
             _evaluate(
                 profile, layout, model_config, train_config, topology,
                 global_batch, grad_accum, microbatches, budget,
+                measured_margin_bytes=int(measured_margin_bytes or 0),
             )
         )
     matching = [c for c in candidates if _matches_pinned(c.layout, pinned)]
@@ -937,6 +960,31 @@ def plan(
         hbm_bytes_per_device=budget,
         warnings=warnings,
     )
+
+
+def measured_margin_from_workdir(workdir: str) -> Optional[int]:
+    """The activation/workspace residual a prior run under ``workdir``
+    actually measured: the last ``memory_watermark`` event's
+    ``measured_minus_predicted_bytes`` across every per-process ledger (the
+    fleet-wide worst — a plan must fit the hungriest host). None when no run
+    ledgered watermarks (CPU backends) or the workdir has no ledger; negative
+    residuals (the estimate over-shot) clamp to 0 — the margin only ever adds
+    safety, never spends it."""
+    from tensorflowdistributedlearning_tpu.obs import capacity as capacity_lib
+    from tensorflowdistributedlearning_tpu.obs import fleet as fleet_lib
+
+    deltas = []
+    try:
+        ledgers = fleet_lib.discover_ledgers(workdir)
+    except OSError:
+        return None
+    for led in ledgers:
+        marks = capacity_lib.aggregate_watermark_events(led.events)
+        if marks and marks.get("measured_minus_predicted_bytes") is not None:
+            deltas.append(int(marks["measured_minus_predicted_bytes"]))
+    if not deltas:
+        return None
+    return max(0, max(deltas))
 
 
 def _pinned_from_config(train_config) -> Dict:

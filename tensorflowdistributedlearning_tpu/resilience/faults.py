@@ -29,6 +29,11 @@ Spec grammar (``--inject-fault``)::
                     response) — the un-drainable replica death the fleet
                     router/supervisor must converge through; unlike sigterm
                     there is no graceful path, the process just vanishes
+    sigkill-step@6  SIGKILL this process after train step 6 — the host-death
+                    drill (parallel/elastic.py): one host of a multi-process
+                    run vanishes without draining, and the elastic
+                    coordinator must detect it, drain the survivors, and
+                    resize the world
     nan-loss@2      poison the 2nd OBSERVED loss (log window) with NaN — the
                     health-monitor drill (obs/health.py): the NaN guard must
                     alert, and warn-vs-abort must behave as configured.
@@ -70,6 +75,7 @@ _KIND_SITE = {
     "raise": SITE_STEP,
     "sigterm": SITE_STEP,
     "sigkill": SITE_REQUEST,
+    "sigkill-step": SITE_STEP,
     "io-data": SITE_DATA,
     "io-read": SITE_IO,
     "io-ckpt": SITE_CHECKPOINT,
@@ -77,7 +83,8 @@ _KIND_SITE = {
 }
 
 _SPEC_RE = re.compile(
-    r"^(?P<kind>raise|sigterm|sigkill|io-data|io-read|io-ckpt|nan-loss)"
+    r"^(?P<kind>raise|sigterm|sigkill-step|sigkill|io-data|io-read|io-ckpt"
+    r"|nan-loss)"
     r"@(?P<lo>\d+)(?:-(?P<hi>\d+))?"
     r"(?:x(?P<count>\d+))?$"
 )
@@ -179,9 +186,10 @@ class FaultInjector:
         if spec.kind == "sigterm":
             os.kill(os.getpid(), signal.SIGTERM)
             return
-        if spec.kind == "sigkill":
-            # uncatchable by design: the replica-death drill must model a
-            # process that VANISHES (OOM kill, node loss), not one that drains
+        if spec.kind in ("sigkill", "sigkill-step"):
+            # uncatchable by design: the replica/host-death drills must model
+            # a process that VANISHES (OOM kill, node loss), not one that
+            # drains
             os.kill(os.getpid(), signal.SIGKILL)
             return
         raise TransientInjectedIOError(

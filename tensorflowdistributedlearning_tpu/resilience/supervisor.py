@@ -19,6 +19,14 @@ preemption (restart after backoff); anything else is a crash (also restarted,
 but the crash-loop detector watches it). Progress is read from the ledger by
 default (the last event carrying a ``step``), so the supervisor needs no
 protocol with its child beyond the workdir.
+
+This class supervises ONE child at a fixed shape. The multi-process
+generalization — N host-slot children, where one death triggers a
+checkpoint-coordinated WORLD RESIZE instead of a same-shape restart — is
+``parallel/elastic.py``'s :class:`ElasticCoordinator`, which composes this
+module's progress/backoff/crash-loop machinery (``ledger_progress``,
+``retry.backoff_delay``, the same restart-budget semantics for
+non-membership crashes).
 """
 
 from __future__ import annotations
@@ -339,3 +347,10 @@ class Supervisor:
 def run_supervised(argv: Sequence[str], **kwargs) -> SupervisorResult:
     """One-shot convenience: ``Supervisor(argv, **kwargs).run()``."""
     return Supervisor(argv, **kwargs).run()
+
+
+def shell_rc(rc: int) -> int:
+    """A Popen returncode as the conventional shell exit status: signal
+    deaths (``-N``) fold to ``128+N`` instead of a negative value the shell
+    would wrap mod 256 — shared by the supervised and elastic CLI paths."""
+    return 128 - rc if rc < 0 else rc

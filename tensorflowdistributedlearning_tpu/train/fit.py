@@ -359,6 +359,14 @@ class ClassifierTrainer:
                     resume_state=self._restored_data_state,
                 )
                 self._data_service = svc
+                if svc.redeal is not None:
+                    # resumed across a world resize (parallel/elastic.py):
+                    # the validated re-deal is part of the run's durable
+                    # story — telemetry-report lines it up with the
+                    # coordinator's world_resize event
+                    tel.event(
+                        "data_redeal", step=start_step, **svc.redeal
+                    )
                 return svc.batches(steps=steps)
             if self._restored_data_state is not None:
                 # the checkpoint was written by a service-fed run (sidecar

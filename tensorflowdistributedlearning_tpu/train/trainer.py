@@ -446,6 +446,7 @@ class Trainer:
         last_eval_time = 0.0
         final_metrics: Dict[str, float] = {}
 
+        data_service = None
         if tcfg.data_service_workers > 0:
             # streaming data service over the in-memory fold (data/service.py
             # ArrayBatchSource): batch assembly moves off the host loop onto
@@ -459,7 +460,14 @@ class Trainer:
 
             svc = service_lib.StreamingDataService(
                 service_lib.ArrayBatchSource(
-                    {"images": train_ds.images, "masks": train_ds.masks}
+                    {"images": train_ds.images, "masks": train_ds.masks},
+                    # the fold arrays were host-sharded for THIS world size:
+                    # stamping it into the resume sidecar makes a resume that
+                    # crossed a world resize an explicit, ledgered re-deal
+                    # (the per-host rows change meaning) instead of a silent
+                    # re-index — the same resize-aware contract as fit()'s
+                    # record path
+                    process_count=jax.process_count(),
                 ),
                 batch_size=local_bs,
                 seed=tcfg.seed + fold,
@@ -470,7 +478,16 @@ class Trainer:
                     if self._telemetry.enabled and tb_train is not None
                     else None
                 ),
+                resume_state=(
+                    ckpt.restore_data_state(start_step)
+                    if start_step > 0 else None
+                ),
             )
+            data_service = svc
+            if svc.redeal is not None:
+                self._telemetry.event(
+                    "data_redeal", step=start_step, fold=fold, **svc.redeal
+                )
             batches = svc.batches(steps=steps - start_step)
         else:
             batches = pipeline_lib.train_batches(
@@ -533,6 +550,16 @@ class Trainer:
         overlap = async_loop.HostOverlap(
             tel, dispatch_ahead=tcfg.dispatch_ahead_steps, emit=emit_window
         )
+
+        def save_data_sidecar(step: int) -> None:
+            # the fold stream's resume state rides every checkpoint (process
+            # 0 writes; seed/batch_index are identical on every host) — the
+            # durable half of the service resume contract, like fit()'s
+            if data_service is not None and is_main:
+                ckpt.save_data_state(
+                    step, data_service.state(step).to_json()
+                )
+
         batches_it = iter(batches)
         _end = object()
         while True:
@@ -565,6 +592,7 @@ class Trainer:
                     pass
                 with tel.span(obs_lib.SPAN_CHECKPOINT):
                     ckpt.save(state, force=True)
+                save_data_sidecar(step_no)
                 tel.checkpoint_event(step_no, fold=fold, preempted=True)
                 tel.event(
                     "preempted",
@@ -613,6 +641,7 @@ class Trainer:
             if saved:
                 overlap.flush()
                 window_dirty = True
+                save_data_sidecar(step_no)
                 tel.checkpoint_event(step_no, fold=fold)
             # eval cadence: an explicit eval_every_steps knob decouples eval from
             # checkpointing AND bypasses the time throttle (explicit user intent,
@@ -648,6 +677,7 @@ class Trainer:
             abort_err = e
         with tel.span(obs_lib.SPAN_CHECKPOINT):
             ckpt.save(state, force=True)
+        save_data_sidecar(step_no)
         tel.checkpoint_event(step_no, fold=fold, final=True)
         if abort_err is not None:
             raise abort_err

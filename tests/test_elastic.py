@@ -1,0 +1,842 @@
+"""Elastic pod-scale training (parallel/elastic.py): eviction-policy state
+machine, coordinator resize machinery on fake children, the data service's
+validated world-resize re-deal, the planner's measured-margin feedback, the
+elastic report/top sections, sentinel gates — and the slow-marked REAL
+multi-process drills: a 2-process gloo fit over record shards (per-epoch
+shard reassignment + the elastic re-deal on a world-1 resume) and the
+headline host-death drill with final params bit-identical to a clean dp−1
+run from the same checkpoint."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.data import records as rec
+from tensorflowdistributedlearning_tpu.data import service as svc
+from tensorflowdistributedlearning_tpu.parallel import elastic
+from tensorflowdistributedlearning_tpu.parallel import planner
+from tensorflowdistributedlearning_tpu.resilience import parse_fault_spec
+from tensorflowdistributedlearning_tpu.resilience.faults import SITE_STEP
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import bench_elastic  # noqa: E402
+import regression_sentinel  # noqa: E402
+
+
+# -- eviction policy ----------------------------------------------------------
+
+
+def _policy(**kw):
+    kw.setdefault("threshold", 1.25)
+    kw.setdefault("sustained", 3)
+    kw.setdefault("cooldown_s", 60.0)
+    kw.setdefault("min_hosts", 1)
+    return elastic.EvictionPolicy(**kw)
+
+
+def test_eviction_fires_only_past_sustained_threshold():
+    p = _policy(sustained=3)
+    alert = {"worst_process": 2, "skew": 1.6}
+    assert p.observe(0.0, 4, 10, alert) is None
+    assert p.observe(1.0, 4, 11, alert) is None
+    assert p.observe(2.0, 4, 12, alert) == 2  # third consecutive window
+
+
+def test_stale_window_not_double_counted():
+    p = _policy(sustained=2)
+    alert = {"worst_process": 1, "skew": 2.0}
+    assert p.observe(0.0, 4, 10, alert) is None
+    # the same window observed again (polls outpace windows): not fresh
+    assert p.observe(1.0, 4, 10, alert) is None
+    assert p.observe(2.0, 4, 10, alert) is None
+    assert p.observe(3.0, 4, 11, alert) == 1
+
+
+def test_flapping_host_never_evicted():
+    """A clean fresh window resets the streak — a host that is slow for
+    sustained-1 windows then recovers never trips the eviction."""
+    p = _policy(sustained=3)
+    alert = {"worst_process": 2, "skew": 1.6}
+    for start in (10, 20, 30):  # three bursts of 2 alerts + 1 clean window
+        assert p.observe(0.0, 4, start, alert) is None
+        assert p.observe(0.0, 4, start + 1, alert) is None
+        assert p.observe(0.0, 4, start + 2, None) is None  # clean: reset
+    # a different worst host also resets the streak
+    assert p.observe(0.0, 4, 40, alert) is None
+    assert p.observe(0.0, 4, 41, {"worst_process": 0, "skew": 1.5}) is None
+    assert p.observe(0.0, 4, 42, alert) is None
+
+
+def test_never_evicts_below_min_hosts():
+    p = _policy(sustained=1, min_hosts=2)
+    alert = {"worst_process": 1, "skew": 3.0}
+    assert p.observe(0.0, 2, 10, alert) is None  # 2 - 1 < min_hosts
+    assert p.observe(0.0, 3, 11, alert) == 1
+
+
+def test_cooldown_blocks_eviction_cascade():
+    p = _policy(sustained=1, cooldown_s=30.0)
+    alert = {"worst_process": 1, "skew": 2.0}
+    assert p.observe(0.0, 4, 10, alert) == 1
+    p.notify_resize(10.0)
+    # after the resize the NEW relative-slowest host alerts immediately (the
+    # resized fleet re-warms) — cooldown must absorb it
+    assert p.observe(20.0, 3, 11, {"worst_process": 0, "skew": 1.9}) is None
+    assert p.observe(45.0, 3, 12, {"worst_process": 0, "skew": 1.9}) == 0
+
+
+def test_skew_at_or_below_threshold_is_clean():
+    p = _policy(sustained=1, threshold=1.5)
+    assert p.observe(0.0, 4, 10, {"worst_process": 1, "skew": 1.5}) is None
+    assert p.observe(0.0, 4, 11, {"worst_process": 1, "skew": 1.51}) == 1
+
+
+# -- coordinator on fake children --------------------------------------------
+
+
+class FakeChild:
+    """Scripted child: ``rc_plan`` is the returncode it will exit with once
+    ``exit_after`` polls elapsed (None = runs until signaled)."""
+
+    _next_pid = 1000
+
+    def __init__(self, rc=None, exit_after=0):
+        FakeChild._next_pid += 1
+        self.pid = FakeChild._next_pid
+        self._rc = rc
+        self._exit_after = exit_after
+        self._polls = 0
+        self.signals = []
+
+    def poll(self):
+        if self._rc is not None:
+            self._polls += 1
+            if self._polls > self._exit_after:
+                return self._rc
+        return None
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+        # the preemption contract: a SIGTERMed child drains with rc 75
+        self._rc = 75
+        self._exit_after = 0
+
+    def kill(self):
+        self.signals.append(signal.SIGKILL)
+        self._rc = -9
+        self._exit_after = 0
+
+
+def _coordinator(tmp_path, script, cfg=None, probe=None, plan_fn=None):
+    """Coordinator over scripted generations: ``script[g]`` is a list of
+    FakeChild factories for generation g (missing generations spawn clean
+    children that exit 0 immediately)."""
+    spawned = []
+
+    def spawn(argv, env):
+        gen = len([s for s in spawned if s[0] == "spawn"])  # not used
+        return None  # replaced below
+
+    calls = {"argv": [], "gen": -1, "idx": 0}
+
+    def argv_fn(world, pid, coord, generation):
+        if generation != calls["gen"]:
+            calls["gen"] = generation
+            calls["idx"] = 0
+        calls["argv"].append(
+            {"world": world, "pid": pid, "coord": coord, "gen": generation}
+        )
+        return ["child", str(world), str(pid)]
+
+    children = []
+
+    def spawn(argv, env):  # noqa: F811 — the real fake
+        gen = calls["gen"]
+        plan = script.get(gen, [])
+        idx = calls["idx"]
+        calls["idx"] += 1
+        child = plan[idx]() if idx < len(plan) else FakeChild(rc=0)
+        children.append(child)
+        return child
+
+    cfg = cfg or elastic.ElasticConfig(
+        hosts=2,
+        min_hosts=1,
+        poll_interval_s=0.0,
+        straggler_poll_s=0.0,
+        drain_timeout_s=0.5,
+        backoff_base_s=0.0,
+        backoff_max_s=0.0,
+        heartbeat_timeout_s=0.0,
+    )
+    coord = elastic.ElasticCoordinator(
+        argv_fn,
+        str(tmp_path),
+        cfg,
+        spawn=spawn,
+        straggler_probe=probe or (lambda world: (None, None)),
+        plan_fn=plan_fn,
+        sleep=lambda s: None,
+    )
+    return coord, calls, children
+
+
+def _events(tmp_path):
+    out = []
+    path = os.path.join(str(tmp_path), "telemetry.jsonl")
+    if os.path.exists(path):
+        for line in open(path, encoding="utf-8"):
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                pass
+    return out
+
+
+def test_coordinator_clean_world_completes(tmp_path):
+    coord, calls, _ = _coordinator(
+        tmp_path, {0: [lambda: FakeChild(rc=0), lambda: FakeChild(rc=0)]}
+    )
+    result = coord.run()
+    assert result.ok and result.resizes == 0 and result.world_size == 2
+    kinds = [e["event"] for e in _events(tmp_path)]
+    assert kinds[0] == "elastic_start" and kinds[-1] == "elastic_end"
+    assert "world_resize" not in kinds
+    # both slots spawned with the coordinator address set (world > 1)
+    assert [c["pid"] for c in calls["argv"]] == [0, 1]
+    assert all(c["coord"] for c in calls["argv"])
+
+
+def test_host_death_resizes_to_smaller_world(tmp_path):
+    """A SIGKILLed child (rc -9) triggers drain + resize: the next
+    generation spawns world-1 children (single host ⇒ no coordinator
+    address), and the ledger carries the world_resize with the plan delta."""
+    script = {
+        # host 1 vanishes after a few polls; host 0 keeps running until the
+        # drain SIGTERMs it (FakeChild then exits 75 — the preempt contract)
+        0: [lambda: FakeChild(), lambda: FakeChild(rc=-9, exit_after=2)],
+        1: [lambda: FakeChild(rc=0)],
+    }
+    plans = []
+
+    def plan_fn(world, margin):
+        plans.append((world, margin))
+        return {
+            "layout": {"data_parallel": world},
+            "predicted": {"total_bytes_per_chip": 1000 * world},
+        }
+
+    coord, calls, children = _coordinator(tmp_path, script, plan_fn=plan_fn)
+    result = coord.run()
+    assert result.ok and result.resizes == 1 and result.world_size == 1
+    gen1 = [c for c in calls["argv"] if c["gen"] == 1]
+    assert [c["world"] for c in gen1] == [1]
+    assert gen1[0]["coord"] is None  # single-host world: no cluster
+    # the survivor was drained with SIGTERM
+    assert signal.SIGTERM in children[0].signals
+    resize = [e for e in _events(tmp_path) if e["event"] == "world_resize"]
+    assert len(resize) == 1
+    assert resize[0]["old_world"] == 2 and resize[0]["new_world"] == 1
+    assert resize[0]["reason"] == "host_death"
+    assert resize[0]["process_index"] == 1
+    assert resize[0]["evicted_process"] is None
+    assert resize[0]["rc"] == 137  # folded SIGKILL
+    assert resize[0]["plan_old"]["layout"]["data_parallel"] == 2
+    assert resize[0]["plan_new"]["layout"]["data_parallel"] == 1
+    assert plans == [(2, None), (1, None)]
+
+
+def test_resize_below_min_hosts_aborts(tmp_path):
+    cfg = elastic.ElasticConfig(
+        hosts=2, min_hosts=2, poll_interval_s=0.0, drain_timeout_s=0.5,
+        backoff_base_s=0.0, heartbeat_timeout_s=0.0,
+    )
+    script = {0: [lambda: FakeChild(), lambda: FakeChild(rc=-9)]}
+    coord, _, _ = _coordinator(tmp_path, script, cfg=cfg)
+    result = coord.run()
+    assert not result.ok and result.aborted == elastic.ABORT_MIN_HOSTS
+    kinds = [e["event"] for e in _events(tmp_path)]
+    assert "elastic_abort" in kinds and "world_resize" not in kinds
+
+
+def test_plain_crash_restarts_same_shape(tmp_path):
+    """A nonzero (non-SIGKILL) exit is a crash, not a host loss: the world
+    respawns at the SAME size under the restart budget."""
+    script = {
+        0: [lambda: FakeChild(), lambda: FakeChild(rc=1, exit_after=1)],
+        1: [lambda: FakeChild(rc=0), lambda: FakeChild(rc=0)],
+    }
+    coord, calls, _ = _coordinator(tmp_path, script)
+    result = coord.run()
+    assert result.ok and result.resizes == 0 and result.restarts == 1
+    assert result.world_size == 2
+    gen1 = [c for c in calls["argv"] if c["gen"] == 1]
+    assert [c["world"] for c in gen1] == [2, 2]
+    kinds = [e["event"] for e in _events(tmp_path)]
+    assert "restart" in kinds and "world_resize" not in kinds
+
+
+def test_progressless_resizes_do_not_feed_crash_loop(tmp_path):
+    """Two quick host deaths before any ledger progress (normal spot churn
+    during warm-up) must not pre-charge the crash-loop counter: the first
+    ORDINARY crash afterwards still gets its same-shape restart."""
+    cfg = elastic.ElasticConfig(
+        hosts=3, min_hosts=1, poll_interval_s=0.0, straggler_poll_s=0.0,
+        drain_timeout_s=0.5, backoff_base_s=0.0, heartbeat_timeout_s=0.0,
+    )
+    script = {
+        0: [lambda: FakeChild(), lambda: FakeChild(),
+            lambda: FakeChild(rc=-9, exit_after=1)],
+        1: [lambda: FakeChild(), lambda: FakeChild(rc=-9, exit_after=1)],
+        2: [lambda: FakeChild(rc=1, exit_after=1)],
+        3: [lambda: FakeChild(rc=0)],
+    }
+    coord, _, _ = _coordinator(tmp_path, script, cfg=cfg)
+    result = coord.run()
+    assert result.ok, result
+    assert result.resizes == 2 and result.restarts == 1
+    assert result.aborted is None
+
+
+def test_crash_loop_aborts(tmp_path):
+    script = {
+        g: [lambda: FakeChild(rc=1), lambda: FakeChild(rc=1)]
+        for g in range(6)
+    }
+    coord, _, _ = _coordinator(tmp_path, script)
+    result = coord.run()
+    assert not result.ok
+    assert result.aborted == elastic.ABORT_CRASH_LOOP
+
+
+def test_straggler_eviction_resizes_with_events(tmp_path):
+    """The live probe path: sustained fresh alerts on host 1 evict it —
+    host_evicted + world_resize(straggler_evicted) land in the ledger and
+    the next generation runs the smaller world."""
+    steps = iter(range(100, 200))
+
+    def probe(world):
+        return next(steps), {"worst_process": 1, "skew": 1.8}
+
+    cfg = elastic.ElasticConfig(
+        hosts=2, min_hosts=1, poll_interval_s=0.0, straggler_poll_s=0.0,
+        straggler_sustained=2, drain_timeout_s=0.5, backoff_base_s=0.0,
+        heartbeat_timeout_s=0.0,
+    )
+    script = {
+        0: [lambda: FakeChild(), lambda: FakeChild()],
+        1: [lambda: FakeChild(rc=0)],
+    }
+    coord, calls, children = _coordinator(
+        tmp_path, script, cfg=cfg, probe=probe
+    )
+    result = coord.run()
+    assert result.ok and result.resizes == 1 and result.evictions == 1
+    events = _events(tmp_path)
+    evicted = [e for e in events if e["event"] == "host_evicted"]
+    assert len(evicted) == 1 and evicted[0]["process_index"] == 1
+    resize = [e for e in events if e["event"] == "world_resize"][0]
+    assert resize["reason"] == "straggler_evicted"
+    assert resize["evicted_process"] == 1
+    # EVERY host was drained cooperatively (eviction keeps collectives live)
+    assert signal.SIGTERM in children[0].signals
+    assert signal.SIGTERM in children[1].signals
+
+
+def test_ledger_straggler_probe_reads_current_world(tmp_path):
+    """The default probe merges per-process ledgers, returns the newest
+    cross-compared step and the alert at it, and excludes stale ledgers of
+    slots outside the current world."""
+    def write(path, proc, step_ms):
+        with open(os.path.join(str(tmp_path), path), "w") as f:
+            f.write(json.dumps({
+                "event": "run_header", "t": 1.0, "process_index": proc,
+            }) + "\n")
+            for step, ms in step_ms:
+                f.write(json.dumps({
+                    "event": "step_window", "t": 2.0, "step": step,
+                    "steps": 1, "step_time_ms": {"mean_ms": ms},
+                }) + "\n")
+
+    write("telemetry.jsonl", 0, [(1, 100.0), (2, 100.0)])
+    write("telemetry-1.jsonl", 1, [(1, 100.0), (2, 250.0)])
+    # a stale third ledger with absurd skew must be ignored at world 2
+    write("telemetry-2.jsonl", 2, [(1, 9000.0), (2, 9000.0)])
+    step, alert = elastic.ledger_straggler_probe(
+        str(tmp_path), 2, threshold=1.25
+    )
+    assert step == 2
+    # skew = worst / median; the 2-host median averages (100, 250) -> 175
+    assert alert == {"worst_process": 1, "skew": 1.429}
+    # at the full world the stale host dominates
+    step3, alert3 = elastic.ledger_straggler_probe(
+        str(tmp_path), 3, threshold=1.25
+    )
+    assert step3 == 2 and alert3["worst_process"] == 2
+
+
+# -- data service: validated world-resize re-deal -----------------------------
+
+
+def _shards(tmp_path, n=40, shards=3, hw=12, classes=5, seed=1):
+    rng = np.random.default_rng(seed)
+    images = [
+        rng.integers(0, 255, (hw, hw, 3), dtype=np.uint8) for _ in range(n)
+    ]
+    labels = list(rng.integers(0, classes, n))
+    return rec.write_classification_shards(
+        str(tmp_path), images, labels, shards=shards
+    )
+
+
+def _source(paths, process_index=0, process_count=1):
+    return svc.ClassificationRecordSource(
+        paths, image_shape=(12, 12), channels=3,
+        process_index=process_index, process_count=process_count,
+    )
+
+
+def test_redeal_accepts_changed_process_count(tmp_path):
+    paths = _shards(tmp_path)
+    old = svc.StreamingDataService(
+        _source(paths, 0, 2), batch_size=8, seed=7, workers=1, start_batch=4,
+    )
+    sidecar = old.state(4).to_json()
+    old.close()
+    assert sidecar["process_count"] == 2
+    resumed = svc.StreamingDataService(
+        _source(paths, 0, 1), batch_size=8, seed=7, workers=1, start_batch=4,
+        resume_state=sidecar,
+    )
+    assert resumed.redeal == {
+        "old_process_count": 2, "new_process_count": 1, "batch_index": 4,
+    }
+    # the re-dealt stream is EXACTLY the stream a clean world-1 service
+    # produces from the same (seed, batch_index) — the bit-identity half
+    fresh = svc.StreamingDataService(
+        _source(paths, 0, 1), batch_size=8, seed=7, workers=1, start_batch=4,
+    )
+    for a, b in zip(resumed.batches(steps=4), fresh.batches(steps=4)):
+        assert np.array_equal(a["images"], b["images"])
+        assert np.array_equal(a["labels"], b["labels"])
+
+
+def test_redeal_still_refuses_real_mismatches(tmp_path):
+    paths = _shards(tmp_path)
+    service = svc.StreamingDataService(
+        _source(paths, 0, 2), batch_size=8, seed=7, workers=1, start_batch=4,
+    )
+    sidecar = service.state(4).to_json()
+    service.close()
+    # wrong seed and wrong per-host batch still refuse even across a resize
+    with pytest.raises(ValueError, match="resume state mismatch"):
+        svc.StreamingDataService(
+            _source(paths, 0, 1), batch_size=8, seed=8, workers=1,
+            start_batch=4, resume_state=sidecar,
+        )
+    with pytest.raises(ValueError, match="resume state mismatch"):
+        svc.StreamingDataService(
+            _source(paths, 0, 1), batch_size=16, seed=7, workers=1,
+            start_batch=4, resume_state=sidecar,
+        )
+    # changed shard SET refuses (re-sharding is not a world resize)
+    with pytest.raises(ValueError, match="resume state mismatch"):
+        svc.StreamingDataService(
+            _source(paths[:-1], 0, 1), batch_size=8, seed=7, workers=1,
+            start_batch=4, resume_state=sidecar,
+        )
+    # unchanged world: no redeal flagged
+    ok = svc.StreamingDataService(
+        _source(paths, 0, 2), batch_size=8, seed=7, workers=1, start_batch=4,
+        resume_state=sidecar,
+    )
+    assert ok.redeal is None
+    ok.close()
+
+
+def test_array_source_carries_world_identity():
+    source = svc.ArrayBatchSource(
+        {"x": np.zeros((6, 2), np.float32)}, process_count=2
+    )
+    service = svc.StreamingDataService(
+        source, batch_size=2, seed=1, workers=1
+    )
+    assert service.state(0).process_count == 2
+    service.close()
+
+
+# -- planner: measured-margin feedback ---------------------------------------
+
+
+def _sds(shape, dtype=np.float32):
+    import jax
+
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def _margin_profile():
+    count = 8 * 4
+    return planner.ModelProfile(
+        params={"w": _sds((8, 4))},
+        batch_stats={},
+        opt_state={"mu": _sds((8, 4))},
+        activation_bytes_per_example=0,
+        param_count=count,
+    )
+
+
+def test_measured_margin_tightens_budget():
+    from tensorflowdistributedlearning_tpu.config import ModelConfig, TrainConfig
+
+    cfg = ModelConfig(
+        num_classes=10, input_shape=(32, 32), input_channels=3,
+        n_blocks=(1, 1, 1), base_depth=8, width_multiplier=0.0625,
+        output_stride=None,
+    )
+    topo = planner.Topology(n_devices=8, local_device_count=8)
+    profile = _margin_profile()
+    # budget that fits every layout without margin (params+opt = 256 B)
+    plan = planner.plan(
+        cfg, TrainConfig(), 64, topology=topo, profile=profile,
+        hbm_bytes_per_device=1000,
+    )
+    assert plan.chosen.feasible
+    assert "measured_margin_bytes" not in (plan.chosen.bytes or {})
+    # a measured residual bigger than the budget rejects everything
+    with pytest.raises(planner.PlanError, match=planner.REJECT_BUDGET):
+        planner.plan(
+            cfg, TrainConfig(), 64, topology=topo, profile=profile,
+            hbm_bytes_per_device=1000, measured_margin_bytes=2000,
+        )
+    # a margin that still fits rides the candidate's bytes + headroom
+    plan = planner.plan(
+        cfg, TrainConfig(), 64, topology=topo, profile=profile,
+        hbm_bytes_per_device=1000, measured_margin_bytes=100,
+    )
+    assert plan.chosen.bytes["measured_margin_bytes"] == 100
+    assert plan.chosen.bytes["total_bytes_per_chip"] >= 100
+
+
+def test_measured_margin_from_workdir(tmp_path):
+    from tensorflowdistributedlearning_tpu.obs.ledger import RunLedger
+
+    assert planner.measured_margin_from_workdir(str(tmp_path)) is None
+    ledger = RunLedger(str(tmp_path))
+    ledger.event("run_header", process_index=0)
+    ledger.event(
+        "memory_watermark", phase="step", peak_bytes=1000,
+        predicted_bytes_per_device=800, measured_minus_predicted_bytes=200,
+    )
+    ledger.close()
+    assert planner.measured_margin_from_workdir(str(tmp_path)) == 200
+    # the fleet-wide WORST residual wins; negative residuals clamp to 0
+    ledger = RunLedger(str(tmp_path), filename="telemetry-1.jsonl")
+    ledger.event("run_header", process_index=1)
+    ledger.event(
+        "memory_watermark", phase="step", peak_bytes=1500,
+        measured_minus_predicted_bytes=450,
+    )
+    ledger.close()
+    assert planner.measured_margin_from_workdir(str(tmp_path)) == 450
+
+
+# -- fault spec ---------------------------------------------------------------
+
+
+def test_sigkill_step_fault_spec():
+    spec = parse_fault_spec("sigkill-step@6")
+    assert spec.kind == "sigkill-step" and spec.at == 6
+    assert spec.site == SITE_STEP
+    # the serve-side sigkill kind still parses as before
+    assert parse_fault_spec("sigkill@30").site != SITE_STEP
+
+
+# -- report / top -------------------------------------------------------------
+
+
+def _elastic_history():
+    t = [0.0]
+
+    def ev(kind, **fields):
+        t[0] += 1.0
+        return {"event": kind, "t": t[0], **fields}
+
+    return [
+        ev("elastic_start", hosts=3, min_hosts=1),
+        ev("run_header", process_index=0),
+        ev("world_resize", old_world=3, new_world=2, reason="host_death",
+           progress_step=7, downtime_s=4.5,
+           plan_old={"layout": {"data_parallel": 3}},
+           plan_new={"layout": {"data_parallel": 2}}),
+        ev("host_evicted", process_index=1, skew=1.8, world_size=2, step=20),
+        ev("world_resize", old_world=2, new_world=1,
+           reason="straggler_evicted", evicted_process=1, progress_step=20,
+           downtime_s=2.5),
+        ev("data_redeal", step=20, old_process_count=2, new_process_count=1),
+        ev("elastic_end", ok=True, world_size=1, resizes=2, restarts=0,
+           evictions=1, resize_downtime_s=7.0),
+    ]
+
+
+def test_elastic_report_section():
+    from tensorflowdistributedlearning_tpu.obs import report as report_lib
+
+    section = report_lib._elastic_section(_elastic_history())
+    assert section["hosts"] == 3 and section["world_size"] == 1
+    assert section["resizes"] == 2 and section["evictions"] == 1
+    assert section["data_redeals"] == 1
+    assert section["resize_downtime_s"] == 7.0
+    assert section["ok"] is True and section["live"] is False
+    reasons = [e["reason"] for e in section["resize_events"]]
+    assert reasons == ["host_death", "straggler_evicted"]
+    assert section["resize_events"][1]["evicted_process"] == 1
+    # no elastic history -> no section
+    assert report_lib._elastic_section(
+        [{"event": "run_header", "t": 0.0}]
+    ) is None
+
+
+def test_elastic_report_renders(tmp_path):
+    """End to end through build_report/render_report on a synthesized
+    workdir ledger."""
+    from tensorflowdistributedlearning_tpu.obs import report as report_lib
+    from tensorflowdistributedlearning_tpu.obs.ledger import RunLedger
+
+    ledger = RunLedger(str(tmp_path))
+    for e in _elastic_history():
+        kind = e.pop("event")
+        e.pop("t")
+        ledger.event(kind, **e)
+    ledger.close()
+    report = report_lib.build_report(str(tmp_path))
+    assert report["elastic"]["resizes"] == 2
+    rendered = report_lib.render_report(report)
+    assert "elastic: world 3 -> 1" in rendered
+    assert "straggler_evicted" in rendered
+    assert "evicted host 1" in rendered
+
+
+def test_top_frame_carries_elastic_row(tmp_path):
+    from tensorflowdistributedlearning_tpu.obs import top as top_lib
+    from tensorflowdistributedlearning_tpu.obs.ledger import RunLedger
+
+    ledger = RunLedger(str(tmp_path))
+    ledger.event("run_header", process_index=0)
+    ledger.event("elastic_start", hosts=2, min_hosts=1)
+    ledger.event("world_resize", old_world=2, new_world=1,
+                 reason="host_death", downtime_s=1.5)
+    ledger.close()
+    frame = top_lib.build_frame(str(tmp_path))
+    assert frame["elastic"]["world_size"] == 1
+    assert frame["elastic"]["live"] is True
+    rendered = top_lib.render_frame(frame)
+    assert "elastic: world 1/2 [LIVE]" in rendered
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def test_fit_parser_accepts_elastic_flags():
+    from tensorflowdistributedlearning_tpu import cli
+
+    args = cli.build_parser().parse_args([
+        "fit", "--preset", "elastic_smoke", "--model-dir", "/tmp/x",
+        "--elastic", "2", "--min-hosts", "1", "--devices-per-host", "2",
+        "--host-inject-fault", "1:sigkill-step@6",
+    ])
+    assert args.elastic == 2 and args.min_hosts == 1
+    assert args.host_inject_fault == ["1:sigkill-step@6"]
+    assert args.coordinator_address is None
+
+
+def test_strip_elastic_flags_removes_coordinator_knobs():
+    from tensorflowdistributedlearning_tpu import cli
+
+    argv = [
+        "fit", "--preset", "p", "--model-dir", "m", "--elastic", "2",
+        "--min-hosts=1", "--batch-size", "16", "--no-straggler-evict",
+        "--host-inject-fault", "1:sigkill-step@6", "--steps", "30",
+        "--weight-update-sharding",
+    ]
+    assert cli._strip_elastic_flags(argv) == [
+        "fit", "--preset", "p", "--model-dir", "m", "--steps", "30",
+        "--weight-update-sharding",
+    ]
+
+
+def test_parse_host_faults_validates():
+    from tensorflowdistributedlearning_tpu import cli
+
+    assert cli._parse_host_faults(["1:sigkill-step@6", "0:raise@3"]) == {
+        1: "sigkill-step@6", 0: "raise@3",
+    }
+    with pytest.raises(SystemExit):
+        cli._parse_host_faults(["nonsense"])
+    with pytest.raises(ValueError):
+        cli._parse_host_faults(["1:bogus@2"])
+
+
+# -- sentinel -----------------------------------------------------------------
+
+
+def test_sentinel_elastic_passes_on_committed_baseline():
+    rc = regression_sentinel.main(["--check", "--benches", "elastic"])
+    assert rc == 0
+
+
+def test_sentinel_elastic_fails_on_injected_regressions(tmp_path):
+    with open(os.path.join(REPO, "BENCH_ELASTIC.json")) as f:
+        record = json.load(f)
+    bad = dict(record, bit_identical_resume=False)
+    fresh = tmp_path / "bad.json"
+    fresh.write_text(json.dumps(bad))
+    rc = regression_sentinel.main([
+        "--check", "--benches", "elastic", "--fresh-elastic", str(fresh),
+    ])
+    assert rc == 1
+    # a drill that never resized must also fail
+    bad = dict(record)
+    bad["resize"] = dict(record["resize"], new_world=record["resize"]["old_world"])
+    fresh.write_text(json.dumps(bad))
+    rc = regression_sentinel.main([
+        "--check", "--benches", "elastic", "--fresh-elastic", str(fresh),
+    ])
+    assert rc == 1
+
+
+def test_bench_check_record_gates():
+    with open(os.path.join(REPO, "BENCH_ELASTIC.json")) as f:
+        record = json.load(f)
+    assert bench_elastic.check_record(
+        record, max_downtime_s=60.0, min_throughput_ratio=0.4
+    ) == []
+    broken = dict(record, bit_identical_resume=False)
+    failures = bench_elastic.check_record(
+        broken, max_downtime_s=60.0, min_throughput_ratio=0.4
+    )
+    assert any("bit_identical" in f for f in failures)
+
+
+# -- REAL multi-process drills (slow) -----------------------------------------
+
+
+def _gloo_unavailable():
+    try:
+        import jax
+
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        return False
+    except Exception:  # noqa: BLE001
+        return True
+
+
+@pytest.mark.slow
+def test_two_process_fit_over_records_with_redeal_resume(tmp_path):
+    """PR 12 follow-on made REAL: a 2-process gloo ``fit`` over record
+    shards through the streaming data service (per-epoch shard reassignment
+    exercised across >= 2 epochs), then a WORLD-1 resume of the same workdir
+    — the elastic re-deal through the plain CLI (process_count 2 -> 1,
+    ledgered ``data_redeal``), completing to the target step."""
+    if _gloo_unavailable():
+        pytest.skip("gloo CPU collectives unavailable")
+    data_dir = str(tmp_path / "data")
+    model_dir = str(tmp_path / "m")
+    os.makedirs(data_dir)
+    bench_elastic.write_drill_shards(data_dir, n=40, shards=3)
+
+    def run_fit(steps, world, extra):
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        )
+        argv_base = [
+            sys.executable, "-m", "tensorflowdistributedlearning_tpu",
+            "fit", "--preset", "elastic_smoke", "--model-dir", model_dir,
+            "--data-dir", data_dir, "--steps", str(steps),
+            "--batch-size", str(4 * world), "--eval-every", "100000",
+        ]
+        if world == 1:
+            return [subprocess.run(
+                argv_base + extra, env=env, capture_output=True, text=True,
+                timeout=420,
+            )]
+        port = elastic.free_port()
+        procs = [
+            subprocess.Popen(
+                argv_base + extra + [
+                    "--coordinator-address", f"127.0.0.1:{port}",
+                    "--num-processes", str(world), "--process-id", str(pid),
+                ],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            )
+            for pid in range(world)
+        ]
+        outs = []
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=420)
+            outs.append(subprocess.CompletedProcess(
+                p.args, p.returncode, stdout, stderr
+            ))
+        return outs
+
+    # 2-process fit across >= 2 epochs (40 records / 2 hosts ~ 20/epoch per
+    # host; 10 steps x 4 = 40 virtual records per host)
+    outs = run_fit(10, 2, [])
+    for out in outs:
+        assert out.returncode == 0, out.stderr[-1200:]
+    assert os.path.exists(os.path.join(model_dir, "telemetry.jsonl"))
+    assert os.path.exists(os.path.join(model_dir, "telemetry-1.jsonl"))
+    # world-1 resume of the same workdir: validated re-deal, not a refusal
+    outs = run_fit(14, 1, [])
+    assert outs[0].returncode == 0, outs[0].stderr[-1200:]
+    events = []
+    for line in open(os.path.join(model_dir, "telemetry.jsonl")):
+        try:
+            events.append(json.loads(line))
+        except ValueError:
+            pass
+    redeals = [e for e in events if e.get("event") == "data_redeal"]
+    assert redeals and redeals[-1]["old_process_count"] == 2
+    assert redeals[-1]["new_process_count"] == 1
+    resumed = [e for e in events if e.get("event") == "resumed"]
+    assert resumed and resumed[-1]["step"] == 10
+
+
+@pytest.mark.slow
+def test_headline_host_death_drill_bit_identical(tmp_path):
+    """THE acceptance drill: SIGKILL one host of a 2-process elastic run
+    (ZeRO-1 on, record shards through the data service) → coordinated drain
+    → planner re-plan at dp−1 → resume with optimizer state resharded and
+    the shard plan re-dealt → final params BIT-IDENTICAL to a clean dp−1
+    run from the same checkpoint."""
+    if _gloo_unavailable():
+        pytest.skip("gloo CPU collectives unavailable")
+    data_dir = str(tmp_path / "data")
+    drill_dir = str(tmp_path / "drill")
+    golden_dir = str(tmp_path / "golden")
+    os.makedirs(data_dir)
+    bench_elastic.write_drill_shards(data_dir)
+    drill = bench_elastic.run_elastic_drill(
+        drill_dir, data_dir, steps=12, kill_step=8, devices_per_host=2,
+    )
+    resize = drill["resize"]
+    assert resize["old_world"] == 2 and resize["new_world"] == 1
+    assert resize["reason"] == "host_death"
+    assert drill["redeals"] >= 1
+    bench_elastic.run_clean_comparison(
+        golden_dir, data_dir, drill_dir, drill["resume_step"],
+        steps=12, new_world=1, devices_per_host=2,
+    )
+    a = bench_elastic.params_digest(drill_dir)
+    b = bench_elastic.params_digest(golden_dir)
+    assert a["step"] == 12
+    assert a == b, f"elastic resume diverged from the clean dp-1 oracle: {a} vs {b}"

@@ -403,6 +403,78 @@ def check_plan(
     return out
 
 
+# elastic gates: all dimensionless/hard (replay-only, like fleet/promotion —
+# the full drill spawns real multi-process worlds, too heavy for every CI
+# run); the downtime ceiling applies to the committed record's own box
+DEFAULT_ELASTIC_DOWNTIME_CEILING_S = 120.0
+DEFAULT_ELASTIC_THROUGHPUT_FLOOR = 0.4
+
+
+def check_elastic(
+    baseline: Dict,
+    fresh: Optional[Dict] = None,
+    *,
+    downtime_ceiling_s: float = DEFAULT_ELASTIC_DOWNTIME_CEILING_S,
+    throughput_floor: float = DEFAULT_ELASTIC_THROUGHPUT_FLOOR,
+) -> List[Dict]:
+    """Replay the committed BENCH_ELASTIC.json hard gates
+    (tools/bench_elastic.py output shape): the headline host-death drill
+    must have actually RESIZED the world (old != new, reason host_death) and
+    resumed with final params BIT-IDENTICAL to a clean dp−1 run from the
+    same checkpoint — the whole point of elastic training; the measured
+    resize downtime must clear the ceiling and the per-chip throughput must
+    survive the resize. An elastic-path PR must re-run the bench and commit
+    numbers that still clear these. ``--fresh-elastic`` gates a fresh record
+    instead."""
+    record = fresh if fresh is not None else baseline
+    out: List[Dict] = []
+    out.append(_finding(
+        "elastic", "bit_identical_resume", True,
+        record.get("bit_identical_resume"),
+        "== true (elastic resume must equal a clean same-world resume, hard)",
+        bool(record.get("bit_identical_resume")),
+    ))
+    resize = record.get("resize") or {}
+    resized = (
+        resize.get("old_world") is not None
+        and resize.get("old_world") != resize.get("new_world")
+    )
+    out.append(_finding(
+        "elastic", "resize.world_changed", True,
+        f"{resize.get('old_world')}->{resize.get('new_world')}",
+        "old_world != new_world (the drill must actually resize, hard)",
+        resized,
+    ))
+    out.append(_finding(
+        "elastic", "resize.reason", "host_death", resize.get("reason"),
+        "== host_death (the drill kills a host, hard)",
+        resize.get("reason") == "host_death",
+    ))
+    downtime = record.get("resize_downtime_s")
+    out.append(_finding(
+        "elastic", "resize_downtime_s", downtime_ceiling_s, downtime,
+        f"<= {downtime_ceiling_s}s (drain + re-plan + respawn)",
+        downtime is not None and downtime <= downtime_ceiling_s,
+    ))
+    ratio = (record.get("throughput_per_chip") or {}).get("after_over_before")
+    if ratio is not None:
+        out.append(_finding(
+            "elastic", "throughput_per_chip.after_over_before",
+            throughput_floor, ratio,
+            f">= {throughput_floor} (per-chip efficiency survives the "
+            "resize)",
+            ratio >= throughput_floor,
+        ))
+    redeals = record.get("data_redeals")
+    if redeals is not None:
+        out.append(_finding(
+            "elastic", "data_redeals", ">= 1", redeals,
+            ">= 1 (the resumed world re-dealt the shard assignment, hard)",
+            redeals >= 1,
+        ))
+    return out
+
+
 def check_promotion(
     baseline: Dict,
     fresh: Optional[Dict] = None,
@@ -518,7 +590,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "mode; the flag exists so the CI step reads as a "
                         "gate)")
     parser.add_argument("--benches",
-                        default="async,serve,fleet,records,promotion,plan",
+                        default="async,serve,fleet,records,promotion,plan,"
+                        "elastic",
                         help="comma-separated subset to check")
     parser.add_argument("--baseline-async",
                         default=os.path.join(REPO, "BENCH_ASYNC.json"))
@@ -528,6 +601,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                         default=os.path.join(REPO, "RECORDS_BENCH.json"))
     parser.add_argument("--baseline-plan",
                         default=os.path.join(REPO, "BENCH_PLAN.json"))
+    parser.add_argument("--baseline-elastic",
+                        default=os.path.join(REPO, "BENCH_ELASTIC.json"))
+    parser.add_argument("--fresh-elastic", default=None, metavar="JSON",
+                        help="pre-computed tools/bench_elastic.py output "
+                        "(default: replay the committed baseline's gates, "
+                        "like the fleet section)")
+    parser.add_argument("--elastic-downtime-ceiling", type=float,
+                        default=DEFAULT_ELASTIC_DOWNTIME_CEILING_S,
+                        help="resize downtime ceiling on the elastic bench "
+                        "record (seconds; applies to the committed record's "
+                        "own box)")
+    parser.add_argument("--elastic-throughput-floor", type=float,
+                        default=DEFAULT_ELASTIC_THROUGHPUT_FLOOR,
+                        help="floor on the elastic bench's per-chip "
+                        "throughput after/before ratio")
     parser.add_argument("--fresh-plan", default=None, metavar="JSON",
                         help="pre-computed bench.py --plan output (default: "
                         "replay the committed baseline's gates, like the "
@@ -632,6 +720,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         except (OSError, ValueError) as e:
             errors.append(f"plan: {e}")
+    if "elastic" in benches:
+        try:
+            baseline = _load(args.baseline_elastic)
+            fresh = _load(args.fresh_elastic) if args.fresh_elastic else None
+            findings += check_elastic(
+                baseline, fresh,
+                downtime_ceiling_s=args.elastic_downtime_ceiling,
+                throughput_floor=args.elastic_throughput_floor,
+            )
+        except (OSError, ValueError) as e:
+            errors.append(f"elastic: {e}")
     if "records" in benches:
         try:
             baseline = _load(args.baseline_records)
