@@ -94,6 +94,14 @@ def _add_observability(p: argparse.ArgumentParser) -> None:
                    "training), abort (alert then stop at a recorded "
                    "boundary), off; default: the config's (warn). Drill "
                    "with --inject-fault nan-loss@N")
+    p.add_argument("--profile-every-windows", type=int, default=None,
+                   help="continuous profiling cadence: capture a short "
+                   "windowed jax.profiler trace every N log windows, parse "
+                   "it into a per-op roofline, and ledger profile_capture/"
+                   "op_roofline events (obs/profiler.py). 0 disables (the "
+                   "config default); overhead is gated <=2%% by `bench.py "
+                   "--profile-overhead`. Alert-triggered postmortem "
+                   "captures fire regardless of this cadence")
 
 
 def _add_planner(p: argparse.ArgumentParser) -> None:
@@ -386,6 +394,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "workdir and add it to every candidate's budget "
                         "check (what the elastic coordinator does "
                         "automatically on re-plan)")
+    p_plan.add_argument("--measured-costs-from", default=None,
+                        metavar="WORKDIR",
+                        help="close the cost-model feedback loop: score "
+                        "candidates with the achieved FLOP/s and collective "
+                        "bytes/s from this prior run's ledgered op_roofline "
+                        "events (profile once with --profile-every-windows, "
+                        "plan better forever after) instead of the analytic "
+                        "peak-FLOPs table + ICI constant; the table then "
+                        "shows measured vs analytic scores side by side and "
+                        "the provenance rides the run header. Exits 2 when "
+                        "the workdir has no roofline events")
     p_plan.add_argument("--json", action="store_true",
                         help="full machine-readable plan (chosen layout + "
                         "every candidate's verdict) instead of the table")
@@ -749,6 +768,8 @@ def _trainer(args):
         overlap["trace_sample_rate"] = args.trace_sample_rate
     if getattr(args, "nan_guard", None) is not None:
         overlap["nan_guard"] = args.nan_guard
+    if getattr(args, "profile_every_windows", None) is not None:
+        overlap["profile_every_windows"] = args.profile_every_windows
     tcfg = TrainConfig(
         lr=getattr(args, "lr", 0.001),
         n_devices=args.n_devices,
@@ -1018,6 +1039,7 @@ def cmd_fit(args) -> int:
         data_service_workers=args.data_workers,
         trace_sample_rate=args.trace_sample_rate,
         nan_guard=args.nan_guard,
+        profile_every_windows=args.profile_every_windows,
         parallelism=args.parallelism,
         hbm_budget_gb=args.hbm_budget_gb,
     )
@@ -1100,9 +1122,27 @@ def cmd_plan(args) -> int:
                 "planning without margin",
                 file=sys.stderr,
             )
+    measured_costs = None
+    if args.measured_costs_from:
+        measured_costs = planner_lib.measured_costs_from_workdir(
+            args.measured_costs_from
+        )
+        if measured_costs is None:
+            # same contract as telemetry-report on a missing ledger: rc 2
+            # plus a one-line hint — measured costs were asked for and none
+            # exist, so silently falling back would misprice every candidate
+            print(
+                f"plan: no op_roofline events under "
+                f"{args.measured_costs_from} — run with "
+                "--profile-every-windows N to ledger roofline captures, "
+                "then re-plan",
+                file=sys.stderr,
+            )
+            return 2
     try:
         result = planner_lib.plan(
-            mcfg, tcfg, batch, pinned=pinned, measured_margin_bytes=margin
+            mcfg, tcfg, batch, pinned=pinned, measured_margin_bytes=margin,
+            measured_costs=measured_costs,
         )
     except planner_lib.PlanError as e:
         print(f"plan: {e}", file=sys.stderr)

@@ -346,6 +346,16 @@ class TrainConfig:
     # with tracing fully on is gated <= 2% step time (bench.py
     # --trace-overhead, CI).
     trace_sample_rate: float = 0.0
+    # continuous profiling cadence (obs/profiler.py), counted in LOG WINDOWS:
+    # every N-th window boundary captures a short windowed jax.profiler trace
+    # (a few steps, stopped early), parses it through utils/xplane.py into a
+    # per-op roofline classification, and ledgers `profile_capture` +
+    # `op_roofline` events the planner's measured-costs loop and the live
+    # console read. 0 (default) disables cadence capture entirely; triggered
+    # captures (health alerts, serve /admin/profile) are independent of it.
+    # Overhead with the cadence on is gated <= 2% step time (bench.py
+    # --profile-overhead, CI).
+    profile_every_windows: int = 0
     # online health monitors (obs/health.py) over the per-window telemetry:
     # NaN/Inf loss guard, rolling median+MAD loss-spike detector, step-time
     # regression vs the first clean windows. Alerts land as structured
@@ -555,6 +565,11 @@ class TrainConfig:
             raise ValueError(
                 "trace_sample_rate must be in [0, 1] (0 disables tracing), "
                 f"got {self.trace_sample_rate}"
+            )
+        if self.profile_every_windows < 0:
+            raise ValueError(
+                "profile_every_windows must be >= 0 (0 disables cadence "
+                f"profiling), got {self.profile_every_windows}"
             )
         if self.nan_guard not in ("warn", "abort", "off"):
             raise ValueError(

@@ -28,7 +28,11 @@ metrics+tracing as a core subsystem, Abadi et al., arXiv:1605.08695):
   (``telemetry-report --export-trace``);
 - ``obs.health``    — online health monitors (NaN/Inf loss guard, loss-spike
   MAD detector, step-time regression, serving SLO error budget) emitting
-  structured ``health_alert`` ledger events.
+  structured ``health_alert`` ledger events;
+- ``obs.profiler``  — continuous profiling: bounded windowed ``jax.profiler``
+  captures on a cadence, on demand, and at alert chokepoints; per-op roofline
+  classification and achieved-vs-peak MFU ledgered as ``profile_capture`` /
+  ``op_roofline`` events that feed the planner's measured cost model.
 """
 
 from tensorflowdistributedlearning_tpu.obs.capacity import (
@@ -70,6 +74,13 @@ from tensorflowdistributedlearning_tpu.obs.metrics import (
     MetricsRegistry,
     TimeHistogram,
     time_summary,
+)
+from tensorflowdistributedlearning_tpu.obs.profiler import (
+    OP_ROOFLINE_EVENT,
+    PROFILE_CAPTURE_EVENT,
+    ContinuousProfiler,
+    build_roofline,
+    resolve_peak_flops,
 )
 from tensorflowdistributedlearning_tpu.obs.recompile import RecompileDetector
 from tensorflowdistributedlearning_tpu.obs.telemetry import (
@@ -115,6 +126,9 @@ __all__ = [
     "MetricsRegistry",
     "NULL_TELEMETRY",
     "NULL_TRACER",
+    "OP_ROOFLINE_EVENT",
+    "PROFILE_CAPTURE_EVENT",
+    "ContinuousProfiler",
     "RecompileDetector",
     "RunLedger",
     "SloTracker",
@@ -123,6 +137,7 @@ __all__ = [
     "TraceContext",
     "Tracer",
     "WatermarkTracker",
+    "build_roofline",
     "compare_workdirs",
     "discover_ledgers",
     "export_chrome_trace",
@@ -134,6 +149,7 @@ __all__ = [
     "read_ledger",
     "read_ledger_with_errors",
     "register_run",
+    "resolve_peak_flops",
     "run_summary",
     "time_summary",
     "write_chrome_trace",

@@ -132,6 +132,9 @@ def run_summary(workdir: str) -> Dict:
     tp = report.get("throughput")
     if tp:
         row["throughput_mean"] = tp["mean"]
+    mfu = report.get("mfu")
+    if mfu:
+        row["mfu_mean"] = mfu["mean"]
     metrics = report["evals"].get("last_metrics")
     if metrics:
         row["eval_metrics"] = metrics
@@ -255,6 +258,9 @@ _METRICS = (
      "lower", 0.05, "abs"),
     ("throughput_mean", lambda r: r.get("throughput_mean"),
      "higher", 0.10, "rel"),
+    # MFU derives from the same step-time samples as throughput (the FLOP
+    # numerator is deterministic for a fixed config) → same 10% noise band
+    ("mfu_mean", lambda r: r.get("mfu_mean"), "higher", 0.10, "rel"),
     ("wall_s", lambda r: r.get("wall_s"), "lower", 0.25, "rel"),
     ("recompiles_post_warmup", lambda r: r.get("recompiles_post_warmup"),
      "lower", 0.0, "abs"),

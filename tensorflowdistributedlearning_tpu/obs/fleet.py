@@ -240,6 +240,20 @@ def _process_row(led: ProcessLedger) -> Dict:
     mean_ms = _weighted_mean_ms(windows)
     if mean_ms is not None:
         row["step_time_mean_ms"] = round(mean_ms, 3)
+    # per-host MFU (steps-weighted over clean windows): a host whose MFU sits
+    # below the fleet's is burning its FLOPs somewhere — the roofline capture
+    # says where. Absent when the backend has no peak-FLOPs entry (CPU).
+    mfu_pairs = [
+        (float(e["mfu"]), float(e.get("steps", 1)))
+        for e in windows
+        if e.get("mfu") is not None and not e.get("dirty")
+    ]
+    if mfu_pairs:
+        total_w = sum(w for _, w in mfu_pairs)
+        if total_w:
+            row["mfu"] = round(
+                sum(v * w for v, w in mfu_pairs) / total_w, 4
+            )
     fp = header.get("fingerprint") or {}
     if fp and "error" not in fp:
         row["device_kind"] = fp.get("device_kind")
@@ -390,6 +404,29 @@ def fleet_section(
                 9,
             )
         section["capacity"] = rollup
+    # fleet MFU rollup: min + median across hosts. A host whose MFU trails
+    # the fleet median is a straggler signal ORTHOGONAL to step-time skew —
+    # on a synchronous fleet steps finish together, so a slow host shows up
+    # as everyone's lower MFU, but a host burning time off the device (input
+    # stalls, host-side work) shows a LOWER OWN MFU at the same step time.
+    mfus = sorted(
+        (r["process_index"], r["mfu"]) for r in rows if r.get("mfu") is not None
+    )
+    if mfus:
+        vals = sorted(v for _, v in mfus)
+        mid = len(vals) // 2
+        median = (
+            vals[mid]
+            if len(vals) % 2
+            else (vals[mid - 1] + vals[mid]) / 2.0
+        )
+        worst = min(mfus, key=lambda pair: pair[1])
+        section["mfu"] = {
+            "hosts": len(mfus),
+            "min": round(min(vals), 4),
+            "median": round(median, 4),
+            "min_process": worst[0],
+        }
     straggler = straggler_section(ledgers, skew_threshold=skew_threshold)
     if straggler:
         section["straggler"] = straggler
@@ -431,6 +468,8 @@ def render_fleet_section(section: Dict) -> List[str]:
         ]
         if row.get("step_time_mean_ms") is not None:
             parts.append(f"step {row['step_time_mean_ms']:.2f}ms")
+        if row.get("mfu") is not None:
+            parts.append(f"mfu {row['mfu']:.1%}")
         parts.append(
             f"wait/compute/fetch/barrier "
             f"{row['data_wait_s']:.2f}/{row['compute_s']:.2f}/"
@@ -475,6 +514,20 @@ def render_fleet_section(section: Dict) -> List[str]:
                 f"p90 {pr['p90'] * 1000:.3f}  "
                 f"p99(worst replica) {pr['p99_worst_replica'] * 1000:.3f}"
             )
+    fleet_mfu = section.get("mfu")
+    if fleet_mfu:
+        line = (
+            f"  mfu: min {fleet_mfu['min']:.1%} "
+            f"(p{fleet_mfu['min_process']}), "
+            f"median {fleet_mfu['median']:.1%} over {fleet_mfu['hosts']} "
+            "host(s)"
+        )
+        if fleet_mfu["min"] < 0.8 * fleet_mfu["median"]:
+            line += (
+                f" — !! p{fleet_mfu['min_process']} trails the fleet (host-"
+                "side stall? capture a roofline with --profile-every-windows)"
+            )
+        lines.append(line)
     st = section.get("straggler")
     if st:
         lines.append(

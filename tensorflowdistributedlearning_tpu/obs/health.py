@@ -33,8 +33,10 @@ Monitors:
   even though they never produce a latency sample.
 
 All alerts share one event schema: ``health_alert`` with ``monitor``,
-``severity`` ("warn" | "critical"), ``step`` (trainer-side), and
-monitor-specific numeric context; recoveries write ``resolved: true``.
+``severity`` ("warn" | "critical"), ``step`` (trainer-side), a unique
+``alert_id`` (stamped at ledger time — triggered postmortem profiles
+reference it), and monitor-specific numeric context; recoveries write
+``resolved: true``.
 """
 
 from __future__ import annotations
@@ -45,6 +47,8 @@ import math
 import statistics
 import threading
 from typing import Deque, Dict, List, Optional
+
+from tensorflowdistributedlearning_tpu.obs import trace as trace_lib
 
 HEALTH_ALERT_EVENT = "health_alert"
 
@@ -508,6 +512,7 @@ class HealthMonitor:
             samples_to_limit=watermark.get("samples_to_limit"),
         )
         if alert:
+            alert.setdefault("alert_id", trace_lib.new_id())
             self.alerts.append(alert)
             telemetry.event(HEALTH_ALERT_EVENT, **alert)
         return alert
@@ -550,6 +555,10 @@ class HealthMonitor:
             if starved:
                 alerts.append(starved)
         for alert in alerts:
+            # every ledgered alert carries a unique id so downstream
+            # artifacts (a triggered postmortem profile_capture, an operator
+            # runbook) can reference THIS alert, not just its kind
+            alert.setdefault("alert_id", trace_lib.new_id())
             self.alerts.append(alert)
             telemetry.event(HEALTH_ALERT_EVENT, **alert)
         if any(

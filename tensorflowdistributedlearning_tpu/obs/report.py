@@ -41,8 +41,13 @@ def _trace_section(trace_dir: str, top: int) -> Optional[Dict]:
     # ONLY host-thread planes — still aggregated, with a note, so the report
     # names the hot host frames rather than showing nothing
     note = None
+    skipped = 0
     for plane_filter in ("TPU", "/device:", ""):
-        rows = xplane.op_breakdown(trace_dir, plane_filter=plane_filter)
+        # _with_errors: a torn/partially-written plane file (profiler killed
+        # mid-capture) is skipped and counted, not a mid-report crash
+        rows, skipped = xplane.op_breakdown_with_errors(
+            trace_dir, plane_filter=plane_filter
+        )
         if rows:
             if plane_filter == "":
                 note = (
@@ -55,6 +60,8 @@ def _trace_section(trace_dir: str, top: int) -> Optional[Dict]:
         "buckets_ms": xplane.grouped_breakdown(rows),
         "top_ops": [dataclasses.asdict(r) for r in rows[:top]],
     }
+    if skipped:
+        section["skipped_plane_files"] = skipped
     if note:
         section["note"] = note
     return section
@@ -693,6 +700,51 @@ def build_report(
                 max(e["step_time_ms"]["p99_ms"] for e in stw), 3
             ),
         }
+    # MFU: analytic FLOPs (6*params*batch, the planner's model) over measured
+    # step time and the device peak — absent (never 0/0) when the backend has
+    # no peak-FLOPs entry (CPU) or the trainer never priced the step. Clean
+    # windows only: a compile/eval window's step time is not model FLOPs.
+    mfu_windows = [e for e in clean if e.get("mfu") is not None]
+    if mfu_windows:
+        mfu_weights = [float(e.get("steps", 1)) for e in mfu_windows]
+        mfu_vals = [float(e["mfu"]) for e in mfu_windows]
+        report["mfu"] = {
+            "windows": len(mfu_vals),
+            "mean": round(_weighted(mfu_vals, mfu_weights) or 0.0, 4),
+            "last": mfu_vals[-1],
+            "best": max(mfu_vals),
+        }
+    # continuous profiling (obs/profiler.py): windowed/triggered jax.profiler
+    # captures and their per-op roofline classification. Stable --json keys:
+    # profiling.{captures,by_reason,rooflines,skipped_plane_files,
+    # last_roofline}
+    captures = [e for e in events if e.get("event") == "profile_capture"]
+    rooflines = [e for e in events if e.get("event") == "op_roofline"]
+    if captures or rooflines:
+        by_reason: Dict[str, int] = {}
+        for e in captures:
+            reason = str(e.get("reason") or "unknown")
+            by_reason[reason] = by_reason.get(reason, 0) + 1
+        prof: Dict = {"captures": len(captures), "by_reason": by_reason}
+        skipped_planes = sum(
+            int(e.get("skipped_plane_files") or 0) for e in captures
+        )
+        if skipped_planes:
+            prof["skipped_plane_files"] = skipped_planes
+        if rooflines:
+            prof["rooflines"] = len(rooflines)
+            last_rf = rooflines[-1]
+            prof["last_roofline"] = {
+                k: last_rf.get(k)
+                for k in (
+                    "capture_id", "reason", "phase", "total_ms", "classes",
+                    "top_hbm_op", "mfu", "compute_mfu",
+                    "achieved_flops_per_sec_per_chip", "peak_flops_per_chip",
+                    "achieved_collective_bytes_per_sec", "alert_id",
+                )
+                if last_rf.get(k) is not None
+            }
+        report["profiling"] = prof
     if memories:
         device_peak = 0
         for e in memories:
@@ -842,6 +894,17 @@ def render_report(report: Dict) -> str:
                 f"{delta / (1 << 20):.1f} MB vs predicted (the margin the "
                 "planner's activation model needs)"
             )
+        if plan.get("cost_provenance"):
+            prov = plan["cost_provenance"]
+            mc = plan.get("measured_costs") or {}
+            if prov == "measured" and mc.get("flops_per_sec_per_chip"):
+                lines.append(
+                    f"   cost model: measured "
+                    f"({mc['flops_per_sec_per_chip'] / 1e12:.2f} TFLOP/s/chip "
+                    f"from {mc.get('captures', 0)} roofline capture(s))"
+                )
+            else:
+                lines.append(f"   cost model: {prov}")
         for warning in plan.get("warnings") or ():
             lines.append(f"   !! {warning}")
     tp = report.get("throughput")
@@ -855,6 +918,13 @@ def render_report(report: Dict) -> str:
         lines.append(
             f"step time (ms): mean {st['mean']:.2f}  p50 {st['p50']:.2f}  "
             f"p90 {st['p90']:.2f}  p99(worst window) {st['p99_worst_window']:.2f}"
+        )
+    mfu = report.get("mfu")
+    if mfu:
+        lines.append(
+            f"MFU: mean {mfu['mean']:.1%}  best {mfu['best']:.1%}  "
+            f"last {mfu['last']:.1%}  over {mfu['windows']} clean window(s) "
+            "(analytic 6*params*batch FLOPs vs device peak)"
         )
     ts = report["time_split"]
     lines.append("\nwhere the wall time went:")
@@ -1315,11 +1385,58 @@ def render_report(report: Dict) -> str:
         lines.append(line)
         for failure in qc.get("failures") or ():
             lines.append(f"  !! {failure}")
+    prof = report.get("profiling")
+    if prof:
+        reasons = ", ".join(
+            f"{n} {reason}" for reason, n in sorted(prof["by_reason"].items())
+        ) or "none"
+        line = (
+            f"\ncontinuous profiling: {prof['captures']} capture(s) "
+            f"({reasons}), {prof.get('rooflines', 0)} roofline(s)"
+        )
+        if prof.get("skipped_plane_files"):
+            line += (
+                f" — !! {prof['skipped_plane_files']} truncated plane "
+                "file(s) skipped"
+            )
+        lines.append(line)
+        rf = prof.get("last_roofline")
+        if rf:
+            cls = rf.get("classes") or {}
+            detail = (
+                f"  last roofline [{rf.get('reason', '?')}]: "
+                f"compute {cls.get('compute_frac', 0):.0%} / "
+                f"hbm {cls.get('hbm_frac', 0):.0%} / "
+                f"collective {cls.get('collective_frac', 0):.0%}"
+            )
+            if rf.get("mfu") is not None:
+                detail += f", mfu {rf['mfu']:.1%}"
+            if rf.get("achieved_flops_per_sec_per_chip"):
+                detail += (
+                    f" ({rf['achieved_flops_per_sec_per_chip'] / 1e12:.2f} "
+                    "TFLOP/s/chip achieved)"
+                )
+            lines.append(detail)
+            hbm_op = rf.get("top_hbm_op")
+            if hbm_op:
+                lines.append(
+                    f"  top HBM-bound op: {hbm_op['name']} "
+                    f"({hbm_op['total_ms']:.3f} ms, {hbm_op['fraction']:.1%})"
+                )
+            if rf.get("alert_id"):
+                lines.append(
+                    f"  postmortem capture triggered by alert {rf['alert_id']}"
+                )
     tr = report.get("trace")
     if tr:
         lines.append(f"\ndevice op breakdown ({tr['dir']}):")
         if tr.get("note"):
             lines.append(f"  ({tr['note']})")
+        if tr.get("skipped_plane_files"):
+            lines.append(
+                f"  !! {tr['skipped_plane_files']} truncated/corrupt plane "
+                "file(s) skipped"
+            )
         for bucket, ms in tr["buckets_ms"].items():
             lines.append(f"  {bucket:<24} {ms:>10.3f} ms")
         lines.append(f"  top {len(tr['top_ops'])} ops:")

@@ -116,6 +116,16 @@ class Telemetry:
         capacity_sampling: bool = True,
     ):
         self.enabled = enabled and workdir is not None
+        # the run's workdir (None when disabled) — the continuous profiler
+        # (obs/profiler.py) roots its capture dirs under it
+        self.workdir = workdir if self.enabled else None
+        # attached via set_profiler; None = no profiling (one pointer check
+        # per step/window is the whole cost of the hook points)
+        self.profiler = None
+        # analytic per-step FLOP pricing (set_step_flops): what turns
+        # measured step time into a first-class windowed `mfu` field and
+        # prices the profiler's rooflines
+        self.step_flops: Optional[Dict] = None
         # capacity/cost layer (obs/capacity.py): per-phase HBM watermarks and
         # chip-seconds accounting, sampled on the WINDOW cadence (never per
         # step — the <=1% overhead gate, bench.py --capacity-overhead).
@@ -239,10 +249,18 @@ class Telemetry:
                 else:
                     yield
         finally:
-            self.registry.histogram(f"span/{name}").record(
-                time.perf_counter() - t0
-            )
+            dt = time.perf_counter() - t0
+            self.registry.histogram(f"span/{name}").record(dt)
             self._span_stack.pop()
+            prof = self.profiler
+            if prof is not None and prof.capturing and name == SPAN_STEP:
+                # an active windowed capture counts train steps (and their
+                # wall time — the same basis as step_time_ms) so it can stop
+                # after capture_steps; the common path costs one None check
+                try:
+                    prof.note_step(dt)
+                except Exception:  # noqa: BLE001 — profiling never kills training
+                    logger.warning("profiler note_step failed", exc_info=True)
 
     def _span_delta(self, name: str) -> List[float]:
         """Span samples recorded since the last window boundary. Draining
@@ -280,6 +298,72 @@ class Telemetry:
             DATA_WORKER_BUSY_HISTOGRAM
         ).drain()
         return samples
+
+    # -- profiling / MFU ---------------------------------------------------
+
+    def set_profiler(self, profiler) -> None:
+        """Attach a ``ContinuousProfiler`` (obs/profiler.py). The telemetry
+        drives its hook points: step spans count into active captures,
+        window boundaries run the cadence + alert triggers, and close()
+        finishes any capture in flight."""
+        self.profiler = profiler
+
+    def set_step_flops(
+        self,
+        flops_per_step: float,
+        *,
+        peak_flops_per_chip: Optional[float] = None,
+        n_devices: Optional[int] = None,
+        collective_bytes_per_step: Optional[float] = None,
+    ) -> None:
+        """Price this run's steps analytically so measured time becomes MFU.
+
+        ``flops_per_step`` is the planner's dense-proxy model
+        (``6 * param_count * global_batch``) for ONE optimizer step across
+        the whole job; ``peak_flops_per_chip`` defaults to the device peak
+        table (``obs.profiler.resolve_peak_flops``) and stays ``None`` on
+        unknown kinds — every ``step_window`` then simply omits ``mfu``
+        (never a fabricated 0/0). ``collective_bytes_per_step`` is the
+        planner's priced per-chip collective volume, which lets rooflines
+        report achieved collective bandwidth."""
+        if not self.enabled:
+            return
+        if n_devices is None:
+            try:
+                import jax
+
+                n_devices = len(jax.devices())
+            except Exception:  # noqa: BLE001
+                n_devices = 1
+        if peak_flops_per_chip is None:
+            from tensorflowdistributedlearning_tpu.obs.profiler import (
+                resolve_peak_flops,
+            )
+
+            peak_flops_per_chip = resolve_peak_flops()
+        self.step_flops = {
+            "flops_per_step": float(flops_per_step),
+            "n_devices": int(n_devices),
+        }
+        if peak_flops_per_chip:
+            self.step_flops["peak_flops_per_chip"] = float(peak_flops_per_chip)
+        if collective_bytes_per_step:
+            self.step_flops["collective_bytes_per_step"] = float(
+                collective_bytes_per_step
+            )
+
+    def _window_mfu(self, mean_step_s: float) -> Optional[float]:
+        """Model FLOPs utilization for a window with the given mean step
+        time; None unless both the analytic pricing and a real device peak
+        are known."""
+        sf = self.step_flops
+        if not sf or not mean_step_s or mean_step_s <= 0:
+            return None
+        peak = sf.get("peak_flops_per_chip")
+        if not peak:
+            return None
+        achieved = sf["flops_per_step"] / mean_step_s / sf["n_devices"]
+        return round(achieved / peak, 4)
 
     # -- events ------------------------------------------------------------
 
@@ -385,6 +469,12 @@ class Telemetry:
                 for k, v in s.items()
                 if k.endswith("_s") and k != "total_s"
             }
+            # first-class MFU: analytic step FLOPs (set_step_flops) against
+            # this window's mean measured step time; absent without a known
+            # device peak (CPU) — never 0/0
+            mfu = self._window_mfu(s.get("mean_s") or 0.0)
+            if mfu is not None:
+                fields["mfu"] = mfu
         if images_per_sec is not None:
             fields["images_per_sec"] = round(float(images_per_sec), 2)
         if scalars:
@@ -404,10 +494,27 @@ class Telemetry:
         self._windows += 1
         if self._windows % self._memory_every_windows == 0:
             self.memory_event(step=step)
-        if self.health is not None:
-            # AFTER the window is persisted: alerts (and a NaN-guard abort)
-            # land in a ledger that already tells the window's story
-            self.health.observe_window(self, step, scalars or {}, fields)
+        alerts: List[Dict] = []
+        try:
+            if self.health is not None:
+                # AFTER the window is persisted: alerts (and a NaN-guard
+                # abort) land in a ledger that already tells the window's
+                # story
+                alerts = (
+                    self.health.observe_window(self, step, scalars or {}, fields)
+                    or []
+                )
+        finally:
+            # profiler hooks run even when a health abort is propagating —
+            # the alert that ends the run is the one most worth a capture at
+            # the NEXT opportunity; failures degrade to a warning
+            if self.profiler is not None:
+                try:
+                    self.profiler.on_window(
+                        step=step, windows=self._windows, alerts=alerts
+                    )
+                except Exception:  # noqa: BLE001 — never kill training
+                    logger.warning("profiler window hook failed", exc_info=True)
 
     def eval_event(
         self, step: int, metrics: Dict[str, float], duration_s: float, **extra
@@ -546,6 +653,13 @@ class Telemetry:
         self._closed = True
         if not self.enabled:
             return
+        if self.profiler is not None:
+            # finish any capture in flight BEFORE run_end/close so its
+            # events land inside this run's ledger
+            try:
+                self.profiler.close()
+            except Exception:  # noqa: BLE001
+                logger.warning("profiler close failed", exc_info=True)
         if self.detector is not None:
             final_fields.setdefault(
                 "recompiles_post_warmup", self.detector.post_warmup_count

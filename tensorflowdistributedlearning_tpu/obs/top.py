@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Tuple
 
 from tensorflowdistributedlearning_tpu.obs import capacity as capacity_lib
 from tensorflowdistributedlearning_tpu.obs import fleet as fleet_lib
+from tensorflowdistributedlearning_tpu.obs.profiler import OP_ROOFLINE_EVENT
 
 # ANSI: clear screen + home; plain strings so tests can strip them trivially
 _CLEAR = "\x1b[2J\x1b[H"
@@ -100,6 +101,8 @@ def _process_status(led: fleet_lib.ProcessLedger, now: float) -> Dict:
             }
         if window.get("images_per_sec") is not None:
             row["images_per_sec"] = window["images_per_sec"]
+        if window.get("mfu") is not None:
+            row["mfu"] = window["mfu"]
         if window.get("recompiles_post_warmup"):
             row["recompiles_post_warmup"] = window["recompiles_post_warmup"]
         svc = window.get("data_service")
@@ -178,6 +181,24 @@ def _process_status(led: fleet_lib.ProcessLedger, now: float) -> Dict:
             crow["chip_seconds_total"] = train["chip_seconds_total"]
         if crow:
             row["cost"] = crow
+    # last ledgered roofline (obs/profiler.py): the live "where do the FLOPs
+    # go" row — roofline class split, top HBM-bound op, collective share.
+    # Workdirs without captures simply have no "roofline" key (rendered "-").
+    roofline = _last(events, OP_ROOFLINE_EVENT)
+    if roofline is not None:
+        cls = roofline.get("classes") or {}
+        rrow: Dict = {
+            "reason": roofline.get("reason"),
+            "compute_frac": cls.get("compute_frac"),
+            "hbm_frac": cls.get("hbm_frac"),
+            "collective_frac": cls.get("collective_frac"),
+        }
+        if roofline.get("mfu") is not None:
+            rrow["mfu"] = roofline["mfu"]
+        hbm_op = roofline.get("top_hbm_op")
+        if hbm_op:
+            rrow["top_hbm_op"] = hbm_op.get("name")
+        row["roofline"] = rrow
     alerts = [e for e in events if e.get("event") == "health_alert"]
     if alerts:
         active: Dict[str, bool] = {}
@@ -269,6 +290,29 @@ def render_frame(frame: Dict) -> str:
                 )
             if row.get("images_per_sec") is not None:
                 bits.append(f"{row['images_per_sec']:.1f} img/s")
+            lines.append("  ".join(bits))
+        if "step" in row or row.get("roofline"):
+            # the live MFU/roofline row: "-" where no pricing/capture exists
+            # (CPU backend without flop counters, workdir with no captures)
+            rf = row.get("roofline") or {}
+            mfu = row.get("mfu", rf.get("mfu"))
+            bits = [
+                "  mfu "
+                + (f"{mfu:.1%}" if mfu is not None else "-")
+            ]
+            if rf.get("compute_frac") is not None:
+                bits.append(
+                    f"roofline compute {rf['compute_frac']:.0%} / "
+                    f"hbm {rf['hbm_frac']:.0%} / "
+                    f"coll {rf['collective_frac']:.0%}"
+                )
+            else:
+                bits.append("roofline -")
+            bits.append(
+                f"top-hbm {rf['top_hbm_op']}"
+                if rf.get("top_hbm_op")
+                else "top-hbm -"
+            )
             lines.append("  ".join(bits))
         ds = row.get("data_service")
         if ds:

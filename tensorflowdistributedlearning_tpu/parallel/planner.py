@@ -56,12 +56,14 @@ import numpy as np
 __all__ = [
     "PlanError",
     "Layout",
+    "MeasuredCosts",
     "Topology",
     "ModelProfile",
     "Candidate",
     "ParallelPlan",
     "detect_topology",
     "profile_model",
+    "measured_costs_from_workdir",
     "measured_margin_from_workdir",
     "plan",
     "plan_for_config",
@@ -451,6 +453,9 @@ class Candidate:
     compute_s: Optional[float] = None
     comm_s: Optional[float] = None
     score: Optional[float] = None
+    # the analytic-constants score, kept alongside when `score` was priced
+    # with measured rates — the plan table's measured-vs-analytic columns
+    score_analytic: Optional[float] = None
 
     def to_json(self) -> Dict:
         out: Dict = {
@@ -467,6 +472,8 @@ class Candidate:
             out["headroom_frac"] = self.headroom_frac
         if self.score is not None:
             out["score"] = self.score
+        if self.score_analytic is not None:
+            out["score_analytic"] = self.score_analytic
         return out
 
 
@@ -604,6 +611,39 @@ def _check_divisibility(
     return None
 
 
+@dataclasses.dataclass(frozen=True)
+class MeasuredCosts:
+    """Measured rates that replace the cost model's analytic constants —
+    this box's numbers instead of the public peak table. Read back from the
+    continuous profiler's ledgered ``op_roofline`` events
+    (:func:`measured_costs_from_workdir`).
+
+    ``flops_per_sec_per_chip`` is the achieved END-TO-END rate (analytic
+    step FLOPs over measured step wall) — deliberately not the MXU-only
+    rate: it folds in the HBM-bound reality the analytic peak ignores, so
+    measured scores are absolute step-time estimates where analytic scores
+    are only a relative ordering. ``collective_bytes_per_sec`` is the
+    achieved per-chip collective bandwidth from the xplane ``collectives``
+    bucket; ``None`` falls back to ``ICI_BYTES_PER_SEC`` (CPU runs, or
+    captures whose layout priced no collective volume)."""
+
+    flops_per_sec_per_chip: float
+    collective_bytes_per_sec: Optional[float] = None
+    captures: int = 0
+    source: Optional[str] = None  # the workdir the rooflines came from
+
+    def to_json(self) -> Dict:
+        out: Dict = {
+            "flops_per_sec_per_chip": self.flops_per_sec_per_chip,
+            "captures": self.captures,
+        }
+        if self.collective_bytes_per_sec is not None:
+            out["collective_bytes_per_sec"] = self.collective_bytes_per_sec
+        if self.source:
+            out["source"] = self.source
+        return out
+
+
 def _cost(
     profile: ModelProfile,
     layout: Layout,
@@ -611,6 +651,7 @@ def _cost(
     bytes_per_chip: Dict[str, int],
     global_batch: int,
     microbatches: Optional[int],
+    measured: Optional[MeasuredCosts] = None,
 ) -> Tuple[float, float]:
     """(compute_s, comm_s) for one step under the simple cost model.
 
@@ -634,14 +675,27 @@ def _cost(
     data parallel launches ONE bucketed all-reduce, tensor/expert parallel
     launch ~2 per layer — the fixed cost that keeps TP from winning on small
     models where its lower all-reduce volume would otherwise look free.
+
+    With ``measured`` (:class:`MeasuredCosts`, from a prior run's ledgered
+    rooflines) the achieved FLOP/s replaces the peak table and the achieved
+    collective bandwidth replaces ``ICI_BYTES_PER_SEC`` — same model, this
+    box's rates.
     """
     dp = layout.data_parallel
     tp = layout.model_parallel
     act = float(bytes_per_chip["activation_bytes_per_chip"])
     grad_bytes = float(bytes_per_chip["params_bytes_per_chip"])
 
+    flops_per_chip_rate = (
+        measured.flops_per_sec_per_chip if measured else topo.peak_flops()
+    )
+    ici_bytes_per_sec = (
+        measured.collective_bytes_per_sec
+        if measured and measured.collective_bytes_per_sec
+        else ICI_BYTES_PER_SEC
+    )
     flops = 6.0 * profile.param_count * global_batch
-    compute = flops / topo.n_devices / topo.peak_flops()
+    compute = flops / topo.n_devices / flops_per_chip_rate
     if layout.pipeline_parallel > 1:
         micro = microbatches or layout.pipeline_parallel
         compute *= 1.0 + (layout.pipeline_parallel - 1) / micro
@@ -669,7 +723,7 @@ def _cost(
         latency_ops += 2 * profile.n_layers
     return (
         compute,
-        comm / ICI_BYTES_PER_SEC
+        comm / ici_bytes_per_sec
         + latency_ops * topo.collective_latency_s(),
     )
 
@@ -685,6 +739,7 @@ def _evaluate(
     microbatches: Optional[int],
     budget_bytes: Optional[int],
     measured_margin_bytes: int = 0,
+    measured_costs: Optional[MeasuredCosts] = None,
 ) -> Candidate:
     cand = Candidate(layout=layout)
     failed = _check_conflicts(layout, train_config) or _check_divisibility(
@@ -730,10 +785,18 @@ def _evaluate(
             return cand
     cand.feasible = True
     compute, comm = _cost(
-        profile, layout, topo, cand.bytes, global_batch, microbatches
+        profile, layout, topo, cand.bytes, global_batch, microbatches,
+        measured=measured_costs,
     )
     cand.compute_s, cand.comm_s = compute, comm
     cand.score = compute + comm
+    if measured_costs is not None:
+        # keep the analytic score alongside so the plan table can show
+        # measured-vs-analytic per candidate (and a re-score is auditable)
+        a_compute, a_comm = _cost(
+            profile, layout, topo, cand.bytes, global_batch, microbatches
+        )
+        cand.score_analytic = a_compute + a_comm
     return cand
 
 
@@ -805,6 +868,15 @@ class ParallelPlan:
     topology: Topology
     hbm_bytes_per_device: Optional[int]
     warnings: List[str] = dataclasses.field(default_factory=list)
+    # the measured rates the scores were priced with (None = analytic
+    # constants); `cost_provenance` is the run-header stamp
+    measured_costs: Optional[MeasuredCosts] = None
+
+    @property
+    def cost_provenance(self) -> str:
+        """``"measured"`` when candidate scores were priced with a prior
+        run's ledgered roofline rates, ``"analytic"`` for the constants."""
+        return "measured" if self.measured_costs is not None else "analytic"
 
     @property
     def layout(self) -> Layout:
@@ -841,6 +913,11 @@ class ParallelPlan:
                 out["headroom_frac"] = self.chosen.headroom_frac
         if self.chosen.score is not None:
             out["score"] = round(self.chosen.score, 9)
+        out["cost_provenance"] = self.cost_provenance
+        if self.measured_costs is not None:
+            out["measured_costs"] = self.measured_costs.to_json()
+            if self.chosen.score_analytic is not None:
+                out["score_analytic"] = round(self.chosen.score_analytic, 9)
         if self.chosen.reject_reason:
             out["reject_reason"] = self.chosen.reject_reason
         if self.warnings:
@@ -867,6 +944,7 @@ def plan(
     hbm_bytes_per_device: Optional[int] = None,
     source: Optional[str] = None,
     measured_margin_bytes: Optional[int] = None,
+    measured_costs: Optional[MeasuredCosts] = None,
 ) -> ParallelPlan:
     """The engine. ``pinned`` holds the layout fields explicit flags fixed
     (explicit flags always win); the planner fills the rest by score. With
@@ -880,7 +958,13 @@ def plan(
     (:func:`measured_margin_from_workdir`) and every candidate's budget check
     adds it on top of the abstract estimate — the elastic coordinator's
     re-plan (parallel/elastic.py) sources it from the workdir it is about to
-    resume."""
+    resume.
+
+    ``measured_costs`` closes the COST-model loop the same way
+    (:func:`measured_costs_from_workdir`): candidate scores are priced with
+    a prior run's achieved FLOP/s and collective bandwidth instead of the
+    analytic constants, and the plan's ``cost_provenance`` header stamp
+    flips to ``"measured"``."""
     pinned = dict(pinned or {})
     if topology is None:
         topology = detect_topology(getattr(train_config, "n_devices", None))
@@ -913,6 +997,7 @@ def plan(
                 profile, layout, model_config, train_config, topology,
                 global_batch, grad_accum, microbatches, budget,
                 measured_margin_bytes=int(measured_margin_bytes or 0),
+                measured_costs=measured_costs,
             )
         )
     matching = [c for c in candidates if _matches_pinned(c.layout, pinned)]
@@ -959,6 +1044,7 @@ def plan(
         topology=topology,
         hbm_bytes_per_device=budget,
         warnings=warnings,
+        measured_costs=measured_costs,
     )
 
 
@@ -987,6 +1073,53 @@ def measured_margin_from_workdir(workdir: str) -> Optional[int]:
     return max(0, max(deltas))
 
 
+def measured_costs_from_workdir(workdir: str) -> Optional[MeasuredCosts]:
+    """Measured cost-model rates from the ``op_roofline`` events a prior run
+    under ``workdir`` ledgered (obs/profiler.py): the achieved FLOP/s per
+    chip and — when any capture priced a collective volume — the achieved
+    per-chip collective bandwidth. Per ledger the LAST roofline wins (the
+    most recent steady state); across the fleet the MINIMUM wins (a plan
+    must price for the slowest host, the same stance as
+    :func:`measured_margin_from_workdir`). None when the workdir has no
+    ledger or no roofline carries an achieved rate (profiling never ran, or
+    ran without analytic FLOP pricing)."""
+    from tensorflowdistributedlearning_tpu.obs import fleet as fleet_lib
+    from tensorflowdistributedlearning_tpu.obs.profiler import (
+        OP_ROOFLINE_EVENT,
+    )
+
+    try:
+        ledgers = fleet_lib.discover_ledgers(workdir)
+    except OSError:
+        return None
+    flops_rates: List[float] = []
+    coll_rates: List[float] = []
+    captures = 0
+    for led in ledgers:
+        last_flops = None
+        last_coll = None
+        for e in led.events:
+            if e.get("event") != OP_ROOFLINE_EVENT:
+                continue
+            captures += 1
+            if e.get("achieved_flops_per_sec_per_chip"):
+                last_flops = float(e["achieved_flops_per_sec_per_chip"])
+            if e.get("achieved_collective_bytes_per_sec"):
+                last_coll = float(e["achieved_collective_bytes_per_sec"])
+        if last_flops is not None:
+            flops_rates.append(last_flops)
+        if last_coll is not None:
+            coll_rates.append(last_coll)
+    if not flops_rates:
+        return None
+    return MeasuredCosts(
+        flops_per_sec_per_chip=min(flops_rates),
+        collective_bytes_per_sec=min(coll_rates) if coll_rates else None,
+        captures=captures,
+        source=workdir,
+    )
+
+
 def _pinned_from_config(train_config) -> Dict:
     return {
         "model_parallel": train_config.model_parallel,
@@ -1004,10 +1137,17 @@ def plan_for_config(
     *,
     topology: Optional[Topology] = None,
     profile: Optional[ModelProfile] = None,
+    workdir: Optional[str] = None,
 ) -> ParallelPlan:
     """The trainer-facing entry: ``parallelism='auto'`` plans freely with any
     non-default degree pinned (explicit flags win); ``'explicit'`` validates
-    the requested layout through the same machinery."""
+    the requested layout through the same machinery.
+
+    ``workdir`` (the run's model dir) closes the measured-costs loop on the
+    auto path: when a PRIOR run in the same workdir ledgered rooflines
+    (``profile_every_windows``), auto candidates are re-scored with that
+    box's achieved rates and the run header's ``cost_provenance`` flips to
+    ``"measured"`` — profile once, plan better forever after."""
     if getattr(train_config, "parallelism", "explicit") == "auto":
         pinned = {}
         for k, v in _pinned_from_config(train_config).items():
@@ -1016,9 +1156,16 @@ def plan_for_config(
             default = False if k == "weight_update_sharding" else 1
             if v != default:
                 pinned[k] = v
+        measured = None
+        if workdir:
+            try:
+                measured = measured_costs_from_workdir(workdir)
+            except Exception:  # noqa: BLE001 — a torn ledger must not block
+                measured = None
         return plan(
             model_config, train_config, global_batch,
             topology=topology, profile=profile, pinned=pinned, source="auto",
+            measured_costs=measured,
         )
     return validate_config(
         model_config, train_config, global_batch,
@@ -1070,9 +1217,31 @@ def render_plan_table(p: ParallelPlan) -> str:
             "   HBM budget: none (divisibility-only feasibility; pass "
             "--hbm-gb or run on a backend that reports bytes_limit)"
         )
+    measured = p.measured_costs is not None
+    if measured:
+        mc = p.measured_costs
+        rate = f"{mc.flops_per_sec_per_chip / 1e12:.2f} TFLOP/s/chip"
+        coll = (
+            f", {mc.collective_bytes_per_sec / 1e9:.1f} GB/s collective"
+            if mc.collective_bytes_per_sec
+            else ""
+        )
+        lines.append(
+            f"   cost provenance: measured ({rate}{coll}; "
+            f"{mc.captures} roofline capture(s) from {mc.source})"
+        )
+    else:
+        lines.append(
+            "   cost provenance: analytic (peak-FLOPs table + ICI constant; "
+            "pass --measured-costs-from WORKDIR to price with ledgered "
+            "roofline rates)"
+        )
+    score_cols = (
+        f"{'measured':>12}  {'analytic':>12}" if measured else f"{'score':>12}"
+    )
     lines.append(
         f"   {'layout':<22} {'params':>9} {'opt':>9} {'act':>9} "
-        f"{'total':>9}  {'headroom':>8}  {'score':>12}  verdict"
+        f"{'total':>9}  {'headroom':>8}  {score_cols}  verdict"
     )
     order = sorted(
         p.candidates,
@@ -1089,6 +1258,13 @@ def render_plan_table(p: ParallelPlan) -> str:
             f"{c.headroom_frac:8.1%}" if c.headroom_frac is not None else "     n/a"
         )
         score = f"{c.score:12.6f}" if c.score is not None else "         n/a"
+        if measured:
+            analytic = (
+                f"{c.score_analytic:12.6f}"
+                if c.score_analytic is not None
+                else "         n/a"
+            )
+            score = f"{score}  {analytic}"
         verdict = (
             "chosen" if c.layout == p.layout else
             ("ok" if c.feasible else

@@ -809,6 +809,40 @@ class FleetRouter:
             conns[key] = conn
         return conn
 
+    def profile_fleet(self, seconds: float) -> Dict:
+        """Router-aggregated ``/admin/profile``: fan the capture request out
+        to every non-dead replica and collect the per-replica answers. Each
+        replica profiles itself (202) or reports why not (409 in-flight, 503
+        no workdir); a replica that cannot be reached is reported dead-style
+        rather than failing the sweep — the operator asked for whatever
+        evidence the fleet can produce, not all-or-nothing."""
+        results: Dict[str, Dict] = {}
+        started = 0
+        for rep in self._replica_list():
+            if rep.status == STATUS_DEAD:
+                results[str(rep.replica_id)] = {"error": "dead"}
+                continue
+            try:
+                conn = self._conn(rep)
+                conn.request("GET", f"/admin/profile?seconds={seconds:g}")
+                resp = conn.getresponse()
+                body = json.loads(resp.read() or b"{}")
+                body["http_status"] = resp.status
+                if resp.status == 202:
+                    started += 1
+                results[str(rep.replica_id)] = body
+            except (http.client.HTTPException, OSError, ValueError) as e:
+                self._drop_conn(rep)
+                results[str(rep.replica_id)] = {
+                    "error": f"{type(e).__name__}: {e}"
+                }
+        return {
+            "seconds": seconds,
+            "replicas": len(results),
+            "started": started,
+            "per_replica": results,
+        }
+
     def _drop_conn(self, rep: ReplicaState) -> None:
         conns = getattr(self._conn_local, "conns", None)
         if conns:
@@ -1075,6 +1109,29 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 )
             else:
                 self._json(200, promoter.status())
+        elif parsed.path == "/admin/profile":
+            # fleet-wide capture sweep: ask every live replica to profile
+            # itself for N seconds; the per-replica rooflines land in each
+            # replica's ledger and merge through telemetry-report
+            query = urllib.parse.parse_qs(parsed.query)
+            try:
+                seconds = float(query.get("seconds", ["1"])[0])
+            except ValueError:
+                self._json(
+                    400,
+                    {"error": {"code": "bad_request",
+                               "message": "seconds must be a number"}},
+                )
+                return
+            if not (0 < seconds <= 60):
+                self._json(
+                    400,
+                    {"error": {"code": "bad_request",
+                               "message": "seconds must be in (0, 60]"}},
+                )
+                return
+            body = self.ctx.profile_fleet(seconds)
+            self._json(202 if body["started"] else 503, body)
         elif parsed.path == "/metrics":
             query = urllib.parse.parse_qs(parsed.query)
             accept = self.headers.get("Accept", "")

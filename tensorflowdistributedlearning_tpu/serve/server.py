@@ -181,6 +181,17 @@ class ServingServer:
             else capacity_lib.WatermarkTracker()
         )
         self._last_cost: Dict = {}
+        # continuous profiling (obs/profiler.py): /admin/profile?seconds=N
+        # on-demand captures, plus ONE rate-limited postmortem capture when
+        # the SLO budget blows (emit_window). Timed captures only — the
+        # serving tier has no train-step spans to count.
+        from tensorflowdistributedlearning_tpu.obs.profiler import (
+            ContinuousProfiler,
+        )
+
+        self.profiler = ContinuousProfiler(self.telemetry, phase="infer")
+        if self.telemetry.enabled:
+            self.telemetry.set_profiler(self.profiler)
         if self.slo is not None and self.window_secs <= 0:
             # the budget evaluates at window boundaries; with periodic windows
             # off only shutdown's final window (or a manual emit_window) runs
@@ -466,7 +477,14 @@ class ServingServer:
             # in the window for the report's health section
             verdict = self.slo.evaluate()
             if verdict is not None:
+                verdict.setdefault("alert_id", trace_lib.new_id())
                 self.telemetry.event(health_lib.HEALTH_ALERT_EVENT, **verdict)
+                if not verdict.get("resolved"):
+                    # SLO budget blown: capture ONE rate-limited postmortem
+                    # profile stamped with the triggering alert id — the
+                    # evidence an on-call wants is the trace from the bad
+                    # minutes, not a capture requested after the fact
+                    self.profiler.trigger(verdict, seconds=2.0)
             fields["slo"] = self.slo.snapshot()
         if final:
             fields["final"] = True
@@ -539,6 +557,13 @@ class ServingServer:
         except Exception:  # noqa: BLE001
             logger.exception("final serve window emission failed")
             final = {}
+        try:
+            # stop any in-flight timed capture and ledger what it got;
+            # telemetry.close would do this too, but only when it owns the
+            # profiler (enabled telemetry) — close is idempotent either way
+            self.profiler.close()
+        except Exception:  # noqa: BLE001
+            logger.warning("profiler close failed", exc_info=True)
         self.telemetry.close(
             kind="serve",
             requests=final.get("requests"),
@@ -668,6 +693,37 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             else:
                 self._json(200, self.ctx.metrics_snapshot())
+        elif parsed.path == "/admin/profile":
+            # on-demand capture: kick a timed jax.profiler capture in the
+            # background and answer immediately (202); the parsed roofline
+            # lands in the ledger when the capture window closes. 409 while
+            # another capture is in flight — the running one wins.
+            query = urllib.parse.parse_qs(parsed.query)
+            try:
+                seconds = float(query.get("seconds", ["1"])[0])
+            except ValueError:
+                self._error(400, "bad_request", "seconds must be a number")
+                return
+            if not (0 < seconds <= 60):
+                self._error(
+                    400, "bad_request", "seconds must be in (0, 60]"
+                )
+                return
+            if self.ctx.profiler.logdir is None:
+                self._error(
+                    503, "profiling_unavailable",
+                    "no telemetry workdir to write captures into",
+                )
+                return
+            started = self.ctx.profiler.capture_timed(seconds, reason="admin")
+            if started is None:
+                self._error(
+                    409, "capture_in_flight",
+                    "a profile capture is already running on this replica",
+                )
+                return
+            started["replica"] = self.ctx.replica_id
+            self._json(202, started)
         else:
             self._error(404, "not_found", f"no route for GET {self.path}")
 

@@ -527,6 +527,29 @@ class ClassifierTrainer:
             ),
             weight_update_sharding=tcfg.weight_update_sharding,
         )
+        # MFU pricing + continuous profiling: the planner's analytic FLOP
+        # model (6 * params * batch per step: fwd 2x + bwd 4x) against the
+        # measured step time turns every step_window into an MFU point; the
+        # profiler layers windowed/triggered jax.profiler captures on top and
+        # ledgers the per-op roofline (obs/profiler.py)
+        if tel.enabled:
+            n_dev = self.mesh.devices.size
+            tel.set_step_flops(
+                6.0 * float(self.params) * float(batch_size),
+                n_devices=n_dev,
+                # dominant steady-state collective: the gradient all-reduce,
+                # ~2x params bytes on-wire per step (ring); only priced when
+                # there is a wire to cross
+                collective_bytes_per_step=(
+                    2.0 * float(
+                        state_lib.tree_bytes_per_device(state.params)
+                    ) if n_dev > 1 else None
+                ),
+            )
+            profiler = obs_lib.ContinuousProfiler(
+                tel, every_windows=tcfg.profile_every_windows
+            )
+            tel.set_profiler(profiler)
         ckpt = self._checkpointer()
         state = ckpt.restore_latest(state)
         start_step = int(jax.device_get(state.step))
@@ -1103,6 +1126,7 @@ def fit_preset(
     data_service_workers: Optional[int] = None,
     trace_sample_rate: Optional[float] = None,
     nan_guard: Optional[str] = None,
+    profile_every_windows: Optional[int] = None,
     parallelism: Optional[str] = None,
     hbm_budget_gb: Optional[float] = None,
 ) -> FitResult:
@@ -1155,6 +1179,7 @@ def fit_preset(
         or data_service_workers is not None
         or trace_sample_rate is not None
         or nan_guard is not None
+        or profile_every_windows is not None
     ):
         train_cfg = dataclasses.replace(
             train_cfg,
@@ -1223,6 +1248,11 @@ def fit_preset(
             nan_guard=(
                 nan_guard if nan_guard is not None else train_cfg.nan_guard
             ),
+            profile_every_windows=(
+                profile_every_windows
+                if profile_every_windows is not None
+                else train_cfg.profile_every_windows
+            ),
         )
     # route EVERY preset's layout through the parallelism planner before the
     # trainer is built: auto derives the layout (explicit flags pinned),
@@ -1248,8 +1278,19 @@ def fit_preset(
             pinned["expert_parallel"] = expert_parallel
         if weight_update_sharding is not None:
             pinned["weight_update_sharding"] = weight_update_sharding
+        # prior runs in this workdir may have ledgered op_roofline captures
+        # (--profile-every-windows): score candidates with the MEASURED
+        # achieved rates when they exist — profile once, plan better forever
+        # after. Falls back to the analytic constants (and stamps the
+        # provenance in the run header) when none do.
+        measured = None
+        try:
+            measured = planner_lib.measured_costs_from_workdir(model_dir)
+        except Exception:  # noqa: BLE001 — a torn ledger must not block
+            measured = None
         run_plan = planner_lib.plan(
-            preset.model, train_cfg, global_batch, pinned=pinned, source="auto"
+            preset.model, train_cfg, global_batch, pinned=pinned,
+            source="auto", measured_costs=measured,
         )
         train_cfg = dataclasses.replace(train_cfg, **run_plan.overrides())
     else:

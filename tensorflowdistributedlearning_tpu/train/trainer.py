@@ -414,6 +414,26 @@ class Trainer:
             ),
             weight_update_sharding=tcfg.weight_update_sharding,
         )
+        # MFU pricing + continuous profiling: analytic 6*params*batch FLOPs
+        # against measured step time makes every step_window carry `mfu`; the
+        # profiler adds windowed/triggered jax.profiler captures and ledgers
+        # the per-op roofline (obs/profiler.py)
+        if self._telemetry.enabled:
+            n_dev = self.mesh.devices.size
+            self._telemetry.set_step_flops(
+                6.0 * float(self.params) * float(batch_size),
+                n_devices=n_dev,
+                collective_bytes_per_step=(
+                    2.0 * float(
+                        state_lib.tree_bytes_per_device(state.params)
+                    ) if n_dev > 1 else None
+                ),
+            )
+            if self._telemetry.profiler is None:
+                self._telemetry.set_profiler(obs_lib.ContinuousProfiler(
+                    self._telemetry,
+                    every_windows=tcfg.profile_every_windows,
+                ))
         start_step = int(jax.device_get(state.step))
         if start_step >= steps:
             logger.info("fold %d already trained to step %d", fold, start_step)

@@ -131,6 +131,16 @@ class PlaneBreakdown:
     ops: List[OpTime]
 
 
+def _plane_name(plane_buf) -> str:
+    """The plane's name alone — a cheap top-level scan (length-delimited
+    payloads are skipped, not decoded) so callers can reject planes by name
+    without paying for a full :func:`_parse_plane`."""
+    for field, _, value in _fields(plane_buf):
+        if field == 2:
+            return bytes(value).decode("utf-8", "replace")
+    return ""
+
+
 def _parse_plane(
     plane_buf,
 ) -> Tuple[str, Dict[str, Dict[str, List[float]]]]:
@@ -206,21 +216,67 @@ def op_breakdown(
     "XLA Ops" line contributes only its op-level lines; planes without one
     (host planes — flat thread lines) contribute every line. ``fraction`` is
     each op's share of the aggregated time — with op-level lines and one
-    traced step per capture this reads directly as "share of the step"."""
+    traced step per capture this reads directly as "share of the step".
+
+    Truncated/partially-written plane files (a capture torn by SIGKILL) are
+    SKIPPED, not fatal — see :func:`op_breakdown_with_errors` for the count."""
+    rows, _ = op_breakdown_with_errors(
+        logdir, plane_filter=plane_filter, line_filter=line_filter, top=top
+    )
+    return rows
+
+
+def op_breakdown_with_errors(
+    logdir: str,
+    *,
+    plane_filter: str = "TPU",
+    line_filter: Optional[str] = None,
+    top: Optional[int] = None,
+) -> Tuple[List[OpTime], int]:
+    """:func:`op_breakdown` plus the count of plane files skipped as
+    corrupt/truncated. A torn capture (profiler killed mid-write — SIGKILL,
+    OOM, preemption) leaves a partial ``*.xplane.pb`` whose wire scan raises;
+    one torn file must not take down a whole-workdir report, so each file
+    parses independently, bad ones are counted and skipped with a warning,
+    and the good ones still aggregate. Raises FileNotFoundError only when NO
+    plane file exists at all; a logdir where every file is torn returns
+    ``([], n_skipped)``."""
+    import logging as _logging
+
     paths = find_xplane_files(logdir)
     if not paths:
         raise FileNotFoundError(f"no *.xplane.pb under {logdir}")
     plane_lines: List[Dict[str, Dict[str, List[float]]]] = []
+    skipped = 0
     for path in paths:
-        with open(path, "rb") as f:
-            space = f.read()
-        for field, _, value in _fields(space):
-            if field != 1:
-                continue
-            name, lines = _parse_plane(value)
-            if plane_filter and plane_filter not in name:
-                continue
-            plane_lines.append(lines)
+        try:
+            with open(path, "rb") as f:
+                space = f.read()
+            file_planes = []
+            for field, _, value in _fields(space):
+                if field != 1:
+                    continue
+                # resolve the name from the plane's top-level fields before
+                # parsing the body: payloads are length-skipped memoryviews,
+                # so rejecting a plane (host threads on TPU, the event-less
+                # /host:metadata plane everywhere) costs O(#fields), not
+                # O(bytes) — on a 4 MB CPU capture that is ~40% of the parse
+                if plane_filter and plane_filter not in _plane_name(value):
+                    continue
+                name, lines = _parse_plane(value)
+                if plane_filter and plane_filter not in name:
+                    continue
+                file_planes.append(lines)
+            # all-or-nothing per file: a plane scanned before the tear must
+            # not half-contribute a file the count reports as skipped
+            plane_lines.extend(file_planes)
+        except (ValueError, IndexError, OSError) as e:
+            # IndexError: _read_varint ran off the end of a truncated buffer;
+            # ValueError: overflow / unsupported wire type mid-garbage
+            skipped += 1
+            _logging.getLogger(__name__).warning(
+                "skipping truncated/corrupt plane file %s: %s", path, e
+            )
     agg: Dict[str, List[float]] = {}
     for lines in plane_lines:
         effective_filter = line_filter
@@ -265,22 +321,58 @@ def op_breakdown(
         for op, (ms, cnt) in agg.items()
     ]
     rows.sort(key=lambda r: -r.total_ms)
-    return rows[:top] if top else rows
+    return (rows[:top] if top else rows), skipped
 
 
 def plane_names(logdir: str) -> List[str]:
     """Every plane name in the capture (pick the device plane to filter on)."""
     names = []
     for path in find_xplane_files(logdir):
-        with open(path, "rb") as f:
-            space = f.read()
-        for field, _, value in _fields(space):
-            if field == 1:
-                for f2, _, v2 in _fields(value):
-                    if f2 == 2:
-                        names.append(bytes(v2).decode("utf-8", "replace"))
-                        break
+        try:
+            with open(path, "rb") as f:
+                space = f.read()
+            for field, _, value in _fields(space):
+                if field == 1:
+                    for f2, _, v2 in _fields(value):
+                        if f2 == 2:
+                            names.append(bytes(v2).decode("utf-8", "replace"))
+                            break
+        except (ValueError, IndexError, OSError):
+            continue  # torn capture — same stance as op_breakdown
     return names
+
+
+# the default grouped_breakdown buckets, public because the roofline
+# classifier (obs/profiler.py) keys its compute/HBM/collective split on the
+# SAME bucket names — one bucketing, two consumers
+DEFAULT_GROUPS: Dict[str, Tuple[str, ...]] = {
+    "conv": ("convolution", "conv"),
+    "matmul": ("dot", "einsum"),
+    "fusion(elementwise/bn)": ("fusion",),
+    "collectives": (
+        "all-reduce",
+        "all-gather",
+        "reduce-scatter",
+        "collective-permute",
+        "all-to-all",
+        "collective-broadcast",
+        "ragged-all-to-all",
+    ),
+    "reduce": ("reduce",),
+    "copy/transpose": ("copy", "transpose", "bitcast"),
+    "infeed/outfeed": ("infeed", "outfeed"),
+}
+
+
+def classify_bucket(op_name: str) -> str:
+    """The :data:`DEFAULT_GROUPS` bucket ``op_name`` falls into (first hit in
+    insertion order, ``"other"`` when none matches) — per-op form of
+    :func:`grouped_breakdown`."""
+    lowered = op_name.lower()
+    for bucket, needles in DEFAULT_GROUPS.items():
+        if any(n in lowered for n in needles):
+            return bucket
+    return "other"
 
 
 def grouped_breakdown(
@@ -295,23 +387,7 @@ def grouped_breakdown(
     multi-host capture a fat ``collectives`` bucket with healthy per-host
     step times reads as a slow NETWORK, where a straggling host shows up in
     the fleet report's per-host skew instead (obs/fleet.py)."""
-    groups = groups or {
-        "conv": ("convolution", "conv"),
-        "matmul": ("dot", "einsum"),
-        "fusion(elementwise/bn)": ("fusion",),
-        "collectives": (
-            "all-reduce",
-            "all-gather",
-            "reduce-scatter",
-            "collective-permute",
-            "all-to-all",
-            "collective-broadcast",
-            "ragged-all-to-all",
-        ),
-        "reduce": ("reduce",),
-        "copy/transpose": ("copy", "transpose", "bitcast"),
-        "infeed/outfeed": ("infeed", "outfeed"),
-    }
+    groups = groups or DEFAULT_GROUPS
     out = {k: 0.0 for k in groups}
     out["other"] = 0.0
     for row in rows:

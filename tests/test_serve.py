@@ -369,6 +369,87 @@ def test_http_rejects_while_draining(serve_fn):
         _post(url + "/v1/predict", {"instances": [[0.0] * FEATURES]}, timeout=3)
 
 
+def _get_status(url, timeout=10):
+    """GET returning (status, json_body) — error statuses included."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_admin_profile_endpoint(serve_fn, tmp_path, monkeypatch):
+    """/admin/profile route semantics: 400 on bad seconds, 202 with a
+    capture_id when a capture starts, 409 while one is in flight, and the
+    finished capture ledgered as a profile_capture event. jax.profiler is
+    faked — the route and the profiler's single-capture discipline are the
+    contract here, not TSL."""
+    import jax
+
+    dirs = []
+
+    def fake_start(logdir):
+        dirs.append(logdir)
+
+    def fake_stop():
+        import os
+
+        run = os.path.join(dirs[-1], "plugins", "profile", "run0")
+        os.makedirs(run, exist_ok=True)
+        with open(os.path.join(run, "host.xplane.pb"), "wb") as f:
+            f.write(b"")  # valid empty XSpace: zero ops, zero skips
+
+    monkeypatch.setattr(jax.profiler, "start_trace", fake_start)
+    monkeypatch.setattr(jax.profiler, "stop_trace", fake_stop)
+
+    workdir = str(tmp_path / "profile_run")
+    tel = Telemetry(workdir, run_info={"kind": "serve"})
+    engine = InferenceEngine(serve_fn, (FEATURES,), buckets=(1,))
+    batcher = MicroBatcher(engine, max_wait_ms=1)
+    server = ServingServer(
+        engine, batcher, port=0, telemetry=tel, window_secs=0
+    ).start()
+    try:
+        for bad in ("abc", "0", "-1", "61"):
+            status, body = _get_status(
+                server.url + f"/admin/profile?seconds={bad}"
+            )
+            assert status == 400
+            assert body["error"]["code"] == "bad_request"
+        status, body = _get_status(server.url + "/admin/profile?seconds=0.4")
+        assert status == 202
+        assert body["status"] == "started" and body["capture_id"]
+        assert "replica" in body
+        # single-capture discipline: the running capture wins
+        status, body = _get_status(server.url + "/admin/profile?seconds=0.4")
+        assert status == 409
+        assert body["error"]["code"] == "capture_in_flight"
+    finally:
+        server.shutdown()  # waits out the capture; ledger closes after it
+    from tensorflowdistributedlearning_tpu.obs import read_ledger
+
+    events = read_ledger(workdir)
+    captures = [e for e in events if e["event"] == "profile_capture"]
+    assert len(captures) == 1
+    assert captures[0]["reason"] == "admin"
+    assert captures[0]["capture_id"]
+    assert dirs and dirs[0].startswith(workdir)
+
+
+def test_http_admin_profile_without_workdir_503(serve_fn):
+    """A server on disabled telemetry has nowhere to write captures: the
+    route answers 503 profiling_unavailable instead of pretending."""
+    engine = InferenceEngine(serve_fn, (FEATURES,), buckets=(1,))
+    batcher = MicroBatcher(engine, max_wait_ms=1)
+    server = ServingServer(engine, batcher, port=0, window_secs=0).start()
+    try:
+        status, body = _get_status(server.url + "/admin/profile?seconds=1")
+        assert status == 503
+        assert body["error"]["code"] == "profiling_unavailable"
+    finally:
+        server.shutdown()
+
+
 # -- CLI surface -------------------------------------------------------------
 
 

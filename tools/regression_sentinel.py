@@ -403,6 +403,45 @@ def check_plan(
     return out
 
 
+# continuous-profiling overhead: the amortized step-time ratio with a
+# sparse-cadence capture landing mid-run must stay within the documented
+# <= 2% budget (dimensionless, transfers across machines)
+DEFAULT_PROFILE_RATIO_LIMIT = 1.02
+
+
+def check_profile(
+    baseline: Dict,
+    fresh: Optional[Dict] = None,
+    *,
+    ratio_limit: float = DEFAULT_PROFILE_RATIO_LIMIT,
+) -> List[Dict]:
+    """BENCH_PROFILE.json gates (bench.py --profile-overhead output shape).
+
+    Default mode REPLAYS the committed record (like plan/elastic — ci runs
+    the live A/B as its own gate step, so the sentinel's job is keeping the
+    committed history honest): the profiled/plain step-time ratio must clear
+    the <= 2% budget, and the profiled run must have actually landed at
+    least one capture inside the timed loop — a run that never captured
+    would pass the ratio vacuously. ``--fresh-profile`` gates a fresh record
+    instead."""
+    record = fresh if fresh is not None else baseline
+    out: List[Dict] = []
+    ratio = record.get("step_time_ratio_profiled_over_plain")
+    out.append(_finding(
+        "profile", "step_time_ratio_profiled_over_plain",
+        ratio_limit, ratio,
+        f"<= {ratio_limit} (cadence profiling stays inside the 2% budget)",
+        ratio is not None and ratio <= ratio_limit,
+    ))
+    captures = (record.get("profiling_on") or {}).get("captures_per_run")
+    out.append(_finding(
+        "profile", "profiling_on.captures_per_run", ">= 1", captures,
+        ">= 1 (the profiled side must actually capture, hard)",
+        captures is not None and captures >= 1,
+    ))
+    return out
+
+
 # elastic gates: all dimensionless/hard (replay-only, like fleet/promotion —
 # the full drill spawns real multi-process worlds, too heavy for every CI
 # run); the downtime ceiling applies to the committed record's own box
@@ -591,7 +630,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "gate)")
     parser.add_argument("--benches",
                         default="async,serve,fleet,records,promotion,plan,"
-                        "elastic",
+                        "elastic,profile",
                         help="comma-separated subset to check")
     parser.add_argument("--baseline-async",
                         default=os.path.join(REPO, "BENCH_ASYNC.json"))
@@ -603,6 +642,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                         default=os.path.join(REPO, "BENCH_PLAN.json"))
     parser.add_argument("--baseline-elastic",
                         default=os.path.join(REPO, "BENCH_ELASTIC.json"))
+    parser.add_argument("--baseline-profile",
+                        default=os.path.join(REPO, "BENCH_PROFILE.json"))
+    parser.add_argument("--fresh-profile", default=None, metavar="JSON",
+                        help="pre-computed bench.py --profile-overhead "
+                        "output (default: replay the committed baseline's "
+                        "gates; ci runs the live A/B as its own step)")
+    parser.add_argument("--profile-ratio-limit", type=float,
+                        default=DEFAULT_PROFILE_RATIO_LIMIT,
+                        help="profiled/plain step-time ratio ceiling for "
+                        "the continuous-profiling bench (dimensionless; "
+                        "the documented <= 2% budget)")
     parser.add_argument("--fresh-elastic", default=None, metavar="JSON",
                         help="pre-computed tools/bench_elastic.py output "
                         "(default: replay the committed baseline's gates, "
@@ -731,6 +781,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         except (OSError, ValueError) as e:
             errors.append(f"elastic: {e}")
+    if "profile" in benches:
+        try:
+            baseline = _load(args.baseline_profile)
+            fresh = _load(args.fresh_profile) if args.fresh_profile else None
+            findings += check_profile(
+                baseline, fresh, ratio_limit=args.profile_ratio_limit
+            )
+        except (OSError, ValueError) as e:
+            errors.append(f"profile: {e}")
     if "records" in benches:
         try:
             baseline = _load(args.baseline_records)
