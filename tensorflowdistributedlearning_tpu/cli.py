@@ -415,9 +415,38 @@ def build_parser() -> argparse.ArgumentParser:
         "StableHLO artifact (bucketed compilation, bounded-queue "
         "backpressure, /v1/predict + /healthz + /metrics)",
     )
-    p_serve.add_argument("--artifact-dir", required=True,
+    p_serve.add_argument("--artifact-dir", default=None,
                          help="artifact directory from export_serving "
-                         "(serving.stablehlo + manifest.json)")
+                         "(serving.stablehlo + manifest.json); required "
+                         "unless --registry names the artifacts")
+    p_serve.add_argument("--registry", default=None, metavar="PATH",
+                         help="multi-tenant load: a registry.json "
+                         "(serve/registry.py schema) — EVERY entry's "
+                         "artifact loads into this replica as its own "
+                         "engine + micro-batcher, requests route by the "
+                         "payload's \"model\" key, and per-model SLOs / "
+                         "bucket ladders / prewarm budgets apply")
+    p_serve.add_argument("--model", default=None,
+                         help="name this replica serves under (the registry "
+                         "entry a fleet bound it to); stamps /healthz "
+                         "identity, per-model metrics labels, and "
+                         "serve_window events")
+    p_serve.add_argument("--model-version", type=int, default=None,
+                         help="registry version of the served artifact "
+                         "(advertised on /healthz and /metrics; flips on "
+                         "promote)")
+    p_serve.add_argument("--prewarm-buckets", type=int, default=None,
+                         help="warm only the first K buckets of the ladder "
+                         "at spawn (smallest first); colder buckets compile "
+                         "on first hit, ledgered per bucket as "
+                         "serve/cold_bucket_hits — trades spawn-to-ready "
+                         "time against first-request stalls")
+    p_serve.add_argument("--visible-devices", default=None, metavar="IDS",
+                         help="comma-separated accelerator ordinals this "
+                         "replica may claim (exported as *_VISIBLE_DEVICES "
+                         "before the runtime initializes) — how a "
+                         "multi-tenant fleet places replicas on disjoint "
+                         "chips")
     p_serve.add_argument("--host", default="127.0.0.1")
     p_serve.add_argument("--port", type=int, default=8000,
                          help="0 = any free port (printed on startup)")
@@ -480,9 +509,26 @@ def build_parser() -> argparse.ArgumentParser:
         "plus optional autoscaling on sustained queue depth and the SLO "
         "error budget (fleet_scale ledger events)",
     )
-    p_fleet.add_argument("--artifact-dir", required=True,
+    p_fleet.add_argument("--artifact-dir", default=None,
                          help="artifact directory every replica serves "
-                         "(export_serving output)")
+                         "(export_serving output); required unless "
+                         "--registry (or a registry.json in --workdir) "
+                         "names per-model artifacts")
+    p_fleet.add_argument("--registry", default=None, metavar="PATH",
+                         help="multi-tenant fleet: a registry.json "
+                         "(serve/registry.py schema). Each model entry "
+                         "spawns its OWN replica set with its artifact, "
+                         "bucket ladder, SLO, prewarm budget, fair-share "
+                         "weight, and visible-device slots; the router "
+                         "routes by the payload's \"model\" key and sheds "
+                         "by fair share under saturation. When omitted, a "
+                         "registry.json already in --workdir is picked up "
+                         "automatically")
+    p_fleet.add_argument("--chip-budget", type=int, default=None,
+                         help="fleet-wide chip ceiling for per-model "
+                         "autoscaling: sum(replicas x chips_per_replica) "
+                         "never exceeds this — an over-budget scale-up is "
+                         "ledgered as budget_deferred instead of applied")
     p_fleet.add_argument("--workdir", default=None,
                          help="shared fleet workdir: the controller writes "
                          "telemetry.jsonl, replica i telemetry-{i}.jsonl — "
@@ -561,6 +607,12 @@ def build_parser() -> argparse.ArgumentParser:
     p_prom.add_argument("--candidate-dir", default=None,
                         help="the artifact directory to promote "
                         "(export_serving output); required unless --abort")
+    p_prom.add_argument("--model", default=None,
+                        help="multi-tenant fleet: promote ONLY this "
+                        "registry model — its replicas roll, completion "
+                        "flips its registry.json entry (version bump), and "
+                        "every other tenant keeps serving untouched; "
+                        "REQUIRED when the fleet serves more than one model")
     p_prom.add_argument("--reference-dir", default=None,
                         help="float32 reference for the quantize-check "
                         "admission gate (fingerprint pairing + accuracy "
@@ -1264,8 +1316,33 @@ def cmd_serve(args) -> int:
     """Serve an exported artifact over HTTP: warm every bucket, run the
     micro-batcher behind /v1/predict, drain gracefully on SIGINT/SIGTERM.
     Request-path telemetry lands in {workdir}/telemetry.jsonl; render it with
-    ``telemetry-report``."""
+    ``telemetry-report``. With ``--registry`` the replica loads EVERY model
+    entry (its own engine + micro-batcher each) and routes requests by the
+    payload's ``model`` key."""
+    import os
     import signal
+
+    from tensorflowdistributedlearning_tpu.serve.registry import (
+        DEFAULT_MODEL,
+        read_registry,
+    )
+
+    if not args.artifact_dir and not args.registry:
+        print(
+            "serve: one of --artifact-dir or --registry is required",
+            file=sys.stderr,
+        )
+        return 2
+    if args.visible_devices:
+        # device placement must land BEFORE the accelerator runtime
+        # initializes (the first jax import below): every runtime reads its
+        # own variable, so export the mask under each spelling
+        for var in (
+            "CUDA_VISIBLE_DEVICES",
+            "HIP_VISIBLE_DEVICES",
+            "TPU_VISIBLE_CHIPS",
+        ):
+            os.environ[var] = args.visible_devices
 
     from tensorflowdistributedlearning_tpu.obs import Telemetry
     from tensorflowdistributedlearning_tpu.resilience import faults
@@ -1276,13 +1353,44 @@ def cmd_serve(args) -> int:
         bind_ephemeral,
     )
 
+    # every model this replica serves: (entry, fleet-default fallbacks
+    # resolved). Single-artifact stays the one-entry degenerate case.
+    entries = None
+    if args.registry:
+        registry = read_registry(
+            os.path.dirname(os.path.abspath(args.registry)),
+            path=args.registry,
+        )
+        entries = list(registry.models.values())
+        if args.model:
+            entries = [registry.entry(args.model)]
     # bind BEFORE telemetry: with --port 0 the kernel picks the port, and the
     # run header (written at Telemetry construction) must carry the REAL one
     # — it is how a fleet test/manager spawning N replicas learns each
     # endpoint without port races
     sock = bind_ephemeral(args.host, args.port)
     port = sock.getsockname()[1]
-    workdir = args.workdir or args.artifact_dir
+    workdir = (
+        args.workdir
+        or args.artifact_dir
+        or os.path.dirname(os.path.abspath(args.registry))
+    )
+    run_info = {
+        "kind": "serve",
+        "replica": args.replica_id,
+        "artifact_dir": args.artifact_dir,
+        "buckets": list(args.buckets),
+        "max_wait_ms": args.max_wait_ms,
+        "queue_size": args.queue_size,
+        "port": port,
+        "endpoint": f"http://{args.host}:{port}",
+    }
+    if args.model:
+        run_info["model"] = args.model
+    if entries is not None:
+        run_info["models"] = {e.name: e.version for e in entries}
+    if args.visible_devices:
+        run_info["visible_devices"] = args.visible_devices
     telemetry = Telemetry(
         workdir,
         trace_sample_rate=args.trace_sample_rate,
@@ -1290,60 +1398,140 @@ def cmd_serve(args) -> int:
         # replicas sharing one workdir leave per-replica ledgers the
         # telemetry-report merge attributes individually (obs/fleet.py)
         process_index=args.replica_id,
-        run_info={
-            "kind": "serve",
-            "replica": args.replica_id,
-            "artifact_dir": args.artifact_dir,
-            "buckets": list(args.buckets),
-            "max_wait_ms": args.max_wait_ms,
-            "queue_size": args.queue_size,
-            "port": port,
-            "endpoint": f"http://{args.host}:{port}",
-        },
+        run_info=run_info,
     )
     if getattr(args, "inject_fault", None):
         # the serving-tier drill seam: sigkill@N fires off the request path
         # (serve/server.py) — a replica that vanishes mid-soak, on schedule
         faults.install(args.inject_fault, seed=getattr(args, "seed", 0))
-    engine = InferenceEngine.from_artifact(
-        args.artifact_dir,
-        buckets=args.buckets,
-        registry=telemetry.registry,
-        tracer=telemetry.tracer,
-    )
-    warmup_s = engine.warmup(telemetry=telemetry)
-    batcher = MicroBatcher(
-        engine,
-        max_wait_ms=args.max_wait_ms,
-        max_queue=args.queue_size,
-        default_deadline_ms=args.default_deadline_ms,
-    )
-    server = ServingServer(
-        engine,
-        batcher,
-        host=args.host,
-        port=args.port,
-        telemetry=telemetry,
-        window_secs=args.window_secs,
-        slo_p99_ms=args.slo_p99_ms,
-        slo_error_budget=args.slo_error_budget,
-        replica_id=args.replica_id,
-        sock=sock,
-    )
+    if entries is None:
+        # single-artifact (possibly model-labelled, fleet-spawned) load
+        engine = InferenceEngine.from_artifact(
+            args.artifact_dir,
+            buckets=args.buckets,
+            registry=telemetry.registry,
+            tracer=telemetry.tracer,
+        )
+        warmup_s = engine.warmup(
+            telemetry=telemetry, budget=args.prewarm_buckets
+        )
+        batcher = MicroBatcher(
+            engine,
+            max_wait_ms=args.max_wait_ms,
+            max_queue=args.queue_size,
+            default_deadline_ms=args.default_deadline_ms,
+        )
+        server = ServingServer(
+            engine,
+            batcher,
+            host=args.host,
+            port=args.port,
+            telemetry=telemetry,
+            window_secs=args.window_secs,
+            slo_p99_ms=args.slo_p99_ms,
+            slo_error_budget=args.slo_error_budget,
+            replica_id=args.replica_id,
+            sock=sock,
+            model=args.model or DEFAULT_MODEL,
+            registry_version=args.model_version,
+        )
+        warmup_field = {str(b): s for b, s in warmup_s.items()}
+        models_field = (
+            {args.model: args.model_version or 1} if args.model else None
+        )
+    else:
+        from tensorflowdistributedlearning_tpu.obs.metrics import (
+            MetricsRegistry,
+        )
+
+        engines = []
+        for i, entry in enumerate(entries):
+            # one MetricsRegistry per tenant: the primary rides the
+            # telemetry registry (legacy single-tenant metric names keep
+            # meaning "the whole replica"), later tenants isolate theirs
+            engines.append(
+                InferenceEngine.from_artifact(
+                    entry.artifact_dir,
+                    buckets=entry.buckets or tuple(args.buckets),
+                    registry=(
+                        telemetry.registry if i == 0 else MetricsRegistry()
+                    ),
+                    tracer=telemetry.tracer,
+                )
+            )
+        warmup_field = {}
+        for i, (entry, eng) in enumerate(zip(entries, engines)):
+            # warm EVERY engine before arming the recompile detector: the
+            # mark lands once, after the last — earlier engines' compiles
+            # are warmup, not steady-state recompiles
+            timings = eng.warmup(
+                telemetry=telemetry,
+                budget=entry.prewarm_budget,
+                mark_warm=(i == len(engines) - 1),
+            )
+            warmup_field.update(
+                {f"{entry.name}/{b}": s for b, s in timings.items()}
+            )
+        first = entries[0]
+        batcher = MicroBatcher(
+            engines[0],
+            max_wait_ms=args.max_wait_ms,
+            max_queue=args.queue_size,
+            default_deadline_ms=args.default_deadline_ms,
+        )
+        server = ServingServer(
+            engines[0],
+            batcher,
+            host=args.host,
+            port=args.port,
+            telemetry=telemetry,
+            window_secs=args.window_secs,
+            slo_p99_ms=(
+                first.slo_p99_ms
+                if first.slo_p99_ms is not None
+                else args.slo_p99_ms
+            ),
+            slo_error_budget=(
+                first.slo_error_budget
+                if first.slo_error_budget is not None
+                else args.slo_error_budget
+            ),
+            replica_id=args.replica_id,
+            sock=sock,
+            model=first.name,
+            registry_version=first.version,
+        )
+        for entry, eng in zip(entries[1:], engines[1:]):
+            server.add_model(
+                entry.name,
+                eng,
+                MicroBatcher(
+                    eng,
+                    max_wait_ms=args.max_wait_ms,
+                    max_queue=args.queue_size,
+                    default_deadline_ms=args.default_deadline_ms,
+                ),
+                version=entry.version,
+                slo_p99_ms=entry.slo_p99_ms,
+                slo_error_budget=(
+                    entry.slo_error_budget
+                    if entry.slo_error_budget is not None
+                    else 0.01
+                ),
+            )
+        models_field = {e.name: e.version for e in entries}
     server.start()
-    print(
-        json.dumps(
-            {
-                "serving": server.url,
-                "port": server.port,
-                "replica": args.replica_id,
-                "buckets": list(engine.buckets),
-                "warmup_s": {str(b): s for b, s in warmup_s.items()},
-                "ledger": workdir,
-            }
-        ),
-        flush=True,
-    )
+    ready = {
+        "serving": server.url,
+        "port": server.port,
+        "replica": args.replica_id,
+        "buckets": list(server.engine.buckets),
+        "warmup_s": warmup_field,
+        "ledger": workdir,
+    }
+    if models_field:
+        ready["models"] = models_field
+    print(json.dumps(ready), flush=True)
     # resilience contract for the serving tier: SIGTERM = graceful drain
     server.install_signal_handlers((signal.SIGINT, signal.SIGTERM))
     try:
@@ -1359,6 +1547,7 @@ def cmd_serve_fleet(args) -> int:
     router, with optional autoscaling — one SIGTERM drains the whole fleet.
     All ledgers (controller + replicas) land in one workdir; render the
     merged story with ``telemetry-report``."""
+    import os
     import signal
 
     from tensorflowdistributedlearning_tpu.obs import Telemetry
@@ -1367,6 +1556,43 @@ def cmd_serve_fleet(args) -> int:
         FleetConfig,
         ServeFleet,
         bind_ephemeral,
+    )
+    from tensorflowdistributedlearning_tpu.serve.registry import (
+        RegistryError,
+        read_registry,
+        registry_path,
+    )
+
+    if not args.artifact_dir and not args.registry and not (
+        args.workdir and os.path.exists(registry_path(args.workdir))
+    ):
+        print(
+            "serve-fleet: one of --artifact-dir or --registry is required "
+            "(or a registry.json in --workdir)",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        if args.registry:
+            registry = read_registry(
+                os.path.dirname(os.path.abspath(args.registry)),
+                path=args.registry,
+            )
+        else:
+            # workdir registry.json is picked up automatically; a plain
+            # --artifact-dir fleet synthesizes the implicit one-entry
+            # registry (fully legacy behavior)
+            registry = read_registry(
+                args.workdir or args.artifact_dir,
+                default_artifact_dir=args.artifact_dir,
+            )
+    except RegistryError as e:
+        print(f"serve-fleet: {e}", file=sys.stderr)
+        return 2
+    # the fleet default artifact backs legacy replicas and rollback spawns;
+    # with a registry and no --artifact-dir, the first entry's stands in
+    default_artifact_dir = (
+        args.artifact_dir or next(iter(registry.models.values())).artifact_dir
     )
 
     fault_specs = {}
@@ -1382,22 +1608,29 @@ def cmd_serve_fleet(args) -> int:
         fault_specs[int(rid)] = spec
     sock = bind_ephemeral(args.host, args.port)
     port = sock.getsockname()[1]
-    workdir = args.workdir or args.artifact_dir
-    telemetry = Telemetry(
-        workdir,
-        run_info={
-            "kind": "serve-fleet",
-            "artifact_dir": args.artifact_dir,
-            "replicas": args.replicas,
-            "autoscale": not args.no_autoscale,
-            "port": port,
-            "endpoint": f"http://{args.host}:{port}",
-        },
+    workdir = args.workdir or args.artifact_dir or os.path.dirname(
+        os.path.abspath(args.registry)
     )
+    run_info = {
+        "kind": "serve-fleet",
+        "artifact_dir": default_artifact_dir,
+        "replicas": args.replicas,
+        "autoscale": not args.no_autoscale,
+        "port": port,
+        "endpoint": f"http://{args.host}:{port}",
+    }
+    if not registry.implicit:
+        run_info["models"] = {
+            name: e.version for name, e in registry.models.items()
+        }
+        if args.chip_budget is not None:
+            run_info["chip_budget"] = args.chip_budget
+    telemetry = Telemetry(workdir, run_info=run_info)
     fleet = ServeFleet(
         FleetConfig(
-            artifact_dir=args.artifact_dir,
+            artifact_dir=default_artifact_dir,
             workdir=workdir,
+            registry=registry,
             buckets=tuple(args.buckets),
             max_wait_ms=args.max_wait_ms,
             queue_size=args.queue_size,
@@ -1426,23 +1659,24 @@ def cmd_serve_fleet(args) -> int:
         autoscale_interval_s=args.autoscale_interval_s,
         poll_interval_s=args.poll_interval_s,
         window_secs=args.window_secs,
+        chip_budget=args.chip_budget,
     )
     fleet.start(args.replicas)
-    print(
-        json.dumps(
-            {
-                "router": fleet.url,
-                "port": port,
-                "replicas": [
-                    {"replica": rid, "endpoint": url}
-                    for rid, url in fleet.manager.endpoints()
-                ],
-                "autoscale": not args.no_autoscale,
-                "ledger": workdir,
-            }
-        ),
-        flush=True,
-    )
+    ready = {
+        "router": fleet.url,
+        "port": port,
+        "replicas": [
+            {"replica": rid, "endpoint": url}
+            for rid, url in fleet.manager.endpoints()
+        ],
+        "autoscale": not args.no_autoscale,
+        "ledger": workdir,
+    }
+    if not registry.implicit:
+        ready["models"] = {
+            name: e.version for name, e in registry.models.items()
+        }
+    print(json.dumps(ready), flush=True)
     fleet.install_signal_handlers((signal.SIGINT, signal.SIGTERM))
     try:
         fleet.wait()
@@ -1520,6 +1754,8 @@ def cmd_promote(args) -> int:
                 payload["reference_dir"] = os.path.abspath(args.reference_dir)
             if args.canary_inject_fault:
                 payload["fault_spec"] = args.canary_inject_fault
+            if args.model:
+                payload["model"] = args.model
             for key in (
                 "shadow_secs",
                 "shadow_fraction",

@@ -300,6 +300,26 @@ def _process_row(led: ProcessLedger) -> Dict:
         }
         if last.get("replica") is not None:
             serve["replica"] = last["replica"]
+        # multi-tenant attribution: a model-bound replica stamps its model
+        # (and registry version) on every window; a replica mounting several
+        # models carries a per-model sub-dict instead
+        if last.get("model") is not None:
+            serve["model"] = last["model"]
+            if last.get("model_version") is not None:
+                serve["model_version"] = last["model_version"]
+        models = last.get("models")
+        if isinstance(models, dict):
+            serve["models"] = {
+                name: {
+                    "version": mrow.get("version"),
+                    "requests": mrow.get("requests", 0),
+                    "completed": mrow.get("completed", 0),
+                    "p99_ms": (
+                        (mrow.get("latency_ms") or {}).get("request") or {}
+                    ).get("p99_ms"),
+                }
+                for name, mrow in models.items()
+            }
         p99s = [
             e["latency_ms"]["request"]["p99_ms"]
             for e in serve_windows
@@ -427,6 +447,50 @@ def fleet_section(
             "median": round(median, 4),
             "min_process": worst[0],
         }
+    # per-model serving rollup across the fleet: replica count, completed
+    # totals, worst replica p99 per tenant (both attribution shapes merge —
+    # single-model replicas' top-level stamp and multi-mount sub-dicts)
+    model_totals: Dict[str, Dict] = {}
+    for r in rows:
+        sv = r.get("serve")
+        if not sv:
+            continue
+        per = sv.get("models")
+        if not per and sv.get("model"):
+            per = {
+                sv["model"]: {
+                    "version": sv.get("model_version"),
+                    "requests": sv.get("requests", 0),
+                    "completed": sv.get("completed", 0),
+                    "p99_ms": sv.get("request_p99_worst_window_ms"),
+                }
+            }
+        if not per:
+            continue
+        for name, mrow in per.items():
+            agg = model_totals.setdefault(
+                name,
+                {
+                    "replicas": 0,
+                    "requests": 0,
+                    "completed": 0,
+                    "worst_p99_ms": None,
+                    "versions": {},
+                },
+            )
+            agg["replicas"] += 1
+            agg["requests"] += int(mrow.get("requests") or 0)
+            agg["completed"] += int(mrow.get("completed") or 0)
+            p99 = mrow.get("p99_ms")
+            if p99 is not None:
+                agg["worst_p99_ms"] = max(
+                    agg["worst_p99_ms"] or 0.0, float(p99)
+                )
+            if mrow.get("version") is not None:
+                key = str(mrow["version"])
+                agg["versions"][key] = agg["versions"].get(key, 0) + 1
+    if model_totals:
+        section["models"] = model_totals
     straggler = straggler_section(ledgers, skew_threshold=skew_threshold)
     if straggler:
         section["straggler"] = straggler
@@ -480,8 +544,9 @@ def render_fleet_section(section: Dict) -> List[str]:
             replica = (
                 f" replica {sv['replica']}" if "replica" in sv else ""
             )
+            model = f"[{sv['model']}]" if sv.get("model") else ""
             parts.append(
-                f"serve{replica}: {sv['completed']}/{sv['requests']} ok"
+                f"serve{model}{replica}: {sv['completed']}/{sv['requests']} ok"
             )
         if row.get("cost", {}).get("rps_per_chip") is not None:
             parts.append(f"{row['cost']['rps_per_chip']:.1f} rps/chip")
@@ -514,6 +579,22 @@ def render_fleet_section(section: Dict) -> List[str]:
                 f"p90 {pr['p90'] * 1000:.3f}  "
                 f"p99(worst replica) {pr['p99_worst_replica'] * 1000:.3f}"
             )
+    models = section.get("models")
+    if models:
+        lines.append("  models:")
+        for name, m in models.items():
+            line = (
+                f"    {name}: {m['replicas']} replica(s), "
+                f"{m['completed']}/{m['requests']} ok"
+            )
+            if m.get("worst_p99_ms") is not None:
+                line += f", worst p99 {m['worst_p99_ms']:.1f}ms"
+            if m.get("versions"):
+                vers = "/".join(sorted(m["versions"]))
+                line += f", v{vers}"
+                if len(m["versions"]) > 1:
+                    line += " (mixed — promotion in flight?)"
+            lines.append(line)
     fleet_mfu = section.get("mfu")
     if fleet_mfu:
         line = (

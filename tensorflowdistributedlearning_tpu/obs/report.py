@@ -105,6 +105,15 @@ def _serve_section(windows: List[Dict]) -> Dict:
         )
     if windows[-1].get("slo"):
         section["slo"] = windows[-1]["slo"]
+    # multi-tenant replica: per-model counters/latency/SLO ride in the last
+    # window's "models" dict (serve/server.py emit_window); a single-tenant
+    # model-aware replica stamps "model"/"model_version" at top level
+    if last.get("models"):
+        section["models"] = last["models"]
+    elif last.get("model"):
+        section["model"] = last["model"]
+        if last.get("model_version") is not None:
+            section["model_version"] = last["model_version"]
     latency: Dict = {}
     for name in ("queue_wait", "pad", "compute", "request"):
         per_window = [
@@ -141,7 +150,17 @@ def silent_mixed_fleet(fleet_state: Optional[Dict]) -> bool:
     event's ``fleet`` payload)."""
     fleet_state = fleet_state or {}
     artifacts = fleet_state.get("artifacts") or {}
-    return len(artifacts) > 1 and not fleet_state.get("promotion_active")
+    if len(artifacts) <= 1 or fleet_state.get("promotion_active"):
+        return False
+    models = fleet_state.get("models") or {}
+    if models:
+        # multi-tenant fleet: distinct artifacts per model are the design,
+        # not drift — the mix is only "silent" when a single model answers
+        # from more than one registry version with no promotion in charge
+        return any(
+            len(row.get("versions") or {}) > 1 for row in models.values()
+        )
+    return True
 
 
 def _serve_fleet_section(events: List[Dict]) -> Optional[Dict]:
@@ -180,6 +199,12 @@ def _serve_fleet_section(events: List[Dict]) -> Optional[Dict]:
         # identity): >1 distinct artifact OUTSIDE an active promotion is a
         # silent mixed fleet — rendered as a warning, not trivia
         fleet_state = last.get("fleet") or {}
+        if fleet_state.get("models"):
+            # multi-tenant routing: per-model replica sets, backlog, worst
+            # p99, version mix, and the router's own per-model counters
+            section["router"]["models"] = fleet_state["models"]
+        if last.get("fair_share"):
+            section["router"]["fair_share"] = last["fair_share"]
         artifacts = fleet_state.get("artifacts") or {}
         if artifacts:
             section["router"]["artifacts"] = artifacts
@@ -194,17 +219,22 @@ def _serve_fleet_section(events: List[Dict]) -> Optional[Dict]:
             "scale_down": sum(
                 1 for e in scales if e.get("action") == "scale_down"
             ),
+            "budget_deferred": sum(
+                1 for e in scales if e.get("action") == "budget_deferred"
+            ),
             "final_replicas": scales[-1].get("to_replicas"),
             "events": [
                 {
                     k: e.get(k)
                     for k in (
                         "action",
+                        "model",
                         "from_replicas",
                         "to_replicas",
                         "reason",
                         "mean_queue_depth",
                     )
+                    if k != "model" or e.get("model") is not None
                 }
                 for e in scales[-10:]
             ],
@@ -1192,6 +1222,11 @@ def render_report(report: Dict) -> str:
         dtype_tag = (
             f" [{sv['serving_dtype']}]" if sv.get("serving_dtype") else ""
         )
+        if sv.get("model"):
+            ver = sv.get("model_version")
+            dtype_tag += f" [{sv['model']}" + (
+                f" v{ver}]" if ver is not None else "]"
+            )
         lines.append(
             f"\nserving{dtype_tag} ({sv['windows']} window(s)): "
             f"{sv['requests']} requests, {sv['completed']} completed, "
@@ -1204,6 +1239,25 @@ def render_report(report: Dict) -> str:
                 f"  batches: {sv['batches']} "
                 f"(mean fill {sv.get('mean_batch_fill', 0):.1f} examples)"
             )
+        for name, m in sorted((sv.get("models") or {}).items()):
+            p99 = (
+                (m.get("latency_ms") or {}).get("request") or {}
+            ).get("p99_ms")
+            mline = (
+                f"  model {name} v{m.get('version', '?')}: "
+                f"{m.get('completed', 0)}/{m.get('requests', 0)} ok"
+            )
+            if p99 is not None:
+                mline += f", window p99 {p99:.1f}ms"
+            mslo = m.get("slo")
+            if mslo:
+                mline += (
+                    f", SLO {mslo['p99_target_ms']:.0f}ms "
+                    + ("met" if mslo.get("healthy", True) else "BREACHED")
+                )
+            if m.get("serving_dtype"):
+                mline += f" [{m['serving_dtype']}]"
+            lines.append(mline)
         if sv.get("bucket_hits"):
             hits = "  ".join(
                 f"{b}:{n}" for b, n in sorted(
@@ -1270,6 +1324,42 @@ def render_report(report: Dict) -> str:
                     f"{fl.get('draining', 0)} draining, "
                     f"{fl.get('dead', 0)} dead"
                 )
+            for name, m in sorted((rt.get("models") or {}).items()):
+                mline = (
+                    f"  model {name}: {m.get('replicas', 0)} replica(s), "
+                    f"{m.get('routed', 0)}/{m.get('requests', 0)} routed, "
+                    f"{m.get('shed', 0)} shed "
+                    f"({m.get('fair_shed', 0)} fair-shed)"
+                )
+                if m.get("worst_p99_ms") is not None:
+                    mline += f", worst p99 {m['worst_p99_ms']:.1f}ms"
+                versions = m.get("versions") or {}
+                if versions:
+                    mline += ", " + "/".join(
+                        f"v{v}:{n}" for v, n in sorted(versions.items())
+                    )
+                    if len(versions) > 1:
+                        mline += " (mixed — promotion in flight?)"
+                lines.append(mline)
+            fs = rt.get("fair_share")
+            if fs and fs.get("admitted_shares"):
+                weights = fs.get("weights") or {}
+                total_w = sum(weights.values()) or 1.0
+                bits = [
+                    f"{name} {share:.0%}"
+                    + (
+                        f" (fair {weights[name] / total_w:.0%})"
+                        if name in weights
+                        else ""
+                    )
+                    for name, share in sorted(
+                        fs["admitted_shares"].items()
+                    )
+                ]
+                tag = " UNDER PRESSURE" if fs.get("pressured") else ""
+                lines.append(
+                    f"  admitted shares{tag}: " + ", ".join(bits)
+                )
             if rt.get("artifacts"):
                 mix = "  ".join(
                     f"{key}:{n}" for key, n in sorted(rt["artifacts"].items())
@@ -1284,14 +1374,26 @@ def render_report(report: Dict) -> str:
                     )
         sc = sf.get("autoscale")
         if sc:
+            counts = (
+                f"({sc['scale_up']} up / {sc['scale_down']} down"
+                + (
+                    f" / {sc['budget_deferred']} budget-deferred"
+                    if sc.get("budget_deferred")
+                    else ""
+                )
+                + ")"
+            )
             lines.append(
-                f"  autoscale: {sc['decisions']} decision(s) "
-                f"({sc['scale_up']} up / {sc['scale_down']} down), "
+                f"  autoscale: {sc['decisions']} decision(s) {counts}, "
                 f"final target {sc['final_replicas']} replica(s)"
             )
             for e in sc["events"][-3:]:
+                model_tag = (
+                    f"[{e['model']}] " if e.get("model") else ""
+                )
                 lines.append(
-                    f"    - {e['action']}: {e['from_replicas']} -> "
+                    f"    - {model_tag}{e['action']}: "
+                    f"{e['from_replicas']} -> "
                     f"{e['to_replicas']} ({e['reason']}, mean queue "
                     f"{e['mean_queue_depth']})"
                 )

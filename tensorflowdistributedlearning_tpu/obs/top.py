@@ -128,6 +128,11 @@ def _process_status(led: fleet_lib.ProcessLedger, now: float) -> Dict:
         }
         if serve.get("replica") is not None:
             srow["replica"] = serve["replica"]
+        if serve.get("model"):
+            srow["model"] = serve["model"]
+        elif serve.get("models"):
+            # multi-tenant replica: name the mounted tenants compactly
+            srow["models"] = sorted(serve["models"])
         req = (serve.get("latency_ms") or {}).get("request") or {}
         if req.get("p99_ms") is not None:
             srow["p99_ms"] = req["p99_ms"]
@@ -145,6 +150,20 @@ def _process_status(led: fleet_lib.ProcessLedger, now: float) -> Dict:
             "live": fleet_state.get("live", 0),
             "status": fleet_state.get("status", "?"),
         }
+        models = fleet_state.get("models") or {}
+        if models:
+            row["router"]["models"] = {
+                name: {
+                    "replicas": m.get("replicas", 0),
+                    "shed": m.get("shed", 0),
+                    **(
+                        {"worst_p99_ms": m["worst_p99_ms"]}
+                        if m.get("worst_p99_ms") is not None
+                        else {}
+                    ),
+                }
+                for name, m in models.items()
+            }
         artifacts = fleet_state.get("artifacts") or {}
         if artifacts:
             from tensorflowdistributedlearning_tpu.obs import (
@@ -327,9 +346,15 @@ def render_frame(frame: Dict) -> str:
             lines.append("  ".join(bits))
         sv = row.get("serve")
         if sv:
+            model_tag = ""
+            if sv.get("model"):
+                model_tag = f" [{sv['model']}]"
+            elif sv.get("models"):
+                model_tag = f" [{'+'.join(sv['models'])}]"
             bits = [
                 f"  serve"
                 + (f" r{sv['replica']}" if "replica" in sv else "")
+                + model_tag
                 + f": {sv['completed']}/{sv['requests']} ok",
                 f"backlog {sv['backlog']}",
             ]
@@ -348,6 +373,14 @@ def render_frame(frame: Dict) -> str:
             if rt.get("mixed"):
                 line += "  !! MIXED ARTIFACTS (no promotion active)"
             lines.append(line)
+            for name, m in sorted((rt.get("models") or {}).items()):
+                mline = (
+                    f"    {name}: {m['replicas']} replica(s), "
+                    f"{m['shed']} shed"
+                )
+                if m.get("worst_p99_ms") is not None:
+                    mline += f", p99 {m['worst_p99_ms']:.1f}ms"
+                lines.append(mline)
         mem = row.get("memory")
         if mem:
             line = f"  hbm peak {_fmt_bytes(mem['peak_bytes'])}"

@@ -194,3 +194,112 @@ class Autoscaler:
         }
         self.decisions.append(decision)
         return decision
+
+
+class FleetAutoscaler:
+    """Per-model autoscaling inside one fleet-wide chip budget.
+
+    One :class:`Autoscaler` state machine per registry model (each with its
+    own min/max bounds from the model's entry), all drawing replicas from a
+    shared pool of chips: a model may scale up only while the fleet's total
+    chip claim (``sum over models of replicas * chips_per_replica``) stays
+    within ``chip_budget``. A scale-up the budget refuses is returned as an
+    explicit ``budget_deferred`` decision (ledgered, not silently dropped) —
+    the pressure signal persists, so the capacity is granted the moment
+    another model's idle detector releases chips.
+
+    ``evaluate`` consumes the router ``fleet_snapshot()`` with its
+    ``models`` sub-dict (per-model live replicas / backlog / shed counters)
+    plus the manager's per-model starting counts, and returns the list of
+    decisions for this tick, each stamped with its ``model``."""
+
+    def __init__(
+        self,
+        configs: Dict[str, AutoscaleConfig],
+        *,
+        chip_budget: Optional[int] = None,
+        chips_per_replica: Optional[Dict[str, int]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if not configs:
+            raise ValueError("FleetAutoscaler needs at least one model config")
+        self.scalers: Dict[str, Autoscaler] = {
+            name: Autoscaler(cfg, clock=clock)
+            for name, cfg in configs.items()
+        }
+        self.chip_budget = chip_budget
+        self.chips_per_replica = dict(chips_per_replica or {})
+        min_claim = sum(
+            cfg.min_replicas * self.chips(name)
+            for name, cfg in configs.items()
+        )
+        if chip_budget is not None and min_claim > chip_budget:
+            raise ValueError(
+                f"chip_budget {chip_budget} cannot satisfy the models' "
+                f"min_replicas floor ({min_claim} chips)"
+            )
+
+    def chips(self, model: str) -> int:
+        return int(self.chips_per_replica.get(model, 1))
+
+    def _fleet_chips(self, capacities: Dict[str, int]) -> int:
+        return sum(n * self.chips(m) for m, n in capacities.items())
+
+    def evaluate(
+        self,
+        snapshot: Dict,
+        *,
+        starting_by_model: Optional[Dict[str, int]] = None,
+    ) -> List[Dict]:
+        """One tick over every model. ``snapshot["models"]`` rows supply the
+        per-model signals; models with no row yet (fleet still warming)
+        evaluate on zeros, which keeps their min-replica floor enforced."""
+        models = snapshot.get("models") or {}
+        starting_by_model = starting_by_model or {}
+        # capacity census BEFORE any decision: budget math sees the whole
+        # fleet, not just the model being evaluated
+        capacities = {
+            name: int((models.get(name) or {}).get("replicas", 0))
+            + int(starting_by_model.get(name, 0))
+            for name in self.scalers
+        }
+        decisions: List[Dict] = []
+        for name, scaler in self.scalers.items():
+            row = models.get(name) or {}
+            sub = {
+                "live": int(row.get("replicas", 0)),
+                "starting": int(starting_by_model.get(name, 0)),
+                # per-model degraded signal: worst replica p99 over the
+                # model's own SLO rides in through "degraded" rows when the
+                # poller saw them; absent = 0
+                "degraded": int(row.get("degraded", 0)),
+                "queue_depth_total": float(row.get("queue_depth", 0.0)),
+                "shed_total": int(row.get("shed", 0)),
+            }
+            decision = scaler.evaluate(sub)
+            if decision is None:
+                continue
+            decision["model"] = name
+            if decision["action"] == "scale_up":
+                grow = decision["to_replicas"] - decision["from_replicas"]
+                claimed = self._fleet_chips(capacities)
+                needed = grow * self.chips(name)
+                if (
+                    self.chip_budget is not None
+                    and claimed + needed > self.chip_budget
+                ):
+                    # refuse within budget — explicit, ledgered, retried on
+                    # a later tick once chips free up
+                    decision["action"] = "budget_deferred"
+                    decision["to_replicas"] = decision["from_replicas"]
+                    decision["chip_budget"] = self.chip_budget
+                    decision["chips_claimed"] = claimed
+                    decision["chips_needed"] = needed
+                else:
+                    capacities[name] += grow
+            elif decision["action"] == "scale_down":
+                capacities[name] += (
+                    decision["to_replicas"] - decision["from_replicas"]
+                )
+            decisions.append(decision)
+        return decisions

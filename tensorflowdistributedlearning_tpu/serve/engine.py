@@ -119,6 +119,15 @@ class InferenceEngine:
         # exactly one ladder of buffers
         self._scratch = threading.local()
         self.warmed = False
+        # buckets actually compiled so far — warmup(budget=N) may leave the
+        # top of the ladder cold on purpose; a cold bucket compiles on its
+        # first hit and that hit is counted (serve/cold_bucket_hits/{b})
+        # so the tradeoff is visible in /metrics and the ledger
+        self.warmed_buckets: set = set()
+        self._cold_counters = {
+            b: self.registry.counter(f"serve/cold_bucket_hits/{b}")
+            for b in self.buckets
+        }
 
     @classmethod
     def from_artifact(
@@ -214,16 +223,38 @@ class InferenceEngine:
             )
         return self.buckets[i]
 
-    def warmup(self, telemetry=None) -> Dict[int, float]:
-        """Compile every bucket up front (zeros input), returning per-bucket
-        wall seconds. After this, steady-state serving touches only warmed
-        shapes — when ``telemetry`` is passed, its recompile detector is
-        marked warm so any later compile is flagged (and ledgered) as the
-        goodput bug it is."""
+    def warmup(
+        self,
+        telemetry=None,
+        *,
+        budget: Optional[int] = None,
+        mark_warm: bool = True,
+    ) -> Dict[int, float]:
+        """Compile the bucket ladder up front (zeros input), returning
+        per-bucket wall seconds. After this, steady-state serving touches
+        only warmed shapes — when ``telemetry`` is passed, its recompile
+        detector is marked warm so any later compile is flagged (and
+        ledgered) as the goodput bug it is.
+
+        ``budget`` caps how many buckets are compiled, smallest first (the
+        registry's ``prewarm_budget`` / ``serve --prewarm-buckets`` knob):
+        spawn-to-ready time trades against a first-request compile stall on
+        each cold bucket. Cold buckets are excluded from the recompile
+        detector's warm mark only in the sense that their first hit is
+        ledgered per bucket (``serve/cold_bucket_hits/{b}``).
+
+        ``mark_warm=False`` defers arming the recompile detector: a replica
+        loading SEVERAL engines (multi-tenant registry load) warms them in
+        sequence and must mark warm once, after the LAST — otherwise every
+        engine after the first would be flagged as a steady-state
+        recompile."""
         import jax
 
+        to_warm = self.buckets
+        if budget is not None and budget < len(self.buckets):
+            to_warm = self.buckets[: max(0, int(budget))]
         timings: Dict[int, float] = {}
-        for b in self.buckets:
+        for b in to_warm:
             # transient zeros: the request-path scratch pads are thread-local
             # and the batcher worker is a different thread than the one
             # running warmup — filling this thread's ladder would just leave
@@ -232,11 +263,16 @@ class InferenceEngine:
             t0 = time.perf_counter()
             jax.block_until_ready(self.serve_fn(x))
             timings[b] = round(time.perf_counter() - t0, 6)
+            self.warmed_buckets.add(b)
         self.warmed = True
         if telemetry is not None:
             warm_fields = {}
             if self.quantization is not None:
                 warm_fields["serving_dtype"] = self.quantization.get("dtype")
+            cold = [b for b in self.buckets if b not in self.warmed_buckets]
+            if cold:
+                warm_fields["cold_buckets"] = [str(b) for b in cold]
+                warm_fields["prewarm_budget"] = len(to_warm)
             telemetry.event(
                 "serve_warmup",
                 buckets={str(b): s for b, s in timings.items()},
@@ -244,7 +280,8 @@ class InferenceEngine:
                 input_dtype=str(self.input_dtype),
                 **warm_fields,
             )
-            telemetry.mark_warm()
+            if mark_warm:
+                telemetry.mark_warm()
             # bucket compilation is the serving tier's peak-HBM moment on
             # most artifacts — ledger it as the compile-phase watermark
             # before request traffic attributes anything to "infer"
@@ -270,6 +307,12 @@ class InferenceEngine:
             )
         n = x.shape[0]
         bucket = self.select_bucket(n)
+        if self.warmed and bucket not in self.warmed_buckets:
+            # cold bucket past a budgeted warmup: this dispatch pays the
+            # compile. Count it (per bucket) and fold the bucket into the
+            # warmed set — the executable is cached from here on.
+            self._cold_counters[bucket].inc()
+            self.warmed_buckets.add(bucket)
         # trace spans nest under the caller's active span (the batcher's
         # batch span) via the tracer's thread-local stack; disabled tracing
         # costs one attribute read per infer

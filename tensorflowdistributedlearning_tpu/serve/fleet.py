@@ -49,6 +49,11 @@ from tensorflowdistributedlearning_tpu.serve.autoscale import (
     FLEET_SCALE_EVENT,
     AutoscaleConfig,
     Autoscaler,
+    FleetAutoscaler,
+)
+from tensorflowdistributedlearning_tpu.serve.registry import (
+    DEFAULT_MODEL,
+    Registry,
 )
 from tensorflowdistributedlearning_tpu.serve.router import FleetRouter
 
@@ -97,6 +102,12 @@ class FleetConfig:
     # threading here so replica scaling is honest on a shared host)
     extra_env: Optional[Dict[str, str]] = None
     python: str = sys.executable
+    # multi-tenant mode: a loaded serve.registry.Registry. Non-implicit
+    # registries make the fleet model-aware — each entry spawns its own
+    # replica set (`entry.replicas` of them) with per-entry artifact dir,
+    # bucket ladder, SLO, prewarm budget, and visible-device slots;
+    # ``artifact_dir`` above then only backs the legacy/implicit path.
+    registry: Optional[Registry] = None
 
 
 class ReplicaProcess:
@@ -119,6 +130,12 @@ class ReplicaProcess:
         # per-replica artifact override (None = the fleet default): persists
         # across restarts, so a promoted canary stays on its candidate
         self.artifact_dir: Optional[str] = None
+        # multi-tenant: the registry model this replica serves (None = the
+        # legacy single-artifact fleet) and its visible-device mask — both
+        # persist across restarts, so a relaunched replica keeps serving the
+        # same tenant on the same chips
+        self.model: Optional[str] = None
+        self.device_mask: Optional[str] = None
         # fault drill for this replica's FIRST launch only (scale_up path)
         self.pending_fault_spec: Optional[str] = None
         # a drain was explicitly requested (scale_down): the decision is
@@ -140,6 +157,10 @@ class ReplicaProcess:
         }
         if self.artifact_dir is not None:
             out["artifact_dir"] = self.artifact_dir
+        if self.model is not None:
+            out["model"] = self.model
+        if self.device_mask is not None:
+            out["device_mask"] = self.device_mask
         return out
 
 
@@ -157,7 +178,17 @@ class FleetManager:
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         self._rng = random.Random(seed)  # restart-backoff jitter
+        # per-model spawn ordinal: drives round-robin over the entry's
+        # declared device_slots so replica i of a model lands on slot
+        # i % len(slots) (restarts keep their mask — only fresh spawns draw)
+        self._model_ordinals: Dict[str, int] = {}
         os.makedirs(config.workdir, exist_ok=True)
+
+    @property
+    def multi_model(self) -> bool:
+        """True when a non-implicit registry drives per-model replica sets."""
+        reg = self.config.registry
+        return reg is not None and not reg.implicit
 
     # -- launch --------------------------------------------------------------
 
@@ -166,11 +197,32 @@ class FleetManager:
         replica_id: int,
         fault_spec: Optional[str],
         artifact_dir: Optional[str] = None,
+        model: Optional[str] = None,
+        device_mask: Optional[str] = None,
     ) -> List[str]:
         cfg = self.config
+        # a model-bound replica launches from its registry entry: the
+        # entry's artifact dir / bucket ladder / SLO / prewarm budget
+        # override the fleet defaults (an explicit artifact_dir still wins —
+        # that is the promotion controller introducing a canary for this
+        # model)
+        entry = None
+        if model is not None and cfg.registry is not None:
+            entry = cfg.registry.entry(model)
+        default_dir = entry.artifact_dir if entry is not None else cfg.artifact_dir
+        buckets = cfg.buckets
+        slo_p99_ms = cfg.slo_p99_ms
+        slo_error_budget = cfg.slo_error_budget
+        if entry is not None:
+            if entry.buckets:
+                buckets = entry.buckets
+            if entry.slo_p99_ms is not None:
+                slo_p99_ms = entry.slo_p99_ms
+            if entry.slo_error_budget is not None:
+                slo_error_budget = entry.slo_error_budget
         argv = [
             cfg.python, "-m", "tensorflowdistributedlearning_tpu", "serve",
-            "--artifact-dir", artifact_dir or cfg.artifact_dir,
+            "--artifact-dir", artifact_dir or default_dir,
             "--workdir", cfg.workdir,
             "--host", cfg.host,
             "--port", "0",
@@ -178,14 +230,23 @@ class FleetManager:
             "--window-secs", str(cfg.window_secs),
             "--max-wait-ms", str(cfg.max_wait_ms),
             "--queue-size", str(cfg.queue_size),
-            "--buckets", *[str(b) for b in cfg.buckets],
+            "--buckets", *[str(b) for b in buckets],
         ]
+        if entry is not None:
+            argv += [
+                "--model", entry.name,
+                "--model-version", str(entry.version),
+            ]
+            if entry.prewarm_budget is not None:
+                argv += ["--prewarm-buckets", str(entry.prewarm_budget)]
+        if device_mask:
+            argv += ["--visible-devices", device_mask]
         if cfg.default_deadline_ms is not None:
             argv += ["--default-deadline-ms", str(cfg.default_deadline_ms)]
-        if cfg.slo_p99_ms is not None:
+        if slo_p99_ms is not None:
             argv += [
-                "--slo-p99-ms", str(cfg.slo_p99_ms),
-                "--slo-error-budget", str(cfg.slo_error_budget),
+                "--slo-p99-ms", str(slo_p99_ms),
+                "--slo-error-budget", str(slo_error_budget),
             ]
         if fault_spec:
             argv += ["--inject-fault", fault_spec]
@@ -198,11 +259,15 @@ class FleetManager:
         restart_of: Optional[ReplicaProcess] = None,
         artifact_dir: Optional[str] = None,
         fault_spec: Optional[str] = None,
+        model: Optional[str] = None,
+        device_mask: Optional[str] = None,
     ) -> ReplicaProcess:
         cfg = self.config
         rep = restart_of if restart_of is not None else ReplicaProcess(replica_id)
         if restart_of is None:
             rep.artifact_dir = artifact_dir
+            rep.model = model
+            rep.device_mask = device_mask
             rep.pending_fault_spec = fault_spec
         rep.state = R_STARTING
         rep.url = None
@@ -219,7 +284,11 @@ class FleetManager:
                 fault_spec = rep.pending_fault_spec
         rep.pending_fault_spec = None
         argv = self._replica_argv(
-            replica_id, fault_spec, artifact_dir=rep.artifact_dir
+            replica_id,
+            fault_spec,
+            artifact_dir=rep.artifact_dir,
+            model=rep.model,
+            device_mask=rep.device_mask,
         )
         env = dict(os.environ)
         # the child runs `-m tensorflowdistributedlearning_tpu`: make the
@@ -261,6 +330,8 @@ class FleetManager:
             restart=rep.restarts,
             fault_spec=fault_spec,
             artifact_dir=rep.artifact_dir or cfg.artifact_dir,
+            model=rep.model,
+            device_mask=rep.device_mask,
         )
         return rep
 
@@ -291,15 +362,41 @@ class FleetManager:
         except (OSError, ValueError):
             pass
 
+    def _draw_device_mask(self, model: Optional[str]) -> Optional[str]:
+        """Next visible-device mask for a fresh replica of ``model`` —
+        round-robin over the entry's device_slots. Caller holds the lock."""
+        if model is None or self.config.registry is None:
+            return None
+        entry = self.config.registry.entry(model)
+        ordinal = self._model_ordinals.get(model, 0)
+        self._model_ordinals[model] = ordinal + 1
+        return entry.device_slot(ordinal)
+
     def start(self, n: int) -> "FleetManager":
-        """Spawn ``n`` replicas, wait for every one to report ready, start
-        the monitor. Raises if any replica fails to come up in time."""
+        """Spawn the fleet, wait for every replica to report ready, start
+        the monitor. Raises if any replica fails to come up in time.
+
+        Legacy single-artifact fleets spawn ``n`` identical replicas. With a
+        non-implicit registry, each model entry spawns its OWN replica set
+        (``entry.replicas`` of them) and ``n`` is ignored — the registry is
+        the fleet plan."""
         with self._lock:
+            plan: List[Optional[str]] = [None] * n
+            if self.multi_model:
+                plan = [
+                    entry.name
+                    for entry in self.config.registry.models.values()
+                    for _ in range(entry.replicas)
+                ]
             reps = []
-            for _ in range(n):
+            for model in plan:
                 rid = self._next_id
                 self._next_id += 1
-                rep = self._spawn(rid)
+                rep = self._spawn(
+                    rid,
+                    model=model,
+                    device_mask=self._draw_device_mask(model),
+                )
                 self._replicas[rid] = rep
                 reps.append(rep)
         deadline = time.monotonic() + self.config.spawn_timeout_s
@@ -337,29 +434,50 @@ class FleetManager:
             by_state[rep.state] = by_state.get(rep.state, 0) + 1
         return by_state
 
+    def starting_by_model(self) -> Dict[str, int]:
+        """Warming replicas per model — the in-flight capacity the per-model
+        autoscaler must count so it never double-orders during a warmup."""
+        out: Dict[str, int] = {}
+        for rep in self.replicas():
+            if rep.state == R_STARTING:
+                key = rep.model or DEFAULT_MODEL
+                out[key] = out.get(key, 0) + 1
+        return out
+
     # -- scaling -------------------------------------------------------------
 
     def scale_up(
         self,
         artifact_dir: Optional[str] = None,
         fault_spec: Optional[str] = None,
+        model: Optional[str] = None,
     ) -> int:
         """Spawn one more replica (returns its id). Non-blocking: the replica
         warms in the background and joins ``endpoints()`` when ready.
         ``artifact_dir`` overrides the fleet default for THIS replica (and
         its restarts) — how the promotion controller introduces a canary;
-        ``fault_spec`` rides its first launch only (drills)."""
+        ``fault_spec`` rides its first launch only (drills); ``model`` binds
+        the replica to that registry entry (multi-tenant fleets)."""
         with self._lock:
             rid = self._next_id
             self._next_id += 1
             rep = self._spawn(
-                rid, artifact_dir=artifact_dir, fault_spec=fault_spec
+                rid,
+                artifact_dir=artifact_dir,
+                fault_spec=fault_spec,
+                model=model,
+                device_mask=self._draw_device_mask(model),
             )
             self._replicas[rid] = rep
         return rid
 
-    def scale_down(self, replica_id: Optional[int] = None) -> Optional[int]:
-        """Drain one replica gracefully (highest-id live one by default):
+    def scale_down(
+        self,
+        replica_id: Optional[int] = None,
+        model: Optional[str] = None,
+    ) -> Optional[int]:
+        """Drain one replica gracefully (highest-id live one by default;
+        ``model`` restricts the pick to that tenant's replica set):
         SIGTERM triggers the serve drain contract, the monitor reaps the
         clean exit. Returns the drained id, or None when nothing matched.
 
@@ -375,11 +493,13 @@ class FleetManager:
                 for r in self._replicas.values()
                 if r.state in (R_LIVE, R_STARTING, R_BACKOFF)
             ]
+            if model is not None:
+                candidates = [r for r in candidates if r.model == model]
             if replica_id is not None:
                 candidates = [
                     r for r in candidates if r.replica_id == replica_id
                 ]
-            else:
+            elif candidates:
                 # never pick a dead-in-backoff replica implicitly: draining
                 # a replica that can actually honor SIGTERM beats cancelling
                 # a restart the operator cannot see
@@ -566,10 +686,20 @@ class ServeFleet:
         autoscale_interval_s: float = 2.0,
         poll_interval_s: float = 0.5,
         window_secs: float = 15.0,
+        chip_budget: Optional[int] = None,
     ):
         self.config = config
         self.telemetry = telemetry if telemetry is not None else NULL_TELEMETRY
         self.manager = FleetManager(config, telemetry=self.telemetry)
+        registry = config.registry
+        multi = registry is not None and not registry.implicit
+        # multi-tenant: the router sheds by fair-share weight from the
+        # registry; every model's weight rides in even if 1.0 (the default)
+        model_weights = (
+            {name: e.weight for name, e in registry.models.items()}
+            if multi
+            else None
+        )
         self.router = FleetRouter(
             self.manager.endpoints,
             host=router_host,
@@ -578,10 +708,31 @@ class ServeFleet:
             telemetry=self.telemetry,
             poll_interval_s=poll_interval_s,
             window_secs=window_secs,
+            model_weights=model_weights,
         )
-        self.autoscaler = (
-            Autoscaler(autoscale) if autoscale is not None else None
-        )
+        if autoscale is not None and multi:
+            # one state machine per model, each bounded by its entry, all
+            # drawing chips from the shared budget
+            configs = {}
+            chips = {}
+            for name, e in registry.models.items():
+                configs[name] = dataclasses.replace(
+                    autoscale,
+                    min_replicas=e.min_replicas,
+                    max_replicas=(
+                        e.max_replicas
+                        if e.max_replicas is not None
+                        else max(autoscale.max_replicas, e.min_replicas)
+                    ),
+                )
+                chips[name] = e.chips_per_replica
+            self.autoscaler: Optional[object] = FleetAutoscaler(
+                configs, chip_budget=chip_budget, chips_per_replica=chips
+            )
+        elif autoscale is not None:
+            self.autoscaler = Autoscaler(autoscale)
+        else:
+            self.autoscaler = None
         # promotion surface (serve/promote.py): every fleet can roll a
         # candidate artifact through canary/shadow/rollback; the router's
         # /admin/promotion endpoints delegate here (lazy import — promote
@@ -607,7 +758,7 @@ class ServeFleet:
         return self.router.url
 
     def start(self, replicas: int) -> "ServeFleet":
-        if self.autoscaler is not None:
+        if isinstance(self.autoscaler, Autoscaler):
             cfg = self.autoscaler.config
             replicas = min(max(replicas, cfg.min_replicas), cfg.max_replicas)
         self.manager.start(replicas)
@@ -618,11 +769,19 @@ class ServeFleet:
                 daemon=True,
             )
             self._autoscale_thread.start()
+        fields = {}
+        if self.manager.multi_model:
+            registry = self.config.registry
+            fields["models"] = {
+                name: e.replicas for name, e in registry.models.items()
+            }
+            replicas = sum(fields["models"].values())
         self.telemetry.event(
             "fleet_start",
             router=self.router.url,
             replicas=replicas,
             autoscale=self.autoscaler is not None,
+            **fields,
         )
         return self
 
@@ -644,6 +803,8 @@ class ServeFleet:
         if getattr(self.router, "promotion_active", False):
             return None
         snapshot = self.router.fleet_snapshot()
+        if isinstance(self.autoscaler, FleetAutoscaler):
+            return self._autoscale_tick_multi(snapshot)
         # the router only sees replicas the manager lists as ready, so a
         # spawn still warming (manager state "starting") is invisible to it
         # — merge it in, or the scaler double-orders during every warmup
@@ -671,6 +832,31 @@ class ServeFleet:
             decision["to_replicas"], decision["reason"],
         )
         return decision
+
+    def _autoscale_tick_multi(self, snapshot: Dict) -> Optional[List[Dict]]:
+        """Multi-tenant tick: one decision per model, each ledgered and
+        applied to THAT model's replica set. ``budget_deferred`` decisions
+        are ledgered but apply nothing — the chip budget refused the grow."""
+        decisions = self.autoscaler.evaluate(
+            snapshot, starting_by_model=self.manager.starting_by_model()
+        )
+        for decision in decisions:
+            # ledger BEFORE applying, same contract as the legacy path
+            self.telemetry.event(FLEET_SCALE_EVENT, **decision)
+            model = decision["model"]
+            delta = decision["to_replicas"] - decision["from_replicas"]
+            if decision["action"] == "scale_up":
+                for _ in range(max(1, delta)):
+                    self.manager.scale_up(model=model)
+            elif decision["action"] == "scale_down":
+                for _ in range(max(1, -delta)):
+                    self.manager.scale_down(model=model)
+            logger.info(
+                "fleet_scale[%s]: %s %d -> %d (%s)",
+                model, decision["action"], decision["from_replicas"],
+                decision["to_replicas"], decision["reason"],
+            )
+        return decisions or None
 
     def wait(self) -> None:
         self.router.wait()

@@ -178,6 +178,10 @@ class PromotionController:
         self._reference_dir: Optional[str] = None
         self._candidate_identity: Optional[Dict] = None
         self._incumbent_dir: Optional[str] = None
+        # multi-tenant: the registry model this promotion targets (None =
+        # legacy whole-fleet promotion); scopes every replica filter, so
+        # other tenants' replicas are invisible to the rollout
+        self._model: Optional[str] = None
         self._config = PromoteConfig()
         self._fault_spec: Optional[str] = None
         self._started_t: Optional[float] = None
@@ -196,6 +200,8 @@ class PromotionController:
                 "incumbent_dir": self._incumbent_dir,
                 "history": list(self._history),
             }
+            if self._model is not None:
+                out["model"] = self._model
             if self._candidate_identity:
                 out["candidate"] = self._candidate_identity
             if self._last_shadow:
@@ -217,9 +223,30 @@ class PromotionController:
         reference_dir: Optional[str] = None,
         config: Optional[PromoteConfig] = None,
         fault_spec: Optional[str] = None,
+        model: Optional[str] = None,
     ) -> Dict:
         """Launch a promotion in the background; returns the initial status.
-        Raises ``RuntimeError`` when one is already in flight."""
+        Raises ``RuntimeError`` when one is already in flight.
+
+        ``model`` scopes the promotion to ONE registry entry of a
+        multi-tenant fleet: only that model's replicas roll, and completion
+        is a registry version flip (other tenants keep serving throughout).
+        A multi-model fleet REQUIRES the model name — an unscoped rollout
+        would drag every tenant onto one artifact."""
+        registry = getattr(self.manager.config, "registry", None)
+        if model is not None:
+            if registry is None:
+                raise ValueError(
+                    "promotion names a model but the fleet has no registry"
+                )
+            incumbent_dir = registry.entry(model).artifact_dir
+        else:
+            if registry is not None and len(registry) > 1:
+                raise ValueError(
+                    "multi-model fleet: promotion requires a model name "
+                    f"(registry holds {sorted(registry.models)})"
+                )
+            incumbent_dir = self.manager.config.artifact_dir
         with self._lock:
             if self._thread is not None and self._thread.is_alive():
                 raise RuntimeError(
@@ -234,7 +261,8 @@ class PromotionController:
             self._candidate_dir = candidate_dir
             self._reference_dir = reference_dir
             self._candidate_identity = None
-            self._incumbent_dir = self.manager.config.artifact_dir
+            self._model = model
+            self._incumbent_dir = incumbent_dir
             self._config = config or PromoteConfig()
             self._fault_spec = fault_spec
             self._started_t = time.time()
@@ -255,6 +283,7 @@ class PromotionController:
             raise ValueError("candidate_dir is required")
         reference_dir = payload.pop("reference_dir", None)
         fault_spec = payload.pop("fault_spec", None)
+        model = payload.pop("model", None)
         fields = {f.name for f in dataclasses.fields(PromoteConfig)}
         unknown = set(payload) - fields
         if unknown:
@@ -268,6 +297,7 @@ class PromotionController:
             reference_dir=reference_dir,
             config=config,
             fault_spec=fault_spec,
+            model=model,
         )
 
     def abort(self) -> None:
@@ -316,6 +346,7 @@ class PromotionController:
                 replicas=len(self._live_replicas()),
                 shadow_secs=cfg.shadow_secs,
                 shadow_fraction=cfg.shadow_fraction,
+                model=self._model,
             )
             baseline_p99 = self._fleet_p99()
             # the fleet strength rollback must restore (captured BEFORE the
@@ -408,7 +439,9 @@ class PromotionController:
         self._check_abort("canary")
         self._set_phase("canary")
         rid = self.manager.scale_up(
-            artifact_dir=self._candidate_dir, fault_spec=self._fault_spec
+            artifact_dir=self._candidate_dir,
+            fault_spec=self._fault_spec,
+            model=self._model,
         )
         # exclusion before readiness: the router must never route a client
         # to the canary, including the poll cycle that first admits it
@@ -559,7 +592,7 @@ class PromotionController:
                 first = False
             else:
                 new_rid = self.manager.scale_up(
-                    artifact_dir=self._candidate_dir
+                    artifact_dir=self._candidate_dir, model=self._model
                 )
                 self._wait_ready(new_rid, "rollout")
                 self._verify_identity(new_rid, identity, "rollout")
@@ -605,7 +638,18 @@ class PromotionController:
     def _complete(self, identity: Optional[Dict]) -> None:
         # future spawns (autoscaler, restarts) come up on the candidate:
         # the promotion is durable, not a transient override
-        self.manager.config.artifact_dir = self._candidate_dir
+        version = None
+        registry = getattr(self.manager.config, "registry", None)
+        if self._model is not None and registry is not None:
+            # the registry flip IS the promotion for a multi-tenant fleet:
+            # one entry moves, every other tenant's document line (and
+            # replicas) are untouched
+            entry = registry.set_version(
+                self._model, self._candidate_dir, telemetry=self.telemetry
+            )
+            version = entry.version
+        else:
+            self.manager.config.artifact_dir = self._candidate_dir
         self.telemetry.event(
             PROMOTION_COMPLETE_EVENT,
             candidate_dir=self._candidate_dir,
@@ -613,12 +657,15 @@ class PromotionController:
             dtype=(identity or {}).get("dtype"),
             replicas=len(self._live_replicas()),
             duration_s=round(time.time() - (self._started_t or time.time()), 3),
+            model=self._model,
+            version=version,
         )
         with self._lock:
             self._state = S_COMPLETE
             self._phase = "complete"
         logger.info(
-            "promotion complete: fleet on %s", self._candidate_dir
+            "promotion complete: %s on %s",
+            self._model or "fleet", self._candidate_dir,
         )
 
     # -- rollback ------------------------------------------------------------
@@ -645,7 +692,9 @@ class PromotionController:
         for rep in self._candidate_replicas():
             need_replacement = len(self._incumbent_replicas()) < target
             if need_replacement:
-                new_rid = self.manager.scale_up(artifact_dir=None)
+                new_rid = self.manager.scale_up(
+                    artifact_dir=None, model=self._model
+                )
                 try:
                     self._wait_ready(new_rid, "rollback")
                 except _Rollback as e:
@@ -712,11 +761,21 @@ class PromotionController:
         with self._lock:
             return self._state in (S_REFUSED, S_COMPLETE)
 
+    def _model_matches(self, rep) -> bool:
+        """Model-scoped promotions only ever see their own tenant's
+        replicas; unscoped (legacy) promotions see everything."""
+        return (
+            self._model is None
+            or getattr(rep, "model", None) == self._model
+        )
+
     def _live_replicas(self, exclude: Optional[int] = None) -> List:
         return [
             r
             for r in self.manager.replicas()
-            if r.state == _R_LIVE and r.replica_id != exclude
+            if r.state == _R_LIVE
+            and r.replica_id != exclude
+            and self._model_matches(r)
         ]
 
     def _rep_artifact_dir(self, rep) -> str:
@@ -735,6 +794,7 @@ class PromotionController:
             for r in self.manager.replicas()
             if r.artifact_dir == self._candidate_dir
             and r.state != _R_ABANDONED
+            and self._model_matches(r)
         ]
 
     def _find(self, rid: int):

@@ -60,6 +60,7 @@ the ``promote`` CLI drives a live fleet through.
 
 from __future__ import annotations
 
+import collections
 import http.client
 import json
 import logging
@@ -72,6 +73,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 from tensorflowdistributedlearning_tpu.obs.telemetry import NULL_TELEMETRY
+from tensorflowdistributedlearning_tpu.serve.registry import DEFAULT_MODEL
 
 logger = logging.getLogger(__name__)
 
@@ -90,9 +92,13 @@ _COUNTERS = (
     "routed",          # forwards attempted (includes retries)
     "retries",         # re-dispatches after a replica failure/drain/429
     "shed",            # answered 429: every routable replica saturated
+    "fair_shed",       # answered 429 by the weighted fair-share policy
     "no_replica",      # answered 503: no routable replica at all
     "replica_failures",  # network-level forward failures observed
 )
+
+# per-model traffic counters the router tracks (fleet_snapshot / prometheus)
+_MODEL_COUNTERS = ("requests", "routed", "shed", "fair_shed")
 
 
 def artifact_key(artifact: Optional[Dict]) -> str:
@@ -138,6 +144,17 @@ class ReplicaState:
         # serves the candidate through this, and the aggregate healthz /
         # router_window report the fleet's artifact mix from it
         self.artifact: Optional[Dict] = None
+        # per-model rows from the replica's /metrics "models" view
+        # (server.models_snapshot): which tenants this replica answers for,
+        # each with version/backlog/p99. None until the first poll of a
+        # models-aware replica; legacy replicas stay None forever and are
+        # treated as serving only the default model.
+        self.models: Optional[Dict[str, Dict]] = None
+
+    def serves(self, model: str) -> bool:
+        if self.models is None:
+            return model == DEFAULT_MODEL
+        return model in self.models
 
     @property
     def routable(self) -> bool:
@@ -166,6 +183,15 @@ class ReplicaState:
             out["chip_seconds_total"] = self.chip_seconds_total
         if self.artifact is not None:
             out["artifact"] = self.artifact
+        if self.models is not None:
+            out["models"] = {
+                name: {
+                    k: row.get(k)
+                    for k in ("version", "status", "queue_depth", "p99_ms")
+                    if row.get(k) is not None
+                }
+                for name, row in self.models.items()
+            }
         return out
 
 
@@ -267,6 +293,128 @@ class ShadowStats:
             return out
 
 
+class FairShedder:
+    """Weighted fair shedding under fleet saturation — pure policy, no I/O.
+
+    The router is work-conserving while there is capacity: every model's
+    traffic is admitted. The moment the fleet saturates (a routing attempt
+    ends in fleet-wide 429), fairness takes over: each model is entitled to
+    ``weight_m / sum(weights of competing models)`` of the admitted window,
+    and a model whose admitted share exceeds its entitlement (times a small
+    ``grace``) is shed pre-forward with the same structured 429 the
+    saturation path answers. The math:
+
+    - *competing* models = models with demand in the sliding window (a lone
+      tenant is never shed against itself, whatever its weight);
+    - shares are measured over the last ``window`` admitted requests, so
+      the policy adapts at traffic speed and needs no reset;
+    - pressure decays: ``pressure_window_s`` after the last observed
+      saturation signal the policy stands down and admission is
+      unconditional again.
+
+    All inputs arrive via ``note_*`` calls and ``now`` is injectable, so the
+    policy is deterministic under test.
+    """
+
+    def __init__(
+        self,
+        weights: Optional[Dict[str, float]] = None,
+        *,
+        window: int = 512,
+        grace: float = 1.05,
+        pressure_window_s: float = 5.0,
+        min_samples: int = 16,
+    ):
+        self.weights = dict(weights or {})
+        self.grace = float(grace)
+        self.pressure_window_s = float(pressure_window_s)
+        self.min_samples = int(min_samples)
+        self._admitted: "collections.deque" = collections.deque(
+            maxlen=int(window)
+        )
+        self._demand: "collections.deque" = collections.deque(
+            maxlen=int(window)
+        )
+        self._last_saturation_t: Optional[float] = None
+        self._shed_counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def weight(self, model: str) -> float:
+        return float(self.weights.get(model, 1.0))
+
+    def note_demand(self, model: str) -> None:
+        with self._lock:
+            self._demand.append(model)
+
+    def note_admitted(self, model: str) -> None:
+        with self._lock:
+            self._admitted.append(model)
+
+    def note_saturation(self, now: Optional[float] = None) -> None:
+        with self._lock:
+            self._last_saturation_t = (
+                now if now is not None else time.monotonic()
+            )
+
+    def pressured(self, now: Optional[float] = None) -> bool:
+        with self._lock:
+            t = self._last_saturation_t
+        if t is None:
+            return False
+        now = now if now is not None else time.monotonic()
+        return (now - t) <= self.pressure_window_s
+
+    def should_shed(self, model: str, now: Optional[float] = None) -> bool:
+        """Shed ``model``'s next request? Only under live saturation
+        pressure, only when other models are competing for the window, and
+        only when this model's admitted share exceeds its weighted fair
+        share."""
+        if not self.pressured(now):
+            return False
+        with self._lock:
+            demand_counts = collections.Counter(self._demand)
+            admitted_counts = collections.Counter(self._admitted)
+        competing = {m for m, c in demand_counts.items() if c > 0}
+        competing.add(model)
+        if len(competing) < 2:
+            return False
+        admitted_total = sum(admitted_counts[m] for m in competing)
+        if admitted_total < self.min_samples:
+            return False
+        total_weight = sum(self.weight(m) for m in competing)
+        fair_share = self.weight(model) / total_weight
+        admitted_share = admitted_counts[model] / admitted_total
+        shed = admitted_share > fair_share * self.grace
+        if shed:
+            with self._lock:
+                self._shed_counts[model] = (
+                    self._shed_counts.get(model, 0) + 1
+                )
+        return shed
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            demand_counts = collections.Counter(self._demand)
+            admitted_counts = collections.Counter(self._admitted)
+            shed = dict(self._shed_counts)
+        admitted_total = sum(admitted_counts.values())
+        out: Dict = {
+            "pressured": self.pressured(),
+            "weights": {
+                m: self.weight(m) for m in set(demand_counts) | set(self.weights)
+            },
+            "demand": dict(demand_counts),
+        }
+        if admitted_total:
+            out["admitted_shares"] = {
+                m: round(c / admitted_total, 4)
+                for m, c in admitted_counts.items()
+            }
+        if shed:
+            out["fair_shed"] = shed
+        return out
+
+
 class FleetRouter:
     """HTTP front end over a (possibly changing) set of serving replicas.
 
@@ -289,6 +437,7 @@ class FleetRouter:
         request_timeout_s: float = 60.0,
         dead_after_failures: int = 2,
         sock: Optional[socket.socket] = None,
+        model_weights: Optional[Dict[str, float]] = None,
     ):
         self._endpoints_fn = (
             endpoints if callable(endpoints) else (lambda: list(endpoints))
@@ -302,6 +451,12 @@ class FleetRouter:
         self._replicas: Dict[int, ReplicaState] = {}
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {k: 0 for k in _COUNTERS}
+        # weighted fair shedding under saturation: weights come from the
+        # registry entries (serve-fleet) or the caller; unlisted models
+        # default to weight 1.0
+        self.shedder = FairShedder(model_weights)
+        # per-model traffic counters (requests/routed/shed/fair_shed)
+        self._model_stats: Dict[str, Dict[str, int]] = {}
         self._started_t = time.time()
         self._stop = threading.Event()
         self._shutdown_lock = threading.Lock()
@@ -506,6 +661,12 @@ class FleetRouter:
         rep.headroom_frac = (
             float(headroom) if headroom is not None else None
         )
+        # per-model serving view (models-aware replicas only): what
+        # model-targeted routing filters on, and what the per-model fleet
+        # aggregates are built from
+        models = body.get("models")
+        if isinstance(models, dict):
+            rep.models = models
 
     def _poll_loop(self) -> None:
         while not self._stop.wait(self.poll_interval_s):
@@ -581,6 +742,14 @@ class FleetRouter:
                 return
             target = self._replicas.get(sid)
         if target is None:
+            return
+        if target.models is not None and not target.serves(
+            self._parse_model(body) or DEFAULT_MODEL
+        ):
+            # model-scoped promotion on a multi-tenant fleet: other tenants'
+            # requests must not be replayed against a canary that does not
+            # serve their model (every sample would 404 and read as a canary
+            # error, failing the promotion for traffic it never owned)
             return
         with stats.lock:
             stats.selected += 1
@@ -683,17 +852,22 @@ class FleetRouter:
 
     # -- routing -------------------------------------------------------------
 
-    def _candidates(self) -> List[ReplicaState]:
+    def _candidates(
+        self, model: Optional[str] = None
+    ) -> List[ReplicaState]:
         """Replicas to try, in order: healthy first (by score), degraded only
         after every ok replica — the SLO breach IS the drain signal. The
         shadow target (an armed canary) is NEVER a candidate: shadow mode
-        must not answer clients."""
+        must not answer clients. With ``model`` set, only replicas serving
+        that model qualify — the per-model replica set."""
         with self._lock:
             shadow = self._shadow_replica
         reps = [
             r
             for r in self._replica_list()
-            if r.routable and r.replica_id != shadow
+            if r.routable
+            and r.replica_id != shadow
+            and (model is None or r.serves(model))
         ]
         ok = sorted(
             (r for r in reps if r.status == STATUS_OK), key=ReplicaState.score
@@ -708,9 +882,31 @@ class FleetRouter:
         with self._lock:
             self._counters[key] += n
 
+    def _count_model(self, model: str, key: str, n: int = 1) -> None:
+        with self._lock:
+            stats = self._model_stats.setdefault(
+                model, {k: 0 for k in _MODEL_COUNTERS}
+            )
+            stats[key] += n
+
+    def model_stats(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {m: dict(s) for m, s in self._model_stats.items()}
+
     def counters(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._counters)
+
+    @staticmethod
+    def _parse_model(body: bytes) -> Optional[str]:
+        """The ``"model"`` key of a predict payload, or None (absent /
+        unparseable — the replica's own 400 stays authoritative for garbage
+        bodies; the router only needs the routing hint)."""
+        try:
+            name = json.loads(body).get("model")
+        except (ValueError, AttributeError):
+            return None
+        return name if isinstance(name, str) and name else None
 
     def artifact_mix(self) -> Dict[str, int]:
         """Replica count per served artifact identity (``dtype:fp8`` keys).
@@ -737,6 +933,57 @@ class FleetRouter:
         if STATUS_DRAINING in statuses or STATUS_STARTING in statuses:
             return STATUS_DRAINING
         return "down"
+
+    def models_snapshot(self) -> Dict[str, Dict]:
+        """Per-model fleet aggregate: live replica count, summed backlog,
+        worst windowed p99, version mix (one version per model except
+        mid-promotion), plus the router's own per-model traffic counters and
+        the model's fair-share weight. What the per-model autoscaler
+        evaluates and the multitenant bench gates read."""
+        out: Dict[str, Dict] = {}
+        for rep in self._replica_list():
+            if not rep.models or not rep.routable:
+                continue
+            for name, row in rep.models.items():
+                agg = out.setdefault(
+                    name,
+                    {
+                        "replicas": 0,
+                        "degraded": 0,
+                        "queue_depth": 0.0,
+                        "worst_p99_ms": None,
+                        "versions": {},
+                    },
+                )
+                agg["replicas"] += 1
+                if row.get("status") == "degraded":
+                    agg["degraded"] += 1
+                agg["queue_depth"] += float(row.get("queue_depth") or 0)
+                p99 = row.get("p99_ms")
+                if p99 is not None:
+                    agg["worst_p99_ms"] = max(
+                        agg["worst_p99_ms"] or 0.0, float(p99)
+                    )
+                version = row.get("version")
+                if version is not None:
+                    key = str(version)
+                    agg["versions"][key] = agg["versions"].get(key, 0) + 1
+        for name, stats in self.model_stats().items():
+            agg = out.setdefault(
+                name,
+                {
+                    "replicas": 0,
+                    "degraded": 0,
+                    "queue_depth": 0.0,
+                    "worst_p99_ms": None,
+                    "versions": {},
+                },
+            )
+            agg.update(stats)
+        for name, agg in out.items():
+            agg["weight"] = self.shedder.weight(name)
+            agg["queue_depth"] = round(agg["queue_depth"], 2)
+        return out
 
     def fleet_snapshot(self) -> Dict:
         """The aggregate view the autoscaler evaluates (and /metrics embeds):
@@ -782,6 +1029,10 @@ class FleetRouter:
             "artifacts": self.artifact_mix(),
             "promotion_active": self.promotion_active,
         }
+        models = self.models_snapshot()
+        if models:
+            snapshot["models"] = models
+            snapshot["fair_shed_total"] = self.counters()["fair_shed"]
         with self._lock:
             if self._shadow_replica is not None:
                 snapshot["shadow_replica"] = self._shadow_replica
@@ -887,12 +1138,47 @@ class FleetRouter:
     def route_predict(
         self, body: bytes, request_id: str
     ) -> Tuple[int, Dict[str, str], bytes]:
-        """The routing loop: try candidates best-score-first; retry on
-        network failure / drain / saturation; shed structurally when the
-        whole fleet is saturated or empty."""
+        """The routing loop: parse the model hint, try that model's
+        candidates best-score-first; retry on network failure / drain /
+        saturation; shed structurally when the whole fleet is saturated or
+        empty. Under saturation pressure the weighted fair-share policy
+        (:class:`FairShedder`) sheds over-share models pre-forward so one
+        tenant's burst cannot starve another's SLO."""
         self._count("requests")
-        candidates = self._candidates()
+        explicit_model = self._parse_model(body)
+        model = explicit_model or DEFAULT_MODEL
+        self._count_model(model, "requests")
+        self.shedder.note_demand(model)
+        if self.shedder.should_shed(model):
+            self._count("shed")
+            self._count("fair_shed")
+            self._count_model(model, "shed")
+            self._count_model(model, "fair_shed")
+            return self._structured_error(
+                429,
+                "fleet_saturated",
+                f"fleet saturated; model {model!r} is over its fair share "
+                f"(weight {self.shedder.weight(model):g}) — back off",
+                request_id,
+                retry_after=1,
+            )
+        candidates = self._candidates(model)
+        if not candidates and explicit_model is None:
+            # legacy client on a named-model fleet: no replica claims the
+            # implicit default — route over the whole fleet rather than
+            # refusing traffic the replicas themselves would accept
+            candidates = self._candidates()
         if not candidates:
+            if explicit_model is not None and self._candidates():
+                # the fleet is alive, it just doesn't serve this model:
+                # caller error, not capacity — don't invite retries
+                self._count("no_replica")
+                return self._structured_error(
+                    404,
+                    "model_unknown",
+                    f"no replica serves model {explicit_model!r}",
+                    request_id,
+                )
             self._count("no_replica")
             return self._structured_error(
                 503,
@@ -937,14 +1223,22 @@ class FleetRouter:
             with self._lock:
                 rep.routed += 1
             if status == 200:
+                self._count_model(model, "routed")
+                self.shedder.note_admitted(model)
                 # shadow duplication rides ONLY answered requests (the
                 # canary sees what real traffic saw), enqueued off-path
                 self._maybe_shadow(
                     rep, body, data, time.perf_counter() - t0
                 )
+            if saw_429:
+                # some replica was saturated even though this one answered:
+                # keep the fairness policy pressured
+                self.shedder.note_saturation()
             return status, headers, data
         if saw_429:
             self._count("shed")
+            self._count_model(model, "shed")
+            self.shedder.note_saturation()
             # fleet-wide saturation: shed with the SMALLEST backoff any
             # replica advertised — capacity frees up as soon as the fastest
             # drain completes
@@ -1044,6 +1338,31 @@ class FleetRouter:
             gauge("rps_per_chip_total", fleet["rps_per_chip_total"])
         if fleet.get("chip_seconds_total") is not None:
             gauge("chip_seconds_total", fleet["chip_seconds_total"])
+        # per-model routing series, {model=} labeled so one scrape of the
+        # router distinguishes tenants (versions ride on the replicas'
+        # tfdl_serve_model_* series)
+        models = fleet.get("models") or {}
+        if models:
+            for metric in _MODEL_COUNTERS:
+                pname = f"tfdl_router_model_{metric}_total"
+                lines.append(f"# TYPE {pname} counter")
+                for name in sorted(models):
+                    value = models[name].get(metric, 0)
+                    lines.append(f'{pname}{{model="{name}"}} {value}')
+            lines.append("# TYPE tfdl_router_model_queue_depth gauge")
+            for name in sorted(models):
+                lines.append(
+                    f'tfdl_router_model_queue_depth{{model="{name}"}} '
+                    f"{models[name]['queue_depth']}"
+                )
+            lines.append("# TYPE tfdl_router_model_worst_p99_ms gauge")
+            for name in sorted(models):
+                p99 = models[name].get("worst_p99_ms")
+                if p99 is not None:
+                    lines.append(
+                        f'tfdl_router_model_worst_p99_ms{{model="{name}"}} '
+                        f"{p99}"
+                    )
         return "\n".join(lines) + "\n"
 
     def emit_window(self, final: bool = False) -> Dict:
@@ -1054,6 +1373,11 @@ class FleetRouter:
                 str(r.replica_id): r.routed for r in self._replica_list()
             },
         }
+        if self._model_stats:
+            # fairness evidence: admitted shares vs weights + per-model shed
+            # counts — what the report's fair-shed line and the bench's
+            # fairness gate read
+            fields["fair_share"] = self.shedder.snapshot()
         if final:
             fields["final"] = True
         self.telemetry.event(ROUTER_WINDOW_EVENT, **fields)

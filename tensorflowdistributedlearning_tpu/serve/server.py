@@ -69,6 +69,7 @@ from tensorflowdistributedlearning_tpu.serve.batcher import (
     ServerClosedError,
 )
 from tensorflowdistributedlearning_tpu.serve.engine import InferenceEngine
+from tensorflowdistributedlearning_tpu.serve.registry import DEFAULT_MODEL
 
 logger = logging.getLogger(__name__)
 
@@ -114,8 +115,44 @@ def bind_ephemeral(host: str = "127.0.0.1", port: int = 0) -> socket.socket:
     return sock
 
 
+class _ModelRuntime:
+    """One tenant inside a replica: its engine, batcher, version, and SLO.
+
+    Each model owns a *separate* ``MetricsRegistry`` (the engine's), so
+    tenant counters and latency histograms never cross-contaminate — the
+    server sums across runtimes for its aggregate views and reports each
+    runtime under a ``models`` sub-dict for per-tenant ones."""
+
+    def __init__(
+        self,
+        name: str,
+        engine: InferenceEngine,
+        batcher: MicroBatcher,
+        *,
+        version: int = 1,
+        slo: Optional[health_lib.SloTracker] = None,
+    ):
+        self.name = name
+        self.engine = engine
+        self.batcher = batcher
+        self.version = int(version)
+        self.slo = slo
+
+    @property
+    def status(self) -> str:
+        if self.slo is not None and not self.slo.healthy:
+            return "degraded"
+        return "ok"
+
+
 class ServingServer:
-    """Engine + batcher behind a ThreadingHTTPServer, with ledger windows."""
+    """Engine + batcher behind a ThreadingHTTPServer, with ledger windows.
+
+    Multi-tenant: the constructor's engine/batcher pair becomes the
+    *primary* model (named ``model``, default :data:`DEFAULT_MODEL` — which
+    is also what requests that don't name a model resolve to), and
+    :meth:`add_model` mounts further tenants before :meth:`start`. Requests
+    select a tenant with a ``"model"`` key in the predict payload."""
 
     def __init__(
         self,
@@ -131,6 +168,8 @@ class ServingServer:
         slo_error_budget: float = 0.01,
         replica_id: int = 0,
         sock: Optional[socket.socket] = None,
+        model: str = DEFAULT_MODEL,
+        registry_version: Optional[int] = None,
     ):
         self.engine = engine
         self.batcher = batcher
@@ -149,6 +188,22 @@ class ServingServer:
             if slo_p99_ms is not None
             else None
         )
+        # tenant table: the constructor pair is the primary model; add_model
+        # mounts more. Ordered so windows/metrics render deterministically.
+        self._primary = _ModelRuntime(
+            model,
+            engine,
+            batcher,
+            version=registry_version if registry_version is not None else 1,
+            slo=self.slo,
+        )
+        self.models: Dict[str, _ModelRuntime] = collections.OrderedDict(
+            {model: self._primary}
+        )
+        # spawned from a registry entry (vs the legacy --artifact-dir path):
+        # only then does /healthz artifact identity carry model + version —
+        # legacy probes keep seeing exactly the shape they always did
+        self._versioned = registry_version is not None
         # HBM headroom monitor (obs/health.py): fed by the per-window
         # watermark sample below; a replica running out of device memory
         # degrades /healthz BEFORE it OOMs, so the fleet router drains it
@@ -258,6 +313,11 @@ class ServingServer:
             daemon=True,
         )
         self._serve_thread.start()
+        start_fields: Dict = {}
+        if len(self.models) > 1 or self._versioned:
+            start_fields["models"] = {
+                name: rt.version for name, rt in self.models.items()
+            }
         self.telemetry.event(
             "serve_start",
             endpoint=self.url,
@@ -266,6 +326,7 @@ class ServingServer:
             max_batch_size=self.batcher.max_batch_size,
             max_wait_ms=self.batcher.max_wait_s * 1000,
             max_queue=self.batcher.max_queue,
+            **start_fields,
         )
         if self.window_secs > 0:
             self._ticker = threading.Thread(
@@ -289,13 +350,71 @@ class ServingServer:
         for sig in signals or (signal_lib.SIGINT, signal_lib.SIGTERM):
             signal_lib.signal(sig, lambda *_: self.shutdown())
 
+    def add_model(
+        self,
+        name: str,
+        engine: InferenceEngine,
+        batcher: MicroBatcher,
+        *,
+        version: int = 1,
+        slo_p99_ms: Optional[float] = None,
+        slo_error_budget: float = 0.01,
+    ) -> _ModelRuntime:
+        """Mount another tenant on this replica (before :meth:`start`).
+
+        The engine must carry its own ``MetricsRegistry`` — tenants sharing
+        instruments would cross-contaminate every per-model window."""
+        if name in self.models:
+            raise ValueError(f"model {name!r} already mounted")
+        if engine.registry is self.engine.registry:
+            raise ValueError(
+                f"model {name!r}: each tenant needs its own MetricsRegistry "
+                "(shared instruments cross-contaminate per-model windows)"
+            )
+        slo = (
+            health_lib.SloTracker(slo_p99_ms, error_budget=slo_error_budget)
+            if slo_p99_ms is not None
+            else None
+        )
+        runtime = _ModelRuntime(
+            name, engine, batcher, version=version, slo=slo
+        )
+        # one cost meter per replica: chip-seconds are a property of the
+        # chips, not the tenant — per-model cost splits happen upstream
+        # (router/bench) from per-model request rates
+        batcher.cost_meter = self.cost_meter
+        self.models[name] = runtime
+        return runtime
+
+    def model_runtime(self, name: Optional[str]) -> Optional[_ModelRuntime]:
+        """Resolve a request's model name: absent -> primary, unknown -> None."""
+        if name is None:
+            return self._primary
+        return self.models.get(name)
+
+    def queue_depth_total(self) -> int:
+        return sum(
+            rt.engine.registry.gauge("serve/queue_depth").value or 0
+            for rt in self.models.values()
+        )
+
+    def _counter_total(self, name: str) -> int:
+        return sum(
+            rt.engine.registry.counter(f"serve/{name}").value
+            for rt in self.models.values()
+        )
+
     @property
     def health_status(self) -> str:
         """The replica's live state a fleet router routes on: "draining" >
-        "degraded" (SLO budget blown, or HBM headroom at OOM risk) > "ok"."""
+        "degraded" (any tenant's SLO budget blown, or HBM headroom at OOM
+        risk) > "ok"."""
         if self.draining:
             return "draining"
-        if self.slo is not None and not self.slo.healthy:
+        if any(
+            rt.slo is not None and not rt.slo.healthy
+            for rt in self.models.values()
+        ):
             return "degraded"
         if self.headroom.degraded:
             return "degraded"
@@ -303,16 +422,22 @@ class ServingServer:
 
     def artifact_identity(self) -> Optional[Dict]:
         """What this replica is actually serving — manifest dtype + source
-        fingerprint (train/quantize.py) — so a readiness probe can tell
-        replicas serving different artifacts apart. None for raw-closure /
-        legacy engines whose manifest carries no quantization section."""
+        fingerprint (train/quantize.py), plus the registry version when the
+        replica was spawned from a registry entry — so a readiness probe can
+        tell replicas serving different artifacts (or different *versions*
+        of one model) apart. None for raw-closure / legacy engines whose
+        manifest carries no quantization section and no registry entry."""
         q = self.engine.quantization
-        if q is None:
-            return None
-        return {
-            "dtype": q.get("dtype"),
-            "source_fingerprint": q.get("source_fingerprint"),
-        }
+        identity: Dict = {}
+        if q is not None:
+            identity = {
+                "dtype": q.get("dtype"),
+                "source_fingerprint": q.get("source_fingerprint"),
+            }
+        if self._versioned:
+            identity["model"] = self._primary.name
+            identity["registry_version"] = self._primary.version
+        return identity or None
 
     def note_drain_progress(self) -> None:
         """Sample the cumulative completed counter (throttled to ~5Hz) so
@@ -322,7 +447,7 @@ class ServingServer:
         with self._drain_lock:
             if self._drain_samples and now - self._drain_samples[-1][0] < 0.2:
                 return
-            completed = self.engine.registry.counter("serve/completed").value
+            completed = self._counter_total("completed")
             self._drain_samples.append((now, completed))
 
     def retry_after_s(self) -> int:
@@ -330,10 +455,9 @@ class ServingServer:
         back off: current queue depth / the window's observed drain rate,
         clamped to [1, 30]. With no drain observed yet the estimate is a
         conservative default — better than hot-looping clients either way."""
-        reg = self.engine.registry
-        depth = reg.gauge("serve/queue_depth").value or 0
+        depth = self.queue_depth_total()
         now = time.monotonic()
-        completed = reg.counter("serve/completed").value
+        completed = self._counter_total("completed")
         rate = 0.0
         with self._drain_lock:
             # rate over the recent past only: drop samples older than ~10s
@@ -357,6 +481,34 @@ class ServingServer:
             )
         )
 
+    def models_snapshot(self) -> Dict[str, Dict]:
+        """Per-tenant live view: what the fleet router's poll routes on —
+        version, backlog, windowed p99 — plus counters and SLO state."""
+        out: Dict[str, Dict] = {}
+        for name, rt in self.models.items():
+            reg = rt.engine.registry
+            row: Dict = {
+                "version": rt.version,
+                "status": rt.status,
+                "queue_depth": reg.gauge("serve/queue_depth").value or 0,
+                "requests": reg.counter("serve/requests").value,
+                "completed": reg.counter("serve/completed").value,
+                "rejected_queue_full": reg.counter(
+                    "serve/rejected_queue_full"
+                ).value,
+            }
+            hist = reg.histogram("serve/request")
+            if len(hist):
+                row["p99_ms"] = round(
+                    hist.summary().get("p99_s", 0.0) * 1000, 3
+                )
+            if rt.slo is not None:
+                row["slo"] = rt.slo.snapshot()
+            if rt.engine.quantization is not None:
+                row["serving_dtype"] = rt.engine.quantization.get("dtype")
+            out[name] = row
+        return out
+
     def metrics_snapshot(self) -> Dict:
         """The ``/metrics`` body: live registry view + serving identity."""
         reg = self.engine.registry
@@ -368,10 +520,13 @@ class ServingServer:
             "padding_waste": {
                 str(b): w for b, w in self.engine.padding_waste.items()
             },
-            "queue_depth": reg.gauge("serve/queue_depth").value or 0,
+            "queue_depth": self.queue_depth_total(),
             # histograms here are "since the last ledger window" — the window
             # drain keeps a long-lived server's sample memory bounded
             "registry": reg.snapshot(),
+            # per-tenant view (one entry even single-tenant: the fleet
+            # router's per-model routing state comes from here)
+            "models": self.models_snapshot(),
         }
         if self.slo is not None:
             snapshot["slo"] = self.slo.snapshot()
@@ -433,17 +588,79 @@ class ServingServer:
                 )
             if memory.get("bytes_limit"):
                 reg.gauge("serve/hbm_bytes_limit").set(memory["bytes_limit"])
-        return reg.render_prometheus()
+        return reg.render_prometheus() + self._prometheus_model_text()
+
+    # per-model series exposed with {model=,version=} labels so ONE scrape
+    # distinguishes tenants; names live under tfdl_serve_model_* beside the
+    # unlabeled per-replica aggregates render_prometheus produces
+    _MODEL_PROM_COUNTERS = (
+        "requests",
+        "completed",
+        "rejected_queue_full",
+        "deadline_exceeded",
+        "errors",
+    )
+
+    def _prometheus_model_text(self) -> str:
+        lines = []
+        labeled = []
+        for name, rt in self.models.items():
+            labeled.append(
+                (f'model="{name}",version="{rt.version}"', rt)
+            )
+        for metric in self._MODEL_PROM_COUNTERS:
+            pname = f"tfdl_serve_model_{metric}_total"
+            lines.append(f"# TYPE {pname} counter")
+            for labels, rt in labeled:
+                value = rt.engine.registry.counter(f"serve/{metric}").value
+                lines.append(f"{pname}{{{labels}}} {value}")
+        lines.append("# TYPE tfdl_serve_model_queue_depth gauge")
+        for labels, rt in labeled:
+            depth = (
+                rt.engine.registry.gauge("serve/queue_depth").value or 0
+            )
+            lines.append(f"tfdl_serve_model_queue_depth{{{labels}}} {depth}")
+        lines.append("# TYPE tfdl_serve_model_request_seconds summary")
+        for labels, rt in labeled:
+            hist = rt.engine.registry.histogram("serve/request")
+            if not len(hist):
+                continue
+            summary = hist.summary()
+            for q, key in ((0.5, "p50_s"), (0.9, "p90_s"), (0.99, "p99_s")):
+                if key in summary:
+                    lines.append(
+                        f'tfdl_serve_model_request_seconds'
+                        f'{{{labels},quantile="{q}"}} {summary[key]:.10g}'
+                    )
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _latency_row(samples) -> Dict:
+        summary = time_summary(samples)
+        row = {
+            k[:-2] + "_ms": round(v * 1000, 3)
+            for k, v in summary.items()
+            if k.endswith("_s") and k != "total_s"
+        }
+        # exact even when the histogram ring capped the raw samples
+        row["count"] = float(window_count(samples))
+        return row
 
     def emit_window(self, final: bool = False) -> Dict:
         """One ``serve_window`` ledger event: cumulative counters, this
-        window's latency split (ms percentiles), post-warmup recompiles."""
-        reg = self.engine.registry
+        window's latency split (ms percentiles), post-warmup recompiles.
+
+        Multi-tenant: top-level counters/latency are the sum across the
+        replica's models (identical to the old single-model fields when one
+        model is mounted — no ledger flag-day), and a ``models`` sub-dict
+        carries the same shape per tenant. Each tenant's SLO budget is
+        evaluated on its own window; breaches ledger ``health_alert`` events
+        stamped with the model name."""
         fields: Dict = {
-            k: reg.counter(f"serve/{k}").value for k in _WINDOW_COUNTERS
+            k: self._counter_total(k) for k in _WINDOW_COUNTERS
         }
         fields["replica"] = self.replica_id
-        fields["queue_depth"] = reg.gauge("serve/queue_depth").value or 0
+        fields["queue_depth"] = self.queue_depth_total()
         fields["bucket_hits"] = {
             str(b): n for b, n in self.engine.bucket_hits.items()
         }
@@ -454,38 +671,83 @@ class ServingServer:
             fields["padding_waste"] = {str(b): w for b, w in waste.items()}
         if self.engine.quantization is not None:
             fields["serving_dtype"] = self.engine.quantization.get("dtype")
+        # drain every tenant's histograms once; aggregate windows are the
+        # concatenation (exact counts/totals summed via SampleWindow)
+        combined: Dict[str, list] = {}
+        models_field: Dict[str, Dict] = {}
+        multi = len(self.models) > 1
+        for name, rt in self.models.items():
+            reg = rt.engine.registry
+            mrow: Dict = {
+                "version": rt.version,
+                **{
+                    k: reg.counter(f"serve/{k}").value
+                    for k in _WINDOW_COUNTERS
+                },
+            }
+            mrow["queue_depth"] = (
+                reg.gauge("serve/queue_depth").value or 0
+            )
+            mlat: Dict = {}
+            for hname in _WINDOW_HISTOGRAMS:
+                samples = reg.histogram(f"serve/{hname}").drain()
+                if samples:
+                    combined.setdefault(hname, []).append(samples)
+                    mlat[hname] = self._latency_row(samples)
+            if mlat:
+                mrow["latency_ms"] = mlat
+            if rt.slo is not None:
+                verdict = rt.slo.evaluate()
+                if verdict is not None:
+                    verdict.setdefault("alert_id", trace_lib.new_id())
+                    if multi:
+                        verdict.setdefault("model", name)
+                    self.telemetry.event(
+                        health_lib.HEALTH_ALERT_EVENT, **verdict
+                    )
+                    if not verdict.get("resolved"):
+                        # SLO budget blown: capture ONE rate-limited
+                        # postmortem profile stamped with the triggering
+                        # alert id — the evidence an on-call wants is the
+                        # trace from the bad minutes, not a capture
+                        # requested after the fact
+                        self.profiler.trigger(verdict, seconds=2.0)
+                mrow["slo"] = rt.slo.snapshot()
+            if rt.engine.quantization is not None:
+                mrow["serving_dtype"] = rt.engine.quantization.get("dtype")
+            models_field[name] = mrow
         latency: Dict = {}
-        for name in _WINDOW_HISTOGRAMS:
-            samples = reg.histogram(f"serve/{name}").drain()
-            if samples:
-                summary = time_summary(samples)
-                latency[name] = {
-                    k[:-2] + "_ms": round(v * 1000, 3)
-                    for k, v in summary.items()
-                    if k.endswith("_s") and k != "total_s"
-                }
-                # exact even when the histogram ring capped the raw samples
-                latency[name]["count"] = float(window_count(samples))
+        for hname, windows in combined.items():
+            if len(windows) == 1:
+                merged = windows[0]
+            else:
+                from tensorflowdistributedlearning_tpu.obs.metrics import (
+                    SampleWindow,
+                )
+
+                merged = SampleWindow(
+                    [s for w in windows for s in w],
+                    sum(window_count(w) for w in windows),
+                    sum(getattr(w, "total_s", 0.0) for w in windows),
+                )
+            latency[hname] = self._latency_row(merged)
         if latency:
             fields["latency_ms"] = latency
         detector = self.telemetry.detector
         if detector is not None:
             fields["recompiles_post_warmup"] = detector.post_warmup_count
         if self.slo is not None:
-            # evaluate the error budget on the window boundary: breaches /
-            # recoveries become health_alert events, and the live state rides
-            # in the window for the report's health section
-            verdict = self.slo.evaluate()
-            if verdict is not None:
-                verdict.setdefault("alert_id", trace_lib.new_id())
-                self.telemetry.event(health_lib.HEALTH_ALERT_EVENT, **verdict)
-                if not verdict.get("resolved"):
-                    # SLO budget blown: capture ONE rate-limited postmortem
-                    # profile stamped with the triggering alert id — the
-                    # evidence an on-call wants is the trace from the bad
-                    # minutes, not a capture requested after the fact
-                    self.profiler.trigger(verdict, seconds=2.0)
+            # the primary model's live SLO state rides at top level for the
+            # report's health section, exactly as before
             fields["slo"] = self.slo.snapshot()
+        if multi:
+            fields["models"] = models_field
+        elif self._versioned:
+            # one model-aware tenant on this replica (fleet spawn with
+            # --model): name it at top level so the fleet merge can
+            # attribute the replica's whole window to that tenant
+            fields["model"] = self._primary.name
+            fields["model_version"] = self._primary.version
         if final:
             fields["final"] = True
         self.telemetry.event("serve_window", **fields)
@@ -551,7 +813,8 @@ class ServingServer:
         self._stop.set()
         if self._ticker is not None:
             self._ticker.join(timeout=5)
-        self.batcher.close(drain=True)
+        for rt in self.models.values():
+            rt.batcher.close(drain=True)
         try:
             final = self.emit_window(final=True)
         except Exception:  # noqa: BLE001
@@ -670,6 +933,13 @@ class _Handler(BaseHTTPRequestHandler):
             }
             if self.ctx.slo is not None:
                 body["slo"] = self.ctx.slo.snapshot()
+            if len(self.ctx.models) > 1 or self.ctx._versioned:
+                # which tenants (and which registry versions) this replica
+                # answers for — the multi-tenant readiness contract
+                body["models"] = {
+                    name: {"version": rt.version, "status": rt.status}
+                    for name, rt in self.ctx.models.items()
+                }
             if self.ctx.headroom.last is not None:
                 # the OOM-risk view a fleet controller drains on (None until
                 # a device watermark sample exists — CPU builds stay silent)
@@ -759,13 +1029,21 @@ class _Handler(BaseHTTPRequestHandler):
         # handler threads die with the process, which is the point.
         faults_lib.fire(faults_lib.SITE_REQUEST)
 
+    # the tenant the in-flight POST resolved to; _predict sets it before
+    # dispatch so _account_latency attributes the request histogram and SLO
+    # sample to the right model (handlers are per-connection and a
+    # connection's requests are sequential, so an instance attribute is safe)
+    _runtime = None
+
     def _account_latency(self, status: int, dt: float) -> None:
         """End-to-end handler latency: answered requests feed the `request`
-        histogram (and the SLO budget); deadline expiries count as SLO
-        violations even though they produce no latency sample."""
-        slo = self.ctx.slo
+        histogram (and the SLO budget) of the model that answered; deadline
+        expiries count as SLO violations even though they produce no latency
+        sample."""
+        runtime = self._runtime or self.ctx._primary
+        slo = runtime.slo
         if status == 200:
-            self.ctx.engine.registry.histogram("serve/request").record(dt)
+            runtime.engine.registry.histogram("serve/request").record(dt)
             if slo is not None:
                 slo.observe(dt)
         elif status == 504 and slo is not None:
@@ -776,6 +1054,7 @@ class _Handler(BaseHTTPRequestHandler):
         ``span`` is the open request trace span (None when tracing is off):
         its context rides the batcher Request so the worker can emit this
         request's queue/pad/compute child spans."""
+        self._runtime = None
         if self.ctx.draining:
             return self._error(
                 503,
@@ -791,13 +1070,30 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(
                 400, "bad_request", f"expected JSON {{'instances': [...]}}: {e}"
             )
+        # tenant selection: {"model": NAME} routes to that model's
+        # engine/batcher; absent -> the primary model (the only one on a
+        # legacy single-artifact replica); unknown -> structured 404
+        model_name = payload.get("model")
+        if model_name is not None and not isinstance(model_name, str):
+            return self._error(
+                400, "bad_request", "'model' must be a string"
+            )
+        runtime = self.ctx.model_runtime(model_name)
+        if runtime is None:
+            return self._error(
+                404,
+                "model_unknown",
+                f"model {model_name!r} is not served here; "
+                f"available: {sorted(self.ctx.models)}",
+            )
+        self._runtime = runtime
         try:
-            x = np.asarray(instances, self.ctx.engine.input_dtype)
+            x = np.asarray(instances, runtime.engine.input_dtype)
         except (ValueError, TypeError) as e:
             return self._error(400, "bad_request", f"instances not array-like: {e}")
         deadline_ms = payload.get("deadline_ms")
         try:
-            request = self.ctx.batcher.submit(
+            request = runtime.batcher.submit(
                 x,
                 deadline_ms=deadline_ms,
                 trace=span.context if span is not None else None,

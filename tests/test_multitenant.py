@@ -1,0 +1,961 @@
+"""Multi-tenant serving: artifact registry, model-aware routing/autoscaling,
+weighted fair shedding, and the single-model promotion flip.
+
+The contracts under test are the ones a shared fleet is operated by: the
+registry document is strict (a typo'd field fails the fleet at spawn, not
+silently at 3am), a legacy single-artifact workdir keeps working as an
+implicit one-entry registry (no flag-day), the router routes on the
+payload's model hint and sheds by weighted fair share only under live
+saturation pressure, the per-model autoscaler defers — explicitly, ledgered
+— rather than bust the fleet-wide chip budget, and a promotion scoped to one
+model flips exactly that registry entry's version while every other tenant
+keeps serving.
+
+The subprocess end-to-end drills (slow-marked, run unfiltered by the focused
+ci.yml step) drive the real tier: a 2-model registry fleet behind one
+router — saturating tenant alpha sheds per fair-share weights while beta's
+p99 stays inside its SLO band, and ``promote --model alpha`` rolls only
+alpha's replicas with zero client-visible errors on beta.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.serve.registry import (
+    DEFAULT_MODEL,
+    REGISTRY_FLIP_EVENT,
+    ModelEntry,
+    Registry,
+    RegistryError,
+    read_registry,
+    registry_path,
+    write_registry,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FEATURES = 6
+CLASSES = 3
+
+
+# -- registry schema ---------------------------------------------------------
+
+
+def test_registry_round_trip(tmp_path):
+    wd = str(tmp_path)
+    write_registry(wd, [
+        ModelEntry(name="alpha", artifact_dir="/a", weight=2.0,
+                   buckets=(1, 4), prewarm_budget=1, slo_p99_ms=50.0,
+                   replicas=2, max_replicas=3, chips_per_replica=2,
+                   device_slots=("0,1", "2,3")),
+        ModelEntry(name="beta", artifact_dir="/b"),
+    ])
+    reg = read_registry(wd)
+    assert not reg.implicit
+    assert sorted(reg.models) == ["alpha", "beta"]
+    a = reg.entry("alpha")
+    assert a.weight == 2.0
+    assert a.buckets == (1, 4)
+    assert a.prewarm_budget == 1
+    assert a.slo_p99_ms == 50.0
+    assert a.replicas == 2 and a.max_replicas == 3
+    assert a.chips_per_replica == 2
+    assert a.device_slots == ("0,1", "2,3")
+    b = reg.entry("beta")
+    assert b.version == 1 and b.weight == 1.0 and b.buckets is None
+
+
+def test_registry_rejects_unknown_field(tmp_path):
+    """The manifest.json lesson: a typo'd knob must fail the fleet at spawn,
+    not silently warm everything."""
+    wd = str(tmp_path)
+    doc = {
+        "schema_version": 1,
+        "models": [
+            {"name": "m", "artifact_dir": "/a", "prewarm_budgit": 2},
+        ],
+    }
+    with open(registry_path(wd), "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(RegistryError, match="prewarm_budgit"):
+        read_registry(wd)
+
+
+def test_registry_rejects_corrupt_and_unknown_version(tmp_path):
+    wd = str(tmp_path)
+    with open(registry_path(wd), "w") as f:
+        f.write("{not json")
+    with pytest.raises(RegistryError):
+        read_registry(wd)
+    with open(registry_path(wd), "w") as f:
+        json.dump({"schema_version": 99, "models": []}, f)
+    with pytest.raises(RegistryError, match="schema_version"):
+        read_registry(wd)
+
+
+def test_registry_legacy_workdir_loads_implicit(tmp_path):
+    """No flag-day: a workdir without registry.json resolves to an implicit
+    one-entry registry under DEFAULT_MODEL, and saving it never writes a
+    registry.json the operator didn't ask for."""
+    wd = str(tmp_path)
+    reg = read_registry(wd, default_artifact_dir="/legacy/artifact")
+    assert reg.implicit
+    assert list(reg.models) == [DEFAULT_MODEL]
+    assert reg.entry(DEFAULT_MODEL).artifact_dir == "/legacy/artifact"
+    reg.set_version(DEFAULT_MODEL, "/legacy/v2")
+    assert not os.path.exists(registry_path(wd))
+
+
+def test_registry_without_source_is_an_error(tmp_path):
+    with pytest.raises(RegistryError):
+        read_registry(str(tmp_path))
+
+
+def test_registry_unknown_model_lists_known(tmp_path):
+    write_registry(str(tmp_path), [ModelEntry(name="alpha",
+                                              artifact_dir="/a")])
+    reg = read_registry(str(tmp_path))
+    with pytest.raises(RegistryError, match="alpha"):
+        reg.entry("nope")
+
+
+def test_registry_version_flip_is_atomic_and_forward_only(tmp_path):
+    from tensorflowdistributedlearning_tpu.obs import Telemetry
+    from tensorflowdistributedlearning_tpu.obs.ledger import read_ledger
+
+    wd = str(tmp_path / "fleet")
+    os.makedirs(wd)
+    write_registry(wd, [ModelEntry(name="alpha", artifact_dir="/v1"),
+                        ModelEntry(name="beta", artifact_dir="/b")])
+    reg = read_registry(wd)
+    led = str(tmp_path / "ledger")
+    tel = Telemetry(led, run_info={"kind": "test"})
+    entry = reg.set_version("alpha", "/v2", telemetry=tel)
+    tel.close()
+    assert entry.version == 2 and entry.artifact_dir == "/v2"
+    # the flip is on disk (atomic rewrite), other entries untouched
+    reread = read_registry(wd)
+    assert reread.entry("alpha").version == 2
+    assert reread.entry("alpha").artifact_dir == "/v2"
+    assert reread.entry("beta").version == 1
+    # forward-only: a stale promoter cannot roll the counter back
+    with pytest.raises(RegistryError):
+        reg.set_version("alpha", "/v1", version=1)
+    # and the flip is ledgered
+    events = read_ledger(led)
+    flips = [e for e in events if e.get("event") == REGISTRY_FLIP_EVENT]
+    assert len(flips) == 1
+    assert flips[0]["model"] == "alpha"
+    assert flips[0]["version"] == 2 and flips[0]["previous_version"] == 1
+
+
+def test_model_entry_device_slot_round_robin():
+    e = ModelEntry(name="m", artifact_dir="/a", device_slots=("0", "1"))
+    assert [e.device_slot(i) for i in range(4)] == ["0", "1", "0", "1"]
+    assert ModelEntry(name="m", artifact_dir="/a").device_slot(0) is None
+
+
+# -- weighted fair shedding --------------------------------------------------
+
+
+def _shedder(**kw):
+    from tensorflowdistributedlearning_tpu.serve.router import FairShedder
+
+    return FairShedder({"alpha": 2.0, "beta": 1.0}, **kw)
+
+
+def test_fair_shedder_idle_without_pressure():
+    s = _shedder()
+    for _ in range(50):
+        s.note_demand("alpha")
+        s.note_admitted("alpha")
+        s.note_demand("beta")
+        s.note_admitted("beta")
+    # equal admitted shares exceed beta's fair share, but with no live
+    # saturation signal nothing is shed — fair shedding is a pressure
+    # policy, not a rate limiter
+    assert not s.should_shed("beta", now=100.0)
+
+
+def test_fair_shedder_sheds_over_share_model_under_pressure():
+    s = _shedder()
+    for _ in range(50):
+        for m in ("alpha", "beta"):
+            s.note_demand(m)
+            s.note_admitted(m)
+    s.note_saturation(now=100.0)
+    # equal admitted shares (50/50) against 2:1 weights: beta (fair share
+    # 33%) is over, alpha (fair share 67%) is under
+    assert s.should_shed("beta", now=100.0)
+    assert not s.should_shed("alpha", now=100.0)
+
+
+def test_fair_shedder_single_model_never_shed():
+    s = _shedder()
+    for _ in range(50):
+        s.note_demand("beta")
+        s.note_admitted("beta")
+    s.note_saturation(now=100.0)
+    # no competing tenant in the window: 100% of the traffic is beta's fair
+    # share by definition
+    assert not s.should_shed("beta", now=100.0)
+
+
+# -- per-model autoscaling under a chip budget -------------------------------
+
+
+def _fleet_scaler(chip_budget=None, chips=None):
+    from tensorflowdistributedlearning_tpu.serve import AutoscaleConfig
+    from tensorflowdistributedlearning_tpu.serve.autoscale import (
+        FleetAutoscaler,
+    )
+
+    clock = {"t": 0.0}
+    cfg = dict(queue_high=2.0, queue_low=0.25, sustain=2, cooldown_s=0.0)
+    scaler = FleetAutoscaler(
+        {
+            "alpha": AutoscaleConfig(min_replicas=1, max_replicas=4, **cfg),
+            "beta": AutoscaleConfig(min_replicas=1, max_replicas=4, **cfg),
+        },
+        chip_budget=chip_budget,
+        chips_per_replica=chips,
+        clock=lambda: clock["t"],
+    )
+    return scaler, clock
+
+
+def _pressure_snapshot(alpha_queue=0.0, beta_queue=0.0):
+    return {
+        "models": {
+            "alpha": {"replicas": 1, "degraded": 0,
+                      "queue_depth": alpha_queue, "shed": 0},
+            "beta": {"replicas": 1, "degraded": 0,
+                     "queue_depth": beta_queue, "shed": 0},
+        }
+    }
+
+
+def test_fleet_autoscaler_decisions_are_model_tagged():
+    scaler, clock = _fleet_scaler()
+    decisions = []
+    for _ in range(3):
+        clock["t"] += 5.0
+        decisions += scaler.evaluate(_pressure_snapshot(alpha_queue=50.0))
+    ups = [d for d in decisions if d["action"] == "scale_up"]
+    assert ups and all(d["model"] == "alpha" for d in ups)
+    assert not any(d["model"] == "beta" for d in decisions)
+
+
+def test_fleet_autoscaler_defers_over_budget_scale_up():
+    # budget 2 chips, both models already hold 1 each: pressure on alpha
+    # must produce an explicit budget_deferred decision, not a spawn order
+    scaler, clock = _fleet_scaler(chip_budget=2)
+    deferred = []
+    for _ in range(4):
+        clock["t"] += 5.0
+        for d in scaler.evaluate(_pressure_snapshot(alpha_queue=50.0)):
+            if d["action"] == "budget_deferred":
+                deferred.append(d)
+    assert deferred, "over-budget pressure vanished silently"
+    d = deferred[0]
+    assert d["model"] == "alpha"
+    assert d["to_replicas"] == d["from_replicas"]
+    assert d["chip_budget"] == 2
+    assert d["chips_needed"] >= 1
+
+
+def test_fleet_autoscaler_budget_within_headroom_scales():
+    scaler, clock = _fleet_scaler(chip_budget=3)
+    ups = []
+    for _ in range(4):
+        clock["t"] += 5.0
+        for d in scaler.evaluate(_pressure_snapshot(alpha_queue=50.0)):
+            if d["action"] == "scale_up":
+                ups.append(d)
+    assert ups and ups[0]["model"] == "alpha"
+
+
+def test_fleet_autoscaler_unsatisfiable_budget_raises():
+    with pytest.raises(ValueError, match="chip_budget"):
+        _fleet_scaler(chip_budget=1)
+
+
+# -- multi-model replica (one server, N engines) -----------------------------
+
+
+@pytest.fixture(scope="module")
+def serve_fns():
+    import jax
+    import jax.numpy as jnp
+
+    def make(seed):
+        w = jax.random.normal(
+            jax.random.PRNGKey(seed), (FEATURES, CLASSES)
+        ) * 0.3
+
+        @jax.jit
+        def fn(x):
+            return {
+                "probabilities": jax.nn.softmax(x @ w, axis=-1),
+                "class": jnp.argmax(x @ w, axis=-1),
+            }
+
+        return fn
+
+    return make(0), make(1)
+
+
+def _post(url, payload, timeout=10):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture()
+def two_model_server(serve_fns):
+    from tensorflowdistributedlearning_tpu.obs.metrics import MetricsRegistry
+    from tensorflowdistributedlearning_tpu.serve import (
+        InferenceEngine,
+        MicroBatcher,
+        ServingServer,
+    )
+
+    fn_a, fn_b = serve_fns
+    eng_a = InferenceEngine(fn_a, (FEATURES,), buckets=(1, 4))
+    eng_a.warmup()
+    eng_b = InferenceEngine(
+        fn_b, (FEATURES,), buckets=(1, 4), registry=MetricsRegistry()
+    )
+    eng_b.warmup()
+    server = ServingServer(
+        eng_a,
+        MicroBatcher(eng_a, max_wait_ms=1, max_queue=32),
+        port=0,
+        model="alpha",
+        registry_version=3,
+    )
+    server.add_model(
+        "beta", eng_b, MicroBatcher(eng_b, max_wait_ms=1, max_queue=32),
+        version=7,
+    )
+    server.start()
+    yield server
+    server.shutdown()
+
+
+def test_multi_model_server_routes_by_payload(two_model_server):
+    server = two_model_server
+    url = f"http://{server.host}:{server.port}"
+    x = np.zeros((1, FEATURES), np.float32).tolist()
+    for model in ("alpha", "beta"):
+        status, body = _post(url + "/v1/predict",
+                             {"model": model, "instances": x})
+        assert status == 200 and body["n"] == 1
+    # no hint routes to the primary; an unknown name is a structured 404
+    status, _ = _post(url + "/v1/predict", {"instances": x})
+    assert status == 200
+    status, body = _post(url + "/v1/predict",
+                         {"model": "gamma", "instances": x})
+    assert status == 404
+    assert body["error"]["code"] == "model_unknown"
+    # per-tenant counters stayed isolated
+    snap = server.models_snapshot()
+    assert snap["alpha"]["completed"] == 2  # explicit + default-routed
+    assert snap["beta"]["completed"] == 1
+    assert snap["alpha"]["version"] == 3 and snap["beta"]["version"] == 7
+
+
+def test_multi_model_healthz_and_prometheus_carry_identity(two_model_server):
+    server = two_model_server
+    url = f"http://{server.host}:{server.port}"
+    health = _get(url + "/healthz")
+    assert set(health["models"]) == {"alpha", "beta"}
+    assert health["models"]["alpha"]["version"] == 3
+    assert health["models"]["beta"]["version"] == 7
+    req = urllib.request.Request(url + "/metrics",
+                                 headers={"Accept": "text/plain"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        text = resp.read().decode()
+    assert 'model="alpha"' in text and 'model="beta"' in text
+    assert 'version="7"' in text
+
+
+def test_add_model_rejects_shared_metrics_registry(serve_fns):
+    from tensorflowdistributedlearning_tpu.serve import (
+        InferenceEngine,
+        MicroBatcher,
+        ServingServer,
+    )
+
+    fn_a, fn_b = serve_fns
+    eng_a = InferenceEngine(fn_a, (FEATURES,), buckets=(1,))
+    eng_b = InferenceEngine(
+        fn_b, (FEATURES,), buckets=(1,), registry=eng_a.registry
+    )
+    server = ServingServer(
+        eng_a, MicroBatcher(eng_a, max_wait_ms=1, max_queue=8), port=0
+    )
+    with pytest.raises(ValueError, match="MetricsRegistry"):
+        server.add_model(
+            "beta", eng_b, MicroBatcher(eng_b, max_wait_ms=1, max_queue=8)
+        )
+
+
+# -- pre-warm budget ---------------------------------------------------------
+
+
+def test_warmup_budget_caps_warmed_ladder(serve_fns):
+    from tensorflowdistributedlearning_tpu.serve import InferenceEngine
+
+    fn, _ = serve_fns
+    engine = InferenceEngine(fn, (FEATURES,), buckets=(1, 4, 16))
+    engine.warmup(budget=2)
+    assert engine.warmed_buckets == {1, 4}
+    # traffic that escapes the warmed prefix compiles lazily ONCE, and the
+    # cold hit is counted per bucket
+    x = np.zeros((8, FEATURES), np.float32)
+    engine.infer(x)
+    assert engine.registry.counter("serve/cold_bucket_hits/16").value == 1
+    engine.infer(x)
+    assert engine.registry.counter("serve/cold_bucket_hits/16").value == 1
+
+
+def test_warmup_full_ladder_by_default(serve_fns):
+    from tensorflowdistributedlearning_tpu.serve import InferenceEngine
+
+    fn, _ = serve_fns
+    engine = InferenceEngine(fn, (FEATURES,), buckets=(1, 4))
+    engine.warmup()
+    assert engine.warmed_buckets == {1, 4}
+
+
+# -- fleet plumbing: model-aware spawns and device placement -----------------
+
+
+def _registry_manager(tmp_path, **entry_kw):
+    from tensorflowdistributedlearning_tpu.serve import (
+        FleetConfig,
+        FleetManager,
+    )
+
+    wd = str(tmp_path)
+    write_registry(wd, [
+        ModelEntry(name="alpha", artifact_dir="/art/alpha", weight=2.0,
+                   **entry_kw),
+        ModelEntry(name="beta", artifact_dir="/art/beta"),
+    ])
+    cfg = FleetConfig(
+        artifact_dir="/art/alpha", workdir=wd, buckets=(1, 4),
+        registry=read_registry(wd),
+    )
+    return FleetManager(cfg)
+
+
+def test_replica_argv_carries_model_identity(tmp_path):
+    manager = _registry_manager(
+        tmp_path, prewarm_budget=1, slo_p99_ms=80.0, buckets=(1,),
+    )
+    argv = manager._replica_argv(
+        1, None, model="alpha", device_mask="0,1"
+    )
+    joined = " ".join(argv)
+    assert "--artifact-dir /art/alpha" in joined
+    assert "--model alpha" in joined
+    assert "--model-version 1" in joined
+    assert "--prewarm-buckets 1" in joined
+    assert "--visible-devices 0,1" in joined
+    assert "--slo-p99-ms 80.0" in joined
+    # the entry's own ladder overrides the fleet default
+    assert "--buckets 1 " in joined + " "
+    # the other tenant spawns against its own artifact, no prewarm cap
+    argv_b = " ".join(manager._replica_argv(2, None, model="beta"))
+    assert "--artifact-dir /art/beta" in argv_b
+    assert "--model beta" in argv_b
+    assert "--prewarm-buckets" not in argv_b
+    assert "--visible-devices" not in argv_b
+
+
+def test_device_masks_round_robin_per_model(tmp_path):
+    manager = _registry_manager(tmp_path, device_slots=("0,1", "2,3"))
+    masks = [manager._draw_device_mask("alpha") for _ in range(3)]
+    assert masks == ["0,1", "2,3", "0,1"]
+    assert manager._draw_device_mask("beta") is None
+
+
+# -- promotion scoping -------------------------------------------------------
+
+
+def test_promotion_model_requires_registry(tmp_path):
+    import types
+
+    from tensorflowdistributedlearning_tpu.serve.promote import (
+        PromotionController,
+    )
+
+    manager = types.SimpleNamespace(
+        config=types.SimpleNamespace(registry=None, artifact_dir="/a")
+    )
+    controller = PromotionController(manager, router=None)
+    with pytest.raises(ValueError, match="no registry"):
+        controller.start("/candidate", model="alpha")
+
+
+def test_promotion_on_multimodel_fleet_requires_model(tmp_path):
+    import types
+
+    from tensorflowdistributedlearning_tpu.serve.promote import (
+        PromotionController,
+    )
+
+    wd = str(tmp_path)
+    write_registry(wd, [ModelEntry(name="alpha", artifact_dir="/a"),
+                        ModelEntry(name="beta", artifact_dir="/b")])
+    manager = types.SimpleNamespace(
+        config=types.SimpleNamespace(
+            registry=read_registry(wd), artifact_dir="/a"
+        )
+    )
+    controller = PromotionController(manager, router=None)
+    with pytest.raises(ValueError, match="requires a model name"):
+        controller.start("/candidate")
+
+
+# -- telemetry: the mixed-fleet warning is tenant-aware ----------------------
+
+
+def test_silent_mixed_fleet_is_multitenant_aware():
+    from tensorflowdistributedlearning_tpu.obs.report import (
+        silent_mixed_fleet,
+    )
+
+    # two artifacts, no models data, no promotion: the legacy warning
+    assert silent_mixed_fleet(
+        {"artifacts": {"f32:a": 1, "f32:b": 1}, "promotion_active": False}
+    )
+    # two artifacts BECAUSE two tenants, each on one version: by design
+    assert not silent_mixed_fleet({
+        "artifacts": {"f32:a": 1, "f32:b": 1},
+        "promotion_active": False,
+        "models": {"alpha": {"versions": {"1": 1}},
+                   "beta": {"versions": {"1": 1}}},
+    })
+    # one tenant answering from two versions with no promotion in charge:
+    # that IS the silent mix
+    assert silent_mixed_fleet({
+        "artifacts": {"f32:a": 1, "f32:b": 1},
+        "promotion_active": False,
+        "models": {"alpha": {"versions": {"1": 1, "2": 1}},
+                   "beta": {"versions": {"1": 1}}},
+    })
+    assert not silent_mixed_fleet({
+        "artifacts": {"f32:a": 1, "f32:b": 1},
+        "promotion_active": True,
+        "models": {"alpha": {"versions": {"1": 1, "2": 1}}},
+    })
+
+
+def test_report_renders_per_model_serve_and_router_lines(tmp_path):
+    from tensorflowdistributedlearning_tpu.obs import Telemetry
+    from tensorflowdistributedlearning_tpu.obs.report import report_workdir
+
+    wd = str(tmp_path)
+    tel = Telemetry(wd, run_info={"kind": "serve"})
+    tel.event(
+        "serve_window",
+        requests=10, completed=9, rejected_queue_full=1,
+        deadline_exceeded=0, errors=0, batches=5, batched_examples=9,
+        models={
+            "alpha": {"version": 3, "requests": 6, "completed": 6,
+                      "queue_depth": 0,
+                      "latency_ms": {"request": {"count": 6, "mean_ms": 4.0,
+                                                 "p50_ms": 4.0, "p90_ms": 5.0,
+                                                 "p99_ms": 6.0}}},
+            "beta": {"version": 7, "requests": 4, "completed": 3,
+                     "queue_depth": 0},
+        },
+    )
+    tel.event(
+        "router_window",
+        requests=10, routed=10, retries=0, shed=2, no_replica=0,
+        replica_failures=0,
+        fleet={
+            "status": "ok", "live": 2, "starting": 0, "draining": 0,
+            "dead": 0, "queue_depth_total": 0,
+            "models": {
+                "alpha": {"replicas": 1, "requests": 6, "routed": 6,
+                          "shed": 0, "fair_shed": 0, "worst_p99_ms": 6.0,
+                          "versions": {"3": 1}, "weight": 2.0,
+                          "queue_depth": 0, "degraded": 0},
+                "beta": {"replicas": 1, "requests": 4, "routed": 4,
+                         "shed": 2, "fair_shed": 2, "worst_p99_ms": 9.0,
+                         "versions": {"7": 1}, "weight": 1.0,
+                         "queue_depth": 0, "degraded": 0},
+            },
+        },
+        fair_share={
+            "pressured": True,
+            "weights": {"alpha": 2.0, "beta": 1.0},
+            "demand": {"alpha": 6, "beta": 6},
+            "admitted_shares": {"alpha": 0.66, "beta": 0.34},
+            "fair_shed": {"beta": 2},
+        },
+    )
+    tel.close()
+    rendered = report_workdir(wd)
+    assert "model alpha v3" in rendered
+    assert "model beta v7" in rendered
+    assert "model alpha: 1 replica(s)" in rendered
+    assert "(2 fair-shed)" in rendered
+    assert "admitted shares UNDER PRESSURE" in rendered
+    as_json = json.loads(report_workdir(wd, as_json=True))
+    assert as_json["serve"]["models"]["alpha"]["version"] == 3
+    assert (
+        as_json["serve_fleet"]["router"]["models"]["beta"]["fair_shed"] == 2
+    )
+
+
+# -- the regression sentinel's multitenant gates -----------------------------
+
+
+def test_sentinel_multitenant_gates():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from regression_sentinel import check_multitenant
+
+    good = {
+        "multitenant": {
+            "slo_p99_ms": 750.0,
+            "models": {
+                "alpha": {"ok": 100, "errors_5xx": 0, "errors_4xx": 0,
+                          "errors_conn": 0, "latency_ms": {"p99": 40.0}},
+                "beta": {"ok": 90, "errors_5xx": 0, "errors_4xx": 0,
+                         "errors_conn": 0, "latency_ms": {"p99": 45.0}},
+            },
+            "replicas": {
+                "1": {"completed": 100, "recompiles_post_warmup": 0},
+                "2": {"completed": 90, "recompiles_post_warmup": 0},
+            },
+            "saturation": {
+                "shed_429_total": 50, "errors_5xx": 0,
+                "per_model": {"alpha": {"ok": 60}, "beta": {"ok": 30}},
+                "fair_weighted": True,
+            },
+        }
+    }
+    findings = check_multitenant(good)
+    assert findings and all(f["ok"] for f in findings)
+
+    bad = json.loads(json.dumps(good))
+    bad["multitenant"]["models"]["beta"]["latency_ms"]["p99"] = 900.0
+    bad["multitenant"]["replicas"]["1"]["recompiles_post_warmup"] = 3
+    bad["multitenant"]["saturation"]["fair_weighted"] = False
+    bad["multitenant"]["saturation"]["per_model"]["beta"]["ok"] = 0
+    failed = {
+        f["metric"] for f in check_multitenant(bad) if not f["ok"]
+    }
+    assert "models.beta.p99_ms" in failed
+    assert "replica_post_warmup_recompiles" in failed
+    assert "saturation.fair_weighted" in failed
+    assert "saturation.beta.ok" in failed
+
+    # the committed baseline must itself clear every gate
+    committed = json.load(open(os.path.join(REPO, "BENCH_SERVE.json")))
+    findings = check_multitenant(committed)
+    assert findings, "BENCH_SERVE.json lost its multitenant section"
+    assert all(f["ok"] for f in findings)
+
+
+# -- subprocess end-to-end drills --------------------------------------------
+
+
+def _export_identified_artifact(directory, seed, perturb=0.0):
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowdistributedlearning_tpu.train import quantize
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    w = jax.random.normal(
+        jax.random.PRNGKey(seed), (FEATURES, CLASSES)
+    ) * 0.5
+    if perturb:
+        w = w + perturb * jax.random.normal(
+            jax.random.PRNGKey(seed + 100), w.shape
+        )
+    params = {"dense": {"kernel": w}}
+    _, section = quantize.quantize_pytree(params, "float32")
+
+    def serve(x):
+        logits = x @ params["dense"]["kernel"]
+        return {
+            "probabilities": jax.nn.softmax(logits, axis=-1),
+            "class": jnp.argmax(logits, axis=-1),
+        }
+
+    serving_lib.export_serving_artifact(
+        serve, (1, FEATURES), directory, quantization=section
+    )
+    return directory
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return env
+
+
+def _spawn_registry_fleet(workdir, extra=()):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tensorflowdistributedlearning_tpu",
+         "serve-fleet", "--workdir", workdir,
+         "--registry", registry_path(workdir),
+         "--port", "0", "--replicas", "2", "--no-autoscale",
+         "--window-secs", "2", "--buckets", "1", "4",
+         "--poll-interval-s", "0.25", *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=_env(), text=True,
+    )
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline().strip()
+        if line.startswith("{"):
+            return proc, json.loads(line)
+    proc.kill()
+    raise RuntimeError("registry serve-fleet not ready")
+
+
+def _stop_fleet(proc):
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(90)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(10)
+
+
+class _ModelLoad:
+    """Closed-loop client driving ONE tenant; latencies + non-200s kept."""
+
+    def __init__(self, url, model, clients=1, delay_s=0.01):
+        self.url = url
+        self.model = model
+        self.delay_s = delay_s
+        self.ok = 0
+        self.shed = 0
+        self.errors = []
+        self.latencies = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        rng = np.random.default_rng(3)
+        self.x = rng.normal(0, 1, (1, FEATURES)).astype(np.float32)
+        self.threads = [
+            threading.Thread(target=self._run, daemon=True)
+            for _ in range(clients)
+        ]
+        for t in self.threads:
+            t.start()
+
+    def _run(self):
+        import http.client
+        import urllib.parse
+
+        parsed = urllib.parse.urlsplit(self.url)
+        body = json.dumps(
+            {"model": self.model, "instances": self.x.tolist()}
+        )
+        conn = None
+        while not self._stop.is_set():
+            try:
+                if conn is None:
+                    conn = http.client.HTTPConnection(
+                        parsed.hostname, parsed.port, timeout=30
+                    )
+                t0 = time.perf_counter()
+                conn.request("POST", "/v1/predict", body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                dt = time.perf_counter() - t0
+                with self._lock:
+                    if resp.status == 200:
+                        self.ok += 1
+                        self.latencies.append(dt)
+                    elif resp.status == 429:
+                        self.shed += 1
+                    else:
+                        self.errors.append(resp.status)
+            except (OSError, Exception) as e:  # noqa: BLE001
+                try:
+                    if conn is not None:
+                        conn.close()
+                except OSError:
+                    pass
+                conn = None
+                with self._lock:
+                    self.errors.append(f"conn:{type(e).__name__}")
+            if self.delay_s:
+                time.sleep(self.delay_s)
+
+    def p99_ms(self):
+        with self._lock:
+            lat = list(self.latencies)
+        if not lat:
+            return None
+        return float(np.percentile(np.asarray(lat) * 1000, 99))
+
+    def stop(self):
+        self._stop.set()
+        for t in self.threads:
+            t.join(10)
+
+
+@pytest.mark.slow
+def test_multitenant_drill_fair_shed_keeps_beta_slo(tmp_path):
+    """The headline drill, part 1: two tenants behind one router with tiny
+    per-replica queues. A saturating burst on alpha must be shed back at
+    alpha (structured 429s, fair-share policy), while beta — light, steady,
+    weight 1 — keeps answering inside its SLO band with zero errors."""
+    alpha_art = _export_identified_artifact(str(tmp_path / "alpha"), seed=1)
+    beta_art = _export_identified_artifact(str(tmp_path / "beta"), seed=2)
+    workdir = str(tmp_path / "fleet")
+    os.makedirs(workdir)
+    slo_ms = 750.0
+    write_registry(workdir, [
+        ModelEntry(name="alpha", artifact_dir=alpha_art, weight=2.0,
+                   slo_p99_ms=slo_ms),
+        ModelEntry(name="beta", artifact_dir=beta_art, weight=1.0,
+                   slo_p99_ms=slo_ms),
+    ])
+    proc, header = _spawn_registry_fleet(
+        workdir, extra=("--queue-size", "4")
+    )
+    url = header["router"]
+    assert set(header.get("models") or {}) == {"alpha", "beta"}
+    beta = _ModelLoad(url, "beta", clients=1, delay_s=0.02)
+    alpha = _ModelLoad(url, "alpha", clients=16, delay_s=0.0)
+    try:
+        time.sleep(6.0)
+        alpha.stop()
+        beta.stop()
+        metrics = _get(url + "/metrics")
+        models = (metrics.get("fleet") or {}).get("models") or {}
+    finally:
+        alpha.stop()
+        beta.stop()
+        _stop_fleet(proc)
+    # the router routed both tenants and saw the saturation on alpha
+    assert models.get("alpha", {}).get("requests", 0) > 0
+    assert models.get("beta", {}).get("requests", 0) > 0
+    assert alpha.ok > 0
+    assert alpha.shed > 0, "saturating alpha was never shed"
+    # beta rode through alpha's burst: zero errors, zero shed, p99 in band
+    assert beta.errors == [], f"beta client-visible errors: {beta.errors[:5]}"
+    assert beta.shed == 0, "light beta traffic was shed during alpha's burst"
+    assert beta.ok > 20
+    assert beta.p99_ms() is not None and beta.p99_ms() <= slo_ms
+
+
+@pytest.mark.slow
+def test_multitenant_drill_promote_flips_one_model(tmp_path):
+    """The headline drill, part 2: ``promote --model alpha`` on a 2-tenant
+    fleet runs the full admission -> canary/shadow -> rollout machinery
+    against alpha only and completes as a registry version flip. Beta's
+    replica never rolls, beta's clients never see an error, and beta's
+    registry entry is untouched."""
+    alpha_v1 = _export_identified_artifact(str(tmp_path / "a1"), seed=1)
+    alpha_v2 = _export_identified_artifact(
+        str(tmp_path / "a2"), seed=1, perturb=0.002
+    )
+    beta_art = _export_identified_artifact(str(tmp_path / "b1"), seed=2)
+    workdir = str(tmp_path / "fleet")
+    os.makedirs(workdir)
+    write_registry(workdir, [
+        ModelEntry(name="alpha", artifact_dir=alpha_v1, weight=1.0),
+        ModelEntry(name="beta", artifact_dir=beta_art, weight=1.0),
+    ])
+    proc, header = _spawn_registry_fleet(workdir)
+    url = header["router"]
+    alpha = _ModelLoad(url, "alpha", clients=1, delay_s=0.005)
+    beta = _ModelLoad(url, "beta", clients=1, delay_s=0.005)
+    try:
+        time.sleep(1.0)  # pre-promotion traffic on both tenants
+        result = subprocess.run(
+            [sys.executable, "-m", "tensorflowdistributedlearning_tpu",
+             "promote", "--workdir", workdir, "--candidate-dir", alpha_v2,
+             "--model", "alpha",
+             "--shadow-secs", "1.5", "--shadow-fraction", "1.0",
+             "--shadow-min-requests", "5", "--observe-secs", "0.5",
+             "--max-p99-ratio", "5.0", "--timeout", "420", "--json"],
+            capture_output=True, text=True, env=_env(), timeout=600,
+        )
+        assert result.returncode == 0, (
+            f"promote --model alpha failed: {result.stdout}\n{result.stderr}"
+        )
+        status = json.loads(result.stdout.strip().splitlines()[-1])
+        assert status["state"] == "complete"
+        assert status.get("model") == "alpha"
+        alpha.stop()
+        beta.stop()
+    finally:
+        alpha.stop()
+        beta.stop()
+        _stop_fleet(proc)
+    # the flip landed in the registry document: alpha v2 on the candidate,
+    # beta untouched at v1 on its own artifact
+    reg = read_registry(workdir)
+    assert reg.entry("alpha").version == 2
+    assert reg.entry("alpha").artifact_dir == alpha_v2
+    assert reg.entry("beta").version == 1
+    assert reg.entry("beta").artifact_dir == beta_art
+    # zero client-visible errors on the tenant that was NOT promoted (and
+    # none on the promoted one either — that is the rollout contract)
+    assert beta.errors == [], f"beta errors during alpha promotion: " \
+                              f"{beta.errors[:10]}"
+    assert alpha.errors == [], f"alpha errors during its promotion: " \
+                               f"{alpha.errors[:10]}"
+    assert beta.ok > 50
+    # the ledger tells the scoped story: a registry_flip for alpha, and the
+    # promotion events carry the model tag
+    from tensorflowdistributedlearning_tpu.obs.ledger import read_ledger
+
+    events = read_ledger(workdir)
+    flips = [e for e in events if e.get("event") == REGISTRY_FLIP_EVENT]
+    assert len(flips) == 1 and flips[0]["model"] == "alpha"
+    start = next(e for e in events if e.get("event") == "promotion_start")
+    assert start["model"] == "alpha"
+    complete = next(
+        e for e in events if e.get("event") == "promotion_complete"
+    )
+    assert complete["model"] == "alpha" and complete["version"] == 2
+    # beta's original replica survived the whole drill: every replica_drain
+    # belongs to alpha's rollout
+    spawns = {
+        e["replica"]: e.get("model")
+        for e in events if e.get("event") == "replica_spawn"
+    }
+    beta_ids = {rid for rid, m in spawns.items() if m == "beta"}
+    drained = {
+        e["replica"] for e in events if e.get("event") == "replica_drain"
+    }
+    assert beta_ids and not (beta_ids & drained)

@@ -408,7 +408,9 @@ class _FakeReplica:
 
 class _FakeManager:
     def __init__(self, n_incumbents=3, incumbent_dir="/v1"):
-        self.config = types.SimpleNamespace(artifact_dir=incumbent_dir)
+        self.config = types.SimpleNamespace(
+            artifact_dir=incumbent_dir, registry=None
+        )
         self._reps = {
             i: _FakeReplica(i) for i in range(1, n_incumbents + 1)
         }
@@ -420,7 +422,7 @@ class _FakeManager:
     def replicas(self):
         return list(self._reps.values())
 
-    def scale_up(self, artifact_dir=None, fault_spec=None):
+    def scale_up(self, artifact_dir=None, fault_spec=None, model=None):
         rid = self._next
         self._next += 1
         rep = _FakeReplica(rid, artifact_dir=artifact_dir)
@@ -663,7 +665,7 @@ def test_controller_canary_crash_loop_rolls_back(tmp_path, monkeypatch):
 
     orig_scale_up = manager.scale_up
 
-    def crashing_scale_up(artifact_dir=None, fault_spec=None):
+    def crashing_scale_up(artifact_dir=None, fault_spec=None, model=None):
         rid = orig_scale_up(artifact_dir=artifact_dir, fault_spec=fault_spec)
         if artifact_dir == "/v2":
             # ready once, then flapping: restarts past the threshold
@@ -688,7 +690,7 @@ def test_controller_single_restart_is_tolerated(tmp_path, monkeypatch):
 
     orig_scale_up = manager.scale_up
 
-    def one_restart_scale_up(artifact_dir=None, fault_spec=None):
+    def one_restart_scale_up(artifact_dir=None, fault_spec=None, model=None):
         rid = orig_scale_up(artifact_dir=artifact_dir, fault_spec=fault_spec)
         if fault_spec:
             manager._reps[rid].restarts = 1  # died once, restarted clean

@@ -583,12 +583,16 @@ def _check_promotion_section(promo: dict) -> list:
     return problems
 
 
-def fleet_closed_loop(url: str, concurrency: int, duration_s: float) -> dict:
+def fleet_closed_loop(
+    url: str, concurrency: int, duration_s: float, model: str = None
+) -> dict:
     """Closed-loop clients against the ROUTER, status-aware: 200s count
     toward throughput, 429s are recorded as shed (with Retry-After presence
     checked — the back-off contract), anything 5xx other than the drain
     family is a hard error, and transport failures are counted separately
-    (a router must never drop a connection on the floor)."""
+    (a router must never drop a connection on the floor). With ``model``
+    set, every request names that tenant — the router's per-model routing
+    path (and the fair shedder's demand signal) under test."""
     import http.client
     import socket as socket_lib
     import urllib.parse
@@ -600,6 +604,7 @@ def fleet_closed_loop(url: str, concurrency: int, duration_s: float) -> dict:
     shed_with_retry_after = [0] * concurrency
     no_replica = [0] * concurrency
     errors_5xx = [0] * concurrency
+    errors_4xx = [0] * concurrency
     errors_conn = [0] * concurrency
     latencies: list = [[] for _ in range(concurrency)]
     barrier = threading.Barrier(concurrency + 1)
@@ -607,7 +612,10 @@ def fleet_closed_loop(url: str, concurrency: int, duration_s: float) -> dict:
     examples = rng.normal(0, 1, (concurrency, FEATURES)).astype(np.float32)
 
     def client(i: int):
-        body = json.dumps({"instances": examples[i : i + 1].tolist()})
+        payload: dict = {"instances": examples[i : i + 1].tolist()}
+        if model is not None:
+            payload["model"] = model
+        body = json.dumps(payload)
         conn = None
         barrier.wait()
         while time.monotonic() < stop:
@@ -657,6 +665,9 @@ def fleet_closed_loop(url: str, concurrency: int, duration_s: float) -> dict:
                 time.sleep(0.02)
             else:
                 errors_5xx[i] += resp.status >= 500
+                # a 404 model_unknown here means the routing hint broke —
+                # it must not hide inside a quietly-low ok count
+                errors_4xx[i] += 400 <= resp.status < 500
         if conn is not None:
             try:
                 conn.close()
@@ -681,6 +692,7 @@ def fleet_closed_loop(url: str, concurrency: int, duration_s: float) -> dict:
         "shed_with_retry_after": int(sum(shed_with_retry_after)),
         "no_replica_503": int(sum(no_replica)),
         "errors_5xx": int(sum(errors_5xx)),
+        "errors_4xx": int(sum(errors_4xx)),
         "errors_conn": int(sum(errors_conn)),
         "elapsed_s": round(elapsed, 3),
         "requests_per_sec": round(sum(ok) / elapsed, 1) if elapsed else 0.0,
@@ -704,6 +716,7 @@ def _spawn_fleet_cli(
     inject: str = None,
     window_secs: float = 2.0,
     timeout_s: float = 300.0,
+    registry_path: str = None,
 ):
     """Launch the REAL tier — ``serve-fleet`` CLI in its own process (router
     + supervisor there, replica subprocesses under it) — and return
@@ -717,7 +730,13 @@ def _spawn_fleet_cli(
     cmd = [
         sys.executable, "-m", "tensorflowdistributedlearning_tpu",
         "serve-fleet",
-        "--artifact-dir", artifact_dir,
+        # a registry (multi-tenant) fleet takes its artifact set and initial
+        # replica plan from registry.json; a plain fleet takes one artifact
+        *(
+            ["--registry", registry_path]
+            if registry_path
+            else ["--artifact-dir", artifact_dir]
+        ),
         "--workdir", workdir,
         "--port", "0",
         "--replicas", str(n),
@@ -802,10 +821,13 @@ def _fleet_ledger_stats(workdir: str) -> dict:
         if not windows:
             continue
         last = windows[-1]
-        stats[str(led.process_index)] = {
+        row = {
             "completed": last.get("completed", 0),
             "recompiles_post_warmup": last.get("recompiles_post_warmup", 0),
         }
+        if last.get("model"):
+            row["model"] = last["model"]
+        stats[str(led.process_index)] = row
     return stats
 
 
@@ -1018,6 +1040,226 @@ def _check_fleet(fleet: dict, args) -> list:
     return problems
 
 
+# the two tenants of the multitenant soak: alpha carries twice beta's
+# fair-share weight, so under saturation with equal demand the router must
+# admit alpha a strictly larger share — the fairness gate
+MT_MODELS = ("alpha", "beta")
+MT_WEIGHTS = {"alpha": 2.0, "beta": 1.0}
+
+
+def multitenant_soak(args, telemetry) -> dict:
+    """The multi-tenant section: one registry fleet, two models with their
+    own artifacts behind one router. Steady phase measures per-model
+    throughput and p99 against each tenant's SLO target plus fleet-wide
+    rps/chip; the saturation phase (tiny queues, equal oversubscribed
+    demand) must shed by weighted fair share without starving either
+    tenant; every replica must finish with zero post-warmup recompiles —
+    tenants must not trip each other's compilation caches. Record section:
+    ``multitenant`` (replayed by the regression sentinel's hard gates)."""
+    import tempfile
+
+    from tensorflowdistributedlearning_tpu.obs import capacity as capacity_lib
+    from tensorflowdistributedlearning_tpu.serve.registry import (
+        ModelEntry,
+        write_registry,
+    )
+
+    root = tempfile.mkdtemp(prefix="bench_mt_")
+    artifacts = {
+        name: export_promotion_artifact(
+            os.path.join(root, f"art-{name}"), seed=31 + i
+        )
+        for i, name in enumerate(MT_MODELS)
+    }
+
+    def entries():
+        return [
+            ModelEntry(
+                name=name,
+                artifact_dir=artifacts[name],
+                weight=MT_WEIGHTS[name],
+                replicas=1,
+                slo_p99_ms=args.mt_slo_p99_ms,
+            )
+            for name in MT_MODELS
+        ]
+
+    def run_tenants(router_url: str, per_model_clients: int,
+                    duration_s: float) -> dict:
+        """Drive both tenants CONCURRENTLY (the point of the soak) and
+        return per-model client-side stats."""
+        results: dict = {}
+
+        def drive(name: str):
+            results[name] = fleet_closed_loop(
+                router_url, per_model_clients, duration_s, model=name
+            )
+
+        threads = [
+            threading.Thread(target=drive, args=(m,), daemon=True)
+            for m in MT_MODELS
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(duration_s + 90)
+        return results
+
+    section: dict = {
+        "weights": dict(MT_WEIGHTS),
+        "slo_p99_ms": args.mt_slo_p99_ms,
+        "concurrency_per_model": args.fleet_concurrency // 2,
+        "duration_s": args.fleet_duration,
+    }
+
+    # -- steady phase: both tenants under moderate concurrent load ----------
+    print(f"multitenant steady: {len(MT_MODELS)} models x "
+          f"{args.fleet_concurrency // 2} clients, "
+          f"{args.fleet_duration}s ...", flush=True)
+    steady_dir = os.path.join(root, "mt-steady")
+    os.makedirs(steady_dir, exist_ok=True)
+    reg = write_registry(steady_dir, entries())
+    proc, router_url = _spawn_fleet_cli(
+        args, None, steady_dir, 2, registry_path=reg.path
+    )
+    try:
+        steady = run_tenants(
+            router_url, args.fleet_concurrency // 2, args.fleet_duration
+        )
+        try:
+            metrics = _get_json(router_url + "/metrics")
+            section["router_models"] = (
+                metrics.get("fleet") or {}
+            ).get("models") or {}
+        except OSError:
+            pass
+    finally:
+        _stop_fleet_cli(proc)
+    section["models"] = steady
+    section["replicas"] = _fleet_ledger_stats(steady_dir)
+    n_chips = capacity_lib.device_count()
+    section["n_chips"] = n_chips
+    total_ok = sum(r["ok"] for r in steady.values())
+    elapsed = max(r["elapsed_s"] for r in steady.values()) or 1.0
+    section["requests_per_sec_total"] = round(total_ok / elapsed, 1)
+    section["rps_per_chip_total"] = round(total_ok / elapsed / n_chips, 1)
+    telemetry.event("bench_mode", mode="multitenant_steady",
+                    rps_per_chip_total=section["rps_per_chip_total"],
+                    **{f"{m}_ok": steady[m]["ok"] for m in MT_MODELS})
+
+    # -- saturation phase: tiny queues, equal oversubscribed demand ---------
+    # fairness contract: with weight 2:1 and symmetric demand the router's
+    # fair shedder must admit alpha a larger share than beta, shed the rest
+    # as structured 429s, and starve neither tenant
+    print("multitenant saturation (tiny queues, equal demand) ...",
+          flush=True)
+    sat_dir = os.path.join(root, "mt-sat")
+    os.makedirs(sat_dir, exist_ok=True)
+    reg = write_registry(sat_dir, entries())
+    proc, router_url = _spawn_fleet_cli(
+        args, None, sat_dir, 2, registry_path=reg.path, queue_size=4
+    )
+    try:
+        sat_clients = max(args.fleet_concurrency, 24)
+        sat_runs = run_tenants(
+            router_url, sat_clients, min(args.fleet_duration, 3.0)
+        )
+    finally:
+        _stop_fleet_cli(proc)
+    admitted_total = sum(r["ok"] for r in sat_runs.values())
+    sat: dict = {
+        "queue_size": 4,
+        "concurrency_per_model": sat_clients,
+        "per_model": sat_runs,
+        "shed_429_total": sum(r["shed_429"] for r in sat_runs.values()),
+        "errors_5xx": sum(r["errors_5xx"] for r in sat_runs.values()),
+    }
+    if admitted_total:
+        sat["admitted_shares"] = {
+            m: round(sat_runs[m]["ok"] / admitted_total, 4)
+            for m in MT_MODELS
+        }
+        sat["fair_weighted"] = (
+            sat["admitted_shares"]["alpha"] >= sat["admitted_shares"]["beta"]
+        )
+    section["saturation"] = sat
+    telemetry.event("bench_mode", mode="multitenant_saturation", **{
+        k: v for k, v in sat.items() if k != "per_model"
+    })
+    return section
+
+
+def _check_multitenant(mt: dict, args) -> list:
+    """The multitenant gates (--check with --multitenant): both tenants
+    actually served with zero hard errors, every model's p99 within its SLO
+    target, zero cross-tenant recompiles on every replica, and weighted
+    fair shedding (neither tenant starved, heavier tenant admitted at least
+    the lighter one's share) under saturation."""
+    problems = []
+    models = mt.get("models") or {}
+    for name in MT_MODELS:
+        entry = models.get(name)
+        if not entry:
+            problems.append(f"multitenant: model {name} never measured")
+            continue
+        if not entry.get("ok"):
+            problems.append(
+                f"multitenant: model {name} completed zero requests"
+            )
+        for key in ("errors_5xx", "errors_4xx", "errors_conn"):
+            if entry.get(key):
+                problems.append(
+                    f"multitenant: model {name} saw {entry[key]} {key} "
+                    "under steady load"
+                )
+        p99 = (entry.get("latency_ms") or {}).get("p99")
+        if p99 is not None and p99 > mt.get("slo_p99_ms", float("inf")):
+            problems.append(
+                f"multitenant: model {name} p99 {p99}ms blew its "
+                f"{mt['slo_p99_ms']}ms SLO target"
+            )
+    for rid, stats in (mt.get("replicas") or {}).items():
+        if stats.get("recompiles_post_warmup"):
+            problems.append(
+                f"multitenant: replica {rid} saw "
+                f"{stats['recompiles_post_warmup']} post-warmup "
+                "recompile(s) — cross-tenant compilation leak"
+            )
+    if mt.get("rps_per_chip_total") is not None and (
+        mt["rps_per_chip_total"] < args.min_mt_rps_per_chip
+    ):
+        problems.append(
+            f"multitenant: fleet-wide {mt['rps_per_chip_total']} rps/chip "
+            f"< required {args.min_mt_rps_per_chip}"
+        )
+    sat = mt.get("saturation")
+    if sat is None:
+        problems.append("multitenant: saturation phase did not run")
+    else:
+        if not sat.get("shed_429_total"):
+            problems.append(
+                "multitenant saturation shed nothing — queues grew instead "
+                "of rejecting"
+            )
+        if sat.get("errors_5xx"):
+            problems.append(
+                f"multitenant saturation answered {sat['errors_5xx']} "
+                "non-drain 5xx(s)"
+            )
+        for name in MT_MODELS:
+            if not (sat.get("per_model", {}).get(name) or {}).get("ok"):
+                problems.append(
+                    f"multitenant saturation STARVED model {name} — fair "
+                    "shedding must keep every tenant serving"
+                )
+        if sat.get("fair_weighted") is False:
+            problems.append(
+                "multitenant saturation: admitted shares inverted the "
+                "fair-share weights (alpha w=2 admitted less than beta w=1)"
+            )
+    return problems
+
+
 def closed_loop(issue, concurrency: int, duration_s: float) -> dict:
     """Run ``concurrency`` closed-loop clients for ``duration_s``; returns
     completed-request throughput and client-observed latency percentiles."""
@@ -1198,6 +1440,23 @@ def main() -> int:
     parser.add_argument("--promotion-kill-after", type=int, default=25,
                         help="kill-mid-canary drill: SIGKILL the canary "
                         "after its Nth answered (shadow) request")
+    parser.add_argument("--multitenant", action="store_true",
+                        help="add the multi-tenant soak: a 2-model registry "
+                        "fleet behind one router — concurrent per-model "
+                        "load at fixed per-model SLO, weighted fair "
+                        "shedding under saturation, zero cross-tenant "
+                        "recompiles (record section: multitenant)")
+    parser.add_argument("--multitenant-only", action="store_true",
+                        help="run ONLY the multi-tenant soak (implies "
+                        "--multitenant)")
+    parser.add_argument("--mt-slo-p99-ms", type=float, default=750.0,
+                        help="per-model p99 SLO target the multitenant "
+                        "steady phase is gated against (generous for "
+                        "shared CI runners; the committed record pins the "
+                        "actual measured tails)")
+    parser.add_argument("--min-mt-rps-per-chip", type=float, default=10.0,
+                        help="--check floor for the multitenant steady "
+                        "phase's fleet-wide requests/sec per chip")
     parser.add_argument("--min-fleet-scaling", type=float, default=1.6,
                         help="--check floor for 2-replica vs 1-replica "
                         "throughput")
@@ -1211,9 +1470,13 @@ def main() -> int:
         args.fleet = True
     if args.promotion_only:
         args.promotion = True
-    if sum((args.fleet_only, args.quant_only, args.promotion_only)) > 1:
-        print("--fleet-only/--quant-only/--promotion-only are mutually "
-              "exclusive", file=sys.stderr)
+    if args.multitenant_only:
+        args.multitenant = True
+    only_flags = (args.fleet_only, args.quant_only, args.promotion_only,
+                  args.multitenant_only)
+    if sum(only_flags) > 1:
+        print("--fleet-only/--quant-only/--promotion-only/"
+              "--multitenant-only are mutually exclusive", file=sys.stderr)
         return 2
 
     from tensorflowdistributedlearning_tpu.obs import Telemetry
@@ -1250,7 +1513,8 @@ def main() -> int:
         "max_wait_ms": args.max_wait_ms,
     }
 
-    skip_ab = args.quant_only or args.fleet_only or args.promotion_only
+    skip_ab = (args.quant_only or args.fleet_only or args.promotion_only
+               or args.multitenant_only)
     if not skip_ab:
         serve_fn = make_synthetic_model()
         # one engine (with its OWN registry) per mode so counters and
@@ -1380,6 +1644,9 @@ def main() -> int:
     if args.promotion:
         record["promotion"] = promotion_soak(args, telemetry)
 
+    if args.multitenant:
+        record["multitenant"] = multitenant_soak(args, telemetry)
+
     if standalone_detector is not None:
         standalone_detector.detach()
     telemetry.event("bench_serve", **{
@@ -1427,6 +1694,16 @@ def main() -> int:
             k: kill.get(k)
             for k in ("client_errors", "restarts", "converged")
         }
+    if args.multitenant:
+        mt = record["multitenant"]
+        summary["multitenant_rps_per_chip"] = mt.get("rps_per_chip_total")
+        summary["multitenant_p99_ms"] = {
+            m: (e.get("latency_ms") or {}).get("p99")
+            for m, e in (mt.get("models") or {}).items()
+        }
+        summary["multitenant_admitted_shares"] = (
+            mt.get("saturation") or {}
+        ).get("admitted_shares")
     if args.promotion:
         promo = record["promotion"]
         summary["promotion_kill_canary"] = {
@@ -1465,6 +1742,8 @@ def main() -> int:
             problems.extend(_check_fleet(record["fleet"], args))
         if args.promotion:
             problems.extend(_check_promotion_section(record["promotion"]))
+        if args.multitenant:
+            problems.extend(_check_multitenant(record["multitenant"], args))
         if problems:
             print("CHECK FAILED: " + "; ".join(problems), file=sys.stderr)
             return 1

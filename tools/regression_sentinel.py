@@ -360,6 +360,86 @@ def check_fleet(
     return out
 
 
+def check_multitenant(
+    baseline: Dict,
+    fresh: Optional[Dict] = None,
+) -> List[Dict]:
+    """Replay the BENCH_SERVE.json ``multitenant`` section's hard gates.
+
+    Like the fleet and promotion soaks, the multi-tenant soak (``bench_serve
+    --multitenant``) is too heavy for every CI run, so the default mode
+    REPLAYS the committed section: every tenant must have served with zero
+    hard errors and a p99 inside its recorded SLO target, every replica must
+    have finished with ZERO post-warmup recompiles (tenants must not trip
+    each other's compilation caches), and the saturation phase must show
+    weighted fair shedding — structured 429s, no 5xx, neither tenant
+    starved, and the heavier tenant admitted at least the lighter one's
+    share. All gates are correctness-hard (dimensionless or gated against
+    the record's own SLO box), no machine slack. A ``--fresh-serve`` record
+    carrying its own ``multitenant`` section is gated instead."""
+    record = fresh if fresh and fresh.get("multitenant") else baseline
+    mt = record.get("multitenant")
+    if not mt:
+        return []
+    out: List[Dict] = []
+    slo = mt.get("slo_p99_ms")
+    for name, entry in (mt.get("models") or {}).items():
+        errors = (
+            entry.get("errors_5xx", 0)
+            + entry.get("errors_4xx", 0)
+            + entry.get("errors_conn", 0)
+        )
+        out.append(_finding(
+            "multitenant", f"models.{name}.errors", 0, errors,
+            "== 0 (hard)", errors == 0,
+        ))
+        out.append(_finding(
+            "multitenant", f"models.{name}.ok", ">= 1",
+            entry.get("ok", 0), ">= 1 (the tenant actually served)",
+            entry.get("ok", 0) >= 1,
+        ))
+        p99 = (entry.get("latency_ms") or {}).get("p99")
+        if slo is not None and p99 is not None:
+            out.append(_finding(
+                "multitenant", f"models.{name}.p99_ms", slo, p99,
+                f"<= {slo} (the tenant's recorded SLO target)", p99 <= slo,
+            ))
+    recompiles = sum(
+        stats.get("recompiles_post_warmup", 0) or 0
+        for stats in (mt.get("replicas") or {}).values()
+    )
+    out.append(_finding(
+        "multitenant", "replica_post_warmup_recompiles", 0, recompiles,
+        "== 0 (no cross-tenant compilation leaks)", recompiles == 0,
+    ))
+    sat = mt.get("saturation")
+    if sat is not None:
+        out.append(_finding(
+            "multitenant", "saturation.shed_429_total", ">= 1",
+            sat.get("shed_429_total", 0), ">= 1 (structured shed)",
+            sat.get("shed_429_total", 0) >= 1,
+        ))
+        out.append(_finding(
+            "multitenant", "saturation.errors_5xx", 0,
+            sat.get("errors_5xx", 0), "== 0 (hard)",
+            not sat.get("errors_5xx"),
+        ))
+        for name, entry in (sat.get("per_model") or {}).items():
+            out.append(_finding(
+                "multitenant", f"saturation.{name}.ok", ">= 1",
+                entry.get("ok", 0),
+                ">= 1 (fair shedding must not starve a tenant)",
+                entry.get("ok", 0) >= 1,
+            ))
+        out.append(_finding(
+            "multitenant", "saturation.fair_weighted", True,
+            sat.get("fair_weighted"),
+            "== true (admitted shares follow the fair-share weights)",
+            bool(sat.get("fair_weighted")),
+        ))
+    return out
+
+
 # the planner acceptance floor: auto must match or beat the hand-tuned
 # preset layout (ISSUE-14); dimensionless, so it replays without machine
 # slack like the fleet gates
@@ -629,8 +709,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "mode; the flag exists so the CI step reads as a "
                         "gate)")
     parser.add_argument("--benches",
-                        default="async,serve,fleet,records,promotion,plan,"
-                        "elastic,profile",
+                        default="async,serve,fleet,records,promotion,"
+                        "multitenant,plan,elastic,profile",
                         help="comma-separated subset to check")
     parser.add_argument("--baseline-async",
                         default=os.path.join(REPO, "BENCH_ASYNC.json"))
@@ -761,6 +841,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             findings += check_promotion(baseline, fresh)
         except (OSError, ValueError) as e:
             errors.append(f"promotion: {e}")
+    if "multitenant" in benches:
+        try:
+            baseline = _load(args.baseline_serve)
+            fresh = _load(args.fresh_serve) if args.fresh_serve else None
+            findings += check_multitenant(baseline, fresh)
+        except (OSError, ValueError) as e:
+            errors.append(f"multitenant: {e}")
     if "plan" in benches:
         try:
             baseline = _load(args.baseline_plan)
