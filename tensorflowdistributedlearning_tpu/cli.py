@@ -15,8 +15,9 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -216,6 +217,48 @@ def _add_elastic(p: argparse.ArgumentParser) -> None:
                    help="this process's rank in the explicit cluster")
 
 
+def _add_auto_promote(p: argparse.ArgumentParser) -> None:
+    """Close the train->serve loop from the training CLI: after
+    --export-serving, hand the fresh artifact straight to a live fleet's
+    promotion controller. The exit status IS the promotion verdict."""
+    p.add_argument("--auto-promote", action="store_true",
+                   help="after --export-serving, promote the exported "
+                   "artifact onto the live serve-fleet found via "
+                   "--fleet-workdir/--router: quantize-check admission "
+                   "(manifest gate), shadow-compared canary, rolling "
+                   "restart, auto-rollback — exit 0 only when the fleet "
+                   "completes the flip (what the flywheel controller runs)")
+    p.add_argument("--fleet-workdir", default=None, metavar="DIR",
+                   help="the live fleet's workdir: its router endpoint is "
+                   "read from the run-header ledger event")
+    p.add_argument("--router", default=None, metavar="URL",
+                   help="the live fleet router's base URL (overrides "
+                   "--fleet-workdir)")
+    p.add_argument("--promote-model", default=None,
+                   help="multi-tenant fleet: the registry model to promote")
+    p.add_argument("--promote-shadow-secs", type=float, default=None,
+                   help="shadow window length for the auto-promotion "
+                   "(default: the controller's)")
+    p.add_argument("--promote-min-requests", type=int, default=None,
+                   help="shadow compare floor (PromoteConfig "
+                   "shadow_min_requests)")
+    p.add_argument("--promote-max-disagree", type=float, default=None,
+                   help="class-disagreement ceiling for the shadow compare "
+                   "— a RETRAINED candidate legitimately disagrees with "
+                   "the incumbent more than a re-quantized one, loosen "
+                   "accordingly")
+    p.add_argument("--promote-max-abs-delta", type=float, default=None,
+                   help="max |delta| ceiling on float outputs during shadow")
+    p.add_argument("--promote-max-mean-delta", type=float, default=None,
+                   help="mean |delta| ceiling on float outputs during shadow")
+    p.add_argument("--promote-min-iou", type=float, default=None,
+                   help="mask-IoU floor for the shadow compare")
+    p.add_argument("--promote-max-p99-ratio", type=float, default=None,
+                   help="canary latency gate (PromoteConfig max_p99_ratio)")
+    p.add_argument("--promote-timeout", type=float, default=600.0,
+                   help="seconds to wait for a terminal promotion state")
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="tensorflowdistributedlearning_tpu",
@@ -246,6 +289,7 @@ def build_parser() -> argparse.ArgumentParser:
                          "(activations bf16); quantized exports land in "
                          "export/serving-{dtype} beside the float32 "
                          "reference and must pass quantize-check to ship")
+    _add_auto_promote(p_train)
     _add_planner(p_train)
     _add_host_loop(p_train)
     _add_observability(p_train)
@@ -340,6 +384,19 @@ def build_parser() -> argparse.ArgumentParser:
                        "(crop drops the mirror — digits/text; none streams "
                        "batches untouched; mixup/cutmix add image/label "
                        "mixing on top of flip_crop)")
+    p_fit.add_argument("--export-serving", action="store_true",
+                       help="after training, export the best checkpoint's "
+                       "standalone StableHLO serving artifact "
+                       "({model_dir}/export/serving) and stamp its "
+                       "drift_baseline (output distribution over the pinned "
+                       "eval batch) into the manifest")
+    p_fit.add_argument("--serving-dtype",
+                       choices=("float32", "bfloat16", "int8"),
+                       default="float32",
+                       help="post-training precision recipe for "
+                       "--export-serving (quantized exports land in "
+                       "export/serving-{dtype})")
+    _add_auto_promote(p_fit)
     _add_planner(p_fit)
     _add_host_loop(p_fit)
     _add_observability(p_fit)
@@ -500,6 +557,35 @@ def build_parser() -> argparse.ArgumentParser:
                          "bench_serve --fleet's kill soak converge through")
     p_serve.add_argument("--seed", type=int, default=0,
                          help="seed for ranged --inject-fault specs")
+    p_serve.add_argument("--capture-dir", default=None, metavar="DIR",
+                         help="arm the traffic-capture tee (loop/capture.py): "
+                         "sample accepted requests off the hot path into "
+                         "record shards under DIR (self-labeled with the "
+                         "served model's argmax), ledgered as capture_window "
+                         "events — the raw material `records-ingest` folds "
+                         "into a retraining dataset")
+    p_serve.add_argument("--capture-fraction", type=float, default=1.0,
+                         help="fraction of accepted requests the capture tee "
+                         "samples (deterministic stride, not a coin flip)")
+    p_serve.add_argument("--capture-quota-mb", type=float, default=64.0,
+                         help="disk ceiling for captured shards: oldest "
+                         "sealed shards are evicted first when the quota is "
+                         "exceeded (the newest shard always survives)")
+    p_serve.add_argument("--capture-records-per-shard", type=int, default=64,
+                         help="records per sealed capture shard")
+    p_serve.add_argument("--drift-threshold", type=float, default=None,
+                         help="arm the DriftMonitor (obs/health.py): total-"
+                         "variation distance between the serving output "
+                         "class distribution and the artifact manifest's "
+                         "promotion-time drift_baseline past this emits "
+                         "drift_alert ledger events (the flywheel's retrain "
+                         "trigger); requires a stamped baseline — skipped "
+                         "with a warning otherwise")
+    p_serve.add_argument("--drift-min-requests", type=int, default=20,
+                         help="window floor before a drift verdict counts")
+    p_serve.add_argument("--drift-sustain-windows", type=int, default=2,
+                         help="consecutive over-threshold windows before the "
+                         "alert fires (one weird window is noise)")
 
     p_fleet = sub.add_parser(
         "serve-fleet",
@@ -593,6 +679,22 @@ def build_parser() -> argparse.ArgumentParser:
                          "restart relaunches clean) — how the failover "
                          "tests and bench_serve --fleet's kill soak "
                          "schedule a deterministic mid-soak replica death")
+    p_fleet.add_argument("--capture-dir", default=None, metavar="DIR",
+                         help="arm every replica's traffic-capture tee: "
+                         "replica i writes record shards under "
+                         "DIR/replica-{i} (per-replica subdirs keep shard "
+                         "sequences disjoint; records-ingest walks them "
+                         "recursively)")
+    p_fleet.add_argument("--capture-fraction", type=float, default=1.0)
+    p_fleet.add_argument("--capture-quota-mb", type=float, default=64.0,
+                         help="per-replica capture disk ceiling")
+    p_fleet.add_argument("--capture-records-per-shard", type=int, default=64)
+    p_fleet.add_argument("--drift-threshold", type=float, default=None,
+                         help="arm every replica's DriftMonitor against the "
+                         "artifact's stamped drift_baseline (drift_alert "
+                         "ledger events — the flywheel retrain trigger)")
+    p_fleet.add_argument("--drift-min-requests", type=int, default=20)
+    p_fleet.add_argument("--drift-sustain-windows", type=int, default=2)
 
     p_prom = sub.add_parser(
         "promote",
@@ -712,6 +814,75 @@ def build_parser() -> argparse.ArgumentParser:
     p_qc.add_argument("--workdir", default=None,
                       help="telemetry ledger dir for the quant_check event "
                       "(default: the candidate dir)")
+
+    p_ing = sub.add_parser(
+        "records-ingest",
+        help="fold captured traffic shards into a versioned training "
+        "dataset: validate every candidate shard (full CRC re-read), dedup "
+        "by content fingerprint against the dataset manifest, copy "
+        "survivors in as train-*.tfrecord (+ .idx), bump the manifest "
+        "version — idempotent (re-running is a ledgered no-op) and "
+        "`fit --data-dir` can train on the result directly",
+    )
+    p_ing.add_argument("--capture-dir", required=True,
+                       help="directory the serve-tier capture tee wrote "
+                       "(walked recursively: per-replica subdirs merge)")
+    p_ing.add_argument("--dataset-dir", required=True,
+                       help="the versioned dataset root (dataset_manifest."
+                       "json + train-*.tfrecord); created when missing")
+    p_ing.add_argument("--prefix", default="train",
+                       help="shard filename prefix (fit's split glob)")
+    p_ing.add_argument("--workdir", default=None,
+                       help="telemetry ledger dir for the records_ingest "
+                       "event (default: the dataset dir)")
+    p_ing.add_argument("--json", action="store_true",
+                       help="print the ingest summary as JSON")
+
+    p_fly = sub.add_parser(
+        "flywheel",
+        help="continuous-learning controller (loop/controller.py): watch a "
+        "capture dir, ingest new traffic into the versioned dataset, and "
+        "when the data-volume or drift trigger fires run the retrain "
+        "command (everything after --), expecting it to train + "
+        "--export-serving --auto-promote so its exit status is the "
+        "promotion verdict — the full cycle ledgered as loop_trigger/"
+        "loop_retrain/loop_promoted/loop_rejected events",
+    )
+    p_fly.add_argument("--capture-dir", required=True,
+                       help="the serve-tier capture directory to ingest from")
+    p_fly.add_argument("--dataset-dir", required=True,
+                       help="versioned dataset the ingest step appends to "
+                       "(and the retrain command should --data-dir)")
+    p_fly.add_argument("--fleet-workdir", default=None,
+                       help="the live fleet's workdir: scanned for "
+                       "drift_alert events (the drift trigger) and the "
+                       "default home of the flywheel's own ledger")
+    p_fly.add_argument("--workdir", default=None,
+                       help="flywheel telemetry ledger dir (default: "
+                       "--fleet-workdir, written as a high-numbered "
+                       "process ledger so telemetry-report merges it)")
+    p_fly.add_argument("--min-new-records", type=int, default=256,
+                       help="data-volume trigger: retrain once this many "
+                       "new records accumulate since the last cycle; "
+                       "0 disables (drift-only)")
+    p_fly.add_argument("--no-drift-trigger", action="store_true",
+                       help="ignore drift_alert events (volume-only)")
+    p_fly.add_argument("--poll-secs", type=float, default=2.0,
+                       help="ingest + trigger evaluation cadence")
+    p_fly.add_argument("--max-cycles", type=int, default=None,
+                       help="exit after this many retrain cycles (benches "
+                       "and drills; default: run until signalled)")
+    p_fly.add_argument("--max-wait-secs", type=float, default=None,
+                       help="give up (exit 3) when no trigger fires for "
+                       "this long")
+    p_fly.add_argument("--cooldown-secs", type=float, default=0.0,
+                       help="dwell after a cycle before the next trigger "
+                       "may fire")
+    p_fly.add_argument("retrain", nargs=argparse.REMAINDER,
+                       help="the retrain command after `--`: CLI argv run "
+                       "as a subprocess of this package's CLI (e.g. `-- fit "
+                       "--preset elastic_smoke --data-dir DATASET "
+                       "--export-serving --auto-promote --fleet-workdir W`)")
 
     sub.add_parser("presets", help="list the named BASELINE config presets")
 
@@ -913,12 +1084,48 @@ def cmd_train(args) -> int:
     if getattr(args, "export_serving", False) and results:
         fold = _best_fold(results)
         out["serving_fold"] = fold
-        out["serving_artifact"] = trainer.export_serving(
+        out["serving_artifact"] = _artifact_dir(trainer.export_serving(
             fold, serving_dtype=getattr(args, "serving_dtype", "float32")
-        )
+        ))
         out["serving_dtype"] = getattr(args, "serving_dtype", "float32")
+        _stamp_baseline(out["serving_artifact"])
     print(json.dumps(out))
+    if getattr(args, "auto_promote", False):
+        if not out.get("serving_artifact"):
+            print(
+                "auto-promote: nothing exported — pass --export-serving",
+                file=sys.stderr,
+            )
+            return 2
+        return _auto_promote(args, out["serving_artifact"])
     return 0
+
+
+def _artifact_dir(path: Optional[str]) -> Optional[str]:
+    """Exporters return the serialized-module PATH; every consumer (stamp,
+    promote, serve --artifact-dir) wants the artifact DIRECTORY."""
+    if path and os.path.isfile(path):
+        return os.path.dirname(path)
+    return path
+
+
+def _stamp_baseline(artifact_dir: Optional[str]) -> None:
+    """Best-effort drift-baseline stamp on a fresh export: the serving
+    tier's DriftMonitor needs the output distribution in the manifest, but
+    a failed stamp must not fail the training run that produced the
+    artifact."""
+    if not artifact_dir:
+        return
+    from tensorflowdistributedlearning_tpu.serve.quant_check import (
+        stamp_drift_baseline,
+    )
+
+    try:
+        stamp_drift_baseline(artifact_dir)
+    except Exception as e:  # noqa: BLE001 — the export must survive
+        logging.getLogger(__name__).warning(
+            "drift-baseline stamp failed for %s: %s", artifact_dir, e
+        )
 
 
 def _predict_from_artifact(args) -> int:
@@ -1094,13 +1301,32 @@ def cmd_fit(args) -> int:
         profile_every_windows=args.profile_every_windows,
         parallelism=args.parallelism,
         hbm_budget_gb=args.hbm_budget_gb,
+        export_serving=(
+            getattr(args, "serving_dtype", "float32")
+            if getattr(args, "export_serving", False)
+            else None
+        ),
     )
-    print(json.dumps({
+    if result.serving_artifact:
+        result.serving_artifact = _artifact_dir(result.serving_artifact)
+        _stamp_baseline(result.serving_artifact)
+    summary = {
         "preset": args.preset,
         "steps": result.steps,
         "n_params": result.n_params,
         "final_metrics": result.final_metrics,
-    }))
+    }
+    if result.serving_artifact:
+        summary["serving_artifact"] = result.serving_artifact
+    print(json.dumps(summary))
+    if getattr(args, "auto_promote", False):
+        if not result.serving_artifact:
+            print(
+                "auto-promote: nothing exported — pass --export-serving",
+                file=sys.stderr,
+            )
+            return 2
+        return _auto_promote(args, result.serving_artifact)
     return 0
 
 
@@ -1404,6 +1630,52 @@ def cmd_serve(args) -> int:
         # the serving-tier drill seam: sigkill@N fires off the request path
         # (serve/server.py) — a replica that vanishes mid-soak, on schedule
         faults.install(args.inject_fault, seed=getattr(args, "seed", 0))
+    # continuous-learning arms (loop/): both apply to the PRIMARY model only
+    # — the same single-model rule as the promotion shadow tee
+    capture = drift = None
+    primary_dir = (
+        args.artifact_dir if entries is None else entries[0].artifact_dir
+    )
+    if getattr(args, "capture_dir", None):
+        from tensorflowdistributedlearning_tpu.loop.capture import (
+            TrafficCapture,
+        )
+
+        capture = TrafficCapture(
+            args.capture_dir,
+            sample_fraction=args.capture_fraction,
+            records_per_shard=args.capture_records_per_shard,
+            quota_bytes=int(args.capture_quota_mb * (1 << 20)),
+        )
+    if getattr(args, "drift_threshold", None) is not None:
+        from tensorflowdistributedlearning_tpu.obs import health as health_lib
+        from tensorflowdistributedlearning_tpu.train import (
+            serving as serving_lib,
+        )
+
+        baseline = serving_lib.read_manifest(primary_dir).get(
+            "drift_baseline"
+        )
+        if not baseline:
+            logging.getLogger(__name__).warning(
+                "serve: --drift-threshold set but %s carries no "
+                "drift_baseline — export with a current train/fit "
+                "--export-serving (or promote through the controller) to "
+                "stamp one; drift monitoring disabled",
+                primary_dir,
+            )
+        else:
+            try:
+                drift = health_lib.DriftMonitor(
+                    baseline,
+                    threshold=args.drift_threshold,
+                    min_requests=args.drift_min_requests,
+                    sustain_windows=args.drift_sustain_windows,
+                )
+            except ValueError as e:
+                logging.getLogger(__name__).warning(
+                    "serve: drift monitoring disabled: %s", e
+                )
     if entries is None:
         # single-artifact (possibly model-labelled, fleet-spawned) load
         engine = InferenceEngine.from_artifact(
@@ -1434,6 +1706,8 @@ def cmd_serve(args) -> int:
             sock=sock,
             model=args.model or DEFAULT_MODEL,
             registry_version=args.model_version,
+            capture=capture,
+            drift_monitor=drift,
         )
         warmup_field = {str(b): s for b, s in warmup_s.items()}
         models_field = (
@@ -1500,6 +1774,8 @@ def cmd_serve(args) -> int:
             sock=sock,
             model=first.name,
             registry_version=first.version,
+            capture=capture,
+            drift_monitor=drift,
         )
         for entry, eng in zip(entries[1:], engines[1:]):
             server.add_model(
@@ -1640,6 +1916,15 @@ def cmd_serve_fleet(args) -> int:
             slo_error_budget=args.slo_error_budget,
             max_restarts_per_replica=args.max_restarts_per_replica,
             fault_specs=fault_specs or None,
+            capture_dir=getattr(args, "capture_dir", None),
+            capture_fraction=getattr(args, "capture_fraction", 1.0),
+            capture_quota_mb=getattr(args, "capture_quota_mb", 64.0),
+            capture_records_per_shard=getattr(
+                args, "capture_records_per_shard", 64
+            ),
+            drift_threshold=getattr(args, "drift_threshold", None),
+            drift_min_requests=getattr(args, "drift_min_requests", 20),
+            drift_sustain_windows=getattr(args, "drift_sustain_windows", 2),
         ),
         router_host=args.host,
         router_sock=sock,
@@ -1686,13 +1971,13 @@ def cmd_serve_fleet(args) -> int:
     return 0
 
 
-def _resolve_router_url(args) -> Optional[str]:
-    """Where the live fleet's router listens: --router verbatim, or the
-    ``endpoint`` of the last serve-fleet run header in --workdir's ledger —
+def _resolve_router_url(router: Optional[str],
+                        workdir: Optional[str]) -> Optional[str]:
+    """Where the live fleet's router listens: ``router`` verbatim, or the
+    ``endpoint`` of the last serve-fleet run header in ``workdir``'s ledger —
     the same merged-workdir contract everything else in the fleet rides."""
-    if getattr(args, "router", None):
-        return args.router.rstrip("/")
-    workdir = getattr(args, "workdir", None)
+    if router:
+        return router.rstrip("/")
     if not workdir:
         return None
     from tensorflowdistributedlearning_tpu.obs.ledger import read_ledger
@@ -1707,35 +1992,21 @@ def _resolve_router_url(args) -> Optional[str]:
     return None
 
 
-def cmd_promote(args) -> int:
-    """Drive a live fleet's promotion controller over /admin/promotion:
-    start (or --abort), then follow the phase history until a terminal
-    state. Exit status IS the verdict: 0 promoted, 1 rolled back / refused /
-    aborted, 2 usage or connectivity errors."""
-    import os
+def _drive_promotion(url: str, payload: Dict, *, timeout: float = 600.0,
+                     json_out: bool = False):
+    """POST a start/abort to a live fleet's /admin/promotion and follow the
+    phase history to a terminal state. Shared by ``promote`` and the
+    ``--auto-promote`` path of train/fit (the flywheel's retrain leg).
+    Returns ``(rc, final_status_or_None)``: rc 0 = complete, 1 = rolled
+    back / refused / aborted / timed out, 2 = usage or connectivity."""
     import time as time_lib
     import urllib.error
     import urllib.request
 
-    if not args.abort and not args.candidate_dir:
-        print(
-            "promote: --candidate-dir is required (unless --abort)",
-            file=sys.stderr,
-        )
-        return 2
-    url = _resolve_router_url(args)
-    if not url:
-        print(
-            "promote: no router found — pass --router URL, or --workdir "
-            "pointing at a live serve-fleet's ledger dir",
-            file=sys.stderr,
-        )
-        return 2
-
-    def call(method: str, payload=None):
+    def call(method: str, body=None):
         req = urllib.request.Request(
             url + "/admin/promotion",
-            data=json.dumps(payload).encode() if payload is not None else None,
+            data=json.dumps(body).encode() if body is not None else None,
             headers={"Content-Type": "application/json"},
             method=method,
         )
@@ -1743,49 +2014,21 @@ def cmd_promote(args) -> int:
             return json.loads(resp.read())
 
     try:
-        if args.abort:
-            status = call("POST", {"action": "abort"})
-        else:
-            payload = {
-                "action": "start",
-                "candidate_dir": os.path.abspath(args.candidate_dir),
-            }
-            if args.reference_dir:
-                payload["reference_dir"] = os.path.abspath(args.reference_dir)
-            if args.canary_inject_fault:
-                payload["fault_spec"] = args.canary_inject_fault
-            if args.model:
-                payload["model"] = args.model
-            for key in (
-                "shadow_secs",
-                "shadow_fraction",
-                "shadow_min_requests",
-                "shadow_max_secs",
-                "shadow_min_iou",
-                "shadow_max_disagree",
-                "shadow_max_abs_delta",
-                "shadow_max_mean_delta",
-                "max_p99_ratio",
-                "observe_secs",
-            ):
-                value = getattr(args, key, None)
-                if value is not None:
-                    payload[key] = value
-            status = call("POST", payload)
+        status = call("POST", payload)
     except urllib.error.HTTPError as e:
         body = e.read().decode(errors="replace")
         print(f"promote: router answered {e.code}: {body}", file=sys.stderr)
-        return 2
+        return 2, None
     except (OSError, ValueError) as e:
         print(f"promote: cannot reach router at {url}: {e}", file=sys.stderr)
-        return 2
+        return 2, None
 
     terminal = ("complete", "rolled_back", "refused", "aborted", "idle")
-    deadline = time_lib.monotonic() + args.timeout
+    deadline = time_lib.monotonic() + timeout
     seen_phases = 0
     while True:
         history = status.get("history") or []
-        if not args.json:
+        if not json_out:
             for entry in history[seen_phases:]:
                 detail = ", ".join(
                     f"{k}={v}"
@@ -1802,12 +2045,12 @@ def cmd_promote(args) -> int:
             break
         if time_lib.monotonic() >= deadline:
             print(
-                f"promote: no terminal state after {args.timeout:.0f}s — "
+                f"promote: no terminal state after {timeout:.0f}s — "
                 "the promotion is still running fleet-side; re-run to "
                 "re-attach or pass --abort",
                 file=sys.stderr,
             )
-            return 1
+            return 1, status
         time_lib.sleep(0.5)
         try:
             status = call("GET")
@@ -1816,8 +2059,8 @@ def cmd_promote(args) -> int:
                 f"promote: lost the router mid-promotion: {e}",
                 file=sys.stderr,
             )
-            return 2
-    if args.json:
+            return 2, None
+    if json_out:
         print(json.dumps(status))
     else:
         state = status.get("state")
@@ -1827,7 +2070,112 @@ def cmd_promote(args) -> int:
         if status.get("artifacts"):
             line += f" — fleet artifacts: {status['artifacts']}"
         print(line, flush=True)
-    return 0 if status.get("state") == "complete" else 1
+    return (0 if status.get("state") == "complete" else 1), status
+
+
+def cmd_promote(args) -> int:
+    """Drive a live fleet's promotion controller over /admin/promotion:
+    start (or --abort), then follow the phase history until a terminal
+    state. Exit status IS the verdict: 0 promoted, 1 rolled back / refused /
+    aborted, 2 usage or connectivity errors."""
+    import os
+
+    if not args.abort and not args.candidate_dir:
+        print(
+            "promote: --candidate-dir is required (unless --abort)",
+            file=sys.stderr,
+        )
+        return 2
+    url = _resolve_router_url(args.router, args.workdir)
+    if not url:
+        print(
+            "promote: no router found — pass --router URL, or --workdir "
+            "pointing at a live serve-fleet's ledger dir",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.abort:
+        payload = {"action": "abort"}
+    else:
+        payload = {
+            "action": "start",
+            "candidate_dir": os.path.abspath(args.candidate_dir),
+        }
+        if args.reference_dir:
+            payload["reference_dir"] = os.path.abspath(args.reference_dir)
+        if args.canary_inject_fault:
+            payload["fault_spec"] = args.canary_inject_fault
+        if args.model:
+            payload["model"] = args.model
+        for key in (
+            "shadow_secs",
+            "shadow_fraction",
+            "shadow_min_requests",
+            "shadow_max_secs",
+            "shadow_min_iou",
+            "shadow_max_disagree",
+            "shadow_max_abs_delta",
+            "shadow_max_mean_delta",
+            "max_p99_ratio",
+            "observe_secs",
+        ):
+            value = getattr(args, key, None)
+            if value is not None:
+                payload[key] = value
+    rc, _ = _drive_promotion(
+        url, payload, timeout=args.timeout, json_out=args.json
+    )
+    return rc
+
+
+def _auto_promote(args, artifact_dir: str) -> int:
+    """The ``--auto-promote`` tail of train/fit: hand the exported artifact
+    to the live fleet's promotion controller and make the exit status the
+    verdict. No ``reference_dir`` is sent — a retrained model carries a NEW
+    source fingerprint, so the quantize-check pairing gate would refuse it;
+    admission is manifest-parse, and the shadow compare (with the
+    ``--promote-*`` bands) plus rollback is the real gate."""
+    import os
+
+    url = _resolve_router_url(
+        getattr(args, "router", None), getattr(args, "fleet_workdir", None)
+    )
+    if not url:
+        print(
+            "auto-promote: no live fleet found — pass --router URL or "
+            "--fleet-workdir pointing at the serve-fleet's ledger dir",
+            file=sys.stderr,
+        )
+        return 2
+    payload = {
+        "action": "start",
+        "candidate_dir": os.path.abspath(artifact_dir),
+    }
+    if getattr(args, "promote_model", None):
+        payload["model"] = args.promote_model
+    for flag, key in (
+        ("promote_shadow_secs", "shadow_secs"),
+        ("promote_min_requests", "shadow_min_requests"),
+        ("promote_max_disagree", "shadow_max_disagree"),
+        ("promote_max_abs_delta", "shadow_max_abs_delta"),
+        ("promote_max_mean_delta", "shadow_max_mean_delta"),
+        ("promote_min_iou", "shadow_min_iou"),
+        ("promote_max_p99_ratio", "max_p99_ratio"),
+    ):
+        value = getattr(args, flag, None)
+        if value is not None:
+            payload[key] = value
+    rc, status = _drive_promotion(
+        url, payload, timeout=getattr(args, "promote_timeout", 600.0)
+    )
+    print(json.dumps({
+        "auto_promote": True,
+        "candidate_dir": os.path.abspath(artifact_dir),
+        "state": (status or {}).get("state"),
+        "rc": rc,
+    }))
+    return rc
 
 
 def cmd_quantize_check(args) -> int:
@@ -1866,6 +2214,181 @@ def cmd_quantize_check(args) -> int:
         telemetry.close()
     print(json.dumps(result))
     return 0 if result["passed"] else 1
+
+
+def cmd_records_ingest(args) -> int:
+    """One capture->dataset ingest pass (loop/ingest.py), ledgered as a
+    ``records_ingest`` event. Idempotent: re-running over the same capture
+    tree changes nothing (and says so)."""
+    from tensorflowdistributedlearning_tpu.loop.ingest import ingest_shards
+    from tensorflowdistributedlearning_tpu.obs import Telemetry
+
+    telemetry = Telemetry(
+        args.workdir or args.dataset_dir,
+        run_info={
+            "kind": "records-ingest",
+            "capture_dir": args.capture_dir,
+            "dataset_dir": args.dataset_dir,
+        },
+    )
+    try:
+        summary = ingest_shards(
+            args.capture_dir,
+            args.dataset_dir,
+            prefix=args.prefix,
+            telemetry=telemetry,
+        )
+    finally:
+        telemetry.close(kind="records-ingest")
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(
+            f"ingest: dataset v{summary['version']} — "
+            f"+{summary['new_shards']} shards "
+            f"(+{summary['records_added']} records, "
+            f"{summary['deduped']} duplicate, {summary['corrupt']} corrupt); "
+            f"{summary['shards_total']} shards / "
+            f"{summary['records_total']} records total"
+        )
+    return 0
+
+
+def cmd_flywheel(args) -> int:
+    """The continuous-learning daemon (loop/controller.py): ingest captured
+    traffic, fire the retrain command on a data-volume or drift trigger,
+    and let its --auto-promote exit status be the cycle's verdict."""
+    import os
+    import signal
+    import subprocess
+
+    from tensorflowdistributedlearning_tpu.loop.controller import (
+        FLYWHEEL_PROCESS_INDEX,
+        FlywheelConfig,
+        FlywheelController,
+    )
+    from tensorflowdistributedlearning_tpu.obs import Telemetry
+
+    retrain_argv = list(args.retrain or [])
+    if retrain_argv and retrain_argv[0] == "--":
+        retrain_argv = retrain_argv[1:]
+    if not retrain_argv:
+        print(
+            "flywheel: no retrain command — append `-- fit --preset ... "
+            "--data-dir DATASET --export-serving --auto-promote "
+            "--fleet-workdir W`",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        config = FlywheelConfig(
+            capture_dir=args.capture_dir,
+            dataset_dir=args.dataset_dir,
+            fleet_workdir=(
+                None if args.no_drift_trigger else args.fleet_workdir
+            ),
+            min_new_records=args.min_new_records,
+            poll_secs=args.poll_secs,
+            max_cycles=args.max_cycles,
+            max_wait_secs=args.max_wait_secs,
+            cooldown_secs=args.cooldown_secs,
+        )
+    except ValueError as e:
+        print(f"flywheel: {e}", file=sys.stderr)
+        return 2
+
+    workdir = args.workdir or args.fleet_workdir or args.dataset_dir
+    shared = args.fleet_workdir is not None and os.path.abspath(
+        workdir
+    ) == os.path.abspath(args.fleet_workdir)
+    telemetry = Telemetry(
+        workdir,
+        # sharing the fleet's workdir: write a high-numbered per-process
+        # ledger the report merges, NEVER the fleet controller's process-0
+        # telemetry.jsonl
+        process_index=FLYWHEEL_PROCESS_INDEX if shared else 0,
+        run_info={
+            "kind": "flywheel",
+            "capture_dir": args.capture_dir,
+            "dataset_dir": args.dataset_dir,
+            "fleet_workdir": args.fleet_workdir,
+            "retrain": retrain_argv,
+        },
+    )
+
+    def retrain(trigger, ingest_summary):
+        argv = [
+            sys.executable, "-m", "tensorflowdistributedlearning_tpu",
+            *retrain_argv,
+        ]
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = (
+            pkg_root + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH")
+            else pkg_root
+        )
+        proc = subprocess.run(
+            argv, capture_output=True, text=True, env=env, check=False
+        )
+        # the child's output is the cycle's audit trail — surface it
+        sys.stdout.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+        result = {"rc": proc.returncode}
+        # the retrain's JSON tail names the artifact: fit/train print
+        # serving_artifact, the auto-promote verdict prints candidate_dir
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(obj, dict):
+                continue
+            cand = obj.get("candidate_dir") or obj.get("serving_artifact")
+            if cand:
+                result["candidate_dir"] = cand
+                break
+        if result.get("candidate_dir"):
+            try:
+                from tensorflowdistributedlearning_tpu.train import (
+                    serving as serving_lib,
+                )
+
+                manifest = serving_lib.read_manifest(result["candidate_dir"])
+                result["fingerprint"] = (
+                    manifest.get("quantization") or {}
+                ).get("source_fingerprint")
+            except (OSError, ValueError, KeyError):
+                pass
+        return result
+
+    controller = FlywheelController(
+        config, retrain_fn=retrain, telemetry=telemetry
+    )
+
+    def _on_signal(signum, frame):
+        controller.stop()
+
+    previous = {}
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        previous[sig] = signal.signal(sig, _on_signal)
+    try:
+        rc = controller.run()
+    finally:
+        for sig, handler in previous.items():
+            signal.signal(sig, handler)
+        telemetry.close(kind="flywheel")
+    print(
+        json.dumps({
+            "flywheel": True,
+            "cycles": controller.cycles,
+            "promoted": controller.promoted,
+            "rejected": controller.rejected,
+            "rc": rc,
+        }),
+        flush=True,
+    )
+    return rc
 
 
 def cmd_presets(args) -> int:
@@ -2362,6 +2885,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         "serve-fleet": cmd_serve_fleet,
         "promote": cmd_promote,
         "quantize-check": cmd_quantize_check,
+        "records-ingest": cmd_records_ingest,
+        "flywheel": cmd_flywheel,
         "presets": cmd_presets,
         "plan": cmd_plan,
         "records-index": cmd_records_index,

@@ -1,0 +1,10 @@
+"""Continuous-learning flywheel: capture -> ingest -> drift -> retrain.
+
+The loop that turns the serving stack into a learning system (ROADMAP
+item 1). ``capture`` tees accepted traffic off the serving hot path into
+record shards; ``ingest`` validates and dedups them into a versioned
+dataset manifest; ``controller`` watches data volume and ``drift_alert``
+events and fires ``fit --export-serving --auto-promote`` retrains, with
+the promotion controller's admission + shadow-rollback as the safety net.
+Every decision is ledgered (``loop_*`` events, docs/LEDGER_SCHEMA.md).
+"""

@@ -1,0 +1,246 @@
+"""Flywheel controller: the supervised daemon that closes the loop.
+
+One poll cycle: ingest whatever the capture tees sealed since last time,
+then evaluate the two retrain triggers — enough new data
+(``min_new_records``) or an unresolved ``drift_alert`` in the fleet's
+ledger (obs/health.py DriftMonitor). A trigger fires ONE retrain through
+the injected ``retrain_fn`` (the CLI wires a ``fit --export-serving
+--auto-promote`` subprocess; tests inject a stub), whose exit status IS
+the promotion verdict — the promotion controller's quantize-check
+admission and shadow-compare rollback already guard the fleet, so the
+flywheel never needs its own safety logic.
+
+Every decision lands in the run ledger: ``loop_trigger`` -> ``loop_retrain``
+-> ``loop_promoted`` | ``loop_rejected`` (docs/LEDGER_SCHEMA.md), the
+history telemetry-report renders as the loop's audit trail.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from tensorflowdistributedlearning_tpu.loop.ingest import ingest_shards
+
+logger = logging.getLogger(__name__)
+
+LOOP_TRIGGER_EVENT = "loop_trigger"
+LOOP_RETRAIN_EVENT = "loop_retrain"
+LOOP_PROMOTED_EVENT = "loop_promoted"
+LOOP_REJECTED_EVENT = "loop_rejected"
+
+# the flywheel's ledger slot when it shares the fleet's workdir: far above
+# any replica id, so telemetry-{900}.jsonl never collides with a replica's
+# per-process ledger and telemetry-report merges it like any other process
+FLYWHEEL_PROCESS_INDEX = 900
+
+
+@dataclasses.dataclass
+class FlywheelConfig:
+    capture_dir: str
+    dataset_dir: str
+    # where the serving fleet ledgers live — the drift_alert source; None
+    # disables the drift trigger (volume-only loop)
+    fleet_workdir: Optional[str] = None
+    # data-volume trigger: newly ingested records since the last retrain;
+    # 0 disables it (drift-only loop)
+    min_new_records: int = 256
+    poll_secs: float = 2.0
+    # retrain cycles to run before exiting; None = run until signaled
+    max_cycles: Optional[int] = None
+    # give up when no trigger fires for this long SINCE THE LAST CYCLE
+    # (or start) — the drill's "the loop must actually close" timeout
+    max_wait_secs: Optional[float] = None
+    cooldown_secs: float = 0.0
+
+    def __post_init__(self):
+        if self.min_new_records < 0:
+            raise ValueError("min_new_records must be >= 0")
+        if self.min_new_records == 0 and self.fleet_workdir is None:
+            raise ValueError(
+                "no trigger armed: min_new_records=0 disables the volume "
+                "trigger and no fleet_workdir means no drift trigger"
+            )
+        if self.poll_secs <= 0:
+            raise ValueError("poll_secs must be > 0")
+
+
+def scan_drift_alerts(
+    fleet_workdir: str, since_t: float = 0.0
+) -> Optional[Dict]:
+    """The newest UNRESOLVED ``drift_alert`` across every ledger in the
+    fleet workdir (each replica writes its own telemetry-{i}.jsonl), newer
+    than ``since_t``. A per-replica resolved alert retracts that replica's
+    earlier firing; torn lines are skipped — readers ignore what they
+    cannot parse, same as every other ledger consumer."""
+    latest: Dict[str, Dict] = {}
+    paths = glob.glob(os.path.join(fleet_workdir, "telemetry*.jsonl"))
+    for path in sorted(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    if '"drift_alert"' not in line:
+                        continue
+                    try:
+                        e = json.loads(line)
+                    except ValueError:
+                        continue
+                    if e.get("event") == "drift_alert":
+                        latest[path] = e
+        except OSError:
+            continue
+    live = [
+        e
+        for e in latest.values()
+        if not e.get("resolved") and e.get("t", 0.0) > since_t
+    ]
+    return max(live, key=lambda e: e.get("t", 0.0)) if live else None
+
+
+class FlywheelController:
+    """``run()`` drives poll cycles until ``max_cycles`` retrains completed,
+    ``max_wait_secs`` passed without a trigger, or ``stop()``.
+
+    ``retrain_fn(trigger, ingest_summary) -> dict`` runs one retrain and
+    must return at least ``{"rc": int}``; ``candidate_dir``/``fingerprint``
+    keys ride into the verdict events when present. Exit status: 0 when
+    every cycle promoted (and at least one ran), 1 when any retrain was
+    rejected, 3 when the loop timed out without a single trigger."""
+
+    def __init__(
+        self,
+        config: FlywheelConfig,
+        *,
+        retrain_fn: Callable[[Dict, Dict], Dict],
+        telemetry=None,
+        ingest_fn: Callable = ingest_shards,
+    ):
+        from tensorflowdistributedlearning_tpu.obs.telemetry import (
+            NULL_TELEMETRY,
+        )
+
+        self.config = config
+        self.telemetry = telemetry if telemetry is not None else NULL_TELEMETRY
+        self.retrain_fn = retrain_fn
+        self.ingest_fn = ingest_fn
+        self._stop = threading.Event()
+        self.records_since_retrain = 0
+        self.cycles = 0
+        self.promoted = 0
+        self.rejected = 0
+        # drift alerts at or before this wall-clock time are consumed: a
+        # retrain answers every alert that preceded it
+        self._drift_handled_t = 0.0
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- triggers -------------------------------------------------------------
+
+    def _evaluate_trigger(self) -> Optional[Dict]:
+        cfg = self.config
+        if (
+            cfg.min_new_records > 0
+            and self.records_since_retrain >= cfg.min_new_records
+        ):
+            return {
+                "reason": "data_volume",
+                "records_new": self.records_since_retrain,
+                "min_new_records": cfg.min_new_records,
+            }
+        if cfg.fleet_workdir is not None:
+            alert = scan_drift_alerts(
+                cfg.fleet_workdir, since_t=self._drift_handled_t
+            )
+            if alert is not None:
+                return {
+                    "reason": "drift",
+                    "records_new": self.records_since_retrain,
+                    "drift_score": alert.get("score"),
+                    "drift_threshold": alert.get("threshold"),
+                    "drift_alert_t": alert.get("t"),
+                    "alert_id": alert.get("alert_id"),
+                }
+        return None
+
+    # -- one retrain cycle ----------------------------------------------------
+
+    def _retrain(self, trigger: Dict, ingest_summary: Dict) -> None:
+        cfg = self.config
+        self.telemetry.event(
+            LOOP_TRIGGER_EVENT,
+            dataset_version=ingest_summary.get("version"),
+            records_total=ingest_summary.get("records_total"),
+            **trigger,
+        )
+        t0 = time.monotonic()
+        try:
+            result = self.retrain_fn(trigger, ingest_summary) or {}
+        except Exception as e:  # noqa: BLE001 — a retrain crash is a
+            # rejected cycle, not a dead daemon
+            logger.exception("flywheel retrain failed")
+            result = {"rc": -1, "error": f"{type(e).__name__}: {e}"}
+        duration_s = round(time.monotonic() - t0, 3)
+        rc = int(result.get("rc", -1))
+        fields = {
+            "rc": rc,
+            "duration_s": duration_s,
+            "reason": trigger["reason"],
+            "dataset_version": ingest_summary.get("version"),
+        }
+        for k in ("candidate_dir", "fingerprint", "error"):
+            if result.get(k) is not None:
+                fields[k] = result[k]
+        self.telemetry.event(LOOP_RETRAIN_EVENT, **fields)
+        verdict = dict(fields)
+        verdict.pop("reason", None)
+        if rc == 0:
+            self.promoted += 1
+            self.telemetry.event(LOOP_PROMOTED_EVENT, **verdict)
+        else:
+            self.rejected += 1
+            self.telemetry.event(LOOP_REJECTED_EVENT, **verdict)
+        self.cycles += 1
+        self.records_since_retrain = 0
+        # the retrain answers everything that came before it, including
+        # alerts the retrain itself may have taken minutes to address
+        self._drift_handled_t = time.time()
+        if cfg.cooldown_secs > 0:
+            self._stop.wait(cfg.cooldown_secs)
+
+    # -- main loop ------------------------------------------------------------
+
+    def run(self) -> int:
+        cfg = self.config
+        waiting_since = time.monotonic()
+        while not self._stop.is_set():
+            summary = self.ingest_fn(
+                cfg.capture_dir, cfg.dataset_dir, telemetry=self.telemetry
+            )
+            self.records_since_retrain += summary.get("records_added", 0)
+            trigger = self._evaluate_trigger()
+            if trigger is not None:
+                self._retrain(trigger, summary)
+                waiting_since = time.monotonic()
+                if cfg.max_cycles is not None and self.cycles >= cfg.max_cycles:
+                    break
+                continue
+            if (
+                cfg.max_wait_secs is not None
+                and time.monotonic() - waiting_since > cfg.max_wait_secs
+            ):
+                logger.warning(
+                    "flywheel: no trigger within %.1fs — giving up",
+                    cfg.max_wait_secs,
+                )
+                return 3 if self.cycles == 0 else (1 if self.rejected else 0)
+            self._stop.wait(cfg.poll_secs)
+        if self.cycles == 0:
+            return 3
+        return 1 if self.rejected else 0

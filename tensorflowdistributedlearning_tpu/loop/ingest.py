@@ -1,0 +1,156 @@
+"""records-ingest: captured shards -> a versioned training dataset.
+
+The bridge between the capture tee and the trainers: every sealed
+``capture-*.tfrecord`` under a capture tree (replicas write per-replica
+subdirectories) is CRC-validated record by record, content-fingerprinted,
+and — when new — copied into the dataset directory under a ``train-``
+prefixed, fingerprint-derived name the ``fit`` glob and the data service's
+per-epoch shard re-deal (``data/service.py``) pick up directly.
+
+``dataset_manifest.json`` is the dedup ledger and the version counter:
+re-ingesting the same capture tree is a no-op (same fingerprints, same
+version — idempotence is a tested contract), and the version bumps only
+when the shard set actually changes, so a retrain can cite exactly which
+dataset version it trained on. Manifest installs are atomic
+(tmp + ``os.replace``); a torn ingest re-validates from the shards.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import shutil
+import time
+from typing import Dict, List, Optional
+
+from tensorflowdistributedlearning_tpu.data import records as records_lib
+
+logger = logging.getLogger(__name__)
+
+INGEST_EVENT = "records_ingest"
+MANIFEST_NAME = "dataset_manifest.json"
+
+
+def read_dataset_manifest(dataset_dir: str) -> Dict:
+    path = os.path.join(dataset_dir, MANIFEST_NAME)
+    try:
+        with open(path, encoding="utf-8") as f:
+            manifest = json.load(f)
+    except FileNotFoundError:
+        return {"version": 0, "shards": [], "records_total": 0}
+    if not isinstance(manifest.get("shards"), list):
+        raise ValueError(f"{path}: malformed dataset manifest (no shard list)")
+    return manifest
+
+
+def _write_manifest(dataset_dir: str, manifest: Dict) -> None:
+    path = os.path.join(dataset_dir, MANIFEST_NAME)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def _discover_capture_shards(capture_dir: str) -> List[str]:
+    """Every sealed capture shard under the tree, oldest-first per directory
+    (seal order is encoded in the shard sequence number). Temp files from a
+    mid-seal writer never match — installs are atomic renames."""
+    found: List[str] = []
+    for root, _dirs, files in os.walk(capture_dir):
+        found.extend(
+            os.path.join(root, f)
+            for f in files
+            if f.startswith("capture-") and f.endswith(".tfrecord")
+        )
+    return sorted(found)
+
+
+def _validate_shard(path: str) -> Optional[Dict]:
+    """Full CRC re-read + content fingerprint, or None when corrupt. The
+    fingerprint hashes the RECORD PAYLOADS (not the file) so it is stable
+    across framing rewrites and is the dedup identity."""
+    digest = hashlib.md5()
+    n = 0
+    try:
+        for rec in records_lib.read_records(path, verify=True):
+            digest.update(rec)
+            n += 1
+    except (OSError, ValueError) as e:
+        logger.warning("ingest: skipping corrupt shard %s: %s", path, e)
+        return None
+    if n == 0:
+        return None
+    return {
+        "fingerprint": digest.hexdigest()[:16],
+        "records": n,
+        "bytes": os.path.getsize(path),
+    }
+
+
+def ingest_shards(
+    capture_dir: str,
+    dataset_dir: str,
+    *,
+    prefix: str = "train",
+    telemetry=None,
+) -> Dict:
+    """One ingest pass; returns (and optionally ledgers) the summary.
+
+    Accepted shards land as ``{prefix}-{fingerprint}.tfrecord`` + ``.idx``
+    in ``dataset_dir`` — glob-compatible with ``fit --data-dir`` and
+    deterministic, so the copy itself is idempotent too."""
+    os.makedirs(dataset_dir, exist_ok=True)
+    manifest = read_dataset_manifest(dataset_dir)
+    seen = {s["fingerprint"] for s in manifest["shards"]}
+    new_shards: List[Dict] = []
+    deduped = corrupt = records_added = bytes_added = 0
+    for path in _discover_capture_shards(capture_dir):
+        info = _validate_shard(path)
+        if info is None:
+            corrupt += 1
+            continue
+        if info["fingerprint"] in seen:
+            deduped += 1
+            continue
+        name = f"{prefix}-{info['fingerprint']}.tfrecord"
+        dest = os.path.join(dataset_dir, name)
+        tmp = f"{dest}.{os.getpid()}.tmp"
+        shutil.copyfile(path, tmp)
+        os.replace(tmp, dest)
+        records_lib.write_shard_index(dest)
+        entry = {
+            **info,
+            "name": name,
+            "source": os.path.relpath(path, capture_dir),
+            "ingested_t": round(time.time(), 3),
+        }
+        seen.add(info["fingerprint"])
+        new_shards.append(entry)
+        records_added += info["records"]
+        bytes_added += info["bytes"]
+    if new_shards:
+        manifest["shards"].extend(new_shards)
+        manifest["version"] = int(manifest.get("version", 0)) + 1
+        manifest["records_total"] = sum(
+            s["records"] for s in manifest["shards"]
+        )
+        _write_manifest(dataset_dir, manifest)
+    summary = {
+        "dataset_dir": dataset_dir,
+        "capture_dir": capture_dir,
+        "version": int(manifest.get("version", 0)),
+        "new_shards": len(new_shards),
+        "deduped": deduped,
+        "corrupt": corrupt,
+        "records_added": records_added,
+        "bytes_added": bytes_added,
+        "shards_total": len(manifest["shards"]),
+        "records_total": int(manifest.get("records_total", 0)),
+    }
+    if telemetry is not None:
+        # ledgered even when a no-op: "ingest ran and found nothing new" is
+        # evidence the loop is alive, not an error to hide
+        telemetry.event(INGEST_EVENT, **summary)
+    return summary

@@ -30,7 +30,14 @@ Monitors:
   budget: with budget ``b``, "p99 <= target" IS "at most ``b`` of requests
   over target" (b=0.01 by default), so one fraction drives both the alert
   and the /healthz flip, and deadline-exceeded requests count as violations
-  even though they never produce a latency sample.
+  even though they never produce a latency sample;
+- :class:`DriftMonitor` — serving OUTPUT-distribution shift against the
+  promotion-time ``quant_check`` baseline persisted in the artifact
+  manifest (``drift_baseline``): total-variation distance between the
+  window's class histogram and the baseline's. Emits its own event kind —
+  ``drift_alert`` — because its consumer is different in kind: the
+  flywheel controller (loop/controller.py) treats an unresolved alert as a
+  RETRAIN TRIGGER, not just an operator alarm.
 
 All alerts share one event schema: ``health_alert`` with ``monitor``,
 ``severity`` ("warn" | "critical"), ``step`` (trainer-side), a unique
@@ -51,6 +58,7 @@ from typing import Deque, Dict, List, Optional
 from tensorflowdistributedlearning_tpu.obs import trace as trace_lib
 
 HEALTH_ALERT_EVENT = "health_alert"
+DRIFT_ALERT_EVENT = "drift_alert"
 
 NAN_ACTIONS = ("warn", "abort", "off")
 
@@ -423,6 +431,134 @@ class SloTracker:
             out["window_violations"] = w.violations
             if w.p99_ms is not None:
                 out["window_p99_ms"] = w.p99_ms
+        return out
+
+
+class DriftMonitor:
+    """Serving output-distribution drift vs the promotion-time baseline.
+
+    The baseline is the artifact manifest's ``drift_baseline`` section —
+    ``quant_check.summarize_output_distribution`` over the pinned eval
+    batch, persisted at export/promotion time so no eval re-run is needed.
+    The monitor tracks the first integer-valued output it names (fit's
+    serving artifacts call it ``class``): ``observe`` folds each answered
+    request's class ids into a histogram, ``evaluate`` (called at serve
+    ledger windows) drains it and scores the shift as total-variation
+    distance ``0.5 * sum|p - q|`` in [0, 1].
+
+    Transition-disciplined like every monitor here: one ``drift_alert``
+    on ok->drifted (after ``sustain_windows`` consecutive bad windows —
+    one odd traffic window is not a distribution shift), one
+    ``resolved: true`` on recovery. Windows under ``min_requests`` are
+    ignored: an idle replica has no distribution to compare."""
+
+    def __init__(
+        self,
+        baseline: Dict,
+        *,
+        threshold: float = 0.35,
+        min_requests: int = 20,
+        sustain_windows: int = 2,
+    ):
+        if not 0.0 < threshold <= 1.0:
+            raise ValueError(f"threshold must be in (0, 1], got {threshold}")
+        if sustain_windows < 1:
+            raise ValueError("sustain_windows must be >= 1")
+        outputs = baseline.get("outputs") or {}
+        self.output_name = None
+        hist = None
+        for name in sorted(outputs):
+            spec = outputs[name]
+            if spec.get("kind") == "integer" and spec.get("hist"):
+                self.output_name, hist = name, spec["hist"]
+                break
+        if hist is None:
+            raise ValueError(
+                "drift baseline has no integer output histogram — "
+                "re-export the artifact (the exporter stamps drift_baseline) "
+                f"or re-promote it; baseline outputs: {sorted(outputs)}"
+            )
+        total = sum(float(v) for v in hist.values()) or 1.0
+        self.baseline_hist = {
+            int(k): float(v) / total for k, v in hist.items()
+        }
+        self.threshold = float(threshold)
+        self.min_requests = max(1, int(min_requests))
+        self.sustain_windows = int(sustain_windows)
+        self.healthy = True
+        self.last_score: Optional[float] = None
+        self._bad_streak = 0
+        self._lock = threading.Lock()
+        self._counts: Dict[int, int] = {}
+        self._n = 0
+
+    def observe(self, outputs: Dict) -> None:
+        """Fold one answered request's outputs; cheap (a bincount over the
+        batch's class ids) and silent on shape surprises — the monitor must
+        never make a 200 into a 500."""
+        arr = outputs.get(self.output_name)
+        if arr is None:
+            return
+        try:
+            import numpy as np
+
+            flat = np.asarray(arr).reshape(-1)
+            with self._lock:
+                for cls, cnt in zip(*np.unique(flat, return_counts=True)):
+                    self._counts[int(cls)] = (
+                        self._counts.get(int(cls), 0) + int(cnt)
+                    )
+                self._n += int(flat.size)
+        except (ValueError, TypeError):
+            return
+
+    def evaluate(self) -> Optional[Dict]:
+        """Drain the window; alert dict on the ok->drifted transition (or
+        the resolution), None otherwise — the server ledgers it as a
+        ``drift_alert`` event."""
+        with self._lock:
+            counts, n = self._counts, self._n
+            self._counts, self._n = {}, 0
+        if n < self.min_requests:
+            return None
+        classes = set(self.baseline_hist) | set(counts)
+        score = 0.5 * sum(
+            abs(counts.get(c, 0) / n - self.baseline_hist.get(c, 0.0))
+            for c in classes
+        )
+        self.last_score = round(score, 4)
+        drifted = score > self.threshold
+        self._bad_streak = self._bad_streak + 1 if drifted else 0
+        fields = {
+            "monitor": "drift",
+            "output": self.output_name,
+            "score": self.last_score,
+            "threshold": self.threshold,
+            "window_outputs": n,
+            "severity": "critical" if drifted else "warn",
+        }
+        if drifted and self.healthy:
+            if self._bad_streak < self.sustain_windows:
+                return None
+            self.healthy = False
+            fields["sustained_windows"] = self._bad_streak
+            return fields
+        if not drifted and not self.healthy:
+            self.healthy = True
+            fields["severity"] = "warn"
+            fields["resolved"] = True
+            return fields
+        return None
+
+    def snapshot(self) -> Dict:
+        """The live view serve windows embed (``drift`` sub-dict)."""
+        out: Dict = {
+            "output": self.output_name,
+            "threshold": self.threshold,
+            "healthy": self.healthy,
+        }
+        if self.last_score is not None:
+            out["score"] = self.last_score
         return out
 
 

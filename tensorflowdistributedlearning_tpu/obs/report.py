@@ -105,6 +105,13 @@ def _serve_section(windows: List[Dict]) -> Dict:
         )
     if windows[-1].get("slo"):
         section["slo"] = windows[-1]["slo"]
+    # capture-tee loss (cumulative, like the other counters): samples the
+    # loop WANTED but the bounded queue dropped — visible capture loss is
+    # the fix for the shadow tee's original silent-drop gap
+    if last.get("tee_dropped"):
+        section["tee_dropped"] = last["tee_dropped"]
+    if last.get("drift"):
+        section["drift"] = last["drift"]
     # multi-tenant replica: per-model counters/latency/SLO ride in the last
     # window's "models" dict (serve/server.py emit_window); a single-tenant
     # model-aware replica stamps "model"/"model_version" at top level
@@ -190,6 +197,7 @@ def _serve_fleet_section(events: List[Dict]) -> Optional[Dict]:
                     "shed",
                     "no_replica",
                     "replica_failures",
+                    "tee_dropped",
                 )
             },
             "per_replica_routed": last.get("per_replica_routed", {}),
@@ -308,6 +316,145 @@ def _promotion_section(events: List[Dict]) -> Optional[Dict]:
             if rollbacks[-1].get(k) is not None
         }
     return section
+
+
+_LOOP_KINDS = (
+    "loop_trigger",
+    "loop_retrain",
+    "loop_promoted",
+    "loop_rejected",
+)
+
+
+def _loop_section(ledgers) -> Optional[Dict]:
+    """The continuous-learning loop's audit trail (loop/), merged across
+    EVERY process ledger in the workdir: capture_window/drift_alert events
+    live in the replica ledgers (process >= 1), records_ingest and the
+    loop_* cycle events in the flywheel's high-numbered ledger. None when
+    nothing loop-related ever ran here."""
+    merged: List[Dict] = []
+    for led in ledgers:
+        merged.extend(
+            e
+            for e in led.events
+            if e.get("event")
+            in _LOOP_KINDS + ("capture_window", "records_ingest", "drift_alert")
+        )
+    if not merged:
+        return None
+    merged.sort(key=lambda e: e.get("t", 0.0))
+    section: Dict = {}
+
+    captures = [e for e in merged if e.get("event") == "capture_window"]
+    if captures:
+        # totals are cumulative per replica — take each replica's last window
+        last_per_replica: Dict = {}
+        for e in captures:
+            last_per_replica[e.get("replica", 0)] = e
+        section["capture"] = {
+            "windows": len(captures),
+            "replicas": len(last_per_replica),
+            "captured": sum(
+                e.get("total_captured", 0)
+                for e in last_per_replica.values()
+            ),
+            "dropped": sum(
+                e.get("total_dropped", 0) for e in last_per_replica.values()
+            ),
+            "shards": sum(
+                e.get("shards", 0) for e in last_per_replica.values()
+            ),
+            "evicted": sum(
+                e.get("shards_evicted", 0) for e in captures
+            ),
+            "bytes_on_disk": sum(
+                e.get("bytes_on_disk", 0)
+                for e in last_per_replica.values()
+            ),
+        }
+
+    ingests = [e for e in merged if e.get("event") == "records_ingest"]
+    if ingests:
+        last = ingests[-1]
+        section["ingest"] = {
+            "runs": len(ingests),
+            "records_added": sum(e.get("records_added", 0) for e in ingests),
+            "new_shards": sum(e.get("new_shards", 0) for e in ingests),
+            "deduped": sum(e.get("deduped", 0) for e in ingests),
+            "corrupt": sum(e.get("corrupt", 0) for e in ingests),
+            "dataset_version": last.get("version"),
+            "records_total": last.get("records_total"),
+            "dataset_dir": last.get("dataset_dir"),
+        }
+
+    drift_alerts = [e for e in merged if e.get("event") == "drift_alert"]
+    fired = [e for e in drift_alerts if not e.get("resolved")]
+    if drift_alerts:
+        section["drift"] = {
+            "alerts": len(fired),
+            "resolved": len(drift_alerts) - len(fired),
+            "last": {
+                k: drift_alerts[-1].get(k)
+                for k in (
+                    "replica", "score", "threshold", "output", "resolved",
+                )
+                if drift_alerts[-1].get(k) is not None
+            },
+        }
+
+    cycles = [e for e in merged if e.get("event") in _LOOP_KINDS]
+    if cycles:
+        triggers = [e for e in cycles if e.get("event") == "loop_trigger"]
+        promoted = [e for e in cycles if e.get("event") == "loop_promoted"]
+        rejected = [e for e in cycles if e.get("event") == "loop_rejected"]
+        loop: Dict = {
+            "triggers": len(triggers),
+            "retrains": sum(
+                1 for e in cycles if e.get("event") == "loop_retrain"
+            ),
+            "promoted": len(promoted),
+            "rejected": len(rejected),
+            "history": [
+                {
+                    "t": e.get("t"),
+                    "kind": e.get("event"),
+                    **{
+                        k: e.get(k)
+                        for k in (
+                            "reason", "records_new", "dataset_version",
+                            "drift_score", "rc", "duration_s",
+                            "candidate_dir", "fingerprint", "error",
+                        )
+                        if e.get(k) is not None
+                    },
+                }
+                for e in cycles
+            ],
+        }
+        # drift-trigger latency: alert fired -> loop answered
+        drift_trigs = [
+            e
+            for e in triggers
+            if e.get("reason") == "drift" and e.get("drift_alert_t")
+        ]
+        if drift_trigs:
+            loop["drift_trigger_latency_s"] = round(
+                max(
+                    0.0,
+                    drift_trigs[-1]["t"] - drift_trigs[-1]["drift_alert_t"],
+                ),
+                3,
+            )
+        if promoted:
+            last_ok = promoted[-1]
+            loop["last_promoted"] = {
+                k: last_ok.get(k)
+                for k in ("candidate_dir", "fingerprint", "duration_s")
+                if last_ok.get(k) is not None
+            }
+        section["cycles"] = loop
+
+    return section or None
 
 
 def _health_section(events: List[Dict]) -> Optional[Dict]:
@@ -640,6 +787,10 @@ def build_report(
     promotion = _promotion_section(events)
     if promotion:
         report["promotion"] = promotion
+
+    loop = _loop_section(ledgers)
+    if loop:
+        report["loop"] = loop
 
     quant_checks = [e for e in events if e.get("event") == "quant_check"]
     if quant_checks:
@@ -1288,6 +1439,22 @@ def render_report(report: Dict) -> str:
             if slo.get("window_p99_ms") is not None:
                 line += f" (last window p99 {slo['window_p99_ms']:.1f}ms)"
             lines.append(line)
+        if sv.get("tee_dropped"):
+            lines.append(
+                f"  !! capture tee dropped {sv['tee_dropped']} sample(s) "
+                "(bounded queue full) — captured data under-represents the "
+                "traffic; slow the sample fraction or raise the queue"
+            )
+        dr = sv.get("drift")
+        if dr:
+            state = "ok" if dr.get("healthy", True) else "DRIFTED"
+            line = (
+                f"  drift monitor [{dr.get('output', '?')}]: {state} "
+                f"(threshold {dr.get('threshold', 0):.2f}"
+            )
+            if dr.get("score") is not None:
+                line += f", last score {dr['score']:.3f}"
+            lines.append(line + ")")
         rc_s = sv.get("recompiles_post_warmup")
         if rc_s:
             lines.append(
@@ -1307,6 +1474,13 @@ def render_report(report: Dict) -> str:
                 f"{rt['no_replica']} no-replica (503), "
                 f"{rt['replica_failures']} replica failure(s)"
             )
+            if rt.get("tee_dropped"):
+                lines.append(
+                    f"  !! shadow tee dropped {rt['tee_dropped']} "
+                    "request(s) (bounded queue full / canary 429) — the "
+                    "shadow compare saw less traffic than the fraction "
+                    "promised"
+                )
             if rt.get("per_replica_routed"):
                 routed = "  ".join(
                     f"r{rid}:{n}" for rid, n in sorted(
@@ -1470,6 +1644,95 @@ def render_report(report: Dict) -> str:
                         else ""
                     )
                 )
+    lp = report.get("loop")
+    if lp:
+        lines.append("\ncontinuous learning loop:")
+        cap = lp.get("capture")
+        if cap:
+            line = (
+                f"  capture: {cap['captured']} record(s) across "
+                f"{cap['shards']} shard(s) from {cap['replicas']} "
+                f"replica(s) ({cap['bytes_on_disk'] / 2**20:.1f} MiB on "
+                "disk)"
+            )
+            if cap.get("evicted"):
+                line += f", {cap['evicted']} shard(s) quota-evicted"
+            lines.append(line)
+            if cap.get("dropped"):
+                lines.append(
+                    f"  !! capture dropped {cap['dropped']} sample(s) — "
+                    "bounded-queue loss, counted not silent"
+                )
+        ing = lp.get("ingest")
+        if ing:
+            lines.append(
+                f"  ingest: {ing['runs']} pass(es) — "
+                f"+{ing['records_added']} record(s) in "
+                f"{ing['new_shards']} shard(s) "
+                f"({ing['deduped']} duplicate, {ing['corrupt']} corrupt "
+                f"skipped); dataset v{ing.get('dataset_version')} holds "
+                f"{ing.get('records_total')} record(s)"
+            )
+        dr = lp.get("drift")
+        if dr:
+            last = dr.get("last") or {}
+            line = f"  drift: {dr['alerts']} alert(s)"
+            if dr.get("resolved"):
+                line += f", {dr['resolved']} resolved"
+            if last.get("score") is not None:
+                line += (
+                    f" — last score {last['score']:.3f} vs threshold "
+                    f"{last.get('threshold', 0):.2f}"
+                    f" (replica {last.get('replica', '?')})"
+                )
+            lines.append(line)
+        cy = lp.get("cycles")
+        if cy:
+            lines.append(
+                f"  cycles: {cy['triggers']} trigger(s), "
+                f"{cy['retrains']} retrain(s) — {cy['promoted']} "
+                f"promoted, {cy['rejected']} rejected"
+                + (
+                    f"; drift->trigger latency "
+                    f"{cy['drift_trigger_latency_s']:.1f}s"
+                    if cy.get("drift_trigger_latency_s") is not None
+                    else ""
+                )
+            )
+            for e in cy["history"]:
+                kind = e["kind"]
+                if kind == "loop_trigger":
+                    detail = ", ".join(
+                        f"{k}={e[k]}"
+                        for k in ("records_new", "dataset_version",
+                                  "drift_score")
+                        if e.get(k) is not None
+                    )
+                    lines.append(
+                        f"    - trigger [{e.get('reason', '?')}]"
+                        + (f" ({detail})" if detail else "")
+                    )
+                elif kind == "loop_retrain":
+                    lines.append(
+                        f"    - retrain rc={e.get('rc')} in "
+                        f"{e.get('duration_s', 0)}s"
+                        + (
+                            f" -> {e['candidate_dir']}"
+                            if e.get("candidate_dir")
+                            else ""
+                        )
+                    )
+                elif kind == "loop_promoted":
+                    lines.append(
+                        "    - PROMOTED: fleet flipped to "
+                        f"{e.get('candidate_dir', '?')}"
+                    )
+                elif kind == "loop_rejected":
+                    lines.append(
+                        "    - rejected"
+                        + (f": {e['error']}" if e.get("error") else
+                           f" (rc={e.get('rc')})")
+                    )
     for qc in report.get("quant_checks", ()):
         verdict = "PASSED" if qc.get("passed") else "FAILED"
         details = []

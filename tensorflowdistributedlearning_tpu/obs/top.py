@@ -139,7 +139,38 @@ def _process_status(led: fleet_lib.ProcessLedger, now: float) -> Dict:
         slo = serve.get("slo")
         if slo is not None:
             srow["slo_healthy"] = bool(slo.get("healthy", True))
+        if serve.get("tee_dropped"):
+            srow["tee_dropped"] = serve["tee_dropped"]
+        drift = serve.get("drift")
+        if drift is not None:
+            srow["drift_healthy"] = bool(drift.get("healthy", True))
         row["serve"] = srow
+    cap = _last(events, "capture_window")
+    if cap is not None:
+        # the loop's raw-material gauge: live capture volume and loss
+        row["capture"] = {
+            "captured": cap.get("total_captured", 0),
+            "dropped": cap.get("total_dropped", 0),
+            "shards": cap.get("shards", 0),
+            "bytes_on_disk": cap.get("bytes_on_disk", 0),
+        }
+    loop_retrain = _last(events, "loop_retrain")
+    loop_trigger = _last(events, "loop_trigger")
+    if loop_trigger is not None or loop_retrain is not None:
+        lrow: Dict = {}
+        if loop_trigger is not None:
+            lrow["last_trigger"] = loop_trigger.get("reason")
+        if loop_retrain is not None:
+            lrow["last_retrain_rc"] = loop_retrain.get("rc")
+            promoted = _last(events, "loop_promoted")
+            rejected = _last(events, "loop_rejected")
+            if promoted is not None or rejected is not None:
+                p_t = (promoted or {}).get("t", -1.0)
+                r_t = (rejected or {}).get("t", -1.0)
+                lrow["last_verdict"] = (
+                    "promoted" if p_t >= r_t else "rejected"
+                )
+        row["loop"] = lrow
     router = _last(events, "router_window")
     if router is not None:
         fleet_state = router.get("fleet") or {}
@@ -362,7 +393,30 @@ def render_frame(frame: Dict) -> str:
                 bits.append(f"p99 {sv['p99_ms']:.1f}ms")
             if sv.get("slo_healthy") is False:
                 bits.append("!! SLO BREACHED")
+            if sv.get("tee_dropped"):
+                bits.append(f"!! tee dropped {sv['tee_dropped']}")
+            if sv.get("drift_healthy") is False:
+                bits.append("!! DRIFTED")
             lines.append("  ".join(bits))
+        cap = row.get("capture")
+        if cap:
+            line = (
+                f"  capture: {cap['captured']} rec in {cap['shards']} "
+                f"shard(s) ({_fmt_bytes(cap['bytes_on_disk'])})"
+            )
+            if cap.get("dropped"):
+                line += f"  !! {cap['dropped']} dropped"
+            lines.append(line)
+        lp = row.get("loop")
+        if lp:
+            line = "  loop:"
+            if lp.get("last_trigger"):
+                line += f" trigger {lp['last_trigger']}"
+            if lp.get("last_verdict"):
+                line += f", last cycle {lp['last_verdict'].upper()}"
+            elif lp.get("last_retrain_rc") is not None:
+                line += f", retrain rc={lp['last_retrain_rc']}"
+            lines.append(line)
         rt = row.get("router")
         if rt:
             line = (

@@ -98,6 +98,18 @@ class FleetConfig:
     # (drills: "sigkill@N" kills it after N answered requests; restarts are
     # clean so the drill converges instead of crash-looping)
     fault_specs: Optional[Dict[int, str]] = None
+    # continuous-learning arms (loop/): when capture_dir is set every
+    # replica runs the traffic-capture tee into its OWN subdir
+    # ({capture_dir}/replica-{id} — shard sequences stay disjoint; ingest
+    # walks recursively), and drift_threshold arms each replica's
+    # DriftMonitor against the artifact's stamped baseline
+    capture_dir: Optional[str] = None
+    capture_fraction: float = 1.0
+    capture_quota_mb: float = 64.0
+    capture_records_per_shard: int = 64
+    drift_threshold: Optional[float] = None
+    drift_min_requests: int = 20
+    drift_sustain_windows: int = 2
     # extra environment for replica processes (the bench pins XLA's CPU
     # threading here so replica scaling is honest on a shared host)
     extra_env: Optional[Dict[str, str]] = None
@@ -247,6 +259,21 @@ class FleetManager:
             argv += [
                 "--slo-p99-ms", str(slo_p99_ms),
                 "--slo-error-budget", str(slo_error_budget),
+            ]
+        if cfg.capture_dir:
+            argv += [
+                "--capture-dir",
+                os.path.join(cfg.capture_dir, f"replica-{replica_id}"),
+                "--capture-fraction", str(cfg.capture_fraction),
+                "--capture-quota-mb", str(cfg.capture_quota_mb),
+                "--capture-records-per-shard",
+                str(cfg.capture_records_per_shard),
+            ]
+        if cfg.drift_threshold is not None:
+            argv += [
+                "--drift-threshold", str(cfg.drift_threshold),
+                "--drift-min-requests", str(cfg.drift_min_requests),
+                "--drift-sustain-windows", str(cfg.drift_sustain_windows),
             ]
         if fault_spec:
             argv += ["--inject-fault", fault_spec]

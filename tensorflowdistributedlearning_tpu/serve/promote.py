@@ -398,6 +398,25 @@ class PromotionController:
                 self._refuse(
                     "quantize-check failed: " + "; ".join(result["failures"])
                 )
+            if result.get("candidate_summary"):
+                # promotion-time drift baseline: the check already ran the
+                # candidate over the pinned batch — persist that output
+                # distribution into the manifest so the DriftMonitor has a
+                # canonical reference without re-running eval
+                from tensorflowdistributedlearning_tpu.serve.quant_check import (
+                    write_drift_baseline,
+                )
+
+                try:
+                    write_drift_baseline(
+                        self._candidate_dir, result["candidate_summary"]
+                    )
+                except OSError as e:
+                    logger.warning(
+                        "could not persist drift baseline into %s: %s",
+                        self._candidate_dir,
+                        e,
+                    )
         with self._lock:
             self._candidate_identity = (
                 {

@@ -78,6 +78,81 @@ def _is_binary(a: np.ndarray) -> bool:
     return a.size > 0 and bool(np.isin(np.unique(a), (0, 1)).all())
 
 
+def summarize_output_distribution(
+    outputs: Dict[str, np.ndarray], *, batch: int, seed: int
+) -> Dict:
+    """Per-output distribution summary over the pinned eval batch — the
+    canonical ``drift_baseline`` the DriftMonitor (obs/health.py) compares
+    live serving outputs against. Integer outputs (argmax class ids) keep a
+    normalized histogram; float outputs keep mean/std. Persisted into the
+    artifact manifest at export and promotion time so drift detection never
+    re-runs eval."""
+    summary: Dict = {"batch": int(batch), "seed": int(seed), "outputs": {}}
+    for name in sorted(outputs):
+        arr = np.asarray(outputs[name])
+        if np.issubdtype(arr.dtype, np.integer):
+            vals, counts = np.unique(arr, return_counts=True)
+            summary["outputs"][name] = {
+                "kind": "integer",
+                "n": int(arr.size),
+                "hist": {
+                    str(int(v)): round(float(c) / arr.size, 6)
+                    for v, c in zip(vals, counts)
+                },
+            }
+        else:
+            a = arr.astype(np.float64)
+            summary["outputs"][name] = {
+                "kind": "float",
+                "mean": round(float(a.mean()), 6) if a.size else 0.0,
+                "std": round(float(a.std()), 6) if a.size else 0.0,
+            }
+    return summary
+
+
+def write_drift_baseline(artifact_dir: str, baseline: Dict) -> None:
+    """Install ``drift_baseline`` into an artifact's manifest atomically.
+    Extra manifest keys ride along untouched (train/serving.py validates
+    only what it knows), so an already-promoted artifact can be stamped
+    in place."""
+    import json
+    import os
+
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    path = os.path.join(artifact_dir, serving_lib.MANIFEST_NAME)
+    with open(path, encoding="utf-8") as f:
+        manifest = json.load(f)
+    manifest["drift_baseline"] = baseline
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def stamp_drift_baseline(
+    artifact_dir: str, *, batch_size: int = 32, seed: int = 0
+) -> Dict:
+    """Compute and persist an artifact's own output-distribution baseline
+    (export-time path — a fresh export has no quantize-check run to reuse;
+    promotion reuses the check's outputs instead of calling this)."""
+    import jax
+
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    manifest = serving_lib.read_manifest(artifact_dir)
+    batch = pinned_eval_batch(manifest, batch_size, seed)
+    fn = serving_lib.load_serving_artifact(artifact_dir)
+    out = jax.device_get(fn(batch))
+    baseline = summarize_output_distribution(
+        {k: np.asarray(v) for k, v in out.items()},
+        batch=batch.shape[0],
+        seed=seed,
+    )
+    write_drift_baseline(artifact_dir, baseline)
+    return baseline
+
+
 def output_delta(name: str, ref: np.ndarray, cand: np.ndarray) -> Dict:
     """Delta record for one output; the applicable threshold keys depend on
     which of the three output kinds this is. Public: the promotion
@@ -155,6 +230,7 @@ def run_quant_check(
 
     batch = pinned_eval_batch(cand_manifest, batch_size, seed)
     outputs: Dict[str, Dict] = {}
+    candidate_summary: Optional[Dict] = None
     if not failures:  # a wrong pairing makes the numerics noise; skip them
         ref_fn = serving_lib.load_serving_artifact(reference_dir)
         cand_fn = serving_lib.load_serving_artifact(candidate_dir)
@@ -206,6 +282,15 @@ def run_quant_check(
                     f"{limits['mean_abs_delta']}"
                 )
 
+        # the candidate's output distribution over this same pinned batch:
+        # the promotion controller persists it into the winning manifest as
+        # the drift baseline — no second eval run needed
+        candidate_summary = summarize_output_distribution(
+            {k: np.asarray(v) for k, v in cand_out.items()},
+            batch=batch.shape[0],
+            seed=seed,
+        )
+
     result = {
         "reference": reference_dir,
         "candidate": candidate_dir,
@@ -220,6 +305,8 @@ def run_quant_check(
         "failures": failures,
         "passed": not failures,
     }
+    if candidate_summary is not None:
+        result["candidate_summary"] = candidate_summary
     if telemetry is not None:
         telemetry.event("quant_check", **result)
     return result

@@ -95,6 +95,10 @@ _COUNTERS = (
     "fair_shed",       # answered 429 by the weighted fair-share policy
     "no_replica",      # answered 503: no routable replica at all
     "replica_failures",  # network-level forward failures observed
+    "tee_dropped",     # shadow-tee samples lost (queue full / canary 429)
+    #                    — cumulative across shadow sessions, so capture
+    #                    loss survives the per-window ShadowStats drain
+    #                    instead of vanishing with it (PR-13 gap)
 )
 
 # per-model traffic counters the router tracks (fleet_snapshot / prometheus)
@@ -760,6 +764,7 @@ class FleetRouter:
         except queue.Full:
             with stats.lock:
                 stats.dropped += 1
+            self._count("tee_dropped")
 
     def _shadow_loop(self) -> None:
         """The shadow worker: replay sampled requests against the canary and
@@ -812,6 +817,7 @@ class FleetRouter:
                 # answer — shadow load is best-effort sampling by design
                 with stats.lock:
                     stats.dropped += 1
+                self._count("tee_dropped")
                 continue
             if resp.status != 200:
                 with stats.lock:

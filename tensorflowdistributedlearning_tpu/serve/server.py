@@ -170,9 +170,17 @@ class ServingServer:
         sock: Optional[socket.socket] = None,
         model: str = DEFAULT_MODEL,
         registry_version: Optional[int] = None,
+        capture=None,
+        drift_monitor=None,
     ):
         self.engine = engine
         self.batcher = batcher
+        # continuous-learning tees (loop/capture.py, obs/health.py
+        # DriftMonitor): both observe the PRIMARY model's accepted requests
+        # only — foreign tenants' traffic is skipped, same rule as the
+        # promotion shadow tee
+        self.capture = capture
+        self.drift = drift_monitor
         self.telemetry = telemetry if telemetry is not None else NULL_TELEMETRY
         self.window_secs = float(window_secs)
         self.result_timeout_s = float(result_timeout_s)
@@ -740,6 +748,29 @@ class ServingServer:
             # the primary model's live SLO state rides at top level for the
             # report's health section, exactly as before
             fields["slo"] = self.slo.snapshot()
+        if self.capture is not None:
+            # capture-loss is never silent: the cumulative drop count rides
+            # every serve_window, and windows with tee activity ledger a
+            # full capture_window record
+            fields["tee_dropped"] = self.capture.total_dropped
+            if self.capture.active() or final:
+                from tensorflowdistributedlearning_tpu.loop.capture import (
+                    CAPTURE_WINDOW_EVENT,
+                )
+
+                snap = self.capture.window_snapshot()
+                if final:
+                    snap["final"] = True
+                self.telemetry.event(
+                    CAPTURE_WINDOW_EVENT, replica=self.replica_id, **snap
+                )
+        if self.drift is not None:
+            verdict = self.drift.evaluate()
+            if verdict is not None:
+                verdict.setdefault("alert_id", trace_lib.new_id())
+                verdict["replica"] = self.replica_id
+                self.telemetry.event(health_lib.DRIFT_ALERT_EVENT, **verdict)
+            fields["drift"] = self.drift.snapshot()
         if multi:
             fields["models"] = models_field
         elif self._versioned:
@@ -815,6 +846,13 @@ class ServingServer:
             self._ticker.join(timeout=5)
         for rt in self.models.values():
             rt.batcher.close(drain=True)
+        if self.capture is not None:
+            try:
+                # seal the partial shard BEFORE the final window so the
+                # closing capture_window reports everything on disk
+                self.capture.close()
+            except Exception:  # noqa: BLE001
+                logger.warning("capture tee close failed", exc_info=True)
         try:
             final = self.emit_window(final=True)
         except Exception:  # noqa: BLE001
@@ -1127,4 +1165,24 @@ class _Handler(BaseHTTPRequestHandler):
             lambda a: np.asarray(a).tolist(), out
         )
         self._json(200, {"predictions": predictions, "n": request.n})
+        ctx = self.ctx
+        if (
+            (ctx.capture is not None or ctx.drift is not None)
+            and runtime is ctx._primary
+        ):
+            # continuous-learning tees, AFTER the client was answered: the
+            # capture enqueue is non-blocking and the drift fold is a
+            # bincount, but neither may turn a served 200 into anything else
+            try:
+                raw = (
+                    {k: np.asarray(v) for k, v in out.items()}
+                    if isinstance(out, dict)
+                    else {"output": np.asarray(out)}
+                )
+                if ctx.drift is not None:
+                    ctx.drift.observe(raw)
+                if ctx.capture is not None:
+                    ctx.capture.maybe_capture(x, raw)
+            except Exception:  # noqa: BLE001
+                logger.exception("capture/drift tee failed")
         return 200

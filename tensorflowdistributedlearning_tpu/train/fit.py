@@ -76,6 +76,9 @@ class FitResult:
     final_metrics: Dict[str, float]
     n_params: int
     steps: int
+    # set when fit_preset exported a serving artifact after training
+    # (fit --export-serving): the directory the promotion pipeline takes
+    serving_artifact: Optional[str] = None
 
 
 class ClassifierTrainer:
@@ -1129,6 +1132,8 @@ def fit_preset(
     profile_every_windows: Optional[int] = None,
     parallelism: Optional[str] = None,
     hbm_budget_gb: Optional[float] = None,
+    export_serving: Optional[str] = None,
+    export_dir: Optional[str] = None,
 ) -> FitResult:
     """Train a named config preset end-to-end (the CLI `fit` entry point).
 
@@ -1300,8 +1305,16 @@ def fit_preset(
     trainer = ClassifierTrainer(
         model_dir, data_dir, preset.model, train_cfg, plan=run_plan.header()
     )
-    return trainer.fit(
+    result = trainer.fit(
         batch_size=global_batch,
         steps=steps,
         eval_every_steps=eval_every_steps,
     )
+    if export_serving is not None:
+        # export rides the SAME trainer (best-checkpoint restore) so the
+        # artifact is exactly the run that just finished — the flywheel's
+        # `fit --export-serving --auto-promote` retrain path
+        result.serving_artifact = trainer.export_serving(
+            export_dir, serving_dtype=export_serving
+        )
+    return result

@@ -1,0 +1,557 @@
+"""Continuous learning loop (loop/): traffic capture tee determinism
+(captured shards byte-identical through ShardRangeReader), quota eviction,
+ingest validation/dedup/idempotence, DriftMonitor transitions, and the
+flywheel controller's trigger -> retrain -> verdict cycle."""
+
+import json
+import os
+import queue
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu.data import records as rec
+from tensorflowdistributedlearning_tpu.loop import capture as cap_lib
+from tensorflowdistributedlearning_tpu.loop import ingest as ing_lib
+from tensorflowdistributedlearning_tpu.loop.capture import (
+    TrafficCapture,
+    encode_example,
+    to_uint8_image,
+)
+from tensorflowdistributedlearning_tpu.loop.controller import (
+    FlywheelConfig,
+    FlywheelController,
+    scan_drift_alerts,
+)
+from tensorflowdistributedlearning_tpu.loop.ingest import (
+    ingest_shards,
+    read_dataset_manifest,
+)
+from tensorflowdistributedlearning_tpu.obs.health import DriftMonitor
+
+
+class RecordingTelemetry:
+    def __init__(self):
+        self.events = []
+
+    def event(self, kind, **fields):
+        self.events.append({"event": kind, **fields})
+
+    def kinds(self):
+        return [e["event"] for e in self.events]
+
+
+def _batch(rng, n=4, shape=(8, 8, 3)):
+    return rng.standard_normal((n, *shape)).astype(np.float32)
+
+
+def _outputs(labels):
+    return {"class": np.asarray(labels, np.int32)}
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+# -- capture: encode determinism ----------------------------------------------
+
+
+def test_to_uint8_image_deterministic(rng):
+    arr = rng.standard_normal((8, 8, 3)).astype(np.float32)
+    a, b = to_uint8_image(arr), to_uint8_image(arr.copy())
+    assert a.dtype == np.uint8
+    assert np.array_equal(a, b)
+    # uint8 passes through untouched; [0,1] scales by 255 exactly
+    u8 = rng.integers(0, 256, (4, 4), dtype=np.uint8)
+    assert to_uint8_image(u8) is u8
+    unit = np.full((2, 2), 0.5)
+    assert np.array_equal(to_uint8_image(unit), np.full((2, 2), 128, np.uint8))
+    with pytest.raises(ValueError):
+        to_uint8_image(np.array([1.0, np.nan]))
+
+
+def test_encode_example_roundtrips_label_and_is_stable(rng):
+    img = rng.standard_normal((8, 8, 3)).astype(np.float32)
+    payload = encode_example(img, 3)
+    assert payload == encode_example(img.copy(), 3)
+    label, png = rec.decode_classification_record(payload)
+    assert label == 3
+    assert png[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+def test_capture_byte_identity_via_shard_range_reader(tmp_path, rng):
+    """THE determinism contract: what the tee wrote is byte-identical to
+    encode_example over the samples it selected, re-read through the .idx
+    sidecar + ShardRangeReader (the data-service read path)."""
+    d = str(tmp_path / "cap")
+    cap = TrafficCapture(d, sample_fraction=1.0, records_per_shard=6)
+    batches = [_batch(rng, n=3) for _ in range(4)]
+    labels = [[i % 4, (i + 1) % 4, (i + 2) % 4] for i in range(4)]
+    for b, l in zip(batches, labels):
+        cap.maybe_capture(b, _outputs(l))
+    assert _wait(lambda: cap.total_captured == 12)
+    cap.close()
+
+    want = [
+        encode_example(b[j], l[j])
+        for b, l in zip(batches, labels)
+        for j in range(3)
+    ]
+    shards = sorted(
+        str(p) for p in (tmp_path / "cap").glob("capture-*.tfrecord")
+    )
+    assert len(shards) == 2  # 12 records / 6 per shard
+    got = []
+    for path in shards:
+        assert os.path.exists(rec.shard_index_path(path))
+        offsets = rec.shard_offsets(path)
+        with rec.ShardRangeReader(path) as r:
+            got.extend(r.read(list(offsets)))
+    assert got == want
+
+
+def test_capture_stride_sampling_and_window_drain(tmp_path, rng):
+    cap = TrafficCapture(str(tmp_path), sample_fraction=0.5, records_per_shard=64)
+    for i in range(10):
+        cap.maybe_capture(_batch(rng, n=2), _outputs([0, 1]))
+    assert _wait(lambda: cap.total_captured == 10)  # 5 batches x 2
+    snap = cap.window_snapshot(drain=True)
+    assert snap["selected"] == 5
+    assert snap["captured"] == 10
+    assert snap["total_captured"] == 10
+    assert snap["dropped"] == 0
+    # drained: next window starts clean but totals persist
+    snap2 = cap.window_snapshot()
+    assert snap2["selected"] == 0 and snap2["total_captured"] == 10
+    cap.close()
+
+
+def test_capture_full_queue_counts_drop(tmp_path, rng, monkeypatch):
+    cap = TrafficCapture(str(tmp_path), sample_fraction=1.0)
+
+    def full(_item):
+        raise queue.Full
+
+    monkeypatch.setattr(cap._queue, "put_nowait", full)
+    cap.maybe_capture(_batch(rng), _outputs([0, 1, 2, 3]))
+    snap = cap.window_snapshot()
+    assert snap["dropped"] == 1
+    assert snap["total_dropped"] == 1
+    monkeypatch.undo()
+    cap.close()
+
+
+def test_capture_quota_evicts_oldest_first(tmp_path, rng):
+    d = str(tmp_path)
+    # seal 1-record shards; quota sized to hold ~2 of them
+    cap = TrafficCapture(d, records_per_shard=1, quota_bytes=1)
+    # quota 1 byte: every seal evicts the previous shard, newest survives
+    for i in range(5):
+        cap.maybe_capture(_batch(rng, n=1), _outputs([i % 4]))
+    assert _wait(lambda: cap.total_captured == 5)
+    cap.close()
+    snap = cap.window_snapshot()
+    left = sorted(p.name for p in tmp_path.glob("capture-*.tfrecord"))
+    assert left == ["capture-00004.tfrecord"]  # newest always survives
+    assert snap["shards_evicted"] == 4
+    assert snap["bytes_on_disk"] <= snap["bytes_written"]
+    # evicted sidecars went with their shards
+    assert sorted(p.name for p in tmp_path.glob("*.idx")) == [
+        "capture-00004.tfrecord.idx"
+    ]
+
+
+def test_capture_close_seals_partial_shard(tmp_path, rng):
+    cap = TrafficCapture(str(tmp_path), records_per_shard=100)
+    cap.maybe_capture(_batch(rng, n=3), _outputs([0, 1, 2]))
+    assert _wait(lambda: cap.total_captured == 3)
+    cap.close()
+    shards = list(tmp_path.glob("capture-*.tfrecord"))
+    assert len(shards) == 1
+    assert len(list(rec.read_records(str(shards[0])))) == 3
+    # idempotent close
+    cap.close()
+
+
+def test_capture_restart_resumes_sequence(tmp_path, rng):
+    """A restarted replica (promotion flip) must not overwrite the shards
+    its previous incarnation sealed into the same capture dir."""
+    cap = TrafficCapture(str(tmp_path), records_per_shard=2)
+    cap.maybe_capture(_batch(rng, n=2), _outputs([0, 1]))
+    assert _wait(lambda: cap.total_captured == 2)
+    cap.close()
+    cap2 = TrafficCapture(str(tmp_path), records_per_shard=2)
+    cap2.maybe_capture(_batch(rng, n=2), _outputs([2, 3]))
+    assert _wait(lambda: cap2.total_captured == 2)
+    cap2.close()
+    names = sorted(p.name for p in tmp_path.glob("capture-*.tfrecord"))
+    assert names == ["capture-00000.tfrecord", "capture-00001.tfrecord"]
+
+
+def test_capture_rejects_bad_config(tmp_path):
+    with pytest.raises(ValueError):
+        TrafficCapture(str(tmp_path), sample_fraction=0.0)
+    with pytest.raises(ValueError):
+        TrafficCapture(str(tmp_path), records_per_shard=0)
+
+
+def test_label_array_picks_integer_output():
+    out = {
+        "prob": np.ones((3, 4), np.float32),
+        "class": np.array([2, 0, 1], np.int64),
+    }
+    assert list(cap_lib._label_array(out, 3)) == [2, 0, 1]
+    # no integer output -> zeros, structurally valid shard
+    assert list(cap_lib._label_array({"p": np.ones((3,))}, 3)) == [0, 0, 0]
+
+
+# -- ingest -------------------------------------------------------------------
+
+
+def _capture_tree(tmp_path, rng, replicas=2, shards_per=2, n_per=3):
+    root = tmp_path / "capture"
+    total = 0
+    for r in range(1, replicas + 1):
+        d = root / f"replica-{r}"
+        cap = TrafficCapture(str(d), records_per_shard=n_per)
+        for s in range(shards_per):
+            labels = [(r + s + j) % 4 for j in range(n_per)]
+            cap.maybe_capture(_batch(rng, n=n_per), _outputs(labels))
+            total += n_per
+        assert _wait(lambda: cap.total_captured == shards_per * n_per)
+        cap.close()
+    return str(root), total
+
+
+def test_ingest_validates_copies_and_versions(tmp_path, rng):
+    cap_dir, total = _capture_tree(tmp_path, rng)
+    ds = str(tmp_path / "ds")
+    tel = RecordingTelemetry()
+    summary = ingest_shards(cap_dir, ds, telemetry=tel)
+    assert summary["new_shards"] == 4
+    assert summary["records_added"] == total
+    assert summary["version"] == 1
+    assert summary["corrupt"] == 0 and summary["deduped"] == 0
+    manifest = read_dataset_manifest(ds)
+    assert manifest["version"] == 1
+    assert manifest["records_total"] == total
+    # dataset shards are fit-glob compatible, indexed, and CRC-clean
+    names = sorted(os.listdir(ds))
+    train = [n for n in names if n.startswith("train-") and n.endswith(".tfrecord")]
+    assert len(train) == 4
+    for n in train:
+        path = os.path.join(ds, n)
+        assert os.path.exists(rec.shard_index_path(path))
+        assert len(list(rec.read_records(path, verify=True))) == 3
+    assert tel.kinds() == ["records_ingest"]
+
+
+def test_ingest_idempotent_reingest_is_ledgered_noop(tmp_path, rng):
+    cap_dir, _ = _capture_tree(tmp_path, rng)
+    ds = str(tmp_path / "ds")
+    first = ingest_shards(cap_dir, ds)
+    tel = RecordingTelemetry()
+    again = ingest_shards(cap_dir, ds, telemetry=tel)
+    assert again["new_shards"] == 0
+    assert again["records_added"] == 0
+    assert again["deduped"] == first["new_shards"]
+    assert again["version"] == first["version"]  # version did NOT bump
+    assert tel.kinds() == ["records_ingest"]  # the no-op is still ledgered
+    assert sorted(os.listdir(ds)) == sorted(os.listdir(ds))
+
+
+def test_ingest_dedups_identical_content_across_paths(tmp_path):
+    cap_dir = tmp_path / "capture"
+    (cap_dir / "a").mkdir(parents=True)
+    (cap_dir / "b").mkdir(parents=True)
+    payloads = [b"same-payload-%d" % i for i in range(4)]
+    rec.write_records(str(cap_dir / "a" / "capture-00000.tfrecord"), payloads)
+    rec.write_records(str(cap_dir / "b" / "capture-00007.tfrecord"), payloads)
+    summary = ingest_shards(str(cap_dir), str(tmp_path / "ds"))
+    assert summary["new_shards"] == 1
+    assert summary["deduped"] == 1
+    assert summary["records_added"] == 4
+
+
+def test_ingest_skips_corrupt_and_empty_shards(tmp_path):
+    cap_dir = tmp_path / "capture"
+    cap_dir.mkdir()
+    good = str(cap_dir / "capture-00000.tfrecord")
+    rec.write_records(good, [b"ok-%d" % i for i in range(3)])
+    bad = str(cap_dir / "capture-00001.tfrecord")
+    rec.write_records(bad, [b"will-corrupt"])
+    raw = bytearray(open(bad, "rb").read())
+    raw[-3] ^= 0xFF  # flip a payload/crc byte
+    open(bad, "wb").write(bytes(raw))
+    open(str(cap_dir / "capture-00002.tfrecord"), "wb").close()  # empty
+    summary = ingest_shards(str(cap_dir), str(tmp_path / "ds"))
+    assert summary["new_shards"] == 1
+    assert summary["corrupt"] == 2
+    assert summary["records_added"] == 3
+
+
+def test_ingest_growth_bumps_version_once_per_change(tmp_path, rng):
+    cap_dir, _ = _capture_tree(tmp_path, rng, replicas=1, shards_per=1)
+    ds = str(tmp_path / "ds")
+    assert ingest_shards(cap_dir, ds)["version"] == 1
+    # a new shard arrives
+    extra = os.path.join(cap_dir, "replica-1", "capture-00009.tfrecord")
+    rec.write_records(extra, [b"fresh-%d" % i for i in range(2)])
+    rec.write_shard_index(extra)
+    second = ingest_shards(cap_dir, ds)
+    assert second["version"] == 2
+    assert second["new_shards"] == 1
+    assert read_dataset_manifest(ds)["records_total"] == second["records_total"]
+
+
+# -- drift monitor ------------------------------------------------------------
+
+
+def _baseline(hist=None):
+    return {
+        "outputs": {
+            "class": {"kind": "integer", "hist": hist or {"0": 50, "1": 50}},
+            "prob": {"kind": "float", "mean": 0.5, "std": 0.1},
+        }
+    }
+
+
+def test_drift_monitor_requires_integer_histogram():
+    with pytest.raises(ValueError):
+        DriftMonitor({"outputs": {"prob": {"kind": "float"}}})
+    with pytest.raises(ValueError):
+        DriftMonitor({})
+
+
+def test_drift_monitor_sustain_then_alert_then_resolve():
+    mon = DriftMonitor(
+        _baseline(), threshold=0.3, min_requests=10, sustain_windows=2
+    )
+    shifted = np.ones(30, np.int64)  # all class 1: TV distance 0.5
+    mon.observe({"class": shifted})
+    assert mon.evaluate() is None  # first bad window: not sustained yet
+    assert mon.healthy
+    mon.observe({"class": shifted})
+    alert = mon.evaluate()
+    assert alert is not None and alert["severity"] == "critical"
+    assert alert["score"] == pytest.approx(0.5)
+    assert alert["sustained_windows"] == 2
+    assert not mon.healthy
+    snap = mon.snapshot()
+    assert snap["healthy"] is False and snap["output"] == "class"
+    # recovery: balanced traffic -> one resolved:true event, then silence
+    balanced = np.array([0, 1] * 15, np.int64)
+    mon.observe({"class": balanced})
+    resolved = mon.evaluate()
+    assert resolved is not None and resolved.get("resolved") is True
+    assert mon.healthy
+    mon.observe({"class": balanced})
+    assert mon.evaluate() is None
+
+
+def test_drift_monitor_ignores_thin_windows_and_unknown_outputs():
+    mon = DriftMonitor(_baseline(), threshold=0.3, min_requests=20,
+                       sustain_windows=1)
+    mon.observe({"class": np.ones(5, np.int64)})
+    assert mon.evaluate() is None  # under min_requests: no distribution
+    mon.observe({"other": np.ones(50, np.int64)})  # not the tracked output
+    assert mon.evaluate() is None
+    assert mon.healthy
+
+
+# -- flywheel controller ------------------------------------------------------
+
+
+def _stub_ingest(records_per_call):
+    calls = iter(records_per_call)
+
+    def fn(capture_dir, dataset_dir, telemetry=None, **kw):
+        n = next(calls, 0)
+        return {
+            "records_added": n,
+            "version": 1 if n else 0,
+            "records_total": n,
+        }
+
+    return fn
+
+
+def test_flywheel_config_requires_a_trigger(tmp_path):
+    with pytest.raises(ValueError):
+        FlywheelConfig(
+            capture_dir=str(tmp_path), dataset_dir=str(tmp_path),
+            min_new_records=0, fleet_workdir=None,
+        )
+    with pytest.raises(ValueError):
+        FlywheelConfig(
+            capture_dir=str(tmp_path), dataset_dir=str(tmp_path), poll_secs=0
+        )
+
+
+def test_flywheel_volume_trigger_promotes(tmp_path):
+    tel = RecordingTelemetry()
+    cfg = FlywheelConfig(
+        capture_dir=str(tmp_path), dataset_dir=str(tmp_path),
+        min_new_records=10, poll_secs=0.01, max_cycles=1,
+    )
+    seen = {}
+
+    def retrain(trigger, summary):
+        seen.update(trigger)
+        return {"rc": 0, "candidate_dir": "/tmp/cand", "fingerprint": "abc123"}
+
+    ctl = FlywheelController(
+        cfg, retrain_fn=retrain, telemetry=tel,
+        ingest_fn=_stub_ingest([4, 7]),  # 4 then 11 >= 10
+    )
+    assert ctl.run() == 0
+    assert ctl.cycles == 1 and ctl.promoted == 1 and ctl.rejected == 0
+    assert seen["reason"] == "data_volume" and seen["records_new"] == 11
+    assert tel.kinds() == ["loop_trigger", "loop_retrain", "loop_promoted"]
+    retrain_ev = tel.events[1]
+    assert retrain_ev["rc"] == 0
+    assert retrain_ev["fingerprint"] == "abc123"
+    assert "duration_s" in retrain_ev
+
+
+def test_flywheel_rejected_cycle_and_crash_are_rc_1(tmp_path):
+    tel = RecordingTelemetry()
+    cfg = FlywheelConfig(
+        capture_dir=str(tmp_path), dataset_dir=str(tmp_path),
+        min_new_records=1, poll_secs=0.01, max_cycles=2,
+    )
+    outcomes = iter([{"rc": 1}, RuntimeError("train exploded")])
+
+    def retrain(trigger, summary):
+        out = next(outcomes)
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+    ctl = FlywheelController(
+        cfg, retrain_fn=retrain, telemetry=tel, ingest_fn=_stub_ingest([5, 5])
+    )
+    assert ctl.run() == 1
+    assert ctl.rejected == 2 and ctl.promoted == 0
+    kinds = tel.kinds()
+    assert kinds.count("loop_rejected") == 2
+    crashed = tel.events[-1]
+    assert "train exploded" in crashed.get("error", "")
+
+
+def test_flywheel_timeout_without_trigger_is_rc_3(tmp_path):
+    cfg = FlywheelConfig(
+        capture_dir=str(tmp_path), dataset_dir=str(tmp_path),
+        min_new_records=1000, poll_secs=0.01, max_wait_secs=0.05,
+    )
+    ctl = FlywheelController(
+        cfg, retrain_fn=lambda t, s: {"rc": 0},
+        ingest_fn=lambda *a, **k: {"records_added": 0, "version": 0},
+    )
+    assert ctl.run() == 3
+    assert ctl.cycles == 0
+
+
+def _write_ledger(path, events):
+    with open(path, "w", encoding="utf-8") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+
+def test_scan_drift_alerts_latest_unresolved_wins(tmp_path):
+    fw = str(tmp_path)
+    now = time.time()
+    _write_ledger(
+        os.path.join(fw, "telemetry-1.jsonl"),
+        [
+            {"event": "drift_alert", "t": now - 5, "score": 0.6, "replica": 1},
+            {"event": "serve_window", "t": now - 4},
+        ],
+    )
+    _write_ledger(
+        os.path.join(fw, "telemetry-2.jsonl"),
+        [
+            {"event": "drift_alert", "t": now - 3, "score": 0.7, "replica": 2},
+            {"event": "drift_alert", "t": now - 1, "resolved": True,
+             "replica": 2},
+        ],
+    )
+    # replica 2's alert was retracted by its resolution; replica 1's stands
+    alert = scan_drift_alerts(fw)
+    assert alert is not None and alert["replica"] == 1
+    # since_t past replica 1's firing -> nothing live
+    assert scan_drift_alerts(fw, since_t=now - 4) is None
+    # torn trailing line is skipped, not fatal
+    with open(os.path.join(fw, "telemetry-1.jsonl"), "a") as f:
+        f.write('{"event": "drift_alert", "t":')
+    assert scan_drift_alerts(fw)["replica"] == 1
+
+
+def test_flywheel_drift_trigger_fires_and_is_consumed(tmp_path):
+    fleet = tmp_path / "fleet"
+    fleet.mkdir()
+    _write_ledger(
+        str(fleet / "telemetry-1.jsonl"),
+        [{"event": "drift_alert", "t": time.time(), "score": 0.55,
+          "threshold": 0.3, "alert_id": "a1", "replica": 1}],
+    )
+    tel = RecordingTelemetry()
+    cfg = FlywheelConfig(
+        capture_dir=str(tmp_path), dataset_dir=str(tmp_path),
+        fleet_workdir=str(fleet), min_new_records=0,  # drift-only loop
+        poll_secs=0.01, max_cycles=1,
+    )
+    triggers = []
+
+    def retrain(trigger, summary):
+        triggers.append(trigger)
+        return {"rc": 0}
+
+    ctl = FlywheelController(
+        cfg, retrain_fn=retrain, telemetry=tel,
+        ingest_fn=lambda *a, **k: {"records_added": 0, "version": 0},
+    )
+    assert ctl.run() == 0
+    assert triggers[0]["reason"] == "drift"
+    assert triggers[0]["drift_score"] == 0.55
+    assert triggers[0]["alert_id"] == "a1"
+    # the retrain consumed the alert: a fresh run on the same ledger times out
+    cfg2 = FlywheelConfig(
+        capture_dir=str(tmp_path), dataset_dir=str(tmp_path),
+        fleet_workdir=str(fleet), min_new_records=0,
+        poll_secs=0.01, max_cycles=1, max_wait_secs=0.05,
+    )
+    ctl2 = FlywheelController(
+        cfg2, retrain_fn=retrain, telemetry=RecordingTelemetry(),
+        ingest_fn=lambda *a, **k: {"records_added": 0, "version": 0},
+    )
+    ctl2._drift_handled_t = time.time()
+    assert ctl2.run() == 3
+
+
+def test_flywheel_capture_to_retrain_uses_real_ingest(tmp_path, rng):
+    """loop-level integration: real capture shards -> real ingest -> the
+    volume trigger cites the real dataset version."""
+    cap_dir, total = _capture_tree(tmp_path, rng, replicas=1, shards_per=2)
+    ds = str(tmp_path / "ds")
+    tel = RecordingTelemetry()
+    cfg = FlywheelConfig(
+        capture_dir=cap_dir, dataset_dir=ds,
+        min_new_records=total, poll_secs=0.01, max_cycles=1,
+    )
+    ctl = FlywheelController(
+        cfg, retrain_fn=lambda t, s: {"rc": 0}, telemetry=tel
+    )
+    assert ctl.run() == 0
+    trig = [e for e in tel.events if e["event"] == "loop_trigger"][0]
+    assert trig["records_new"] == total
+    assert trig["dataset_version"] == 1
+    assert read_dataset_manifest(ds)["records_total"] == total
+    # ingest events rode the same ledger
+    assert "records_ingest" in tel.kinds()

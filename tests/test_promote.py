@@ -1025,14 +1025,9 @@ def test_cli_promote_resolves_router_from_workdir_ledger(tmp_path):
                   "endpoint": "http://127.0.0.1:7777"},
     )
     tel.close()
-    args = types.SimpleNamespace(router=None, workdir=workdir)
-    assert _resolve_router_url(args) == "http://127.0.0.1:7777"
-    args = types.SimpleNamespace(
-        router="http://10.0.0.1:9/", workdir=workdir
-    )
-    assert _resolve_router_url(args) == "http://10.0.0.1:9"
-    args = types.SimpleNamespace(router=None, workdir=str(tmp_path / "nope"))
-    assert _resolve_router_url(args) is None
+    assert _resolve_router_url(None, workdir) == "http://127.0.0.1:7777"
+    assert _resolve_router_url("http://10.0.0.1:9/", workdir) == "http://10.0.0.1:9"
+    assert _resolve_router_url(None, str(tmp_path / "nope")) is None
 
 
 def test_cli_promote_without_target_is_usage_error(capsys):

@@ -659,6 +659,102 @@ def check_promotion(
     return out
 
 
+DEFAULT_LOOP_CYCLE_CEILING_S = 300.0
+DEFAULT_LOOP_TRIGGER_LATENCY_CEILING_S = 30.0
+
+
+def check_loop(
+    baseline: Dict,
+    fresh: Optional[Dict] = None,
+    *,
+    cycle_ceiling_s: float = DEFAULT_LOOP_CYCLE_CEILING_S,
+    trigger_latency_ceiling_s: float = DEFAULT_LOOP_TRIGGER_LATENCY_CEILING_S,
+) -> List[Dict]:
+    """Replay the committed BENCH_LOOP.json (tools/bench_loop.py) gates.
+
+    The continuous-learning drill is too heavy for every CI run, so the
+    default mode REPLAYS the committed record — and almost every gate is
+    correctness-hard, not performance: the loop must have CLOSED (one cycle,
+    promoted, zero rejected), with zero client-visible errors while the
+    fleet flipped under live load, on a drift alert that was actually earned
+    (score past threshold), retraining on data that was actually captured
+    and ingested, and the whole fleet must have converged on ONE fingerprint
+    — the promoted one. The two wall-clock bounds (cycle time, drift->trigger
+    latency) only catch the catastrophic class, same policy as everywhere
+    else. A ``--fresh-loop`` record is gated instead."""
+    record = fresh if fresh else baseline
+    out: List[Dict] = []
+    fw = record.get("flywheel") or {}
+    out.append(_finding(
+        "loop", "flywheel.promoted", ">= 1", fw.get("promoted"),
+        ">= 1 (the loop must actually close)",
+        (fw.get("promoted") or 0) >= 1,
+    ))
+    out.append(_finding(
+        "loop", "flywheel.rejected", 0, fw.get("rejected"),
+        "== 0 (hard)", not fw.get("rejected"),
+    ))
+    out.append(_finding(
+        "loop", "client_errors", 0, record.get("client_errors"),
+        "== 0 (zero client-visible errors through the whole drill, "
+        "promotion flip included)", record.get("client_errors") == 0,
+    ))
+    out.append(_finding(
+        "loop", "client_ok", ">= 1000", record.get("client_ok"),
+        ">= 1000 (the zero-errors gate must have seen real load)",
+        (record.get("client_ok") or 0) >= 1000,
+    ))
+    ingested = record.get("samples_ingested") or 0
+    out.append(_finding(
+        "loop", "samples_ingested", ">= 64", ingested,
+        ">= 64 (the retrain ran on actually-captured data)",
+        ingested >= 64,
+    ))
+    out.append(_finding(
+        "loop", "samples_captured", f">= ingested ({ingested})",
+        record.get("samples_captured"),
+        ">= samples_ingested (capture feeds ingest, never the reverse)",
+        (record.get("samples_captured") or 0) >= ingested,
+    ))
+    alert = record.get("drift_alert") or {}
+    out.append(_finding(
+        "loop", "drift_alert.score", f"> {alert.get('threshold')}",
+        alert.get("score"),
+        "> threshold (the alert was earned, not injected)",
+        alert.get("score") is not None
+        and alert.get("threshold") is not None
+        and alert["score"] > alert["threshold"],
+    ))
+    latency = record.get("drift_trigger_latency_s")
+    out.append(_finding(
+        "loop", "drift_trigger_latency_s",
+        f"<= {trigger_latency_ceiling_s}", latency,
+        "present and bounded (the flywheel saw the alert promptly)",
+        latency is not None and 0 <= latency <= trigger_latency_ceiling_s,
+    ))
+    out.append(_finding(
+        "loop", "cycle_wall_s", f"<= {cycle_ceiling_s}",
+        record.get("cycle_wall_s"),
+        "bounded (catastrophic-class only, like every wall-clock gate)",
+        record.get("cycle_wall_s") is not None
+        and record["cycle_wall_s"] <= cycle_ceiling_s,
+    ))
+    fingerprint = record.get("promoted_fingerprint") or ""
+    mix = record.get("artifact_mix") or {}
+    converged = (
+        bool(fingerprint)
+        and len(mix) == 1
+        and next(iter(mix)).split(":", 1)[-1] in fingerprint
+    )
+    out.append(_finding(
+        "loop", "promoted_fingerprint", "fleet converged on it",
+        {"fingerprint": fingerprint[:24], "artifact_mix": mix},
+        "one artifact key in the post-flip mix, matching the promoted "
+        "fingerprint", converged,
+    ))
+    return out
+
+
 # -- fresh-run plumbing ------------------------------------------------------
 
 
@@ -710,7 +806,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "gate)")
     parser.add_argument("--benches",
                         default="async,serve,fleet,records,promotion,"
-                        "multitenant,plan,elastic,profile",
+                        "multitenant,plan,elastic,profile,loop",
                         help="comma-separated subset to check")
     parser.add_argument("--baseline-async",
                         default=os.path.join(REPO, "BENCH_ASYNC.json"))
@@ -724,6 +820,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                         default=os.path.join(REPO, "BENCH_ELASTIC.json"))
     parser.add_argument("--baseline-profile",
                         default=os.path.join(REPO, "BENCH_PROFILE.json"))
+    parser.add_argument("--baseline-loop",
+                        default=os.path.join(REPO, "BENCH_LOOP.json"))
+    parser.add_argument("--fresh-loop", default=None, metavar="JSON",
+                        help="pre-computed tools/bench_loop.py output "
+                        "(default: replay the committed baseline's gates, "
+                        "like the fleet section)")
+    parser.add_argument("--loop-cycle-ceiling", type=float,
+                        default=DEFAULT_LOOP_CYCLE_CEILING_S,
+                        help="retrain-cycle wall-clock ceiling on the loop "
+                        "bench record (seconds; catastrophic-class only)")
+    parser.add_argument("--loop-trigger-latency-ceiling", type=float,
+                        default=DEFAULT_LOOP_TRIGGER_LATENCY_CEILING_S,
+                        help="drift-alert -> loop_trigger latency ceiling "
+                        "on the loop bench record (seconds)")
     parser.add_argument("--fresh-profile", default=None, metavar="JSON",
                         help="pre-computed bench.py --profile-overhead "
                         "output (default: replay the committed baseline's "
@@ -877,6 +987,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         except (OSError, ValueError) as e:
             errors.append(f"profile: {e}")
+    if "loop" in benches:
+        try:
+            baseline = _load(args.baseline_loop)
+            fresh = _load(args.fresh_loop) if args.fresh_loop else None
+            findings += check_loop(
+                baseline, fresh,
+                cycle_ceiling_s=args.loop_cycle_ceiling,
+                trigger_latency_ceiling_s=args.loop_trigger_latency_ceiling,
+            )
+        except (OSError, ValueError) as e:
+            errors.append(f"loop: {e}")
     if "records" in benches:
         try:
             baseline = _load(args.baseline_records)
