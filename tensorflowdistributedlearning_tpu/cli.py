@@ -16,6 +16,7 @@ import argparse
 import json
 import logging
 import os
+import shutil
 import sys
 from typing import Dict, List, Optional
 
@@ -103,6 +104,18 @@ def _add_observability(p: argparse.ArgumentParser) -> None:
                    "config default); overhead is gated <=2%% by `bench.py "
                    "--profile-overhead`. Alert-triggered postmortem "
                    "captures fire regardless of this cadence")
+
+
+def _add_compile_cache(p: argparse.ArgumentParser) -> None:
+    """The shared cold-start knob (train/fit/serve/serve-fleet) —
+    utils/compile_cache.py."""
+    p.add_argument("--compile-cache-dir", default=None, metavar="DIR",
+                   help="persistent XLA compile cache: executables land in "
+                   "DIR keyed on module + jaxlib + flags + device kinds, so "
+                   "a second same-shape run (or the next replica/resize) "
+                   "LOADS instead of compiling. Hits/misses ride the compile "
+                   "ledger events and telemetry-report's hit-ratio line; an "
+                   "unwritable DIR warns and runs uncached")
 
 
 def _add_planner(p: argparse.ArgumentParser) -> None:
@@ -198,6 +211,15 @@ def _add_elastic(p: argparse.ArgumentParser) -> None:
                    help="seconds after any resize during which no eviction "
                    "fires (the resized fleet re-warms, which looks exactly "
                    "like a straggler)")
+    p.add_argument("--aot-standby", action="store_true",
+                   help="after each generation settles, background-compile "
+                   "the NEXT world size's (world-1) step function into the "
+                   "shared --compile-cache-dir from a rank-for-rank standby "
+                   "mini-world on a scratch workdir (cache keys bind the "
+                   "process-local topology), so a resize's respawn loads "
+                   "its executables instead of rebuilding them (requires "
+                   "--compile-cache-dir; ledgered as aot_standby events, "
+                   "measured by world_settled.settle_s)")
     p.add_argument("--host-inject-fault", action="append", default=[],
                    metavar="HOST:SPEC",
                    help="drill: pass --inject-fault SPEC to host-slot HOST "
@@ -294,6 +316,7 @@ def build_parser() -> argparse.ArgumentParser:
     _add_host_loop(p_train)
     _add_observability(p_train)
     _add_resilience(p_train)
+    _add_compile_cache(p_train)
 
     p_pred = sub.add_parser("predict", help="fold x TTA ensemble prediction")
     _add_common(p_pred)
@@ -402,6 +425,7 @@ def build_parser() -> argparse.ArgumentParser:
     _add_observability(p_fit)
     _add_resilience(p_fit)
     _add_elastic(p_fit)
+    _add_compile_cache(p_fit)
 
     p_plan = sub.add_parser(
         "plan",
@@ -586,6 +610,7 @@ def build_parser() -> argparse.ArgumentParser:
     p_serve.add_argument("--drift-sustain-windows", type=int, default=2,
                          help="consecutive over-threshold windows before the "
                          "alert fires (one weird window is noise)")
+    _add_compile_cache(p_serve)
 
     p_fleet = sub.add_parser(
         "serve-fleet",
@@ -695,6 +720,7 @@ def build_parser() -> argparse.ArgumentParser:
                          "ledger events — the flywheel retrain trigger)")
     p_fleet.add_argument("--drift-min-requests", type=int, default=20)
     p_fleet.add_argument("--drift-sustain-windows", type=int, default=2)
+    _add_compile_cache(p_fleet)
 
     p_prom = sub.add_parser(
         "promote",
@@ -993,6 +1019,8 @@ def _trainer(args):
         overlap["nan_guard"] = args.nan_guard
     if getattr(args, "profile_every_windows", None) is not None:
         overlap["profile_every_windows"] = args.profile_every_windows
+    if getattr(args, "compile_cache_dir", None) is not None:
+        overlap["compile_cache_dir"] = args.compile_cache_dir
     tcfg = TrainConfig(
         lr=getattr(args, "lr", 0.001),
         n_devices=args.n_devices,
@@ -1089,6 +1117,7 @@ def cmd_train(args) -> int:
         ))
         out["serving_dtype"] = getattr(args, "serving_dtype", "float32")
         _stamp_baseline(out["serving_artifact"])
+        _attach_cache(args, out["serving_artifact"])
     print(json.dumps(out))
     if getattr(args, "auto_promote", False):
         if not out.get("serving_artifact"):
@@ -1125,6 +1154,23 @@ def _stamp_baseline(artifact_dir: Optional[str]) -> None:
     except Exception as e:  # noqa: BLE001 — the export must survive
         logging.getLogger(__name__).warning(
             "drift-baseline stamp failed for %s: %s", artifact_dir, e
+        )
+
+
+def _attach_cache(args, artifact_dir: Optional[str]) -> None:
+    """With --compile-cache-dir set, ship the export's compiled bucket
+    ladder beside the artifact (train/serving.py attach_compile_cache) so
+    replicas loading it go ready without compiling. Best-effort: a failed
+    attach costs replicas their warm start, never the export."""
+    if not artifact_dir or not getattr(args, "compile_cache_dir", None):
+        return
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    try:
+        serving_lib.attach_compile_cache(artifact_dir)
+    except Exception as e:  # noqa: BLE001 — the export must survive
+        logging.getLogger(__name__).warning(
+            "compile-cache attach failed for %s: %s", artifact_dir, e
         )
 
 
@@ -1275,6 +1321,7 @@ def cmd_fit(args) -> int:
     result = fit_preset(
         args.preset,
         args.model_dir,
+        compile_cache_dir=getattr(args, "compile_cache_dir", None),
         data_dir=args.data_dir,
         steps=args.steps,
         batch_size=args.batch_size,
@@ -1310,6 +1357,7 @@ def cmd_fit(args) -> int:
     if result.serving_artifact:
         result.serving_artifact = _artifact_dir(result.serving_artifact)
         _stamp_baseline(result.serving_artifact)
+        _attach_cache(args, result.serving_artifact)
     summary = {
         "preset": args.preset,
         "steps": result.steps,
@@ -1579,6 +1627,14 @@ def cmd_serve(args) -> int:
         bind_ephemeral,
     )
 
+    if getattr(args, "compile_cache_dir", None):
+        # before the engines build: warmup must LOAD executables (the
+        # artifact's shipped entries merge into this dir) instead of
+        # compiling them — the load-not-compile replica path
+        from tensorflowdistributedlearning_tpu.utils import compile_cache
+
+        compile_cache.configure(args.compile_cache_dir)
+
     # every model this replica serves: (entry, fleet-default fallbacks
     # resolved). Single-artifact stays the one-entry degenerate case.
     entries = None
@@ -1734,18 +1790,30 @@ def cmd_serve(args) -> int:
                 )
             )
         warmup_field = {}
-        for i, (entry, eng) in enumerate(zip(entries, engines)):
-            # warm EVERY engine before arming the recompile detector: the
-            # mark lands once, after the last — earlier engines' compiles
-            # are warmup, not steady-state recompiles
-            timings = eng.warmup(
-                telemetry=telemetry,
-                budget=entry.prewarm_budget,
-                mark_warm=(i == len(engines) - 1),
-            )
-            warmup_field.update(
-                {f"{entry.name}/{b}": s for b, s in timings.items()}
-            )
+        # warm the engines CONCURRENTLY (each ladder already compiles in
+        # parallel; engines are independent executables), so a multi-tenant
+        # replica goes ready in ~its slowest model's time, not the sum —
+        # and arm the recompile detector once, strictly after EVERY engine:
+        # no engine's warmup compiles are flagged as steady-state recompiles
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(
+            max_workers=len(engines), thread_name_prefix="engine-warmup"
+        ) as pool:
+            futs = [
+                pool.submit(
+                    eng.warmup,
+                    telemetry=telemetry,
+                    budget=entry.prewarm_budget,
+                    mark_warm=False,
+                )
+                for entry, eng in zip(entries, engines)
+            ]
+            for entry, fut in zip(entries, futs):
+                warmup_field.update(
+                    {f"{entry.name}/{b}": s for b, s in fut.result().items()}
+                )
+        telemetry.mark_warm()
         first = entries[0]
         batcher = MicroBatcher(
             engines[0],
@@ -1925,6 +1993,7 @@ def cmd_serve_fleet(args) -> int:
             drift_threshold=getattr(args, "drift_threshold", None),
             drift_min_requests=getattr(args, "drift_min_requests", 20),
             drift_sustain_windows=getattr(args, "drift_sustain_windows", 2),
+            compile_cache_dir=getattr(args, "compile_cache_dir", None),
         ),
         router_host=args.host,
         router_sock=sock,
@@ -2619,7 +2688,10 @@ def _strip_elastic_flags(argv: List[str]) -> List[str]:
         "--host-inject-fault", "--max-restarts", "--batch-size",
         "--inject-fault",
     ])
-    return [t for t in stripped if t != "--no-straggler-evict"]
+    return [
+        t for t in stripped
+        if t not in ("--no-straggler-evict", "--aot-standby")
+    ]
 
 
 def _parse_host_faults(specs: List[str]) -> dict:
@@ -2689,6 +2761,50 @@ def _run_elastic(args, argv: List[str]) -> int:
             child += ["--inject-fault", host_faults[pid]]
         return child
 
+    _standby_scratch: dict = {}
+
+    def standby_argv_fn(world, pid, coordinator):
+        # One rank of the AOT standby mini-world: this same fit command,
+        # pointed at a scratch workdir (shared by all standby ranks, like the
+        # real pod shares --model-dir) with the next world's GLOBAL batch and
+        # just enough steps to compile state-init + the train step. The
+        # standby must be a rank-for-rank replica of the pod a resize would
+        # spawn — cache keys bind the process-local backend topology, so
+        # only rank p of a real `world`-process run writes the entry rank p
+        # of the resized pod will load from --compile-cache-dir.
+        import tempfile
+
+        scratch = _standby_scratch.get(world)
+        if scratch is None:
+            scratch = tempfile.mkdtemp(prefix=f"tfdl-aot-standby-w{world}-")
+            _standby_scratch[world] = scratch
+        sb = _strip_flags(base, ["--model-dir", "--steps", "--eval-every"])
+        sb = [t for t in sb if t not in ("--export-serving", "--auto-promote")]
+        child = [
+            sys.executable, "-m", "tensorflowdistributedlearning_tpu",
+            *sb,
+            "--model-dir", scratch,
+            "--batch-size", str(local_bs * world),
+            "--steps", "2",
+            "--eval-every", "100000",
+        ]
+        if coordinator is not None:
+            child += [
+                "--coordinator-address", coordinator,
+                "--num-processes", str(world),
+                "--process-id", str(pid),
+            ]
+        return child
+
+    aot_standby = bool(getattr(args, "aot_standby", False))
+    if aot_standby and not getattr(args, "compile_cache_dir", None):
+        print(
+            "fit: --aot-standby needs --compile-cache-dir (the standby's "
+            "compiles have nowhere to land) — standby disabled",
+            file=sys.stderr,
+        )
+        aot_standby = False
+
     def plan_fn(world, measured_margin_bytes):
         # the coordinator's off-device what-if plan at the (new) world size:
         # a plain Topology, no devices touched — exactly the planner's
@@ -2749,16 +2865,24 @@ def _run_elastic(args, argv: List[str]) -> int:
         # None (flag not given) = the elastic default of 3; an EXPLICIT 0
         # disables same-shape restarts (fail fast on deterministic crashes)
         max_restarts=3 if args.max_restarts is None else args.max_restarts,
+        aot_standby=aot_standby,
         seed=getattr(args, "seed", 0),
     )
     child_env = dict(os.environ, TFDL_SUPERVISED_CHILD="1")
-    result = ElasticCoordinator(
-        child_argv_fn,
-        args.model_dir,
-        cfg,
-        plan_fn=plan_fn,
-        env=child_env,
-    ).run()
+    try:
+        result = ElasticCoordinator(
+            child_argv_fn,
+            args.model_dir,
+            cfg,
+            plan_fn=plan_fn,
+            standby_argv_fn=standby_argv_fn if aot_standby else None,
+            env=child_env,
+        ).run()
+    finally:
+        # standby scratch workdirs hold throwaway checkpoints/ledgers; the
+        # compiles they existed for are already in --compile-cache-dir
+        for scratch in _standby_scratch.values():
+            shutil.rmtree(scratch, ignore_errors=True)
     print(
         json.dumps(
             {
@@ -2771,6 +2895,7 @@ def _run_elastic(args, argv: List[str]) -> int:
                 "aborted": result.aborted,
                 "final_step": result.final_step,
                 "resize_downtime_s": result.resize_downtime_s,
+                "post_resize_settle_s": result.post_resize_settle_s,
             }
         ),
         file=sys.stderr,

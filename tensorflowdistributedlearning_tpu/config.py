@@ -333,6 +333,14 @@ class TrainConfig:
     # degrade to a warning on an unwritable workdir; disabling also skips the
     # span bookkeeping and the jax.monitoring compile listener.
     telemetry: bool = True
+    # persistent XLA compile cache directory (utils/compile_cache.py): point
+    # repeated runs at the same dir and a second same-shape run loads its
+    # executables instead of recompiling (keys hash the StableHLO module +
+    # jaxlib version + XLA flags + device kinds — NOT process topology, so
+    # the elastic AOT standby and serve replicas share entries). None (the
+    # default) leaves the cache off; an unwritable dir degrades to a warning
+    # and an uncached run. CLI: --compile-cache-dir on train/fit/serve.
+    compile_cache_dir: Optional[str] = None
     # memory snapshot cadence, counted in LOG WINDOWS (every N-th window event
     # also records per-device HBM + host RSS); the trainers additionally
     # snapshot once after state init

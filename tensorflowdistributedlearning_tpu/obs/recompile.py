@@ -37,6 +37,11 @@ try:
 except Exception:  # noqa: BLE001 — jax without the monitoring API
     _monitoring = None
 
+try:  # cache-hit attribution (utils sibling); detector works without it
+    from ..utils import compile_cache as _compile_cache
+except Exception:  # noqa: BLE001
+    _compile_cache = None
+
 # One process-wide listener fans out to attached detectors: jax.monitoring has
 # no unregister in its public API, so registering per-detector would leak a
 # callback per trainer construction for the process lifetime.
@@ -48,10 +53,20 @@ _listener_registered = False
 def _dispatch(event: str, duration_secs: float, **kwargs) -> None:
     if event != _COMPILE_EVENT:
         return
+    # JAX fires the persistent-cache hit/saved events on the compiling
+    # thread BEFORE this duration event closes; consume the thread-local
+    # verdict exactly once per compile so it cannot leak to the next one
+    cache_hit: Optional[bool] = None
+    saved_s = 0.0
+    if _compile_cache is not None:
+        try:
+            cache_hit, saved_s = _compile_cache.consume_pending()
+        except Exception:  # noqa: BLE001 — attribution is best-effort
+            cache_hit, saved_s = None, 0.0
     with _lock:
         targets = list(_detectors)
     for det in targets:
-        det._on_compile(duration_secs)
+        det._on_compile(duration_secs, cache_hit=cache_hit, saved_s=saved_s)
 
 
 def _ensure_listener() -> bool:
@@ -75,6 +90,11 @@ class CompileEvent:
     duration_s: float
     phase: str  # telemetry span active at compile time ("" when unattributed)
     post_warmup: bool
+    # persistent-cache verdict: None = cache not consulted (disabled),
+    # False = genuine compile (miss), True = served from cache — a cached
+    # "compile" still stalls the step but costs load time, not XLA time
+    cache_hit: Optional[bool] = None
+    saved_s: float = 0.0  # compile time the hit saved (hit only)
 
 
 class RecompileDetector:
@@ -163,9 +183,27 @@ class RecompileDetector:
     def post_warmup_count(self) -> int:
         return len(self.post_warmup_events)
 
+    @property
+    def cache_hit_count(self) -> int:
+        return sum(1 for e in self.events if e.cache_hit)
+
+    @property
+    def cache_miss_count(self) -> int:
+        return sum(1 for e in self.events if e.cache_hit is False)
+
+    @property
+    def cache_saved_s(self) -> float:
+        return float(sum(e.saved_s for e in self.events if e.cache_hit))
+
     # -- listener side ----------------------------------------------------
 
-    def _on_compile(self, duration_s: float) -> None:
+    def _on_compile(
+        self,
+        duration_s: float,
+        *,
+        cache_hit: Optional[bool] = None,
+        saved_s: float = 0.0,
+    ) -> None:
         phase = ""
         if self._phase_fn is not None:
             try:
@@ -177,6 +215,8 @@ class RecompileDetector:
             duration_s=float(duration_s),
             phase=phase,
             post_warmup=self.is_warm(phase),
+            cache_hit=cache_hit,
+            saved_s=float(saved_s),
         )
         self.events.append(event)
         if self._on_event is not None:

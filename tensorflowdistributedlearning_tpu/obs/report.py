@@ -248,7 +248,23 @@ def _serve_fleet_section(events: List[Dict]) -> Optional[Dict]:
             ],
         }
     if any(lifecycle.values()):
-        section["replicas"] = lifecycle
+        section["replicas"] = dict(lifecycle)
+        # spawn -> readiness-line wall time per replica_ready event: the
+        # cold-start metric (interpreter boot + artifact load + ladder
+        # warmup) the shipped compile cache exists to shrink
+        ttrs = [
+            float(e["time_to_ready_s"])
+            for e in events
+            if e.get("event") == "replica_ready"
+            and e.get("time_to_ready_s") is not None
+        ]
+        if ttrs:
+            section["replicas"]["time_to_ready_s"] = {
+                "count": len(ttrs),
+                "mean": round(sum(ttrs) / len(ttrs), 3),
+                "max": round(max(ttrs), 3),
+                "last": round(ttrs[-1], 3),
+            }
     return section
 
 
@@ -670,7 +686,15 @@ def build_report(
     evals = [e for e in events if e.get("event") == "eval"]
     checkpoints = [e for e in events if e.get("event") == "checkpoint"]
     compiles = [e for e in events if e.get("event") == "compile"]
-    recompiles = [e for e in compiles if e.get("post_warmup")]
+    # a cache-SERVED compile still stalls the step that triggered it, but it
+    # is a load, not a rebuild: counting it as a recompile would page the
+    # operator for a shared cache doing its job. The zero-post-warmup
+    # contract applies to REAL compiles only.
+    cached_compiles = [e for e in compiles if e.get("cache_hit")]
+    recompiles = [
+        e for e in compiles if e.get("post_warmup") and not e.get("cache_hit")
+    ]
+    cached_post_warmup = [e for e in cached_compiles if e.get("post_warmup")]
     memories = [e for e in events if e.get("event") == "memory"]
     run_end = next(
         (e for e in reversed(events) if e.get("event") == "run_end"), None
@@ -739,6 +763,9 @@ def build_report(
         "recompiles": {
             "post_warmup_count": len(recompiles),
             "post_warmup_s": round(recompile_s, 3),
+            # post-warmup compiles the persistent cache answered: visible
+            # (they still interrupt a step) but not alarms
+            "cache_served_post_warmup": len(cached_post_warmup),
             "events": [
                 {
                     "t": e["t"],
@@ -754,6 +781,31 @@ def build_report(
         },
         "checkpoints": len(checkpoints),
     }
+
+    # persistent compile cache verdicts: run_end carries the detector's
+    # exact totals; a run that died early falls back to the ledgered
+    # per-compile verdicts (cache-consulted compiles are always ledgered)
+    cc_hits = (run_end or {}).get("compile_cache_hits")
+    cc_misses = (run_end or {}).get("compile_cache_misses")
+    cc_saved = (run_end or {}).get("compile_saved_s")
+    if cc_hits is None and cc_misses is None:
+        verdicts = [e for e in compiles if e.get("cache_hit") is not None]
+        if verdicts:
+            cc_hits = sum(1 for e in verdicts if e.get("cache_hit"))
+            cc_misses = len(verdicts) - cc_hits
+            cc_saved = round(
+                sum(e.get("saved_s", 0.0) for e in verdicts
+                    if e.get("cache_hit")),
+                3,
+            )
+    if cc_hits is not None:
+        total = cc_hits + (cc_misses or 0)
+        report["compile_cache"] = {
+            "hits": cc_hits,
+            "misses": cc_misses or 0,
+            "hit_ratio": round(cc_hits / total, 4) if total else None,
+            "saved_s": cc_saved,
+        }
 
     fleet = fleet_lib.fleet_section(
         workdir, ledgers=ledgers, skew_threshold=straggler_threshold
@@ -1134,6 +1186,19 @@ def render_report(report: Dict) -> str:
         f"  compile      {_fmt_frac(ts['compile_frac'])}  {ts['compile_s']:9.2f}s"
         "  (overlaps the span it interrupted)"
     )
+    cc = report.get("compile_cache")
+    if cc:
+        ratio = (
+            f"{cc['hit_ratio']:.0%}" if cc.get("hit_ratio") is not None
+            else "n/a"
+        )
+        line = (
+            f"compile cache: {cc['hits']} hit(s) / {cc['misses']} miss(es) "
+            f"— {ratio} served from cache"
+        )
+        if cc.get("saved_s") is not None:
+            line += f", ~{cc['saved_s']:.2f}s compile time saved"
+        lines.append(line)
     rc = report["recompiles"]
     if rc["post_warmup_count"]:
         lines.append(
@@ -1146,6 +1211,11 @@ def render_report(report: Dict) -> str:
             )
     else:
         lines.append("\nrecompiles after warmup: none")
+    if rc.get("cache_served_post_warmup"):
+        lines.append(
+            f"  ({rc['cache_served_post_warmup']} post-warmup compile(s) "
+            "served from the persistent cache — loads, not rebuilds)"
+        )
     pf = report.get("prefetch")
     if pf:
         if "mean_queue_depth" in pf:
@@ -1581,6 +1651,13 @@ def render_report(report: Dict) -> str:
             if rl.get("abandoned"):
                 line += f", !! {rl['abandoned']} ABANDONED"
             lines.append(line)
+            ttr = rl.get("time_to_ready_s")
+            if ttr:
+                lines.append(
+                    f"  replica time-to-ready: mean {ttr['mean']:.2f}s  "
+                    f"max {ttr['max']:.2f}s  last {ttr['last']:.2f}s "
+                    f"over {ttr['count']} readiness event(s)"
+                )
     pm = report.get("promotion")
     if pm:
         verdictbits = []

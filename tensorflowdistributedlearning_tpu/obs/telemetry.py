@@ -625,13 +625,24 @@ class Telemetry:
     _COMPILE_LEDGER_MIN_S = 0.01
 
     def _on_compile(self, event: CompileEvent) -> None:
-        if event.post_warmup or event.duration_s >= self._COMPILE_LEDGER_MIN_S:
-            self._event(
-                "compile",
-                duration_s=round(event.duration_s, 6),
-                phase=event.phase,
-                post_warmup=event.post_warmup,
-            )
+        # cache-served compiles are fast by construction, so the min-duration
+        # gate would hide exactly the events that prove the cache works —
+        # any compile with a cache verdict is ledgered unconditionally
+        if (
+            event.post_warmup
+            or event.cache_hit is not None
+            or event.duration_s >= self._COMPILE_LEDGER_MIN_S
+        ):
+            fields = {
+                "duration_s": round(event.duration_s, 6),
+                "phase": event.phase,
+                "post_warmup": event.post_warmup,
+            }
+            if event.cache_hit is not None:
+                fields["cache_hit"] = event.cache_hit
+                if event.cache_hit:
+                    fields["saved_s"] = round(event.saved_s, 6)
+            self._event("compile", **fields)
         if event.post_warmup:
             logger.warning(
                 "post-warmup recompilation #%d detected (%.2fs, during %r) — "
@@ -668,6 +679,16 @@ class Telemetry:
             final_fields.setdefault(
                 "compile_total_s", round(self.detector.compile_total_s, 3)
             )
+            if self.detector.cache_hit_count or self.detector.cache_miss_count:
+                final_fields.setdefault(
+                    "compile_cache_hits", self.detector.cache_hit_count
+                )
+                final_fields.setdefault(
+                    "compile_cache_misses", self.detector.cache_miss_count
+                )
+                final_fields.setdefault(
+                    "compile_saved_s", round(self.detector.cache_saved_s, 3)
+                )
             self.detector.detach()
         self._event("run_end", **final_fields)
         if self.ledger is not None:

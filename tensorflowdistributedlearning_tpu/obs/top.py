@@ -171,6 +171,13 @@ def _process_status(led: fleet_lib.ProcessLedger, now: float) -> Dict:
                     "promoted" if p_t >= r_t else "rejected"
                 )
         row["loop"] = lrow
+    ready = _last(events, "replica_ready")
+    if ready is not None and ready.get("time_to_ready_s") is not None:
+        # the controller's newest replica cold-start: spawn -> readiness line
+        row["last_replica_ready"] = {
+            "replica": ready.get("replica"),
+            "time_to_ready_s": ready["time_to_ready_s"],
+        }
     router = _last(events, "router_window")
     if router is not None:
         fleet_state = router.get("fleet") or {}
@@ -426,6 +433,12 @@ def render_frame(frame: Dict) -> str:
             )
             if rt.get("mixed"):
                 line += "  !! MIXED ARTIFACTS (no promotion active)"
+            rr = row.get("last_replica_ready")
+            if rr:
+                line += (
+                    f", last ready r{rr.get('replica', '?')} in "
+                    f"{rr['time_to_ready_s']:.1f}s"
+                )
             lines.append(line)
             for name, m in sorted((rt.get("models") or {}).items()):
                 mline = (

@@ -125,6 +125,19 @@ class ElasticConfig:
     heartbeat_timeout_s: float = 600.0
     max_restarts: int = 3  # same-shape restarts (crashes), like Supervisor
     max_resizes: int = 8
+    # AOT warm standby: once a generation settles (first fresh ledger step),
+    # background-compile the NEXT world's (world-1) step function into the
+    # shared persistent compile cache (utils/compile_cache.py), so a resize's
+    # respawn LOADS its executables instead of rebuilding them and the
+    # downtime left is checkpoint I/O. Cache keys hash the serialized
+    # backend topology, which is PROCESS-LOCAL (total device count plus
+    # which devices are this rank's) — so the standby is a real
+    # (world-1)-process mini-world on a scratch workdir, rank-for-rank
+    # identical to the pod a resize would spawn; a solo process emulating
+    # the device count would write entries nobody ever reads. Needs a
+    # standby_argv_fn (the CLI injects one) and a configured
+    # --compile-cache-dir to be useful.
+    aot_standby: bool = False
     crash_loop_tolerance: int = 2
     backoff_base_s: float = 1.0
     backoff_max_s: float = 30.0
@@ -285,6 +298,10 @@ class ElasticResult:
     aborted: Optional[str] = None  # ABORT_* or None
     final_step: Optional[int] = None
     resize_downtime_s: float = 0.0
+    # spawn -> first fresh ledger step, summed over post-resize generations:
+    # the warmup (interpreter boot + restore + COMPILE) a resize actually
+    # costs beyond the drain, and the number the AOT standby exists to shrink
+    post_resize_settle_s: float = 0.0
 
 
 class _Child:
@@ -333,6 +350,9 @@ class ElasticCoordinator:
         config: ElasticConfig,
         *,
         plan_fn: Optional[Callable[[int, Optional[int]], Optional[Dict]]] = None,
+        standby_argv_fn: Optional[
+            Callable[[int, int, Optional[str]], Optional[Sequence[str]]]
+        ] = None,
         spawn: Optional[Callable[[Sequence[str], Dict[str, str]], _Child]] = None,
         straggler_probe: Optional[
             Callable[[int], Tuple[Optional[int], Optional[Dict]]]
@@ -345,6 +365,20 @@ class ElasticCoordinator:
         self.config = config
         self._argv_fn = child_argv_fn
         self._plan_fn = plan_fn
+        # AOT standby seam: argv for one rank of a compile-only mini-world at
+        # the given size — ``(world, pid, coordinator_address)``, mirroring
+        # child_argv_fn (None: no standby possible for that size). The
+        # standby must be a REAL world of ``world`` processes: XLA cache keys
+        # hash the serialized backend topology, which is process-local (total
+        # device count AND which devices belong to this rank), so only a
+        # rank-for-rank replica of the future world produces entries the
+        # resized pod can actually hit.
+        self._standby_argv_fn = standby_argv_fn
+        self._standby: List[_Child] = []
+        self._standby_world: Optional[int] = None
+        self._standby_t0 = 0.0
+        self._standby_done: set = set()  # worlds already compiled into cache
+        self._settles: Dict[int, float] = {}  # generation -> settle wall s
         self._spawn = spawn or self._spawn_subprocess
         self._probe = straggler_probe or (
             lambda world: ledger_straggler_probe(
@@ -382,6 +416,115 @@ class ElasticCoordinator:
                 f"{self.config.devices_per_host}"
             )
         return env
+
+    # -- AOT warm standby --------------------------------------------------
+
+    def _standby_env(self) -> Dict[str, str]:
+        """Same env as a real child (identical forced device count — the
+        standby rank's backend topology must match the future world's rank
+        bit-for-bit, or its cache keys miss), plus the standby marker."""
+        env = self._child_env()
+        env["TFDL_AOT_STANDBY"] = "1"
+        return env
+
+    def _maybe_start_standby(self, world: int, generation: int, ledger) -> None:
+        """Kick off the background compile of the world-1 step function —
+        called once per generation, after the live world settled (its own
+        compile is done, so the standby no longer competes with it). The
+        standby is a full ``world-1``-process mini-world on a scratch
+        workdir: cache keys are process-topology-bound, so only rank p of a
+        real (world-1)-world writes the entry rank p of the resized pod
+        will read."""
+        if not self.config.aot_standby or self._standby_argv_fn is None:
+            return
+        target = world - 1
+        if target < self.config.min_hosts or target in self._standby_done:
+            return
+        if self._standby:
+            if self._standby_world == target and any(
+                c.poll() is None for c in self._standby
+            ):
+                return  # already compiling exactly this world
+            self._kill_standby()  # stale target — the world moved on
+        try:
+            coord = f"127.0.0.1:{free_port()}" if target > 1 else None
+            procs: List[_Child] = []
+            env = self._standby_env()
+            for pid in range(target):
+                argv = self._standby_argv_fn(target, pid, coord)
+                if not argv:
+                    for c in procs:
+                        c.kill()
+                    return
+                procs.append(self._spawn(list(argv), env))
+            self._standby = procs
+        except Exception as e:  # noqa: BLE001 — the standby is an
+            # optimization; a failed spawn must never touch the live world
+            logger.warning("aot standby spawn at world %d failed: %s",
+                           target, e)
+            self._kill_standby()
+            return
+        self._standby_world = target
+        self._standby_t0 = self._clock()
+        ledger.event(
+            "aot_standby",
+            action="start",
+            target_world=target,
+            generation=generation,
+            procs=len(self._standby),
+            pid=self._standby[0].pid,
+        )
+
+    def _poll_standby(self, ledger) -> None:
+        if not self._standby:
+            return
+        rcs = [c.poll() for c in self._standby]
+        if any(rc is None for rc in rcs):
+            return
+        rc = next((r for r in rcs if r != 0), 0)
+        ledger.event(
+            "aot_standby",
+            action="ready" if rc == 0 else "failed",
+            target_world=self._standby_world,
+            rc=rc,
+            duration_s=round(self._clock() - self._standby_t0, 3),
+        )
+        if rc == 0:
+            self._standby_done.add(self._standby_world)
+        else:
+            logger.warning(
+                "aot standby for world %s exited rc=%s — next resize "
+                "compiles cold", self._standby_world, rc,
+            )
+        self._standby = []
+
+    def _kill_standby(self) -> None:
+        for c in self._standby:
+            try:
+                c.kill()
+            except Exception:  # noqa: BLE001 — already-dead child
+                pass
+        self._standby = []
+
+    def _reap_standby(self, ledger) -> None:
+        """The world is about to respawn: the standby's job is moot (the new
+        generation compiles-or-loads RIGHT NOW) and on a shared box its
+        processes would compete with the respawn for cores — the exact
+        window the standby exists to shrink. Harvest a finished standby
+        (its entries are on disk), kill a running one (every entry compiled
+        so far is already written; only the tail is lost)."""
+        if not self._standby:
+            return
+        self._poll_standby(ledger)
+        if not self._standby:
+            return
+        ledger.event(
+            "aot_standby",
+            action="superseded",
+            target_world=self._standby_world,
+            duration_s=round(self._clock() - self._standby_t0, 3),
+        )
+        self._kill_standby()
 
     def _ledger(self):
         from tensorflowdistributedlearning_tpu.obs.ledger import RunLedger
@@ -529,7 +672,13 @@ class ElasticCoordinator:
             **({"plan": self._plan_lite(plan_header)} if plan_header else {}),
         )
 
+        resized_gens: set = set()  # generations spawned BY a resize
+
         def finish(res: ElasticResult) -> ElasticResult:
+            res.post_resize_settle_s = round(
+                sum(s for g, s in self._settles.items() if g in resized_gens),
+                3,
+            )
             ledger.event(
                 "elastic_end",
                 ok=res.ok,
@@ -540,6 +689,7 @@ class ElasticCoordinator:
                 aborted=res.aborted,
                 step=res.final_step,
                 resize_downtime_s=round(res.resize_downtime_s, 3),
+                post_resize_settle_s=res.post_resize_settle_s,
             )
             return res
 
@@ -552,7 +702,7 @@ class ElasticCoordinator:
                     world_size=world,
                     pids=[c.pid for c in self._children if c is not None],
                 )
-                event = self._monitor(world, ledger)
+                event = self._monitor(world, ledger, generation)
                 step = self._progress()
                 if self._stop_signal is not None or event["kind"] == "signaled":
                     # the coordinator itself was told to stop: children were
@@ -590,6 +740,7 @@ class ElasticCoordinator:
                 # membership change or crash: drain whatever still runs
                 drain_t0 = self._clock()
                 self._drain()
+                self._reap_standby(ledger)
                 last_step = prev_step
                 step = self._progress()
                 progressed = step is not None and (
@@ -705,6 +856,7 @@ class ElasticCoordinator:
                     )
                     world = new_world
                     generation += 1
+                    resized_gens.add(generation)
                     continue
 
                 # crash / stall: same-shape restart, budgeted like Supervisor
@@ -774,20 +926,28 @@ class ElasticCoordinator:
         finally:
             # finish() already ledgered elastic_end on every return path;
             # this only covers an unexpected exception escaping the loop
+            self._kill_standby()
             self._restore_signals(prev_handlers)
             ledger.close()
 
     # -- per-generation monitor --------------------------------------------
 
-    def _monitor(self, world: int, ledger) -> Dict:
+    def _monitor(self, world: int, ledger, generation: int = 0) -> Dict:
         """Watch one generation until it completes or a membership/crash
         event fires. Returns ``{"kind": ...}`` with kind one of ``done``,
         ``signaled``, :data:`RESIZE_HOST_DEATH`, :data:`RESIZE_EVICTION`,
-        ``crash`` or ``stall`` (+ ``rc``/``process``/``skew`` context)."""
+        ``crash`` or ``stall`` (+ ``rc``/``process``/``skew`` context).
+
+        The first FRESH ledger step past the spawn-time watermark marks the
+        generation as settled: ``world_settled`` is ledgered with the
+        spawn->step wall time (boot + restore + compile — the real post-drain
+        warmup a resize costs), and the AOT standby for the next world size
+        starts only then, so its compile never races the live world's own."""
         cfg = self.config
         spawn_t = self._clock()
         last_progress_t = spawn_t
         last_step = self._progress()
+        settled = False
         next_straggler_t = spawn_t + cfg.straggler_poll_s
         # heartbeat bookkeeping: the ledger reparse is O(file size), so it
         # runs on its own (>= 1s) cadence and only when the canonical ledger
@@ -825,8 +985,10 @@ class ElasticCoordinator:
             if len(exited) == len(self._children):
                 return {"kind": "done"}
             now = self._clock()
-            # heartbeat: ledger step progress is the fleet's pulse
-            if cfg.heartbeat_timeout_s and now >= next_heartbeat_t:
+            # heartbeat: ledger step progress is the fleet's pulse (the same
+            # cadence also drives settle detection, so it runs even with the
+            # stall timeout disabled)
+            if now >= next_heartbeat_t:
                 next_heartbeat_t = now + heartbeat_poll_s
                 try:
                     size = os.stat(ledger_path).st_size
@@ -838,8 +1000,26 @@ class ElasticCoordinator:
                     if step != last_step:
                         last_step = step
                         last_progress_t = now
-                if now - last_progress_t > cfg.heartbeat_timeout_s:
+                        if not settled:
+                            settled = True
+                            settle_s = now - spawn_t
+                            self._settles[generation] = settle_s
+                            ledger.event(
+                                "world_settled",
+                                generation=generation,
+                                world_size=world,
+                                step=step,
+                                settle_s=round(settle_s, 3),
+                            )
+                            self._maybe_start_standby(
+                                world, generation, ledger
+                            )
+                if (
+                    cfg.heartbeat_timeout_s
+                    and now - last_progress_t > cfg.heartbeat_timeout_s
+                ):
                     return {"kind": "stall", "rc": None}
+                self._poll_standby(ledger)
             # straggler watch: only meaningful with >= 2 hosts
             if world > 1 and now >= next_straggler_t:
                 next_straggler_t = now + cfg.straggler_poll_s

@@ -23,6 +23,8 @@ from __future__ import annotations
 import bisect
 import contextlib
 import logging
+import os
+import tempfile
 import threading
 import time
 from typing import Callable, Dict, Optional, Sequence, Tuple
@@ -47,6 +49,52 @@ class RequestTooLargeError(ValueError):
     """A request carries more examples than the largest compiled bucket —
     the caller must chunk it; silently splitting here would reorder the
     batcher's fairness guarantees."""
+
+
+# manifest-adjacent cache subdir an exporter may ship beside the artifact
+# (train/serving.py attach_compile_cache); warmup LOADS these executables
+# instead of compiling them — the load-not-compile replica path
+ARTIFACT_CACHE_SUBDIR = "compile_cache"
+
+
+def consume_artifact_cache(directory: str, manifest: Optional[Dict]) -> int:
+    """Fold an artifact's shipped compile-cache subdir into this process's
+    active persistent cache so the subsequent warmup loads, not compiles.
+
+    The manifest's ``compile_cache`` section records the subdir's
+    fingerprint at export time; a mismatch (truncated copy, mixed artifact)
+    warns and skips the shipped entries — a stale cache entry is harmless
+    (keys are content-addressed) but a torn one is not worth the risk. When
+    no cache dir is configured yet, the entries land in a throwaway temp
+    cache so the artifact directory itself is never written to at runtime.
+    Returns the number of entries merged (0 = nothing shipped/usable)."""
+    from tensorflowdistributedlearning_tpu.utils import compile_cache
+
+    sub = os.path.join(directory, ARTIFACT_CACHE_SUBDIR)
+    if not os.path.isdir(sub):
+        return 0
+    recorded = (manifest or {}).get("compile_cache")
+    if recorded and recorded.get("fingerprint"):
+        fresh = compile_cache.fingerprint(sub)
+        if fresh["fingerprint"] != recorded["fingerprint"]:
+            logger.warning(
+                "artifact %s ships a compile cache whose fingerprint does "
+                "not match its manifest (%s entries on disk vs %s recorded) "
+                "— skipping the shipped cache; warmup will compile",
+                directory, fresh["entries"], recorded.get("entries"),
+            )
+            return 0
+    dst = compile_cache.active_dir()
+    if dst is None:
+        dst = tempfile.mkdtemp(prefix="tfdl-compile-cache-")
+        if not compile_cache.configure(dst):
+            return 0
+    merged = compile_cache.merge(sub, dst)
+    if merged:
+        logger.info(
+            "loaded %d shipped compile-cache entries from %s", merged, sub
+        )
+    return merged
 
 
 def _tree_map(fn, tree):
@@ -149,6 +197,13 @@ class InferenceEngine:
 
         serve = serving_lib.load_serving_artifact(directory)
         manifest = serving_lib.read_manifest(directory)
+        # shipped cache entries must be active BEFORE warmup compiles the
+        # ladder — this is what turns a replica spawn into a load, not a
+        # compile (failures degrade to a normal compiling warmup)
+        try:
+            consume_artifact_cache(directory, manifest)
+        except Exception:  # noqa: BLE001 — a bad cache must not block serving
+            logger.warning("shipped compile cache unusable", exc_info=True)
         shape = manifest["input_shape"]
         if any(d is None for d in shape[1:]):
             raise ValueError(
@@ -247,14 +302,21 @@ class InferenceEngine:
         loading SEVERAL engines (multi-tenant registry load) warms them in
         sequence and must mark warm once, after the LAST — otherwise every
         engine after the first would be flagged as a steady-state
-        recompile."""
+        recompile.
+
+        Buckets compile CONCURRENTLY (XLA releases the GIL for the whole
+        backend compile): ladder warmup costs ~the slowest bucket instead of
+        the sum. Each bucket joins ``warmed_buckets`` as its own compile
+        lands, and the detector's warm mark still happens strictly after
+        every bucket — the ordering contract is unchanged."""
         import jax
 
         to_warm = self.buckets
         if budget is not None and budget < len(self.buckets):
             to_warm = self.buckets[: max(0, int(budget))]
         timings: Dict[int, float] = {}
-        for b in to_warm:
+
+        def _compile(b: int) -> float:
             # transient zeros: the request-path scratch pads are thread-local
             # and the batcher worker is a different thread than the one
             # running warmup — filling this thread's ladder would just leave
@@ -262,8 +324,23 @@ class InferenceEngine:
             x = np.zeros((b, *self.example_shape), self.input_dtype)
             t0 = time.perf_counter()
             jax.block_until_ready(self.serve_fn(x))
-            timings[b] = round(time.perf_counter() - t0, 6)
-            self.warmed_buckets.add(b)
+            return round(time.perf_counter() - t0, 6)
+
+        if len(to_warm) > 1:
+            from concurrent.futures import ThreadPoolExecutor, as_completed
+
+            with ThreadPoolExecutor(
+                max_workers=len(to_warm), thread_name_prefix="warmup"
+            ) as pool:
+                futures = {pool.submit(_compile, b): b for b in to_warm}
+                for fut in as_completed(futures):
+                    b = futures[fut]
+                    timings[b] = fut.result()
+                    self.warmed_buckets.add(b)
+        else:
+            for b in to_warm:
+                timings[b] = _compile(b)
+                self.warmed_buckets.add(b)
         self.warmed = True
         if telemetry is not None:
             warm_fields = {}
@@ -275,7 +352,7 @@ class InferenceEngine:
                 warm_fields["prewarm_budget"] = len(to_warm)
             telemetry.event(
                 "serve_warmup",
-                buckets={str(b): s for b, s in timings.items()},
+                buckets={str(b): timings[b] for b in sorted(timings)},
                 example_shape=list(self.example_shape),
                 input_dtype=str(self.input_dtype),
                 **warm_fields,

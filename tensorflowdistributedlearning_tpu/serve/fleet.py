@@ -110,6 +110,12 @@ class FleetConfig:
     drift_threshold: Optional[float] = None
     drift_min_requests: int = 20
     drift_sustain_windows: int = 2
+    # shared persistent compile cache (utils/compile_cache.py): every
+    # replica points its XLA compiles here, so the FIRST replica of a shape
+    # pays the ladder compile and every later spawn (scale-up surge,
+    # restart, promotion canary) loads it — time_to_ready_s on the
+    # replica_ready event is the measured win
+    compile_cache_dir: Optional[str] = None
     # extra environment for replica processes (the bench pins XLA's CPU
     # threading here so replica scaling is honest on a shared host)
     extra_env: Optional[Dict[str, str]] = None
@@ -275,6 +281,8 @@ class FleetManager:
                 "--drift-min-requests", str(cfg.drift_min_requests),
                 "--drift-sustain-windows", str(cfg.drift_sustain_windows),
             ]
+        if cfg.compile_cache_dir:
+            argv += ["--compile-cache-dir", cfg.compile_cache_dir]
         if fault_spec:
             argv += ["--inject-fault", fault_spec]
         return argv
@@ -385,6 +393,12 @@ class FleetManager:
                         endpoint=rep.url,
                         pid=process.pid,
                         port=obj.get("port"),
+                        # spawn→readiness-line wall time: interpreter boot +
+                        # artifact load + ladder warmup — the cold-start
+                        # metric the compile cache exists to shrink
+                        time_to_ready_s=round(
+                            time.monotonic() - rep.started_t, 3
+                        ),
                     )
         except (OSError, ValueError):
             pass

@@ -108,6 +108,12 @@ class ClassifierTrainer:
         self.data_dir = data_dir
         self.model_config = model_config
         self.train_config = train_config or TrainConfig()
+        if self.train_config.compile_cache_dir:
+            # before anything compiles (state init, eval, the step): a second
+            # same-shape run must LOAD its executables, not rebuild them
+            from tensorflowdistributedlearning_tpu.utils import compile_cache
+
+            compile_cache.configure(self.train_config.compile_cache_dir)
         if self.train_config.parallelism == "auto" and plan is None:
             # the mesh is built below from the config's explicit degrees, so
             # an unresolved 'auto' here would silently train explicit while
@@ -1132,6 +1138,7 @@ def fit_preset(
     profile_every_windows: Optional[int] = None,
     parallelism: Optional[str] = None,
     hbm_budget_gb: Optional[float] = None,
+    compile_cache_dir: Optional[str] = None,
     export_serving: Optional[str] = None,
     export_dir: Optional[str] = None,
 ) -> FitResult:
@@ -1185,6 +1192,7 @@ def fit_preset(
         or trace_sample_rate is not None
         or nan_guard is not None
         or profile_every_windows is not None
+        or compile_cache_dir is not None
     ):
         train_cfg = dataclasses.replace(
             train_cfg,
@@ -1257,6 +1265,11 @@ def fit_preset(
                 profile_every_windows
                 if profile_every_windows is not None
                 else train_cfg.profile_every_windows
+            ),
+            compile_cache_dir=(
+                compile_cache_dir
+                if compile_cache_dir is not None
+                else train_cfg.compile_cache_dir
             ),
         )
     # route EVERY preset's layout through the parallelism planner before the

@@ -145,6 +145,12 @@ class Trainer:
         self.augment_config = augment_config or augment_lib.AugmentConfig(
             crop_probability=0.0
         )
+        if self.train_config.compile_cache_dir:
+            # before the first compile (fold state init): a restarted run
+            # loads its executables from the cache instead of rebuilding
+            from tensorflowdistributedlearning_tpu.utils import compile_cache
+
+            compile_cache.configure(self.train_config.compile_cache_dir)
         if self.train_config.parallelism == "auto" and plan is None:
             # same contract as ClassifierTrainer: the mesh is built below
             # from the explicit degrees, so 'auto' must be resolved (and its

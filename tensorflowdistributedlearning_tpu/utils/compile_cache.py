@@ -1,0 +1,285 @@
+"""Persistent XLA compile-cache plumbing: the load-not-compile layer.
+
+Compilation is the biggest cold-start cliff in the stack: every serve
+replica recompiles its bucket ladder, every elastic resize recompiles the
+step function at the new world size, every train restart pays full warmup.
+JAX ships a content-addressed persistent compilation cache (keyed on the
+canonicalized StableHLO module + jaxlib version + registered XLA flags +
+compile options + device kinds); this module wires it through the CLI
+surface (``--compile-cache-dir`` on train/fit/serve/serve-fleet) and turns
+its hit/miss stream into telemetry the rest of obs/ can ledger.
+
+Three public seams:
+
+- :func:`configure` points the process at a cache directory, forcing the
+  cache-everything knobs (JAX's defaults skip sub-second compiles, which on
+  CPU smoke scale means caching *nothing*). Unwritable directory degrades
+  to a warning + uncached run — a bad ``--compile-cache-dir`` must never
+  kill a training job.
+- :func:`consume_pending` is called by ``obs.recompile`` exactly once per
+  backend-compile event to learn whether that compile was served from the
+  cache (and how much compile time the hit saved). JAX fires the cache-hit
+  monitoring events synchronously on the compiling thread *before* the
+  compile-duration event closes, so a thread-local carries the verdict
+  across the two listener callbacks.
+- :func:`fingerprint` / :func:`merge` support shipping a cache subdir
+  beside an exported serving artifact (manifest records the fingerprint;
+  serve merges the entries into its active cache before warmup).
+
+Cache-key caveat (documented, load-bearing): keys hash the canonicalized
+module, jaxlib version, registered XLA flags, compile options AND the
+serialized backend topology — which is PROCESS-LOCAL: it covers the total
+device count and which devices belong to this process, so two processes
+only share entries when their whole topology matches rank-for-rank
+(verified empirically: rank 0 and rank 1 of the same 2-process world
+compute *different* keys for the same module). Consequences wired through
+this codebase: (1) the elastic AOT standby is a real (world-1)-process
+mini-world, not a solo emulator; (2) ``attach_compile_cache`` compiles the
+serving ladder in a 1-device subprocess because replicas load under the
+serving topology, not the trainer's; (3) ``configure`` disables the XLA
+autotune-cache debug option, whose directory (a path inside cache_dir)
+would otherwise be hashed into every key, pinning entries to one absolute
+cache path. Keys do NOT survive jaxlib upgrades or XLA flag changes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import shutil
+import tempfile
+import threading
+from typing import Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+# jax.monitoring event names fired by jax._src.compiler.compile_or_get_cached
+# (verified against the installed jax; literal strings are the stable API)
+_REQUEST_EVENT = "/jax/compilation_cache/compile_requests_use_cache"
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_SAVED_EVENT = "/jax/compilation_cache/compile_time_saved_sec"
+
+try:
+    from jax import monitoring as _monitoring
+except Exception:  # noqa: BLE001 — jax without the monitoring API
+    _monitoring = None
+
+_lock = threading.Lock()
+_listener_registered = False
+_active_dir: Optional[str] = None
+
+# Per-thread in-flight verdict: compile_or_get_cached fires request → (hit,
+# saved) → the backend-compile duration event, all on the compiling thread,
+# so thread-local state bridges them without cross-compile races even under
+# the parallel warmup pool.
+_tls = threading.local()
+
+# Process-wide counters (updated by the listeners on every compile) for
+# introspection and the run_end summary; guarded by _lock. "misses" is
+# derived as requests - hits at stats() read time.
+_stats: Dict[str, float] = {"requests": 0, "hits": 0, "saved_s": 0.0}
+
+
+def _on_record_event(event: str, **kwargs) -> None:
+    # Stats are counted here, in the listener, not in consume_pending():
+    # consume_pending() only runs when an obs.recompile detector is attached,
+    # and a bare process (serve replica without telemetry, standby sidecar)
+    # must still report accurate hit/miss counts via stats().
+    if event == _REQUEST_EVENT:
+        _tls.pending_request = True
+        with _lock:
+            _stats["requests"] += 1
+    elif event == _HIT_EVENT:
+        _tls.pending_hit = True
+        with _lock:
+            _stats["hits"] += 1
+
+
+def _on_duration_event(event: str, duration_secs: float, **kwargs) -> None:
+    if event == _SAVED_EVENT:
+        _tls.pending_saved_s = float(duration_secs)
+        with _lock:
+            _stats["saved_s"] += float(duration_secs)
+
+
+def _ensure_listeners() -> bool:
+    """Register the cache-hit monitoring listeners once per process."""
+    global _listener_registered
+    if _monitoring is None:
+        return False
+    with _lock:
+        if _listener_registered:
+            return True
+        try:
+            _monitoring.register_event_listener(_on_record_event)
+            _monitoring.register_event_duration_secs_listener(
+                _on_duration_event
+            )
+        except Exception as e:  # noqa: BLE001 — degrade, never crash
+            logger.warning("compile-cache hit telemetry unavailable: %s", e)
+            return False
+        _listener_registered = True
+    return True
+
+
+def consume_pending() -> Tuple[Optional[bool], float]:
+    """Pop this thread's in-flight cache verdict.
+
+    Returns ``(cache_hit, saved_s)`` where ``cache_hit`` is ``None`` when
+    the persistent cache was not consulted for the compile that just closed
+    (cache disabled, or key generation failed), ``True`` on a hit (with the
+    compile time the hit saved), ``False`` on a genuine miss. Called by
+    ``obs.recompile._dispatch`` exactly once per backend-compile event.
+    """
+    requested = getattr(_tls, "pending_request", False)
+    hit = getattr(_tls, "pending_hit", False)
+    saved_s = getattr(_tls, "pending_saved_s", 0.0)
+    _tls.pending_request = False
+    _tls.pending_hit = False
+    _tls.pending_saved_s = 0.0
+    if not requested:
+        return None, 0.0
+    return (True, saved_s) if hit else (False, 0.0)
+
+
+def stats() -> Dict[str, float]:
+    """Process-wide hit/miss counters (every compile the listeners saw)."""
+    with _lock:
+        out = dict(_stats)
+    out["misses"] = out["requests"] - out["hits"]
+    return out
+
+
+def reset_stats() -> None:
+    with _lock:
+        for k in _stats:
+            _stats[k] = 0.0 if k == "saved_s" else 0
+
+
+def active_dir() -> Optional[str]:
+    """The cache directory this process was configured with (None = off)."""
+    return _active_dir
+
+
+def _probe_writable(cache_dir: str) -> bool:
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        fd, probe = tempfile.mkstemp(prefix=".cache_probe_", dir=cache_dir)
+        os.close(fd)
+        os.unlink(probe)
+        return True
+    except OSError:
+        return False
+
+
+def configure(cache_dir: Optional[str]) -> bool:
+    """Point this process's XLA compiles at a persistent cache directory.
+
+    Must run before the first compile to catch everything, but is safe (and
+    effective for later compiles) at any point — an already-initialized
+    cache backend is reset so the new directory takes. Forces the
+    cache-everything knobs: JAX's defaults skip compiles under 1 s and tiny
+    entries, which at CPU-smoke scale silently caches nothing.
+
+    Returns True when the cache is active. An unwritable/uncreatable
+    directory logs a warning and returns False with the process left
+    uncached — degradation, never a crash. ``cache_dir=None`` is a no-op
+    False (callers can pass the knob through unconditionally).
+    """
+    global _active_dir
+    if not cache_dir:
+        return False
+    cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+    if not _probe_writable(cache_dir):
+        logger.warning(
+            "compile cache dir %s is not writable — proceeding UNCACHED "
+            "(every compile will be paid in full)",
+            cache_dir,
+        )
+        return False
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache EVERYTHING: the defaults (min 1.0s compile, min entry size)
+        # are tuned for real accelerators and would skip our smoke compiles
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # The default enables the XLA per-fusion autotune cache, whose
+        # directory (a path INSIDE cache_dir) is baked into compile options
+        # and is NOT stripped from the cache key — so keys would depend on
+        # the cache dir's absolute path and entries shipped beside an
+        # artifact could never hit. Disable it; it's a GPU-only feature.
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
+    except Exception as e:  # noqa: BLE001 — old jax without the knobs
+        logger.warning("persistent compile cache unavailable: %s", e)
+        return False
+    # The cache backend latches on first compile: _cache_initialized flips
+    # True even when the dir was unset (leaving _cache None *permanently*),
+    # so a late configure() must reset unconditionally — checking _cache
+    # alone misses the initialized-while-disabled state.
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — private seam; best-effort
+        pass
+    _ensure_listeners()
+    _active_dir = cache_dir
+    logger.info("persistent compile cache at %s", cache_dir)
+    return True
+
+
+# -- artifact cache subdir support ------------------------------------------
+
+
+def fingerprint(cache_dir: str) -> Dict[str, object]:
+    """Content fingerprint of a cache directory for manifest stamping.
+
+    Hashes the sorted (relative path, size) list — cheap, order-stable, and
+    enough to detect a truncated/mixed copy. Entry *contents* are already
+    content-addressed by JAX's own key, so hashing bytes again buys nothing.
+    """
+    entries = []
+    if os.path.isdir(cache_dir):
+        for root, _dirs, files in os.walk(cache_dir):
+            for name in sorted(files):
+                path = os.path.join(root, name)
+                rel = os.path.relpath(path, cache_dir)
+                try:
+                    entries.append((rel, os.path.getsize(path)))
+                except OSError:
+                    continue
+    entries.sort()
+    h = hashlib.sha256()
+    for rel, size in entries:
+        h.update(f"{rel}\x00{size}\n".encode())
+    return {"entries": len(entries), "fingerprint": h.hexdigest()}
+
+
+def merge(src_dir: str, dst_dir: str) -> int:
+    """Copy cache entries from ``src_dir`` into ``dst_dir`` (skip existing).
+
+    Used by serve to fold an artifact's shipped cache subdir into the
+    replica's active cache directory so warmup loads instead of compiling.
+    Returns the number of entries copied; I/O failures skip the entry (a
+    missed merge costs one compile, not the replica).
+    """
+    copied = 0
+    if not os.path.isdir(src_dir):
+        return 0
+    for root, _dirs, files in os.walk(src_dir):
+        for name in files:
+            src = os.path.join(root, name)
+            rel = os.path.relpath(src, src_dir)
+            dst = os.path.join(dst_dir, rel)
+            if os.path.exists(dst):
+                continue
+            try:
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                shutil.copy2(src, dst)
+                copied += 1
+            except OSError as e:
+                logger.warning("cache merge skipped %s: %s", rel, e)
+    return copied

@@ -1,0 +1,400 @@
+"""Cold-start elimination: persistent compile cache + load-not-compile.
+
+The contracts under test are the ones the cold-start work ships on:
+
+- the persistent compile cache survives the PROCESS — a fresh interpreter
+  running the same-shape computation loads its executables (ledgered cache
+  hits, zero real compiles) instead of rebuilding them;
+- an exported artifact's shipped ``compile_cache/`` subdir round-trips
+  through the real manifest seam (attach at export, fingerprint-verified
+  consume at load) and a warm replica's warmup is compile-free;
+- an unwritable cache dir degrades to an uncached run with a warning —
+  never a crash (utils/compile_cache.py configure());
+- parallel bucket warmup preserves the warm-mark ordering and the
+  ``warmed_buckets`` accounting;
+- ``replica_ready.time_to_ready_s`` and the compile-cache verdicts surface
+  in ``telemetry-report``/``telemetry-top``, with cache-served compiles
+  counted apart from real recompiles (the zero-post-warmup contract stays
+  meaningful under a shared cache).
+"""
+
+import json
+import os
+import stat
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tensorflowdistributedlearning_tpu import obs
+from tensorflowdistributedlearning_tpu.obs.report import (
+    build_report,
+    render_report,
+)
+from tensorflowdistributedlearning_tpu.utils import compile_cache
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FEATURES = 6
+CLASSES = 3
+
+
+def _env(extra=None):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+    )
+    env.update(extra or {})
+    return env
+
+
+# -- cross-process persistent-cache round-trip -------------------------------
+
+_ROUNDTRIP_SCRIPT = """
+import json, sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+from tensorflowdistributedlearning_tpu.utils import compile_cache
+from tensorflowdistributedlearning_tpu.obs import Telemetry
+
+assert compile_cache.configure({cache_dir!r})
+import jax, jax.numpy as jnp
+
+tel = Telemetry({workdir!r}, run_info={{"kind": "cache-roundtrip"}})
+
+@jax.jit
+def f(x):
+    return jnp.tanh(x @ x.T).sum()
+
+@jax.jit
+def g(x):
+    return (x * 2.0 + 1.0).mean()
+
+jax.block_until_ready(f(jnp.ones((8, 8))))
+jax.block_until_ready(g(jnp.ones((16,))))
+tel.close()
+print(json.dumps(compile_cache.stats()))
+"""
+
+
+@pytest.fixture(scope="module")
+def cache_roundtrip(tmp_path_factory):
+    """Two fresh interpreters, same cache dir, same computation — the
+    second must LOAD. Shared by the ledger and report assertions."""
+    base = tmp_path_factory.mktemp("cc_roundtrip")
+    cache_dir = str(base / "cache")
+    runs = []
+    for i in (0, 1):
+        workdir = str(base / f"run{i}")
+        script = _ROUNDTRIP_SCRIPT.format(
+            repo=REPO, cache_dir=cache_dir, workdir=workdir
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", script], env=_env(), capture_output=True,
+            text=True, timeout=240,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        stats = json.loads(out.stdout.strip().splitlines()[-1])
+        runs.append({"workdir": workdir, "stats": stats})
+    return cache_dir, runs
+
+
+def test_second_interpreter_loads_from_cache(cache_roundtrip):
+    cache_dir, (cold, warm) = cache_roundtrip
+    # run 0 populated the cache (misses), run 1 consumed it (hits, 0 misses)
+    assert cold["stats"]["misses"] >= 2 and cold["stats"]["hits"] == 0
+    assert warm["stats"]["hits"] >= 2 and warm["stats"]["misses"] == 0
+    entries = compile_cache.fingerprint(cache_dir)["entries"]
+    assert entries >= 2
+
+
+def test_cache_verdicts_reach_the_ledger(cache_roundtrip):
+    _, (cold, warm) = cache_roundtrip
+    cold_events = obs.read_ledger(cold["workdir"])
+    warm_events = obs.read_ledger(warm["workdir"])
+
+    def compiles(events):
+        return [e for e in events if e.get("event") == "compile"]
+
+    # cache-consulted compiles are ALWAYS ledgered (the duration threshold
+    # would hide exactly the proof the cache works)
+    assert any(e.get("cache_hit") is False for e in compiles(cold_events))
+    warm_hits = [e for e in compiles(warm_events) if e.get("cache_hit")]
+    assert warm_hits, "second run ledgered no cache hits"
+    # the second run did strictly fewer REAL compiles than the first
+    real = lambda evs: [e for e in compiles(evs) if not e.get("cache_hit")]
+    assert len(real(warm_events)) < len(real(cold_events))
+    # run_end totals carry the detector's exact counters
+    warm_end = [e for e in warm_events if e.get("event") == "run_end"][-1]
+    assert warm_end["compile_cache_hits"] >= 2
+    assert warm_end["compile_cache_misses"] == 0
+
+
+def test_report_renders_hit_ratio(cache_roundtrip):
+    _, (_, warm) = cache_roundtrip
+    report = build_report(warm["workdir"])
+    cc = report["compile_cache"]
+    assert cc["hits"] >= 2 and cc["misses"] == 0
+    assert cc["hit_ratio"] == 1.0
+    text = render_report(report)
+    assert "compile cache:" in text
+    assert "100% served from cache" in text
+
+
+# -- degradation: unwritable cache dir ---------------------------------------
+
+
+def test_unwritable_cache_dir_degrades_uncached(tmp_path, caplog):
+    ro = tmp_path / "ro"
+    ro.mkdir()
+    os.chmod(ro, stat.S_IRUSR | stat.S_IXUSR)
+    try:
+        if os.access(str(ro / "probe"), os.W_OK) or os.getuid() == 0:
+            pytest.skip("running as root — read-only dirs are writable")
+        before = compile_cache.active_dir()
+        with caplog.at_level("WARNING"):
+            assert compile_cache.configure(str(ro)) is False
+        assert compile_cache.active_dir() == before  # untouched, not crashed
+        assert any("UNCACHED" in r.message for r in caplog.records)
+    finally:
+        os.chmod(ro, stat.S_IRWXU)
+
+
+def test_configure_none_is_a_noop():
+    before = compile_cache.active_dir()
+    assert compile_cache.configure(None) is False
+    assert compile_cache.active_dir() == before
+
+
+# -- artifact cache subdir: attach -> fingerprint -> consume -----------------
+
+
+@pytest.fixture(scope="module")
+def serve_fn():
+    import jax
+    import jax.numpy as jnp
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (FEATURES, CLASSES)) * 0.3
+
+    @jax.jit
+    def fn(x):
+        logits = x @ w
+        return {
+            "probabilities": jax.nn.softmax(logits, axis=-1),
+            "class": jnp.argmax(logits, axis=-1),
+        }
+
+    return fn
+
+
+@pytest.fixture(scope="module")
+def cached_artifact(tmp_path_factory, serve_fn):
+    """An exported artifact with its compile cache attached through the
+    real seam (train/serving.py attach_compile_cache)."""
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    directory = str(tmp_path_factory.mktemp("artifact") / "art")
+    serving_lib.export_serving_artifact(serve_fn, (1, FEATURES), directory)
+    section = serving_lib.attach_compile_cache(directory, buckets=(1, 4))
+    return directory, section
+
+
+def test_attach_stamps_manifest_fingerprint(cached_artifact):
+    from tensorflowdistributedlearning_tpu.serve.engine import (
+        ARTIFACT_CACHE_SUBDIR,
+    )
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    directory, section = cached_artifact
+    assert section["subdir"] == ARTIFACT_CACHE_SUBDIR
+    assert section["entries"] >= 1
+    assert section["buckets"] == [1, 4]
+    sub = os.path.join(directory, ARTIFACT_CACHE_SUBDIR)
+    assert os.path.isdir(sub)
+    manifest = serving_lib.read_manifest(directory)
+    assert manifest["compile_cache"]["fingerprint"] == section["fingerprint"]
+    # the attach must NOT leave the process writing into the artifact
+    assert compile_cache.active_dir() != sub
+
+
+_LOAD_SCRIPT = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from tensorflowdistributedlearning_tpu.utils import compile_cache
+assert compile_cache.configure({cache_dir!r})
+from tensorflowdistributedlearning_tpu.serve.engine import InferenceEngine
+eng = InferenceEngine.from_artifact({artifact!r}, buckets=(1, 4))
+timings = eng.warmup()
+print(json.dumps({{
+    "stats": compile_cache.stats(),
+    "warmed": sorted(eng.warmed_buckets),
+    "timings": {{str(k): v for k, v in timings.items()}},
+}}))
+"""
+
+
+def _load_replica(artifact: str, cache_dir: str) -> dict:
+    script = _LOAD_SCRIPT.format(
+        repo=REPO, cache_dir=cache_dir, artifact=artifact
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", script], env=_env(), capture_output=True,
+        text=True, timeout=240,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_warm_artifact_load_is_compile_free(cached_artifact, tmp_path):
+    directory, _ = cached_artifact
+    res = _load_replica(directory, str(tmp_path / "replica_cache"))
+    # every warmup compile answered from the shipped entries
+    assert res["warmed"] == [1, 4]
+    assert res["stats"]["hits"] >= 2
+    assert res["stats"]["misses"] == 0
+
+
+def test_cold_artifact_load_compiles(cached_artifact, tmp_path):
+    import shutil
+
+    from tensorflowdistributedlearning_tpu.serve.engine import (
+        ARTIFACT_CACHE_SUBDIR,
+    )
+
+    directory, _ = cached_artifact
+    bare = str(tmp_path / "bare_artifact")
+    shutil.copytree(directory, bare)
+    shutil.rmtree(os.path.join(bare, ARTIFACT_CACHE_SUBDIR))
+    res = _load_replica(bare, str(tmp_path / "replica_cache"))
+    assert res["warmed"] == [1, 4]
+    assert res["stats"]["misses"] >= 2
+    assert res["stats"]["hits"] == 0
+
+
+def test_torn_shipped_cache_is_refused(cached_artifact, tmp_path, caplog):
+    """A shipped cache whose fingerprint mismatches the manifest (truncated
+    copy, mixed artifact) is skipped — warmup compiles, serving proceeds."""
+    import shutil
+
+    from tensorflowdistributedlearning_tpu.serve.engine import (
+        ARTIFACT_CACHE_SUBDIR,
+        consume_artifact_cache,
+    )
+    from tensorflowdistributedlearning_tpu.train import serving as serving_lib
+
+    directory, _ = cached_artifact
+    torn = str(tmp_path / "torn_artifact")
+    shutil.copytree(directory, torn)
+    sub = os.path.join(torn, ARTIFACT_CACHE_SUBDIR)
+    entry = next(
+        os.path.join(root, f)
+        for root, _, files in os.walk(sub)
+        for f in files
+    )
+    with open(entry, "ab") as fh:
+        fh.write(b"torn")
+    manifest = serving_lib.read_manifest(torn)
+    with caplog.at_level("WARNING"):
+        assert consume_artifact_cache(torn, manifest) == 0
+    assert any("fingerprint" in r.message for r in caplog.records)
+
+
+# -- parallel warmup: ordering + accounting ----------------------------------
+
+
+def test_parallel_warmup_accounting_and_warm_mark(tmp_path, serve_fn):
+    from tensorflowdistributedlearning_tpu.obs import Telemetry
+    from tensorflowdistributedlearning_tpu.serve.engine import InferenceEngine
+
+    eng = InferenceEngine(serve_fn, (FEATURES,), buckets=(1, 4, 8))
+    tel = Telemetry(str(tmp_path), run_info={"kind": "serve"})
+    timings = eng.warmup(telemetry=tel)
+    assert set(timings) == {1, 4, 8}
+    assert eng.warmed and eng.warmed_buckets == {1, 4, 8}
+    assert all(t >= 0 for t in timings.values())
+    # the warm mark landed strictly after every bucket: steady-state traffic
+    # on warmed shapes triggers zero post-warmup recompiles
+    x = np.random.default_rng(0).normal(size=(3, FEATURES)).astype("float32")
+    eng.infer(x)
+    assert tel.detector.post_warmup_count == 0
+    tel.close()
+    events = obs.read_ledger(str(tmp_path))
+    warmup_events = [e for e in events if e.get("event") == "serve_warmup"]
+    assert len(warmup_events) == 1
+    assert sorted(warmup_events[0]["buckets"]) == ["1", "4", "8"]
+
+
+def test_deferred_warm_mark_for_multi_engine_load(tmp_path, serve_fn):
+    """mark_warm=False (the multi-engine registry path) must leave the
+    detector unarmed so a SECOND engine's warmup is not flagged."""
+    from tensorflowdistributedlearning_tpu.obs import Telemetry
+    from tensorflowdistributedlearning_tpu.serve.engine import InferenceEngine
+
+    tel = Telemetry(str(tmp_path), run_info={"kind": "serve"})
+    a = InferenceEngine(serve_fn, (FEATURES,), buckets=(1, 4))
+    a.warmup(telemetry=tel, mark_warm=False)
+    b = InferenceEngine(lambda x: {"y": x * 3.0}, (FEATURES,), buckets=(2,))
+    b.warmup(telemetry=tel, mark_warm=False)
+    assert tel.detector.post_warmup_count == 0
+    tel.mark_warm()
+    tel.close()
+
+
+# -- replica time_to_ready_s + compile split in report/top -------------------
+
+
+def test_replica_ttr_surfaces_in_report_and_top(tmp_path):
+    from tensorflowdistributedlearning_tpu.obs import fleet as fleet_lib
+    from tensorflowdistributedlearning_tpu.obs import top as top_lib
+
+    ledger = obs.RunLedger(str(tmp_path))
+    ledger.event("run_header", schema_version=1, kind="serve-fleet")
+    ledger.event("replica_spawn", replica=0, port=9001)
+    ledger.event("replica_ready", replica=0, port=9001, time_to_ready_s=6.4)
+    ledger.event("replica_spawn", replica=1, port=9002)
+    ledger.event("replica_ready", replica=1, port=9002, time_to_ready_s=1.6)
+    ledger.close()
+
+    report = build_report(str(tmp_path))
+    ttr = report["serve_fleet"]["replicas"]["time_to_ready_s"]
+    assert ttr["count"] == 2
+    assert ttr["mean"] == 4.0
+    assert ttr["max"] == 6.4
+    assert ttr["last"] == 1.6
+    text = render_report(report)
+    assert "replica time-to-ready" in text
+
+    led = fleet_lib.discover_ledgers(str(tmp_path))[0]
+    row = top_lib._process_status(led, now=led.events[-1]["t"] + 1)
+    assert row["last_replica_ready"]["time_to_ready_s"] == 1.6
+    assert row["last_replica_ready"]["replica"] == 1
+
+
+def test_cache_served_compiles_split_from_recompiles(tmp_path):
+    """The satellite bugfix: a post-warmup compile the persistent cache
+    answered is a LOAD — it must not trip the recompile alarm, but it must
+    stay visible."""
+    ledger = obs.RunLedger(str(tmp_path))
+    ledger.event("run_header", schema_version=1, task="classification")
+    ledger.event(
+        "compile", duration_s=0.002, phase="train", post_warmup=True,
+        cache_hit=True, saved_s=0.5,
+    )
+    ledger.event(
+        "compile", duration_s=1.25, phase="train", post_warmup=True,
+        cache_hit=False,
+    )
+    ledger.close()
+    report = build_report(str(tmp_path))
+    rc = report["recompiles"]
+    assert rc["post_warmup_count"] == 1  # the REAL rebuild only
+    assert rc["cache_served_post_warmup"] == 1
+    assert rc["post_warmup_s"] == 1.25
+    # no run_end totals here: the section falls back to ledgered verdicts
+    cc = report["compile_cache"]
+    assert cc == {"hits": 1, "misses": 1, "hit_ratio": 0.5, "saved_s": 0.5}
+    text = render_report(report)
+    assert "1 POST-WARMUP RECOMPILE(S)" in text
+    assert "served from the persistent cache" in text

@@ -102,9 +102,12 @@ def run_elastic_drill(
     zero1: bool = True,
     drain_timeout: float = 30.0,
     timeout: int = 600,
+    extra_argv: Optional[List[str]] = None,
 ) -> Dict:
     """The headline drill through the real CLI. Returns the measured facts;
-    raises RuntimeError when the run itself failed."""
+    raises RuntimeError when the run itself failed. ``extra_argv`` appends
+    drill variations (bench_coldstart reuses this for --compile-cache-dir /
+    --aot-standby runs)."""
     argv = [
         sys.executable, "-m", "tensorflowdistributedlearning_tpu", "fit",
         "--preset", PRESET,
@@ -121,6 +124,8 @@ def run_elastic_drill(
     ]
     if zero1:
         argv.append("--weight-update-sharding")
+    if extra_argv:
+        argv.extend(extra_argv)
     t0 = time.time()
     out = subprocess.run(
         argv, env=_env(devices_per_host), capture_output=True, text=True,

@@ -659,6 +659,106 @@ def check_promotion(
     return out
 
 
+# cold-start gates (BENCH_COLDSTART.json, tools/bench_coldstart.py): the
+# warm/cold ratios are dimensionless and transfer across machines; the
+# settle comparison is an absolute delta because the elastic coordinator's
+# settle time is quantized by its ~2s poll interval (a ratio gate flaps on
+# one tick)
+DEFAULT_COLDSTART_REPLICA_RATIO = 0.5
+DEFAULT_COLDSTART_RERUN_RATIO = 0.9
+DEFAULT_COLDSTART_SETTLE_DELTA_S = 4.0
+
+
+def check_coldstart(
+    baseline: Dict,
+    fresh: Optional[Dict] = None,
+    *,
+    max_replica_ratio: float = DEFAULT_COLDSTART_REPLICA_RATIO,
+    max_rerun_ratio: float = DEFAULT_COLDSTART_RERUN_RATIO,
+    settle_delta_s: float = DEFAULT_COLDSTART_SETTLE_DELTA_S,
+) -> List[Dict]:
+    """Replay the committed BENCH_COLDSTART.json hard gates
+    (tools/bench_coldstart.py output shape). Like elastic, the drill spawns
+    real multi-process worlds and full train runs — too heavy for every CI
+    invocation — so the default mode REPLAYS the committed record: the
+    second same-shape train run must have LEDGERED cache hits and a reduced
+    time-to-first-step; a replica loading the artifact's shipped cache
+    subdir must go ready in <= half the cold time with >= 1 hit; the elastic
+    drill with ``--aot-standby`` must still resume bit-identical, must have
+    actually started a standby that ended ready/superseded, and must not
+    settle slower than the no-standby drill by more than the poll-quantized
+    slack. A cold-start-path PR must re-run the bench and commit numbers
+    that still clear these. ``--fresh-coldstart`` gates a fresh record
+    instead."""
+    record = fresh if fresh is not None else baseline
+    out: List[Dict] = []
+    rerun = record.get("train_rerun") or {}
+    out.append(_finding(
+        "coldstart", "train_rerun.warm_cache_hits", ">= 1",
+        rerun.get("warm_cache_hits"),
+        ">= 1 (second same-shape run must ledger cache hits, hard)",
+        (rerun.get("warm_cache_hits") or 0) >= 1,
+    ))
+    ratio = rerun.get("warm_over_cold")
+    out.append(_finding(
+        "coldstart", "train_rerun.warm_over_cold", max_rerun_ratio, ratio,
+        f"<= {max_rerun_ratio} (rerun time-to-first-step must shrink)",
+        ratio is not None and ratio <= max_rerun_ratio,
+    ))
+    replica = record.get("replica") or {}
+    out.append(_finding(
+        "coldstart", "replica.warm_hits", ">= 1", replica.get("warm_hits"),
+        ">= 1 (the shipped artifact cache must be consumed, hard)",
+        (replica.get("warm_hits") or 0) >= 1,
+    ))
+    r_ratio = replica.get("warm_over_cold")
+    out.append(_finding(
+        "coldstart", "replica.warm_over_cold", max_replica_ratio, r_ratio,
+        f"<= {max_replica_ratio} (warm replica time-to-ready, the "
+        "ISSUE acceptance bar)",
+        r_ratio is not None and r_ratio <= max_replica_ratio,
+    ))
+    elastic = record.get("elastic_standby") or {}
+    out.append(_finding(
+        "coldstart", "elastic_standby.bit_identical_resume", True,
+        elastic.get("bit_identical_resume"),
+        "== true (AOT standby must not perturb the resumed math, hard)",
+        bool(elastic.get("bit_identical_resume")),
+    ))
+    sb = elastic.get("standby") or {}
+    out.append(_finding(
+        "coldstart", "elastic_standby.standby.started", True,
+        sb.get("standby_started"),
+        "== true (the drill must actually spawn a standby, hard)",
+        bool(sb.get("standby_started")),
+    ))
+    out.append(_finding(
+        "coldstart", "elastic_standby.standby.outcome",
+        "ready | superseded", sb.get("standby_outcome"),
+        "in (ready, superseded) — superseded means reaped at drain with "
+        "its entries already on disk",
+        sb.get("standby_outcome") in ("ready", "superseded"),
+    ))
+    out.append(_finding(
+        "coldstart", "elastic_standby.standby.post_resize_cache_hits",
+        ">= 1", sb.get("post_resize_cache_hits"),
+        ">= 1 (the resized world must consume the standby's entries)",
+        (sb.get("post_resize_cache_hits") or 0) >= 1,
+    ))
+    ns_settle = (elastic.get("nostandby") or {}).get("post_resize_settle_s")
+    sb_settle = sb.get("post_resize_settle_s")
+    if ns_settle is not None and sb_settle is not None:
+        out.append(_finding(
+            "coldstart", "elastic_standby.settle_delta_s",
+            f"<= {settle_delta_s}", round(sb_settle - ns_settle, 3),
+            f"standby settle - no-standby settle <= {settle_delta_s}s "
+            "(a standby competing with the respawn instead of pre-warming "
+            "it measured +6s before the drain-time reap)",
+            sb_settle - ns_settle <= settle_delta_s,
+        ))
+    return out
+
+
 DEFAULT_LOOP_CYCLE_CEILING_S = 300.0
 DEFAULT_LOOP_TRIGGER_LATENCY_CEILING_S = 30.0
 
@@ -806,7 +906,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "gate)")
     parser.add_argument("--benches",
                         default="async,serve,fleet,records,promotion,"
-                        "multitenant,plan,elastic,profile,loop",
+                        "multitenant,plan,elastic,profile,loop,coldstart",
                         help="comma-separated subset to check")
     parser.add_argument("--baseline-async",
                         default=os.path.join(REPO, "BENCH_ASYNC.json"))
@@ -822,6 +922,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                         default=os.path.join(REPO, "BENCH_PROFILE.json"))
     parser.add_argument("--baseline-loop",
                         default=os.path.join(REPO, "BENCH_LOOP.json"))
+    parser.add_argument("--baseline-coldstart",
+                        default=os.path.join(REPO, "BENCH_COLDSTART.json"))
+    parser.add_argument("--fresh-coldstart", default=None, metavar="JSON",
+                        help="pre-computed tools/bench_coldstart.py output "
+                        "(default: replay the committed baseline's gates, "
+                        "like the elastic section)")
+    parser.add_argument("--coldstart-replica-ratio", type=float,
+                        default=DEFAULT_COLDSTART_REPLICA_RATIO,
+                        help="warm/cold replica time-to-ready ceiling on "
+                        "the cold-start bench record (dimensionless; the "
+                        "ISSUE acceptance bar)")
+    parser.add_argument("--coldstart-rerun-ratio", type=float,
+                        default=DEFAULT_COLDSTART_RERUN_RATIO,
+                        help="warm/cold train time-to-first-step ceiling "
+                        "on the cold-start bench record (dimensionless)")
     parser.add_argument("--fresh-loop", default=None, metavar="JSON",
                         help="pre-computed tools/bench_loop.py output "
                         "(default: replay the committed baseline's gates, "
@@ -998,6 +1113,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         except (OSError, ValueError) as e:
             errors.append(f"loop: {e}")
+    if "coldstart" in benches:
+        try:
+            baseline = _load(args.baseline_coldstart)
+            fresh = (
+                _load(args.fresh_coldstart) if args.fresh_coldstart else None
+            )
+            findings += check_coldstart(
+                baseline, fresh,
+                max_replica_ratio=args.coldstart_replica_ratio,
+                max_rerun_ratio=args.coldstart_rerun_ratio,
+            )
+        except (OSError, ValueError) as e:
+            errors.append(f"coldstart: {e}")
     if "records" in benches:
         try:
             baseline = _load(args.baseline_records)
