@@ -199,6 +199,108 @@ def bench_fused_bn_act(
     return results
 
 
+def bench_quant(
+    batch: int = 64,
+    features: int = 1024,
+    hw: int = 13,
+    conv_channels: int = 128,
+    mask_hw: int = 101,
+    iters: int = 30,
+    warmup: int = 5,
+    repeats: int = 64,
+) -> Dict:
+    """int8-compute kernels vs their dequantize-f32 XLA twins at the serving
+    shapes (the quant model's dense width; the seg head's mask). On TPU the
+    Pallas column is the real int8 x int8 -> int32 MXU kernel and the gate is
+    a speedup floor; off-TPU ``int8_matmul``/``int8_conv2d`` auto-dispatch TO
+    the reference, so the honest CPU column is a dispatch-overhead tripwire
+    (ratio pinned ~1.0) — never the minutes-per-call interpreter. Weights are
+    square / channel-preserving so the chained harness can feed outputs back
+    as inputs."""
+    import jax
+    import numpy as np
+
+    from tensorflowdistributedlearning_tpu.ops.pallas_kernels import (
+        fused_sigmoid_mask,
+        fused_sigmoid_mask_reference,
+    )
+    from tensorflowdistributedlearning_tpu.ops.quant_kernels import (
+        int8_conv2d,
+        int8_conv2d_reference,
+        int8_matmul,
+        int8_matmul_reference,
+    )
+    from tensorflowdistributedlearning_tpu.train.quantize import quantize_pytree
+
+    rng = np.random.default_rng(3)
+
+    def qweight(shape):
+        qtree, _ = quantize_pytree(
+            {"m": {"kernel": rng.normal(0, 0.5, shape).astype(np.float32)}},
+            "int8",
+        )
+        rec = qtree["m"]["kernel"]
+        return jax.device_put(rec["q"]), jax.device_put(rec["scale"])
+
+    results: Dict = {}
+    wins = 0
+
+    x = jax.device_put(
+        rng.normal(0, 1, (batch, features)).astype(np.float32)
+    )
+    wq, ws = qweight((features, features))
+    mm_pallas, mm_xla, mm_speedup = _paired_us(
+        lambda a: int8_matmul(a, wq, ws, act="relu"),
+        lambda a: int8_matmul_reference(a, wq, ws, act="relu"),
+        (x,), max(2, iters // 10), warmup, repeats=repeats,
+    )
+    results["matmul"] = {
+        "pallas_us": round(mm_pallas, 1),
+        "xla_us": round(mm_xla, 1),
+        "speedup": round(mm_speedup, 3),
+        "shape": [batch, features, features],
+    }
+    wins += mm_speedup > 1.0
+
+    xc = jax.device_put(
+        rng.normal(0, 1, (8, hw, hw, conv_channels)).astype(np.float32)
+    )
+    cq, cs = qweight((3, 3, conv_channels, conv_channels))
+    cv_pallas, cv_xla, cv_speedup = _paired_us(
+        lambda a: int8_conv2d(a, cq, cs, padding="SAME", act="relu"),
+        lambda a: int8_conv2d_reference(a, cq, cs, padding="SAME", act="relu"),
+        (xc,), max(2, iters // 10), warmup, repeats=repeats,
+    )
+    results["conv"] = {
+        "pallas_us": round(cv_pallas, 1),
+        "xla_us": round(cv_xla, 1),
+        "speedup": round(cv_speedup, 3),
+        "shape": [8, hw, hw, conv_channels],
+    }
+    wins += cv_speedup > 1.0
+
+    logits = jax.device_put(
+        rng.normal(0, 2, (8, mask_hw, mask_hw, 1)).astype(np.float32)
+    )
+    # both outputs consumed (p + m is shape/dtype-preserving for the chain)
+    # so neither side can dead-code the mask
+    sm_pallas, sm_xla, sm_speedup = _paired_us(
+        lambda a: (lambda p, m: p + m)(*fused_sigmoid_mask(a, 0.5)),
+        lambda a: (lambda p, m: p + m)(*fused_sigmoid_mask_reference(a, 0.5)),
+        (logits,), max(2, iters // 10), warmup, repeats=repeats,
+    )
+    results["sigmoid_mask"] = {
+        "pallas_us": round(sm_pallas, 1),
+        "xla_us": round(sm_xla, 1),
+        "speedup": round(sm_speedup, 3),
+        "shape": [8, mask_hw, mask_hw, 1],
+    }
+    wins += sm_speedup > 1.0
+
+    results["pallas_wins"] = bool(wins >= 2)
+    return results
+
+
 def bench_attention(
     batch: int = 32,
     heads: int = 6,
@@ -331,6 +433,13 @@ def main() -> None:
                                 repeats=2)
     bn["platform"] = jax.default_backend()
     print(json.dumps({"fused_bn_act": bn}), flush=True)
+    if jax.default_backend() == "tpu":
+        qk = bench_quant()
+    else:
+        qk = bench_quant(batch=4, features=32, hw=5, conv_channels=8,
+                         mask_hw=9, iters=2, warmup=1, repeats=2)
+    qk["platform"] = jax.default_backend()
+    print(json.dumps({"quant_kernels": qk}), flush=True)
     if jax.default_backend() == "tpu":
         attn = bench_attention()
     else:

@@ -302,14 +302,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "standalone StableHLO serving artifact next to its "
                          "checkpoint ({fold_dir}/export/serving)")
     p_train.add_argument("--serving-dtype",
-                         choices=("float32", "bfloat16", "int8"),
+                         choices=("float32", "bfloat16", "int8",
+                                  "int8-compute"),
                          default="float32",
-                         help="post-training precision recipe for "
+                         help="post-training precision spec for "
                          "--export-serving (train/quantize.py): bfloat16 "
                          "casts params at export, int8 stores conv/dense "
                          "kernels as int8 with per-channel symmetric scales "
-                         "(activations bf16); quantized exports land in "
-                         "export/serving-{dtype} beside the float32 "
+                         "(activations bf16), int8-compute stores the same "
+                         "bytes and runs the matmul/conv arithmetic in int8 "
+                         "via the quant kernels; quantized exports land in "
+                         "export/serving-{spec} beside the float32 "
                          "reference and must pass quantize-check to ship")
     _add_auto_promote(p_train)
     _add_planner(p_train)
@@ -414,11 +417,13 @@ def build_parser() -> argparse.ArgumentParser:
                        "drift_baseline (output distribution over the pinned "
                        "eval batch) into the manifest")
     p_fit.add_argument("--serving-dtype",
-                       choices=("float32", "bfloat16", "int8"),
+                       choices=("float32", "bfloat16", "int8",
+                                "int8-compute"),
                        default="float32",
-                       help="post-training precision recipe for "
+                       help="post-training precision spec for "
                        "--export-serving (quantized exports land in "
-                       "export/serving-{dtype})")
+                       "export/serving-{spec}; int8-compute runs real int8 "
+                       "matmul/conv arithmetic via ops/quant_kernels.py)")
     _add_auto_promote(p_fit)
     _add_planner(p_fit)
     _add_host_loop(p_fit)

@@ -253,6 +253,20 @@ _BN_ACTIVATIONS = {
 }
 
 
+def bias_act_epilogue(y, bias=None, act: str = "none"):
+    """The shared f32 epilogue: ``act(y + bias)``. ONE copy of the
+    bias-then-activate tail used by :func:`fused_bias_act`'s kernel body,
+    both quant-kernel epilogues (ops/quant_kernels.py fuses it after the
+    int32->f32 scale application), and their XLA references — so a kernel
+    and its oracle can never disagree about the tail math. ``y`` is f32;
+    ``bias`` broadcasts over the leading dims (``None`` skips the add)."""
+    if act not in _BN_ACTIVATIONS:
+        raise ValueError(f"act {act!r} not in {sorted(_BN_ACTIVATIONS)}")
+    if bias is not None:
+        y = y + bias
+    return _BN_ACTIVATIONS[act](y)
+
+
 def _fold_bn(scale, bias, mean, var, eps):
     """Inference BN as per-channel affine: ``y = x*m + b`` with
     ``m = scale*rsqrt(var+eps)``, ``b = bias - mean*m``. Folded in float32 —
@@ -391,3 +405,177 @@ def fused_bn_act(
         out_shape=out_shape,
         interpret=interpret,
     )(*operands)
+
+
+# -- fused bias + activation (the reusable epilogue) --------------------------
+
+
+def fused_bias_act_reference(
+    x: jax.Array, bias: Optional[jax.Array] = None, *, act: str = "none"
+) -> jax.Array:
+    """XLA oracle/fallback: ``act(x + bias)`` with f32 internal math, output
+    in ``x``'s dtype. ``bias``: [C] over the last axis (or ``None``)."""
+    y = bias_act_epilogue(
+        x.astype(jnp.float32),
+        None if bias is None else bias.astype(jnp.float32),
+        act,
+    )
+    return y.astype(x.dtype)
+
+
+def _fused_bias_act_kernel(x_ref, b_ref, o_ref, *, act: str):
+    y = bias_act_epilogue(x_ref[...].astype(jnp.float32), b_ref[...], act)
+    o_ref[...] = y.astype(o_ref.dtype)
+
+
+def fused_sigmoid_mask_reference(
+    logits: jax.Array, threshold: float
+) -> tuple:
+    """XLA oracle/fallback — literally the unfused segmentation head
+    (train/step.py SegmentationTask.predictions): probabilities in the
+    logits dtype, binary mask as float32. The fused kernel must stay
+    BIT-IDENTICAL to this, so the ops here are the contract."""
+    probs = jax.nn.sigmoid(logits)
+    return probs, (probs > threshold).astype(jnp.float32)
+
+
+def _sigmoid_mask_kernel(x_ref, p_ref, m_ref, *, threshold: float):
+    # the same two ops as the reference, in the same dtype — one HBM read
+    # feeding BOTH outputs is the entire win; any "optimization" of the
+    # math here would break the bit-identity contract
+    p = jax.nn.sigmoid(x_ref[...])
+    p_ref[...] = p
+    m_ref[...] = (p > threshold).astype(jnp.float32)
+
+
+def fused_bias_act(
+    x: jax.Array,
+    bias: Optional[jax.Array] = None,
+    *,
+    act: str = "none",
+    interpret: Optional[bool] = None,
+    vmem_limit_bytes: int = _VMEM_BLOCK_LIMIT_BYTES,
+) -> jax.Array:
+    """Fused per-channel bias + activation over the last axis, Pallas where
+    it fits: one read and one write of ``x`` instead of XLA's
+    add-then-activate pair when the fusion boundary splits them. This is the
+    standalone face of :func:`bias_act_epilogue` — the quantized matmul/conv
+    kernels (ops/quant_kernels.py) fuse the identical tail after their
+    int32->f32 scale application, so the epilogue math has exactly one home.
+
+    ``x``: [..., C]; ``bias``: [C] or ``None``. INFERENCE-ONLY (no VJP).
+    ``interpret=None`` auto-selects compiled Pallas on TPU and the XLA
+    reference off-TPU (the interpreter is for tests, not the hot path);
+    falls back to the reference when a row block exceeds the VMEM budget or
+    under shard_map's interpreter restriction.
+    """
+    if act not in _BN_ACTIVATIONS:
+        raise ValueError(f"act {act!r} not in {sorted(_BN_ACTIVATIONS)}")
+    c = x.shape[-1]
+    if bias is not None and bias.shape != (c,):
+        raise ValueError(f"bias must be [{c}] to match x's last axis, got {bias.shape}")
+    if x.ndim < 2:
+        return fused_bias_act_reference(x, bias, act=act)
+    if interpret is None:
+        interpret = not pallas_platform_ok()
+        if interpret:
+            return fused_bias_act_reference(x, bias, act=act)
+    if interpret and vma_of(x):
+        return fused_bias_act_reference(x, bias, act=act)
+    x2 = x.reshape(-1, c)
+    r = x2.shape[0]
+    itemsize = jnp.dtype(x.dtype).itemsize
+    rt = r
+    while rt > 1 and rt % 2 == 0 and rt * c * (itemsize + 4) > vmem_limit_bytes:
+        rt //= 2
+    if rt * c * (itemsize + 4) > vmem_limit_bytes:
+        return fused_bias_act_reference(x, bias, act=act)
+    b32 = (
+        jnp.zeros((1, c), jnp.float32)
+        if bias is None
+        else bias.astype(jnp.float32).reshape(1, c)
+    )
+    vma = vma_of(x)
+    out_shape = (
+        jax.ShapeDtypeStruct(x2.shape, x.dtype, vma=vma)
+        if vma
+        else jax.ShapeDtypeStruct(x2.shape, x.dtype)
+    )
+    out = pl.pallas_call(
+        functools.partial(_fused_bias_act_kernel, act=act),
+        grid=(r // rt,),
+        in_specs=[
+            pl.BlockSpec((rt, c), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rt, c), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(x2, b32)
+    return out.reshape(x.shape)
+
+
+# -- fused sigmoid + threshold mask head --------------------------------------
+
+
+def fused_sigmoid_mask(
+    logits: jax.Array,
+    threshold: float,
+    *,
+    interpret: Optional[bool] = None,
+    vmem_limit_bytes: int = _VMEM_BLOCK_LIMIT_BYTES,
+) -> tuple:
+    """Fused segmentation serve head: ``(sigmoid(logits),
+    (sigmoid(logits) > threshold).float32)`` from ONE pass over the logits.
+
+    The unfused head reads the logits to build probs, writes probs, then
+    reads probs again to build the mask — three HBM traversals of an
+    [B, H, W, 1] tensor for two elementwise ops. The kernel reads each
+    logits block once and emits both outputs while it is VMEM-resident.
+
+    BIT-IDENTITY CONTRACT: outputs are bitwise equal to
+    :func:`fused_sigmoid_mask_reference` (the literal unfused ops, which is
+    what SegmentationTask.predictions computes) — the kernel runs the same
+    sigmoid in the same dtype, so fusing is a memory-traffic change, not a
+    numerics change. Enforced by tests/test_pallas_kernels.py.
+
+    INFERENCE-ONLY (no VJP). ``interpret=None`` auto-selects compiled
+    Pallas on TPU and the XLA reference off-TPU; ``interpret=True`` runs
+    the kernel body interpreted (tests). Falls back to the reference when
+    an image block exceeds the VMEM budget, for rank<2 inputs, or under
+    shard_map's interpreter restriction.
+    """
+    if logits.ndim < 2:
+        return fused_sigmoid_mask_reference(logits, threshold)
+    if interpret is None:
+        interpret = not pallas_platform_ok()
+        if interpret:
+            return fused_sigmoid_mask_reference(logits, threshold)
+    if interpret and vma_of(logits):
+        return fused_sigmoid_mask_reference(logits, threshold)
+    b = logits.shape[0]
+    rest = 1
+    for d in logits.shape[1:]:
+        rest *= d
+    itemsize = jnp.dtype(logits.dtype).itemsize
+    # in-block + probs-block + f32 mask-block resident together
+    if rest * (2 * itemsize + 4) > vmem_limit_bytes:
+        return fused_sigmoid_mask_reference(logits, threshold)
+    x2 = logits.reshape(b, rest)
+    vma = vma_of(logits)
+    def _sds(shape, dtype):
+        return (
+            jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+            if vma
+            else jax.ShapeDtypeStruct(shape, dtype)
+        )
+    spec = pl.BlockSpec((1, rest), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    probs, mask = pl.pallas_call(
+        functools.partial(_sigmoid_mask_kernel, threshold=threshold),
+        grid=(b,),
+        in_specs=[spec],
+        out_specs=[spec, spec],
+        out_shape=[_sds((b, rest), logits.dtype), _sds((b, rest), jnp.float32)],
+        interpret=interpret,
+    )(x2)
+    return probs.reshape(logits.shape), mask.reshape(logits.shape)

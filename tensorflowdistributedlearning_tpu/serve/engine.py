@@ -229,6 +229,19 @@ class InferenceEngine:
         )
 
     @property
+    def compute_dtype(self) -> Optional[str]:
+        """The manifest-declared matmul/conv arithmetic dtype
+        (``quantization.compute_dtype``, read_manifest-defaulted): "int8"
+        when the graph was traced through the quantized-compute kernels,
+        the float dtype for dequantize-in-graph/plain artifacts, ``None``
+        for raw closures with no quantization section. Informational — the
+        exported graph carries its own arithmetic; this is how telemetry
+        and the quantize-check gate know which budget applies."""
+        if not self.quantization:
+            return None
+        return self.quantization.get("compute_dtype")
+
+    @property
     def max_batch_size(self) -> int:
         return self.buckets[-1]
 
@@ -260,7 +273,12 @@ class InferenceEngine:
         if bufs is None:
             bufs = self._scratch.bufs = {}
         buf = bufs.get(bucket)
-        if buf is None:
+        if buf is None or buf.dtype != self.input_dtype:
+            # allocated in the ARTIFACT's wire dtype, never a float32
+            # default: an int8/bf16-input artifact padding through a f32
+            # scratch would silently upcast (and re-cast) every request
+            # batch before dispatch. The dtype recheck keeps a cached
+            # ladder from going stale if input_dtype is ever rebound.
             buf = bufs[bucket] = np.zeros(
                 (bucket, *self.example_shape), self.input_dtype
             )
@@ -305,10 +323,12 @@ class InferenceEngine:
         recompile.
 
         Buckets compile CONCURRENTLY (XLA releases the GIL for the whole
-        backend compile): ladder warmup costs ~the slowest bucket instead of
-        the sum. Each bucket joins ``warmed_buckets`` as its own compile
-        lands, and the detector's warm mark still happens strictly after
-        every bucket — the ordering contract is unchanged."""
+        backend compile) after the smallest bucket compiles alone — the
+        first-ever call through a loaded Exported must not race itself (see
+        the comment below), so ladder warmup costs ~smallest + slowest
+        instead of the sum. Each bucket joins ``warmed_buckets`` as its own
+        compile lands, and the detector's warm mark still happens strictly
+        after every bucket — the ordering contract is unchanged."""
         import jax
 
         to_warm = self.buckets
@@ -326,19 +346,35 @@ class InferenceEngine:
             jax.block_until_ready(self.serve_fn(x))
             return round(time.perf_counter() - t0, 6)
 
-        if len(to_warm) > 1:
+        if to_warm:
+            # The FIRST call must be alone: jax caches the jitted wrapper
+            # around a loaded Exported under an lru keyed on the exported
+            # object, and concurrent first-ever calls race its miss path —
+            # each builds its own wrapper, the bucket executables split
+            # across them, and only one wrapper survives in the cache. The
+            # survivor is then missing the other threads' shapes, so the
+            # first request on a "lost" bucket recompiles AFTER the warm
+            # mark — the exact goodput bug warmup exists to prevent
+            # (surfaced as a flaky post-warmup recompile under the full
+            # test sweep). Warming the smallest bucket synchronously
+            # populates the cache entry; the remaining buckets then share
+            # the one wrapper and still overlap their compiles.
+            timings[to_warm[0]] = _compile(to_warm[0])
+            self.warmed_buckets.add(to_warm[0])
+        rest = to_warm[1:]
+        if len(rest) > 1:
             from concurrent.futures import ThreadPoolExecutor, as_completed
 
             with ThreadPoolExecutor(
-                max_workers=len(to_warm), thread_name_prefix="warmup"
+                max_workers=len(rest), thread_name_prefix="warmup"
             ) as pool:
-                futures = {pool.submit(_compile, b): b for b in to_warm}
+                futures = {pool.submit(_compile, b): b for b in rest}
                 for fut in as_completed(futures):
                     b = futures[fut]
                     timings[b] = fut.result()
                     self.warmed_buckets.add(b)
         else:
-            for b in to_warm:
+            for b in rest:
                 timings[b] = _compile(b)
                 self.warmed_buckets.add(b)
         self.warmed = True
@@ -346,6 +382,10 @@ class InferenceEngine:
             warm_fields = {}
             if self.quantization is not None:
                 warm_fields["serving_dtype"] = self.quantization.get("dtype")
+                if self.quantization.get("compute_dtype"):
+                    warm_fields["compute_dtype"] = self.quantization[
+                        "compute_dtype"
+                    ]
             cold = [b for b in self.buckets if b not in self.warmed_buckets]
             if cold:
                 warm_fields["cold_buckets"] = [str(b) for b in cold]

@@ -59,7 +59,33 @@ DEFAULT_THRESHOLDS: Dict[str, Dict[str, float]] = {
         "min_iou": 0.95,
         "max_disagree": 0.05,
     },
+    # int8-COMPUTE adds dynamic per-tensor activation quantization on top of
+    # int8 weight storage: each quantized layer's inputs round to 8 bits, so
+    # the error budget is wider than weight-only int8. The comparison is
+    # still against the F32 REFERENCE artifact — not the dequantize-f32
+    # int8-store sibling — so kernel-arithmetic drift is caught at
+    # admission, on the same path that serves (the candidate's own traced
+    # graph, which also stamps the drift baseline).
+    "int8-compute": {
+        "max_abs_delta": 0.25,
+        "mean_abs_delta": 0.05,
+        "min_iou": 0.92,
+        "max_disagree": 0.08,
+    },
 }
+
+
+def budget_key(quantization: Optional[Dict]) -> str:
+    """Which DEFAULT_THRESHOLDS budget a manifest ``quantization`` section
+    gates under: the storage dtype, except int8 storage with int8 compute
+    gates under the wider ``int8-compute`` budget. The ONE place the
+    (dtype, compute_dtype) pair maps to a budget name — bench_serve's gate
+    table and the sentinel replay key off the same answer."""
+    q = quantization or {}
+    dtype = q.get("dtype", "float32")
+    if dtype == "int8" and q.get("compute_dtype") == "int8":
+        return "int8-compute"
+    return dtype
 
 
 def pinned_eval_batch(manifest: Dict, batch_size: int, seed: int = 0) -> np.ndarray:
@@ -199,8 +225,9 @@ def run_quant_check(
     Returns the verdict record (also ledgered as a ``quant_check`` event when
     ``telemetry`` is passed): per-output deltas, the thresholds applied, the
     failure list, and ``passed``. The candidate's precision — hence its
-    budget — comes from its own manifest's ``quantization.dtype`` (legacy
-    manifests gate as float32).
+    budget — comes from its own manifest's ``quantization`` section via
+    :func:`budget_key`: storage dtype, widened to ``int8-compute`` when the
+    manifest declares int8 arithmetic (legacy manifests gate as float32).
     """
     import jax
 
@@ -208,7 +235,7 @@ def run_quant_check(
 
     ref_manifest = serving_lib.read_manifest(reference_dir)
     cand_manifest = serving_lib.read_manifest(candidate_dir)
-    dtype = (cand_manifest.get("quantization") or {}).get("dtype", "float32")
+    dtype = budget_key(cand_manifest.get("quantization"))
     limits = dict(DEFAULT_THRESHOLDS.get(dtype, DEFAULT_THRESHOLDS["int8"]))
     if thresholds:
         limits.update({k: v for k, v in thresholds.items() if v is not None})

@@ -1019,9 +1019,12 @@ class ClassifierTrainer:
         of the K-fold Trainer's serving_fn (reference exported SavedModels via
         BestExporter, model.py:190-204). Honors ``data_format='NCHW'`` at the
         boundary exactly like the segmentation path, and the same
-        ``serving_dtype`` precision recipes (train/quantize.py): float32 wire
-        contract either way, quantized constants inside; the closure carries
-        its manifest section as ``serve.quantization``."""
+        ``serving_dtype`` precision specs (train/quantize.py SERVING_SPECS,
+        including ``int8-compute`` which traces dense/conv layers through the
+        quantized-compute kernels): float32 wire contract either way,
+        quantized constants inside; the closure carries its manifest section
+        as ``serve.quantization``."""
+        from tensorflowdistributedlearning_tpu.ops import quant_kernels
         from tensorflowdistributedlearning_tpu.train import quantize
         from tensorflowdistributedlearning_tpu.train.trainer import _forward_cached
 
@@ -1035,6 +1038,7 @@ class ClassifierTrainer:
             state.params, state.batch_stats, serving_dtype
         )
         act_dtype = quantize.compute_dtype(serving_dtype)
+        int8_compute = quant_section.get("compute_dtype") == "int8"
         task = self.task
         forward = _forward_cached(self._plain_model)
         nchw = self.train_config.data_format == "NCHW"
@@ -1046,7 +1050,17 @@ class ClassifierTrainer:
                 params=quantize.dequantize_pytree(qparams, act_dtype),
                 batch_stats=quantize.dequantize_pytree(qstats, act_dtype),
             )
-            out = task.predictions(forward(st, images.astype(act_dtype)))
+            x = images.astype(act_dtype)
+            if int8_compute:
+                # trace the forward under the interceptor: quantized layers
+                # take the int8-compute kernels, the rest keep the
+                # dequantized-float path (qparams records are shared with
+                # dequantize_pytree above, so the int8 constants serialize once)
+                with quant_kernels.int8_intercept(qparams, act_dtype):
+                    logits = forward(st, x)
+            else:
+                logits = forward(st, x)
+            out = task.serve_predictions(logits)
             return quantize.cast_outputs_float32(out)
 
         serve.quantization = quant_section

@@ -39,6 +39,25 @@ import numpy as np
 
 SERVING_DTYPES = ("float32", "bfloat16", "int8")
 
+# Serving SPECS are what the export CLI accepts: the storage dtypes plus
+# "int8-compute", which stores int8 LIKE "int8" but additionally declares
+# int8 *arithmetic* — the serving closure routes dense/conv layers through
+# the quantized-compute kernels (ops/quant_kernels.py) instead of
+# dequantizing to bf16 and paying floating-point matmuls. The manifest
+# section records the split as (dtype="int8", compute_dtype="int8"): storage
+# and compute are separate axes, storage names the bytes at rest, compute
+# names the matmul arithmetic.
+SERVING_SPECS = SERVING_DTYPES + ("int8-compute",)
+
+# manifest compute_dtype per storage dtype when the spec doesn't say
+# otherwise — the pre-compute_dtype behaviour, which legacy manifests
+# (no compute_dtype field) get by default
+_DEFAULT_COMPUTE = {
+    "float32": "float32",
+    "bfloat16": "bfloat16",
+    "int8": "bfloat16",  # PR-6 dequantize-in-graph: int8 bytes, bf16 math
+}
+
 # the int8 recipe quantizes exactly the matmul/conv weights; the leaf name is
 # the flax convention shared by nn.Conv / nn.Dense / DepthwiseConv2D
 _KERNEL_LEAF = "kernel"
@@ -58,12 +77,38 @@ def check_serving_dtype(serving_dtype: str) -> str:
     return serving_dtype
 
 
-def compute_dtype(serving_dtype: str):
-    """The activation dtype a serving graph runs in for a given recipe."""
+def check_serving_spec(spec: str) -> str:
+    if spec not in SERVING_SPECS:
+        raise ValueError(f"serving spec {spec!r} not in {SERVING_SPECS}")
+    return spec
+
+
+def parse_serving_spec(spec: str) -> Tuple[str, str]:
+    """Split a serving spec into its two axes: ``(storage_dtype,
+    compute_dtype)``. ``"int8-compute"`` -> ``("int8", "int8")``; the plain
+    dtypes keep their historical compute (f32/bf16/bf16-dequantized)."""
+    check_serving_spec(spec)
+    if spec == "int8-compute":
+        return "int8", "int8"
+    return spec, _DEFAULT_COMPUTE[spec]
+
+
+def default_compute_dtype(storage_dtype: str) -> str:
+    """What a manifest without a ``compute_dtype`` field means — the ONE
+    legacy-default site ``read_manifest`` applies."""
+    check_serving_dtype(storage_dtype)
+    return _DEFAULT_COMPUTE[storage_dtype]
+
+
+def compute_dtype(serving_spec: str):
+    """The ACTIVATION dtype a serving graph runs in for a given spec. Note
+    int8-compute still answers bf16: activations between layers stay bf16 —
+    the int8 part is the matmul/conv arithmetic inside the quant kernels,
+    which dynamically quantize their own inputs and hand back bf16."""
     import jax.numpy as jnp
 
-    check_serving_dtype(serving_dtype)
-    return jnp.float32 if serving_dtype == "float32" else jnp.bfloat16
+    check_serving_spec(serving_spec)
+    return jnp.float32 if serving_spec == "float32" else jnp.bfloat16
 
 
 def fingerprint_tree(tree) -> str:
@@ -109,24 +154,27 @@ def _walk(tree, path, fn):
     return fn(path, tree)
 
 
-def quantize_pytree(tree, serving_dtype: str) -> Tuple[Any, Dict]:
+def quantize_pytree(tree, serving_spec: str) -> Tuple[Any, Dict]:
     """Transform a (nested-dict) params/batch_stats pytree for export.
 
     Returns ``(qtree, section)`` where ``section`` is the manifest
-    ``quantization`` dict (dtype, per-tensor scale metadata, source
-    fingerprint). ``float32`` returns the tree untouched; ``bfloat16`` casts
-    floating leaves; ``int8`` replaces kernel leaves with
-    ``{__int8__, q, scale}`` records and casts the rest to bf16.
-    ``dequantize_pytree`` inverts the transform inside the traced graph.
+    ``quantization`` dict (dtype, compute_dtype, per-tensor scale metadata,
+    source fingerprint). ``float32`` returns the tree untouched;
+    ``bfloat16`` casts floating leaves; ``int8`` and ``int8-compute``
+    replace kernel leaves with ``{__int8__, q, scale}`` records and cast the
+    rest to bf16 — the two int8 specs produce IDENTICAL bytes; the
+    compute_dtype stamp is what tells the serving closure to trace through
+    the quant kernels instead of ``dequantize_pytree``'s bf16 upcast.
     """
     import jax.numpy as jnp
 
-    check_serving_dtype(serving_dtype)
+    storage_dtype, compute = parse_serving_spec(serving_spec)
     section: Dict[str, Any] = {
-        "dtype": serving_dtype,
+        "dtype": storage_dtype,
+        "compute_dtype": compute,
         "source_fingerprint": fingerprint_tree(tree),
     }
-    if serving_dtype == "float32":
+    if storage_dtype == "float32":
         return tree, section
 
     scales: Dict[str, Dict] = {}
@@ -136,7 +184,7 @@ def quantize_pytree(tree, serving_dtype: str) -> Tuple[Any, Dict]:
         if not np.issubdtype(arr.dtype, np.floating):
             return leaf  # int leaves (counters, ids) pass through untouched
         if (
-            serving_dtype == "int8"
+            storage_dtype == "int8"
             and path
             and path[-1] == _KERNEL_LEAF
             and arr.ndim >= 2
@@ -152,7 +200,7 @@ def quantize_pytree(tree, serving_dtype: str) -> Tuple[Any, Dict]:
         return jnp.asarray(arr, jnp.bfloat16)
 
     qtree = _walk(tree, (), convert)
-    if serving_dtype == "int8":
+    if storage_dtype == "int8":
         section["scheme"] = "per-channel-symmetric"
         section["scales"] = scales
     return qtree, section
@@ -185,13 +233,14 @@ def dequantize_pytree(qtree, dtype=None):
     return restore(qtree)
 
 
-def quantize_state(params, batch_stats, serving_dtype: str):
+def quantize_state(params, batch_stats, serving_spec: str):
     """The trainers' one-call entry: quantize params and batch_stats with a
     single manifest section whose fingerprint covers the PARAMS tree (the
-    identity a checkpoint is selected by)."""
-    qparams, section = quantize_pytree(params, serving_dtype)
+    identity a checkpoint is selected by). Accepts any SERVING_SPECS value
+    including ``int8-compute``."""
+    qparams, section = quantize_pytree(params, serving_spec)
     if batch_stats is not None:
-        qstats, _ = quantize_pytree(batch_stats, serving_dtype)
+        qstats, _ = quantize_pytree(batch_stats, serving_spec)
         # batch_stats never holds kernels: drop the redundant empty scale map
     else:
         qstats = None
@@ -227,6 +276,18 @@ def validate_quantization(section) -> Dict:
         raise ValueError(
             f"manifest quantization.dtype {dtype!r} not in {SERVING_DTYPES}"
         )
+    compute = section.get("compute_dtype")
+    if compute is not None:
+        # storage and compute are separate axes, but not every pairing is a
+        # thing that can be exported: f32/bf16 storage computes in its own
+        # dtype; int8 storage computes bf16 (dequantize-in-graph) or int8
+        # (quant kernels). Anything else is a corrupt or forged manifest.
+        allowed = ("bfloat16", "int8") if dtype == "int8" else (dtype,)
+        if compute not in allowed:
+            raise ValueError(
+                f"manifest quantization.compute_dtype {compute!r} invalid "
+                f"for storage dtype {dtype!r} (allowed: {allowed})"
+            )
     scales = section.get("scales")
     if dtype == "int8":
         if not isinstance(scales, dict) or not scales:

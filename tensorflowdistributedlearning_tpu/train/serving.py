@@ -243,9 +243,11 @@ def attach_compile_cache(
 def read_manifest(directory: str) -> Dict:
     """Read + validate an artifact manifest. The ONE site that applies the
     legacy defaults (pre-input_dtype manifests mean float32; no
-    ``quantization`` section means an unquantized float32 graph) and the one
-    gate that rejects corrupt quantization metadata — every consumer
-    (engine, loader, quantize-check, CLI) reads through here."""
+    ``quantization`` section means an unquantized float32 graph; a
+    quantization section without ``compute_dtype`` means the storage dtype's
+    historical arithmetic — f32/bf16/bf16-dequantized) and the one gate that
+    rejects corrupt quantization metadata — every consumer (engine, loader,
+    quantize-check, CLI) reads through here."""
     from tensorflowdistributedlearning_tpu.train import quantize
 
     with open(os.path.join(directory, MANIFEST_NAME)) as f:
@@ -253,4 +255,7 @@ def read_manifest(directory: str) -> Dict:
     manifest.setdefault("input_dtype", "float32")
     if "quantization" in manifest:
         quantize.validate_quantization(manifest["quantization"])
+        q = manifest["quantization"]
+        if "compute_dtype" not in q and q.get("dtype") in quantize.SERVING_DTYPES:
+            q["compute_dtype"] = quantize.default_compute_dtype(q["dtype"])
     return manifest

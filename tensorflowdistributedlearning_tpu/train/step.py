@@ -316,6 +316,20 @@ class SegmentationTask:
             "mask": (probs > self.threshold).astype(jnp.float32),
         }
 
+    def serve_predictions(self, logits: jax.Array) -> Dict[str, jax.Array]:
+        """The serving-closure head: same outputs as :meth:`predictions` but
+        through the fused sigmoid+threshold kernel — one HBM pass over the
+        logits instead of three, bit-identical by contract
+        (ops/pallas_kernels.py fused_sigmoid_mask). Only the serving export
+        path calls this; train/eval keep the plain ops, which XLA already
+        fuses into the surrounding step."""
+        from tensorflowdistributedlearning_tpu.ops.pallas_kernels import (
+            fused_sigmoid_mask,
+        )
+
+        probs, mask = fused_sigmoid_mask(logits, self.threshold)
+        return {"probabilities": probs, "mask": mask}
+
 
 @dataclasses.dataclass(frozen=True)
 class ClassificationTask:
@@ -367,6 +381,12 @@ class ClassificationTask:
     def predictions(self, logits: jax.Array) -> Dict[str, jax.Array]:
         probs = jax.nn.softmax(logits, axis=-1)
         return {"probabilities": probs, "class": jnp.argmax(logits, axis=-1)}
+
+    def serve_predictions(self, logits: jax.Array) -> Dict[str, jax.Array]:
+        """Serving head — classification has no fused variant (softmax+argmax
+        already fuse under XLA), so this is :meth:`predictions`; the method
+        exists so serving closures can call one name for every task."""
+        return self.predictions(logits)
 
 
 def _l2_penalty(params: Any) -> jax.Array:

@@ -936,19 +936,23 @@ class Trainer:
         (normalized + Laplacian channel, exactly what the reference's serving
         placeholder received).
 
-        ``serving_dtype`` selects the post-training precision recipe
-        (train/quantize.py): ``float32`` is the training graph unchanged,
-        ``bfloat16`` casts params/batch_stats and runs bf16 activations,
-        ``int8`` stores conv/dense kernels as int8 with per-channel scales
-        (dequantized to bf16 inside the graph). Wire contract is constant
-        across recipes: float32 in, float32 out. The returned closure carries
-        its manifest ``quantization`` section as ``serve.quantization``.
+        ``serving_dtype`` selects the post-training precision spec
+        (train/quantize.py SERVING_SPECS): ``float32`` is the training graph
+        unchanged, ``bfloat16`` casts params/batch_stats and runs bf16
+        activations, ``int8`` stores conv/dense kernels as int8 with
+        per-channel scales (dequantized to bf16 inside the graph), and
+        ``int8-compute`` stores the same bytes but traces dense/stride-1
+        conv layers through the int8-arithmetic kernels
+        (ops/quant_kernels.py). Wire contract is constant across specs:
+        float32 in, float32 out. The returned closure carries its manifest
+        ``quantization`` section as ``serve.quantization``.
 
         ``data_format="NCHW"`` is honored at this boundary: inputs arrive
         ``[B, C, H, W]`` and outputs return ``[B, 1, H, W]`` (the reference's NCHW
         mode transposed at the top of model_fn, model.py:344-351; on TPU, XLA owns
         the internal layout, so the transpose happens exactly once, here).
         """
+        from tensorflowdistributedlearning_tpu.ops import quant_kernels
         from tensorflowdistributedlearning_tpu.train import quantize
 
         state = self._restore_fold_or_raise(fold, self._init_state())
@@ -962,6 +966,7 @@ class Trainer:
             state.params, state.batch_stats, serving_dtype
         )
         act_dtype = quantize.compute_dtype(serving_dtype)
+        int8_compute = quant_section.get("compute_dtype") == "int8"
         task = self.task
         forward = self._forward
         nchw = self.train_config.data_format == "NCHW"
@@ -973,7 +978,15 @@ class Trainer:
                 params=quantize.dequantize_pytree(qparams, act_dtype),
                 batch_stats=quantize.dequantize_pytree(qstats, act_dtype),
             )
-            out = task.predictions(forward(st, images.astype(act_dtype)))
+            x = images.astype(act_dtype)
+            if int8_compute:
+                # quantized layers take the int8-compute kernels; layers
+                # outside the kernels' envelope keep the dequantized path
+                with quant_kernels.int8_intercept(qparams, act_dtype):
+                    logits = forward(st, x)
+            else:
+                logits = forward(st, x)
+            out = task.serve_predictions(logits)
             out = quantize.cast_outputs_float32(out)
             if nchw:
                 out = {k: jnp.transpose(v, (0, 3, 1, 2)) for k, v in out.items()}

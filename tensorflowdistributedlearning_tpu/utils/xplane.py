@@ -346,9 +346,15 @@ def plane_names(logdir: str) -> List[str]:
 # classifier (obs/profiler.py) keys its compute/HBM/collective split on the
 # SAME bucket names — one bucketing, two consumers
 DEFAULT_GROUPS: Dict[str, Tuple[str, ...]] = {
+    # Pallas kernels surface in device traces under their kernel function
+    # name ("_qmm_kernel", "_qconv_kernel", ...). The int8 matmul/conv run
+    # the MXU just like their XLA counterparts, so they must land in the
+    # compute buckets the roofline classifier keys on; "qconv" is caught by
+    # the "conv" needle, "qmm" needs its own. The fused epilogue/mask heads
+    # are single-HBM-pass elementwise work — same class as XLA fusions.
     "conv": ("convolution", "conv"),
-    "matmul": ("dot", "einsum"),
-    "fusion(elementwise/bn)": ("fusion",),
+    "matmul": ("dot", "einsum", "qmm"),
+    "fusion(elementwise/bn)": ("fusion", "fused_bias_act", "sigmoid_mask"),
     "collectives": (
         "all-reduce",
         "all-gather",

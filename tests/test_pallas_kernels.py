@@ -256,3 +256,117 @@ def test_fused_bn_act_validation():
         fused_bn_act(x, s, b, m, v, residual=jnp.zeros((1, 4, 4, 4)), interpret=True)
     with pytest.raises(ValueError, match="B, H, W, C"):
         fused_bn_act(jnp.zeros((4, 8)), s, b, m, v, interpret=True)
+
+
+# -- fused bias + activation (the shared epilogue's standalone face) -----------
+
+
+@pytest.mark.parametrize("act", ["none", "relu", "gelu"])
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_fused_bias_act_matches_reference(act, with_bias):
+    from tensorflowdistributedlearning_tpu.ops.pallas_kernels import (
+        fused_bias_act,
+        fused_bias_act_reference,
+    )
+
+    rng = np.random.default_rng(20)
+    x = jnp.asarray(rng.normal(0, 1, (3, 7, 128)), jnp.float32)
+    b = jnp.asarray(rng.normal(0, 0.2, (128,)), jnp.float32) if with_bias else None
+    got = fused_bias_act(x, b, act=act, interpret=True)
+    want = fused_bias_act_reference(x, b, act=act)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6)
+
+
+def test_fused_bias_act_row_tiling_and_fallback():
+    from tensorflowdistributedlearning_tpu.ops.pallas_kernels import (
+        fused_bias_act,
+        fused_bias_act_reference,
+    )
+
+    rng = np.random.default_rng(21)
+    x = jnp.asarray(rng.normal(0, 1, (16, 64)), jnp.float32)
+    b = jnp.asarray(rng.normal(0, 0.2, (64,)), jnp.float32)
+    want = fused_bias_act_reference(x, b, act="relu")
+    # budget admits a quarter of the rows: the grid must tile and stay exact
+    tiled = fused_bias_act(
+        x, b, act="relu", interpret=True, vmem_limit_bytes=4 * 64 * 8 + 1
+    )
+    np.testing.assert_array_equal(np.asarray(tiled), np.asarray(want))
+    # tiny budget: XLA fallback, still exact
+    fb = fused_bias_act(x, b, act="relu", interpret=True, vmem_limit_bytes=16)
+    np.testing.assert_array_equal(np.asarray(fb), np.asarray(want))
+
+
+def test_fused_bias_act_validation():
+    from tensorflowdistributedlearning_tpu.ops.pallas_kernels import fused_bias_act
+
+    with pytest.raises(ValueError, match="act"):
+        fused_bias_act(jnp.zeros((2, 4)), act="swish", interpret=True)
+    with pytest.raises(ValueError, match="bias"):
+        fused_bias_act(jnp.zeros((2, 4)), jnp.zeros((3,)), interpret=True)
+
+
+# -- fused sigmoid + threshold mask head (segmentation serve path) ------------
+
+
+def _mask_logits(shape=(2, 9, 9, 1), seed=22):
+    # spread logits across the threshold so some pixels land on each side,
+    # including values AT zero (sigmoid(0) == 0.5 exactly — the boundary the
+    # strict > must not flip)
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 2, shape).astype(np.float32)
+    x.flat[:3] = 0.0
+    return jnp.asarray(x)
+
+
+@pytest.mark.parametrize("threshold", [0.5, 0.3])
+def test_fused_sigmoid_mask_bit_identical(threshold):
+    """The contract the serve head relies on: fusing is a memory-traffic
+    change, not a numerics change — BITWISE equality with the unfused ops."""
+    from tensorflowdistributedlearning_tpu.ops.pallas_kernels import (
+        fused_sigmoid_mask,
+        fused_sigmoid_mask_reference,
+    )
+
+    logits = _mask_logits()
+    p_ref, m_ref = fused_sigmoid_mask_reference(logits, threshold)
+    for kwargs in ({"interpret": True}, {}):  # kernel body AND auto-fallback
+        probs, mask = fused_sigmoid_mask(logits, threshold, **kwargs)
+        assert probs.dtype == logits.dtype and mask.dtype == jnp.float32
+        np.testing.assert_array_equal(np.asarray(probs), np.asarray(p_ref))
+        np.testing.assert_array_equal(np.asarray(mask), np.asarray(m_ref))
+        assert set(np.unique(np.asarray(mask))) <= {0.0, 1.0}
+
+
+def test_fused_sigmoid_mask_vmem_and_rank_fallbacks():
+    from tensorflowdistributedlearning_tpu.ops.pallas_kernels import (
+        fused_sigmoid_mask,
+        fused_sigmoid_mask_reference,
+    )
+
+    logits = _mask_logits((2, 64, 64, 1), seed=23)
+    p_ref, m_ref = fused_sigmoid_mask_reference(logits, 0.5)
+    p, m = fused_sigmoid_mask(logits, 0.5, interpret=True, vmem_limit_bytes=128)
+    np.testing.assert_array_equal(np.asarray(p), np.asarray(p_ref))
+    np.testing.assert_array_equal(np.asarray(m), np.asarray(m_ref))
+    v = jnp.asarray([0.0, -1.0, 3.0], jnp.float32)  # rank-1: reference path
+    p1, m1 = fused_sigmoid_mask(v, 0.5, interpret=True)
+    pr, mr = fused_sigmoid_mask_reference(v, 0.5)
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(pr))
+    np.testing.assert_array_equal(np.asarray(m1), np.asarray(mr))
+
+
+def test_segmentation_serve_predictions_uses_fused_head():
+    """SegmentationTask.serve_predictions must agree bitwise with the
+    training-path predictions() dict — same probabilities, same mask."""
+    from tensorflowdistributedlearning_tpu.train.step import SegmentationTask
+
+    task = SegmentationTask()
+    logits = _mask_logits((2, 5, 5, 1), seed=24)
+    served = task.serve_predictions(logits)
+    trained = task.predictions(logits)
+    assert set(served) == set(trained)
+    for k in served:
+        np.testing.assert_array_equal(
+            np.asarray(served[k]), np.asarray(trained[k])
+        )

@@ -138,8 +138,36 @@ def test_bf16_and_float32_recipes():
     # float32 is the identity recipe: the very same leaves, zero graph drift
     assert f32["dense1"]["kernel"] is tree["dense1"]["kernel"]
     assert section["dtype"] == "float32"
-    with pytest.raises(ValueError, match="serving_dtype"):
+    with pytest.raises(ValueError, match="serving spec"):
         quantize.quantize_pytree(tree, "fp8")
+
+
+def test_serving_spec_axis():
+    """The (storage, compute) spec axis: every legacy dtype keeps its
+    historical arithmetic; int8-compute is int8 storage + int8 arithmetic
+    and produces BYTE-IDENTICAL quantized leaves to int8 storage (same
+    export recipe — only the traced graph differs)."""
+    assert quantize.parse_serving_spec("float32") == ("float32", "float32")
+    assert quantize.parse_serving_spec("bfloat16") == ("bfloat16", "bfloat16")
+    assert quantize.parse_serving_spec("int8") == ("int8", "bfloat16")
+    assert quantize.parse_serving_spec("int8-compute") == ("int8", "int8")
+    assert quantize.default_compute_dtype("int8") == "bfloat16"
+    with pytest.raises(ValueError, match="serving spec"):
+        quantize.check_serving_spec("int4-compute")
+    tree = make_params()
+    q_store, s_store = quantize.quantize_pytree(tree, "int8")
+    q_comp, s_comp = quantize.quantize_pytree(tree, "int8-compute")
+    assert s_store["dtype"] == s_comp["dtype"] == "int8"
+    assert s_store["compute_dtype"] == "bfloat16"
+    assert s_comp["compute_dtype"] == "int8"
+    np.testing.assert_array_equal(
+        np.asarray(q_store["dense1"]["kernel"]["q"]),
+        np.asarray(q_comp["dense1"]["kernel"]["q"]),
+    )
+    # invalid pairings die in validation, not downstream
+    bad = dict(s_comp, compute_dtype="float32")
+    with pytest.raises(ValueError, match="compute_dtype"):
+        quantize.validate_quantization(bad)
 
 
 def test_int8_only_quantizes_kernels():
@@ -601,3 +629,214 @@ def test_cli_train_serving_dtype_flag():
             ["train", "--data-dir", "d", "--model-dir", "m",
              "--serving-dtype", "fp4"]
         )
+
+
+# -- int8-compute: real int8 arithmetic on the serve path ---------------------
+
+COMPUTE_FEATURES = 64
+COMPUTE_HIDDEN = 512
+
+
+def make_flax_net():
+    """A flax module (not raw matmuls): int8-compute routes through the
+    nn.intercept_methods hook, so the closure must apply real nn.Dense."""
+    from flax import linen as nn
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(COMPUTE_HIDDEN, name="dense1")(x)
+            x = nn.relu(x)
+            return nn.Dense(CLASSES, name="dense2")(x)
+
+    return Net()
+
+
+def export_compute_precision(params, net, spec, directory):
+    """The trainers' serving-closure shape for the full spec axis: quantize
+    once, trace under int8_intercept when the section says int8 compute."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowdistributedlearning_tpu.ops import quant_kernels
+
+    qtree, section = quantize.quantize_pytree(params, spec)
+    act = quantize.compute_dtype(spec)
+    int8c = section.get("compute_dtype") == "int8"
+
+    def serve(x):
+        p = (
+            params
+            if spec == "float32"
+            else quantize.dequantize_pytree(qtree, act)
+        )
+        xx = x.astype(act)
+        if int8c:
+            with quant_kernels.int8_intercept(qtree, act):
+                logits = net.apply({"params": p}, xx)
+        else:
+            logits = net.apply({"params": p}, xx)
+        out = {"probabilities": jax.nn.softmax(logits.astype(jnp.float32), -1)}
+        return quantize.cast_outputs_float32(out)
+
+    serving_lib.export_serving_artifact(
+        serve, (1, COMPUTE_FEATURES), str(directory), quantization=section
+    )
+    return str(directory)
+
+
+@pytest.fixture(scope="module")
+def compute_artifacts(tmp_path_factory):
+    """f32 / int8-store / int8-compute artifacts from the same flax params —
+    weights big enough that at-rest sizes mean something."""
+    import jax
+
+    root = tmp_path_factory.mktemp("compute_artifacts")
+    net = make_flax_net()
+    x0 = np.zeros((1, COMPUTE_FEATURES), np.float32)
+    params = net.init(jax.random.PRNGKey(3), x0)["params"]
+    return {
+        spec: export_compute_precision(params, net, spec, root / spec)
+        for spec in ("float32", "int8", "int8-compute")
+    }
+
+
+def test_int8_compute_manifest_roundtrip(compute_artifacts):
+    expected = {"float32": "float32", "int8": "bfloat16", "int8-compute": "int8"}
+    for spec, directory in compute_artifacts.items():
+        q = serving_lib.read_manifest(directory)["quantization"]
+        assert q["compute_dtype"] == expected[spec], spec
+    # legacy manifests (no compute_dtype) get the historical arithmetic
+    # filled in at the ONE defaulting site
+    import shutil
+
+    d = compute_artifacts["int8"]
+    with open(os.path.join(d, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["quantization"].pop("compute_dtype") == "bfloat16"
+    legacy = d + "-legacy"
+    shutil.copytree(d, legacy, dirs_exist_ok=True)
+    with open(os.path.join(legacy, "manifest.json"), "w") as f:
+        json.dump(manifest, f)
+    q = serving_lib.read_manifest(legacy)["quantization"]
+    assert q["compute_dtype"] == "bfloat16"
+
+
+def test_int8_compute_serves_recompile_free(compute_artifacts, rng):
+    """The bucket-ladder contract extends to the quant-kernel graph: warmup
+    compiles the ladder, then NO request batch size compiles anything — and
+    the engine self-describes the arithmetic via compute_dtype."""
+    detector = RecompileDetector().attach()
+    try:
+        engine = InferenceEngine.from_artifact(
+            compute_artifacts["int8-compute"], buckets=(1, 4, 8)
+        )
+        assert engine.compute_dtype == "int8"
+        engine.warmup()
+        assert detector.compile_count >= 1
+        detector.mark_warm()
+        for n in range(1, 9):
+            engine.infer(
+                rng.normal(0, 1, (n, COMPUTE_FEATURES)).astype(np.float32)
+            )
+        assert detector.post_warmup_count == 0
+    finally:
+        detector.detach()
+    # store-only int8 keeps its historical self-description
+    store = InferenceEngine.from_artifact(compute_artifacts["int8"], buckets=(1,))
+    assert store.compute_dtype == "bfloat16"
+
+
+def test_int8_compute_outputs_track_f32(compute_artifacts, rng):
+    x = rng.normal(0, 1, (5, COMPUTE_FEATURES)).astype(np.float32)
+    ref = InferenceEngine.from_artifact(
+        compute_artifacts["float32"], buckets=(8,)
+    ).infer(x)
+    got = InferenceEngine.from_artifact(
+        compute_artifacts["int8-compute"], buckets=(8,)
+    ).infer(x)
+    assert got["probabilities"].dtype == np.float32  # wire contract holds
+    np.testing.assert_allclose(
+        got["probabilities"], ref["probabilities"], atol=0.05
+    )
+
+
+def test_int8_compute_warmup_ledger_stamps_compute_dtype(
+    compute_artifacts, tmp_path
+):
+    from tensorflowdistributedlearning_tpu.obs import read_ledger
+
+    workdir = str(tmp_path / "ledger")
+    tel = Telemetry(workdir, run_info={"kind": "serve"})
+    try:
+        engine = InferenceEngine.from_artifact(
+            compute_artifacts["int8-compute"], buckets=(1,)
+        )
+        engine.warmup(telemetry=tel)
+    finally:
+        tel.close()
+    warm = next(
+        e for e in read_ledger(workdir) if e["event"] == "serve_warmup"
+    )
+    assert warm["serving_dtype"] == "int8"
+    assert warm["compute_dtype"] == "int8"
+
+
+def test_int8_compute_artifact_small_at_rest(compute_artifacts):
+    """int8-compute must keep int8-store's at-rest economics: the quant
+    kernels consume the int8 records DIRECTLY (jnp.asarray before any
+    astype), so no trace-time eager upcast re-embeds f32 constants."""
+    sizes = {
+        spec: os.path.getsize(os.path.join(d, "serving.stablehlo"))
+        for spec, d in compute_artifacts.items()
+    }
+    assert sizes["int8-compute"] < sizes["float32"] * 0.35
+    assert sizes["int8-compute"] < sizes["int8"] * 1.15
+
+
+def test_quant_check_int8_compute_budget(compute_artifacts):
+    """The gate compares int8-compute output against the F32 REFERENCE
+    artifact — the real serving arithmetic, not the dequantize-f32 twin —
+    under the wider int8-compute budget keyed off the manifest pair."""
+    from tensorflowdistributedlearning_tpu.serve.quant_check import budget_key
+
+    assert budget_key({"dtype": "int8", "compute_dtype": "int8"}) == "int8-compute"
+    assert budget_key({"dtype": "int8", "compute_dtype": "bfloat16"}) == "int8"
+    assert budget_key({"dtype": "int8"}) == "int8"
+    assert budget_key(None) == "float32"
+    result = run_quant_check(
+        compute_artifacts["float32"], compute_artifacts["int8-compute"]
+    )
+    assert result["passed"], result["failures"]
+    assert result["dtype"] == "int8-compute"
+    assert result["fingerprint_match"] is True
+
+
+def test_scratch_dtype_follows_input_dtype(rng):
+    """Satellite of the int8-compute path: the pad scratch allocates in the
+    engine's WIRE dtype. An int8-input artifact must not get a silent f32
+    scratch upcast (4x the pad traffic and a dtype mismatch at dispatch)."""
+    engine = InferenceEngine(
+        lambda x: {"y": np.asarray(x, np.float32) * 2.0},
+        (FEATURES,),
+        buckets=(4,),
+        input_dtype="int8",
+    )
+    x = (rng.integers(-5, 5, (2, FEATURES))).astype(np.int8)
+    out = engine.infer(x)
+    assert engine._scratch.bufs[4].dtype == np.int8
+    np.testing.assert_allclose(out["y"], x.astype(np.float32) * 2.0)
+    # rebinding the wire dtype REALLOCATES rather than serving stale-dtype rows
+    engine.input_dtype = np.dtype("float32")
+    engine.infer(rng.normal(0, 1, (2, FEATURES)).astype(np.float32))
+    assert engine._scratch.bufs[4].dtype == np.float32
+
+
+def test_cli_serving_dtype_accepts_int8_compute():
+    from tensorflowdistributedlearning_tpu.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["train", "--data-dir", "d", "--model-dir", "m",
+         "--export-serving", "--serving-dtype", "int8-compute"]
+    )
+    assert args.serving_dtype == "int8-compute"

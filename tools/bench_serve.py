@@ -148,7 +148,11 @@ def make_quant_model_params():
 def export_quant_artifact(params, serving_dtype: str, directory: str) -> str:
     """Export the quant-A/B model at one precision through the REAL seam:
     quantize the params tree, bake dequantization into the serve closure,
-    serialize with the manifest ``quantization`` section."""
+    serialize with the manifest ``quantization`` section. The
+    ``int8-compute`` spec traces the same model as a flax net under
+    ``int8_intercept`` — the identical seam the trainers' serving closures
+    use — so the artifact's graph runs the quant kernels (TPU) or their
+    dequantize-f32 fallback (CPU), not the dequantize-in-graph path."""
     import jax
     import jax.numpy as jnp
 
@@ -157,6 +161,33 @@ def export_quant_artifact(params, serving_dtype: str, directory: str) -> str:
 
     qtree, section = quantize.quantize_pytree(params, serving_dtype)
     act_dtype = quantize.compute_dtype(serving_dtype)
+
+    if section.get("compute_dtype") == "int8":
+        from flax import linen as nn
+
+        from tensorflowdistributedlearning_tpu.ops import quant_kernels
+
+        class _QuantNet(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                h = nn.relu(nn.Dense(QUANT_HIDDEN, name="dense1")(x))
+                return nn.Dense(CLASSES, name="dense2", use_bias=False)(h)
+
+        net = _QuantNet()
+
+        def serve(x):
+            p = quantize.dequantize_pytree(qtree, act_dtype)
+            with quant_kernels.int8_intercept(qtree, act_dtype):
+                logits = net.apply({"params": p}, x.astype(act_dtype))
+            out = {
+                "probabilities": jax.nn.softmax(logits, axis=-1),
+                "class": jnp.argmax(logits, axis=-1),
+            }
+            return quantize.cast_outputs_float32(out)
+
+        return serving_lib.export_serving_artifact(
+            serve, (1, FEATURES), directory, quantization=section
+        )
 
     def serve(x):
         p = quantize.dequantize_pytree(qtree, act_dtype)
@@ -238,6 +269,14 @@ def quant_precision_ab(args, telemetry) -> dict:
                 os.path.join(directory, serving_lib.ARTIFACT_NAME)
             )
             entry["post_warmup_recompiles"] = detector.post_warmup_count
+            if entry.get("requests_per_sec"):
+                from tensorflowdistributedlearning_tpu.obs import (
+                    capacity as capacity_lib,
+                )
+
+                entry["rps_per_chip"] = round(
+                    entry["requests_per_sec"] / capacity_lib.device_count(), 1
+                )
         finally:
             detector.detach()
         section["precisions"][dtype] = entry
@@ -272,6 +311,20 @@ def quant_precision_ab(args, telemetry) -> dict:
             entry["artifact_bytes_ratio_vs_f32"] = round(
                 entry["artifact_bytes"] / f32["artifact_bytes"], 3
             )
+    # the storage-vs-compute delta: what switching the ARITHMETIC (not the
+    # bytes — both artifacts store identical int8 records) buys or costs
+    store = section["precisions"].get("int8", {})
+    comp = section["precisions"].get("int8-compute", {})
+    if store.get("requests_per_sec") and comp.get("requests_per_sec"):
+        comp["speedup_vs_int8_store"] = round(
+            comp["requests_per_sec"] / store["requests_per_sec"], 3
+        )
+        comp["p99_ratio_vs_int8_store"] = round(
+            comp["latency_ms"]["p99"] / store["latency_ms"]["p99"], 3
+        )
+        comp["artifact_bytes_ratio_vs_int8_store"] = round(
+            comp["artifact_bytes"] / store["artifact_bytes"], 3
+        )
     return section
 
 
@@ -1387,8 +1440,8 @@ def main() -> int:
     parser.add_argument("--min-speedup", type=float, default=3.0)
     parser.add_argument("--quant", action="store_true",
                         help="add the per-precision serving A/B: export "
-                        "f32/bf16/int8 artifacts through the real "
-                        "quantized-serving seam, drive identical load "
+                        "f32/bf16/int8/int8-compute artifacts through the "
+                        "real quantized-serving seam, drive identical load "
                         "through each, run the quantize-check accuracy "
                         "gate (record section: precisions)")
     parser.add_argument("--quant-only", action="store_true",
@@ -1396,14 +1449,23 @@ def main() -> int:
                         "skips the batching A/B + backpressure probe) — "
                         "the fast CI gate mode")
     parser.add_argument("--quant-dtypes", nargs="+",
-                        default=("float32", "bfloat16", "int8"),
-                        choices=("float32", "bfloat16", "int8"))
+                        default=("float32", "bfloat16", "int8",
+                                 "int8-compute"),
+                        choices=("float32", "bfloat16", "int8",
+                                 "int8-compute"))
     parser.add_argument("--min-quant-speedup", type=float, default=None,
                         help="--check floor for bf16-vs-f32 throughput at "
                         "no-worse p99; default 1.5 on TPU (the HBM win the "
                         "path exists for), 0.8 elsewhere (XLA:CPU upcasts "
                         "bf16 — the tripwire just catches a quantized path "
                         "that got materially slower)")
+    parser.add_argument("--min-int8-compute-ratio", type=float, default=None,
+                        help="--check floor for int8-compute-vs-int8-store "
+                        "throughput at no-worse p99; default 1.0 on TPU "
+                        "(the MXU int8 win the kernels exist for), 0.9 "
+                        "elsewhere (CPU serves the dequantize-f32 fallback "
+                        "— near-parity expected, the tripwire catches a "
+                        "fallback that got materially slower)")
     parser.add_argument("--fleet", action="store_true",
                         help="add the serving-tier soak: sweep replica "
                         "counts through real subprocess fleets behind the "
@@ -1638,6 +1700,22 @@ def main() -> int:
             )
         record["quant"] = quant
 
+        # the kernel-vs-XLA microbench column the sentinel's ``kernels``
+        # gate replays: real Pallas int8/fused kernels on TPU (speedup
+        # floor), the dispatch-overhead tripwire off-TPU (both sides run
+        # the same dequantize-f32 fallback, so the ratio pins ~1.0)
+        import bench_kernels as bench_kernels_mod
+
+        if jax.default_backend() == "tpu":
+            kernels = bench_kernels_mod.bench_quant()
+        else:
+            kernels = bench_kernels_mod.bench_quant(
+                batch=16, features=128, hw=7, conv_channels=16, mask_hw=33,
+                iters=4, warmup=2, repeats=4,
+            )
+        kernels["platform"] = jax.default_backend()
+        record["kernels"] = kernels
+
     if args.fleet:
         record["fleet"] = fleet_soak(args, telemetry)
 
@@ -1789,6 +1867,23 @@ def _check_quant(quant: dict, args) -> list:
         elif bf16.get("p99_ratio_vs_f32", 1.0) > 1.25:
             problems.append(
                 f"bf16 p99 regressed {bf16['p99_ratio_vs_f32']}x vs f32 — "
+                "throughput at degraded latency does not count"
+            )
+    comp = quant["precisions"].get("int8-compute", {})
+    if comp.get("speedup_vs_int8_store") is not None:
+        min_ratio = args.min_int8_compute_ratio
+        if min_ratio is None:
+            min_ratio = 1.0 if jax.default_backend() == "tpu" else 0.9
+        if comp["speedup_vs_int8_store"] < min_ratio:
+            problems.append(
+                f"int8-compute throughput {comp['speedup_vs_int8_store']}x "
+                f"vs int8-store < required {min_ratio} on "
+                f"{jax.default_backend()}"
+            )
+        elif comp.get("p99_ratio_vs_int8_store", 1.0) > 1.25:
+            problems.append(
+                f"int8-compute p99 regressed "
+                f"{comp['p99_ratio_vs_int8_store']}x vs int8-store — "
                 "throughput at degraded latency does not count"
             )
     return problems

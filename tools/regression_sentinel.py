@@ -37,6 +37,14 @@ end-to-end decode ratio are dimensionless floors, and a ``--fresh-records``
 record additionally gates per-worker records/sec against machine-drift
 slack.
 
+The ``kernels`` bench REPLAYS the committed BENCH_SERVE.json ``kernels`` +
+``quant`` sections (bench_serve --quant records both): per-kernel speedup vs
+the XLA twin (floor 1.0 on TPU where the Pallas int8/fused kernels must win;
+a 0.5 dispatch tripwire off-TPU where both sides run the same dequantize-f32
+fallback), int8-compute rps/chip >= int8-store at no-worse p99, and — hard —
+zero post-warmup recompiles plus a passing quantize-check for the
+int8-compute artifact.
+
 The ``fleet`` bench REPLAYS the committed BENCH_SERVE.json ``fleet`` section
 (bench_serve --fleet is too heavy for every CI run): the committed 2-replica
 scaling must clear the 1.6x floor, every replica must report zero post-warmup
@@ -198,6 +206,105 @@ def check_serve(
             "serve", "post_warmup_recompiles", 0, recompiles,
             "== 0 (hard)", recompiles == 0,
         ))
+    return out
+
+
+# the quant-kernel acceptance bars (BENCH_SERVE.json ``kernels`` + ``quant``
+# sections): on TPU the Pallas int8/fused kernels must BEAT their XLA twins
+# (that win is why they exist); off-TPU both comparison sides run the same
+# dequantize-f32 fallback, so the ratio is a dispatch-overhead tripwire —
+# 0.5 fails only the catastrophic class (a wrapper that doubled the cost)
+# while tolerating tiny-shape scheduling noise on shared runners. The
+# int8-compute-vs-int8-store serving ratio is the ISSUE-20 acceptance bar:
+# >= 1.0 on TPU (the MXU win), >= 0.9 off-TPU (the fallback must stay near
+# parity with dequantize-in-graph or the spec costs CPU users real rps).
+DEFAULT_KERNEL_TPU_SPEEDUP_FLOOR = 1.0
+DEFAULT_KERNEL_CPU_SPEEDUP_FLOOR = 0.5
+DEFAULT_INT8_COMPUTE_TPU_RATIO_FLOOR = 1.0
+DEFAULT_INT8_COMPUTE_CPU_RATIO_FLOOR = 0.9
+
+
+def check_kernels(
+    baseline: Dict,
+    fresh: Optional[Dict] = None,
+    *,
+    tpu_speedup_floor: float = DEFAULT_KERNEL_TPU_SPEEDUP_FLOOR,
+    cpu_speedup_floor: float = DEFAULT_KERNEL_CPU_SPEEDUP_FLOOR,
+) -> List[Dict]:
+    """Replay the BENCH_SERVE.json quant-kernel gates (bench_serve --quant
+    records both sections; too heavy to re-run every CI pass):
+
+    - per-kernel speedup vs the XLA twin (``kernels`` section): floor 1.0
+      on TPU, the 0.5 dispatch tripwire elsewhere — dimensionless, no
+      machine slack;
+    - int8-compute rps/chip >= int8-store x platform floor at no-worse p99
+      (``quant.precisions``): switching the arithmetic must never cost
+      throughput against the storage-only artifact it replaces;
+    - zero post-warmup recompiles serving the int8-compute artifact and a
+      passing quantize-check verdict — both HARD (correctness).
+
+    A ``--fresh-serve`` record carrying its own sections is gated instead.
+    """
+    record = baseline
+    if fresh and (fresh.get("kernels") or fresh.get("quant")):
+        record = fresh
+    kernels = record.get("kernels")
+    quant = record.get("quant") or {}
+    out: List[Dict] = []
+    if not kernels and not quant:
+        raise ValueError(
+            "no kernels/quant sections in the serve record — run "
+            "tools/bench_serve.py --quant and commit the refreshed baseline"
+        )
+    if kernels:
+        on_tpu = kernels.get("platform") == "tpu"
+        floor = tpu_speedup_floor if on_tpu else cpu_speedup_floor
+        label = "tpu kernel floor" if on_tpu else "cpu dispatch tripwire"
+        for name in ("matmul", "conv", "sigmoid_mask"):
+            entry = kernels.get(name) or {}
+            speedup = entry.get("speedup")
+            if speedup is None:
+                continue
+            out.append(_finding(
+                "kernels", f"{name}.speedup_vs_xla", floor, speedup,
+                f">= {floor} ({label})", speedup >= floor,
+            ))
+    precisions = quant.get("precisions") or {}
+    comp = precisions.get("int8-compute") or {}
+    store = precisions.get("int8") or {}
+    comp_rpc = comp.get("rps_per_chip") or comp.get("requests_per_sec")
+    store_rpc = store.get("rps_per_chip") or store.get("requests_per_sec")
+    if comp_rpc and store_rpc:
+        on_tpu = quant.get("backend") == "tpu"
+        floor = (
+            DEFAULT_INT8_COMPUTE_TPU_RATIO_FLOOR
+            if on_tpu
+            else DEFAULT_INT8_COMPUTE_CPU_RATIO_FLOOR
+        )
+        ratio = round(comp_rpc / store_rpc, 3)
+        out.append(_finding(
+            "kernels", "int8_compute.rps_per_chip_vs_int8_store",
+            floor, ratio, f">= {floor}", ratio >= floor,
+        ))
+        p99_ratio = comp.get("p99_ratio_vs_int8_store")
+        if p99_ratio is not None:
+            out.append(_finding(
+                "kernels", "int8_compute.p99_ratio_vs_int8_store",
+                1.25, p99_ratio, "<= 1.25", p99_ratio <= 1.25,
+            ))
+    if comp:
+        recompiles = comp.get("post_warmup_recompiles")
+        out.append(_finding(
+            "kernels", "int8_compute.post_warmup_recompiles",
+            0, recompiles, "== 0 (hard)", recompiles == 0,
+        ))
+        verdict = (quant.get("quant_check") or {}).get("int8-compute")
+        if verdict is not None:
+            out.append(_finding(
+                "kernels", "int8_compute.quant_check_passed",
+                True, verdict.get("passed"), "== True (hard)",
+                bool(verdict.get("passed")),
+            ))
     return out
 
 
@@ -906,7 +1013,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "gate)")
     parser.add_argument("--benches",
                         default="async,serve,fleet,records,promotion,"
-                        "multitenant,plan,elastic,profile,loop,coldstart",
+                        "multitenant,plan,elastic,profile,loop,coldstart,"
+                        "kernels",
                         help="comma-separated subset to check")
     parser.add_argument("--baseline-async",
                         default=os.path.join(REPO, "BENCH_ASYNC.json"))
@@ -1059,6 +1167,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             findings += check_fleet(baseline, fresh)
         except (OSError, ValueError) as e:
             errors.append(f"fleet: {e}")
+    if "kernels" in benches:
+        try:
+            baseline = _load(args.baseline_serve)
+            fresh = _load(args.fresh_serve) if args.fresh_serve else None
+            findings += check_kernels(baseline, fresh)
+        except (OSError, ValueError) as e:
+            errors.append(f"kernels: {e}")
     if "promotion" in benches:
         try:
             baseline = _load(args.baseline_serve)
